@@ -1,0 +1,270 @@
+"""Core runtime state and the ``init``/``rank``/``size`` API family.
+
+TPU-native equivalent of the reference's Python core
+(``horovod/common/basics.py`` ``HorovodBasics`` — SURVEY.md §2b P1) fused with
+the C++ ``InitializeHorovodOnce`` bootstrap (``horovod/common/operations.cc``
+— SURVEY.md §2a N1).  Where the reference ctypes into a C++ global state, we
+keep a Python-side ``GlobalState`` that owns the topology, process-set table,
+config, timeline and the collective engine; the native TCP controller (multi-
+process mode) is attached underneath when launched by ``torovodrun``.
+
+Rank model (see ``topology.py``): a rank is a device.  In multi-process
+launches (one process per device, or one per host) ``rank()`` returns this
+process's first device's global rank, matching Horovod's process-rank
+semantics; in single-process SPMD mode ``rank()`` is 0 and per-rank identity
+lives inside ``shard_map`` (``ops.axis_rank``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+
+from .config import Config
+from .process_sets import ProcessSet, ProcessSetTable, global_process_set
+from .topology import Topology, build_topology
+
+
+class NotInitializedError(RuntimeError):
+    def __init__(self):
+        super().__init__("horovod_tpu has not been initialized; call hvd.init() first.")
+
+
+class GlobalState:
+    def __init__(self):
+        self.initialized = False
+        self.config: Optional[Config] = None
+        self.topology: Optional[Topology] = None
+        self.process_set_table = ProcessSetTable()
+        self.engine = None          # ops.engine.CollectiveEngine
+        self.timeline = None        # utils.timeline.Timeline
+        self.controller = None      # multi-process TCP controller client
+        self._lock = threading.Lock()
+
+
+_state = GlobalState()
+
+
+def _get_state() -> GlobalState:
+    return _state
+
+
+def init(process_sets: Optional[Sequence[ProcessSet]] = None,
+         devices=None,
+         axis_name: str = "hvd") -> None:
+    """Initialize the runtime.  Idempotent, like ``hvd.init()``.
+
+    Equivalent call stack in the reference: SURVEY.md §3.1 — env parsing,
+    controller selection, background thread spawn.  Here: parse config,
+    build the device topology/mesh, register process sets, start the
+    collective engine (cycle thread + fusion + cache), connect to the
+    launcher's controller when running multi-process.
+    """
+    st = _state
+    with st._lock:
+        if st.initialized:
+            return
+        st.config = Config.from_env()
+
+        # Multi-process bootstrap: when the launcher exported a coordinator
+        # address and jax.distributed has not been initialized, do it now so
+        # all processes share one global device world.
+        cfg = st.config
+        if (cfg.controller_addr and cfg.size_env > 0
+                and jax.process_count() == 1 and cfg.size_env > 1):
+            jax.distributed.initialize(
+                coordinator_address=f"{cfg.controller_addr}:{cfg.controller_port}",
+                num_processes=cfg.size_env,
+                process_id=cfg.rank_env,
+            )
+
+        st.topology = build_topology(axis_name=axis_name, devices=devices)
+        gs = st.process_set_table.initialize(
+            st.topology.devices, axis_name, extra_sets=process_sets)
+        # Rebind the module-level global_process_set singleton.
+        global_process_set.__dict__.update(gs.__dict__)
+        st.process_set_table._sets[0] = global_process_set
+
+        from ..utils.timeline import Timeline
+        st.timeline = Timeline(cfg.timeline_filename,
+                               mark_cycles=cfg.timeline_mark_cycles)
+
+        from ..ops.engine import CollectiveEngine
+        st.engine = CollectiveEngine(st)
+        st.engine.start()
+
+        st.initialized = True
+
+
+def shutdown() -> None:
+    st = _state
+    with st._lock:
+        if not st.initialized:
+            return
+        if st.engine is not None:
+            st.engine.stop()
+            st.engine = None
+        if st.timeline is not None:
+            st.timeline.close()
+            st.timeline = None
+        st.initialized = False
+        st.topology = None
+
+
+atexit.register(shutdown)
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def _topo() -> Topology:
+    if not _state.initialized or _state.topology is None:
+        raise NotInitializedError()
+    return _state.topology
+
+
+def _cfg() -> Config:
+    cfg = _state.config
+    assert cfg is not None
+    return cfg
+
+
+def size() -> int:
+    """Global number of ranks (devices), like ``hvd.size()``."""
+    return _topo().size
+
+
+def rank() -> int:
+    """This process's rank.
+
+    Launcher-provided HOROVOD_RANK wins (one-process-per-device launches);
+    otherwise the global rank of this process's first local device.
+    """
+    t = _topo()
+    env = _cfg().rank_env
+    if env >= 0:
+        return env
+    mine = t.ranks_of_process(t.my_process)
+    return mine[0] if mine else 0
+
+
+def local_size() -> int:
+    env = _cfg().local_size_env
+    return env if env > 0 else _topo().local_size
+
+
+def local_rank() -> int:
+    """Rank of this process's first device within its host.
+
+    Launcher-provided HOROVOD_LOCAL_RANK wins (it knows host boundaries
+    even when several single-device processes share one physical host);
+    otherwise derived from the device topology.
+    """
+    env = _cfg().local_rank_env
+    if env >= 0:
+        return env
+    t = _topo()
+    mine = t.ranks_of_process(t.my_process)
+    if not mine:
+        return 0
+    return t.local_rank_of[mine[0]]
+
+
+def cross_size() -> int:
+    """Number of hosts, like ``hvd.cross_size()``."""
+    env = _cfg().cross_size_env
+    return env if env > 0 else _topo().num_processes
+
+
+def cross_rank() -> int:
+    env = _cfg().cross_rank_env
+    return env if env >= 0 else _topo().my_process
+
+
+def mesh():
+    """The global 1-D world mesh (axis name = ``hvd``)."""
+    return _topo().mesh
+
+
+def is_homogeneous() -> bool:
+    t = _topo()
+    return all(c == t.local_counts[0] for c in t.local_counts)
+
+
+def add_process_set(ps_or_ranks) -> ProcessSet:
+    st = _state
+    if not st.initialized:
+        raise NotInitializedError()
+    ps = ps_or_ranks if isinstance(ps_or_ranks, ProcessSet) else ProcessSet(ps_or_ranks)
+    assert st.topology is not None and st.config is not None
+    return st.process_set_table.add(ps, st.topology.devices, st.config.mesh_axis_name)
+
+
+def remove_process_set(ps: ProcessSet):
+    if not _state.initialized:
+        raise NotInitializedError()
+    _state.process_set_table.remove(ps)
+
+
+def process_set_included(ps: ProcessSet) -> bool:
+    return ps.included(rank())
+
+
+# Capability probes, for API parity with HorovodBasics (reference
+# horovod/common/basics.py: nccl_built/mpi_enabled/...).  On TPU the data
+# plane is always XLA collectives, so these report the analogous truths.
+def xla_built() -> bool:
+    return True
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def tpu_available() -> bool:
+    return any(d.platform == "tpu" for d in jax.devices())
+
+
+def start_timeline(filename: str, mark_cycles: bool = False):
+    """Begin writing a Chrome-trace timeline (reference: timeline.cc N10)."""
+    st = _state
+    if not st.initialized:
+        raise NotInitializedError()
+    from ..utils.timeline import Timeline
+    if st.timeline is not None:
+        st.timeline.close()
+    st.timeline = Timeline(filename, mark_cycles=mark_cycles)
+
+
+def stop_timeline():
+    st = _state
+    if not st.initialized:
+        raise NotInitializedError()
+    if st.timeline is not None:
+        st.timeline.close()
+    from ..utils.timeline import Timeline
+    st.timeline = Timeline("", mark_cycles=False)

@@ -1,0 +1,160 @@
+"""Environment-variable configuration surface.
+
+TPU-native equivalent of the reference's env parser
+(``horovod/common/utils/env_parser.cc``) and the ``HOROVOD_*`` config surface
+described in SURVEY.md §5 ("Config/flag system").  Same two-layer pattern:
+env vars are the core config; the launcher (``horovod_tpu/runner``) forwards
+CLI/YAML settings to workers as env vars.
+
+We accept both the reference's ``HOROVOD_*`` names (so existing user scripts /
+run-books keep working) and ``HVD_TPU_*`` overrides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Look up HVD_TPU_<name> then HOROVOD_<name>."""
+    for prefix in ("HVD_TPU_", "HOROVOD_"):
+        val = os.environ.get(prefix + name)
+        if val is not None:
+            return val
+    return default
+
+
+def _env_int(name: str, default: int) -> int:
+    val = _env(name)
+    if val is None or val == "":
+        return default
+    try:
+        return int(val)
+    except ValueError:
+        raise ValueError(f"Invalid integer for HOROVOD_{name}: {val!r}")
+
+
+def _env_float(name: str, default: float) -> float:
+    val = _env(name)
+    if val is None or val == "":
+        return default
+    try:
+        return float(val)
+    except ValueError:
+        raise ValueError(f"Invalid float for HOROVOD_{name}: {val!r}")
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    val = _env(name)
+    if val is None or val == "":
+        return default
+    return val.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass
+class Config:
+    """Runtime configuration, parsed once at ``init()``.
+
+    Field-by-field mapping to the reference env vars (SURVEY.md §2a N24, §5):
+
+    - ``fusion_threshold_bytes``   <- HOROVOD_FUSION_THRESHOLD (default 64 MB)
+    - ``cycle_time_ms``            <- HOROVOD_CYCLE_TIME
+    - ``cache_capacity``           <- HOROVOD_CACHE_CAPACITY (response cache)
+    - ``timeline_filename``        <- HOROVOD_TIMELINE
+    - ``timeline_mark_cycles``     <- HOROVOD_TIMELINE_MARK_CYCLES
+    - ``stall_check_time_s``       <- HOROVOD_STALL_CHECK_TIME
+    - ``stall_shutdown_time_s``    <- HOROVOD_STALL_SHUTDOWN_TIME
+    - ``stall_check_disable``      <- HOROVOD_STALL_CHECK_DISABLE
+    - ``hierarchical_allreduce``   <- HOROVOD_HIERARCHICAL_ALLREDUCE
+    - ``hierarchical_allgather``   <- HOROVOD_HIERARCHICAL_ALLGATHER
+    - ``autotune``                 <- HOROVOD_AUTOTUNE
+    - ``autotune_log``             <- HOROVOD_AUTOTUNE_LOG
+    - ``autotune_warmup_samples``  <- HOROVOD_AUTOTUNE_WARMUP_SAMPLES
+    - ``autotune_steps_per_sample``<- HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE
+    - ``log_level``                <- HOROVOD_LOG_LEVEL
+    - ``batch_d2d_memcopies``      <- HOROVOD_BATCH_D2D_MEMCOPIES
+
+    TPU-specific additions:
+
+    - ``num_collective_streams``: number of parallel eager-dispatch lanes
+      (analogue of HOROVOD_NUM_NCCL_STREAMS).
+    - ``donate_fusion_buffers``: use XLA buffer donation for fused buffers.
+    - ``mesh_axis_name``: the mesh axis spanned by the "hvd" world.
+    """
+
+    fusion_threshold_bytes: int = 64 * 1024 * 1024
+    cycle_time_ms: float = 1.0
+    cache_capacity: int = 1024
+    cache_enabled: bool = True
+
+    timeline_filename: str = ""
+    timeline_mark_cycles: bool = False
+
+    stall_check_time_s: float = 60.0
+    stall_shutdown_time_s: float = 0.0
+    stall_check_disable: bool = False
+
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+
+    autotune: bool = False
+    autotune_log: str = ""
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+
+    log_level: str = "warning"
+    batch_d2d_memcopies: bool = True
+
+    num_collective_streams: int = 1
+    donate_fusion_buffers: bool = True
+    mesh_axis_name: str = "hvd"
+
+    # Control plane (multi-process mode). Set by the launcher.
+    controller_addr: str = ""
+    controller_port: int = 0
+    rank_env: int = -1
+    size_env: int = -1
+    local_rank_env: int = -1
+    local_size_env: int = -1
+    cross_rank_env: int = -1
+    cross_size_env: int = -1
+
+    # Elastic
+    elastic: bool = False
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        cfg = cls(
+            fusion_threshold_bytes=_env_int("FUSION_THRESHOLD", 64 * 1024 * 1024),
+            cycle_time_ms=_env_float("CYCLE_TIME", 1.0),
+            cache_capacity=_env_int("CACHE_CAPACITY", 1024),
+            timeline_filename=_env("TIMELINE", "") or "",
+            timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
+            stall_check_time_s=_env_float("STALL_CHECK_TIME", 60.0),
+            stall_shutdown_time_s=_env_float("STALL_SHUTDOWN_TIME", 0.0),
+            stall_check_disable=_env_bool("STALL_CHECK_DISABLE", False),
+            hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE", False),
+            hierarchical_allgather=_env_bool("HIERARCHICAL_ALLGATHER", False),
+            autotune=_env_bool("AUTOTUNE", False),
+            autotune_log=_env("AUTOTUNE_LOG", "") or "",
+            autotune_warmup_samples=_env_int("AUTOTUNE_WARMUP_SAMPLES", 3),
+            autotune_steps_per_sample=_env_int("AUTOTUNE_STEPS_PER_SAMPLE", 10),
+            log_level=(_env("LOG_LEVEL", "warning") or "warning").lower(),
+            batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),
+            num_collective_streams=_env_int("NUM_STREAMS", 1),
+            donate_fusion_buffers=_env_bool("DONATE_FUSION_BUFFERS", True),
+            controller_addr=_env("CONTROLLER_ADDR", "") or "",
+            controller_port=_env_int("CONTROLLER_PORT", 0),
+            rank_env=_env_int("RANK", -1),
+            size_env=_env_int("SIZE", -1),
+            local_rank_env=_env_int("LOCAL_RANK", -1),
+            local_size_env=_env_int("LOCAL_SIZE", -1),
+            cross_rank_env=_env_int("CROSS_RANK", -1),
+            cross_size_env=_env_int("CROSS_SIZE", -1),
+            elastic=_env_bool("ELASTIC", False),
+        )
+        if _env_int("CACHE_CAPACITY", 1024) == 0:
+            cfg.cache_enabled = False
+        return cfg

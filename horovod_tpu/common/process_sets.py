@@ -1,0 +1,121 @@
+"""Process sets: named subgroups of ranks with their own sub-mesh.
+
+TPU-native equivalent of the reference's process sets
+(``horovod/common/process_set.cc``, ``horovod/common/process_sets.py`` —
+SURVEY.md §2a N12): where the reference gives each set its own MPI/NCCL
+sub-communicator + controller + tensor queue, we give each set its own
+``jax.sharding.Mesh`` over the subset of devices; eager collectives compile
+against that sub-mesh, and the coordinator keys negotiation by process-set id.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+from jax.sharding import Mesh
+
+
+class ProcessSet:
+    """A named subgroup of ranks over which collectives can run.
+
+    ``ProcessSet([0, 1])`` mirrors ``hvd.ProcessSet([0, 1])`` in the
+    reference.  The special ``global_process_set`` contains every rank.
+    """
+
+    def __init__(self, ranks: Optional[Sequence[int]] = None):
+        self.ranks: Optional[List[int]] = sorted(ranks) if ranks is not None else None
+        self.process_set_id: Optional[int] = None
+        self._mesh: Optional[Mesh] = None
+        self._axis_name: Optional[str] = None
+
+    def _materialize(self, ps_id: int, devices, axis_name: str):
+        self.process_set_id = ps_id
+        self._axis_name = axis_name
+        if self.ranks is None:
+            self.ranks = list(range(len(devices)))
+        bad = [r for r in self.ranks if r < 0 or r >= len(devices)]
+        if bad:
+            raise ValueError(f"ProcessSet ranks out of range: {bad}")
+        sub = np.array([devices[r] for r in self.ranks], dtype=object)
+        self._mesh = Mesh(sub, (axis_name,))
+
+    @property
+    def mesh(self) -> Mesh:
+        if self._mesh is None:
+            raise RuntimeError("ProcessSet not yet registered; call add_process_set() "
+                               "or pass it to init()")
+        return self._mesh
+
+    @property
+    def axis_name(self) -> str:
+        assert self._axis_name is not None
+        return self._axis_name
+
+    def size(self) -> int:
+        if self.ranks is None:
+            raise RuntimeError("ProcessSet not yet registered")
+        return len(self.ranks)
+
+    def rank_in_set(self, global_rank: int) -> int:
+        """Position of a global rank inside this set (ValueError if absent)."""
+        assert self.ranks is not None
+        return self.ranks.index(global_rank)
+
+    def included(self, global_rank: int) -> bool:
+        assert self.ranks is not None
+        return global_rank in self.ranks
+
+    def __repr__(self):
+        return f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})"
+
+
+class ProcessSetTable:
+    """Registry of process sets, id 0 = global set."""
+
+    def __init__(self):
+        self._sets: Dict[int, ProcessSet] = {}
+        self._next_id = 0
+
+    def initialize(self, devices, axis_name: str,
+                   extra_sets: Optional[Sequence[ProcessSet]] = None) -> ProcessSet:
+        self._sets.clear()
+        self._next_id = 0
+        global_set = ProcessSet(None)
+        self.add(global_set, devices, axis_name)
+        for ps in (extra_sets or []):
+            self.add(ps, devices, axis_name)
+        return global_set
+
+    def add(self, ps: ProcessSet, devices, axis_name: str) -> ProcessSet:
+        for existing in self._sets.values():
+            if existing.ranks == (sorted(ps.ranks) if ps.ranks is not None
+                                  else list(range(len(devices)))):
+                raise ValueError(f"A process set with ranks {existing.ranks} already exists")
+        ps._materialize(self._next_id, devices, axis_name)
+        self._sets[self._next_id] = ps
+        self._next_id += 1
+        return ps
+
+    def remove(self, ps: ProcessSet):
+        if ps.process_set_id == 0:
+            raise ValueError("Cannot remove the global process set")
+        if ps.process_set_id in self._sets:
+            del self._sets[ps.process_set_id]
+        ps.process_set_id = None
+        ps._mesh = None
+
+    def get(self, ps_id: int) -> ProcessSet:
+        return self._sets[ps_id]
+
+    @property
+    def global_set(self) -> ProcessSet:
+        return self._sets[0]
+
+    def all_sets(self) -> List[ProcessSet]:
+        return list(self._sets.values())
+
+
+# Singleton placeholder mirroring hvd.global_process_set; bound at init().
+global_process_set = ProcessSet(None)

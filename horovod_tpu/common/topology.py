@@ -1,0 +1,129 @@
+"""Device topology and mesh construction.
+
+TPU-native replacement for the reference's transport contexts
+(``horovod/common/mpi/mpi_context.cc``, ``horovod/common/gloo/gloo_context.cc``
+— SURVEY.md §1 L0): instead of owning MPI communicators, we own
+``jax.sharding.Mesh`` objects laid out over the TPU slice's ICI topology.
+
+Rank model (TPU-first, see DESIGN.md):
+
+- a *rank* is a **device** (chip), not a process.  ``size()`` is the global
+  device count.  In multi-host SPMD each process contributes its local
+  devices; in the hermetic test tier a single process holds 8 virtual CPU
+  devices and therefore "is" all ranks at once — the same model as
+  ``jax.pmap``-style data parallelism.
+- ``local_rank``/``local_size`` describe devices within a process (host);
+  ``cross_rank``/``cross_size`` describe the host grid — exactly the
+  local/cross communicator split the reference uses for hierarchical
+  allreduce (``horovod/common/mpi/mpi_context.cc``).
+
+Device order: ranks are assigned in ICI-topology-aware order (sorted by torus
+coordinates when available) so that ring-structured collectives ride
+neighboring ICI links — the analogue of the reference launcher's host-slot
+ordering.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def _device_sort_key(d: jax.Device):
+    """Sort devices so ring order follows the ICI torus when available."""
+    coords = getattr(d, "coords", None)
+    if coords is not None:
+        core = getattr(d, "core_on_chip", 0)
+        return (0, tuple(coords), core, d.id)
+    return (1, (), 0, d.id)
+
+
+def ordered_devices(devices: Optional[Sequence[jax.Device]] = None) -> List[jax.Device]:
+    devs = list(devices) if devices is not None else list(jax.devices())
+    devs.sort(key=_device_sort_key)
+    return devs
+
+
+@dataclasses.dataclass
+class Topology:
+    """Global view of the device world."""
+
+    devices: List[jax.Device]
+    mesh: Mesh                       # 1-D mesh over all ranks, axis = world axis
+    axis_name: str
+    local_counts: List[int]          # devices per process, by process index
+    my_process: int
+    num_processes: int
+
+    @property
+    def size(self) -> int:
+        return len(self.devices)
+
+    @property
+    def local_size(self) -> int:
+        return self.local_counts[self.my_process]
+
+    @property
+    def local_rank_of(self) -> dict:
+        """rank -> local rank within its process."""
+        out = {}
+        for r, d in enumerate(self.devices):
+            out[r] = sum(1 for r2, d2 in enumerate(self.devices[:r])
+                         if d2.process_index == d.process_index)
+        return out
+
+    def ranks_of_process(self, process_index: int) -> List[int]:
+        return [r for r, d in enumerate(self.devices)
+                if d.process_index == process_index]
+
+    def hierarchical_mesh(self, axis_names: Tuple[str, str] = ("cross", "local")) -> Mesh:
+        """2-D (host × local-device) mesh for hierarchical collectives.
+
+        Reference parity: the NCCL-intra + MPI-inter two-level allreduce
+        (``horovod/common/ops/nccl_operations.cc`` hierarchical path) maps to
+        a (cross, local) mesh where the ``local`` axis rides ICI within a
+        host and ``cross`` spans hosts (DCN between slices).
+        """
+        n_local = self.local_counts[0]
+        if any(c != n_local for c in self.local_counts):
+            raise ValueError(
+                f"hierarchical mesh requires uniform local device counts, got {self.local_counts}")
+        arr = np.array(self.devices, dtype=object).reshape(self.num_processes, n_local)
+        return Mesh(arr, axis_names)
+
+
+def build_topology(axis_name: str = "hvd",
+                   devices: Optional[Sequence[jax.Device]] = None) -> Topology:
+    devs = ordered_devices(devices)
+    arr = np.array(devs, dtype=object)
+    mesh = Mesh(arr, (axis_name,))
+    num_processes = max((d.process_index for d in devs), default=0) + 1
+    local_counts = [0] * num_processes
+    for d in devs:
+        local_counts[d.process_index] += 1
+    return Topology(
+        devices=devs,
+        mesh=mesh,
+        axis_name=axis_name,
+        local_counts=local_counts,
+        my_process=jax.process_index(),
+        num_processes=num_processes,
+    )
+
+
+def torus_dims(devices: Optional[Sequence[jax.Device]] = None) -> Optional[Tuple[int, ...]]:
+    """Physical torus extent of the slice, or None when coords are unknown.
+
+    Used by Adasum (``horovod_tpu/parallel/adasum.py``) to map
+    halving-doubling rounds onto physical ICI axes.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    coords = [getattr(d, "coords", None) for d in devs]
+    if any(c is None for c in coords) or not coords:
+        return None
+    arr = np.array(coords)
+    return tuple(int(x) for x in (arr.max(axis=0) - arr.min(axis=0) + 1))

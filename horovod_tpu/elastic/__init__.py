@@ -1,0 +1,18 @@
+"""Elastic training: state commit/restore/sync and the run wrapper.
+
+Parity with the reference's framework-agnostic elastic layer
+(``horovod/common/elastic.py`` — SURVEY.md §2b P1, §3.4): a ``State`` object
+with ``commit`` (in-memory backup), ``restore`` (rollback after a peer
+failure) and ``sync`` (rank-0 broadcast so joiners catch up), plus the
+``@hvd.elastic.run`` decorator that catches ``HorovodInternalError`` /
+``HostsUpdatedInterrupt``, re-initializes the runtime, and retries.
+
+TPU mapping (SURVEY.md §5 "failure detection"): a lost host invalidates the
+ICI mesh, so recovery re-runs ``init()`` (rebuilding mesh + engine, which
+also invalidates compiled-program caches) before ``state.sync()``.
+"""
+
+from .state import (  # noqa: F401
+    State, ObjectState, JaxState,
+    HorovodInternalError, HostsUpdatedInterrupt, run,
+)

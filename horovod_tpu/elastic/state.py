@@ -1,0 +1,186 @@
+"""Elastic state objects and the retrying run wrapper.
+
+Reference files mirrored: ``horovod/common/elastic.py`` (State, run_fn),
+``horovod/torch/elastic/state.py`` (TorchState analogue -> ``JaxState``).
+See SURVEY.md §3.4 for the control flow being reproduced.
+"""
+
+from __future__ import annotations
+
+import copy
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+
+class HorovodInternalError(RuntimeError):
+    """A peer died mid-collective; training must roll back to last commit."""
+
+
+class HostsUpdatedInterrupt(Exception):
+    """The elastic driver notified a host-set change; re-rendezvous keeping
+    current (committed-or-not) parameters."""
+
+    def __init__(self, skip_sync: bool = False):
+        self.skip_sync = skip_sync
+
+
+class State:
+    """Base elastic state: commit/restore/sync + reset listeners.
+
+    Matches the reference's ``horovod.common.elastic.State`` surface:
+    ``register_reset_callbacks``, ``on_reset``, ``commit``, ``restore``,
+    ``sync``.
+    """
+
+    def __init__(self, **kwargs):
+        self._reset_callbacks = []
+        self._kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def register_reset_callbacks(self, callbacks):
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        for cb in self._reset_callbacks:
+            cb()
+
+    def on_hosts_updated(self, timestamp, update_res):
+        pass
+
+    def commit(self):
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self):
+        # Hooked by the worker-notification client in multi-process mode.
+        notifier = getattr(self, "_notification_manager", None)
+        if notifier is not None:
+            notifier.raise_if_updated()
+
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+
+class ObjectState(State):
+    """Elastic state of plain Python attributes, synced via
+    ``broadcast_object`` (reference: ``horovod/common/elastic.py``)."""
+
+    def __init__(self, bcast_object: Optional[Callable] = None, **kwargs):
+        if bcast_object is None:
+            from ..ops.eager import broadcast_object as bcast_object
+        self._bcast_object = bcast_object
+        self._saved_state: Dict[str, Any] = {}
+        super().__init__(**kwargs)
+        self.save()
+
+    def save(self):
+        self._saved_state = {k: copy.deepcopy(getattr(self, k))
+                             for k in self._kwargs}
+
+    def restore(self):
+        for k, v in self._saved_state.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self):
+        if self._saved_state:
+            synced = self._bcast_object(self._saved_state, root_rank=0)
+            for k, v in synced.items():
+                setattr(self, k, v)
+                self._saved_state[k] = copy.deepcopy(v)
+
+
+class JaxState(ObjectState):
+    """Elastic state of a JAX train state (params/opt_state pytrees).
+
+    The analogue of the reference's ``TorchState``: pytree leaves are saved
+    to host memory on ``commit`` (cheap, async device→host), restored to
+    device on ``restore``, and rank-0-broadcast on ``sync``.
+
+    Usage:
+        state = JaxState(params=params, opt_state=opt_state, epoch=0, batch=0)
+    """
+
+    def __init__(self, **kwargs):
+        self._tree_keys = [k for k, v in kwargs.items()
+                           if _is_pytree_of_arrays(v)]
+        super().__init__(**kwargs)
+
+    def save(self):
+        self._saved_state = {}
+        for k in self._kwargs:
+            v = getattr(self, k)
+            if k in self._tree_keys:
+                self._saved_state[k] = jax.tree_util.tree_map(
+                    lambda x: jax.device_get(x), v)
+            else:
+                self._saved_state[k] = copy.deepcopy(v)
+
+    def restore(self):
+        for k, v in self._saved_state.items():
+            if k in self._tree_keys:
+                setattr(self, k, jax.tree_util.tree_map(jax.numpy.asarray, v))
+            else:
+                setattr(self, k, copy.deepcopy(v))
+
+    def sync(self):
+        if not self._saved_state:
+            return
+        synced = self._bcast_object(self._saved_state, root_rank=0)
+        for k, v in synced.items():
+            if k in self._tree_keys:
+                setattr(self, k, jax.tree_util.tree_map(jax.numpy.asarray, v))
+            else:
+                setattr(self, k, copy.deepcopy(v))
+            self._saved_state[k] = v
+
+
+def _is_pytree_of_arrays(v) -> bool:
+    leaves = jax.tree_util.tree_leaves(v)
+    return bool(leaves) and all(
+        hasattr(leaf, "shape") and hasattr(leaf, "dtype") for leaf in leaves)
+
+
+def run(func: Callable) -> Callable:
+    """``@hvd.elastic.run`` — retrying elastic train-loop wrapper.
+
+    Control flow mirrors SURVEY.md §3.4: sync, run; on
+    ``HorovodInternalError`` restore to last commit; on
+    ``HostsUpdatedInterrupt`` keep params; either way re-init the runtime
+    (which on TPU rebuilds the mesh and recompiles) and retry.
+    """
+    @functools.wraps(func)
+    def wrapper(state: State, *args, **kwargs):
+        from ..common import basics
+        notifier = getattr(state, "_notification_manager", None)
+        reset_required = False
+        skip_sync = False
+        while True:
+            if reset_required:
+                _reset(state)
+                state.on_reset()
+            try:
+                if not skip_sync:
+                    state.sync()
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                state.restore()
+                skip_sync = False
+            except HostsUpdatedInterrupt as e:
+                skip_sync = e.skip_sync
+            reset_required = True
+
+    def _reset(state: State):
+        from ..common import basics
+        basics.shutdown()
+        basics.init()
+
+    return wrapper

@@ -1,0 +1,192 @@
+"""JAX binding: DistributedOptimizer / DistributedGradientTape /
+broadcast_parameters.
+
+Parity targets in the reference (SURVEY.md §2b P2/P4, §3.2/§3.5):
+
+- ``hvd.DistributedOptimizer`` (``horovod/torch/optimizer.py``,
+  ``horovod/tensorflow/__init__.py``): wraps an optimizer so gradients are
+  averaged across ranks before the update, with ``backward_passes_per_step``
+  local aggregation and optional compression.
+- ``hvd.DistributedGradientTape`` (``horovod/tensorflow/__init__.py``):
+  wraps gradient computation itself.
+- ``broadcast_parameters`` / ``broadcast_optimizer_state``
+  (``horovod/torch/functions.py``): rank-0 state sync at start.
+
+TPU-first design: the JAX optimizer is an **optax gradient transformation**.
+Inside a jitted, shard_map'ped train step the allreduce is an in-graph
+``lax.psum`` over the data-parallel mesh axis — XLA fuses and schedules it
+over ICI, which is the whole point of the rebuild (SURVEY.md §7 step 3).
+Outside any mesh context it degrades to the identity (world of 1), so the
+same training script runs unmodified on one chip.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from .compression import Compression
+from ..ops import collectives as C
+from ..common.process_sets import ProcessSet
+
+
+def _axis_in_scope(axis_name) -> bool:
+    """True when `axis_name` is bound by an enclosing shard_map/pmap trace."""
+    try:
+        lax.axis_size(axis_name)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+def allreduce_gradients(grads, op: C.ReduceOp = C.ReduceOp.AVERAGE,
+                        axis_name: str = C.DEFAULT_AXIS,
+                        compression=Compression.none,
+                        process_set: Optional[ProcessSet] = None):
+    """Tree-allreduce a gradient pytree in-graph.
+
+    One fused ``lax.psum`` over all leaves (XLA combines them into a single
+    collective — the compiler-native tensor fusion, reference N7), with
+    compress → reduce → decompress mirroring the reference's hook pipeline.
+    """
+    if process_set is not None:
+        axis_name = process_set.axis_name
+    if not _axis_in_scope(axis_name):
+        return grads  # world of 1 / non-distributed context
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    comp = [compression.compress(g) for g in leaves]
+    reduced = C.grouped_allreduce([c[0] for c in comp], op=op,
+                                  axis_name=axis_name)
+    out = [compression.decompress(r, c[1]) for r, c in zip(reduced, comp)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class _DistOptState(NamedTuple):
+    inner_state: Any
+    acc: Any                 # gradient accumulator (backward_passes_per_step)
+    counter: jnp.ndarray
+
+
+def DistributedOptimizer(optimizer: optax.GradientTransformation,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         op: C.ReduceOp = C.ReduceOp.AVERAGE,
+                         backward_passes_per_step: int = 1,
+                         axis_name: str = C.DEFAULT_AXIS,
+                         process_set: Optional[ProcessSet] = None,
+                         ) -> optax.GradientTransformation:
+    """Wrap an optax optimizer with cross-rank gradient averaging.
+
+    Usage (inside a shard_map/pjit train step over the ``hvd`` axis):
+
+        opt = hvd.DistributedOptimizer(optax.adam(1e-3))
+        updates, opt_state = opt.update(grads, opt_state, params)
+
+    ``backward_passes_per_step > 1`` reproduces the reference's gradient
+    aggregation (``horovod/tensorflow/gradient_aggregation.py``): gradients
+    accumulate locally and the (single) allreduce happens every k-th step.
+    ``named_parameters`` is accepted for API parity and unused (pytrees are
+    self-describing).
+    """
+    del named_parameters
+    if process_set is not None:
+        axis_name = process_set.axis_name
+    k = backward_passes_per_step
+
+    def init_fn(params):
+        inner = optimizer.init(params)
+        if k == 1:
+            return _DistOptState(inner, (), jnp.zeros((), jnp.int32))
+        acc = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return _DistOptState(inner, acc, jnp.zeros((), jnp.int32))
+
+    def _reduce(grads):
+        return allreduce_gradients(grads, op=op, axis_name=axis_name,
+                                   compression=compression)
+
+    def update_fn(grads, state: _DistOptState, params=None):
+        if k == 1:
+            updates, inner = optimizer.update(_reduce(grads), state.inner_state,
+                                              params)
+            return updates, _DistOptState(inner, (), state.counter + 1)
+
+        acc = jax.tree_util.tree_map(lambda a, g: a + g, state.acc, grads)
+        counter = state.counter + 1
+        apply_now = (counter % k) == 0
+
+        def do_apply(operand):
+            acc_, inner_ = operand
+            mean_acc = jax.tree_util.tree_map(lambda a: a / k, acc_)
+            updates, new_inner = optimizer.update(_reduce(mean_acc), inner_,
+                                                  params)
+            zeroed = jax.tree_util.tree_map(jnp.zeros_like, acc_)
+            return updates, new_inner, zeroed
+
+        def skip(operand):
+            acc_, inner_ = operand
+            updates = jax.tree_util.tree_map(jnp.zeros_like, acc_)
+            return updates, inner_, acc_
+
+        updates, inner, acc = lax.cond(apply_now, do_apply, skip,
+                                       (acc, state.inner_state))
+        return updates, _DistOptState(inner, acc, counter)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def DistributedGradientTape(grad_fn: Callable,
+                            compression=Compression.none,
+                            op: C.ReduceOp = C.ReduceOp.AVERAGE,
+                            axis_name: str = C.DEFAULT_AXIS,
+                            process_set: Optional[ProcessSet] = None) -> Callable:
+    """Wrap a gradient function so its output gradients are allreduced.
+
+    The JAX rendering of ``hvd.DistributedGradientTape`` (reference
+    ``horovod/tensorflow/__init__.py`` §3.5): pass ``jax.grad(loss_fn)`` or
+    ``jax.value_and_grad(loss_fn)``; the wrapper averages whatever gradient
+    pytree comes back.  Works with ``value_and_grad`` by reducing only the
+    gradient half of the result.
+    """
+    def wrapped(*args, **kwargs):
+        out = grad_fn(*args, **kwargs)
+        if isinstance(out, tuple) and len(out) == 2:
+            value, grads = out
+            return value, allreduce_gradients(
+                grads, op=op, axis_name=axis_name, compression=compression,
+                process_set=process_set)
+        return allreduce_gradients(out, op=op, axis_name=axis_name,
+                                   compression=compression,
+                                   process_set=process_set)
+    return wrapped
+
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         process_set: Optional[ProcessSet] = None):
+    """Synchronize a parameter pytree from ``root_rank`` to all ranks.
+
+    Reference: ``horovod/torch/functions.py broadcast_parameters``.  In
+    single-controller SPMD there is exactly one copy of the params (a global
+    ``jax.Array``), so all "ranks" are synchronized by construction and this
+    is the identity.  In multi-process mode each process holds its own copy
+    and the byte-level broadcast runs through the coordinator.
+    """
+    if jax.process_count() == 1:
+        return params
+    from ..ops import eager
+    return jax.tree_util.tree_map(
+        lambda p: eager.broadcast(eager.replicated(p, process_set),
+                                  root_rank=root_rank,
+                                  process_set=process_set), params)
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0,
+                              process_set: Optional[ProcessSet] = None):
+    """Reference: ``horovod/torch/functions.py broadcast_optimizer_state``."""
+    return broadcast_parameters(opt_state, root_rank=root_rank,
+                                process_set=process_set)

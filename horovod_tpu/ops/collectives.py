@@ -1,0 +1,203 @@
+"""In-graph collective primitives over a mesh axis.
+
+TPU-native replacement for the reference's L1 collective ops
+(``horovod/common/ops/`` — NCCL/MPI/Gloo classes behind ``OperationManager``,
+SURVEY.md §2a N14–N21).  On TPU there is exactly one data plane — XLA
+collectives over ICI — so the strategy-dispatch layer collapses: these are
+thin, composable wrappers over ``jax.lax`` collectives, usable inside
+``shard_map`` / ``pjit``.  The dynamic/eager path (``ops/engine.py``) compiles
+these same primitives into fused micro-programs.
+
+All functions take an ``axis_name`` (default ``"hvd"``, the world axis) and
+work over any mesh axis or axis tuple, which is what makes them the building
+blocks for TP/SP/EP meshes as well (SURVEY.md §2c).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+DEFAULT_AXIS = "hvd"
+
+
+class ReduceOp(enum.IntEnum):
+    """Reduction ops, value-compatible with the reference's hvd module consts
+
+    (``horovod/torch/mpi_ops.py``: Average=0, Sum=1, Adasum=2, Min=3, Max=4,
+    Product=5).
+    """
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+# Module-level aliases matching `hvd.Average` etc.
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+
+def axis_size(axis_name: AxisName = DEFAULT_AXIS):
+    return lax.axis_size(axis_name)
+
+
+def axis_rank(axis_name: AxisName = DEFAULT_AXIS):
+    """This shard's index along the axis — the in-graph ``rank()``."""
+    return lax.axis_index(axis_name)
+
+
+def _scale(x, factor):
+    if factor is None or factor == 1.0:
+        return x
+    # Keep scaling in the tensor dtype when safe; upcast low-precision ints.
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        return (x.astype(jnp.float32) * factor).astype(x.dtype)
+    return x * jnp.asarray(factor, dtype=x.dtype)
+
+
+def allreduce(x, op: ReduceOp = ReduceOp.AVERAGE,
+              axis_name: AxisName = DEFAULT_AXIS,
+              prescale_factor: Optional[float] = None,
+              postscale_factor: Optional[float] = None):
+    """Allreduce of ``x`` over the axis.
+
+    Parity: ``hvd.allreduce`` (reference ``horovod/torch/mpi_ops.py`` /
+    ``horovod/tensorflow/mpi_ops.py``), incl. pre/post-scale factors
+    (the reference fuses these as a CUDA scale kernel, N18; XLA fuses the
+    multiply into the collective's producer/consumer for free).
+    """
+    x = _scale(x, prescale_factor)
+    if op in (ReduceOp.AVERAGE, ReduceOp.SUM):
+        out = lax.psum(x, axis_name)
+        if op == ReduceOp.AVERAGE:
+            n = lax.axis_size(axis_name)
+            out = out / jnp.asarray(n, dtype=out.dtype) if jnp.issubdtype(
+                out.dtype, jnp.floating) else out // n
+    elif op == ReduceOp.MIN:
+        out = lax.pmin(x, axis_name)
+    elif op == ReduceOp.MAX:
+        out = lax.pmax(x, axis_name)
+    elif op == ReduceOp.PRODUCT:
+        # No native pprod; exp/log is lossy — use all_gather+prod reduction.
+        g = lax.all_gather(x, axis_name)
+        out = jnp.prod(g, axis=0)
+    elif op == ReduceOp.ADASUM:
+        from ..parallel.adasum import adasum_allreduce
+        out = adasum_allreduce(x, axis_name)
+    else:
+        raise ValueError(f"Unknown ReduceOp: {op}")
+    return _scale(out, postscale_factor)
+
+
+def grouped_allreduce(xs, op: ReduceOp = ReduceOp.AVERAGE,
+                      axis_name: AxisName = DEFAULT_AXIS,
+                      prescale_factor: Optional[float] = None,
+                      postscale_factor: Optional[float] = None):
+    """Allreduce a list of tensors as one atomic group.
+
+    Parity: ``hvd.grouped_allreduce`` (reference group_table N13).  Under
+    jit, passing the whole list to one ``psum`` lets XLA combine them into a
+    single fused collective — the compiler-native version of the reference's
+    fusion buffer.
+    """
+    xs = [_scale(x, prescale_factor) for x in xs]
+    if op in (ReduceOp.AVERAGE, ReduceOp.SUM):
+        outs = lax.psum(tuple(xs), axis_name)
+        if op == ReduceOp.AVERAGE:
+            n = lax.axis_size(axis_name)
+            outs = tuple(o / jnp.asarray(n, o.dtype) for o in outs)
+    else:
+        outs = tuple(allreduce(x, op=op, axis_name=axis_name) for x in xs)
+    return [_scale(o, postscale_factor) for o in outs]
+
+
+def allgather(x, axis_name: AxisName = DEFAULT_AXIS, axis: int = 0,
+              tiled: bool = True):
+    """Gather shards from all ranks, concatenated along ``axis``.
+
+    Parity: ``hvd.allgather`` — the reference concatenates along dim 0 and
+    supports ragged first dims (handled in the eager layer by padding;
+    in-graph shapes are static and must match).
+    """
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def broadcast(x, root_rank: int = 0, axis_name: AxisName = DEFAULT_AXIS):
+    """Every rank receives rank ``root_rank``'s value.
+
+    Parity: ``hvd.broadcast``.  Implemented as a masked psum, which XLA
+    lowers to an efficient collective-broadcast on TPU.
+    """
+    idx = lax.axis_index(axis_name)
+    mask = (idx == root_rank)
+    if jnp.issubdtype(x.dtype, jnp.bool_):
+        masked = jnp.where(mask, x, False)
+        return lax.psum(masked.astype(jnp.int32), axis_name).astype(jnp.bool_)
+    masked = jnp.where(mask, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def alltoall(x, axis_name: AxisName = DEFAULT_AXIS,
+             split_axis: int = 0, concat_axis: int = 0):
+    """Even all-to-all: split ``x`` along ``split_axis`` into ``size`` chunks,
+    exchange, concatenate received chunks along ``concat_axis``.
+
+    Parity: ``hvd.alltoall`` with uniform splits (the DLRM embedding-exchange
+    primitive, BASELINE config #5).  Ragged splits are an eager-layer feature
+    (``horovod_tpu.alltoall`` pads to the max split in-graph).
+    """
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def reducescatter(x, op: ReduceOp = ReduceOp.SUM,
+                  axis_name: AxisName = DEFAULT_AXIS, axis: int = 0):
+    """Reduce across ranks and scatter shards along ``axis``.
+
+    Parity: ``hvd.reducescatter`` (reference v0.28 ops, SURVEY.md §2c).
+    The enabling primitive for ZeRO-style sharded optimizers
+    (``horovod_tpu/parallel/zero.py``).
+    """
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("reducescatter supports SUM and AVERAGE")
+    out = lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+    if op == ReduceOp.AVERAGE:
+        out = out / jnp.asarray(lax.axis_size(axis_name), out.dtype)
+    return out
+
+
+def ppermute(x, perm, axis_name: AxisName = DEFAULT_AXIS):
+    """Point-to-point ring permute — the ring-attention substrate.
+
+    No direct reference analogue (Horovod lacks SP, SURVEY.md §5); exposed
+    because XLA's collective-permute over ICI is the natural primitive for
+    ring collectives on the torus.
+    """
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def neighbor_shift(x, shift: int = 1, axis_name: AxisName = DEFAULT_AXIS):
+    """Shift values around the ring by ``shift`` positions (wrapping)."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def barrier_value(axis_name: AxisName = DEFAULT_AXIS):
+    """A value-level barrier: psum of 1 — all ranks must participate.
+
+    Parity: ``hvd.barrier``.
+    """
+    return lax.psum(jnp.ones((), jnp.int32), axis_name)

@@ -1,0 +1,253 @@
+"""Eager (out-of-graph) collective API — the ``hvd.*`` op surface.
+
+Parity with the reference's Python op layer (``horovod/torch/mpi_ops.py``,
+``horovod/tensorflow/mpi_ops.py`` — SURVEY.md §2b P2/P4): blocking and
+``_async`` variants of allreduce / grouped_allreduce / allgather / broadcast /
+alltoall / reducescatter, plus ``synchronize``/``poll``, ``barrier`` and
+``join``.  Requests flow through the background coordinator
+(``ops/engine.py``) exactly like the reference's enqueue path (SURVEY.md
+§3.2), so fusion/caching/timeline apply.
+
+Tensor convention (see engine docstring): per-rank logical shape S is carried
+as a stacked global array ``[world, *S]`` sharded over the world axis.
+``stack_per_rank`` / ``replicated`` build these from host data.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import collectives as C
+from .engine import CollectiveType
+from ..common import basics
+from ..common.process_sets import ProcessSet
+
+_name_counter = itertools.count(0)
+_group_counter = itertools.count(0)
+
+
+def _engine():
+    st = basics._get_state()
+    if not st.initialized or st.engine is None:
+        raise basics.NotInitializedError()
+    return st.engine
+
+
+def _ps(process_set: Optional[ProcessSet]) -> int:
+    if process_set is None:
+        return 0
+    if process_set.process_set_id is None:
+        raise ValueError("process_set has not been registered via add_process_set()")
+    return process_set.process_set_id
+
+
+def _auto_name(prefix: str, name: Optional[str]) -> str:
+    return name if name else f"{prefix}.noname.{next(_name_counter)}"
+
+
+def _as_stacked(x, ps_id: int):
+    """Coerce input to a stacked [world, *S] jax.Array on the set's mesh."""
+    st = basics._get_state()
+    ps = st.process_set_table.get(ps_id)
+    world = ps.size()
+    if isinstance(x, (np.ndarray, list, tuple, int, float)) or np.isscalar(x):
+        x = np.asarray(x)
+    if hasattr(x, "shape") and (len(x.shape) == 0 or x.shape[0] != world):
+        raise ValueError(
+            f"Eager collectives take stacked per-rank tensors of shape "
+            f"[world={world}, ...]; got shape {tuple(x.shape)}. Use "
+            f"stack_per_rank()/replicated() to build one.")
+    sharding = NamedSharding(ps.mesh, P(ps.axis_name))
+    if isinstance(x, jax.Array) and x.sharding == sharding:
+        return x
+    return jax.device_put(x, sharding)
+
+
+def stack_per_rank(values: Sequence, process_set: Optional[ProcessSet] = None):
+    """Stack one value per rank into the global stacked representation."""
+    st = basics._get_state()
+    ps = st.process_set_table.get(_ps(process_set))
+    vals = [np.asarray(v) for v in values]
+    if len(vals) != ps.size():
+        raise ValueError(f"Expected {ps.size()} per-rank values, got {len(vals)}")
+    stacked = np.stack(vals)
+    return jax.device_put(stacked, NamedSharding(ps.mesh, P(ps.axis_name)))
+
+
+def replicated(value, process_set: Optional[ProcessSet] = None):
+    """Every rank contributes the same value."""
+    st = basics._get_state()
+    ps = st.process_set_table.get(_ps(process_set))
+    v = np.asarray(value)
+    return stack_per_rank([v] * ps.size(), process_set)
+
+
+# ------------------------------------------------------------------ allreduce
+def allreduce_async(tensor, name: Optional[str] = None,
+                    op: C.ReduceOp = C.ReduceOp.AVERAGE,
+                    prescale_factor: Optional[float] = None,
+                    postscale_factor: Optional[float] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    ps_id = _ps(process_set)
+    return _engine().enqueue(
+        _auto_name("allreduce", name), CollectiveType.ALLREDUCE,
+        _as_stacked(tensor, ps_id), reduce_op=op, process_set_id=ps_id,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+
+
+def allreduce(tensor, name: Optional[str] = None,
+              op: C.ReduceOp = C.ReduceOp.AVERAGE,
+              prescale_factor: Optional[float] = None,
+              postscale_factor: Optional[float] = None,
+              process_set: Optional[ProcessSet] = None):
+    return synchronize(allreduce_async(
+        tensor, name, op, prescale_factor, postscale_factor, process_set))
+
+
+def grouped_allreduce_async(tensors: Sequence, name: Optional[str] = None,
+                            op: C.ReduceOp = C.ReduceOp.AVERAGE,
+                            prescale_factor: Optional[float] = None,
+                            postscale_factor: Optional[float] = None,
+                            process_set: Optional[ProcessSet] = None) -> List[int]:
+    """Enqueue a group that fuses/executes atomically (reference: N13)."""
+    ps_id = _ps(process_set)
+    gid = next(_group_counter)
+    base = _auto_name("grouped_allreduce", name)
+    eng = _engine()
+    return [eng.enqueue(f"{base}.{i}", CollectiveType.ALLREDUCE,
+                        _as_stacked(t, ps_id), reduce_op=op,
+                        process_set_id=ps_id, prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor, group_id=gid)
+            for i, t in enumerate(tensors)]
+
+
+def grouped_allreduce(tensors: Sequence, name: Optional[str] = None,
+                      op: C.ReduceOp = C.ReduceOp.AVERAGE,
+                      prescale_factor: Optional[float] = None,
+                      postscale_factor: Optional[float] = None,
+                      process_set: Optional[ProcessSet] = None):
+    return [synchronize(h) for h in grouped_allreduce_async(
+        tensors, name, op, prescale_factor, postscale_factor, process_set)]
+
+
+# ------------------------------------------------------------------ allgather
+def allgather_async(tensor, name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    ps_id = _ps(process_set)
+    return _engine().enqueue(_auto_name("allgather", name),
+                             CollectiveType.ALLGATHER,
+                             _as_stacked(tensor, ps_id), process_set_id=ps_id)
+
+
+def allgather(tensor, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None):
+    return synchronize(allgather_async(tensor, name, process_set))
+
+
+# ------------------------------------------------------------------ broadcast
+def broadcast_async(tensor, root_rank: int = 0, name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    ps_id = _ps(process_set)
+    return _engine().enqueue(_auto_name("broadcast", name),
+                             CollectiveType.BROADCAST,
+                             _as_stacked(tensor, ps_id), root_rank=root_rank,
+                             process_set_id=ps_id)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None):
+    return synchronize(broadcast_async(tensor, root_rank, name, process_set))
+
+
+def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None):
+    """Pickle-broadcast an arbitrary Python object (reference:
+    ``horovod/torch/functions.py broadcast_object``).
+
+    In single-controller mode every rank already holds the object; the
+    byte-level broadcast still runs so numerics/latency match multi-process.
+    """
+    import pickle
+    st = basics._get_state()
+    ps = st.process_set_table.get(_ps(process_set))
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    n = np.array([len(payload)], dtype=np.int64)
+    sizes = broadcast(stack_per_rank([n] * ps.size(), process_set),
+                      root_rank=root_rank, name=_auto_name("bcast_obj_size", name))
+    size = int(np.asarray(sizes)[0])
+    buf = np.zeros(size, dtype=np.uint8)
+    buf[:len(payload)] = payload[:size]
+    out = broadcast(stack_per_rank([buf] * ps.size(), process_set),
+                    root_rank=root_rank, name=_auto_name("bcast_obj", name))
+    return pickle.loads(np.asarray(out).tobytes())
+
+
+# ------------------------------------------------------------------ alltoall
+def alltoall_async(tensor, splits=None, name: Optional[str] = None,
+                   process_set: Optional[ProcessSet] = None) -> int:
+    if splits is not None:
+        raise NotImplementedError(
+            "Ragged alltoall splits land with the uneven-split planner; "
+            "even splits (splits=None) are supported")
+    ps_id = _ps(process_set)
+    return _engine().enqueue(_auto_name("alltoall", name),
+                             CollectiveType.ALLTOALL,
+                             _as_stacked(tensor, ps_id), process_set_id=ps_id)
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set: Optional[ProcessSet] = None):
+    return synchronize(alltoall_async(tensor, splits, name, process_set))
+
+
+# -------------------------------------------------------------- reducescatter
+def reducescatter_async(tensor, name: Optional[str] = None,
+                        op: C.ReduceOp = C.ReduceOp.SUM,
+                        process_set: Optional[ProcessSet] = None) -> int:
+    ps_id = _ps(process_set)
+    return _engine().enqueue(_auto_name("reducescatter", name),
+                             CollectiveType.REDUCESCATTER,
+                             _as_stacked(tensor, ps_id), reduce_op=op,
+                             process_set_id=ps_id)
+
+
+def reducescatter(tensor, name: Optional[str] = None,
+                  op: C.ReduceOp = C.ReduceOp.SUM,
+                  process_set: Optional[ProcessSet] = None):
+    return synchronize(reducescatter_async(tensor, name, op, process_set))
+
+
+# ------------------------------------------------------------------- control
+def synchronize(handle):
+    """Wait for handle(s); returns result(s) (reference: mpi_ops.synchronize)."""
+    if isinstance(handle, (list, tuple)):
+        return [_engine().synchronize(h) for h in handle]
+    return _engine().synchronize(handle)
+
+
+def poll(handle) -> bool:
+    return _engine().poll(handle)
+
+
+def barrier(process_set: Optional[ProcessSet] = None):
+    """Block until all ranks reach the barrier (reference: hvd.barrier)."""
+    ps_id = _ps(process_set)
+    h = _engine().enqueue(_auto_name("barrier", None), CollectiveType.BARRIER,
+                          None, process_set_id=ps_id)
+    return _engine().synchronize(h)
+
+
+def join() -> int:
+    """Signal this rank is done submitting work (reference: hvd.join).
+
+    Returns the last rank to join.  In single-controller mode every rank
+    joins simultaneously, so this drains the queue and returns size()-1.
+    """
+    barrier()
+    return basics.size() - 1

@@ -1,0 +1,587 @@
+"""The collective engine: Horovod's background coordinator, TPU-style.
+
+TPU-native re-design of the reference's L2 core runtime
+(``horovod/common/operations.cc`` ``BackgroundThreadLoop``/``RunLoopOnce``,
+``tensor_queue.cc``, ``fusion_buffer_cache.cc``, ``response_cache.cc``,
+``controller.cc`` — SURVEY.md §2a N1/N2/N6/N7/N8 and §3.2).
+
+What survives from the reference (per SURVEY.md §7's design stance):
+the *control plane* — a background cycle thread draining a thread-safe
+tensor queue, negotiating which tensors are globally ready, fusing them, and
+dispatching one collective per fused batch — plus timeline tracing and stall
+inspection.  What changes: the *data plane*.  There is no NCCL ring or
+fusion-buffer memcpy machinery to manage; a fused batch becomes a single
+**jitted XLA micro-program** (flatten → concat → collective → split) compiled
+once per (op, dtype, shape-set, process-set) and cached.  XLA owns the ICI
+scheduling; the cache plays the role of the reference's response cache on the
+steady-state hot path (SURVEY.md §7 "hard parts" #1 and #5).
+
+Tensor representation ("stacked global array" convention): an eager tensor of
+logical per-rank shape S is a ``jax.Array`` of shape ``[world, *S]`` sharded
+over the world mesh axis — shard r is rank r's contribution.  Single-process
+SPMD holds all shards; multi-process mode assembles the global array from each
+process's local shards.  Results come back in natural global form:
+allreduce/broadcast → replicated ``[*S]``; allgather → replicated concat;
+alltoall/reducescatter → stacked, sharded ``[world, ...]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from . import collectives as C
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+
+class CollectiveType(enum.Enum):
+    ALLREDUCE = "allreduce"
+    ALLGATHER = "allgather"
+    BROADCAST = "broadcast"
+    ALLTOALL = "alltoall"
+    REDUCESCATTER = "reducescatter"
+    BARRIER = "barrier"
+
+
+@dataclasses.dataclass
+class TensorTableEntry:
+    """One pending collective request (reference: TensorTableEntry, N6)."""
+    handle: int
+    name: str
+    ctype: CollectiveType
+    tensor: Any                      # stacked global array [world, *S] (or None for barrier)
+    reduce_op: C.ReduceOp = C.ReduceOp.AVERAGE
+    root_rank: int = 0
+    process_set_id: int = 0
+    prescale_factor: Optional[float] = None
+    postscale_factor: Optional[float] = None
+    group_id: int = -1               # grouped ops execute atomically together
+    enqueue_time: float = 0.0
+    # filled on completion:
+    result: Any = None
+    error: Optional[BaseException] = None
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+
+
+def _fusion_key(e: TensorTableEntry) -> Tuple:
+    """Entries with equal keys may fuse into one XLA program.
+
+    dtype is deliberately NOT part of the key: a fused program groups leaves
+    by dtype internally (one concat+psum per dtype) and XLA's collective
+    combiner merges those into one wire transfer — this keeps grouped ops
+    with mixed fp32/bf16 members atomic in a single batch (reference: group
+    table N13 semantics).
+    """
+    return (e.ctype, e.reduce_op, e.root_rank, e.process_set_id,
+            e.prescale_factor, e.postscale_factor)
+
+
+class TensorQueue:
+    """Thread-safe queue of pending entries (reference: tensor_queue.cc N6).
+
+    Duplicate-name detection mirrors the reference's error on submitting a
+    tensor name twice before completion.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: List[TensorTableEntry] = []
+        self._pending_names: Dict[str, int] = {}
+
+    def push(self, e: TensorTableEntry):
+        with self._lock:
+            if e.name in self._pending_names:
+                raise ValueError(
+                    f"A tensor named {e.name!r} is already pending; Horovod "
+                    f"semantics require unique names per in-flight collective")
+            self._pending_names[e.name] = e.handle
+            e.enqueue_time = time.monotonic()
+            self._entries.append(e)
+
+    def drain(self) -> List[TensorTableEntry]:
+        with self._lock:
+            out, self._entries = self._entries, []
+            return out
+
+    def mark_done(self, e: TensorTableEntry):
+        with self._lock:
+            self._pending_names.pop(e.name, None)
+
+    def requeue(self, entries: Sequence[TensorTableEntry]):
+        """Put drained-but-not-ready entries back for the next cycle
+        (reference: ComputeResponseList re-queues tensors not yet ready on
+        all ranks).  Names are still registered, so no duplicate check."""
+        with self._lock:
+            self._entries = list(entries) + self._entries
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class FusedProgramCache:
+    """Compiled fused-collective cache (reference: response_cache.cc N8).
+
+    The reference's response cache turns steady-state negotiation into a
+    bit-vector allreduce; here, the same role — skip per-step planning — is
+    played by caching the jitted fused executable keyed on the *shape
+    signature* of the batch.  Hit == zero Python planning + zero XLA
+    recompile: dispatch cost is one cached-executable launch.
+    """
+
+    def __init__(self, capacity: int = 1024):
+        self.capacity = capacity
+        self._cache: Dict[Tuple, Callable] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_build(self, key: Tuple, builder: Callable[[], Callable]) -> Callable:
+        if self.capacity <= 0:
+            # Caching disabled (HOROVOD_CACHE_CAPACITY=0): build every time.
+            self.misses += 1
+            return builder()
+        fn = self._cache.get(key)
+        if fn is None:
+            self.misses += 1
+            fn = builder()
+            while len(self._cache) >= self.capacity:
+                # FIFO eviction; steady-state training has a tiny working set.
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[key] = fn
+        else:
+            self.hits += 1
+        return fn
+
+
+class StallInspector:
+    """Warns when entries sit unexecuted too long (reference: N11).
+
+    In single-controller mode entries execute next cycle, so stalls indicate
+    an engine bug; in multi-process mode a stall names the ranks that have
+    not submitted a tensor the others are waiting on — the reference's #1
+    user-facing failure diagnosis (SURVEY.md §5 "race detection").
+    """
+
+    def __init__(self, warn_after_s: float, shutdown_after_s: float,
+                 disabled: bool = False):
+        self.warn_after_s = warn_after_s
+        self.shutdown_after_s = shutdown_after_s
+        self.disabled = disabled
+        self._warned: set = set()
+
+    def check(self, waiting: Sequence[TensorTableEntry],
+              missing_ranks: Optional[Dict[str, List[int]]] = None):
+        if self.disabled:
+            return
+        now = time.monotonic()
+        for e in waiting:
+            age = now - e.enqueue_time
+            if age > self.warn_after_s and e.name not in self._warned:
+                self._warned.add(e.name)
+                extra = ""
+                if missing_ranks and e.name in missing_ranks:
+                    extra = f"; ranks not yet submitted: {missing_ranks[e.name]}"
+                log.warning(
+                    "Stall detected: tensor %r has waited %.1fs for "
+                    "negotiation/execution%s", e.name, age, extra)
+            if (self.shutdown_after_s > 0 and age > self.shutdown_after_s):
+                raise RuntimeError(
+                    f"Collective on tensor {e.name!r} stalled for {age:.1f}s "
+                    f"(> HOROVOD_STALL_SHUTDOWN_TIME); aborting")
+
+
+class CollectiveEngine:
+    """Background coordinator: queue → negotiate → fuse → execute.
+
+    Single-controller negotiation is local (everything submitted is ready —
+    the one process is every rank).  Multi-process mode plugs a TCP
+    controller in at ``self.controller`` so all processes agree on the
+    response list before executing identical programs; the execution path
+    below is shared by both modes.
+    """
+
+    def __init__(self, state):
+        self._state = state
+        cfg = state.config
+        self.queue = TensorQueue()
+        self.cache = FusedProgramCache(cfg.cache_capacity)
+        self.stall = StallInspector(cfg.stall_check_time_s,
+                                    cfg.stall_shutdown_time_s,
+                                    cfg.stall_check_disable)
+        self.cycle_time_s = cfg.cycle_time_ms / 1000.0
+        self.fusion_threshold = cfg.fusion_threshold_bytes
+        self._handle_counter = itertools.count(1)
+        self._handles: Dict[int, TensorTableEntry] = {}
+        self._handles_lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._cycle_index = 0
+        self.controller = None       # multi-process TCP controller (optional)
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._background_loop, name="hvd-tpu-coordinator", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._shutdown.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    # ------------------------------------------------------------- submit API
+    def enqueue(self, name: str, ctype: CollectiveType, tensor,
+                reduce_op=C.ReduceOp.AVERAGE, root_rank: int = 0,
+                process_set_id: int = 0, prescale_factor=None,
+                postscale_factor=None, group_id: int = -1) -> int:
+        handle = next(self._handle_counter)
+        e = TensorTableEntry(
+            handle=handle, name=name, ctype=ctype, tensor=tensor,
+            reduce_op=reduce_op, root_rank=root_rank,
+            process_set_id=process_set_id, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, group_id=group_id)
+        with self._handles_lock:
+            self._handles[handle] = e
+        tl = self._state.timeline
+        if tl is not None:
+            tl.start_activity(name, "QUEUE")
+        self.queue.push(e)
+        self._wake.set()
+        return handle
+
+    def synchronize(self, handle: int, timeout: Optional[float] = None):
+        """Block until the handle's collective completed; return result.
+
+        Reference parity: ``horovod/torch/mpi_ops.py synchronize()``.
+        """
+        with self._handles_lock:
+            e = self._handles.get(handle)
+        if e is None:
+            raise ValueError(f"Unknown handle {handle}")
+        if not e.done.wait(timeout):
+            raise TimeoutError(f"Collective {e.name!r} did not complete "
+                               f"within {timeout}s")
+        with self._handles_lock:
+            self._handles.pop(handle, None)
+        if e.error is not None:
+            raise e.error
+        return e.result
+
+    def poll(self, handle: int) -> bool:
+        with self._handles_lock:
+            e = self._handles.get(handle)
+        return e is None or e.done.is_set()
+
+    # ------------------------------------------------------------- main loop
+    def _background_loop(self):
+        while not self._shutdown.is_set():
+            self._wake.wait(timeout=self.cycle_time_s)
+            self._wake.clear()
+            try:
+                self.run_loop_once()
+            except Exception:       # pragma: no cover - engine bug surface
+                log.exception("coordinator cycle failed")
+
+    def run_loop_once(self):
+        """One coordinator cycle (reference: RunLoopOnce, SURVEY.md §3.2).
+
+        Any failure during planning (negotiation error, stall-shutdown
+        abort, timeline I/O) must fail the drained entries — never drop
+        them — or waiters in ``synchronize()`` would hang forever.
+        """
+        self._cycle_index += 1
+        tl = self._state.timeline
+        if tl is not None:
+            tl.mark_cycle(self._cycle_index)
+        entries = self.queue.drain()
+        if not entries:
+            return
+        try:
+            responses, not_ready = self._compute_response_list(entries)
+        except BaseException as exc:  # noqa: BLE001 - propagate to waiters
+            for e in entries:
+                e.error = exc
+                self.queue.mark_done(e)
+                e.done.set()
+            return
+        if not_ready:
+            self.queue.requeue(not_ready)
+        for batch in responses:
+            self._perform_operation(batch)
+
+    # --------------------------------------------------------- negotiation
+    def _compute_response_list(self, entries) -> List[List[TensorTableEntry]]:
+        """Group ready entries into fused batches (reference: N2
+        ``ComputeResponseList``).
+
+        Local mode: all entries are ready.  Grouped entries (group_id >= 0)
+        must land in one batch (reference: group_table N13).  Batches are
+        split at the fusion threshold, never across fusion keys.
+
+        Returns ``(batches, not_ready)``; not-ready entries (multi-process
+        negotiation) are re-queued by the caller for the next cycle.
+        """
+        not_ready: List[TensorTableEntry] = []
+        if self.controller is not None:
+            ready = self.controller.negotiate(entries)
+            ready_handles = {e.handle for e in ready}
+            not_ready = [e for e in entries if e.handle not in ready_handles]
+            entries = ready
+        for e in entries:
+            if self._state.timeline is not None:
+                self._state.timeline.end_activity(e.name, "QUEUE")
+                self._state.timeline.start_activity(
+                    e.name, f"NEGOTIATE_{e.ctype.name}")
+        self.stall.check(entries + not_ready)
+
+        batches: List[List[TensorTableEntry]] = []
+        by_key: Dict[Tuple, List[TensorTableEntry]] = {}
+        for e in entries:
+            by_key.setdefault(_fusion_key(e), []).append(e)
+        for key, group in by_key.items():
+            cur: List[TensorTableEntry] = []
+            cur_bytes = 0
+            # keep grouped-op members adjacent and atomic
+            group.sort(key=lambda e: (e.group_id if e.group_id >= 0 else 1 << 30,
+                                      e.handle))
+            i = 0
+            while i < len(group):
+                e = group[i]
+                members = [e]
+                if e.group_id >= 0:
+                    j = i + 1
+                    while j < len(group) and group[j].group_id == e.group_id:
+                        members.append(group[j])
+                        j += 1
+                    i = j
+                else:
+                    i += 1
+                mbytes = sum(m.tensor.nbytes for m in members
+                             if m.tensor is not None)
+                if cur and cur_bytes + mbytes > self.fusion_threshold:
+                    batches.append(cur)
+                    cur, cur_bytes = [], 0
+                cur.extend(members)
+                cur_bytes += mbytes
+            if cur:
+                batches.append(cur)
+        return batches, not_ready
+
+    # ----------------------------------------------------------- execution
+    def _perform_operation(self, batch: List[TensorTableEntry]):
+        tl = self._state.timeline
+        for e in batch:
+            if tl is not None:
+                tl.end_activity(e.name, f"NEGOTIATE_{e.ctype.name}")
+                tl.start_activity(e.name, f"XLA_{e.ctype.name}")
+        try:
+            results = self._execute_batch(batch)
+            for e, r in zip(batch, results):
+                e.result = r
+        except BaseException as exc:  # noqa: BLE001 - propagate to waiters
+            for e in batch:
+                e.error = exc
+        finally:
+            for e in batch:
+                if tl is not None:
+                    tl.end_activity(e.name, f"XLA_{e.ctype.name}")
+                self.queue.mark_done(e)
+                e.done.set()
+
+    def _mesh_axis(self, ps_id: int):
+        ps = self._state.process_set_table.get(ps_id)
+        return ps.mesh, ps.axis_name, ps.size()
+
+    def _execute_batch(self, batch: List[TensorTableEntry]) -> List[Any]:
+        e0 = batch[0]
+        if e0.ctype == CollectiveType.BARRIER:
+            return [None for _ in batch]
+        mesh, axis, world = self._mesh_axis(e0.process_set_id)
+        shapes = tuple(tuple(e.tensor.shape) for e in batch)
+        dtypes = tuple(str(e.tensor.dtype) for e in batch)
+        key = (_fusion_key(e0), shapes, dtypes)
+        fn = self.cache.get_or_build(
+            key, lambda: self._build_program(e0, shapes, dtypes, mesh, axis, world))
+        outs = fn(*[e.tensor for e in batch])
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        return list(outs)
+
+    # Builders: one jitted micro-program per (fusion key, shape set).  The
+    # fused allreduce flattens every tensor's per-rank shard, concatenates
+    # into one [world, total] buffer (the fusion buffer, living purely as an
+    # XLA temporary in HBM — reference N7 without the memcpy machinery),
+    # runs ONE collective, and splits results out.
+    def _build_program(self, proto: TensorTableEntry, shapes, dtypes, mesh,
+                       axis, world):
+        ctype = proto.ctype
+
+        if ctype == CollectiveType.ALLREDUCE:
+            return self._build_allreduce(proto, shapes, dtypes, mesh, axis, world)
+        if ctype == CollectiveType.BROADCAST:
+            return self._build_broadcast(proto, shapes, mesh, axis, world)
+        if ctype == CollectiveType.ALLGATHER:
+            return self._build_allgather(proto, shapes, mesh, axis, world)
+        if ctype == CollectiveType.REDUCESCATTER:
+            return self._build_reducescatter(proto, shapes, mesh, axis, world)
+        if ctype == CollectiveType.ALLTOALL:
+            return self._build_alltoall(proto, shapes, mesh, axis, world)
+        raise ValueError(f"Unsupported collective: {ctype}")
+
+    def _build_allreduce(self, proto, shapes, dtypes, mesh, axis, world):
+        op = proto.reduce_op
+        pre, post = proto.prescale_factor, proto.postscale_factor
+        per_rank_shapes = [s[1:] for s in shapes]
+        sizes = [int(np.prod(s)) if s else 1 for s in per_rank_shapes]
+        # Fuse per dtype: one concat+reduce per distinct dtype; XLA's
+        # collective combiner merges them into a single wire transfer, so
+        # mixed-dtype groups stay atomic without dtype promotion.
+        dtype_groups: Dict[str, List[int]] = {}
+        for i, dt in enumerate(dtypes):
+            dtype_groups.setdefault(dt, []).append(i)
+
+        def _reduce_flat(flat):
+            if op in (C.ReduceOp.AVERAGE, C.ReduceOp.SUM):
+                red = lax.psum(flat, axis)
+                if op == C.ReduceOp.AVERAGE:
+                    red = red / jnp.asarray(world, red.dtype) if jnp.issubdtype(
+                        red.dtype, jnp.floating) else red // world
+            elif op == C.ReduceOp.MIN:
+                red = lax.pmin(flat, axis)
+            elif op == C.ReduceOp.MAX:
+                red = lax.pmax(flat, axis)
+            elif op == C.ReduceOp.PRODUCT:
+                g = lax.all_gather(flat, axis)
+                red = jnp.prod(g, axis=0)
+            elif op == C.ReduceOp.ADASUM:
+                from ..parallel.adasum import adasum_allreduce
+                red = adasum_allreduce(flat, axis)
+            else:
+                raise ValueError(f"Unknown ReduceOp {op}")
+            return red
+
+        def per_shard(*xs):
+            # xs: per-rank values, each [*S] — flatten, fuse per dtype.
+            outs: List[Any] = [None] * len(xs)
+            for dt, idxs in dtype_groups.items():
+                flat = jnp.concatenate([xs[i].reshape(-1) for i in idxs]) \
+                    if len(idxs) > 1 else xs[idxs[0]].reshape(-1)
+                red = C._scale(_reduce_flat(C._scale(flat, pre)), post)
+                off = 0
+                for i in idxs:
+                    outs[i] = red[off:off + sizes[i]].reshape(per_rank_shapes[i])
+                    off += sizes[i]
+            return tuple(outs)
+
+        in_specs = tuple(P(axis) for _ in shapes)
+        out_specs = tuple(P() for _ in shapes)
+
+        def wrapper(*xs):
+            # Each stacked input [world, *S] → shard [1, *S]; reshape inside.
+            def body(*shards):
+                return per_shard(*[s.reshape(s.shape[1:]) for s in shards])
+            return shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)(*xs)
+
+        return jax.jit(wrapper)
+
+    def _build_broadcast(self, proto, shapes, mesh, axis, world):
+        root = proto.root_rank
+
+        def body(*shards):
+            outs = []
+            for s in shards:
+                x = s.reshape(s.shape[1:])
+                idx = lax.axis_index(axis)
+                if jnp.issubdtype(x.dtype, jnp.bool_):
+                    m = jnp.where(idx == root, x, False)
+                    outs.append(lax.psum(m.astype(jnp.int32), axis).astype(jnp.bool_))
+                else:
+                    m = jnp.where(idx == root, x, jnp.zeros_like(x))
+                    outs.append(lax.psum(m, axis))
+            return tuple(outs)
+
+        return jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(P(axis) for _ in shapes),
+            out_specs=tuple(P() for _ in shapes), check_vma=False))
+
+    def _build_allgather(self, proto, shapes, mesh, axis, world):
+        def body(*shards):
+            outs = []
+            for s in shards:
+                x = s.reshape(s.shape[1:])
+                outs.append(lax.all_gather(x, axis, axis=0, tiled=True))
+            return tuple(outs)
+
+        return jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(P(axis) for _ in shapes),
+            out_specs=tuple(P() for _ in shapes), check_vma=False))
+
+    def _build_reducescatter(self, proto, shapes, mesh, axis, world):
+        op = proto.reduce_op
+        if op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE, C.ReduceOp.MIN,
+                      C.ReduceOp.MAX, C.ReduceOp.PRODUCT):
+            raise ValueError(f"reducescatter does not support ReduceOp {op}")
+
+        def body(*shards):
+            outs = []
+            for s in shards:
+                x = s.reshape(s.shape[1:])
+                if op in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE):
+                    r = lax.psum_scatter(x, axis, scatter_dimension=0,
+                                         tiled=True)
+                    if op == C.ReduceOp.AVERAGE:
+                        r = r / jnp.asarray(world, r.dtype)
+                else:
+                    # MIN/MAX/PRODUCT: no native scatter-reduce; gather,
+                    # reduce elementwise, keep this rank's slice.
+                    g = lax.all_gather(x, axis)          # [world, S0, ...]
+                    if op == C.ReduceOp.MIN:
+                        full = jnp.min(g, axis=0)
+                    elif op == C.ReduceOp.MAX:
+                        full = jnp.max(g, axis=0)
+                    else:
+                        full = jnp.prod(g, axis=0)
+                    chunk = full.shape[0] // world
+                    idx = lax.axis_index(axis)
+                    r = lax.dynamic_slice_in_dim(full, idx * chunk, chunk, 0)
+                outs.append(r[None])  # re-stack: [1, S0/world, ...]
+            return tuple(outs)
+
+        return jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(P(axis) for _ in shapes),
+            out_specs=tuple(P(axis) for _ in shapes), check_vma=False))
+
+    def _build_alltoall(self, proto, shapes, mesh, axis, world):
+        def body(*shards):
+            outs = []
+            for s in shards:
+                x = s.reshape(s.shape[1:])
+                y = lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                                   tiled=True)
+                outs.append(y[None])
+            return tuple(outs)
+
+        return jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(P(axis) for _ in shapes),
+            out_specs=tuple(P(axis) for _ in shapes), check_vma=False))

@@ -1,0 +1,139 @@
+"""Adasum: adaptive-summation gradient reduction on the TPU torus.
+
+TPU-native equivalent of the reference's Adasum ops
+(``horovod/common/ops/adasum/adasum.h``, ``adasum_mpi_operations.cc``,
+``adasum_gpu_operations.cc`` — SURVEY.md §2a N20).  Adasum combines two
+gradients by subtracting the mutual projections so the result is
+scale-invariant when the gradients are correlated:
+
+    adasum(a, b) = (1 - a.b / (2|a|^2)) a + (1 - a.b / (2|b|^2)) b
+
+and reduces n ranks by applying this pairwise in a binary tree — the same
+combination order as the reference's recursive vector-halving-doubling, so
+numerics match rank-for-rank.
+
+Two implementations:
+
+- ``adasum_allreduce``: all_gather + in-register tree combine.  Simple and
+  XLA-friendly; bandwidth cost n·|x| over ICI (fine up to moderate world
+  sizes, and XLA overlaps the gather with compute).
+- ``adasum_allreduce_hd``: true vector-halving-doubling over
+  ``lax.ppermute`` — log2(n) rounds, each exchanging half the remaining
+  vector with a partner at distance 2^k, mirroring the reference's MPI
+  algorithm but riding ICI neighbor links.  Requires power-of-two world.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _dots(a, b):
+    """Returns (a.b, |a|^2, |b|^2) computed in f32 over flattened tensors."""
+    af = a.astype(jnp.float32).reshape(-1)
+    bf = b.astype(jnp.float32).reshape(-1)
+    return af @ bf, af @ af, bf @ bf
+
+
+def adasum_combine(a, b, eps: float = 1e-30):
+    """Pairwise Adasum of two same-shaped tensors.
+
+    Orthogonal gradients (a.b = 0) sum exactly; parallel gradients average,
+    giving scale-invariance — the property the reference's
+    ``docs/adasum_user_guide`` advertises.
+    """
+    ab, aa, bb = _dots(a, b)
+    ca = 1.0 - ab / (2.0 * aa + eps)
+    cb = 1.0 - ab / (2.0 * bb + eps)
+    out = (ca.astype(jnp.float32) * a.astype(jnp.float32)
+           + cb.astype(jnp.float32) * b.astype(jnp.float32))
+    return out.astype(a.dtype)
+
+
+def _tree_reduce(stack, n):
+    """Binary-tree pairwise adasum over a gathered [n, ...] stack.
+
+    Tree pairing (0,1),(2,3),... per level reproduces the reference's
+    halving-doubling combination order.  Non-power-of-two remainders are
+    folded in at each level, as the reference's VHDD remainder step does.
+    """
+    vals = [stack[i] for i in range(n)]
+    while len(vals) > 1:
+        nxt = []
+        for i in range(0, len(vals) - 1, 2):
+            nxt.append(adasum_combine(vals[i], vals[i + 1]))
+        if len(vals) % 2 == 1:
+            nxt[-1] = adasum_combine(nxt[-1], vals[-1])
+        vals = nxt
+    return vals[0]
+
+
+def adasum_allreduce(x, axis_name="hvd"):
+    """Adasum allreduce usable inside shard_map/jit (any world size)."""
+    n = lax.axis_size(axis_name)
+    g = lax.all_gather(x, axis_name)  # [n, ...]
+    return _tree_reduce(g, n)
+
+
+def adasum_allreduce_hd(x, axis_name="hvd"):
+    """Vector-halving-doubling Adasum via ppermute (power-of-two worlds).
+
+    Round k: partner = rank XOR 2^k.  Each rank sends the half of its
+    working vector that the partner owns, receives the partner's half of its
+    own, combines with adasum, and recurses on its half; then the doubling
+    phase allgathers the combined halves back.  This is the reference
+    ``adasum_mpi.cc`` algorithm with MPI_Sendrecv replaced by
+    lax.ppermute pairs over ICI.
+    """
+    n = lax.axis_size(axis_name)
+    # Static world size: shard_map gives a concrete int at trace time.
+    n_static = int(n) if not isinstance(n, int) else n
+    if n_static & (n_static - 1):
+        raise ValueError("adasum_allreduce_hd requires power-of-two world size; "
+                         "use adasum_allreduce instead")
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    pad = (-flat.shape[0]) % n_static
+    flat = jnp.pad(flat, (0, pad))
+    rank = lax.axis_index(axis_name)
+
+    # Halving phase: at each round exchange opposite halves with the partner.
+    segments = flat  # this rank's current working segment
+    rounds = n_static.bit_length() - 1
+    for k in range(rounds):
+        dist = 1 << k
+        perm = [(i, i ^ dist) for i in range(n_static)]
+        half = segments.shape[0] // 2
+        low, high = segments[:half], segments[half:]
+        # Ranks where bit k is 0 keep the low half and send the high; bit 1
+        # keeps high, sends low.
+        to_send = lax.cond(((rank >> k) & 1) == 0, lambda: high, lambda: low)
+        received = lax.ppermute(to_send, axis_name, perm=perm)
+        kept = lax.cond(((rank >> k) & 1) == 0, lambda: low, lambda: high)
+        segments = adasum_combine(kept, received)
+
+    # Doubling phase: allgather the 1/n segments in rank order.
+    gathered = lax.all_gather(segments, axis_name)  # [n, chunk]
+    # Rank r holds the segment whose index is bit-reversal-free: the kept
+    # segment of rank r is the one starting at offset determined by its bits.
+    # Reconstruct by computing each rank's segment start.
+    chunk = segments.shape[0]
+    starts = []
+    for r in range(n_static):
+        start = 0
+        span = n_static
+        for k in range(rounds):
+            span //= 2
+            if (r >> k) & 1:
+                start += span
+            # start tracks which final chunk this rank's segment begins at
+        starts.append(start)
+    order = [0] * n_static
+    for r, s in enumerate(starts):
+        order[s] = r
+    full = jnp.concatenate([gathered[order[i]] for i in range(n_static)])
+    if pad:
+        full = full[:-pad]
+    return full.reshape(orig_shape).astype(orig_dtype)

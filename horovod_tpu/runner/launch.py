@@ -1,0 +1,21 @@
+"""``torovodrun`` console entry point.
+
+Equivalent of the reference's ``horovod/runner/launch.py`` (SURVEY.md §2b P7,
+§3.3).  The full launcher (arg surface, hostfile parsing, rendezvous server,
+ssh/local spawn, elastic driver) lives in this package; this module wires the
+CLI.  Currently implements localhost multi-process launch; the TPU-pod
+ssh/metadata path follows the same spawn interface.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def run_commandline(argv=None) -> int:
+    from .run import main
+    return main(argv if argv is not None else sys.argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(run_commandline())

@@ -1,0 +1,284 @@
+"""``torovodrun`` argument surface and launch orchestration.
+
+Parity with the reference launcher (``horovod/runner/launch.py``, ``run.py``,
+``gloo_run.py``, ``mpi_run.py`` — SURVEY.md §2b P7, §3.3): parse
+``-np``/``-H``/``--hostfile``/elastic/timeline/autotune/fusion flags (plus
+``--config-file`` YAML mirroring them), compute the rank→host placement, and
+spawn per-rank worker processes with the ``HOROVOD_*`` environment injected.
+
+TPU-first differences:
+- No mpirun backend: workers are spawned directly (localhost) or over ssh,
+  and the distributed world is formed by ``jax.distributed`` against the
+  launcher-chosen coordinator (replacing the Gloo HTTP rendezvous).
+- ``--tpu-topology-aware`` orders ranks by ICI torus coordinates (the
+  reference orders by hostfile slots).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import shlex
+import socket
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class HostSpec:
+    hostname: str
+    slots: int
+
+
+def parse_hosts(hosts: str) -> List[HostSpec]:
+    """Parse ``-H host1:2,host2:4`` (reference: runner/common/util/hosts.py)."""
+    specs = []
+    for part in hosts.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, slots = part.rsplit(":", 1)
+            specs.append(HostSpec(name, int(slots)))
+        else:
+            specs.append(HostSpec(part, 1))
+    return specs
+
+
+def parse_hostfile(path: str) -> List[HostSpec]:
+    """Parse a hostfile with ``hostname slots=N`` lines (reference format)."""
+    specs = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            fields = line.split()
+            name = fields[0]
+            slots = 1
+            for f in fields[1:]:
+                if f.startswith("slots="):
+                    slots = int(f.split("=", 1)[1])
+            specs.append(HostSpec(name, slots))
+    return specs
+
+
+def parse_args(argv: Sequence[str]) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="torovodrun",
+        description="Launch a horovod_tpu distributed job",
+        usage="torovodrun -np NP [options] <command> [args...]")
+    p.add_argument("-np", "--num-proc", type=int, dest="np",
+                   help="Total number of worker processes")
+    p.add_argument("-H", "--hosts", dest="hosts",
+                   help="Comma-separated host:slots list")
+    p.add_argument("--hostfile", dest="hostfile",
+                   help="Hostfile with 'hostname slots=N' lines")
+    p.add_argument("--network-interface", dest="nics",
+                   help="Network interface(s) for the control plane")
+    p.add_argument("--start-timeout", type=int, default=600)
+    p.add_argument("--ssh-port", type=int, default=None)
+    p.add_argument("--ssh-identity-file", default=None)
+    p.add_argument("--verbose", "-v", action="count", default=0)
+    p.add_argument("--config-file", dest="config_file",
+                   help="YAML config mirroring the CLI flags")
+    p.add_argument("--output-filename", dest="output_filename",
+                   help="Redirect worker stdout/stderr to "
+                        "<dir>/rank.<N>/stdout|stderr")
+    # Tuning knobs forwarded as HOROVOD_* env (reference: launch.py does the
+    # same forwarding).
+    p.add_argument("--fusion-threshold-mb", type=int, default=None)
+    p.add_argument("--cycle-time-ms", type=float, default=None)
+    p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--stall-check-time", type=float, default=None)
+    p.add_argument("--stall-shutdown-time", type=float, default=None)
+    p.add_argument("--autotune", action="store_true")
+    p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--hierarchical-allreduce", action="store_true")
+    p.add_argument("--tpu-topology-aware", action="store_true", default=True)
+    # Elastic (reference: _run_elastic)
+    p.add_argument("--min-np", type=int, default=None)
+    p.add_argument("--max-np", type=int, default=None)
+    p.add_argument("--host-discovery-script", default=None)
+    p.add_argument("--slots-per-host", type=int, default=None)
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="Training command")
+    args = p.parse_args(list(argv))
+
+    if args.config_file:
+        _apply_config_file(args)
+    if not args.command:
+        p.error("no training command given")
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    elastic = args.host_discovery_script is not None
+    if args.np is None and not elastic:
+        p.error("-np is required (or elastic --host-discovery-script)")
+    return args
+
+
+def _apply_config_file(args: argparse.Namespace):
+    """YAML config file mirroring flags (reference: --config-file)."""
+    import re
+
+    def parse_scalar(v: str):
+        v = v.strip()
+        if v.lower() in ("true", "yes"):
+            return True
+        if v.lower() in ("false", "no"):
+            return False
+        for cast in (int, float):
+            try:
+                return cast(v)
+            except ValueError:
+                pass
+        return v
+
+    with open(args.config_file) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line or ":" not in line:
+                continue
+            key, val = line.split(":", 1)
+            key = key.strip().replace("-", "_")
+            if hasattr(args, key) and getattr(args, key) in (None, False):
+                setattr(args, key, parse_scalar(val))
+
+
+def placement(args) -> List[HostSpec]:
+    if args.hostfile:
+        hosts = parse_hostfile(args.hostfile)
+    elif args.hosts:
+        hosts = parse_hosts(args.hosts)
+    else:
+        hosts = [HostSpec("localhost", args.np)]
+    total = sum(h.slots for h in hosts)
+    if args.np is not None and total < args.np:
+        raise ValueError(f"Requested -np {args.np} but hosts provide only "
+                         f"{total} slots")
+    return hosts
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def worker_envs(args, hosts: List[HostSpec],
+                coordinator: Tuple[str, int]) -> List[Dict[str, str]]:
+    """Compute the per-rank env injection (reference §3.3: HOROVOD_RANK,
+    HOROVOD_SIZE, HOROVOD_LOCAL_RANK, HOROVOD_CROSS_RANK, rendezvous addr)."""
+    np_total = args.np
+    envs = []
+    rank = 0
+    for cross_rank, h in enumerate(hosts):
+        for local_rank in range(h.slots):
+            if rank >= np_total:
+                break
+            env = {
+                "HOROVOD_RANK": str(rank),
+                "HOROVOD_SIZE": str(np_total),
+                "HOROVOD_LOCAL_RANK": str(local_rank),
+                "HOROVOD_LOCAL_SIZE": str(min(h.slots, np_total - rank + local_rank)),
+                "HOROVOD_CROSS_RANK": str(cross_rank),
+                "HOROVOD_CROSS_SIZE": str(len(hosts)),
+                "HOROVOD_CONTROLLER_ADDR": coordinator[0],
+                "HOROVOD_CONTROLLER_PORT": str(coordinator[1]),
+                "HOROVOD_HOSTNAME": h.hostname,
+            }
+            for flag, var, scale in (
+                    ("fusion_threshold_mb", "HOROVOD_FUSION_THRESHOLD", 1024 * 1024),
+                    ("cycle_time_ms", "HOROVOD_CYCLE_TIME", 1),
+                    ("cache_capacity", "HOROVOD_CACHE_CAPACITY", 1),
+                    ("stall_check_time", "HOROVOD_STALL_CHECK_TIME", 1),
+                    ("stall_shutdown_time", "HOROVOD_STALL_SHUTDOWN_TIME", 1)):
+                val = getattr(args, flag)
+                if val is not None:
+                    env[var] = str(int(val * scale) if scale != 1 else val)
+            if args.timeline_filename:
+                env["HOROVOD_TIMELINE"] = f"{args.timeline_filename}.{rank}"
+            if args.timeline_mark_cycles:
+                env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
+            if args.autotune:
+                env["HOROVOD_AUTOTUNE"] = "1"
+                if args.autotune_log_file:
+                    env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
+            if args.hierarchical_allreduce:
+                env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+            envs.append(env)
+            rank += 1
+    return envs
+
+
+def ssh_command(host: str, env: Dict[str, str], command: List[str],
+                ssh_port: Optional[int] = None,
+                identity_file: Optional[str] = None) -> List[str]:
+    """Build the remote spawn command (reference: gloo_run's ssh exec via
+    safe_shell_exec; tested by asserting on the generated argv, like
+    ``test/single/test_run.py``)."""
+    exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in sorted(env.items()))
+    remote = f"cd {shlex.quote(os.getcwd())} && env {exports} " + \
+        " ".join(shlex.quote(c) for c in command)
+    cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        cmd += ["-p", str(ssh_port)]
+    if identity_file:
+        cmd += ["-i", identity_file]
+    cmd += [host, remote]
+    return cmd
+
+
+def launch_workers(args, hosts: List[HostSpec]) -> int:
+    """Spawn all workers, wait, propagate first failure (local + ssh)."""
+    coord = (hosts[0].hostname if hosts[0].hostname != "localhost"
+             else "127.0.0.1", _free_port())
+    envs = worker_envs(args, hosts, coord)
+    procs: List[subprocess.Popen] = []
+    for rank, env in enumerate(envs):
+        host = env["HOROVOD_HOSTNAME"]
+        full_env = {**os.environ, **env}
+        stdout = stderr = None
+        if args.output_filename:
+            d = os.path.join(args.output_filename, f"rank.{rank}")
+            os.makedirs(d, exist_ok=True)
+            stdout = open(os.path.join(d, "stdout"), "w")
+            stderr = open(os.path.join(d, "stderr"), "w")
+        if host in ("localhost", "127.0.0.1", socket.gethostname()):
+            proc = subprocess.Popen(args.command, env=full_env,
+                                    stdout=stdout, stderr=stderr)
+        else:
+            cmd = ssh_command(host, env, args.command, args.ssh_port,
+                              args.ssh_identity_file)
+            proc = subprocess.Popen(cmd, env=os.environ.copy(),
+                                    stdout=stdout, stderr=stderr)
+        procs.append(proc)
+    rc = 0
+    try:
+        for p in procs:
+            p.wait()
+            if p.returncode != 0 and rc == 0:
+                rc = p.returncode
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+    return rc
+
+
+def main(argv: Sequence[str]) -> int:
+    args = parse_args(argv)
+    if args.host_discovery_script is not None:
+        from ..elastic.driver import run_elastic
+        return run_elastic(args)
+    hosts = placement(args)
+    if args.verbose:
+        print(f"[torovodrun] launching np={args.np} over "
+              f"{[(h.hostname, h.slots) for h in hosts]}", file=sys.stderr)
+    return launch_workers(args, hosts)

@@ -1,0 +1,41 @@
+"""Test harness: hermetic 8-virtual-device CPU mesh.
+
+Mirrors the reference's hermetic test tier (SURVEY.md §4): where the
+reference uses multi-process Gloo on localhost as the no-cluster backend, we
+use JAX's virtual CPU devices (``--xla_force_host_platform_device_count=8``)
+so the full enqueue → negotiate → fuse → XLA-collective path runs with 8
+ranks in one process.  Must be set before jax imports anywhere.
+"""
+
+import os
+import sys
+
+# Overwrite, not setdefault: the TPU environment pins JAX_PLATFORMS=axon and
+# tests must run hermetically on virtual CPU devices regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+if "jax" in sys.modules:  # pragma: no cover - belt and braces
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def hvd():
+    import horovod_tpu as hvd
+    hvd.init()
+    yield hvd
+    # Engines/timelines are cheap; keep runtime initialized across tests for
+    # speed (matching how real training uses one init per process).
+
+
+@pytest.fixture(scope="session")
+def world_size():
+    import jax
+    return jax.device_count()

@@ -1,0 +1,53 @@
+"""Basics API tests: init/rank/size/process sets.
+
+Mirrors the reference's rank/size assertions scattered through
+``test/parallel/test_torch.py`` (SURVEY.md §4).
+"""
+
+import pytest
+
+
+def test_init_idempotent(hvd):
+    assert hvd.is_initialized()
+    hvd.init()
+    assert hvd.is_initialized()
+
+
+def test_world(hvd, world_size):
+    assert hvd.size() == world_size == 8
+    assert hvd.rank() == 0
+    assert hvd.local_size() == 8
+    assert hvd.cross_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.is_homogeneous()
+
+
+def test_capabilities(hvd):
+    assert hvd.xla_built()
+    assert not hvd.nccl_built()
+    assert not hvd.mpi_enabled()
+    assert not hvd.cuda_built()
+
+
+def test_mesh(hvd, world_size):
+    m = hvd.mesh()
+    assert m.devices.size == world_size
+    assert m.axis_names == ("hvd",)
+
+
+def test_process_set_add_remove(hvd):
+    ps = hvd.add_process_set([0, 1, 2])
+    try:
+        assert ps.size() == 3
+        assert ps.included(0) and not ps.included(3)
+        assert ps.rank_in_set(2) == 2
+        with pytest.raises(ValueError):
+            hvd.add_process_set([0, 1, 2])  # duplicate
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_global_process_set(hvd, world_size):
+    from horovod_tpu import global_process_set
+    assert global_process_set.process_set_id == 0
+    assert global_process_set.size() == world_size

@@ -1,0 +1,170 @@
+"""Eager collective tests: every op × several dtypes, vs locally-computed
+expectations — the reference's assertion pattern from
+``test/parallel/test_torch.py`` (SURVEY.md §4: "allreduce result == sum over
+size() of deterministic per-rank tensors").
+"""
+
+import numpy as np
+import pytest
+
+
+def _per_rank(world, shape, dtype, seed=0):
+    rng = np.random.RandomState(seed)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        return [rng.randint(0, 10, size=shape).astype(dtype) for _ in range(world)]
+    return [rng.randn(*shape).astype(dtype) for _ in range(world)]
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.int32, np.float16])
+def test_allreduce_sum(hvd, world_size, dtype):
+    vals = _per_rank(world_size, (4, 3), dtype)
+    x = hvd.stack_per_rank(vals)
+    out = hvd.allreduce(x, op=hvd.Sum)
+    expected = np.sum(np.stack(vals), axis=0)
+    np.testing.assert_allclose(np.asarray(out), expected,
+                               rtol=2e-3 if dtype == np.float16 else 1e-6)
+
+
+def test_allreduce_average(hvd, world_size):
+    vals = _per_rank(world_size, (5,), np.float32, seed=1)
+    out = hvd.allreduce(hvd.stack_per_rank(vals), op=hvd.Average)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.mean(np.stack(vals), axis=0), rtol=1e-6)
+
+
+def test_allreduce_min_max(hvd, world_size):
+    vals = _per_rank(world_size, (7,), np.float32, seed=2)
+    out_min = hvd.allreduce(hvd.stack_per_rank(vals), op=hvd.Min)
+    out_max = hvd.allreduce(hvd.stack_per_rank(vals), op=hvd.Max)
+    np.testing.assert_allclose(np.asarray(out_min), np.min(np.stack(vals), 0))
+    np.testing.assert_allclose(np.asarray(out_max), np.max(np.stack(vals), 0))
+
+
+def test_allreduce_product(hvd, world_size):
+    vals = [np.full((3,), 1.0 + 0.1 * r, np.float32) for r in range(world_size)]
+    out = hvd.allreduce(hvd.stack_per_rank(vals), op=hvd.Product)
+    np.testing.assert_allclose(np.asarray(out), np.prod(np.stack(vals), 0),
+                               rtol=1e-5)
+
+
+def test_allreduce_prescale_postscale(hvd, world_size):
+    vals = _per_rank(world_size, (4,), np.float32, seed=3)
+    out = hvd.allreduce(hvd.stack_per_rank(vals), op=hvd.Sum,
+                        prescale_factor=0.5, postscale_factor=2.0)
+    np.testing.assert_allclose(np.asarray(out), np.sum(np.stack(vals), 0),
+                               rtol=1e-5)
+
+
+def test_allreduce_async_poll(hvd, world_size):
+    vals = _per_rank(world_size, (2, 2), np.float32, seed=4)
+    h = hvd.allreduce_async(hvd.stack_per_rank(vals), op=hvd.Sum)
+    out = hvd.synchronize(h)
+    assert hvd.poll(h)
+    np.testing.assert_allclose(np.asarray(out), np.sum(np.stack(vals), 0),
+                               rtol=1e-6)
+
+
+def test_grouped_allreduce(hvd, world_size):
+    a = _per_rank(world_size, (3,), np.float32, seed=5)
+    b = _per_rank(world_size, (2, 2), np.float32, seed=6)
+    outs = hvd.grouped_allreduce([hvd.stack_per_rank(a), hvd.stack_per_rank(b)],
+                                 op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(outs[0]), np.sum(np.stack(a), 0),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(outs[1]), np.sum(np.stack(b), 0),
+                               rtol=1e-6)
+
+
+def test_allgather(hvd, world_size):
+    vals = [np.full((2, 3), r, np.float32) for r in range(world_size)]
+    out = np.asarray(hvd.allgather(hvd.stack_per_rank(vals)))
+    assert out.shape == (2 * world_size, 3)
+    for r in range(world_size):
+        np.testing.assert_array_equal(out[2 * r:2 * r + 2], vals[r])
+
+
+@pytest.mark.parametrize("root", [0, 3])
+def test_broadcast(hvd, world_size, root):
+    vals = [np.full((4,), r, np.float32) for r in range(world_size)]
+    out = np.asarray(hvd.broadcast(hvd.stack_per_rank(vals), root_rank=root))
+    np.testing.assert_array_equal(out, vals[root])
+
+
+def test_broadcast_object(hvd):
+    obj = {"epoch": 3, "lr": 0.1, "name": "resnet"}
+    assert hvd.broadcast_object(obj, root_rank=0) == obj
+
+
+def test_alltoall(hvd, world_size):
+    # rank r sends chunk [r*world + c] to rank c; classic transpose check.
+    vals = [np.arange(world_size, dtype=np.float32) + r * world_size
+            for r in range(world_size)]
+    out = np.asarray(hvd.alltoall(hvd.stack_per_rank(vals)))
+    assert out.shape == (world_size, world_size)
+    expected = np.stack(vals).T  # receiver c gets element c from every rank
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_reducescatter(hvd, world_size):
+    vals = _per_rank(world_size, (world_size * 2, 3), np.float32, seed=7)
+    out = np.asarray(hvd.reducescatter(hvd.stack_per_rank(vals), op=hvd.Sum))
+    total = np.sum(np.stack(vals), axis=0)
+    assert out.shape == (world_size, 2, 3)
+    for r in range(world_size):
+        np.testing.assert_allclose(out[r], total[2 * r:2 * r + 2], rtol=1e-5)
+
+
+def test_process_set_collective(hvd, world_size):
+    ps = hvd.add_process_set([0, 2, 4])
+    try:
+        vals = [np.full((3,), float(r + 1), np.float32) for r in range(3)]
+        out = hvd.allreduce(hvd.stack_per_rank(vals, ps), op=hvd.Sum,
+                            process_set=ps)
+        np.testing.assert_allclose(np.asarray(out), np.full((3,), 6.0))
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_barrier_and_join(hvd, world_size):
+    hvd.barrier()
+    assert hvd.join() == world_size - 1
+
+
+def test_duplicate_name_rejected(hvd, world_size):
+    from horovod_tpu.ops.engine import TensorTableEntry, CollectiveType
+    import horovod_tpu.ops.eager as eager
+    eng = eager._engine()
+    vals = _per_rank(world_size, (2,), np.float32)
+    x = hvd.stack_per_rank(vals)
+    # Exercise the queue-level collision directly (deterministic, no timing).
+    e1 = TensorTableEntry(handle=10**9, name="dup_direct",
+                          ctype=CollectiveType.ALLREDUCE, tensor=x)
+    eng.queue.push(e1)
+    e2 = TensorTableEntry(handle=10**9 + 1, name="dup_direct",
+                          ctype=CollectiveType.ALLREDUCE, tensor=x)
+    with pytest.raises(ValueError):
+        eng.queue.push(e2)
+    eng.queue.drain()
+    eng.queue.mark_done(e1)
+    # After completion the name is free again through the public API:
+    h = hvd.allreduce_async(x, name="dup_direct")
+    hvd.synchronize(h)
+
+
+def test_replicated_helper(hvd, world_size):
+    out = hvd.allreduce(hvd.replicated(np.ones((3,), np.float32)), op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(out), np.full((3,), world_size))
+
+
+def test_cache_hits(hvd, world_size):
+    import horovod_tpu.ops.eager as eager
+    eng = eager._engine()
+    vals = _per_rank(world_size, (6,), np.float32, seed=8)
+    x = hvd.stack_per_rank(vals)
+    hvd.allreduce(x, op=hvd.Sum)
+    misses_before = eng.cache.misses
+    hits_before = eng.cache.hits
+    for _ in range(3):
+        hvd.allreduce(x, op=hvd.Sum)
+    assert eng.cache.misses == misses_before
+    assert eng.cache.hits >= hits_before + 3

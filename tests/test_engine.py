@@ -1,0 +1,206 @@
+"""Coordinator engine unit tests: fusion, cache, stall, error propagation.
+
+Models the reference's single-process tier (``test/single/test_stall.py``,
+``test_timeline.py`` — SURVEY.md §4) plus engine-specific invariants.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+
+def _stacked(hvd, world, shape=(4,), dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    return hvd.stack_per_rank(
+        [rng.randn(*shape).astype(dtype) for _ in range(world)])
+
+
+def test_mixed_dtype_group_atomic(hvd, world_size):
+    """Grouped ops with mixed dtypes must fuse into ONE batch (N13 parity)."""
+    import horovod_tpu.ops.eager as eager
+    from horovod_tpu.ops.engine import CollectiveType
+
+    eng = eager._engine()
+    executed_batches = []
+    orig = eng._perform_operation
+
+    def spy(batch):
+        executed_batches.append([e.name for e in batch])
+        return orig(batch)
+
+    eng._perform_operation = spy
+    try:
+        a = _stacked(hvd, world_size, dtype=np.float32, seed=1)
+        b = _stacked(hvd, world_size, dtype=np.float16, seed=2)
+        outs = hvd.grouped_allreduce([a, b], name="mix", op=hvd.Sum)
+    finally:
+        eng._perform_operation = orig
+    np.testing.assert_allclose(np.asarray(outs[0]),
+                               np.sum(np.asarray(a), 0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs[1]).astype(np.float32),
+                               np.sum(np.asarray(b).astype(np.float32), 0),
+                               rtol=2e-2)
+    group_batches = [b for b in executed_batches if any("mix" in n for n in b)]
+    assert len(group_batches) == 1, f"group split across {group_batches}"
+    assert sorted(group_batches[0]) == ["mix.0", "mix.1"]
+
+
+def test_cache_capacity_zero(hvd, world_size):
+    """HOROVOD_CACHE_CAPACITY=0 disables caching without crashing."""
+    from horovod_tpu.ops.engine import FusedProgramCache
+    c = FusedProgramCache(0)
+    assert c.get_or_build(("k",), lambda: "v1") == "v1"
+    assert c.get_or_build(("k",), lambda: "v2") == "v2"  # rebuilt, no cache
+    assert c.misses == 2 and c.hits == 0
+
+
+def test_planning_error_fails_entries_not_hangs(hvd, world_size):
+    """An exception during negotiation/planning must propagate to waiters
+    (not strand them) — the stall-shutdown abort path in particular."""
+    import horovod_tpu.ops.eager as eager
+
+    eng = eager._engine()
+    orig = eng._compute_response_list
+
+    def boom(entries):
+        raise RuntimeError("negotiation exploded")
+
+    eng._compute_response_list = boom
+    try:
+        h = hvd.allreduce_async(_stacked(hvd, world_size), name="doomed")
+        with pytest.raises(RuntimeError, match="negotiation exploded"):
+            hvd.synchronize(h)
+    finally:
+        eng._compute_response_list = orig
+    # Engine still healthy afterwards:
+    out = hvd.allreduce(_stacked(hvd, world_size, seed=3), op=hvd.Sum)
+    assert np.asarray(out).shape == (4,)
+
+
+def test_reducescatter_min_max(hvd, world_size):
+    vals = [np.random.RandomState(r).randn(world_size * 2, 3).astype(np.float32)
+            for r in range(world_size)]
+    out = np.asarray(hvd.reducescatter(hvd.stack_per_rank(vals), op=hvd.Min))
+    full_min = np.min(np.stack(vals), axis=0)
+    for r in range(world_size):
+        np.testing.assert_allclose(out[r], full_min[2 * r:2 * r + 2], rtol=1e-6)
+    out = np.asarray(hvd.reducescatter(hvd.stack_per_rank(vals), op=hvd.Max))
+    full_max = np.max(np.stack(vals), axis=0)
+    for r in range(world_size):
+        np.testing.assert_allclose(out[r], full_max[2 * r:2 * r + 2], rtol=1e-6)
+
+
+def test_reducescatter_bad_op(hvd, world_size):
+    with pytest.raises(ValueError):
+        hvd.reducescatter(_stacked(hvd, world_size, shape=(world_size, 2)),
+                          op=hvd.Adasum)
+
+
+def test_fusion_splits_at_threshold(hvd, world_size):
+    """Batches split when exceeding HOROVOD_FUSION_THRESHOLD."""
+    import horovod_tpu.ops.eager as eager
+    eng = eager._engine()
+    old_threshold = eng.fusion_threshold
+    executed = []
+    orig = eng._perform_operation
+
+    def spy(batch):
+        executed.append(len(batch))
+        return orig(batch)
+
+    eng.fusion_threshold = 4 * world_size * 10  # fits ~1 tensor of 10 floats
+    eng._perform_operation = spy
+    try:
+        hs = [hvd.allreduce_async(_stacked(hvd, world_size, shape=(10,),
+                                           seed=i), name=f"split{i}",
+                                  op=hvd.Sum)
+              for i in range(4)]
+        hvd.synchronize(hs)
+    finally:
+        eng._perform_operation = orig
+        eng.fusion_threshold = old_threshold
+    assert max(executed) <= 2  # nothing fused beyond the tiny threshold
+
+
+def test_stall_inspector_warns():
+    from horovod_tpu.ops.engine import StallInspector, TensorTableEntry, \
+        CollectiveType
+    from horovod_tpu.utils.logging import get_logger
+    import logging
+    import time
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = Capture()
+    logger = get_logger()
+    logger.addHandler(handler)
+    try:
+        si = StallInspector(warn_after_s=0.0, shutdown_after_s=0.0)
+        e = TensorTableEntry(handle=1, name="slow",
+                             ctype=CollectiveType.ALLREDUCE, tensor=None)
+        e.enqueue_time = time.monotonic() - 5
+        si.check([e], missing_ranks={"slow": [2, 3]})
+    finally:
+        logger.removeHandler(handler)
+    assert any("Stall detected" in m for m in records)
+    assert any("[2, 3]" in m for m in records)
+
+
+def test_stall_shutdown_raises():
+    from horovod_tpu.ops.engine import StallInspector, TensorTableEntry, \
+        CollectiveType
+    import time
+    si = StallInspector(warn_after_s=0.0, shutdown_after_s=0.001)
+    e = TensorTableEntry(handle=1, name="dead", ctype=CollectiveType.ALLREDUCE,
+                         tensor=None)
+    e.enqueue_time = time.monotonic() - 5
+    with pytest.raises(RuntimeError, match="stalled"):
+        si.check([e])
+
+
+def test_timeline_written(tmp_path, hvd, world_size):
+    import json
+    import horovod_tpu as _hvd
+    f = tmp_path / "tl.json"
+    _hvd.start_timeline(str(f))
+    hvd.allreduce(_stacked(hvd, world_size, seed=9), name="tl_tensor")
+    _hvd.stop_timeline()
+    events = json.loads(f.read_text())
+    names = {e.get("name") for e in events}
+    assert "QUEUE" in names and "NEGOTIATE_ALLREDUCE" in names \
+        and "XLA_ALLREDUCE" in names
+    # per-tensor lane metadata exists
+    lanes = [e for e in events if e.get("name") == "thread_name"]
+    assert any(e["args"]["name"] == "tl_tensor" for e in lanes)
+
+
+def test_requeue_preserves_entries(hvd, world_size):
+    """Controller-filtered (not ready) entries execute on a later cycle."""
+    import horovod_tpu.ops.eager as eager
+
+    eng = eager._engine()
+
+    class HoldFirstCycle:
+        def __init__(self):
+            self.calls = 0
+
+        def negotiate(self, entries):
+            self.calls += 1
+            if self.calls == 1:
+                return []  # nothing ready yet
+            return entries
+
+    eng.controller = HoldFirstCycle()
+    try:
+        h = hvd.allreduce_async(_stacked(hvd, world_size, seed=4),
+                                name="held", op=hvd.Sum)
+        out = hvd.synchronize(h, )
+        assert np.asarray(out).shape == (4,)
+        assert eng.controller.calls >= 2
+    finally:
+        eng.controller = None
