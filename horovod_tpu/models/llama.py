@@ -1,0 +1,278 @@
+"""Llama-family decoder with explicit dp/tp/sp parallelism (flagship model).
+
+Role in the rebuild: BASELINE config #4 ("Llama-3 8B pure-DP with Adasum /
+hierarchical allreduce on torus") plus the long-context requirement the
+reference lacks (SURVEY.md §5): ring attention over the ``sp`` axis, Megatron
+tensor parallelism over ``tp``, gradient allreduce over ``dp`` — all written
+as explicit SPMD for ``shard_map``, the TPU-native analogue of the
+reference's explicit-collective style (vs. letting GSPMD guess).
+
+Parameters are plain pytrees (dict of dicts of jnp arrays) with a parallel
+tree of ``PartitionSpec``s (``param_specs``) describing how each leaf is
+sharded over the mesh; activations: batch over ``dp``, sequence over ``sp``,
+heads/ffn over ``tp``.
+
+TP convention (Megatron): wq/wk/wv/w1/w3 column-sharded, wo/w2 row-sharded
+with a psum after; norms/embeddings replicated (their grads are psum'd over
+``tp`` in the train step — the f/g-operator pair).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.ring_attention import ring_attention, local_flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq: int = 8192
+    rope_theta: float = 500000.0
+    dtype: Any = jnp.bfloat16
+    # mesh axis names (set to None to disable an axis)
+    dp_axis: Optional[str] = "dp"
+    tp_axis: Optional[str] = "tp"
+    sp_axis: Optional[str] = "sp"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def tiny(vocab_size: int = 256, d_model: int = 64, n_layers: int = 2,
+         n_heads: int = 4, n_kv_heads: int = 2, d_ff: int = 128,
+         max_seq: int = 128, **kw) -> LlamaConfig:
+    """Small config for tests / dryruns."""
+    return LlamaConfig(vocab_size=vocab_size, d_model=d_model,
+                       n_layers=n_layers, n_heads=n_heads,
+                       n_kv_heads=n_kv_heads, d_ff=d_ff, max_seq=max_seq, **kw)
+
+
+def llama3_8b() -> LlamaConfig:
+    return LlamaConfig()  # defaults above are the 8B geometry
+
+
+# ------------------------------------------------------------------- params
+def init_params(cfg: LlamaConfig, key) -> Dict:
+    """Initialize the full (unsharded) parameter pytree."""
+    k = iter(jax.random.split(key, 4 + 7 * cfg.n_layers))
+    D, H, K, Hd, F = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
+                      cfg.head_dim, cfg.d_ff)
+    dt = cfg.dtype
+
+    def dense(key, fan_in, shape):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / np.sqrt(fan_in))).astype(dt)
+
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append({
+            "attn_norm": jnp.ones((D,), dt),
+            "wq": dense(next(k), D, (D, H * Hd)),
+            "wk": dense(next(k), D, (D, K * Hd)),
+            "wv": dense(next(k), D, (D, K * Hd)),
+            "wo": dense(next(k), H * Hd, (H * Hd, D)),
+            "mlp_norm": jnp.ones((D,), dt),
+            "w1": dense(next(k), D, (D, F)),
+            "w3": dense(next(k), D, (D, F)),
+            "w2": dense(next(k), F, (F, D)),
+        })
+    return {
+        "embed": dense(next(k), D, (cfg.vocab_size, D)),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), dt),
+        "lm_head": dense(next(k), D, (D, cfg.vocab_size)),
+    }
+
+
+def param_specs(cfg: LlamaConfig) -> Dict:
+    """PartitionSpec tree matching ``init_params`` (tp sharding only;
+    params are replicated over dp/sp)."""
+    tp = cfg.tp_axis
+    layer = {
+        "attn_norm": P(),
+        "wq": P(None, tp),
+        "wk": P(None, tp),
+        "wv": P(None, tp),
+        "wo": P(tp, None),
+        "mlp_norm": P(),
+        "w1": P(None, tp),
+        "w3": P(None, tp),
+        "w2": P(tp, None),
+    }
+    return {
+        "embed": P(),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "final_norm": P(),
+        "lm_head": P(),
+    }
+
+
+# ------------------------------------------------------------------ forward
+def _rmsnorm(x, w, eps=1e-5):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def _rope(x, positions, theta):
+    """Rotary embeddings; x: [B, T, H, Hd], positions: [T]."""
+    B, T, H, Hd = x.shape
+    half = Hd // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, half]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin], axis=-1).astype(x.dtype)
+
+
+def _attention(x, p, cfg: LlamaConfig, positions):
+    """Self-attention on the local tp shard of heads; sp-ring over sequence."""
+    B, T, D = x.shape
+    tp = lax.axis_size(cfg.tp_axis) if cfg.tp_axis else 1
+    if cfg.n_heads % tp or cfg.n_kv_heads % tp:
+        raise ValueError(f"n_heads={cfg.n_heads}/n_kv_heads={cfg.n_kv_heads} "
+                         f"must be divisible by tp={tp}")
+    H_loc = cfg.n_heads // tp
+    K_loc = cfg.n_kv_heads // tp
+    Hd = cfg.head_dim
+
+    q = (x @ p["wq"]).reshape(B, T, H_loc, Hd)
+    kk = (x @ p["wk"]).reshape(B, T, K_loc, Hd)
+    v = (x @ p["wv"]).reshape(B, T, K_loc, Hd)
+    q = _rope(q, positions, cfg.rope_theta)
+    kk = _rope(kk, positions, cfg.rope_theta)
+    # GQA: repeat kv heads up to query heads.
+    rep = H_loc // K_loc
+    if rep > 1:
+        kk = jnp.repeat(kk, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    sp = lax.axis_size(cfg.sp_axis) if cfg.sp_axis else 1
+    if sp > 1:
+        out = ring_attention(q, kk, v, axis_name=cfg.sp_axis, causal=True)
+    else:
+        out = local_flash_attention(q, kk, v, causal=True)
+    out = out.reshape(B, T, H_loc * Hd) @ p["wo"]
+    if cfg.tp_axis:
+        out = lax.psum(out, cfg.tp_axis)      # row-parallel output proj
+    return out
+
+
+def _mlp(x, p, cfg: LlamaConfig):
+    h = jax.nn.silu(x @ p["w1"]) * (x @ p["w3"])
+    out = h @ p["w2"]
+    if cfg.tp_axis:
+        out = lax.psum(out, cfg.tp_axis)
+    return out
+
+
+def forward(params, tokens, cfg: LlamaConfig):
+    """Logits for local token shard [B_loc, T_loc] (call inside shard_map,
+    or directly when all axes are disabled/size-1)."""
+    B, T = tokens.shape
+    if cfg.sp_axis:
+        sp_idx = lax.axis_index(cfg.sp_axis)
+        positions = sp_idx * T + jnp.arange(T)
+    else:
+        positions = jnp.arange(T)
+    x = params["embed"][tokens]
+    for p in params["layers"]:
+        x = x + _attention(_rmsnorm(x, p["attn_norm"]), p, cfg, positions)
+        x = x + _mlp(_rmsnorm(x, p["mlp_norm"]), p, cfg)
+    x = _rmsnorm(x, params["final_norm"])
+    return x @ params["lm_head"]
+
+
+def loss_fn(params, tokens, targets, cfg: LlamaConfig):
+    """PARTIAL next-token cross-entropy: this rank's contribution to the
+    global mean.
+
+    Written for shard_map's sum-semantics autodiff (the transpose of an
+    in-graph psum is psum): the differentiated function contains NO loss
+    psum; instead per-rank partial losses are scaled so they sum to the true
+    global mean across every mesh axis — 1/(global_count) for the dp/sp data
+    split and 1/tp for the redundant tensor-parallel compute.  ``sync_grads``
+    then turns per-rank partial grads into the exact mean gradient, and
+    ``psum_loss`` recovers the scalar for logging.
+    """
+    logits = forward(params, tokens, cfg).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # dp/sp factors extend the local count to the global token count; the
+    # tp factor splits the redundantly-computed loss across tp ranks.
+    denom = float(nll.size)
+    for ax in (cfg.dp_axis, cfg.sp_axis, cfg.tp_axis):
+        if ax:
+            denom = denom * lax.axis_size(ax)
+    return jnp.sum(nll) / denom
+
+
+def psum_loss(loss_partial, cfg: LlamaConfig):
+    """Sum per-rank partial losses into the true global mean loss."""
+    for ax in (cfg.dp_axis, cfg.sp_axis, cfg.tp_axis):
+        if ax:
+            loss_partial = lax.psum(loss_partial, ax)
+    return loss_partial
+
+
+# --------------------------------------------------------------- train step
+def sync_grads(grads, cfg: LlamaConfig, specs=None):
+    """Cross-rank gradient synchronization for the explicit-SPMD step.
+
+    Under sum-semantics autodiff each rank's grad is its partial
+    contribution, so:
+
+    - ALL params: psum over dp (the Horovod allreduce) and sp (each sp rank
+      saw a different sequence chunk).
+    - tp-replicated params only (norms, embed, lm_head): additionally psum
+      over tp to combine the per-shard contributions; tp-SHARDED params'
+      grads are already exact for their shard (the cotangent arriving
+      through the row-parallel psum's transpose is the full one).
+    The 1/(count·tp) scaling inside ``loss_fn`` makes these psums land on
+    the exact global-mean gradient.
+    """
+    specs = specs or param_specs(cfg)
+
+    def leaf_sync(g, spec):
+        for ax in (cfg.dp_axis, cfg.sp_axis):
+            if ax:
+                g = lax.psum(g, ax)
+        if cfg.tp_axis and all(s != cfg.tp_axis for s in spec):
+            g = lax.psum(g, cfg.tp_axis)
+        return g
+
+    return jax.tree_util.tree_map(leaf_sync, grads, specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+def make_train_step(cfg: LlamaConfig, optimizer):
+    """Returns ``step(params, opt_state, tokens, targets) -> (params,
+    opt_state, loss)`` for use inside shard_map over (dp, sp, tp)."""
+    import optax
+
+    def step(params, opt_state, tokens, targets):
+        loss_partial, grads = jax.value_and_grad(loss_fn)(
+            params, tokens, targets, cfg)
+        grads = sync_grads(grads, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, psum_loss(loss_partial, cfg)
+
+    return step

@@ -1,0 +1,43 @@
+"""Hierarchical (two-level) allreduce over a (cross, local) mesh.
+
+Parity: the reference's ``HOROVOD_HIERARCHICAL_ALLREDUCE`` path in
+``horovod/common/ops/nccl_operations.cc`` (SURVEY.md §2a N17, §2c) — NCCL
+ReduceScatter intra-node, MPI allreduce cross-node, NCCL Allgather intra-node.
+TPU mapping: ``local`` = ICI within a slice/host, ``cross`` = DCN between
+slices.  Same three-phase structure:
+
+    reducescatter(local) -> allreduce(cross) -> allgather(local)
+
+Total bytes over the slow (cross) links drop by a factor of ``local_size``,
+which is the entire point when cross rides DCN.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def hierarchical_allreduce(x, cross_axis: str = "cross",
+                           local_axis: str = "local",
+                           average: bool = False):
+    """Two-level allreduce; call inside shard_map over a 2-D mesh."""
+    orig_shape, orig_dtype = x.shape, x.dtype
+    n_local = lax.axis_size(local_axis)
+    flat = x.reshape(-1)
+    pad = (-flat.shape[0]) % n_local
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    # Phase 1: reduce-scatter across the fast local axis.
+    shard = lax.psum_scatter(flat, local_axis, tiled=True)
+    # Phase 2: allreduce the 1/n_local shard across the slow cross axis.
+    shard = lax.psum(shard, cross_axis)
+    # Phase 3: allgather back across the local axis.
+    full = lax.all_gather(shard, local_axis, tiled=True)
+    if pad:
+        full = full[:-pad]
+    out = full.reshape(orig_shape)
+    if average:
+        world = n_local * lax.axis_size(cross_axis)
+        out = out / jnp.asarray(world, out.dtype)
+    return out.astype(orig_dtype)

@@ -1,0 +1,60 @@
+"""Multi-axis mesh construction for dp/tp/sp/ep parallelism.
+
+The reference is data-parallel only (SURVEY.md §2c); its process sets +
+alltoall/allgather primitives are the enabling layer for everything else.
+Here the enabling layer is mesh-native: a ``jax.sharding.Mesh`` with named
+axes, ICI-topology-ordered (``common/topology.py``), over which
+``ops/collectives.py`` primitives and the ``parallel/`` schemes compose.
+
+Axis conventions used across the framework:
+
+- ``dp``: data parallel (gradient allreduce — the Horovod axis)
+- ``tp``: tensor parallel (Megatron-style sharded matmuls)
+- ``sp``: sequence/context parallel (ring attention / Ulysses)
+- ``ep``: expert parallel (MoE / DLRM embedding alltoall)
+- ``pp``: pipeline stages (microbatched lax.scan pipeline)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..common.topology import ordered_devices
+
+DP, TP, SP, EP, PP = "dp", "tp", "sp", "ep", "pp"
+
+
+def make_mesh(axis_sizes: Dict[str, int],
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a named mesh, e.g. ``make_mesh({"dp": 2, "tp": 2, "sp": 2})``.
+
+    Axis order in the dict is the physical order: the **last** axis varies
+    fastest over ICI-neighbor devices, so put the most communication-hungry
+    axis (usually ``tp``) last — the standard TPU layout rule (ICI-neighbor
+    collectives are cheapest).
+    """
+    devs = ordered_devices(devices)
+    sizes = list(axis_sizes.values())
+    total = int(np.prod(sizes))
+    if total != len(devs):
+        raise ValueError(
+            f"Mesh axes {axis_sizes} require {total} devices, have {len(devs)}")
+    arr = np.array(devs, dtype=object).reshape(sizes)
+    return Mesh(arr, tuple(axis_sizes.keys()))
+
+
+def infer_mesh(n_devices: int,
+               tp: int = 1, sp: int = 1, ep: int = 1, pp: int = 1,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """dp fills whatever the fixed axes leave over."""
+    denom = tp * sp * ep * pp
+    if n_devices % denom:
+        raise ValueError(f"{n_devices} devices not divisible by tp*sp*ep*pp={denom}")
+    # All axes always present (size-1 axes are free) so PartitionSpecs can
+    # reference any of them unconditionally.
+    axes = {DP: n_devices // denom, PP: pp, EP: ep, SP: sp, TP: tp}
+    return make_mesh(axes, devices)

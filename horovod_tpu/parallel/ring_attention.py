@@ -1,0 +1,115 @@
+"""Ring attention: exact attention over sequence shards via ICI neighbor
+exchange.
+
+No reference analogue (Horovod predates sequence parallelism — SURVEY.md §5
+explicitly: "ABSENT in the reference"); built on the same primitive class the
+reference exposes (point-to-point ring = ``lax.ppermute`` over ICI, the
+substrate XLA already provides on the torus).  Algorithm: blockwise/flash
+attention with an online-softmax accumulator; K/V blocks rotate around the
+``sp`` ring, so each rank sees every block once, overlapping compute with the
+neighbor transfer.  Memory per chip stays O(T/sp · T/sp) and the full
+sequence is never materialized — the long-context workhorse.
+
+Use inside ``shard_map`` with the sequence dimension sharded over ``sp``:
+
+    out = ring_attention(q, k, v, axis_name="sp", causal=True)
+
+Shapes: q, k, v are the local shards ``[batch, seq_local, heads, head_dim]``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, bias, scale):
+    """One q-block × k-block attention with f32 accumulation.
+
+    Returns (unnormalized out, row max, row sumexp) for online-softmax
+    merging.  q: [B,Tq,H,D], k/v: [B,Tk,H,D], bias: [Tq,Tk] or None.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias[None, None, :, :]
+    m = jnp.max(s, axis=-1)                       # [B,H,Tq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                       # [B,H,Tq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
+                   scale: Optional[float] = None):
+    """Exact (flash-equivalent) attention over an ``sp``-sharded sequence."""
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+
+    # Online-softmax accumulators (f32).
+    o_acc = jnp.zeros((B, Tq, H, D), jnp.float32)
+    m_acc = jnp.full((B, H, Tq), NEG_INF, jnp.float32)
+    l_acc = jnp.zeros((B, H, Tq), jnp.float32)
+
+    shift = [(i, (i + 1) % n) for i in range(n)]
+
+    def merge(carry, block):
+        o_acc, m_acc, l_acc = carry
+        o, m, l = block
+        m_new = jnp.maximum(m_acc, m)
+        a = jnp.exp(m_acc - m_new)
+        b = jnp.exp(m - m_new)
+        l_new = l_acc * a + l * b
+        # broadcast [B,H,Tq] -> [B,Tq,H,1]
+        a_ = jnp.transpose(a, (0, 2, 1))[..., None]
+        b_ = jnp.transpose(b, (0, 2, 1))[..., None]
+        o_new = o_acc * a_ + o.astype(jnp.float32) * b_
+        return o_new, m_new, l_new
+
+    kv = (k, v)
+    for step in range(n):
+        src = (my - step) % n          # which rank's K/V block we now hold
+        k_cur, v_cur = kv
+        if causal:
+            q_pos = my * Tq + jnp.arange(Tq)
+            k_pos = src * Tk + jnp.arange(Tk)
+            bias = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, NEG_INF)
+            o, m, l = _block_attn(q, k_cur, v_cur, bias, scale)
+        else:
+            o, m, l = _block_attn(q, k_cur, v_cur, None, scale)
+        o_acc, m_acc, l_acc = merge((o_acc, m_acc, l_acc), (o, m, l))
+        if step != n - 1:
+            # Rotate K/V to the next rank; XLA overlaps this with compute.
+            kv = (lax.ppermute(k_cur, axis_name, perm=shift),
+                  lax.ppermute(v_cur, axis_name, perm=shift))
+
+    l_ = jnp.transpose(l_acc, (0, 2, 1))[..., None]        # [B,Tq,H,1]
+    out = o_acc / jnp.maximum(l_, 1e-30)
+    return out.astype(q.dtype)
+
+
+def local_flash_attention(q, k, v, causal: bool = False,
+                          scale: Optional[float] = None):
+    """Single-device reference attention (same math, no ring) for tests and
+    for the sp=1 fast path."""
+    B, Tq, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        Tk = k.shape[1]
+        mask = jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)

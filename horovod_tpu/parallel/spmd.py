@@ -1,0 +1,68 @@
+"""Harness for jitting explicit-SPMD train steps over a multi-axis mesh.
+
+Glue between the model zoo (``models/``) and the mesh layer: given a model's
+param PartitionSpecs and a per-shard train step (written with explicit
+collectives — the framework's TPU-native style), produce the compiled
+multi-chip program via ``shard_map`` + ``jit``.
+
+The reference has no counterpart (its unit of execution is a single-GPU
+framework graph + out-of-graph collectives); this module is where the
+rebuild exploits XLA's whole-program compilation instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def infer_specs_like(tree, params, param_specs) -> Any:
+    """PartitionSpecs for an arbitrary pytree (e.g. optax state) by shape-
+    matching its array leaves against the params' specs.
+
+    Optax states are pytrees whose array leaves either mirror a param
+    (mu/nu/trace — same shape, same sharding) or are scalars/step counters
+    (replicated).  Shapes that never appear among params get P() —
+    replicated — which is always correct, just not sharded.
+    """
+    shape_to_spec: Dict[Tuple, Any] = {}
+    p_leaves = jax.tree_util.tree_leaves(params)
+    s_leaves = jax.tree_util.tree_leaves(
+        param_specs, is_leaf=lambda x: isinstance(x, P))
+    for pl, sl in zip(p_leaves, s_leaves):
+        shape_to_spec.setdefault(tuple(pl.shape), sl)
+
+    def leaf_spec(leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        return shape_to_spec.get(shape, P())
+
+    return jax.tree_util.tree_map(leaf_spec, tree)
+
+
+def shard_params(params, param_specs, mesh: Mesh):
+    """Place a host-side param pytree onto the mesh per its specs."""
+    def put(p, spec):
+        return jax.device_put(p, NamedSharding(mesh, spec))
+    return jax.tree_util.tree_map(put, params, param_specs,
+                                  is_leaf=lambda x: hasattr(x, "shape"))
+
+
+def make_sharded_train_step(step_fn: Callable, mesh: Mesh,
+                            param_specs, opt_state_specs,
+                            data_spec) -> Callable:
+    """Compile ``step_fn(params, opt_state, tokens, targets)`` over the mesh.
+
+    ``step_fn`` is per-shard (explicit collectives inside); in/out specs:
+    params+opt_state per their spec trees, data per ``data_spec``, loss
+    replicated.
+    """
+    sharded = shard_map(
+        step_fn, mesh=mesh,
+        in_specs=(param_specs, opt_state_specs, data_spec, data_spec),
+        out_specs=(param_specs, opt_state_specs, P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0, 1))

@@ -1,0 +1,51 @@
+"""Ulysses-style sequence parallelism: alltoall head/sequence exchange.
+
+No reference analogue (SURVEY.md §5: sequence parallelism absent), but built
+directly on the primitive the reference *does* ship — ``hvd.alltoall``
+(reference ``horovod/common/ops/*Alltoall``, the DLRM exchange primitive) —
+here as ``lax.all_to_all`` over the ``sp`` mesh axis.
+
+Scheme (DeepSpeed-Ulysses): attention is local in the head dimension, so
+convert a sequence-sharded layout ``[B, T/sp, H, D]`` into a head-sharded
+layout ``[B, T, H/sp, D]`` with one alltoall, run full-sequence attention on
+the local heads, and alltoall back.  Two alltoalls per attention vs ring's
+(sp-1) permutes; wins when heads >= sp and ICI alltoall bandwidth is good.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from jax import lax
+
+
+def seq_to_heads(x, axis_name: str = "sp"):
+    """[B, T/sp, H, D] -> [B, T, H/sp, D] via alltoall over sp."""
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def heads_to_seq(x, axis_name: str = "sp"):
+    """[B, T, H/sp, D] -> [B, T/sp, H, D] — inverse alltoall."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, attn_fn: Optional[Callable] = None,
+                      axis_name: str = "sp", causal: bool = False):
+    """Attention over an sp-sharded sequence via head exchange.
+
+    ``attn_fn(q, k, v, causal=...)`` runs on full-sequence, local-head
+    tensors; defaults to the exact flash reference implementation.
+    Requires heads divisible by sp.
+    """
+    if attn_fn is None:
+        from .ring_attention import local_flash_attention
+        attn_fn = local_flash_attention
+    H = q.shape[2]
+    n = lax.axis_size(axis_name)
+    qh = seq_to_heads(q, axis_name)
+    kh = seq_to_heads(k, axis_name)
+    vh = seq_to_heads(v, axis_name)
+    out = attn_fn(qh, kh, vh, causal=causal)
+    return heads_to_seq(out, axis_name)
