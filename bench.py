@@ -1,0 +1,113 @@
+"""Benchmark entry point: prints ONE JSON line for the driver.
+
+Metric: ResNet-50 synthetic training throughput (images/sec/chip), the
+canonical Horovod benchmark (reference:
+``examples/pytorch/pytorch_synthetic_benchmark.py``, numbers in
+``docs/benchmarks.rst`` — see BASELINE.md).
+
+``vs_baseline`` compares against 219 images/sec — the per-GPU ResNet-50
+throughput on the Pascal P100 hardware Horovod's published 90%-scaling
+results were measured on (docs/benchmarks.rst-era TF benchmark; see
+BASELINE.md provenance caveat: the mounted reference was empty, so this is
+the upstream-published figure).
+
+Env overrides: HVD_BENCH_BATCH, HVD_BENCH_STEPS, HVD_BENCH_IMAGE (size),
+HVD_BENCH_MODEL=resnet50|llama.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+HOROVOD_P100_RESNET50_IMG_PER_SEC = 219.0
+
+
+def bench_resnet(batch: int, steps: int, image_size: int):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from horovod_tpu.models import resnet
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    cfg = resnet.ResNetConfig(
+        depth=50, num_classes=1000,
+        compute_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        sync_bn_axis=None)
+    params, stats = resnet.init_params(cfg, jax.random.PRNGKey(0))
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(params)
+    step = jax.jit(resnet.make_train_step(cfg, opt, axis_name=None),
+                   donate_argnums=(0, 1, 2))
+
+    x, y = resnet.synthetic_batch(batch, image_size=image_size)
+    x, y = jnp.asarray(x), jnp.asarray(y)
+
+    # Warmup (compile) then timed steps.
+    for _ in range(2):
+        params, stats, opt_state, loss = step(params, stats, opt_state, x, y)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, stats, opt_state, loss = step(params, stats, opt_state, x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def bench_llama(batch: int, steps: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from horovod_tpu.models import llama
+
+    cfg = llama.LlamaConfig(vocab_size=8192, d_model=512, n_layers=4,
+                            n_heads=8, n_kv_heads=4, d_ff=1536, max_seq=512,
+                            dtype=jnp.bfloat16, dp_axis=None, tp_axis=None,
+                            sp_axis=None)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+    step = jax.jit(llama.make_train_step(cfg, opt), donate_argnums=(0, 1))
+    rng = np.random.RandomState(0)
+    seq = 512
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+    targets = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                          jnp.int32)
+    for _ in range(2):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return batch * seq * steps / dt
+
+
+def main():
+    model = os.environ.get("HVD_BENCH_MODEL", "resnet50")
+    batch = int(os.environ.get("HVD_BENCH_BATCH", "32"))
+    steps = int(os.environ.get("HVD_BENCH_STEPS", "8"))
+    image = int(os.environ.get("HVD_BENCH_IMAGE", "224"))
+
+    if model == "llama":
+        tps = bench_llama(batch, steps)
+        out = {"metric": "llama_tiny_train_tokens_per_sec_per_chip",
+               "value": round(tps, 2), "unit": "tokens/sec",
+               "vs_baseline": 0.0}
+    else:
+        ips = bench_resnet(batch, steps, image)
+        out = {"metric": "resnet50_synthetic_images_per_sec_per_chip",
+               "value": round(ips, 2), "unit": "images/sec",
+               "vs_baseline": round(ips / HOROVOD_P100_RESNET50_IMG_PER_SEC,
+                                    3)}
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

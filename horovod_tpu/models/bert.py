@@ -1,0 +1,214 @@
+"""BERT encoder — BASELINE config #3 ("BERT-Large pretraining with
+DistributedOptimizer + fp16/bf16 fused allreduce").
+
+Explicit-SPMD like ``models/llama.py`` (shared conventions: Megatron tp for
+attention/FFN, optional sp via Ulysses head-exchange — bidirectional
+attention makes Ulysses the natural sp scheme rather than a causal ring —
+sum-semantics partial loss, spec-aware grad sync).  LayerNorm + GELU + learned
+positions per the BERT architecture; MLM loss over masked positions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.ring_attention import local_flash_attention
+from ..parallel.ulysses import ulysses_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 1024          # BERT-Large
+    n_layers: int = 24
+    n_heads: int = 16
+    d_ff: int = 4096
+    max_seq: int = 512
+    dtype: Any = jnp.bfloat16
+    dp_axis: Optional[str] = "dp"
+    tp_axis: Optional[str] = "tp"
+    sp_axis: Optional[str] = "sp"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def bert_large() -> BertConfig:
+    return BertConfig()
+
+
+def tiny(**kw) -> BertConfig:
+    defaults = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                    d_ff=128, max_seq=64)
+    defaults.update(kw)
+    return BertConfig(**defaults)
+
+
+def init_params(cfg: BertConfig, key) -> Dict:
+    k = iter(jax.random.split(key, 8 + 6 * cfg.n_layers))
+    D, H, Hd, F = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+    dt = cfg.dtype
+
+    def dense(key, fan_in, shape):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / np.sqrt(fan_in))).astype(dt)
+
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append({
+            "ln1_scale": jnp.ones((D,), dt), "ln1_bias": jnp.zeros((D,), dt),
+            "wq": dense(next(k), D, (D, H * Hd)),
+            "wk": dense(next(k), D, (D, H * Hd)),
+            "wv": dense(next(k), D, (D, H * Hd)),
+            "wo": dense(next(k), H * Hd, (H * Hd, D)),
+            "ln2_scale": jnp.ones((D,), dt), "ln2_bias": jnp.zeros((D,), dt),
+            "w_in": dense(next(k), D, (D, F)),
+            "b_in": jnp.zeros((F,), dt),
+            "w_out": dense(next(k), F, (F, D)),
+            "b_out": jnp.zeros((D,), dt),
+        })
+    return {
+        "tok_embed": dense(next(k), D, (cfg.vocab_size, D)),
+        "pos_embed": dense(next(k), D, (cfg.max_seq, D)),
+        "layers": layers,
+        "final_ln_scale": jnp.ones((D,), dt),
+        "final_ln_bias": jnp.zeros((D,), dt),
+        "mlm_head": dense(next(k), D, (D, cfg.vocab_size)),
+    }
+
+
+def param_specs(cfg: BertConfig) -> Dict:
+    tp = cfg.tp_axis
+    layer = {
+        "ln1_scale": P(), "ln1_bias": P(),
+        "wq": P(None, tp), "wk": P(None, tp), "wv": P(None, tp),
+        "wo": P(tp, None),
+        "ln2_scale": P(), "ln2_bias": P(),
+        "w_in": P(None, tp), "b_in": P(tp),
+        "w_out": P(tp, None), "b_out": P(),
+    }
+    return {
+        "tok_embed": P(), "pos_embed": P(),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "final_ln_scale": P(), "final_ln_bias": P(),
+        "mlm_head": P(),
+    }
+
+
+def _layernorm(x, scale, bias, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + eps)).astype(x.dtype) * scale + bias
+
+
+def _attention(x, p, cfg: BertConfig):
+    B, T, D = x.shape
+    tp = lax.axis_size(cfg.tp_axis) if cfg.tp_axis else 1
+    if cfg.n_heads % tp:
+        raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp}")
+    H_loc, Hd = cfg.n_heads // tp, cfg.head_dim
+    q = (x @ p["wq"]).reshape(B, T, H_loc, Hd)
+    k = (x @ p["wk"]).reshape(B, T, H_loc, Hd)
+    v = (x @ p["wv"]).reshape(B, T, H_loc, Hd)
+    sp = lax.axis_size(cfg.sp_axis) if cfg.sp_axis else 1
+    if sp > 1:
+        out = ulysses_attention(q, k, v, axis_name=cfg.sp_axis, causal=False)
+    else:
+        out = local_flash_attention(q, k, v, causal=False)
+    out = out.reshape(B, T, H_loc * Hd) @ p["wo"]
+    if cfg.tp_axis:
+        out = lax.psum(out, cfg.tp_axis)
+    return out
+
+
+def _ffn(x, p, cfg: BertConfig):
+    h = jax.nn.gelu(x @ p["w_in"] + p["b_in"])
+    out = h @ p["w_out"]
+    if cfg.tp_axis:
+        out = lax.psum(out, cfg.tp_axis)
+    return out + p["b_out"]
+
+
+def forward(params, tokens, cfg: BertConfig):
+    """Encoder states for the local token shard [B_loc, T_loc]."""
+    B, T = tokens.shape
+    if cfg.sp_axis:
+        sp_idx = lax.axis_index(cfg.sp_axis)
+        positions = sp_idx * T + jnp.arange(T)
+    else:
+        positions = jnp.arange(T)
+    x = params["tok_embed"][tokens] + params["pos_embed"][positions][None]
+    for p in params["layers"]:
+        x = x + _attention(_layernorm(x, p["ln1_scale"], p["ln1_bias"]),
+                           p, cfg)
+        x = x + _ffn(_layernorm(x, p["ln2_scale"], p["ln2_bias"]), p, cfg)
+    x = _layernorm(x, params["final_ln_scale"], params["final_ln_bias"])
+    return x
+
+
+def mlm_loss_fn(params, tokens, targets, mask, cfg: BertConfig):
+    """Partial masked-LM loss (sum-semantics; see llama.loss_fn).
+
+    ``mask`` is 1.0 at masked positions.  The denominator is the GLOBAL mask
+    count — psum'd over dp/sp, which is safe under sum-semantics autodiff
+    because no parameter cotangent flows through the mask — times tp for the
+    redundant tensor-parallel compute.
+    """
+    x = forward(params, tokens, cfg)
+    logits = (x @ params["mlm_head"]).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    local_sum = jnp.sum(nll * mask)
+    denom = jnp.sum(mask)
+    for ax in (cfg.dp_axis, cfg.sp_axis):
+        if ax:
+            denom = lax.psum(denom, ax)
+    denom = jnp.maximum(denom, 1.0)
+    if cfg.tp_axis:
+        denom = denom * lax.axis_size(cfg.tp_axis)
+    return local_sum / denom
+
+
+def psum_loss(loss_partial, cfg: BertConfig):
+    for ax in (cfg.dp_axis, cfg.sp_axis, cfg.tp_axis):
+        if ax:
+            loss_partial = lax.psum(loss_partial, ax)
+    return loss_partial
+
+
+def sync_grads(grads, cfg: BertConfig, specs=None):
+    specs = specs or param_specs(cfg)
+
+    def leaf_sync(g, spec):
+        for ax in (cfg.dp_axis, cfg.sp_axis):
+            if ax:
+                g = lax.psum(g, ax)
+        if cfg.tp_axis and all(s != cfg.tp_axis for s in spec):
+            g = lax.psum(g, cfg.tp_axis)
+        return g
+
+    return jax.tree_util.tree_map(leaf_sync, grads, specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+def make_train_step(cfg: BertConfig, optimizer):
+    import optax
+
+    def step(params, opt_state, tokens, targets, mask):
+        loss_partial, grads = jax.value_and_grad(mlm_loss_fn)(
+            params, tokens, targets, mask, cfg)
+        grads = sync_grads(grads, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, psum_loss(loss_partial, cfg)
+
+    return step

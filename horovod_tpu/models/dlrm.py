@@ -1,0 +1,187 @@
+"""DLRM — BASELINE config #5 ("DLRM with alltoall embedding exchange").
+
+The reference's role for ``hvd.alltoall`` (SURVEY.md §2c "expert/embedding
+parallel via alltoall"): recommendation models shard their huge embedding
+tables across ranks (model parallel) while MLPs run data parallel; each
+step exchanges looked-up embedding rows with one alltoall so every rank
+gets the embeddings for ITS batch shard from every table shard.
+
+TPU-native layout: tables sharded over the ``ep`` axis (table-parallel —
+each ep rank owns ``n_tables/ep`` whole tables), batch over ``dp``.  The
+exchange is ``lax.all_to_all`` over ep, riding ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class DLRMConfig:
+    n_tables: int = 8                 # total sparse features
+    rows_per_table: int = 1000
+    embed_dim: int = 32
+    dense_dim: int = 13
+    bottom_mlp: Tuple[int, ...] = (64, 32)
+    top_mlp: Tuple[int, ...] = (64, 32, 1)
+    dtype: Any = jnp.float32
+    dp_axis: Optional[str] = "dp"
+    ep_axis: Optional[str] = "ep"
+
+
+def tiny(**kw) -> DLRMConfig:
+    return DLRMConfig(**kw)
+
+
+def _mlp_params(key, dims, dtype):
+    ps = []
+    for i in range(len(dims) - 1):
+        key, k = jax.random.split(key)
+        ps.append({"w": (jax.random.normal(k, (dims[i], dims[i + 1]),
+                                           jnp.float32)
+                         / np.sqrt(dims[i])).astype(dtype),
+                   "b": jnp.zeros((dims[i + 1],), dtype)})
+    return ps
+
+
+def init_params(cfg: DLRMConfig, key) -> Dict:
+    """Tables are stored STACKED [n_tables, rows, dim] so the ep sharding is
+    one leading-axis partition (tables_per_rank = n_tables/ep)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    tables = (jax.random.normal(
+        k1, (cfg.n_tables, cfg.rows_per_table, cfg.embed_dim), jnp.float32)
+        * 0.01).astype(cfg.dtype)
+    n_feats = cfg.embed_dim * cfg.n_tables
+    inter_in = cfg.bottom_mlp[-1] + n_feats
+    return {
+        "tables": tables,
+        "bottom": _mlp_params(k2, (cfg.dense_dim,) + cfg.bottom_mlp, cfg.dtype),
+        "top": _mlp_params(k3, (inter_in,) + cfg.top_mlp, cfg.dtype),
+    }
+
+
+def param_specs(cfg: DLRMConfig) -> Dict:
+    n_bottom = len(cfg.bottom_mlp)
+    n_top = len(cfg.top_mlp)
+    return {
+        "tables": P(cfg.ep_axis),
+        "bottom": [{"w": P(), "b": P()} for _ in range(n_bottom)],
+        "top": [{"w": P(), "b": P()} for _ in range(n_top)],
+    }
+
+
+def _mlp(x, layers, final_act=None):
+    for i, l in enumerate(layers):
+        x = x @ l["w"] + l["b"]
+        if i < len(layers) - 1:
+            x = jax.nn.relu(x)
+        elif final_act is not None:
+            x = final_act(x)
+    return x
+
+
+def _embedding_exchange(tables_local, sparse_ids, cfg: DLRMConfig):
+    """Lookup + alltoall (the reference's ``hvd.alltoall`` hot path).
+
+    Hybrid-parallel layout: the batch is sharded over dp AND ep (data spec
+    ``P(("dp", "ep"))``); tables are sharded over ep.  Per step:
+
+    1. allgather the (small) id matrix over ep so this rank sees the ids of
+       every ep-peer's batch slice;
+    2. look up this rank's local tables for that combined batch;
+    3. ONE alltoall redistributes the (large) embedding rows so each rank
+       ends with all-table embeddings for exactly its own batch slice —
+       the op the reference's DLRM config exists to exercise.
+
+    tables_local: [n_tables/ep, rows, dim]; sparse_ids: [B_loc, n_tables].
+    """
+    ep = lax.axis_size(cfg.ep_axis) if cfg.ep_axis else 1
+    t_loc = tables_local.shape[0]
+    if not cfg.ep_axis or ep == 1:
+        looked = jax.vmap(lambda tbl, ids: tbl[ids], in_axes=(0, 1),
+                          out_axes=1)(tables_local, sparse_ids)
+        return looked.reshape(looked.shape[0], -1)
+    ep_idx = lax.axis_index(cfg.ep_axis)
+    ids_all = lax.all_gather(sparse_ids, cfg.ep_axis, axis=0, tiled=True)
+    my_ids = lax.dynamic_slice_in_dim(ids_all, ep_idx * t_loc, t_loc, 1)
+    # [B_loc*ep, t_loc, dim]: my tables' rows for every ep-peer's slice
+    looked = jax.vmap(lambda tbl, ids: tbl[ids], in_axes=(0, 1),
+                      out_axes=1)(tables_local, my_ids)
+    # alltoall: batch slices out, table groups in -> [B_loc, n_tables, dim]
+    exchanged = lax.all_to_all(looked, cfg.ep_axis, split_axis=0,
+                               concat_axis=1, tiled=True)
+    return exchanged.reshape(exchanged.shape[0], -1)
+
+
+def forward(params, dense, sparse_ids, cfg: DLRMConfig):
+    """dense [B, dense_dim], sparse_ids [B, n_tables] -> logits [B]."""
+    bottom_out = _mlp(dense, params["bottom"])
+    emb = _embedding_exchange(params["tables"], sparse_ids, cfg)
+    interact = jnp.concatenate([bottom_out, emb.astype(bottom_out.dtype)],
+                               axis=-1)
+    return _mlp(interact, params["top"])[:, 0]
+
+
+def loss_fn(params, dense, sparse_ids, labels, cfg: DLRMConfig):
+    """Partial BCE loss (sum semantics over dp; ep compute is not redundant
+    for tables — each rank owns distinct tables — but the MLP compute is
+    replicated over ep, handled by the denominators in sync_grads)."""
+    logits = forward(params, dense, sparse_ids, cfg).astype(jnp.float32)
+    bce = optax.sigmoid_binary_cross_entropy(logits, labels.astype(jnp.float32))
+    denom = float(bce.size)
+    for ax in (cfg.dp_axis, cfg.ep_axis):
+        if ax:
+            denom = denom * lax.axis_size(ax)
+    return jnp.sum(bce) / denom
+
+
+def psum_loss(loss_partial, cfg: DLRMConfig):
+    for ax in (cfg.dp_axis, cfg.ep_axis):
+        if ax:
+            loss_partial = lax.psum(loss_partial, ax)
+    return loss_partial
+
+
+def sync_grads(grads, cfg: DLRMConfig):
+    """dp psum for everything; ep psum only for ep-replicated params (MLPs).
+    Table grads stay local to their ep shard."""
+    specs = param_specs(cfg)
+
+    def leaf_sync(g, spec):
+        if cfg.dp_axis:
+            g = lax.psum(g, cfg.dp_axis)
+        if cfg.ep_axis and all(s != cfg.ep_axis for s in spec):
+            g = lax.psum(g, cfg.ep_axis)
+        return g
+
+    return jax.tree_util.tree_map(leaf_sync, grads, specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+def make_train_step(cfg: DLRMConfig, optimizer):
+    def step(params, opt_state, dense, sparse_ids, labels):
+        loss_partial, grads = jax.value_and_grad(loss_fn)(
+            params, dense, sparse_ids, labels, cfg)
+        grads = sync_grads(grads, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, psum_loss(loss_partial, cfg)
+
+    return step
+
+
+def synthetic_batch(cfg: DLRMConfig, batch: int, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    dense = rng.randn(batch, cfg.dense_dim).astype(np.float32)
+    sparse = rng.randint(0, cfg.rows_per_table,
+                         size=(batch, cfg.n_tables)).astype(np.int32)
+    labels = rng.randint(0, 2, size=(batch,)).astype(np.int32)
+    return dense, sparse, labels

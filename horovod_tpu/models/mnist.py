@@ -1,0 +1,104 @@
+"""MNIST CNN — BASELINE config #1 (reference:
+``examples/pytorch/pytorch_mnist.py``).
+
+The canonical end-to-end smoke: a small convnet trained data-parallel with
+``hvd.DistributedOptimizer`` + ``broadcast_parameters``, here as an explicit
+shard_map step over the ``hvd``/``dp`` axis.  Runs on synthetic digits when
+the real dataset isn't on disk (this image has no network).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def init_params(key, dtype=jnp.float32):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+
+    def he(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * np.sqrt(2.0 / fan_in)).astype(dtype)
+
+    return {
+        "conv1": {"w": he(k1, (3, 3, 1, 32), 9), "b": jnp.zeros((32,), dtype)},
+        "conv2": {"w": he(k2, (3, 3, 32, 64), 9 * 32),
+                  "b": jnp.zeros((64,), dtype)},
+        "fc1": {"w": he(k3, (7 * 7 * 64, 128), 7 * 7 * 64),
+                "b": jnp.zeros((128,), dtype)},
+        "fc2": {"w": he(k4, (128, 10), 128), "b": jnp.zeros((10,), dtype)},
+    }
+
+
+def forward(params, x):
+    """x: [B, 28, 28, 1] -> logits [B, 10]."""
+    def conv(x, p):
+        y = lax.conv_general_dilated(
+            x, p["w"], window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return y + p["b"]
+
+    x = jax.nn.relu(conv(x, params["conv1"]))
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1),
+                          "VALID")
+    x = jax.nn.relu(conv(x, params["conv2"]))
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1),
+                          "VALID")
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["fc1"]["w"] + params["fc1"]["b"])
+    return x @ params["fc2"]["w"] + params["fc2"]["b"]
+
+
+def loss_fn(params, x, y, axis_name: Optional[str] = "hvd"):
+    """Partial mean NLL (sum-semantics; see models/llama.py loss_fn)."""
+    logits = forward(params, x).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, y[:, None], axis=-1)[:, 0]
+    denom = float(nll.size)
+    if axis_name:
+        denom = denom * lax.axis_size(axis_name)
+    return jnp.sum(nll) / denom
+
+
+def make_train_step(optimizer, axis_name: Optional[str] = "hvd"):
+    """Per-shard DP train step: grads psum'd over the world axis — the
+    DistributedOptimizer pattern of SURVEY.md §3.2 in explicit SPMD."""
+
+    def step(params, opt_state, x, y):
+        loss_partial, grads = jax.value_and_grad(loss_fn)(params, x, y,
+                                                          axis_name)
+        if axis_name:
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.psum(g, axis_name), grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        loss = lax.psum(loss_partial, axis_name) if axis_name else loss_partial
+        return params, opt_state, loss
+
+    return step
+
+
+def make_sharded_train_step(optimizer, mesh: Mesh, axis_name: str = "hvd"):
+    step = make_train_step(optimizer, axis_name)
+    return jax.jit(shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(), P(axis_name), P(axis_name)),
+        out_specs=(P(), P(), P()), check_vma=False),
+        donate_argnums=(0, 1))
+
+
+def synthetic_batch(batch: int, seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic fake digits: class-dependent blobs + noise."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, size=(batch,)).astype(np.int32)
+    x = rng.randn(batch, 28, 28, 1).astype(np.float32) * 0.1
+    for i, cls in enumerate(y):
+        r, c = divmod(int(cls), 4)
+        x[i, 4 + r * 6:10 + r * 6, 4 + c * 6:10 + c * 6, 0] += 1.0
+    return x, y

@@ -12,7 +12,7 @@ rebuild exploits XLA's whole-program compilation instead.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable
 
 import jax
 import numpy as np
@@ -21,26 +21,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def infer_specs_like(tree, params, param_specs) -> Any:
-    """PartitionSpecs for an arbitrary pytree (e.g. optax state) by shape-
-    matching its array leaves against the params' specs.
+    """PartitionSpecs for an arbitrary pytree (e.g. optax state).
 
-    Optax states are pytrees whose array leaves either mirror a param
-    (mu/nu/trace — same shape, same sharding) or are scalars/step counters
-    (replicated).  Shapes that never appear among params get P() —
-    replicated — which is always correct, just not sharded.
+    Optax states embed whole subtrees with the params' exact tree structure
+    (mu/nu/trace); those get the params' spec tree verbatim.  Everything
+    else (step counters, scalars, unrecognized leaves) is replicated (P()),
+    which is always correct, just not sharded.  Structure matching — not
+    shape matching — because two params can share a shape but differ in
+    sharding (e.g. a column-parallel wq and row-parallel wo of equal size).
     """
-    shape_to_spec: Dict[Tuple, Any] = {}
-    p_leaves = jax.tree_util.tree_leaves(params)
-    s_leaves = jax.tree_util.tree_leaves(
-        param_specs, is_leaf=lambda x: isinstance(x, P))
-    for pl, sl in zip(p_leaves, s_leaves):
-        shape_to_spec.setdefault(tuple(pl.shape), sl)
+    p_leaves, params_struct = jax.tree_util.tree_flatten(params)
+    p_shapes = [tuple(l.shape) for l in p_leaves]
 
-    def leaf_spec(leaf):
-        shape = tuple(getattr(leaf, "shape", ()))
-        return shape_to_spec.get(shape, P())
+    def is_param_tree(sub) -> bool:
+        # Structure AND leaf-shape equality: structure alone degenerates for
+        # single-array params (any scalar leaf matches a one-leaf treedef).
+        try:
+            leaves, struct = jax.tree_util.tree_flatten(sub)
+            return (struct == params_struct
+                    and [tuple(getattr(l, "shape", ())) for l in leaves]
+                    == p_shapes)
+        except Exception:
+            return False
 
-    return jax.tree_util.tree_map(leaf_spec, tree)
+    return jax.tree_util.tree_map(
+        lambda s: param_specs if is_param_tree(s) else P(),
+        tree, is_leaf=is_param_tree)
 
 
 def shard_params(params, param_specs, mesh: Mesh):
