@@ -1,0 +1,345 @@
+// TPU-native coordinator control plane: TCP negotiation over DCN.
+//
+// Native equivalent of the reference's controller transports
+// (horovod/common/mpi/mpi_controller.cc, horovod/common/gloo/gloo_controller.cc
+// — SURVEY.md §2a N2/N3/N4) with the transport swapped per SURVEY.md §5
+// ("distributed communication backend"): instead of MPI gather/bcast of
+// serialized Request/Response messages, a rank-0 TCP server runs lock-step
+// negotiation rounds with every worker over DCN.  The data plane is NOT
+// here — fused collectives execute as XLA programs over ICI; this is purely
+// the out-of-graph readiness protocol (which tensors are pending on every
+// rank, in what order), plus rank-0 stall tracking (N11's role).
+//
+// Wire protocol (all little-endian, length-prefixed frames):
+//   frame  := uint32 payload_len, payload
+//   C->S   := uint32 n_announce, n_announce * { uint16 required,
+//                                               uint16 len, bytes name }
+//             (names newly enqueued on this rank since the last round;
+//              `required` = number of ranks that must announce before the
+//              tensor is ready — process-set size; 0 means the full world.
+//              A round with nothing new sends n_announce = 0)
+//   S->C   := uint32 n_ready,   n_ready * { uint16 len, bytes name }
+//             uint32 n_warn,    n_warn  * { uint16 len, bytes text }
+//             (ready = pending on ALL ranks, in deterministic order:
+//              first-announce round, then name; warn = stall diagnoses
+//              naming the missing ranks, the reference's stall_inspector
+//              output)
+//
+// Exported C ABI (ctypes-consumed by horovod_tpu/common/native.py):
+//   hvdtpu_server_start(port, world) -> handle
+//   hvdtpu_server_stop(handle)
+//   hvdtpu_client_connect(host, port, rank, timeout_ms) -> handle
+//   hvdtpu_client_round(handle, req, req_len, resp_buf, resp_cap) -> resp_len
+//   hvdtpu_client_close(handle)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+// ---------------------------------------------------------------- framing
+bool read_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_frame(int fd, std::vector<uint8_t>* out) {
+  uint32_t len = 0;
+  if (!read_exact(fd, &len, 4)) return false;
+  out->resize(len);
+  return len == 0 || read_exact(fd, out->data(), len);
+}
+
+bool write_frame(int fd, const std::vector<uint8_t>& payload) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  if (!write_exact(fd, &len, 4)) return false;
+  return payload.empty() || write_exact(fd, payload.data(), payload.size());
+}
+
+void put_u16(std::vector<uint8_t>* b, uint16_t v) {
+  b->push_back(v & 0xff);
+  b->push_back((v >> 8) & 0xff);
+}
+
+void put_u32(std::vector<uint8_t>* b, uint32_t v) {
+  for (int i = 0; i < 4; ++i) b->push_back((v >> (8 * i)) & 0xff);
+}
+
+void put_str(std::vector<uint8_t>* b, const std::string& s) {
+  put_u16(b, static_cast<uint16_t>(s.size()));
+  b->insert(b->end(), s.begin(), s.end());
+}
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  uint16_t u16() {
+    if (p + 2 > end) { ok = false; return 0; }
+    uint16_t v = p[0] | (p[1] << 8);
+    p += 2;
+    return v;
+  }
+  uint32_t u32() {
+    if (p + 4 > end) { ok = false; return 0; }
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) v |= static_cast<uint32_t>(p[i]) << (8 * i);
+    p += 4;
+    return v;
+  }
+  std::string str() {
+    uint16_t n = u16();
+    if (p + n > end) { ok = false; return ""; }
+    std::string s(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return s;
+  }
+};
+
+// ----------------------------------------------------------------- server
+struct PendingInfo {
+  uint64_t order;            // announce sequence for deterministic ordering
+  std::set<int> ready_ranks;
+  int required = 0;          // ranks needed (0 = full world)
+  Clock::time_point first_seen;
+  bool warned = false;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int world = 0;
+  std::vector<int> fds;               // per-rank sockets
+  std::thread loop;
+  std::atomic<bool> stop{false};
+  std::map<std::string, PendingInfo> pending;
+  uint64_t announce_seq = 0;
+  double stall_warn_s = 60.0;
+
+  void run();
+};
+
+void Server::run() {
+  // Accept exactly `world` connections; first message from each client is a
+  // 4-byte rank id.
+  fds.assign(world, -1);
+  for (int i = 0; i < world && !stop.load(); ++i) {
+    int fd = ::accept(listen_fd, nullptr, nullptr);
+    if (fd < 0) return;
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    uint32_t rank = 0;
+    if (!read_exact(fd, &rank, 4) || rank >= static_cast<uint32_t>(world)) {
+      ::close(fd);
+      --i;
+      continue;
+    }
+    fds[rank] = fd;
+  }
+
+  std::vector<uint8_t> frame;
+  while (!stop.load()) {
+    // One lock-step round: a frame from every rank, then a reply to all.
+    for (int r = 0; r < world; ++r) {
+      if (!read_frame(fds[r], &frame)) { stop.store(true); break; }
+      Reader rd{frame.data(), frame.data() + frame.size()};
+      uint32_t n = rd.u32();
+      for (uint32_t i = 0; i < n && rd.ok; ++i) {
+        uint16_t required = rd.u16();
+        std::string name = rd.str();
+        auto it = pending.find(name);
+        if (it == pending.end()) {
+          PendingInfo info;
+          info.order = announce_seq++;
+          info.required = required ? required : world;
+          info.first_seen = Clock::now();
+          it = pending.emplace(name, std::move(info)).first;
+        }
+        it->second.ready_ranks.insert(r);
+      }
+    }
+    if (stop.load()) break;
+
+    // Ready = reported by every rank; deterministic order by announce seq.
+    std::vector<std::pair<uint64_t, std::string>> ready;
+    std::vector<std::string> warns;
+    auto now = Clock::now();
+    for (auto it = pending.begin(); it != pending.end();) {
+      auto& info = it->second;
+      if (static_cast<int>(info.ready_ranks.size()) >= info.required) {
+        ready.emplace_back(info.order, it->first);
+        it = pending.erase(it);
+        continue;
+      }
+      double age =
+          std::chrono::duration<double>(now - info.first_seen).count();
+      if (age > stall_warn_s && !info.warned) {
+        info.warned = true;
+        std::string missing;
+        for (int r = 0; r < world; ++r) {
+          if (!info.ready_ranks.count(r)) {
+            if (!missing.empty()) missing += ",";
+            missing += std::to_string(r);
+          }
+        }
+        warns.push_back("stall: tensor '" + it->first + "' waited " +
+                        std::to_string(age) + "s; missing ranks [" + missing +
+                        "]");
+      }
+      ++it;
+    }
+    std::sort(ready.begin(), ready.end());
+
+    std::vector<uint8_t> resp;
+    put_u32(&resp, static_cast<uint32_t>(ready.size()));
+    for (auto& [ord, name] : ready) put_str(&resp, name);
+    put_u32(&resp, static_cast<uint32_t>(warns.size()));
+    for (auto& w : warns) put_str(&resp, w);
+    for (int r = 0; r < world; ++r) {
+      if (!write_frame(fds[r], resp)) { stop.store(true); break; }
+    }
+  }
+  for (int fd : fds)
+    if (fd >= 0) ::close(fd);
+}
+
+struct Client {
+  int fd = -1;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hvdtpu_server_start(int port, int world, double stall_warn_s) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, world) < 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* s = new Server();
+  s->listen_fd = fd;
+  s->world = world;
+  s->stall_warn_s = stall_warn_s;
+  s->loop = std::thread([s] { s->run(); });
+  return s;
+}
+
+void hvdtpu_server_stop(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  if (!s) return;
+  s->stop.store(true);
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  for (int fd : s->fds)
+    if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+  if (s->loop.joinable()) s->loop.join();
+  delete s;
+}
+
+void* hvdtpu_client_connect(const char* host, int port, int rank,
+                            int timeout_ms) {
+  auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+  while (Clock::now() < deadline) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return nullptr;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      uint32_t r = static_cast<uint32_t>(rank);
+      if (!write_exact(fd, &r, 4)) {
+        ::close(fd);
+        return nullptr;
+      }
+      auto* c = new Client();
+      c->fd = fd;
+      return c;
+    }
+    ::close(fd);
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  return nullptr;
+}
+
+// One lock-step round: send req frame, block for response frame.
+// Returns response length, 0 on empty response, -1 on error, -2 if the
+// response exceeds resp_cap.
+int hvdtpu_client_round(void* handle, const uint8_t* req, int req_len,
+                        uint8_t* resp_buf, int resp_cap) {
+  auto* c = static_cast<Client*>(handle);
+  if (!c || c->fd < 0) return -1;
+  std::vector<uint8_t> payload(req, req + req_len);
+  if (!write_frame(c->fd, payload)) return -1;
+  std::vector<uint8_t> resp;
+  if (!read_frame(c->fd, &resp)) return -1;
+  if (static_cast<int>(resp.size()) > resp_cap) return -2;
+  if (!resp.empty()) std::memcpy(resp_buf, resp.data(), resp.size());
+  return static_cast<int>(resp.size());
+}
+
+// Unblock a thread stuck in hvdtpu_client_round (recv returns 0 after the
+// socket shutdown) WITHOUT freeing the Client — call before client_close so
+// shutdown ordering can't use-after-free a blocked round.
+void hvdtpu_client_interrupt(void* handle) {
+  auto* c = static_cast<Client*>(handle);
+  if (c && c->fd >= 0) ::shutdown(c->fd, SHUT_RDWR);
+}
+
+void hvdtpu_client_close(void* handle) {
+  auto* c = static_cast<Client*>(handle);
+  if (!c) return;
+  if (c->fd >= 0) ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

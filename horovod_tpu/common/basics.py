@@ -33,6 +33,9 @@ class NotInitializedError(RuntimeError):
         super().__init__("horovod_tpu has not been initialized; call hvd.init() first.")
 
 
+
+
+
 class GlobalState:
     def __init__(self):
         self.initialized = False
@@ -69,17 +72,29 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None,
             return
         st.config = Config.from_env()
 
-        # Multi-process bootstrap: when the launcher exported a coordinator
-        # address and jax.distributed has not been initialized, do it now so
-        # all processes share one global device world.
+        # Multi-process bootstrap (launched by torovodrun, SURVEY.md §3.3):
+        # jax.distributed forms the global device world at controller_port;
+        # the native negotiation controller lives at controller_port + 1.
         cfg = st.config
-        if (cfg.controller_addr and cfg.size_env > 0
-                and jax.process_count() == 1 and cfg.size_env > 1):
-            jax.distributed.initialize(
-                coordinator_address=f"{cfg.controller_addr}:{cfg.controller_port}",
-                num_processes=cfg.size_env,
-                process_id=cfg.rank_env,
-            )
+        multi_process = (cfg.controller_addr != "" and cfg.size_env > 1)
+        # NB: must not touch jax.devices()/process_count() before
+        # jax.distributed.initialize — any backend query finalizes the
+        # single-process world.
+        from jax._src import distributed as _jdist
+        if multi_process and _jdist.global_state.client is None:
+            # torovodrun spawns one process per rank (reference §3.3); a
+            # one-process-per-host TPU pod sets HOROVOD_ONE_PROC_PER_HOST
+            # and lets jax auto-detect instead.
+            from .config import _env_bool
+            if _env_bool("ONE_PROC_PER_HOST", False):
+                jax.distributed.initialize()
+            else:
+                jax.distributed.initialize(
+                    coordinator_address=(
+                        f"{cfg.controller_addr}:{cfg.controller_port}"),
+                    num_processes=cfg.size_env,
+                    process_id=cfg.rank_env,
+                )
 
         st.topology = build_topology(axis_name=axis_name, devices=devices)
         gs = st.process_set_table.initialize(
@@ -94,6 +109,16 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None,
 
         from ..ops.engine import CollectiveEngine
         st.engine = CollectiveEngine(st)
+        if multi_process:
+            from .controller import TCPController
+            ctrl_port = (cfg.controller_port2 if cfg.controller_port2
+                         else cfg.controller_port + 1)
+            st.controller = TCPController(
+                cfg.controller_addr, ctrl_port,
+                rank=cfg.rank_env, world=cfg.size_env,
+                stall_warn_s=cfg.stall_check_time_s
+                if not cfg.stall_check_disable else 1e18)
+            st.engine.controller = st.controller
         st.engine.start()
 
         st.initialized = True
@@ -104,9 +129,16 @@ def shutdown() -> None:
     with st._lock:
         if not st.initialized:
             return
+        if st.controller is not None:
+            # Unblock any lock-step round FIRST so the engine thread can't
+            # be left inside the native client when we free it.
+            st.controller.interrupt()
         if st.engine is not None:
             st.engine.stop()
             st.engine = None
+        if st.controller is not None:
+            st.controller.shutdown()
+            st.controller = None
         if st.timeline is not None:
             st.timeline.close()
             st.timeline = None

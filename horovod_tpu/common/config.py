@@ -114,6 +114,7 @@ class Config:
     # Control plane (multi-process mode). Set by the launcher.
     controller_addr: str = ""
     controller_port: int = 0
+    controller_port2: int = 0
     rank_env: int = -1
     size_env: int = -1
     local_rank_env: int = -1
@@ -147,6 +148,7 @@ class Config:
             donate_fusion_buffers=_env_bool("DONATE_FUSION_BUFFERS", True),
             controller_addr=_env("CONTROLLER_ADDR", "") or "",
             controller_port=_env_int("CONTROLLER_PORT", 0),
+            controller_port2=_env_int("CONTROLLER_PORT2", 0),
             rank_env=_env_int("RANK", -1),
             size_env=_env_int("SIZE", -1),
             local_rank_env=_env_int("LOCAL_RANK", -1),

@@ -1,0 +1,136 @@
+"""TCP controller client: multi-process negotiation for the engine.
+
+The Python face of ``csrc/coordinator.cc`` — plays the role of the
+reference's ``Controller::ComputeResponseList`` transport half (SURVEY.md
+§3.2 step 2): every coordinator cycle, announce newly-pending tensor names,
+receive the globally-ready ordered name list, and hand ready entries back to
+the engine (which batches and executes them identically on every process).
+
+Rank 0 additionally hosts the server thread (native, lock-step rounds).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from typing import Dict, List, Optional, Sequence
+
+from . import native
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+_RESP_CAP = 4 * 1024 * 1024
+
+
+class TCPController:
+    """Engine-facing controller (engine calls ``negotiate`` each cycle)."""
+
+    def __init__(self, addr: str, port: int, rank: int, world: int,
+                 stall_warn_s: float = 60.0, connect_timeout_ms: int = 60000):
+        self._lib = native.load()
+        self.rank = rank
+        self.world = world
+        self._server = None
+        if rank == 0:
+            self._server = self._lib.hvdtpu_server_start(
+                port, world, ctypes.c_double(stall_warn_s))
+            if not self._server:
+                raise RuntimeError(f"Failed to start controller server on "
+                                   f"port {port}")
+        self._client = self._lib.hvdtpu_client_connect(
+            addr.encode(), port, rank, connect_timeout_ms)
+        if not self._client:
+            if self._server:
+                self._lib.hvdtpu_server_stop(self._server)
+            raise RuntimeError(
+                f"rank {rank}: failed to connect to controller at "
+                f"{addr}:{port}")
+        self._announced: set = set()
+        self._early_ready: List[str] = []
+        self._resp_buf = (ctypes.c_uint8 * _RESP_CAP)()
+
+    # ------------------------------------------------------------- protocol
+    def _round(self, announces: Sequence) -> tuple:
+        """announces: (name, required_ranks) pairs; required 0 = world."""
+        req = bytearray(struct.pack("<I", len(announces)))
+        for n, required in announces:
+            nb = n.encode()
+            req += struct.pack("<H", required) + struct.pack("<H", len(nb)) + nb
+        buf = (ctypes.c_uint8 * len(req)).from_buffer(req) if req else \
+            (ctypes.c_uint8 * 0)()
+        rc = self._lib.hvdtpu_client_round(
+            self._client, buf, len(req), self._resp_buf, _RESP_CAP)
+        if rc < 0:
+            raise RuntimeError(f"controller round failed (rc={rc}); a peer "
+                               f"likely died mid-negotiation")
+        data = bytes(self._resp_buf[:rc])
+        off = 0
+
+        def read_list():
+            nonlocal off
+            (n,) = struct.unpack_from("<I", data, off)
+            off += 4
+            out = []
+            for _ in range(n):
+                (ln,) = struct.unpack_from("<H", data, off)
+                off += 2
+                out.append(data[off:off + ln].decode())
+                off += ln
+            return out
+
+        ready = read_list()
+        warns = read_list()
+        return ready, warns
+
+    # ---------------------------------------------------------- engine API
+    def negotiate(self, entries: List) -> List:
+        """One negotiation round.  Takes this cycle's drained entries (they
+        may include requeued ones), announces the new names, and returns the
+        subset that is ready everywhere, in the server's global order."""
+        by_name: Dict[str, object] = {e.name: e for e in entries}
+        new = []
+        for n, e in by_name.items():
+            if n in self._announced:
+                continue
+            required = 0
+            ps_id = getattr(e, "process_set_id", 0)
+            if ps_id:
+                # Sub-process-set collectives are only announced by member
+                # ranks; the server readiness threshold is the set size.
+                from .basics import _get_state
+                required = _get_state().process_set_table.get(ps_id).size()
+            new.append((n, required))
+        self._announced.update(n for n, _ in new)
+        ready, warns = self._round(new)
+        for w in warns:
+            log.warning("controller: %s", w)
+        # The engine requeues not-ready entries, so every announced name
+        # reappears in `entries` each cycle; _early_ready only fills in the
+        # (defensive) case of a ready verdict arriving before the local
+        # requeue is drained.
+        ready = self._early_ready + ready
+        self._early_ready = []
+        out = []
+        for name in ready:
+            e = by_name.pop(name, None)
+            if e is None:
+                self._early_ready.append(name)
+                continue
+            self._announced.discard(name)
+            out.append(e)
+        return out
+
+    def interrupt(self):
+        """Unblock any thread stuck in a lock-step round (socket shutdown,
+        no free) — call before stopping the engine thread."""
+        if self._client:
+            self._lib.hvdtpu_client_interrupt(self._client)
+
+    def shutdown(self):
+        if self._client:
+            self._lib.hvdtpu_client_close(self._client)
+            self._client = None
+        if self._server:
+            self._lib.hvdtpu_server_stop(self._server)
+            self._server = None

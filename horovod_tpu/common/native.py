@@ -1,0 +1,61 @@
+"""Build + load the native coordinator library via ctypes.
+
+Reference parity: where ``horovod/common/basics.py`` ctypes-loads the
+prebuilt ``mpi_lib_v2`` extension (SURVEY.md §2b P1), we compile
+``csrc/coordinator.cc`` once (g++ is in the image; no pip/pybind needed) and
+cache the .so under the package.  Pure-build-on-first-use keeps the repo
+installable without a build step.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(os.path.dirname(_PKG_DIR), "csrc", "coordinator.cc")
+_OUT_DIR = os.path.join(_PKG_DIR, "lib")
+_OUT = os.path.join(_OUT_DIR, "libhvdtpu_coord.so")
+
+
+def _build() -> str:
+    os.makedirs(_OUT_DIR, exist_ok=True)
+    if (os.path.exists(_OUT)
+            and os.path.getmtime(_OUT) >= os.path.getmtime(_SRC)):
+        return _OUT
+    tmp = _OUT + ".tmp"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", tmp]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(tmp, _OUT)
+    return _OUT
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        path = _build()
+        lib = ctypes.CDLL(path)
+        lib.hvdtpu_server_start.restype = ctypes.c_void_p
+        lib.hvdtpu_server_start.argtypes = [ctypes.c_int, ctypes.c_int,
+                                            ctypes.c_double]
+        lib.hvdtpu_server_stop.argtypes = [ctypes.c_void_p]
+        lib.hvdtpu_client_connect.restype = ctypes.c_void_p
+        lib.hvdtpu_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                              ctypes.c_int, ctypes.c_int]
+        lib.hvdtpu_client_round.restype = ctypes.c_int
+        lib.hvdtpu_client_round.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+        lib.hvdtpu_client_interrupt.argtypes = [ctypes.c_void_p]
+        lib.hvdtpu_client_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib

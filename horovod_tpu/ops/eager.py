@@ -52,31 +52,103 @@ def _auto_name(prefix: str, name: Optional[str]) -> str:
 
 
 def _as_stacked(x, ps_id: int):
-    """Coerce input to a stacked [world, *S] jax.Array on the set's mesh."""
+    """Coerce input to a stacked [world, *S] jax.Array on the set's mesh.
+
+    Single-process mode: ``x`` is the full stacked [world, *S] host/device
+    array.  Multi-process mode (launched by torovodrun): ``x`` is this
+    process's LOCAL contribution — [*S] with one device per process, or
+    [local_size, *S] with several — and the global array is assembled from
+    per-device shards (``jax.make_array_from_single_device_arrays``), the
+    TPU-native analogue of the reference's per-rank tensor submission
+    (SURVEY.md §3.2).
+    """
     st = basics._get_state()
     ps = st.process_set_table.get(ps_id)
     world = ps.size()
     if isinstance(x, (np.ndarray, list, tuple, int, float)) or np.isscalar(x):
         x = np.asarray(x)
+    sharding = NamedSharding(ps.mesh, P(ps.axis_name))
+    topo = st.topology
+    if topo is not None and topo.num_processes > 1:
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            raise ValueError(
+                "Multi-process eager collectives take this process's LOCAL "
+                "contribution (a host array or local device array), not a "
+                "global jax.Array; use hvd.to_local() on previous results "
+                "before resubmitting them.")
+        local_devs = [d for d in ps.mesh.devices.flat
+                      if d.process_index == jax.process_index()]
+        n_local = len(local_devs)
+        x = np.asarray(x)
+        if n_local > 1:
+            if x.shape[0] != n_local:
+                raise ValueError(
+                    f"Multi-device process: pass [local_size={n_local}, ...] "
+                    f"local contributions; got {x.shape}")
+            per_dev = [x[i][None] for i in range(n_local)]
+        else:
+            per_dev = [x[None]]
+        global_shape = (world,) + tuple(per_dev[0].shape[1:])
+        shards = [jax.device_put(p, d) for p, d in zip(per_dev, local_devs)]
+        return jax.make_array_from_single_device_arrays(
+            global_shape, sharding, shards)
     if hasattr(x, "shape") and (len(x.shape) == 0 or x.shape[0] != world):
         raise ValueError(
             f"Eager collectives take stacked per-rank tensors of shape "
             f"[world={world}, ...]; got shape {tuple(x.shape)}. Use "
             f"stack_per_rank()/replicated() to build one.")
-    sharding = NamedSharding(ps.mesh, P(ps.axis_name))
     if isinstance(x, jax.Array) and x.sharding == sharding:
         return x
     return jax.device_put(x, sharding)
 
 
+def to_local(result):
+    """This process's view of a collective result.
+
+    Replicated results (allreduce/broadcast/allgather) come back whole;
+    stacked sharded results (alltoall/reducescatter) come back as this
+    rank's slice(s).  Single-process mode returns the full array.
+    """
+    if not isinstance(result, jax.Array):
+        return np.asarray(result)
+    if jax.process_count() == 1 or result.is_fully_addressable:
+        return np.asarray(result)
+    # Dedupe by shard index: replicated results place the SAME full array on
+    # every local device — concatenating duplicates would silently corrupt.
+    by_index = {}
+    for s in result.addressable_shards:
+        by_index.setdefault(_index_key(s.index), s)
+    shards = [by_index[k] for k in sorted(by_index)]
+    datas = [np.asarray(s.data) for s in shards]
+    if len(datas) == 1:
+        return datas[0]
+    return np.concatenate(datas, axis=0)
+
+
+def _index_key(index):
+    return tuple((sl.start if sl.start is not None else 0,
+                  sl.stop if sl.stop is not None else -1)
+                 for sl in index)
+
+
 def stack_per_rank(values: Sequence, process_set: Optional[ProcessSet] = None):
-    """Stack one value per rank into the global stacked representation."""
+    """Stack one value per rank into the collective input representation.
+
+    Single-process: the full [world, *S] stacked array.  Multi-process: this
+    process's slice (each process only holds its own ranks' contributions).
+    """
     st = basics._get_state()
     ps = st.process_set_table.get(_ps(process_set))
     vals = [np.asarray(v) for v in values]
     if len(vals) != ps.size():
         raise ValueError(f"Expected {ps.size()} per-rank values, got {len(vals)}")
     stacked = np.stack(vals)
+    topo = st.topology
+    if topo is not None and topo.num_processes > 1:
+        my = [i for i, d in enumerate(ps.mesh.devices.flat)
+              if d.process_index == jax.process_index()]
+        local = stacked[my]
+        return local[0] if len(my) == 1 else local
     return jax.device_put(stacked, NamedSharding(ps.mesh, P(ps.axis_name)))
 
 
@@ -180,12 +252,13 @@ def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None,
     n = np.array([len(payload)], dtype=np.int64)
     sizes = broadcast(stack_per_rank([n] * ps.size(), process_set),
                       root_rank=root_rank, name=_auto_name("bcast_obj_size", name))
-    size = int(np.asarray(sizes)[0])
+    size = int(to_local(sizes)[0])
     buf = np.zeros(size, dtype=np.uint8)
-    buf[:len(payload)] = payload[:size]
+    k = min(len(payload), size)
+    buf[:k] = payload[:k]
     out = broadcast(stack_per_rank([buf] * ps.size(), process_set),
                     root_rank=root_rank, name=_auto_name("bcast_obj", name))
-    return pickle.loads(np.asarray(out).tobytes())
+    return pickle.loads(to_local(out).tobytes())
 
 
 # ------------------------------------------------------------------ alltoall

@@ -310,8 +310,11 @@ class CollectiveEngine:
         if tl is not None:
             tl.mark_cycle(self._cycle_index)
         entries = self.queue.drain()
-        if not entries:
+        if not entries and self.controller is None:
             return
+        # Multi-process mode: every rank must complete a (possibly empty)
+        # lock-step negotiation round each cycle, or peers with pending
+        # tensors would block on this rank's missing frame.
         try:
             responses, not_ready = self._compute_response_list(entries)
         except BaseException as exc:  # noqa: BLE001 - propagate to waiters
@@ -350,28 +353,30 @@ class CollectiveEngine:
                     e.name, f"NEGOTIATE_{e.ctype.name}")
         self.stall.check(entries + not_ready)
 
-        batches: List[List[TensorTableEntry]] = []
-        by_key: Dict[Tuple, List[TensorTableEntry]] = {}
+        # Batching must be a pure function of the NEGOTIATED entry order —
+        # never of local handle/group counters, which differ across ranks
+        # (every rank must build byte-identical fused programs).  Grouped
+        # members are pulled together at the first member's position.
+        clusters: List[List[TensorTableEntry]] = []
+        seen_groups: set = set()
         for e in entries:
-            by_key.setdefault(_fusion_key(e), []).append(e)
-        for key, group in by_key.items():
+            if e.group_id >= 0:
+                if e.group_id in seen_groups:
+                    continue
+                seen_groups.add(e.group_id)
+                clusters.append([m for m in entries
+                                 if m.group_id == e.group_id])
+            else:
+                clusters.append([e])
+
+        batches: List[List[TensorTableEntry]] = []
+        by_key: Dict[Tuple, List[List[TensorTableEntry]]] = {}
+        for members in clusters:
+            by_key.setdefault(_fusion_key(members[0]), []).append(members)
+        for key, key_clusters in by_key.items():
             cur: List[TensorTableEntry] = []
             cur_bytes = 0
-            # keep grouped-op members adjacent and atomic
-            group.sort(key=lambda e: (e.group_id if e.group_id >= 0 else 1 << 30,
-                                      e.handle))
-            i = 0
-            while i < len(group):
-                e = group[i]
-                members = [e]
-                if e.group_id >= 0:
-                    j = i + 1
-                    while j < len(group) and group[j].group_id == e.group_id:
-                        members.append(group[j])
-                        j += 1
-                    i = j
-                else:
-                    i += 1
+            for members in key_clusters:
                 mbytes = sum(m.tensor.nbytes for m in members
                              if m.tensor is not None)
                 if cur and cur_bytes + mbytes > self.fusion_threshold:

@@ -172,7 +172,7 @@ def _free_port() -> int:
 
 
 def worker_envs(args, hosts: List[HostSpec],
-                coordinator: Tuple[str, int]) -> List[Dict[str, str]]:
+                coordinator: Tuple[str, int, int]) -> List[Dict[str, str]]:
     """Compute the per-rank env injection (reference §3.3: HOROVOD_RANK,
     HOROVOD_SIZE, HOROVOD_LOCAL_RANK, HOROVOD_CROSS_RANK, rendezvous addr)."""
     np_total = args.np
@@ -191,6 +191,7 @@ def worker_envs(args, hosts: List[HostSpec],
                 "HOROVOD_CROSS_SIZE": str(len(hosts)),
                 "HOROVOD_CONTROLLER_ADDR": coordinator[0],
                 "HOROVOD_CONTROLLER_PORT": str(coordinator[1]),
+                "HOROVOD_CONTROLLER_PORT2": str(coordinator[2]),
                 "HOROVOD_HOSTNAME": h.hostname,
             }
             for flag, var, scale in (
@@ -238,7 +239,7 @@ def ssh_command(host: str, env: Dict[str, str], command: List[str],
 def launch_workers(args, hosts: List[HostSpec]) -> int:
     """Spawn all workers, wait, propagate first failure (local + ssh)."""
     coord = (hosts[0].hostname if hosts[0].hostname != "localhost"
-             else "127.0.0.1", _free_port())
+             else "127.0.0.1", _free_port(), _free_port())
     envs = worker_envs(args, hosts, coord)
     procs: List[subprocess.Popen] = []
     for rank, env in enumerate(envs):

@@ -1,0 +1,101 @@
+"""Multi-process worker: the reference's ``test/parallel`` pattern
+(SURVEY.md §4) — every rank runs the same assertions against locally
+computed expectations.  Launched by torovodrun in test_multiprocess.py.
+"""
+
+import os
+import sys
+
+# Each worker is one rank with ONE cpu device: strip the 8-virtual-device
+# flag inherited from the test process.
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    assert size == int(os.environ["HOROVOD_SIZE"]), (size, os.environ["HOROVOD_SIZE"])
+    assert rank == int(os.environ["HOROVOD_RANK"])
+    assert jax.process_count() == size
+
+    # allreduce: sum of rank-dependent tensors
+    x = np.arange(4, dtype=np.float32) + rank * 10
+    out = hvd.to_local(hvd.allreduce(x, name="ar", op=hvd.Sum))
+    expected = sum(np.arange(4, dtype=np.float32) + r * 10
+                   for r in range(size))
+    np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+    # broadcast: everyone gets rank 1's value
+    b = np.full((3,), float(rank), np.float32)
+    out = hvd.to_local(hvd.broadcast(b, root_rank=1, name="bc"))
+    np.testing.assert_allclose(out, np.full((3,), 1.0))
+
+    # allgather
+    g = np.full((2,), float(rank), np.float32)
+    out = hvd.to_local(hvd.allgather(g, name="ag"))
+    assert out.shape == (2 * size,)
+    for r in range(size):
+        np.testing.assert_allclose(out[2 * r:2 * r + 2], float(r))
+
+    # grouped allreduce with mixed sizes
+    outs = hvd.grouped_allreduce(
+        [np.full((2,), float(rank + 1), np.float32),
+         np.full((3, 2), float(rank + 2), np.float32)],
+        name="grp", op=hvd.Average)
+    np.testing.assert_allclose(hvd.to_local(outs[0]),
+                               np.mean([r + 1 for r in range(size)]))
+    np.testing.assert_allclose(hvd.to_local(outs[1]),
+                               np.mean([r + 2 for r in range(size)]))
+
+    # out-of-order submission across ranks: rank 0 submits a,b; rank 1
+    # submits b,a — negotiation must still execute them consistently.
+    names = ["ooo_a", "ooo_b"] if rank == 0 else ["ooo_b", "ooo_a"]
+    hs = [hvd.allreduce_async(np.ones((2,), np.float32) * (i + 1), name=n,
+                              op=hvd.Sum)
+          for i, n in enumerate(names)]
+    res = {n: hvd.to_local(r)
+           for n, r in zip(names, hvd.synchronize(hs))}
+    # rank r submitted value (position+1); global sum differs per name:
+    # ooo_a: rank0 pos0 (1), rank1 pos1 (2) -> 3 (for size 2)
+    if size == 2:
+        np.testing.assert_allclose(res["ooo_a"], np.full((2,), 3.0))
+        np.testing.assert_allclose(res["ooo_b"], np.full((2,), 3.0))
+
+    hvd.barrier()
+    # staggered submission: rank 0 waits, others submit first
+    if rank == 0:
+        import time
+        time.sleep(0.3)
+    out = hvd.to_local(hvd.allreduce(np.full((2,), 1.0, np.float32),
+                                     name="late", op=hvd.Sum))
+    np.testing.assert_allclose(out, np.full((2,), float(size)))
+
+    # elastic object state sync via broadcast_object
+    obj = hvd.broadcast_object({"rank_was": rank}, root_rank=0)
+    assert obj == {"rank_was": 0}
+
+    # Sub-process-set collective: only ranks 0,1 participate (exercises the
+    # required-count negotiation — non-members never announce the name).
+    if size >= 3:
+        ps = hvd.add_process_set([0, 1])
+        if ps.included(rank):
+            out = hvd.to_local(hvd.allreduce(
+                np.full((2,), float(rank + 1), np.float32), name="subset",
+                op=hvd.Sum, process_set=ps))
+            np.testing.assert_allclose(out, np.full((2,), 3.0))
+        hvd.barrier()
+
+    print(f"WORKER_OK rank={rank}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Multi-process integration tier: real torovodrun launches on localhost,
+full negotiate (native TCP controller) -> fuse -> XLA-collective path across
+processes — the rebuild's equivalent of the reference's Gloo-on-localhost
+hermetic tier (SURVEY.md §4 "fake backends").
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "data", "worker_collectives.py")
+
+
+def _run_torovodrun(np_, script, timeout=300, extra_args=(), extra_env=None):
+    env = dict(os.environ)
+    # CPU workers must not load the axon TPU site hook: it initializes the
+    # XLA backend at interpreter start, which breaks jax.distributed.
+    other_paths = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + other_paths)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("HOROVOD_TIMELINE", None)
+    if extra_env:
+        env.update(extra_env)
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+           "-np", str(np_), *extra_args, sys.executable, script]
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def test_native_controller_builds():
+    from horovod_tpu.common import native
+    lib = native.load()
+    assert lib is not None
+
+
+def test_controller_negotiation_unit():
+    """Server + 2 client threads, no jax: readiness protocol only."""
+    import threading
+    from horovod_tpu.common.controller import TCPController
+
+    port = 15123
+    results = {}
+
+    def worker(rank):
+        class E:
+            def __init__(self, name):
+                self.name = name
+        ctl = TCPController("127.0.0.1", port, rank=rank, world=2,
+                            stall_warn_s=60.0)
+        try:
+            if rank == 0:
+                # announce a; peer announces b first, then a
+                r1 = ctl.negotiate([E("a")])
+                r2 = ctl.negotiate([E("a"), E("b")])
+                r3 = ctl.negotiate([E("b")] if not any(
+                    e.name == "b" for e in r2) else [])
+                results[rank] = [[e.name for e in r] for r in (r1, r2, r3)]
+            else:
+                r1 = ctl.negotiate([E("b")])
+                r2 = ctl.negotiate([E("b"), E("a")])
+                r3 = ctl.negotiate([E("a")] if not any(
+                    e.name == "a" for e in r2) else [])
+                results[rank] = [[e.name for e in r] for r in (r1, r2, r3)]
+        finally:
+            ctl.shutdown() if rank != 0 else None
+        # rank 0 keeps server alive until both done; shutdown at end
+        if rank == 0:
+            ctl.shutdown()
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert 0 in results and 1 in results
+    # Round 1: nothing globally ready (disjoint names). Round 2+: both a
+    # and b become ready, in the same global order on both ranks.
+    flat0 = [n for r in results[0] for n in r]
+    flat1 = [n for r in results[1] for n in r]
+    assert sorted(flat0) == ["a", "b"], results
+    assert sorted(flat1) == ["a", "b"], results
+    assert flat0 == flat1, results
+
+
+@pytest.mark.parametrize("np_", [2, 3])
+def test_torovodrun_collectives(np_):
+    res = _run_torovodrun(np_, WORKER)
+    ok = res.stdout.count("WORKER_OK")
+    assert res.returncode == 0 and ok == np_, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")

@@ -66,7 +66,7 @@ def test_worker_envs():
                        "--timeline-filename", "/tmp/tl",
                        "python", "t.py"])
     hosts = placement(args)
-    envs = worker_envs(args, hosts, ("1.2.3.4", 5555))
+    envs = worker_envs(args, hosts, ("1.2.3.4", 5555, 5556))
     assert len(envs) == 4
     assert envs[0]["HOROVOD_RANK"] == "0"
     assert envs[3]["HOROVOD_RANK"] == "3"
