@@ -33,6 +33,7 @@
 //   hvdtpu_client_close(handle)
 
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -45,6 +46,7 @@
 #include <cstdint>
 #include <cstring>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <set>
 #include <string>
@@ -144,7 +146,13 @@ struct PendingInfo {
 struct Server {
   int listen_fd = -1;
   int world = 0;
-  std::vector<int> fds;               // per-rank sockets
+  // Per-rank sockets: fixed-size, preallocated before the loop thread
+  // starts, written by run() and shutdown() by server_stop concurrently —
+  // hence atomic slots rather than a resizable vector.
+  std::unique_ptr<std::atomic<int>[]> fds;
+  // Accepted-but-unidentified connection (rank handshake in flight); tracked
+  // so server_stop can unblock a handshake read too.
+  std::atomic<int> handshake_fd{-1};
   std::thread loop;
   std::atomic<bool> stop{false};
   std::map<std::string, PendingInfo> pending;
@@ -156,27 +164,40 @@ struct Server {
 
 void Server::run() {
   // Accept exactly `world` connections; first message from each client is a
-  // 4-byte rank id.
-  fds.assign(world, -1);
+  // 4-byte rank id.  All accepted fds land in `fds` (even on early stop) so
+  // server_stop's cleanup owns closing them — run() never closes a
+  // registered fd, which avoids shutdown() on a recycled fd number.
   for (int i = 0; i < world && !stop.load(); ++i) {
     int fd = ::accept(listen_fd, nullptr, nullptr);
     if (fd < 0) return;
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    handshake_fd.store(fd);
+    if (stop.load()) {  // stop raced the accept; don't block in the read
+      if (handshake_fd.exchange(-1) != -2) ::close(fd);
+      return;
+    }
     uint32_t rank = 0;
-    if (!read_exact(fd, &rank, 4) || rank >= static_cast<uint32_t>(world)) {
+    bool ok = read_exact(fd, &rank, 4);
+    // Ownership handoff: if server_stop already exchanged the slot to -2 it
+    // owns shutdown() on this fd, so we must not close it (the number could
+    // be recycled under its feet); we're stopping anyway.
+    if (handshake_fd.exchange(-1) == -2) return;
+    if (!ok || rank >= static_cast<uint32_t>(world) || fds[rank].load() >= 0) {
       ::close(fd);
       --i;
       continue;
     }
-    fds[rank] = fd;
+    fds[rank].store(fd);
   }
+  for (int r = 0; r < world; ++r)
+    if (fds[r].load() < 0) return;  // stopped before the world assembled
 
   std::vector<uint8_t> frame;
   while (!stop.load()) {
     // One lock-step round: a frame from every rank, then a reply to all.
     for (int r = 0; r < world; ++r) {
-      if (!read_frame(fds[r], &frame)) { stop.store(true); break; }
+      if (!read_frame(fds[r].load(), &frame)) { stop.store(true); break; }
       Reader rd{frame.data(), frame.data() + frame.size()};
       uint32_t n = rd.u32();
       for (uint32_t i = 0; i < n && rd.ok; ++i) {
@@ -231,11 +252,10 @@ void Server::run() {
     put_u32(&resp, static_cast<uint32_t>(warns.size()));
     for (auto& w : warns) put_str(&resp, w);
     for (int r = 0; r < world; ++r) {
-      if (!write_frame(fds[r], resp)) { stop.store(true); break; }
+      if (!write_frame(fds[r].load(), resp)) { stop.store(true); break; }
     }
   }
-  for (int fd : fds)
-    if (fd >= 0) ::close(fd);
+  // fds are closed by hvdtpu_server_stop after the thread joins.
 }
 
 struct Client {
@@ -264,6 +284,8 @@ void* hvdtpu_server_start(int port, int world, double stall_warn_s) {
   s->listen_fd = fd;
   s->world = world;
   s->stall_warn_s = stall_warn_s;
+  s->fds = std::make_unique<std::atomic<int>[]>(world);
+  for (int i = 0; i < world; ++i) s->fds[i].store(-1);
   s->loop = std::thread([s] { s->run(); });
   return s;
 }
@@ -271,41 +293,61 @@ void* hvdtpu_server_start(int port, int world, double stall_warn_s) {
 void hvdtpu_server_stop(void* handle) {
   auto* s = static_cast<Server*>(handle);
   if (!s) return;
+  // shutdown (not close) unblocks the loop thread's blocking accept/recv;
+  // actual closes happen only after the join so no fd is closed (and
+  // potentially recycled) while the loop might still read it.
   s->stop.store(true);
   ::shutdown(s->listen_fd, SHUT_RDWR);
-  ::close(s->listen_fd);
-  for (int fd : s->fds)
+  int hs = s->handshake_fd.exchange(-2);
+  if (hs >= 0) ::shutdown(hs, SHUT_RDWR);
+  for (int i = 0; i < s->world; ++i) {
+    int fd = s->fds[i].load();
     if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+  }
   if (s->loop.joinable()) s->loop.join();
+  ::close(s->listen_fd);
+  for (int i = 0; i < s->world; ++i) {
+    int fd = s->fds[i].load();
+    if (fd >= 0) ::close(fd);
+  }
   delete s;
 }
 
 void* hvdtpu_client_connect(const char* host, int port, int rank,
                             int timeout_ms) {
   auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+  std::string port_str = std::to_string(port);
   while (Clock::now() < deadline) {
-    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-    if (fd < 0) return nullptr;
-    sockaddr_in addr{};
-    addr.sin_family = AF_INET;
-    addr.sin_port = htons(static_cast<uint16_t>(port));
-    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
-      ::close(fd);
-      return nullptr;
+    // Resolve every attempt (DNS, not just dotted IPv4 — hostnames from
+    // `-H node1:2,...` must work; resolution can also succeed late while
+    // hosts boot).
+    addrinfo hints{};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (::getaddrinfo(host, port_str.c_str(), &hints, &res) != 0) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(200));
+      continue;
     }
-    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
-      int one = 1;
-      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      uint32_t r = static_cast<uint32_t>(rank);
-      if (!write_exact(fd, &r, 4)) {
-        ::close(fd);
-        return nullptr;
+    for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+      int fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd < 0) continue;
+      if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        uint32_t r = static_cast<uint32_t>(rank);
+        if (!write_exact(fd, &r, 4)) {
+          ::close(fd);
+          break;  // retry from scratch
+        }
+        ::freeaddrinfo(res);
+        auto* c = new Client();
+        c->fd = fd;
+        return c;
       }
-      auto* c = new Client();
-      c->fd = fd;
-      return c;
+      ::close(fd);
     }
-    ::close(fd);
+    ::freeaddrinfo(res);
     std::this_thread::sleep_for(std::chrono::milliseconds(50));
   }
   return nullptr;

@@ -84,11 +84,19 @@ class TCPController:
         return ready, warns
 
     # ---------------------------------------------------------- engine API
+    @staticmethod
+    def _wire_name(e) -> str:
+        # Namespace by process set so the same tensor name used concurrently
+        # by two disjoint sets can't merge their readiness on the server
+        # (which keys pending state by wire name alone).
+        ps_id = getattr(e, "process_set_id", 0)
+        return f"{ps_id}\x1f{e.name}" if ps_id else e.name
+
     def negotiate(self, entries: List) -> List:
         """One negotiation round.  Takes this cycle's drained entries (they
         may include requeued ones), announces the new names, and returns the
         subset that is ready everywhere, in the server's global order."""
-        by_name: Dict[str, object] = {e.name: e for e in entries}
+        by_name: Dict[str, object] = {self._wire_name(e): e for e in entries}
         new = []
         for n, e in by_name.items():
             if n in self._announced:
@@ -115,7 +123,12 @@ class TCPController:
         for name in ready:
             e = by_name.pop(name, None)
             if e is None:
-                self._early_ready.append(name)
+                # The server broadcasts ready verdicts to every rank; a name
+                # this rank never announced (e.g. another process set's
+                # collective) is not ours — dropping it here keeps
+                # _early_ready from growing unboundedly on non-member ranks.
+                if name in self._announced:
+                    self._early_ready.append(name)
                 continue
             self._announced.discard(name)
             out.append(e)

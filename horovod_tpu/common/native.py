@@ -26,14 +26,26 @@ _OUT = os.path.join(_OUT_DIR, "libhvdtpu_coord.so")
 
 def _build() -> str:
     os.makedirs(_OUT_DIR, exist_ok=True)
-    if (os.path.exists(_OUT)
-            and os.path.getmtime(_OUT) >= os.path.getmtime(_SRC)):
+
+    def fresh():
+        return (os.path.exists(_OUT)
+                and os.path.getmtime(_OUT) >= os.path.getmtime(_SRC))
+
+    if fresh():
         return _OUT
-    tmp = _OUT + ".tmp"
-    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", tmp]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
-    os.replace(tmp, _OUT)
+    # Several worker processes can hit a stale .so simultaneously (e.g. a
+    # local -np N launch after touching the source): serialize builds with an
+    # flock and write to a pid-unique tmp so a racing process can never
+    # observe (or produce) a half-written library.
+    import fcntl
+    with open(_OUT + ".lock", "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        if not fresh():
+            tmp = f"{_OUT}.{os.getpid()}.tmp"
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                   _SRC, "-o", tmp]
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            os.replace(tmp, _OUT)
     return _OUT
 
 

@@ -163,12 +163,21 @@ def placement(args) -> List[HostSpec]:
     return hosts
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+def _free_ports(n: int) -> List[int]:
+    """Allocate ``n`` distinct free ports, holding all probe sockets open
+    until every port is chosen so the kernel can't hand the same port out
+    twice within one call."""
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
 
 
 def worker_envs(args, hosts: List[HostSpec],
@@ -238,8 +247,9 @@ def ssh_command(host: str, env: Dict[str, str], command: List[str],
 
 def launch_workers(args, hosts: List[HostSpec]) -> int:
     """Spawn all workers, wait, propagate first failure (local + ssh)."""
+    ports = _free_ports(2)
     coord = (hosts[0].hostname if hosts[0].hostname != "localhost"
-             else "127.0.0.1", _free_port(), _free_port())
+             else "127.0.0.1", ports[0], ports[1])
     envs = worker_envs(args, hosts, coord)
     procs: List[subprocess.Popen] = []
     for rank, env in enumerate(envs):

@@ -93,6 +93,25 @@ def main():
             np.testing.assert_allclose(out, np.full((2,), 3.0))
         hvd.barrier()
 
+        # Same tensor name on two DISJOINT sets concurrently: negotiation
+        # wire names are namespaced per set, so set A's readiness can never
+        # merge with set B's and fire a collective before all members
+        # announced (and non-members must not accumulate stale ready names).
+        ps_b = hvd.add_process_set(list(range(2, size)))
+        for step in range(3):  # repeat: stale-readiness bugs bite on reuse
+            if ps.included(rank):
+                out = hvd.to_local(hvd.allreduce(
+                    np.full((2,), 1.0, np.float32), name="dup",
+                    op=hvd.Sum, process_set=ps))
+                np.testing.assert_allclose(out, np.full((2,), 2.0))
+            else:
+                out = hvd.to_local(hvd.allreduce(
+                    np.full((2,), 10.0, np.float32), name="dup",
+                    op=hvd.Sum, process_set=ps_b))
+                np.testing.assert_allclose(
+                    out, np.full((2,), 10.0 * (size - 2)))
+        hvd.barrier()
+
     print(f"WORKER_OK rank={rank}")
     hvd.shutdown()
 
