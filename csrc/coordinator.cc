@@ -160,9 +160,21 @@ struct Server {
   double stall_warn_s = 60.0;
 
   void run();
+  void run_inner();
 };
 
 void Server::run() {
+  run_inner();
+  // Whatever ended the loop (peer death, accept failure, stop), surviving
+  // clients must see EOF rather than hang in read_frame.  shutdown only —
+  // close stays with server_stop after the join (fd-recycling discipline).
+  for (int r = 0; r < world; ++r) {
+    int fd = fds[r].load();
+    if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+  }
+}
+
+void Server::run_inner() {
   // Accept exactly `world` connections; first message from each client is a
   // 4-byte rank id.  All accepted fds land in `fds` (even on early stop) so
   // server_stop's cleanup owns closing them — run() never closes a
@@ -305,6 +317,9 @@ void hvdtpu_server_stop(void* handle) {
     if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
   }
   if (s->loop.joinable()) s->loop.join();
+  // If we took ownership of a mid-handshake fd (exchanged to -2 above),
+  // run() deliberately did not close it — close it now, after the join.
+  if (hs >= 0) ::close(hs);
   ::close(s->listen_fd);
   for (int i = 0; i < s->world; ++i) {
     int fd = s->fds[i].load();

@@ -44,8 +44,12 @@ def _build() -> str:
             tmp = f"{_OUT}.{os.getpid()}.tmp"
             cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
                    _SRC, "-o", tmp]
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
-            os.replace(tmp, _OUT)
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, text=True)
+                os.replace(tmp, _OUT)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
     return _OUT
 
 

@@ -1,0 +1,39 @@
+"""``import horovod_tpu.torch as hvd`` — the PyTorch binding.
+
+Mirrors the reference's ``horovod.torch`` module surface (SURVEY.md §2b P2):
+runtime control (init/rank/size/...), collectives over torch tensors,
+``DistributedOptimizer``, parameter/optimizer-state broadcast, compression,
+``SyncBatchNorm`` and the elastic submodule.  The data plane underneath is
+the same TPU coordinator + XLA collectives the JAX binding uses.
+"""
+
+from ..common.basics import (  # noqa: F401
+    init, shutdown, is_initialized,
+    rank, size, local_rank, local_size, cross_rank, cross_size,
+    mesh, is_homogeneous,
+    add_process_set, remove_process_set, process_set_included,
+    xla_built, nccl_built, mpi_enabled, gloo_enabled, mpi_threads_supported,
+    cuda_built, rocm_built, tpu_available,
+    start_timeline, stop_timeline,
+    NotInitializedError,
+)
+from ..common.process_sets import ProcessSet, global_process_set  # noqa: F401
+from .mpi_ops import (  # noqa: F401
+    ReduceOp, Average, Sum, Adasum, Min, Max, Product,
+    allreduce, allreduce_, allreduce_async, allreduce_async_,
+    grouped_allreduce, grouped_allreduce_, grouped_allreduce_async,
+    grouped_allreduce_async_,
+    allgather, allgather_async,
+    broadcast, broadcast_, broadcast_async, broadcast_async_,
+    broadcast_object,
+    alltoall, alltoall_async,
+    reducescatter, reducescatter_async,
+    synchronize, poll, barrier, join,
+)
+from .compression import Compression  # noqa: F401
+from .functions import (  # noqa: F401
+    broadcast_parameters, broadcast_optimizer_state,
+)
+from .optimizer import DistributedOptimizer  # noqa: F401
+from .sync_batch_norm import SyncBatchNorm  # noqa: F401
+from . import elastic  # noqa: F401

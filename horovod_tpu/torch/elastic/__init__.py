@@ -1,0 +1,7 @@
+"""Elastic API for the torch binding (reference: ``horovod.torch.elastic``)."""
+
+from ...elastic.state import (  # noqa: F401
+    HorovodInternalError, HostsUpdatedInterrupt, ObjectState, State, run,
+)
+from .sampler import ElasticSampler  # noqa: F401
+from .state import TorchState  # noqa: F401

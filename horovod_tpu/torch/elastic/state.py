@@ -1,0 +1,108 @@
+"""Elastic state for the torch binding.
+
+Parity: reference ``horovod/torch/elastic/state.py`` — ``TorchState``
+captures model/optimizer (and arbitrary scalar) state with in-memory
+``commit``/``restore`` and rank-0 ``sync`` (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+import torch
+
+from ...elastic.state import ObjectState
+from .. import functions, mpi_ops
+
+
+class _HandlerBase:
+    def __init__(self, value):
+        self.value = value
+
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+
+class TorchModelHandler(_HandlerBase):
+    def __init__(self, model: torch.nn.Module):
+        super().__init__(model)
+        self.save()
+
+    def save(self):
+        self._saved = copy.deepcopy(self.value.state_dict())
+
+    def restore(self):
+        self.value.load_state_dict(self._saved)
+
+    def sync(self):
+        functions.broadcast_parameters(self.value.state_dict(), root_rank=0)
+
+
+class TorchOptimizerHandler(_HandlerBase):
+    def __init__(self, optimizer: torch.optim.Optimizer):
+        super().__init__(optimizer)
+        self.save()
+
+    def save(self):
+        self._saved = copy.deepcopy(self.value.state_dict())
+
+    def restore(self):
+        self.value.load_state_dict(self._saved)
+
+    def sync(self):
+        functions.broadcast_optimizer_state(self.value, root_rank=0)
+
+
+class TorchState(ObjectState):
+    """Elastic training state holding torch models/optimizers.
+
+    Usage mirrors the reference::
+
+        state = hvd.elastic.TorchState(model=model, optimizer=opt, epoch=0)
+        @hvd.elastic.run
+        def train(state): ...
+    """
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        self._handlers: Dict[str, _HandlerBase] = {}
+        scalars: Dict[str, Any] = {}
+        if model is not None:
+            self._handlers["model"] = TorchModelHandler(model)
+        if optimizer is not None:
+            self._handlers["optimizer"] = TorchOptimizerHandler(optimizer)
+        for k, v in kwargs.items():
+            if isinstance(v, torch.nn.Module):
+                self._handlers[k] = TorchModelHandler(v)
+            elif isinstance(v, torch.optim.Optimizer):
+                self._handlers[k] = TorchOptimizerHandler(v)
+            else:
+                scalars[k] = v
+        super().__init__(**scalars)
+
+    def __getattr__(self, name):
+        handlers = self.__dict__.get("_handlers", {})
+        if name in handlers:
+            return handlers[name].value
+        raise AttributeError(name)
+
+    def save(self):
+        for h in self._handlers.values():
+            h.save()
+        super().save()
+
+    def restore(self):
+        for h in self._handlers.values():
+            h.restore()
+        super().restore()
+
+    def sync(self):
+        for h in self._handlers.values():
+            h.sync()
+        super().sync()

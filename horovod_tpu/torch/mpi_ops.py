@@ -1,0 +1,322 @@
+"""PyTorch op surface: collectives over ``torch.Tensor``.
+
+Parity target: the reference's ``horovod/torch/mpi_ops.py`` +
+``mpi_ops_v2.cc`` (SURVEY.md §2a N26, §2b P2) — blocking and ``_async``
+variants of allreduce / grouped_allreduce / allgather / broadcast / alltoall
+/ reducescatter (plus in-place ``*_`` forms), integer handles with
+``synchronize``/``poll``, ``join`` and ``barrier``.
+
+TPU-native design: there is no per-framework C++ shim registering async ops
+with an executor — torch tensors are bridged to host memory and submitted to
+the same background coordinator (``ops/engine.py``) the JAX path uses, so
+negotiation, fusion, response caching, timeline and stall inspection all
+apply identically.  The data plane stays XLA collectives.
+
+Rank semantics match the reference: one process = one rank's contribution.
+Under ``torovodrun`` each process submits its local tensor.  In
+single-process SPMD mode (one controller owning all ``hvd.size()`` devices)
+the process submits on behalf of every rank, i.e. each rank contributes the
+same tensor — AVERAGE is then the identity and SUM multiplies by ``size()``.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import torch
+
+from ..common import basics
+from ..common.process_sets import ProcessSet
+from ..ops import collectives as C
+from ..ops import eager
+
+ReduceOp = C.ReduceOp
+Average = C.ReduceOp.AVERAGE
+Sum = C.ReduceOp.SUM
+Min = C.ReduceOp.MIN
+Max = C.ReduceOp.MAX
+Product = C.ReduceOp.PRODUCT
+Adasum = C.Adasum
+
+_handle_counter = itertools.count(1)
+_handles: Dict[int, "_PendingOp"] = {}
+
+
+class _PendingOp:
+    """Maps an engine handle back to torch-land (dtype/device, in-place dst)."""
+
+    def __init__(self, inner_handle: int, dtype: torch.dtype,
+                 device: torch.device, out: Optional[torch.Tensor] = None,
+                 postprocess=None):
+        self.inner = inner_handle
+        self.dtype = dtype
+        self.device = device
+        self.out = out
+        self.postprocess = postprocess
+
+
+def _to_numpy(t: torch.Tensor) -> np.ndarray:
+    """torch -> numpy preserving dtype (bf16 via ml_dtypes bit view)."""
+    t = t.detach()
+    if t.device.type != "cpu":
+        t = t.cpu()
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+        return t.contiguous().view(torch.int16).numpy().view(ml_dtypes.bfloat16)
+    return t.contiguous().numpy()
+
+
+def _from_numpy(a: np.ndarray, dtype: torch.dtype,
+                device: torch.device) -> torch.Tensor:
+    import ml_dtypes
+    if a.dtype == ml_dtypes.bfloat16:
+        t = torch.from_numpy(a.view(np.int16).copy()).view(torch.bfloat16)
+    else:
+        a = np.ascontiguousarray(a)
+        if not a.flags.writeable:
+            a = a.copy()
+        t = torch.from_numpy(a)
+    if t.dtype != dtype:
+        t = t.to(dtype)
+    if device.type != "cpu":
+        t = t.to(device)
+    return t
+
+
+def _submit(t: torch.Tensor, per_rank=False):
+    """This process's contribution in the eager layer's expected form.
+
+    Multi-process: the local tensor as-is (eager._as_stacked assembles the
+    global array from per-process shards).  Single-process SPMD: replicate —
+    the controller submits the same tensor for every rank it owns.
+    """
+    st = basics._get_state()
+    arr = _to_numpy(t)
+    topo = st.topology
+    if topo is not None and topo.num_processes > 1:
+        return arr
+    return np.broadcast_to(arr[None], (basics.size(),) + arr.shape)
+
+
+def _ps(process_set: Optional[ProcessSet]):
+    return process_set
+
+
+def _register(inner: int, like: torch.Tensor, out=None, postprocess=None) -> int:
+    h = next(_handle_counter)
+    _handles[h] = _PendingOp(inner, like.dtype, like.device, out=out,
+                             postprocess=postprocess)
+    return h
+
+
+def synchronize(handle):
+    """Wait for an async handle; returns the resulting torch tensor.
+
+    Reference: ``horovod/torch/mpi_ops.py synchronize`` resolving the handle
+    table filled by ``mpi_ops_v2.cc`` (SURVEY.md §3.2 completion path).
+    """
+    if isinstance(handle, (list, tuple)):
+        return [synchronize(h) for h in handle]
+    op = _handles.pop(handle)
+    res = eager.synchronize(op.inner)
+    arr = eager.to_local(res)
+    t = _from_numpy(np.asarray(arr), op.dtype, op.device)
+    if op.postprocess is not None:
+        t = op.postprocess(t)
+    if op.out is not None:
+        op.out.data.copy_(t.reshape(op.out.shape))
+        return op.out
+    return t
+
+
+def poll(handle) -> bool:
+    return eager.poll(_handles[handle].inner)
+
+
+# ------------------------------------------------------------------ allreduce
+def allreduce_async(tensor: torch.Tensor, name: Optional[str] = None,
+                    op: ReduceOp = Average,
+                    prescale_factor: Optional[float] = None,
+                    postscale_factor: Optional[float] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    inner = eager.allreduce_async(_submit(tensor), name=name, op=op,
+                                  prescale_factor=prescale_factor,
+                                  postscale_factor=postscale_factor,
+                                  process_set=process_set)
+    return _register(inner, tensor)
+
+
+def allreduce(tensor: torch.Tensor, name: Optional[str] = None,
+              op: ReduceOp = Average,
+              prescale_factor: Optional[float] = None,
+              postscale_factor: Optional[float] = None,
+              process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+    return synchronize(allreduce_async(tensor, name, op, prescale_factor,
+                                       postscale_factor, process_set))
+
+
+def allreduce_async_(tensor: torch.Tensor, name: Optional[str] = None,
+                     op: ReduceOp = Average,
+                     prescale_factor: Optional[float] = None,
+                     postscale_factor: Optional[float] = None,
+                     process_set: Optional[ProcessSet] = None) -> int:
+    inner = eager.allreduce_async(_submit(tensor), name=name, op=op,
+                                  prescale_factor=prescale_factor,
+                                  postscale_factor=postscale_factor,
+                                  process_set=process_set)
+    return _register(inner, tensor, out=tensor)
+
+
+def allreduce_(tensor: torch.Tensor, name: Optional[str] = None,
+               op: ReduceOp = Average,
+               prescale_factor: Optional[float] = None,
+               postscale_factor: Optional[float] = None,
+               process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+    return synchronize(allreduce_async_(tensor, name, op, prescale_factor,
+                                        postscale_factor, process_set))
+
+
+def grouped_allreduce_async(tensors: Sequence[torch.Tensor],
+                            name: Optional[str] = None,
+                            op: ReduceOp = Average,
+                            prescale_factor: Optional[float] = None,
+                            postscale_factor: Optional[float] = None,
+                            process_set: Optional[ProcessSet] = None) -> List[int]:
+    inners = eager.grouped_allreduce_async(
+        [_submit(t) for t in tensors], name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set)
+    return [_register(i, t) for i, t in zip(inners, tensors)]
+
+
+def grouped_allreduce(tensors: Sequence[torch.Tensor],
+                      name: Optional[str] = None, op: ReduceOp = Average,
+                      prescale_factor: Optional[float] = None,
+                      postscale_factor: Optional[float] = None,
+                      process_set: Optional[ProcessSet] = None):
+    return [synchronize(h) for h in grouped_allreduce_async(
+        tensors, name, op, prescale_factor, postscale_factor, process_set)]
+
+
+def grouped_allreduce_async_(tensors: Sequence[torch.Tensor],
+                             name: Optional[str] = None,
+                             op: ReduceOp = Average,
+                             prescale_factor: Optional[float] = None,
+                             postscale_factor: Optional[float] = None,
+                             process_set: Optional[ProcessSet] = None) -> List[int]:
+    inners = eager.grouped_allreduce_async(
+        [_submit(t) for t in tensors], name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set)
+    return [_register(i, t, out=t) for i, t in zip(inners, tensors)]
+
+
+def grouped_allreduce_(tensors: Sequence[torch.Tensor],
+                       name: Optional[str] = None, op: ReduceOp = Average,
+                       prescale_factor: Optional[float] = None,
+                       postscale_factor: Optional[float] = None,
+                       process_set: Optional[ProcessSet] = None):
+    return [synchronize(h) for h in grouped_allreduce_async_(
+        tensors, name, op, prescale_factor, postscale_factor, process_set)]
+
+
+# ------------------------------------------------------------------ allgather
+def allgather_async(tensor: torch.Tensor, name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    inner = eager.allgather_async(_submit(tensor), name=name,
+                                  process_set=process_set)
+    return _register(inner, tensor)
+
+
+def allgather(tensor: torch.Tensor, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+    return synchronize(allgather_async(tensor, name, process_set))
+
+
+# ------------------------------------------------------------------ broadcast
+def broadcast_async(tensor: torch.Tensor, root_rank: int = 0,
+                    name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    inner = eager.broadcast_async(_submit(tensor), root_rank=root_rank,
+                                  name=name, process_set=process_set)
+    return _register(inner, tensor)
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int = 0,
+              name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+    return synchronize(broadcast_async(tensor, root_rank, name, process_set))
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int = 0,
+                     name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None) -> int:
+    inner = eager.broadcast_async(_submit(tensor), root_rank=root_rank,
+                                  name=name, process_set=process_set)
+    return _register(inner, tensor, out=tensor)
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int = 0,
+               name: Optional[str] = None,
+               process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name, process_set))
+
+
+def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None):
+    return eager.broadcast_object(obj, root_rank=root_rank, name=name,
+                                  process_set=process_set)
+
+
+# ------------------------------------------------------------------ alltoall
+def _take_my_row(t: torch.Tensor) -> torch.Tensor:
+    """Stacked sharded results ([world, *S] rows = per-rank outputs, or this
+    process's [1, *S] slice in multi-process mode) → this rank's row."""
+    st = basics._get_state()
+    topo = st.topology
+    if topo is not None and topo.num_processes > 1:
+        return t[0] if t.shape[0] == 1 else t.reshape(-1, *t.shape[2:])
+    return t[basics.rank()]
+
+
+def alltoall_async(tensor: torch.Tensor, splits=None,
+                   name: Optional[str] = None,
+                   process_set: Optional[ProcessSet] = None) -> int:
+    if tensor.shape[0] % basics.size() != 0:
+        raise ValueError(
+            f"alltoall with even splits needs dim0 divisible by "
+            f"size()={basics.size()}; got {tuple(tensor.shape)}")
+    inner = eager.alltoall_async(_submit(tensor), splits=splits,
+                                 name=name, process_set=process_set)
+    return _register(inner, tensor, postprocess=_take_my_row)
+
+
+def alltoall(tensor: torch.Tensor, splits=None, name: Optional[str] = None,
+             process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+    return synchronize(alltoall_async(tensor, splits, name, process_set))
+
+
+# -------------------------------------------------------------- reducescatter
+def reducescatter_async(tensor: torch.Tensor, name: Optional[str] = None,
+                        op: ReduceOp = Sum,
+                        process_set: Optional[ProcessSet] = None) -> int:
+    inner = eager.reducescatter_async(_submit(tensor), name=name, op=op,
+                                      process_set=process_set)
+    return _register(inner, tensor, postprocess=_take_my_row)
+
+
+def reducescatter(tensor: torch.Tensor, name: Optional[str] = None,
+                  op: ReduceOp = Sum,
+                  process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+    return synchronize(reducescatter_async(tensor, name, op, process_set))
+
+
+# ------------------------------------------------------------------- control
+def barrier(process_set: Optional[ProcessSet] = None):
+    return eager.barrier(process_set=process_set)
+
+
+def join() -> int:
+    return eager.join()

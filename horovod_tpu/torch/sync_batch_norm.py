@@ -1,0 +1,130 @@
+"""Cross-rank synchronized batch normalization for the torch binding.
+
+Parity: reference ``horovod/torch/sync_batch_norm.py`` — a drop-in
+``_BatchNorm`` subclass whose training-mode statistics are computed over the
+GLOBAL batch (all ranks), via allreduce of per-rank sums in forward and of
+gradient sums in backward.
+"""
+
+from __future__ import annotations
+
+import torch
+import torch.nn.functional as F
+from torch.autograd.function import Function
+from torch.nn.modules.batchnorm import _BatchNorm
+
+import itertools
+
+from . import mpi_ops
+from ..common import basics
+
+# Collective names must be identical across ranks for negotiation to match;
+# every rank executes the same module sequence, so call-order counters align.
+_fwd_counter = itertools.count(0)
+_bwd_counter = itertools.count(0)
+
+
+class SyncBatchNorm(_BatchNorm):
+    """BatchNorm with statistics synchronized across all ranks.
+
+    Matches the reference's semantics: in eval mode (or world size 1) it is
+    exactly ``torch.nn.BatchNorm*``; in training mode mean/var come from the
+    global batch.
+    """
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=True,
+                 track_running_stats=True, process_set=None):
+        super().__init__(num_features, eps, momentum, affine,
+                         track_running_stats)
+        self.process_set = process_set
+
+    def _run_bn(self, input):
+        return F.batch_norm(
+            input, self.running_mean, self.running_var, self.weight,
+            self.bias, self.training or not self.track_running_stats,
+            self.momentum, self.eps)
+
+    def forward(self, input):
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D)")
+        if not (self.training and
+                (basics.is_initialized() and basics.size() > 1)):
+            return self._run_bn(input)
+        if self.num_batches_tracked is not None:
+            self.num_batches_tracked = self.num_batches_tracked + 1
+        return _SyncBatchNormFn.apply(
+            input, self.weight, self.bias, self.running_mean,
+            self.running_var, self.eps, self.momentum, self.process_set)
+
+
+class _SyncBatchNormFn(Function):
+    @staticmethod
+    def forward(ctx, input, weight, bias, running_mean, running_var, eps,
+                momentum, process_set):
+        c = input.shape[1]
+        reduce_dims = [0] + list(range(2, input.dim()))
+        local_count = input.numel() // c
+        # One fused allreduce for [sum, sqsum, count] — the reference issues
+        # separate mean/var allgathers; summing is both cheaper and exact
+        # for heterogeneous per-rank batch sizes.
+        stats = torch.empty(2 * c + 1, dtype=torch.float32)
+        stats[:c] = input.sum(dim=reduce_dims).float()
+        stats[c:2 * c] = (input * input).sum(dim=reduce_dims).float()
+        stats[2 * c] = float(local_count)
+        g = mpi_ops.allreduce(stats, op=mpi_ops.Sum,
+                              name=f"sync_bn.fwd.{next(_fwd_counter)}",
+                              process_set=process_set)
+        total = g[2 * c].clamp(min=1.0)
+        mean = g[:c] / total
+        var = g[c:2 * c] / total - mean * mean
+        var = var.clamp(min=0.0)
+
+        if running_mean is not None:
+            unbiased = var * (total / (total - 1.0).clamp(min=1.0))
+            running_mean.mul_(1 - momentum).add_(mean.to(running_mean.dtype),
+                                                 alpha=momentum)
+            running_var.mul_(1 - momentum).add_(unbiased.to(running_var.dtype),
+                                                alpha=momentum)
+
+        shape = [1, c] + [1] * (input.dim() - 2)
+        invstd = torch.rsqrt(var + eps)
+        xhat = (input.float() - mean.reshape(shape)) * invstd.reshape(shape)
+        out = xhat
+        if weight is not None:
+            out = out * weight.float().reshape(shape)
+        if bias is not None:
+            out = out + bias.float().reshape(shape)
+        ctx.save_for_backward(xhat, weight, invstd, total)
+        ctx.process_set = process_set
+        return out.to(input.dtype)
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        xhat, weight, invstd, total = ctx.saved_tensors
+        c = xhat.shape[1]
+        reduce_dims = [0] + list(range(2, xhat.dim()))
+        shape = [1, c] + [1] * (xhat.dim() - 2)
+
+        go = grad_output.float()
+        # Local per-channel grad sums, then one fused global SUM.
+        sums = torch.empty(2 * c, dtype=torch.float32)
+        sums[:c] = go.sum(dim=reduce_dims)
+        sums[c:] = (go * xhat).sum(dim=reduce_dims)
+        g = mpi_ops.allreduce(sums, op=mpi_ops.Sum,
+                              name=f"sync_bn.bwd.{next(_bwd_counter)}",
+                              process_set=ctx.process_set)
+        sum_dy = g[:c]
+        sum_dy_xhat = g[c:]
+
+        grad_weight = (go * xhat).sum(dim=reduce_dims) \
+            if weight is not None else None
+        grad_bias = go.sum(dim=reduce_dims)
+
+        w = weight.float().reshape(shape) if weight is not None else 1.0
+        gx = (w * invstd.reshape(shape)) * (
+            go - (sum_dy / total).reshape(shape)
+            - xhat * (sum_dy_xhat / total).reshape(shape))
+        return (gx.to(grad_output.dtype),
+                grad_weight.to(weight.dtype) if weight is not None else None,
+                grad_bias, None, None, None, None, None)

@@ -93,3 +93,15 @@ def test_torovodrun_collectives(np_):
     assert res.returncode == 0 and ok == np_, (
         f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
         f"stderr:\n{res.stderr[-3000:]}")
+
+
+WORKER_TORCH = os.path.join(REPO, "tests", "data", "worker_torch.py")
+
+
+@pytest.mark.parametrize("np_", [2])
+def test_torovodrun_torch_binding(np_):
+    res = _run_torovodrun(np_, WORKER_TORCH)
+    ok = res.stdout.count("WORKER_OK")
+    assert res.returncode == 0 and ok == np_, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
