@@ -33,6 +33,11 @@ class NotInitializedError(RuntimeError):
         super().__init__("horovod_tpu has not been initialized; call hvd.init() first.")
 
 
+def _env_has_rendezvous() -> bool:
+    import os
+    return bool(os.environ.get("HOROVOD_RENDEZVOUS_ADDR"))
+
+
 
 
 
@@ -72,11 +77,18 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None,
             return
         st.config = Config.from_env()
 
+        # Elastic workers fetch rank/size/coordinator from the driver's
+        # versioned rendezvous instead of static env (SURVEY.md §3.4).
+        if st.config.elastic and _env_has_rendezvous():
+            from ..elastic.worker import elastic_bootstrap
+            st.config = elastic_bootstrap()
+
         # Multi-process bootstrap (launched by torovodrun, SURVEY.md §3.3):
         # jax.distributed forms the global device world at controller_port;
         # the native negotiation controller lives at controller_port + 1.
         cfg = st.config
-        multi_process = (cfg.controller_addr != "" and cfg.size_env > 1)
+        multi_process = (cfg.controller_addr != ""
+                         and (cfg.size_env > 1 or cfg.elastic))
         # NB: must not touch jax.devices()/process_count() before
         # jax.distributed.initialize — any backend query finalizes the
         # single-process world.
@@ -106,6 +118,11 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None,
         from ..utils.timeline import Timeline
         st.timeline = Timeline(cfg.timeline_filename,
                                mark_cycles=cfg.timeline_mark_cycles)
+
+        # Wire-visible auto-name counters must restart with the runtime so
+        # elastic re-inits leave every rank's name sequence aligned.
+        from ..ops import eager as _eager
+        _eager.reset_name_counters()
 
         from ..ops.engine import CollectiveEngine
         st.engine = CollectiveEngine(st)
@@ -142,6 +159,13 @@ def shutdown() -> None:
         if st.timeline is not None:
             st.timeline.close()
             st.timeline = None
+        # Elastic resets must fully tear down the JAX world so the next
+        # init() can re-form it with a different size (mesh invalidation —
+        # SURVEY.md §7 hard-part #3).
+        if (st.config is not None and st.config.elastic
+                and st.config.controller_addr != ""):
+            from ..elastic.worker import teardown_distributed
+            teardown_distributed()
         st.initialized = False
         st.topology = None
 

@@ -62,8 +62,12 @@ class TCPController:
         rc = self._lib.hvdtpu_client_round(
             self._client, buf, len(req), self._resp_buf, _RESP_CAP)
         if rc < 0:
-            raise RuntimeError(f"controller round failed (rc={rc}); a peer "
-                               f"likely died mid-negotiation")
+            # HorovodInternalError so elastic run wrappers catch-and-restore
+            # (SURVEY.md §3.4); it subclasses RuntimeError for static mode.
+            from ..elastic.state import HorovodInternalError
+            raise HorovodInternalError(
+                f"controller round failed (rc={rc}); a peer likely died "
+                f"mid-negotiation")
         data = bytes(self._resp_buf[:rc])
         off = 0
 

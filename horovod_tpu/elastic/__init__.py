@@ -16,3 +16,7 @@ from .state import (  # noqa: F401
     State, ObjectState, JaxState,
     HorovodInternalError, HostsUpdatedInterrupt, run,
 )
+from .discovery import (  # noqa: F401
+    DiscoveredHost, FixedHostDiscovery, HostDiscovery, HostDiscoveryScript,
+)
+from .registration import WorkerStateRegistry  # noqa: F401

@@ -1,0 +1,318 @@
+"""The elastic driver: discovery polling, rank assignment, worker lifecycle.
+
+Parity: reference ``horovod/runner/elastic/driver.py`` (``ElasticDriver``)
+wired into ``horovodrun --min-np/--max-np --host-discovery-script``
+(SURVEY.md §2b P10, §3.4): poll the discovery script, maintain the worker
+registry and host blacklist, assign ranks, publish versioned rendezvous
+generations, notify running workers of host changes, spawn/terminate worker
+processes, and decide job success/failure against ``--min-np``.
+
+TPU mapping (SURVEY.md §5): a "host" is a TPU-VM worker; discovery's
+production source is the metadata service + preemption notices; losing a
+host invalidates the ICI mesh, so a generation change means the surviving
+workers re-init the JAX world (see ``worker.teardown_distributed``).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .discovery import DiscoveredHost, HostDiscovery, HostDiscoveryScript
+from .registration import WorkerStateRegistry
+from .rendezvous import RendezvousServer
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+
+def _free_ports(n: int) -> List[int]:
+    socks, ports = [], []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+class ElasticDriver:
+    def __init__(self, discovery: HostDiscovery, command: List[str],
+                 min_np: int, max_np: Optional[int] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 discovery_interval_s: float = 1.0,
+                 start_timeout_s: float = 600.0,
+                 rendezvous_addr: Optional[str] = None,
+                 output_filename: Optional[str] = None,
+                 verbose: int = 0):
+        self.discovery = discovery
+        self.command = command
+        self.min_np = min_np
+        self.max_np = max_np
+        self.extra_env = dict(env or {})
+        self.discovery_interval_s = discovery_interval_s
+        self.start_timeout_s = start_timeout_s
+        self.output_filename = output_filename
+        self.verbose = verbose
+
+        self.registry = WorkerStateRegistry()
+        self.rendezvous = RendezvousServer()
+        self._rdv_addr = rendezvous_addr or "127.0.0.1"
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._hosts: List[DiscoveredHost] = []
+        self._assigned: Dict[str, dict] = {}
+        # Identities the driver itself terminated (host removed / shrunk):
+        # their nonzero exit must not blacklist the host as a failure.
+        self._released: set = set()
+        self._success = threading.Event()
+        self._first_failure_rc = 0
+
+    # ----------------------------------------------------------- assignment
+    def active_hosts(self, discovered: List[DiscoveredHost]) -> List[DiscoveredHost]:
+        return [h for h in discovered
+                if not self.registry.is_blacklisted(h.hostname)]
+
+    def compute_assignments(self, hosts: List[DiscoveredHost]) -> Dict[str, dict]:
+        """Identity → assignment for one generation.  Rank order follows
+        host order then local rank (the reference's hostfile-order rule);
+        host 0 carries the coordinator."""
+        slots = [(h.hostname, lr) for h in hosts for lr in range(h.slots)]
+        if self.max_np is not None:
+            slots = slots[:self.max_np]
+        if len(slots) < self.min_np:
+            return {}
+        size = len(slots)
+        hosts_in_use = []
+        for hn, _ in slots:
+            if hn not in hosts_in_use:
+                hosts_in_use.append(hn)
+        local_sizes = {hn: sum(1 for h, _ in slots if h == hn)
+                       for hn in hosts_in_use}
+        coord_host = ("127.0.0.1" if hosts_in_use[0] in ("localhost",
+                                                         "127.0.0.1")
+                      else hosts_in_use[0])
+        p1, p2 = _free_ports(2)
+        assignments = {}
+        for rank, (hn, lr) in enumerate(slots):
+            assignments[f"{hn}:{lr}"] = {
+                "rank": rank, "size": size,
+                "local_rank": lr, "local_size": local_sizes[hn],
+                "cross_rank": hosts_in_use.index(hn),
+                "cross_size": len(hosts_in_use),
+                "controller_addr": coord_host,
+                "controller_port": p1, "controller_port2": p2,
+                "hostname": hn,
+            }
+        return assignments
+
+    # ------------------------------------------------------------ lifecycle
+    def _worker_env(self, identity: str, hostname: str, local_rank: int):
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env.update({
+            "HOROVOD_ELASTIC": "1",
+            "HOROVOD_HOSTNAME": hostname,
+            "HOROVOD_LOCAL_RANK": str(local_rank),
+            "HOROVOD_RENDEZVOUS_ADDR": self._rdv_addr,
+            "HOROVOD_RENDEZVOUS_PORT": str(self.rendezvous.port),
+        })
+        return env
+
+    def _spawn(self, identity: str, assignment: dict):
+        hostname = assignment["hostname"]
+        env = self._worker_env(identity, hostname, assignment["local_rank"])
+        stdout = stderr = None
+        if self.output_filename:
+            d = os.path.join(self.output_filename, identity.replace(":", "."))
+            os.makedirs(d, exist_ok=True)
+            stdout = open(os.path.join(d, "stdout"), "w")
+            stderr = open(os.path.join(d, "stderr"), "w")
+        if hostname in ("localhost", "127.0.0.1", socket.gethostname()):
+            proc = subprocess.Popen(self.command, env=env,
+                                    stdout=stdout, stderr=stderr)
+        else:
+            from ..runner.run import ssh_command
+            hvd_env = {k: v for k, v in env.items()
+                       if k.startswith("HOROVOD_")}
+            cmd = ssh_command(hostname, hvd_env, self.command)
+            proc = subprocess.Popen(cmd, env=dict(os.environ),
+                                    stdout=stdout, stderr=stderr)
+        self._procs[identity] = proc
+        self.registry.record_ready(identity)
+        if self.verbose:
+            log.warning("elastic driver: spawned %s (pid %s)", identity,
+                        proc.pid)
+
+    def _notify_workers(self, version: int):
+        ports = self.rendezvous.notification_ports()
+        for identity, port in ports.items():
+            if identity not in self._procs:
+                continue
+            host = identity.rsplit(":", 1)[0]
+            addr = "127.0.0.1" if host in ("localhost", "127.0.0.1",
+                                           socket.gethostname()) else host
+            try:
+                with socket.create_connection((addr, port), timeout=5) as s:
+                    s.sendall(f"HOSTS_UPDATED {version}\n".encode())
+            except OSError as exc:
+                log.warning("elastic driver: notify %s failed: %s",
+                            identity, exc)
+
+    def _new_generation(self, hosts: List[DiscoveredHost]) -> bool:
+        assignments = self.compute_assignments(hosts)
+        if not assignments:
+            return False
+        self._assigned = assignments
+        version = self.rendezvous.publish(assignments)
+        if self.verbose:
+            log.warning("elastic driver: generation %s over %s", version,
+                        sorted(assignments))
+        # Terminate workers no longer assigned (removed/blacklisted hosts).
+        for identity, proc in list(self._procs.items()):
+            if identity not in assignments:
+                self._released.add(identity)
+                if proc.poll() is None:
+                    proc.terminate()
+        # Publish BEFORE notifying so a resetting worker always finds the
+        # new generation waiting.
+        self._notify_workers(version)
+        for identity, a in assignments.items():
+            proc = self._procs.get(identity)
+            if proc is None or proc.poll() is not None:
+                self._spawn(identity, a)
+        return True
+
+    # ------------------------------------------------------------ main loop
+    def run(self) -> int:
+        deadline = time.monotonic() + self.start_timeout_s
+        while True:
+            try:
+                discovered = self.discovery.find_available_hosts_and_slots()
+            except RuntimeError as exc:
+                log.warning("elastic driver: discovery failed: %s", exc)
+                discovered = []
+            hosts = self.active_hosts(discovered)
+            if self._new_generation(hosts):
+                self._hosts = hosts
+                break
+            if time.monotonic() > deadline:
+                log.warning("elastic driver: needed min_np=%s slots within "
+                            "start timeout; giving up", self.min_np)
+                self._shutdown_workers()
+                return 1
+            time.sleep(self.discovery_interval_s)
+
+        last_poll = time.monotonic()
+        while True:
+            changed = False
+            # 1. process exits
+            for identity, proc in list(self._procs.items()):
+                rc = proc.poll()
+                if rc is None:
+                    continue
+                del self._procs[identity]
+                if identity in self._released:
+                    self._released.discard(identity)
+                    continue
+                if rc == 0:
+                    self.registry.record_success(identity)
+                    if identity in self._assigned:
+                        self._success.set()
+                else:
+                    self.registry.record_failure(identity)
+                    if self.verbose:
+                        log.warning("elastic driver: %s failed rc=%s",
+                                    identity, rc)
+                    if not self._success.is_set():
+                        self._first_failure_rc = (self._first_failure_rc
+                                                  or rc)
+                        changed = True
+
+            # 2. success: training completed on some rank; drain the rest
+            if self._success.is_set():
+                t_end = time.monotonic() + 30
+                while self._procs and time.monotonic() < t_end:
+                    for identity, proc in list(self._procs.items()):
+                        if proc.poll() is not None:
+                            del self._procs[identity]
+                    time.sleep(0.1)
+                self._shutdown_workers()
+                return 0
+
+            # 3. discovery poll
+            if time.monotonic() - last_poll >= self.discovery_interval_s:
+                last_poll = time.monotonic()
+                try:
+                    discovered = self.discovery.find_available_hosts_and_slots()
+                    hosts = self.active_hosts(discovered)
+                    if ([(h.hostname, h.slots) for h in hosts]
+                            != [(h.hostname, h.slots) for h in self._hosts]):
+                        self._hosts = hosts
+                        changed = True
+                except RuntimeError as exc:
+                    log.warning("elastic driver: discovery failed: %s", exc)
+
+            # 4. re-form the world if needed
+            if changed:
+                if not self._new_generation(self._hosts):
+                    log.warning(
+                        "elastic driver: %s slots < min_np=%s; aborting",
+                        sum(h.slots for h in self._hosts), self.min_np)
+                    self._shutdown_workers()
+                    return self._first_failure_rc or 1
+
+            time.sleep(0.05)
+
+    def _shutdown_workers(self):
+        for proc in self._procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        t_end = time.monotonic() + 10
+        for proc in self._procs.values():
+            while proc.poll() is None and time.monotonic() < t_end:
+                time.sleep(0.05)
+            if proc.poll() is None:
+                proc.kill()
+        self._procs.clear()
+        self.rendezvous.stop()
+
+
+def run_elastic(args) -> int:
+    """``torovodrun --host-discovery-script`` entry (reference:
+    ``_run_elastic``)."""
+    min_np = args.min_np or args.np or 1
+    max_np = args.max_np
+    discovery = HostDiscoveryScript(args.host_discovery_script,
+                                    default_slots=args.slots_per_host or 1)
+    extra_env = {}
+    for flag, var, scale in (
+            ("fusion_threshold_mb", "HOROVOD_FUSION_THRESHOLD", 1024 * 1024),
+            ("cycle_time_ms", "HOROVOD_CYCLE_TIME", 1),
+            ("cache_capacity", "HOROVOD_CACHE_CAPACITY", 1),
+            ("stall_check_time", "HOROVOD_STALL_CHECK_TIME", 1),
+            ("stall_shutdown_time", "HOROVOD_STALL_SHUTDOWN_TIME", 1)):
+        val = getattr(args, flag, None)
+        if val is not None:
+            extra_env[var] = str(int(val * scale) if scale != 1 else val)
+    if getattr(args, "timeline_filename", None):
+        extra_env["HOROVOD_TIMELINE"] = args.timeline_filename
+    driver = ElasticDriver(
+        discovery, args.command, min_np=min_np, max_np=max_np,
+        env=extra_env, start_timeout_s=args.start_timeout,
+        output_filename=args.output_filename, verbose=args.verbose)
+    try:
+        return driver.run()
+    finally:
+        try:
+            driver.rendezvous.stop()
+        except Exception:  # noqa: BLE001 - already stopped
+            pass

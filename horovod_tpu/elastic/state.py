@@ -159,8 +159,11 @@ def run(func: Callable) -> Callable:
     """
     @functools.wraps(func)
     def wrapper(state: State, *args, **kwargs):
+        import os
         from ..common import basics
-        notifier = getattr(state, "_notification_manager", None)
+        if os.environ.get("HOROVOD_ELASTIC"):
+            from . import worker
+            worker.attach_notification_manager(state)
         reset_required = False
         skip_sync = False
         while True:

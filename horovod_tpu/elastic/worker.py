@@ -1,0 +1,167 @@
+"""Worker-side elastic machinery: notification listener + re-rendezvous
+bootstrap.
+
+Parity: reference ``horovod/runner/elastic/worker.py``
+(``WorkerNotificationService``/``WorkerNotificationManager``) and the worker
+half of §3.4's control flow: the driver pings registered workers on host
+changes; ``state.commit()``/``check_host_updates()`` turns the ping into a
+``HostsUpdatedInterrupt``; on reset the worker long-polls the rendezvous for
+a strictly newer generation and re-forms the JAX world.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Optional
+
+from . import rendezvous as rdv
+from .state import HostsUpdatedInterrupt
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+# The generation this process is currently participating in; bootstrap
+# requests strictly newer on re-init so a stale assignment can't be rejoined.
+_current_version: Optional[int] = None
+_manager: Optional["WorkerNotificationManager"] = None
+
+
+def identity() -> str:
+    host = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
+    local_rank = os.environ.get("HOROVOD_LOCAL_RANK", "0")
+    return f"{host}:{local_rank}"
+
+
+class WorkerNotificationService:
+    """Tiny TCP listener; driver sends ``HOSTS_UPDATED <version>\\n``."""
+
+    def __init__(self, on_hosts_updated):
+        self._on_hosts_updated = on_hosts_updated
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", 0))
+        self._sock.listen(8)
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1]
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            try:
+                data = conn.makefile().readline().strip()
+                if data.startswith("HOSTS_UPDATED"):
+                    version = int(data.split()[1]) if " " in data else 0
+                    self._on_hosts_updated(version)
+                conn.close()
+            except (OSError, ValueError):
+                pass
+
+    def stop(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class WorkerNotificationManager:
+    """Registered on elastic ``State`` objects as ``_notification_manager``;
+    ``State.commit()`` calls ``raise_if_updated()``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending_version: Optional[int] = None
+        self._service = WorkerNotificationService(self._notify)
+        addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR")
+        port = os.environ.get("HOROVOD_RENDEZVOUS_PORT")
+        if addr and port:
+            rdv.register_notification_port(addr, int(port), identity(),
+                                           self._service.port)
+
+    def _notify(self, version: int):
+        with self._lock:
+            self._pending_version = version
+
+    def raise_if_updated(self):
+        with self._lock:
+            v = self._pending_version
+            if v is None:
+                return
+            # A late ping for the generation we already joined is not news.
+            if _current_version is not None and v <= _current_version:
+                self._pending_version = None
+                return
+            self._pending_version = None
+        raise HostsUpdatedInterrupt()
+
+
+def attach_notification_manager(state):
+    """Idempotently give ``state`` the process-wide notification manager."""
+    global _manager
+    if _manager is None:
+        _manager = WorkerNotificationManager()
+    state._notification_manager = _manager
+    return _manager
+
+
+def elastic_bootstrap():
+    """Fetch this worker's assignment for the next generation and project it
+    into the environment; returns the re-parsed Config.
+
+    Called from ``basics.init()`` when ``HOROVOD_ELASTIC=1``.
+    """
+    global _current_version
+    from ..common.config import Config
+
+    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR")
+    port = os.environ.get("HOROVOD_RENDEZVOUS_PORT")
+    if not addr or not port:
+        raise RuntimeError(
+            "HOROVOD_ELASTIC=1 but HOROVOD_RENDEZVOUS_ADDR/PORT are not set "
+            "(elastic workers must be launched by torovodrun "
+            "--host-discovery-script)")
+    min_version = 0 if _current_version is None else _current_version + 1
+    timeout = float(os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600"))
+    a = rdv.fetch_assignment(addr, int(port), identity(),
+                             min_version=min_version, timeout_s=timeout)
+    _current_version = int(a["version"])
+    log.info("elastic: joined generation %s as rank %s/%s",
+             a["version"], a["rank"], a["size"])
+    env = {
+        "HOROVOD_RANK": str(a["rank"]),
+        "HOROVOD_SIZE": str(a["size"]),
+        "HOROVOD_LOCAL_RANK": str(a["local_rank"]),
+        "HOROVOD_LOCAL_SIZE": str(a["local_size"]),
+        "HOROVOD_CROSS_RANK": str(a["cross_rank"]),
+        "HOROVOD_CROSS_SIZE": str(a["cross_size"]),
+        "HOROVOD_CONTROLLER_ADDR": str(a["controller_addr"]),
+        "HOROVOD_CONTROLLER_PORT": str(a["controller_port"]),
+        "HOROVOD_CONTROLLER_PORT2": str(a["controller_port2"]),
+    }
+    os.environ.update(env)
+    return Config.from_env()
+
+
+def teardown_distributed():
+    """Tear the JAX world fully down so init() can re-form it with a new
+    size — ``jax.distributed.shutdown()`` plus an XLA backend clear
+    (SURVEY.md §7 hard-part #3: elastic re-meshing implies re-init +
+    recompile; live arrays must already be host-saved via state.commit)."""
+    import jax
+    from jax._src import distributed as _jdist
+    if _jdist.global_state.client is not None:
+        try:
+            jax.distributed.shutdown()
+        except Exception as exc:  # noqa: BLE001 - peers may already be gone
+            log.warning("elastic: jax.distributed.shutdown failed: %s", exc)
+            _jdist.global_state.client = None
+    import jax.extend.backend as jeb
+    jeb.clear_backends()

@@ -31,6 +31,24 @@ from ..common.process_sets import ProcessSet
 _name_counter = itertools.count(0)
 _group_counter = itertools.count(0)
 
+# Auto-generated collective names are part of the negotiation wire protocol:
+# they must be identical on every rank.  init() resets all counters (every
+# rank re-inits together on an elastic reset, so call-order counters
+# realign); other modules with wire-visible counters register here.
+_counter_reset_hooks: List = []
+
+
+def register_name_counter_reset(fn):
+    _counter_reset_hooks.append(fn)
+
+
+def reset_name_counters():
+    global _name_counter, _group_counter
+    _name_counter = itertools.count(0)
+    _group_counter = itertools.count(0)
+    for fn in _counter_reset_hooks:
+        fn()
+
 
 def _engine():
     st = basics._get_state()
@@ -51,6 +69,18 @@ def _auto_name(prefix: str, name: Optional[str]) -> str:
     return name if name else f"{prefix}.noname.{next(_name_counter)}"
 
 
+def per_process_mode() -> bool:
+    """True when this process contributes as ONE rank (torovodrun-launched,
+    including an elastic world that currently has a single process) rather
+    than controlling the whole world (single-controller SPMD)."""
+    st = basics._get_state()
+    topo = st.topology
+    if topo is not None and topo.num_processes > 1:
+        return True
+    cfg = st.config
+    return cfg is not None and cfg.controller_addr != ""
+
+
 def _as_stacked(x, ps_id: int):
     """Coerce input to a stacked [world, *S] jax.Array on the set's mesh.
 
@@ -68,8 +98,7 @@ def _as_stacked(x, ps_id: int):
     if isinstance(x, (np.ndarray, list, tuple, int, float)) or np.isscalar(x):
         x = np.asarray(x)
     sharding = NamedSharding(ps.mesh, P(ps.axis_name))
-    topo = st.topology
-    if topo is not None and topo.num_processes > 1:
+    if per_process_mode():
         if isinstance(x, jax.Array) and not x.is_fully_addressable:
             raise ValueError(
                 "Multi-process eager collectives take this process's LOCAL "
@@ -143,8 +172,7 @@ def stack_per_rank(values: Sequence, process_set: Optional[ProcessSet] = None):
     if len(vals) != ps.size():
         raise ValueError(f"Expected {ps.size()} per-rank values, got {len(vals)}")
     stacked = np.stack(vals)
-    topo = st.topology
-    if topo is not None and topo.num_processes > 1:
+    if per_process_mode():
         my = [i for i, d in enumerate(ps.mesh.devices.flat)
               if d.process_index == jax.process_index()]
         local = stacked[my]

@@ -38,13 +38,18 @@ class ElasticSampler(torch.utils.data.Sampler):
         self.reset()
 
     def record_batch(self, batch_idx: int, batch_size: int):
-        """Record consumption of one batch of this rank's shard."""
+        """Record consumption of one batch of this rank's shard.
+
+        Offsets index ``remaining_indices`` — the list ``__iter__`` actually
+        serves — so recording stays correct after a mid-epoch reset has
+        filtered out already-processed entries.
+        """
         start = self.rank + batch_idx * batch_size * self.num_replicas
         processed = []
         for i in range(batch_size):
             offset = start + i * self.num_replicas
-            if offset < len(self.indices):
-                processed.append(self.indices[offset])
+            if offset < self.total_size:
+                processed.append(self.remaining_indices[offset])
         self.processed_indices.update(processed)
 
     def record_indices(self, indices):

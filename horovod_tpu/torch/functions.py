@@ -20,10 +20,12 @@ def broadcast_parameters(params, root_rank: int = 0, process_set=None):
     ``params`` may be a ``model.state_dict()``, ``dict``, or an iterable of
     ``(name, tensor)`` pairs (e.g. ``model.named_parameters()``).
     """
+    writeback = None
+    if isinstance(params, torch.nn.Module):
+        params = params.state_dict()
     if isinstance(params, dict):
+        writeback = params
         params = sorted(params.items())
-    elif isinstance(params, torch.nn.Module):
-        params = sorted(params.state_dict().items())
     else:
         params = list(params)
 
@@ -42,11 +44,19 @@ def broadcast_parameters(params, root_rank: int = 0, process_set=None):
     for h in handles:
         mpi_ops.synchronize(h)
     if non_tensor:
-        # Non-tensor entries (e.g. num_batches_tracked scalars already
-        # covered above; arbitrary picklables) ride a pickle broadcast.
+        # Non-tensor entries (arbitrary picklables) ride a pickle broadcast;
+        # synced values are written back into the caller's dict.  Iterables
+        # of pairs give no container to write into — broadcasting them only
+        # makes sense for tensors.
         synced = mpi_ops.broadcast_object(non_tensor, root_rank=root_rank,
                                           process_set=process_set)
-        non_tensor.update(synced)
+        if writeback is not None:
+            writeback.update(synced)
+        else:
+            raise ValueError(
+                f"broadcast_parameters got non-tensor entries "
+                f"{sorted(non_tensor)} in a pair iterable; pass the "
+                f"state_dict itself so synced values can be written back")
 
 
 def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,

@@ -85,19 +85,21 @@ def _from_numpy(a: np.ndarray, dtype: torch.dtype,
     return t
 
 
-def _submit(t: torch.Tensor, per_rank=False):
+def _set_size(process_set: Optional[ProcessSet]) -> int:
+    return process_set.size() if process_set is not None else basics.size()
+
+
+def _submit(t: torch.Tensor, process_set: Optional[ProcessSet] = None):
     """This process's contribution in the eager layer's expected form.
 
     Multi-process: the local tensor as-is (eager._as_stacked assembles the
     global array from per-process shards).  Single-process SPMD: replicate —
     the controller submits the same tensor for every rank it owns.
     """
-    st = basics._get_state()
     arr = _to_numpy(t)
-    topo = st.topology
-    if topo is not None and topo.num_processes > 1:
+    if eager.per_process_mode():
         return arr
-    return np.broadcast_to(arr[None], (basics.size(),) + arr.shape)
+    return np.broadcast_to(arr[None], (_set_size(process_set),) + arr.shape)
 
 
 def _ps(process_set: Optional[ProcessSet]):
@@ -141,7 +143,7 @@ def allreduce_async(tensor: torch.Tensor, name: Optional[str] = None,
                     prescale_factor: Optional[float] = None,
                     postscale_factor: Optional[float] = None,
                     process_set: Optional[ProcessSet] = None) -> int:
-    inner = eager.allreduce_async(_submit(tensor), name=name, op=op,
+    inner = eager.allreduce_async(_submit(tensor, process_set), name=name, op=op,
                                   prescale_factor=prescale_factor,
                                   postscale_factor=postscale_factor,
                                   process_set=process_set)
@@ -162,7 +164,7 @@ def allreduce_async_(tensor: torch.Tensor, name: Optional[str] = None,
                      prescale_factor: Optional[float] = None,
                      postscale_factor: Optional[float] = None,
                      process_set: Optional[ProcessSet] = None) -> int:
-    inner = eager.allreduce_async(_submit(tensor), name=name, op=op,
+    inner = eager.allreduce_async(_submit(tensor, process_set), name=name, op=op,
                                   prescale_factor=prescale_factor,
                                   postscale_factor=postscale_factor,
                                   process_set=process_set)
@@ -185,7 +187,7 @@ def grouped_allreduce_async(tensors: Sequence[torch.Tensor],
                             postscale_factor: Optional[float] = None,
                             process_set: Optional[ProcessSet] = None) -> List[int]:
     inners = eager.grouped_allreduce_async(
-        [_submit(t) for t in tensors], name=name, op=op,
+        [_submit(t, process_set) for t in tensors], name=name, op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         process_set=process_set)
     return [_register(i, t) for i, t in zip(inners, tensors)]
@@ -207,7 +209,7 @@ def grouped_allreduce_async_(tensors: Sequence[torch.Tensor],
                              postscale_factor: Optional[float] = None,
                              process_set: Optional[ProcessSet] = None) -> List[int]:
     inners = eager.grouped_allreduce_async(
-        [_submit(t) for t in tensors], name=name, op=op,
+        [_submit(t, process_set) for t in tensors], name=name, op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         process_set=process_set)
     return [_register(i, t, out=t) for i, t in zip(inners, tensors)]
@@ -225,7 +227,7 @@ def grouped_allreduce_(tensors: Sequence[torch.Tensor],
 # ------------------------------------------------------------------ allgather
 def allgather_async(tensor: torch.Tensor, name: Optional[str] = None,
                     process_set: Optional[ProcessSet] = None) -> int:
-    inner = eager.allgather_async(_submit(tensor), name=name,
+    inner = eager.allgather_async(_submit(tensor, process_set), name=name,
                                   process_set=process_set)
     return _register(inner, tensor)
 
@@ -239,7 +241,7 @@ def allgather(tensor: torch.Tensor, name: Optional[str] = None,
 def broadcast_async(tensor: torch.Tensor, root_rank: int = 0,
                     name: Optional[str] = None,
                     process_set: Optional[ProcessSet] = None) -> int:
-    inner = eager.broadcast_async(_submit(tensor), root_rank=root_rank,
+    inner = eager.broadcast_async(_submit(tensor, process_set), root_rank=root_rank,
                                   name=name, process_set=process_set)
     return _register(inner, tensor)
 
@@ -253,7 +255,7 @@ def broadcast(tensor: torch.Tensor, root_rank: int = 0,
 def broadcast_async_(tensor: torch.Tensor, root_rank: int = 0,
                      name: Optional[str] = None,
                      process_set: Optional[ProcessSet] = None) -> int:
-    inner = eager.broadcast_async(_submit(tensor), root_rank=root_rank,
+    inner = eager.broadcast_async(_submit(tensor, process_set), root_rank=root_rank,
                                   name=name, process_set=process_set)
     return _register(inner, tensor, out=tensor)
 
@@ -274,9 +276,7 @@ def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None,
 def _take_my_row(t: torch.Tensor) -> torch.Tensor:
     """Stacked sharded results ([world, *S] rows = per-rank outputs, or this
     process's [1, *S] slice in multi-process mode) → this rank's row."""
-    st = basics._get_state()
-    topo = st.topology
-    if topo is not None and topo.num_processes > 1:
+    if eager.per_process_mode():
         return t[0] if t.shape[0] == 1 else t.reshape(-1, *t.shape[2:])
     return t[basics.rank()]
 
@@ -284,11 +284,12 @@ def _take_my_row(t: torch.Tensor) -> torch.Tensor:
 def alltoall_async(tensor: torch.Tensor, splits=None,
                    name: Optional[str] = None,
                    process_set: Optional[ProcessSet] = None) -> int:
-    if tensor.shape[0] % basics.size() != 0:
+    world = _set_size(process_set)
+    if tensor.shape[0] % world != 0:
         raise ValueError(
-            f"alltoall with even splits needs dim0 divisible by "
-            f"size()={basics.size()}; got {tuple(tensor.shape)}")
-    inner = eager.alltoall_async(_submit(tensor), splits=splits,
+            f"alltoall with even splits needs dim0 divisible by the "
+            f"process set size ({world}); got {tuple(tensor.shape)}")
+    inner = eager.alltoall_async(_submit(tensor, process_set), splits=splits,
                                  name=name, process_set=process_set)
     return _register(inner, tensor, postprocess=_take_my_row)
 
@@ -302,7 +303,7 @@ def alltoall(tensor: torch.Tensor, splits=None, name: Optional[str] = None,
 def reducescatter_async(tensor: torch.Tensor, name: Optional[str] = None,
                         op: ReduceOp = Sum,
                         process_set: Optional[ProcessSet] = None) -> int:
-    inner = eager.reducescatter_async(_submit(tensor), name=name, op=op,
+    inner = eager.reducescatter_async(_submit(tensor, process_set), name=name, op=op,
                                       process_set=process_set)
     return _register(inner, tensor, postprocess=_take_my_row)
 

@@ -130,10 +130,12 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 wire_op = mpi_ops.Sum
             else:
                 wire_op = mpi_ops.Average
+            # Average semantics only: locally accumulated N passes are
+            # divided back to the per-pass mean; Sum/Adasum keep the raw sum.
+            if self.backward_passes_per_step > 1:
+                prescale = (prescale or 1.0) / self.backward_passes_per_step
         else:
             wire_op = self.op
-        if self.backward_passes_per_step > 1:
-            prescale = (prescale or 1.0) / self.backward_passes_per_step
         tensor_compressed, ctx = self._compression.compress(tensor)
         handle = mpi_ops.allreduce_async(
             tensor_compressed, name=f"allreduce.{name}", op=wire_op,

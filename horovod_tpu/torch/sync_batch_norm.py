@@ -19,9 +19,20 @@ from . import mpi_ops
 from ..common import basics
 
 # Collective names must be identical across ranks for negotiation to match;
-# every rank executes the same module sequence, so call-order counters align.
+# every rank executes the same module sequence, so call-order counters align
+# (and reset together with the runtime, for elastic re-inits).
 _fwd_counter = itertools.count(0)
 _bwd_counter = itertools.count(0)
+
+
+def _reset_counters():
+    global _fwd_counter, _bwd_counter
+    _fwd_counter = itertools.count(0)
+    _bwd_counter = itertools.count(0)
+
+
+from ..ops.eager import register_name_counter_reset  # noqa: E402
+register_name_counter_reset(_reset_counters)
 
 
 class SyncBatchNorm(_BatchNorm):
@@ -53,9 +64,14 @@ class SyncBatchNorm(_BatchNorm):
             return self._run_bn(input)
         if self.num_batches_tracked is not None:
             self.num_batches_tracked = self.num_batches_tracked + 1
+        # momentum=None is _BatchNorm's cumulative-moving-average mode.
+        momentum = self.momentum
+        if momentum is None:
+            momentum = (1.0 / float(self.num_batches_tracked)
+                        if self.num_batches_tracked is not None else 0.1)
         return _SyncBatchNormFn.apply(
             input, self.weight, self.bias, self.running_mean,
-            self.running_var, self.eps, self.momentum, self.process_set)
+            self.running_var, self.eps, momentum, self.process_set)
 
 
 class _SyncBatchNormFn(Function):
@@ -97,6 +113,7 @@ class _SyncBatchNormFn(Function):
             out = out + bias.float().reshape(shape)
         ctx.save_for_backward(xhat, weight, invstd, total)
         ctx.process_set = process_set
+        ctx.has_bias = bias is not None
         return out.to(input.dtype)
 
     @staticmethod
@@ -119,7 +136,7 @@ class _SyncBatchNormFn(Function):
 
         grad_weight = (go * xhat).sum(dim=reduce_dims) \
             if weight is not None else None
-        grad_bias = go.sum(dim=reduce_dims)
+        grad_bias = go.sum(dim=reduce_dims) if ctx.has_bias else None
 
         w = weight.float().reshape(shape) if weight is not None else 1.0
         gx = (w * invstd.reshape(shape)) * (

@@ -1,0 +1,73 @@
+"""Elastic integration worker: trains a toy JAX model under
+``@hvd.elastic.run`` while the test mutates the discovery host set, mirroring
+the reference's ``test/integration/data`` training scripts (SURVEY.md §4).
+
+Writes a JSON result (epochs completed, final world size, reset count) from
+rank 0 at the end so the test can assert the job survived the resize.
+"""
+
+import json
+import os
+
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.elastic import JaxState, run
+
+MARKER = os.environ["ELASTIC_TEST_MARKER"]
+RESULT = os.environ["ELASTIC_TEST_RESULT"]
+EPOCHS = int(os.environ.get("ELASTIC_TEST_EPOCHS", "6"))
+
+resets = {"n": 0}
+
+
+@run
+def train(state):
+    import time
+    while state.epoch < EPOCHS:
+        # One "epoch": a real collective so peers must be alive and the
+        # world must be consistent.
+        contrib = np.full((2,), float(hvd.rank() + 1), np.float32)
+        out = hvd.to_local(hvd.allreduce(
+            contrib, name=f"epoch.{state.epoch}", op=hvd.Sum))
+        expected = sum(r + 1.0 for r in range(hvd.size()))
+        np.testing.assert_allclose(out, np.full((2,), expected))
+        state.epoch += 1
+        state.commit()  # checks for host updates -> may raise/reset
+        if state.epoch == 2 and hvd.rank() == 0:
+            with open(MARKER, "w") as fh:
+                fh.write(str(state.epoch))
+        if state.epoch >= 2:
+            # Give the driver time to act on the mutated host set before the
+            # job finishes (discovery poll interval is 1s).
+            time.sleep(1.0)
+    return hvd.size()
+
+
+def on_reset():
+    resets["n"] += 1
+
+
+def main():
+    hvd.init()
+    state = JaxState(epoch=0)
+    state.register_reset_callbacks([on_reset])
+    final_size = train(state)
+    if hvd.rank() == 0:
+        with open(RESULT, "w") as fh:
+            json.dump({"epochs": state.epoch, "final_size": final_size,
+                       "resets": resets["n"]}, fh)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
+
+

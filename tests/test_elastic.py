@@ -1,0 +1,217 @@
+"""Elastic driver tests.
+
+Unit tier mirrors the reference's ``test/single/test_elastic_driver.py``
+pattern (fake discovery from temp files, assert on rank assignment /
+blacklist / rendezvous logic with no real training); the integration tier
+(``test_elastic_integration``) runs a REAL elastic job on localhost whose
+discovery output mutates mid-run, like ``test/integration/
+test_elastic_torch.py`` (SURVEY.md §4).
+"""
+
+import json
+import os
+import stat
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+from horovod_tpu.elastic.discovery import (
+    DiscoveredHost, FixedHostDiscovery, HostDiscoveryScript)
+from horovod_tpu.elastic.driver import ElasticDriver
+from horovod_tpu.elastic.registration import WorkerStateRegistry
+from horovod_tpu.elastic.rendezvous import (
+    RendezvousServer, fetch_assignment, register_notification_port)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ discovery
+def test_discovery_parse():
+    d = HostDiscoveryScript("true", default_slots=2)
+    hosts = d.parse("a:4\nb\n# comment\n\nc:1 # tail\na:9\n")
+    assert hosts == [DiscoveredHost("a", 4), DiscoveredHost("b", 2),
+                     DiscoveredHost("c", 1)]
+
+
+def test_discovery_script_execution(tmp_path):
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("localhost:2\nnode1:4\n")
+    script = tmp_path / "discover.sh"
+    script.write_text(f"#!/bin/sh\ncat {hostfile}\n")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    d = HostDiscoveryScript(str(script))
+    assert d.find_available_hosts_and_slots() == [
+        DiscoveredHost("localhost", 2), DiscoveredHost("node1", 4)]
+    # Mutating the file changes the next poll (the elastic contract).
+    hostfile.write_text("localhost:2\n")
+    assert d.find_available_hosts_and_slots() == [
+        DiscoveredHost("localhost", 2)]
+
+
+def test_discovery_script_failure():
+    d = HostDiscoveryScript("exit 3")
+    with pytest.raises(RuntimeError):
+        d.find_available_hosts_and_slots()
+
+
+# ----------------------------------------------------------------- registry
+def test_registry_blacklist():
+    r = WorkerStateRegistry()
+    r.record_ready("a:0")
+    r.record_failure("a:0")
+    assert r.is_blacklisted("a")
+    assert not r.is_blacklisted("b")
+    assert r.failure_count("a") == 1
+    r.record_success("b:0")
+    assert r.success_count() == 1
+
+
+# -------------------------------------------------------------- assignments
+def _driver(min_np=1, max_np=None):
+    return ElasticDriver(FixedHostDiscovery([]), ["true"], min_np=min_np,
+                         max_np=max_np)
+
+
+def test_compute_assignments_order_and_shape():
+    d = _driver(min_np=2)
+    try:
+        a = d.compute_assignments([DiscoveredHost("h0", 2),
+                                   DiscoveredHost("h1", 1)])
+        assert set(a) == {"h0:0", "h0:1", "h1:0"}
+        assert a["h0:0"]["rank"] == 0
+        assert a["h0:1"]["rank"] == 1
+        assert a["h1:0"]["rank"] == 2
+        assert all(v["size"] == 3 for v in a.values())
+        assert a["h1:0"]["cross_rank"] == 1
+        assert a["h0:1"]["local_size"] == 2
+        assert all(v["controller_addr"] == "h0" for v in a.values())
+    finally:
+        d.rendezvous.stop()
+
+
+def test_compute_assignments_max_np_cap_and_min_np():
+    d = _driver(min_np=2, max_np=2)
+    try:
+        a = d.compute_assignments([DiscoveredHost("h0", 4)])
+        assert set(a) == {"h0:0", "h0:1"}
+        assert all(v["size"] == 2 for v in a.values())
+        assert d.compute_assignments([DiscoveredHost("h0", 1)]) == {}
+    finally:
+        d.rendezvous.stop()
+
+
+def test_blacklisted_host_excluded():
+    d = _driver(min_np=1)
+    try:
+        d.registry.record_failure("bad:0")
+        hosts = d.active_hosts([DiscoveredHost("bad", 2),
+                                DiscoveredHost("good", 1)])
+        assert hosts == [DiscoveredHost("good", 1)]
+    finally:
+        d.rendezvous.stop()
+
+
+# --------------------------------------------------------------- rendezvous
+def test_rendezvous_publish_fetch_versioning():
+    s = RendezvousServer()
+    try:
+        v1 = s.publish({"h:0": {"rank": 0, "size": 1}})
+        assert v1 == 1
+        a = fetch_assignment("127.0.0.1", s.port, "h:0", timeout_s=5)
+        assert a["rank"] == 0 and a["version"] == 1
+        # min_version gating: nothing at version 2 yet.
+        with pytest.raises(TimeoutError):
+            fetch_assignment("127.0.0.1", s.port, "h:0", min_version=2,
+                             timeout_s=1.0)
+        v2 = s.publish({"h:0": {"rank": 0, "size": 2}})
+        a = fetch_assignment("127.0.0.1", s.port, "h:0", min_version=2,
+                             timeout_s=5)
+        assert a["size"] == 2 and a["version"] == v2
+        # Unknown identity stays pending.
+        with pytest.raises(TimeoutError):
+            fetch_assignment("127.0.0.1", s.port, "nope:0", timeout_s=1.0)
+        register_notification_port("127.0.0.1", s.port, "h:0", 12345)
+        assert s.notification_ports() == {"h:0": 12345}
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------- driver process lifecycle
+def test_driver_success_on_worker_exit_zero():
+    d = ElasticDriver(
+        FixedHostDiscovery([DiscoveredHost("localhost", 2)]),
+        [sys.executable, "-c", "pass"], min_np=2, start_timeout_s=30)
+    assert d.run() == 0
+    assert d.registry.success_count() >= 1
+
+
+def test_driver_gives_up_below_min_np():
+    d = ElasticDriver(FixedHostDiscovery([DiscoveredHost("localhost", 1)]),
+                      [sys.executable, "-c", "pass"], min_np=4,
+                      start_timeout_s=2, discovery_interval_s=0.2)
+    assert d.run() == 1
+
+
+def test_driver_failure_blacklists_and_aborts():
+    # Workers always fail; localhost gets blacklisted; below min_np -> abort
+    # with the worker's rc.
+    d = ElasticDriver(
+        FixedHostDiscovery([DiscoveredHost("localhost", 2)]),
+        [sys.executable, "-c", "import sys; sys.exit(7)"],
+        min_np=2, start_timeout_s=30)
+    rc = d.run()
+    assert rc == 7
+    assert d.registry.is_blacklisted("localhost")
+
+
+# ------------------------------------------------------------- integration
+WORKER = os.path.join(REPO, "tests", "data", "worker_elastic.py")
+
+
+@pytest.mark.parametrize("mode", ["grow", "shrink"])
+def test_elastic_integration(tmp_path, mode):
+    """Real elastic run on localhost: discovery output mutates mid-run."""
+    hostfile = tmp_path / "hosts.txt"
+    start, end = (("localhost:1", "localhost:2") if mode == "grow"
+                  else ("localhost:2", "localhost:1"))
+    hostfile.write_text(start + "\n")
+    marker = tmp_path / "epoch_marker"
+    result = tmp_path / "result"
+
+    env = dict(os.environ)
+    other_paths = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + other_paths)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ELASTIC_TEST_MARKER"] = str(marker)
+    env["ELASTIC_TEST_RESULT"] = str(result)
+    env["ELASTIC_TEST_EPOCHS"] = "6"
+    env.pop("HOROVOD_TIMELINE", None)
+
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+           "--host-discovery-script", f"cat {hostfile}",
+           "--min-np", "1", "--max-np", "2",
+           sys.executable, WORKER]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    try:
+        # Wait for a worker to pass epoch 2, then mutate the host set.
+        deadline = time.time() + 120
+        while not marker.exists() and time.time() < deadline:
+            time.sleep(0.2)
+        assert marker.exists(), "worker never reached the marker epoch"
+        hostfile.write_text(end + "\n")
+        out, _ = proc.communicate(timeout=180)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, out[-4000:]
+    assert result.exists(), out[-4000:]
+    res = json.loads(result.read_text())
+    assert res["epochs"] == 6
+    final_size = 2 if mode == "grow" else 1
+    assert res["final_size"] == final_size, (res, out[-4000:])
+    assert res["resets"] >= 1, (res, out[-4000:])

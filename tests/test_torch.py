@@ -327,3 +327,64 @@ def test_compression_roundtrip():
     assert hvd_torch.Compression.bf16.decompress(c, ctx).dtype == torch.float32
     c, ctx = hvd_torch.Compression.none.compress(t)
     assert c is t
+
+
+# ------------------------------------------------- code-review regressions
+def test_sync_batch_norm_affine_false_backward(tvd):
+    sbn = hvd_torch.SyncBatchNorm(3, affine=False)
+    sbn.train()
+    x = torch.randn(8, 3, requires_grad=True)
+    y = sbn(x)
+    y.sum().backward()  # must not raise on the missing bias grad
+    assert x.grad is not None
+
+
+def test_sync_batch_norm_momentum_none(tvd):
+    sbn = hvd_torch.SyncBatchNorm(2, momentum=None)
+    bn = torch.nn.BatchNorm1d(2, momentum=None)
+    sbn.train(), bn.train()
+    for _ in range(3):  # cumulative moving average over several batches
+        x = torch.randn(8, 2)
+        sbn(x), bn(x.clone())
+    assert torch.allclose(sbn.running_mean, bn.running_mean, atol=1e-5)
+
+
+def test_optimizer_sum_op_not_rescaled_by_bpps(tvd):
+    # With op=Sum and backward_passes_per_step=2, the applied grad must be
+    # size() * (accumulated local grad) — no 1/bpps division.
+    model = torch.nn.Linear(2, 1, bias=False)
+    with torch.no_grad():
+        model.weight.fill_(1.0)
+    opt = hvd_torch.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=1.0),
+        named_parameters=model.named_parameters(),
+        op=hvd_torch.Sum, backward_passes_per_step=2)
+    x = torch.ones(1, 2)
+    before = model.weight.clone()
+    for _ in range(2):
+        (model(x)).sum().backward()  # dL/dw = x = 1 each pass
+    opt.step()
+    # accumulated local grad = 2; Sum over 8 identical ranks = 16; lr 1.
+    assert torch.allclose(before - model.weight, torch.full((1, 2), 16.0))
+
+
+def test_elastic_sampler_record_batch_after_reset(tvd):
+    data = list(range(64))
+    s = hvd_torch.elastic.ElasticSampler(data, shuffle=False)
+    first = list(iter(s))
+    s.record_batch(0, 2)  # first two of THIS rank's shard
+    assert set(first[:2]) <= s.processed_indices
+    s.reset()
+    second = list(iter(s))
+    assert not set(first[:2]) & set(second)
+    # After the reset, record_batch must track the filtered list.
+    s.record_batch(0, 2)
+    assert set(second[:2]) <= s.processed_indices
+
+
+def test_broadcast_parameters_writes_back_non_tensor(tvd):
+    sd = {"w": torch.ones(2), "step": 7}
+    hvd_torch.broadcast_parameters(sd, root_rank=0)
+    assert sd["step"] == 7
+    with pytest.raises(ValueError):
+        hvd_torch.broadcast_parameters(iter([("step", 7)]), root_rank=0)
