@@ -1,0 +1,160 @@
+"""Training-loop callbacks for JAX training loops.
+
+Parity: the reference Keras callbacks (``horovod/_keras/callbacks.py`` —
+SURVEY.md §2b P5): ``BroadcastGlobalVariablesCallback``,
+``MetricAverageCallback``, ``LearningRateWarmupCallback``,
+``LearningRateScheduleCallback``.
+
+TPU-first design: the learning-rate policies are ALSO exposed as optax
+schedules (``warmup_scaled_schedule``) — inside a jitted train step a
+schedule is compiler-visible and free, which is the idiomatic home for the
+"scale LR by size(), warm up for N epochs" recipe the reference implements
+by mutating ``optimizer.lr`` between epochs.  The callback classes drive the
+same policies for imperative loops and match the reference surface.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from .common import basics
+from .ops import eager
+from .ops import collectives as C
+
+
+class Callback:
+    """Minimal hook protocol (a structural subset of keras.Callback)."""
+
+    def on_train_begin(self, state: Any = None):
+        pass
+
+    def on_epoch_begin(self, epoch: int, state: Any = None):
+        pass
+
+    def on_epoch_end(self, epoch: int, state: Any = None,
+                     metrics: Optional[Dict[str, float]] = None):
+        pass
+
+    def on_batch_end(self, batch: int, state: Any = None):
+        pass
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast rank 0's parameters to all ranks at train start.
+
+    Reference: ``BroadcastGlobalVariablesCallback`` — ensures consistent
+    initialization (or checkpoint-restored state) across ranks.  ``state``
+    must expose ``params`` (a pytree); ``opt_state`` is broadcast too when
+    present.
+    """
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, state: Any = None):
+        if state is None or basics.size() <= 1:
+            return
+        state.params = broadcast_pytree(state.params, self.root_rank)
+        if getattr(state, "opt_state", None) is not None:
+            state.opt_state = broadcast_pytree(state.opt_state,
+                                               self.root_rank)
+
+
+# The shared implementation lives in ops/eager.py; re-exported here because
+# callback users reach for it alongside BroadcastGlobalVariablesCallback.
+broadcast_pytree = eager.broadcast_pytree
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics over all ranks (reference:
+    ``MetricAverageCallback``) so logged values reflect the global job."""
+
+    def on_epoch_end(self, epoch: int, state: Any = None,
+                     metrics: Optional[Dict[str, float]] = None):
+        if not metrics or basics.size() <= 1:
+            return
+        keys = sorted(k for k, v in metrics.items()
+                      if isinstance(v, (int, float, np.floating, np.integer)))
+        if not keys:
+            return
+        vec = np.asarray([float(metrics[k]) for k in keys], np.float32)
+        out = eager.to_local(eager.allreduce(
+            vec if eager.per_process_mode() else eager.replicated(vec),
+            name=f"metric_avg.{epoch}", op=C.ReduceOp.AVERAGE))
+        for k, v in zip(keys, np.asarray(out).reshape(-1)):
+            metrics[k] = float(v)
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply the base LR by ``multiplier(epoch)`` within [start_epoch,
+    end_epoch) (reference: ``LearningRateScheduleCallback``).  ``state``
+    must expose an ``lr`` attribute consumed by the train step."""
+
+    def __init__(self, initial_lr: float, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True):
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        if callable(multiplier):
+            self.multiplier = multiplier
+        else:
+            self.multiplier = lambda epoch: multiplier
+
+    def _in_range(self, epoch: int) -> bool:
+        return epoch >= self.start_epoch and (
+            self.end_epoch is None or epoch < self.end_epoch)
+
+    def on_epoch_begin(self, epoch: int, state: Any = None):
+        if state is not None and self._in_range(epoch):
+            state.lr = self.initial_lr * self.multiplier(epoch)
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual LR warmup to ``initial_lr * size()`` over ``warmup_epochs``
+    (reference: ``LearningRateWarmupCallback``, implementing the Goyal et
+    al. linear-scaling + warmup recipe).
+
+    ``momentum_correction`` is accepted for reference-API compatibility but
+    has no effect here: it compensates for optimizer-internal momentum
+    buffers when mutating a live torch/TF optimizer, whereas this callback
+    sets ``state.lr`` consumed afresh by the train step.
+    """
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 momentum_correction: bool = True, verbose: int = 0):
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+
+        def multiplier(epoch):
+            size = basics.size() if basics.is_initialized() else 1
+            # epoch+1 so the first epoch already makes progress; the last
+            # warmup epoch lands exactly on size().
+            return 1.0 + (size - 1.0) * (epoch + 1) / max(warmup_epochs, 1)
+
+        # end_epoch bounds the warmup (reference behavior) so composed decay
+        # schedules own the LR afterwards.
+        super().__init__(initial_lr, multiplier, start_epoch=0,
+                         end_epoch=warmup_epochs)
+
+    def on_epoch_begin(self, epoch: int, state: Any = None):
+        super().on_epoch_begin(epoch, state)
+        if self.verbose and state is not None and self._in_range(epoch):
+            print(f"Epoch {epoch}: warmup lr = {state.lr:.6g}")
+
+
+def warmup_scaled_schedule(base_lr: float, steps_per_epoch: int,
+                           warmup_epochs: int = 5,
+                           size: Optional[int] = None):
+    """The same policy as an optax schedule (step-indexed), the idiomatic
+    in-graph form: linear warmup from ``base_lr`` to ``base_lr * size`` over
+    ``warmup_epochs`` epochs, constant after."""
+    import optax
+    n = size if size is not None else (
+        basics.size() if basics.is_initialized() else 1)
+    warmup_steps = max(warmup_epochs * steps_per_epoch, 1)
+    return optax.linear_schedule(base_lr, base_lr * n, warmup_steps)

@@ -1,0 +1,195 @@
+"""Data-loading helpers: rank sharding and async prefetch.
+
+Parity: reference ``horovod/data/data_loader_base.py``
+(``AsyncDataLoaderMixin`` — SURVEY.md §2b P13) plus the shard-per-rank
+pattern every Horovod example implements by hand
+(``DistributedSampler(num_replicas=hvd.size(), rank=hvd.rank())``).
+
+TPU-first additions: ``prefetch_to_device`` overlaps host→HBM transfer with
+compute (the TPU analogue of pinned-memory prefetch), and sharding helpers
+understand the stacked-global-batch convention used by shard_map train
+steps.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, Optional
+
+import jax
+import numpy as np
+
+from ..common import basics
+
+
+class AsyncDataLoaderMixin:
+    """Mix into a loader class to move ``__iter__`` production onto a
+    background thread with a bounded prefetch queue.
+
+    Reference-compatible surface: ``async_loader_queue_size`` (0 disables),
+    ``close_async_loader()``.  Mix first:
+    ``class MyLoader(AsyncDataLoaderMixin, BaseLoader)``.
+    """
+
+    def __init__(self, *args, async_loader_queue_size: int = 64, **kwargs):
+        self.async_loader_queue_size = async_loader_queue_size
+        self._async_queue: Optional[queue.Queue] = None
+        self._async_thread: Optional[threading.Thread] = None
+        self._async_stop = threading.Event()
+        super().__init__(*args, **kwargs)
+
+    def _async_worker(self):
+        try:
+            for item in super().__iter__():
+                if self._async_stop.is_set():
+                    return
+                self._async_queue.put(item)
+        except BaseException as exc:  # noqa: BLE001 - surfaced to consumer
+            self._async_queue.put(_Raise(exc))
+        finally:
+            self._async_queue.put(_SENTINEL)
+
+    def __iter__(self):
+        if self.async_loader_queue_size <= 0:
+            yield from super().__iter__()
+            return
+        self.close_async_loader()
+        self._async_stop.clear()
+        self._async_queue = queue.Queue(maxsize=self.async_loader_queue_size)
+        self._async_thread = threading.Thread(target=self._async_worker,
+                                              daemon=True)
+        self._async_thread.start()
+        while True:
+            item = self._async_queue.get()
+            if item is _SENTINEL:
+                break
+            if isinstance(item, _Raise):
+                raise item.exc
+            yield item
+
+    def close_async_loader(self):
+        """Stop the background producer (reference API)."""
+        if self._async_thread is None:
+            return
+        self._async_stop.set()
+        try:  # unblock a producer stuck on a full queue
+            while True:
+                self._async_queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._async_thread.join(timeout=10)
+        self._async_thread = None
+
+
+class _Raise:
+    def __init__(self, exc):
+        self.exc = exc
+
+
+_SENTINEL = object()
+
+
+def shard_indices(n: int, rank: Optional[int] = None,
+                  size: Optional[int] = None, shuffle: bool = True,
+                  seed: int = 0, drop_remainder: bool = True) -> np.ndarray:
+    """This rank's sample indices — the ``DistributedSampler`` recipe.
+
+    Every rank gets the SAME number of samples (equal per-rank lengths are
+    what keeps per-batch collectives in lockstep): ``drop_remainder=True``
+    truncates to ``n // size`` per rank; ``False`` pads by wrapping around,
+    exactly like ``torch.utils.data.DistributedSampler``.
+    """
+    rank = basics.rank() if rank is None else rank
+    size = basics.size() if size is None else size
+    idx = np.arange(n)
+    if shuffle:
+        np.random.RandomState(seed).shuffle(idx)
+    if drop_remainder:
+        per = n // size
+        return idx[rank * per:(rank + 1) * per]
+    total = -(-n // size) * size  # ceil
+    idx = np.concatenate([idx, idx[:total - n]])
+    return idx[rank:total:size]
+
+
+class ShardedBatchIterator:
+    """Iterate tuples of numpy arrays as per-rank batches.
+
+    In single-controller SPMD mode yields GLOBAL batches of
+    ``batch_size * size()`` rows (feed directly to a shard_map'd step with
+    batch-sharded in_specs); in per-process mode yields this rank's local
+    ``batch_size`` rows.
+    """
+
+    def __init__(self, arrays, batch_size: int, shuffle: bool = True,
+                 seed: int = 0, drop_remainder: bool = True):
+        self.arrays = [np.asarray(a) for a in arrays]
+        n = len(self.arrays[0])
+        assert all(len(a) == n for a in self.arrays)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def _global_batch(self) -> int:
+        world = basics.size() if basics.is_initialized() else 1
+        return self.batch_size * max(world, 1)
+
+    def __iter__(self):
+        from ..ops import eager
+        n = len(self.arrays[0])
+        if basics.is_initialized() and eager.per_process_mode():
+            idx = shard_indices(n, shuffle=self.shuffle,
+                                seed=self.seed + self.epoch,
+                                drop_remainder=self.drop_remainder)
+            bs = self.batch_size
+        else:
+            idx = np.arange(n)
+            if self.shuffle:
+                np.random.RandomState(self.seed + self.epoch).shuffle(idx)
+            bs = self._global_batch()
+        stop = (len(idx) - len(idx) % bs) if self.drop_remainder else len(idx)
+        for i in range(0, stop, bs):
+            sel = idx[i:i + bs]
+            yield tuple(a[sel] for a in self.arrays)
+
+    def __len__(self):
+        from ..ops import eager
+        n = len(self.arrays[0])
+        if basics.is_initialized() and eager.per_process_mode():
+            world = max(basics.size(), 1)
+            return (n // world) // self.batch_size
+        return n // self._global_batch()
+
+
+def prefetch_to_device(iterator: Iterable, size: int = 2,
+                       sharding=None) -> Iterator:
+    """Overlap host→device transfer with compute: keep ``size`` batches in
+    flight as device arrays (``jax.device_put`` is async)."""
+    import collections
+    buf = collections.deque()
+
+    def put(batch):
+        if sharding is not None:
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sharding), batch)
+        return jax.tree_util.tree_map(jax.device_put, batch)
+
+    it = iter(iterator)
+    try:
+        for _ in range(size):
+            buf.append(put(next(it)))
+    except StopIteration:
+        pass
+    while buf:
+        out = buf.popleft()
+        try:
+            buf.append(put(next(it)))
+        except StopIteration:
+            pass
+        yield out

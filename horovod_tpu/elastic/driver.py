@@ -73,6 +73,7 @@ class ElasticDriver:
         # Identities the driver itself terminated (host removed / shrunk):
         # their nonzero exit must not blacklist the host as a failure.
         self._released: set = set()
+        self._out_files: Dict[str, tuple] = {}  # identity -> open log files
         self._success = threading.Event()
         self._first_failure_rc = 0
 
@@ -100,7 +101,20 @@ class ElasticDriver:
         coord_host = ("127.0.0.1" if hosts_in_use[0] in ("localhost",
                                                          "127.0.0.1")
                       else hosts_in_use[0])
-        p1, p2 = _free_ports(2)
+        # The controller binds on host 0, not on the driver: bind-probing is
+        # only meaningful when they are the same machine.  For a remote host
+        # 0 pick from a high range instead (seeded by generation so retries
+        # move on); a collision there surfaces as a worker failure and the
+        # next generation picks different ports.
+        local_coord = coord_host in ("127.0.0.1", "localhost",
+                                     socket.gethostname())
+        if local_coord:
+            p1, p2 = _free_ports(2)
+        else:
+            import random
+            rng = random.Random(self.rendezvous.version + 1)
+            p1 = rng.randrange(20000, 60000)
+            p2 = p1 + 1
         assignments = {}
         for rank, (hn, lr) in enumerate(slots):
             assignments[f"{hn}:{lr}"] = {
@@ -134,8 +148,12 @@ class ElasticDriver:
         if self.output_filename:
             d = os.path.join(self.output_filename, identity.replace(":", "."))
             os.makedirs(d, exist_ok=True)
-            stdout = open(os.path.join(d, "stdout"), "w")
-            stderr = open(os.path.join(d, "stderr"), "w")
+            # Append so respawns across generations extend one log; handles
+            # are tracked and closed when the process is reaped.
+            stdout = open(os.path.join(d, "stdout"), "a")
+            stderr = open(os.path.join(d, "stderr"), "a")
+            self._close_out_files(identity)
+            self._out_files[identity] = (stdout, stderr)
         if hostname in ("localhost", "127.0.0.1", socket.gethostname()):
             proc = subprocess.Popen(self.command, env=env,
                                     stdout=stdout, stderr=stderr)
@@ -200,9 +218,8 @@ class ElasticDriver:
             except RuntimeError as exc:
                 log.warning("elastic driver: discovery failed: %s", exc)
                 discovered = []
-            hosts = self.active_hosts(discovered)
-            if self._new_generation(hosts):
-                self._hosts = hosts
+            self._hosts = discovered  # raw; blacklist applied at use
+            if self._new_generation(self.active_hosts(discovered)):
                 break
             if time.monotonic() > deadline:
                 log.warning("elastic driver: needed min_np=%s slots within "
@@ -220,6 +237,7 @@ class ElasticDriver:
                 if rc is None:
                     continue
                 del self._procs[identity]
+                self._close_out_files(identity)
                 if identity in self._released:
                     self._released.discard(identity)
                     continue
@@ -253,24 +271,33 @@ class ElasticDriver:
                 last_poll = time.monotonic()
                 try:
                     discovered = self.discovery.find_available_hosts_and_slots()
-                    hosts = self.active_hosts(discovered)
-                    if ([(h.hostname, h.slots) for h in hosts]
+                    if ([(h.hostname, h.slots) for h in discovered]
                             != [(h.hostname, h.slots) for h in self._hosts]):
-                        self._hosts = hosts
+                        self._hosts = discovered
                         changed = True
                 except RuntimeError as exc:
                     log.warning("elastic driver: discovery failed: %s", exc)
 
-            # 4. re-form the world if needed
+            # 4. re-form the world if needed.  The blacklist is re-applied
+            # HERE so a failure-triggered regeneration excludes the host
+            # that just failed, not only at discovery-poll boundaries.
             if changed:
-                if not self._new_generation(self._hosts):
+                active = self.active_hosts(self._hosts)
+                if not self._new_generation(active):
                     log.warning(
                         "elastic driver: %s slots < min_np=%s; aborting",
-                        sum(h.slots for h in self._hosts), self.min_np)
+                        sum(h.slots for h in active), self.min_np)
                     self._shutdown_workers()
                     return self._first_failure_rc or 1
 
             time.sleep(0.05)
+
+    def _close_out_files(self, identity: str):
+        for fh in self._out_files.pop(identity, ()):
+            try:
+                fh.close()
+            except OSError:  # pragma: no cover
+                pass
 
     def _shutdown_workers(self):
         for proc in self._procs.values():
@@ -283,6 +310,8 @@ class ElasticDriver:
             if proc.poll() is None:
                 proc.kill()
         self._procs.clear()
+        for identity in list(self._out_files):
+            self._close_out_files(identity)
         self.rendezvous.stop()
 
 

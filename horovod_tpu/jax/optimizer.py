@@ -179,10 +179,9 @@ def broadcast_parameters(params, root_rank: int = 0,
     if jax.process_count() == 1:
         return params
     from ..ops import eager
-    return jax.tree_util.tree_map(
-        lambda p: eager.broadcast(eager.replicated(p, process_set),
-                                  root_rank=root_rank,
-                                  process_set=process_set), params)
+    out = eager.broadcast_pytree(params, root_rank=root_rank,
+                                 process_set=process_set)
+    return jax.tree_util.tree_map(jnp.asarray, out)
 
 
 def broadcast_optimizer_state(opt_state, root_rank: int = 0,

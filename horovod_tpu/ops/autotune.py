@@ -1,0 +1,149 @@
+"""Online autotuning of fusion threshold and cycle time.
+
+Parity: the reference's parameter manager (``horovod/common/
+parameter_manager.cc`` — SURVEY.md §2a N9): warmup discard, scored samples
+(bytes reduced per second), exploration of the (fusion-threshold,
+cycle-time) space, ``HOROVOD_AUTOTUNE`` / ``HOROVOD_AUTOTUNE_LOG`` surface.
+
+TPU-native redesign of the distributed-consistency problem: the reference
+broadcasts every parameter update from the coordinator.  Here the
+exploration *schedule* is a pure function of the work-cycle count — which is
+identical on every rank because negotiated batches are identical — so ranks
+walk the same candidate at the same cycle with no extra messages.  Only the
+FINAL pick depends on per-rank timing, so that one decision is agreed by
+broadcasting rank 0's choice through the engine's own collective path.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+# Log-space multipliers explored around the configured starting point
+# (reference explores fusion 0..64MB and cycle 1..100ms in similar fashion).
+_THRESHOLD_MULTIPLIERS = (0.25, 1.0, 4.0)
+_CYCLE_MULTIPLIERS = (0.2, 1.0, 5.0)
+
+
+class ParameterManager:
+    def __init__(self, engine, warmup_samples: int = 3,
+                 steps_per_sample: int = 10, log_path: str = "",
+                 clock: Optional[Callable[[], float]] = None):
+        self._engine = engine
+        self._warmup_remaining = warmup_samples
+        self._steps_per_sample = steps_per_sample
+        self._log_path = log_path
+        self._clock = clock or time.monotonic
+
+        base_thr = float(engine.fusion_threshold)
+        base_cyc = float(engine.cycle_time_s)
+        self._candidates: List[Tuple[float, float]] = [
+            (max(1024.0, base_thr * tm), max(1e-4, base_cyc * cm))
+            for tm in _THRESHOLD_MULTIPLIERS for cm in _CYCLE_MULTIPLIERS]
+        self._scores: List[float] = []
+        self._sample_idx = -1          # -1 while warming up
+        self._cycles_in_sample = 0
+        self._bytes_in_sample = 0
+        self._sample_start = self._clock()
+        self._finalize_handle: Optional[int] = None
+        self.tuning = True
+        self._log_header_written = False
+
+    # ------------------------------------------------------------ schedule
+    def on_cycle(self, nbytes: int):
+        """Called by the engine after every cycle that processed work."""
+        if not self.tuning or nbytes <= 0:
+            return
+        if self._finalize_handle is not None:
+            self._poll_finalize()
+            return
+        self._cycles_in_sample += 1
+        self._bytes_in_sample += nbytes
+        if self._cycles_in_sample < self._steps_per_sample:
+            return
+
+        elapsed = max(self._clock() - self._sample_start, 1e-9)
+        score = self._bytes_in_sample / elapsed
+        if self._warmup_remaining > 0:
+            self._warmup_remaining -= 1
+        else:
+            if self._sample_idx >= 0:
+                self._scores.append(score)
+                self._log_sample(score)
+            self._sample_idx += 1
+            if self._sample_idx < len(self._candidates):
+                thr, cyc = self._candidates[self._sample_idx]
+                self._engine.fusion_threshold = int(thr)
+                self._engine.cycle_time_s = cyc
+            else:
+                self._begin_finalize()
+        self._cycles_in_sample = 0
+        self._bytes_in_sample = 0
+        self._sample_start = self._clock()
+
+    # ------------------------------------------------------------ finalize
+    def _local_best(self) -> Tuple[float, float]:
+        best = int(np.argmax(self._scores)) if self._scores else 0
+        return self._candidates[best]
+
+    def _begin_finalize(self):
+        """Agree on rank 0's winner via the engine's own broadcast path."""
+        thr, cyc = self._local_best()
+        from . import eager
+        try:
+            value = np.asarray([thr, cyc], np.float64)
+            contrib = (value if eager.per_process_mode()
+                       else eager.replicated(value))
+            self._finalize_handle = eager.broadcast_async(
+                contrib, root_rank=0, name="__autotune.final")
+        except Exception:  # pragma: no cover - never break training
+            self._apply_final(thr, cyc)
+
+    def _poll_finalize(self):
+        from . import eager
+        if not eager.poll(self._finalize_handle):
+            return
+        try:
+            out = np.asarray(eager.to_local(
+                eager.synchronize(self._finalize_handle)))
+            self._apply_final(float(out.reshape(-1)[0]),
+                              float(out.reshape(-1)[1]))
+        except Exception:  # pragma: no cover - never break training
+            thr, cyc = self._local_best()
+            self._apply_final(thr, cyc)
+        finally:
+            self._finalize_handle = None
+
+    def _apply_final(self, thr: float, cyc: float):
+        # The agreement broadcast rides f32 arrays; snap back to the exact
+        # candidate so every rank lands on identical parameters.
+        thr, cyc = min(self._candidates,
+                       key=lambda c: abs(c[0] - thr) / c[0]
+                       + abs(c[1] - cyc) / c[1])
+        self._engine.fusion_threshold = int(thr)
+        self._engine.cycle_time_s = cyc
+        self.tuning = False
+        self._log_line(f"# final: fusion_threshold={int(thr)} "
+                       f"cycle_time_s={cyc:.6f}\n")
+
+    # ------------------------------------------------------------- logging
+    def _log_sample(self, score: float):
+        thr, cyc = self._candidates[self._sample_idx] \
+            if self._sample_idx < len(self._candidates) else self._local_best()
+        if not self._log_header_written:
+            self._log_line("sample,fusion_threshold_bytes,cycle_time_s,"
+                           "score_bytes_per_s\n")
+            self._log_header_written = True
+        self._log_line(f"{self._sample_idx},{int(thr)},{cyc:.6f},"
+                       f"{score:.1f}\n")
+
+    def _log_line(self, line: str):
+        if not self._log_path:
+            return
+        try:
+            with open(self._log_path, "a") as fh:
+                fh.write(line)
+        except OSError:  # pragma: no cover
+            pass

@@ -265,6 +265,25 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
     return synchronize(broadcast_async(tensor, root_rank, name, process_set))
 
 
+def broadcast_pytree(tree, root_rank: int = 0,
+                     process_set: Optional[ProcessSet] = None):
+    """Broadcast every array leaf of a pytree from ``root_rank``; leaves come
+    back as host arrays with their original dtype/shape.
+
+    One async handle per leaf so the engine fuses them into few collectives
+    (reference: ``broadcast_parameters``'s grouped broadcast)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrays = [np.asarray(l) for l in leaves]
+    handles = [broadcast_async(
+        a if per_process_mode() else replicated(a, process_set),
+        root_rank=root_rank, name=f"bcast_pytree.{i}",
+        process_set=process_set)
+        for i, a in enumerate(arrays)]
+    out = [np.asarray(to_local(synchronize(h))) for h in handles]
+    out = [o.astype(a.dtype).reshape(a.shape) for o, a in zip(out, arrays)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None,
                      process_set: Optional[ProcessSet] = None):
     """Pickle-broadcast an arbitrary Python object (reference:

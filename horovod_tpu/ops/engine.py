@@ -231,6 +231,13 @@ class CollectiveEngine:
         self._thread: Optional[threading.Thread] = None
         self._cycle_index = 0
         self.controller = None       # multi-process TCP controller (optional)
+        self.autotuner = None        # reference N9 parameter manager
+        if cfg.autotune:
+            from .autotune import ParameterManager
+            self.autotuner = ParameterManager(
+                self, warmup_samples=cfg.autotune_warmup_samples,
+                steps_per_sample=cfg.autotune_steps_per_sample,
+                log_path=cfg.autotune_log)
 
     # ------------------------------------------------------------- lifecycle
     def start(self):
@@ -327,6 +334,10 @@ class CollectiveEngine:
             self.queue.requeue(not_ready)
         for batch in responses:
             self._perform_operation(batch)
+        if self.autotuner is not None and self.autotuner.tuning:
+            nbytes = sum(e.tensor.nbytes for b in responses for e in b
+                         if e.tensor is not None)
+            self.autotuner.on_cycle(nbytes)
 
     # --------------------------------------------------------- negotiation
     def _compute_response_list(self, entries) -> List[List[TensorTableEntry]]:

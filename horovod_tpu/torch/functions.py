@@ -21,7 +21,9 @@ def broadcast_parameters(params, root_rank: int = 0, process_set=None):
     ``(name, tensor)`` pairs (e.g. ``model.named_parameters()``).
     """
     writeback = None
+    module = None
     if isinstance(params, torch.nn.Module):
+        module = params
         params = params.state_dict()
     if isinstance(params, dict):
         writeback = params
@@ -50,7 +52,13 @@ def broadcast_parameters(params, root_rank: int = 0, process_set=None):
         # makes sense for tensors.
         synced = mpi_ops.broadcast_object(non_tensor, root_rank=root_rank,
                                           process_set=process_set)
-        if writeback is not None:
+        if module is not None:
+            # state_dict() was a fresh copy; push the synced non-tensor
+            # entries back into the live module (tensors already synced
+            # in place through shared storage).
+            writeback.update(synced)
+            module.load_state_dict(writeback)
+        elif writeback is not None:
             writeback.update(synced)
         else:
             raise ValueError(

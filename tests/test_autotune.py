@@ -1,0 +1,122 @@
+"""Autotune (parameter manager) tests — reference test_autotune.py analogue.
+
+Unit tier drives ParameterManager with a fake engine and injected clock;
+the integration tier runs a real HOROVOD_AUTOTUNE=1 engine over many eager
+allreduces and asserts tuning converges and collectives stay correct.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.autotune import ParameterManager
+
+
+class FakeEngine:
+    def __init__(self):
+        self.fusion_threshold = 64 * 1024 * 1024
+        self.cycle_time_s = 0.001
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _drive_sample(pm, clock, nbytes, dt):
+    """One full sample window: steps_per_sample work cycles of dt seconds."""
+    for _ in range(pm._steps_per_sample):
+        clock.t += dt
+        pm.on_cycle(nbytes)
+
+
+def test_parameter_manager_explores_and_picks_best(tmp_path, monkeypatch):
+    eng = FakeEngine()
+    clock = FakeClock()
+    log = tmp_path / "autotune.csv"
+    pm = ParameterManager(eng, warmup_samples=1, steps_per_sample=4,
+                          log_path=str(log), clock=clock)
+    base_thr = eng.fusion_threshold
+
+    # Warmup + schedule-advance sample: params unchanged.
+    _drive_sample(pm, clock, 1000, 0.01)
+    assert eng.fusion_threshold == base_thr
+    _drive_sample(pm, clock, 1000, 0.01)
+    first = (eng.fusion_threshold, eng.cycle_time_s)
+    assert first == (int(pm._candidates[0][0]), pm._candidates[0][1])
+
+    # Run every candidate; make candidate index 4 (the 1.0x/1.0x point)
+    # fastest by giving it the shortest cycle latency.
+    final_broadcasts = []
+    monkeypatch.setattr(pm, "_begin_finalize",
+                        lambda: final_broadcasts.append(pm._local_best()) or
+                        pm._apply_final(*pm._local_best()))
+    for i in range(len(pm._candidates)):
+        dt = 0.001 if i == 4 else 0.05
+        _drive_sample(pm, clock, 1000, dt)
+
+    assert not pm.tuning
+    assert final_broadcasts == [pm._candidates[4]]
+    assert eng.fusion_threshold == int(pm._candidates[4][0])
+    assert eng.cycle_time_s == pm._candidates[4][1]
+
+    text = log.read_text()
+    assert text.startswith("sample,fusion_threshold_bytes")
+    assert "# final:" in text
+    # One scored line per candidate.
+    assert len([l for l in text.splitlines()
+                if l and not l.startswith(("#", "sample"))]) == \
+        len(pm._candidates)
+
+
+def test_parameter_manager_ignores_idle_cycles():
+    eng = FakeEngine()
+    clock = FakeClock()
+    pm = ParameterManager(eng, warmup_samples=0, steps_per_sample=2,
+                          clock=clock)
+    for _ in range(100):
+        pm.on_cycle(0)  # idle cycles must not advance the schedule
+    assert pm._cycles_in_sample == 0
+    assert pm._sample_idx == -1
+
+
+def test_autotune_end_to_end(monkeypatch):
+    """Real engine under HOROVOD_AUTOTUNE=1: tuning completes (including the
+    rank-0 agreement broadcast through the engine itself) and results stay
+    correct throughout."""
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+
+    basics.shutdown()
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "2")
+    try:
+        hvd.init()
+        eng = basics._get_state().engine
+        assert eng.autotuner is not None
+        x = hvd.replicated(np.ones(128, np.float32))
+        n_needed = (1 + 1 + len(eng.autotuner._candidates) + 3) * 2 + 8
+        for i in range(n_needed):
+            out = hvd.to_local(hvd.allreduce(x, name=f"tune.{i}", op=hvd.Sum))
+            np.testing.assert_allclose(out, np.full(128, 8.0))
+            if not eng.autotuner.tuning:
+                break
+        assert not eng.autotuner.tuning, (
+            eng.autotuner._sample_idx, len(eng.autotuner._scores))
+        # Tuned params are one of the candidates (rank 0's pick).
+        assert (eng.fusion_threshold, eng.cycle_time_s) in [
+            (int(t), c) for t, c in eng.autotuner._candidates]
+        # Collectives still correct after tuning.
+        out = hvd.to_local(hvd.allreduce(x, name="after", op=hvd.Sum))
+        np.testing.assert_allclose(out, np.full(128, 8.0))
+    finally:
+        basics.shutdown()
+        monkeypatch.delenv("HOROVOD_AUTOTUNE")
+        monkeypatch.delenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES")
+        monkeypatch.delenv("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE")
+        hvd.init()

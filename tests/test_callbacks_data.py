@@ -1,0 +1,161 @@
+"""Callbacks (reference P5) and data helpers (reference P13) tests."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd_mod
+from horovod_tpu import callbacks as cb
+from horovod_tpu.data import (
+    AsyncDataLoaderMixin, ShardedBatchIterator, prefetch_to_device,
+    shard_indices)
+
+
+class _State:
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+# ---------------------------------------------------------------- callbacks
+def test_broadcast_global_variables_callback(hvd):
+    params = {"w": np.ones((3, 2), np.float32), "b": np.zeros(2, np.float32)}
+    state = _State(params=params, opt_state=None)
+    cb.BroadcastGlobalVariablesCallback(0).on_train_begin(state)
+    np.testing.assert_allclose(state.params["w"], params["w"])
+    np.testing.assert_allclose(state.params["b"], params["b"])
+
+
+def test_broadcast_pytree_nested(hvd):
+    tree = {"layer": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)},
+            "step": np.asarray(3, np.int32)}
+    out = cb.broadcast_pytree(tree)
+    np.testing.assert_allclose(out["layer"]["w"], tree["layer"]["w"])
+    assert out["step"] == 3
+    assert out["step"].dtype == np.int32
+
+
+def test_metric_average_callback(hvd):
+    metrics = {"loss": 2.0, "acc": 0.5, "name": "skip-me"}
+    cb.MetricAverageCallback().on_epoch_end(0, metrics=metrics)
+    # Identical contributions -> averages unchanged; strings untouched.
+    assert metrics["loss"] == pytest.approx(2.0)
+    assert metrics["acc"] == pytest.approx(0.5)
+    assert metrics["name"] == "skip-me"
+
+
+def test_lr_warmup_callback(hvd):
+    state = _State(lr=0.0)
+    warm = cb.LearningRateWarmupCallback(initial_lr=0.1, warmup_epochs=4)
+    size = hvd_mod.size()
+    warm.on_epoch_begin(0, state)
+    first = state.lr
+    assert first == pytest.approx(0.1 * (1 + (size - 1) * 1 / 4))
+    warm.on_epoch_begin(3, state)  # last warmup epoch lands on size()
+    assert state.lr == pytest.approx(0.1 * size)
+    # After warmup the callback must NOT touch lr (composability with decay
+    # schedules — reference uses end_epoch=warmup_epochs).
+    state.lr = 123.0
+    warm.on_epoch_begin(10, state)
+    assert state.lr == 123.0
+
+
+def test_lr_schedule_callback(hvd):
+    state = _State(lr=1.0)
+    sched = cb.LearningRateScheduleCallback(
+        initial_lr=1.0, multiplier=lambda e: 0.1 ** e, start_epoch=1,
+        end_epoch=3)
+    sched.on_epoch_begin(0, state)
+    assert state.lr == 1.0  # before start_epoch
+    sched.on_epoch_begin(1, state)
+    assert state.lr == pytest.approx(0.1)
+    sched.on_epoch_begin(3, state)
+    assert state.lr == pytest.approx(0.1)  # after end_epoch: unchanged
+
+
+def test_warmup_scaled_schedule(hvd):
+    sched = cb.warmup_scaled_schedule(0.1, steps_per_epoch=10,
+                                      warmup_epochs=2)
+    size = hvd_mod.size()
+    assert float(sched(0)) == pytest.approx(0.1)
+    assert float(sched(20)) == pytest.approx(0.1 * size)
+    assert float(sched(10)) == pytest.approx(0.1 * (1 + size) / 2)
+
+
+# -------------------------------------------------------------------- data
+class _ListLoader:
+    def __init__(self, items):
+        self.items = items
+
+    def __iter__(self):
+        yield from self.items
+
+
+class _AsyncListLoader(AsyncDataLoaderMixin, _ListLoader):
+    pass
+
+
+def test_async_data_loader_mixin():
+    loader = _AsyncListLoader(list(range(100)), async_loader_queue_size=8)
+    assert list(loader) == list(range(100))
+    assert list(loader) == list(range(100))  # re-iterable
+    loader.close_async_loader()
+
+
+def test_async_data_loader_disabled():
+    loader = _AsyncListLoader([1, 2, 3], async_loader_queue_size=0)
+    assert list(loader) == [1, 2, 3]
+
+
+def test_async_data_loader_propagates_errors():
+    class Bad:
+        def __iter__(self):
+            yield 1
+            raise ValueError("boom")
+
+    class AsyncBad(AsyncDataLoaderMixin, Bad):
+        pass
+
+    with pytest.raises(ValueError, match="boom"):
+        list(AsyncBad(async_loader_queue_size=4))
+
+
+def test_shard_indices_partition():
+    parts = [shard_indices(103, rank=r, size=4, shuffle=True, seed=1,
+                           drop_remainder=True) for r in range(4)]
+    flat = np.concatenate(parts)
+    assert len(flat) == 25 * 4
+    assert len(set(flat.tolist())) == 100  # disjoint
+    # Without drop_remainder: equal per-rank lengths (pad by wrapping, the
+    # DistributedSampler contract) and full coverage.
+    parts = [shard_indices(103, rank=r, size=4, shuffle=False,
+                           drop_remainder=False) for r in range(4)]
+    assert all(len(p) == 26 for p in parts)
+    assert set(np.concatenate(parts).tolist()) == set(range(103))
+
+
+def test_sharded_batch_iterator_single_controller(hvd):
+    x = np.arange(64, dtype=np.float32)
+    y = x * 2
+    it = ShardedBatchIterator([x, y], batch_size=2, shuffle=False)
+    batches = list(it)
+    # Single-controller: global batches of batch_size * size().
+    assert len(batches) == len(it) == 64 // (2 * hvd_mod.size())
+    bx, by = batches[0]
+    assert bx.shape == (2 * hvd_mod.size(),)
+    np.testing.assert_allclose(by, bx * 2)
+    # Epoch changes reshuffle deterministically.
+    it2 = ShardedBatchIterator([x, y], batch_size=2, shuffle=True, seed=3)
+    it2.set_epoch(0)
+    a = [b[0] for b in it2]
+    it2.set_epoch(1)
+    b = [b[0] for b in it2]
+    assert not all(np.array_equal(p, q) for p, q in zip(a, b))
+
+
+def test_prefetch_to_device(hvd):
+    import jax
+    batches = [(np.full((2,), i, np.float32),) for i in range(10)]
+    out = list(prefetch_to_device(iter(batches), size=3))
+    assert len(out) == 10
+    assert all(isinstance(b[0], jax.Array) for b in out)
+    np.testing.assert_allclose(np.asarray(out[7][0]), 7.0)
