@@ -50,4 +50,5 @@ from .jax.optimizer import (  # noqa: F401
 from .jax.compression import Compression  # noqa: F401
 from . import elastic  # noqa: F401
 from . import callbacks  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import data  # noqa: F401

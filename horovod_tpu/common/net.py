@@ -1,0 +1,43 @@
+"""Shared port-selection helpers for launchers/executors.
+
+Two distinct problems, two helpers:
+
+- ``free_ports(n)``: ports free on THIS machine (bind-probed, SO_REUSEADDR,
+  all probes held open so one call can't return duplicates).  Only valid
+  when the service will bind on this same machine.
+- ``remote_ports(n, seed)``: ports for a service that binds on a DIFFERENT
+  host, where bind-probing here proves nothing.  Picks from a high range,
+  deterministically from ``seed`` so (a) every participant that knows the
+  seed computes the same ports with no extra messages and (b) a retry with
+  a new seed moves to fresh ports after a collision.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+from typing import List
+
+
+def free_ports(n: int) -> List[int]:
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def remote_ports(n: int, seed: int) -> List[int]:
+    rng = random.Random(seed)
+    base = rng.randrange(20000, 60000 - n)
+    return [base + i for i in range(n)]
+
+
+def is_local_host(hostname: str) -> bool:
+    return hostname in ("localhost", "127.0.0.1", socket.gethostname())

@@ -39,26 +39,30 @@ class AsyncDataLoaderMixin:
         self._async_stop = threading.Event()
         super().__init__(*args, **kwargs)
 
-    def _async_worker(self):
+    def _async_worker(self, q: queue.Queue, stop: threading.Event):
+        # q/stop are THIS iteration's, passed by value: a producer that
+        # outlives close_async_loader's join can only ever touch its own
+        # (abandoned) queue, never a newer iteration's.
         try:
             for item in super().__iter__():
-                if self._async_stop.is_set():
+                if stop.is_set():
                     return
-                self._async_queue.put(item)
+                q.put(item)
         except BaseException as exc:  # noqa: BLE001 - surfaced to consumer
-            self._async_queue.put(_Raise(exc))
+            q.put(_Raise(exc))
         finally:
-            self._async_queue.put(_SENTINEL)
+            q.put(_SENTINEL)
 
     def __iter__(self):
         if self.async_loader_queue_size <= 0:
             yield from super().__iter__()
             return
         self.close_async_loader()
-        self._async_stop.clear()
+        self._async_stop = threading.Event()
         self._async_queue = queue.Queue(maxsize=self.async_loader_queue_size)
-        self._async_thread = threading.Thread(target=self._async_worker,
-                                              daemon=True)
+        self._async_thread = threading.Thread(
+            target=self._async_worker,
+            args=(self._async_queue, self._async_stop), daemon=True)
         self._async_thread.start()
         while True:
             item = self._async_queue.get()
@@ -120,6 +124,11 @@ class ShardedBatchIterator:
     ``batch_size * size()`` rows (feed directly to a shard_map'd step with
     batch-sharded in_specs); in per-process mode yields this rank's local
     ``batch_size`` rows.
+
+    ``drop_remainder=False`` keeps the tail as a short final batch — fine
+    for per-process loops and plain jit, but a shard_map'd step with
+    batch-sharded in_specs needs full ``batch_size * size()`` batches:
+    keep the default ``drop_remainder=True`` there.
     """
 
     def __init__(self, arrays, batch_size: int, shuffle: bool = True,
@@ -158,13 +167,19 @@ class ShardedBatchIterator:
             sel = idx[i:i + bs]
             yield tuple(a[sel] for a in self.arrays)
 
-    def __len__(self):
+    def _shard_len(self) -> tuple:
+        """(per-shard sample count, batch size) exactly as __iter__ uses."""
         from ..ops import eager
         n = len(self.arrays[0])
         if basics.is_initialized() and eager.per_process_mode():
             world = max(basics.size(), 1)
-            return (n // world) // self.batch_size
-        return n // self._global_batch()
+            shard = n // world if self.drop_remainder else -(-n // world)
+            return shard, self.batch_size
+        return n, self._global_batch()
+
+    def __len__(self):
+        shard, bs = self._shard_len()
+        return shard // bs if self.drop_remainder else -(-shard // bs)
 
 
 def prefetch_to_device(iterator: Iterable, size: int = 2,

@@ -31,18 +31,8 @@ from ..utils.logging import get_logger
 log = get_logger()
 
 
-def _free_ports(n: int) -> List[int]:
-    socks, ports = [], []
-    try:
-        for _ in range(n):
-            s = socket.socket()
-            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            s.bind(("", 0))
-            socks.append(s)
-        return [s.getsockname()[1] for s in socks]
-    finally:
-        for s in socks:
-            s.close()
+from ..common.net import free_ports as _free_ports  # noqa: E402
+from ..common.net import is_local_host, remote_ports  # noqa: E402
 
 
 class ElasticDriver:
@@ -106,15 +96,10 @@ class ElasticDriver:
         # 0 pick from a high range instead (seeded by generation so retries
         # move on); a collision there surfaces as a worker failure and the
         # next generation picks different ports.
-        local_coord = coord_host in ("127.0.0.1", "localhost",
-                                     socket.gethostname())
-        if local_coord:
+        if is_local_host(coord_host):
             p1, p2 = _free_ports(2)
         else:
-            import random
-            rng = random.Random(self.rendezvous.version + 1)
-            p1 = rng.randrange(20000, 60000)
-            p2 = p1 + 1
+            p1, p2 = remote_ports(2, self.rendezvous.version + 1)
         assignments = {}
         for rank, (hn, lr) in enumerate(slots):
             assignments[f"{hn}:{lr}"] = {

@@ -164,20 +164,8 @@ def placement(args) -> List[HostSpec]:
 
 
 def _free_ports(n: int) -> List[int]:
-    """Allocate ``n`` distinct free ports, holding all probe sockets open
-    until every port is chosen so the kernel can't hand the same port out
-    twice within one call."""
-    socks = []
-    try:
-        for _ in range(n):
-            s = socket.socket()
-            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            s.bind(("", 0))
-            socks.append(s)
-        return [s.getsockname()[1] for s in socks]
-    finally:
-        for s in socks:
-            s.close()
+    from ..common.net import free_ports
+    return free_ports(n)
 
 
 def worker_envs(args, hosts: List[HostSpec],

@@ -1,0 +1,113 @@
+"""Storage abstraction for Spark-style estimator workflows.
+
+Parity: reference ``horovod/spark/common/store.py`` (SURVEY.md §2b P11):
+a ``Store`` maps a run id to train-data / validation-data / checkpoint /
+logs locations, with ``LocalStore`` for filesystems and a factory that
+dispatches on the URL scheme.  Object-store backends (HDFS/S3/GCS/ABFS)
+require their client libraries and raise a clear error when absent — on
+TPU VMs the natural production store is GCS.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+
+class Store:
+    def get_train_data_path(self, idx=None) -> str:
+        raise NotImplementedError
+
+    def get_val_data_path(self, idx=None) -> str:
+        raise NotImplementedError
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write(self, path: str, data: bytes):
+        raise NotImplementedError
+
+    @staticmethod
+    def create(prefix_path: str, *args, **kwargs) -> "Store":
+        """Factory dispatching on scheme (reference: ``Store.create``)."""
+        if prefix_path.startswith(("gs://", "gcs://")):
+            return GCSStore(prefix_path, *args, **kwargs)
+        if prefix_path.startswith(("hdfs://", "s3://", "s3a://", "abfs://",
+                                   "abfss://")):
+            raise NotImplementedError(
+                f"Store scheme of {prefix_path!r} requires its client "
+                f"library (not in the TPU image); use a local path or "
+                f"gs:// with google-cloud-storage installed")
+        return LocalStore(prefix_path, *args, **kwargs)
+
+
+class LocalStore(Store):
+    """Filesystem store (reference: ``LocalStore``)."""
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = prefix_path.rstrip("/")
+        os.makedirs(self.prefix_path, exist_ok=True)
+
+    def _join(self, *parts) -> str:
+        path = os.path.join(self.prefix_path, *parts)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        return path
+
+    def get_train_data_path(self, idx=None) -> str:
+        suffix = f".{idx}" if idx is not None else ""
+        return self._join("intermediate_train_data" + suffix)
+
+    def get_val_data_path(self, idx=None) -> str:
+        suffix = f".{idx}" if idx is not None else ""
+        return self._join("intermediate_val_data" + suffix)
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return self._join(run_id, "checkpoint")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return self._join(run_id, "logs")
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as fh:
+            return fh.read()
+
+    def write(self, path: str, data: bytes):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+
+    def delete(self, path: str):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.unlink(path)
+
+
+class GCSStore(LocalStore):
+    """GCS-backed store; requires ``google-cloud-storage``."""
+
+    def __init__(self, prefix_path: str):  # pragma: no cover - no GCS here
+        try:
+            from google.cloud import storage  # noqa: F401
+        except ImportError as exc:
+            raise ImportError(
+                "GCSStore requires google-cloud-storage, which is not "
+                "installed in this environment") from exc
+        raise NotImplementedError(
+            "GCSStore: install google-cloud-storage and mount credentials; "
+            "the TPU image used for tests has no network egress")

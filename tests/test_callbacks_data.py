@@ -159,3 +159,13 @@ def test_prefetch_to_device(hvd):
     assert len(out) == 10
     assert all(isinstance(b[0], jax.Array) for b in out)
     np.testing.assert_allclose(np.asarray(out[7][0]), 7.0)
+
+
+def test_sharded_batch_iterator_len_matches_iter_tail(hvd):
+    x = np.arange(10, dtype=np.float32)
+    # drop_remainder=False: short final batch, len() counts it.
+    it = ShardedBatchIterator([x], batch_size=3, shuffle=False,
+                              drop_remainder=False)
+    batches = list(it)
+    assert len(batches) == len(it)
+    assert sum(len(b[0]) for b in batches) == 10

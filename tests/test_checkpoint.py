@@ -1,0 +1,89 @@
+"""Sharded checkpointing tests (SURVEY.md §5 checkpoint/resume, promoted to
+first-class)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu import checkpoint as ckpt
+
+
+def _tree():
+    import jax.numpy as jnp
+    return {"params": {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+                       "b": jnp.ones(4, jnp.float32)},
+            "step": jnp.asarray(7, jnp.int32)}
+
+
+def test_save_restore_roundtrip(tmp_path, hvd):
+    tree = _tree()
+    ckpt.save(str(tmp_path), tree, step=5)
+    assert ckpt.latest_step(str(tmp_path)) == 5
+    out = ckpt.restore(str(tmp_path), template=tree)
+    np.testing.assert_allclose(np.asarray(out["params"]["w"]),
+                               np.asarray(tree["params"]["w"]))
+    assert int(out["step"]) == 7
+
+
+def test_restore_without_template(tmp_path, hvd):
+    tree = _tree()
+    ckpt.save(str(tmp_path), tree, step=0)
+    out = ckpt.restore(str(tmp_path))
+    np.testing.assert_allclose(np.asarray(out["params"]["b"]), 1.0)
+
+
+def test_restore_sharded_onto_mesh(tmp_path, hvd):
+    """Save a replicated tree, restore it SHARDED over the 8-device mesh —
+    the elastic-resume reshard path."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd_mod
+    mesh = hvd_mod.mesh()
+    x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+    ckpt.save(str(tmp_path), {"x": x}, step=1)
+
+    sharded = NamedSharding(mesh, P("hvd"))
+    template = {"x": jax.ShapeDtypeStruct((8, 8), jnp.float32,
+                                          sharding=sharded)}
+    out = ckpt.restore(str(tmp_path), template=template)
+    assert out["x"].sharding == sharded
+    np.testing.assert_allclose(np.asarray(out["x"]), np.asarray(x))
+
+
+def test_missing_checkpoint(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore(str(tmp_path / "nope"))
+    assert ckpt.latest_step(str(tmp_path / "nope")) is None
+
+
+def test_manager_policy_and_gc(tmp_path, hvd):
+    mgr = ckpt.CheckpointManager(str(tmp_path), max_to_keep=2,
+                                 save_interval_steps=10)
+    tree = _tree()
+    assert not mgr.save(5, tree)          # off-interval
+    assert mgr.save(10, tree)
+    assert mgr.save(20, tree)
+    assert mgr.save(30, tree)
+    assert mgr.all_steps() == [20, 30]    # GC keeps last 2
+    assert mgr.latest_step() == 30
+    out = mgr.restore(template=tree)
+    assert int(out["step"]) == 7
+    assert mgr.save(31, tree, force=True)
+
+
+def test_elastic_state_durable_commit(tmp_path, hvd):
+    from horovod_tpu.elastic import JaxState
+    import jax.numpy as jnp
+
+    state = JaxState(params={"w": jnp.ones(4)}, epoch=3)
+    ckpt.save_state(state, str(tmp_path), step=3)
+
+    fresh = JaxState(params={"w": jnp.zeros(4)}, epoch=0)
+    ckpt.restore_state(fresh, str(tmp_path))
+    np.testing.assert_allclose(np.asarray(fresh.params["w"]), 1.0)
+    assert int(fresh.epoch) == 3
+    # The restore also rewrote the committed backup.
+    fresh.params = {"w": jnp.full(4, 9.0)}
+    fresh.restore()
+    np.testing.assert_allclose(np.asarray(fresh.params["w"]), 1.0)

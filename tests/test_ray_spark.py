@@ -1,0 +1,123 @@
+"""Ray/Spark integration tests — the parts runnable without ray/pyspark
+(the reference tests placement and store logic the same way: pure logic
+with no cluster, SURVEY.md §4 test_ray.py/test_spark.py)."""
+
+import os
+
+import pytest
+
+from horovod_tpu.ray import NodeResources, RayExecutor, pack, spread
+from horovod_tpu.spark import LocalStore, Store
+
+
+NODES = [NodeResources("a", cpus=8, accelerators=4),
+         NodeResources("b", cpus=8, accelerators=4),
+         NodeResources("c", cpus=8, accelerators=2)]
+
+
+def test_pack_fills_nodes_in_order():
+    allocs = pack(NODES, 6)
+    assert [(a.hostname, a.local_rank, a.rank) for a in allocs] == [
+        ("a", 0, 0), ("a", 1, 1), ("a", 2, 2), ("a", 3, 3),
+        ("b", 0, 4), ("b", 1, 5)]
+    assert allocs[4].cross_rank == 1
+
+
+def test_spread_round_robins():
+    allocs = spread(NODES, 6)
+    by_host = {}
+    for a in allocs:
+        by_host.setdefault(a.hostname, 0)
+        by_host[a.hostname] += 1
+    assert by_host == {"a": 2, "b": 2, "c": 2}
+    # Ranks grouped per host, host order preserved.
+    assert [a.hostname for a in allocs] == ["a", "a", "b", "b", "c", "c"]
+
+
+def test_spread_uneven_capacity():
+    allocs = spread(NODES, 9)
+    by_host = {}
+    for a in allocs:
+        by_host[a.hostname] = by_host.get(a.hostname, 0) + 1
+    assert by_host == {"a": 4, "b": 3, "c": 2}
+
+
+def test_placement_capacity_errors():
+    with pytest.raises(ValueError):
+        pack(NODES, 11)
+    with pytest.raises(ValueError):
+        spread(NODES, 11)
+    assert len(pack(NODES, 10)) == 10
+
+
+def test_ray_executor_env_construction():
+    ex = RayExecutor(num_workers=6, placement="pack")
+    allocs = ex.compute_placement(NODES)
+    env = ex.worker_env(allocs[4], ("a", 1111, 2222))
+    assert env["HOROVOD_RANK"] == "4"
+    assert env["HOROVOD_SIZE"] == "6"
+    assert env["HOROVOD_LOCAL_RANK"] == "0"
+    assert env["HOROVOD_LOCAL_SIZE"] == "2"
+    assert env["HOROVOD_CROSS_RANK"] == "1"
+    assert env["HOROVOD_CROSS_SIZE"] == "2"
+    assert env["HOROVOD_CONTROLLER_ADDR"] == "a"
+    assert env["HOROVOD_HOSTNAME"] == "b"
+
+
+def test_ray_executor_requires_ray_to_start():
+    ex = RayExecutor(num_workers=2)
+    with pytest.raises(ImportError, match="ray"):
+        ex.start()
+    ex.shutdown()  # no-op without workers
+
+
+def test_spark_run_requires_pyspark():
+    import horovod_tpu.spark as hvd_spark
+    with pytest.raises(ImportError, match="pyspark"):
+        hvd_spark.run(lambda: None, num_proc=2)
+
+
+def test_local_store(tmp_path):
+    store = Store.create(str(tmp_path / "store"))
+    assert isinstance(store, LocalStore)
+    ckpt = store.get_checkpoint_path("run1")
+    assert "run1" in ckpt
+    store.write(os.path.join(ckpt, "model.bin"), b"\x00\x01")
+    assert store.exists(os.path.join(ckpt, "model.bin"))
+    assert store.read(os.path.join(ckpt, "model.bin")) == b"\x00\x01"
+    assert store.get_train_data_path(3).endswith("intermediate_train_data.3")
+    assert store.get_logs_path("run1") != ckpt
+    store.delete(ckpt)
+    assert not store.exists(ckpt)
+
+
+def test_store_unknown_scheme():
+    with pytest.raises(NotImplementedError):
+        Store.create("hdfs://namenode/path")
+
+
+def test_spark_task_env_consistency():
+    """Every task computes a consistent world from the same gang view."""
+    from horovod_tpu.spark import _task_env
+    addresses = ["nodeA:1001", "nodeA:1002", "nodeB:1003"]
+    envs = [_task_env(i, addresses, port_seed=42, extra_env={"X": 1})
+            for i in range(3)]
+    assert [e["HOROVOD_RANK"] for e in envs] == ["0", "1", "2"]
+    assert all(e["HOROVOD_SIZE"] == "3" for e in envs)
+    assert [e["HOROVOD_LOCAL_RANK"] for e in envs] == ["0", "1", "0"]
+    assert [e["HOROVOD_LOCAL_SIZE"] for e in envs] == ["2", "2", "1"]
+    assert [e["HOROVOD_CROSS_RANK"] for e in envs] == ["0", "0", "1"]
+    assert all(e["HOROVOD_CONTROLLER_ADDR"] == "nodeA" for e in envs)
+    # Same seed -> same ports on every task; consecutive pair.
+    ports = {(e["HOROVOD_CONTROLLER_PORT"], e["HOROVOD_CONTROLLER_PORT2"])
+             for e in envs}
+    assert len(ports) == 1
+    assert all(e["X"] == "1" for e in envs)
+
+
+def test_remote_ports_deterministic():
+    from horovod_tpu.common.net import remote_ports
+    assert remote_ports(2, 7) == remote_ports(2, 7)
+    assert remote_ports(2, 7) != remote_ports(2, 8)
+    p = remote_ports(3, 123)
+    assert all(20000 <= x < 60000 for x in p)
