@@ -1,18 +1,29 @@
 """Benchmark entry point: prints ONE JSON line for the driver.
 
-Metric: ResNet-50 synthetic training throughput (images/sec/chip), the
-canonical Horovod benchmark (reference:
-``examples/pytorch/pytorch_synthetic_benchmark.py``, numbers in
-``docs/benchmarks.rst`` — see BASELINE.md).
+What it measures (reference: ``docs/benchmarks.rst`` +
+``examples/pytorch/pytorch_synthetic_benchmark.py``; targets in BASELINE.md):
 
-``vs_baseline`` compares against 219 images/sec — the per-GPU ResNet-50
-throughput on the Pascal P100 hardware Horovod's published 90%-scaling
-results were measured on (docs/benchmarks.rst-era TF benchmark; see
-BASELINE.md provenance caveat: the mounted reference was empty, so this is
-the upstream-published figure).
+1. **Allreduce bus-bandwidth (GB/s)** — the north-star metric from
+   BASELINE.json — swept over message sizes, through BOTH data planes:
+   - the eager engine path (``hvd.allreduce`` → background coordinator →
+     fused jitted XLA program), i.e. the framework's own hot path, and
+   - the in-graph ``lax.psum`` path (what a jitted train step executes).
+   bus-bw = 2*(n-1)/n * bytes / t (ring-allreduce wire traffic per rank).
+2. **ResNet-50 synthetic training through the framework**: ``hvd.init()`` +
+   ``hvd.DistributedOptimizer`` (gradient averaging over the ``hvd`` mesh
+   axis) + cross-replica SyncBatchNorm, shard_map'ped over the world mesh —
+   NOT a raw-XLA step.  Reports images/sec/chip and **MFU** (from XLA's own
+   cost analysis and the chip's peak bf16 FLOPs).
+3. **Framework overhead**: the same model/batch through a raw XLA step
+   (no hvd anywhere) — overhead_pct shows what the framework costs.
 
-Env overrides: HVD_BENCH_BATCH, HVD_BENCH_STEPS, HVD_BENCH_IMAGE (size),
-HVD_BENCH_MODEL=resnet50|llama.
+``vs_baseline`` compares framework-path img/s against 219 images/sec — the
+per-GPU ResNet-50 throughput on the P100 hardware Horovod's published
+90%-scaling results used (see BASELINE.md provenance caveat).
+
+Env overrides: HVD_BENCH_BATCH, HVD_BENCH_STEPS, HVD_BENCH_IMAGE,
+HVD_BENCH_SIZES_MB (comma list), HVD_BENCH_MODEL=resnet50|llama,
+HVD_BENCH_SKIP_RAW=1, HVD_BENCH_SKIP_BUSBW=1.
 """
 
 from __future__ import annotations
@@ -24,28 +35,135 @@ import time
 
 HOROVOD_P100_RESNET50_IMG_PER_SEC = 219.0
 
+# Peak dense bf16 FLOP/s per chip, by device_kind substring (public specs).
+_PEAK_BF16 = [
+    ("v6", 918e12),        # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),   # v5e reports device_kind "TPU v5 lite"
+    ("v5e", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
 
-def bench_resnet(batch: int, steps: int, image_size: int):
+
+def _on_tpu():
+    import jax
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def _peak_flops():
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in _PEAK_BF16:
+        if key in kind:
+            return peak
+    return None
+
+
+def bench_busbw(sizes_mb, iters=10):
+    """Allreduce bus-bandwidth sweep over both data planes."""
+    import jax
+    import numpy as np
+    from jax import lax, shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import horovod_tpu as hvd
+
+    n = hvd.size()
+    m = hvd.mesh()
+    factor = 2.0 * (n - 1) / n if n > 1 else 1.0  # n=1: report algo bw
+    out = {"engine": {}, "psum": {}, "world": n,
+           "formula": "2(n-1)/n*bytes/t" if n > 1 else "bytes/t (n=1)"}
+
+    multi_proc = jax.process_count() > 1
+    n_local = len([d for d in m.devices.flat
+                   if d.process_index == jax.process_index()])
+    for mb in sizes_mb:
+        elems = int(mb * (1 << 20)) // 4
+        if multi_proc:
+            # Per-process mode: eager ops take this rank's LOCAL
+            # contribution — [local_size, elems] for multi-device processes.
+            x = np.ones((n_local, elems) if n_local > 1 else (elems,),
+                        np.float32)
+        else:
+            x = jax.device_put(np.ones((n, elems), np.float32),
+                               NamedSharding(m, P("hvd")))
+
+        # Eager engine path: enqueue -> negotiate -> fused program (cached).
+        for _ in range(3):
+            r = hvd.allreduce(x, name="busbw_warm", op=hvd.Sum)
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = hvd.allreduce(x, name="busbw", op=hvd.Sum)
+        jax.block_until_ready(r)
+        dt = (time.perf_counter() - t0) / iters
+        out["engine"][f"{mb}MB"] = round(factor * mb * (1 << 20) / dt / 1e9, 3)
+
+        # In-graph psum path (what a jitted train step runs).
+        def body(s):
+            return lax.psum(s.reshape(s.shape[1:]), "hvd")
+
+        f = jax.jit(shard_map(body, mesh=m, in_specs=P("hvd"),
+                              out_specs=P(), check_vma=False))
+        if multi_proc:
+            x = hvd.to_global(x)
+        y = f(x)
+        jax.block_until_ready(y)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            y = f(x)
+        jax.block_until_ready(y)
+        dt = (time.perf_counter() - t0) / iters
+        out["psum"][f"{mb}MB"] = round(factor * mb * (1 << 20) / dt / 1e9, 3)
+    return out
+
+
+def _resnet_pieces(batch, image_size, framework: bool):
+    """Build (step_fn, state, data) for the framework or raw-XLA path."""
     import jax
     import jax.numpy as jnp
     import optax
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
     from horovod_tpu.models import resnet
+    import horovod_tpu as hvd
 
-    on_tpu = any(d.platform != "cpu" for d in jax.devices())
-    cfg = resnet.ResNetConfig(
-        depth=50, num_classes=1000,
-        compute_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-        sync_bn_axis=None)
-    params, stats = resnet.init_params(cfg, jax.random.PRNGKey(0))
-    opt = optax.sgd(0.1, momentum=0.9)
-    opt_state = opt.init(params)
-    step = jax.jit(resnet.make_train_step(cfg, opt, axis_name=None),
-                   donate_argnums=(0, 1, 2))
-
+    dtype = jnp.bfloat16 if _on_tpu() else jnp.float32
+    sgd = optax.sgd(0.1, momentum=0.9)
     x, y = resnet.synthetic_batch(batch, image_size=image_size)
-    x, y = jnp.asarray(x), jnp.asarray(y)
 
-    # Warmup (compile) then timed steps.
+    if framework:
+        # The framework hot path: DistributedOptimizer averages gradients
+        # over the hvd axis; SyncBN reduces batch statistics over it too.
+        cfg = resnet.ResNetConfig(depth=50, num_classes=1000,
+                                  compute_dtype=dtype, sync_bn_axis="hvd")
+        opt = hvd.DistributedOptimizer(sgd, op=hvd.Average, axis_name="hvd")
+        mesh = hvd.mesh()
+        inner = resnet.make_train_step(cfg, opt, axis_name=None)
+        step = jax.jit(shard_map(inner, mesh=mesh,
+                                 in_specs=(P(), P(), P(), P("hvd"), P("hvd")),
+                                 out_specs=(P(), P(), P(), P()),
+                                 check_vma=False),
+                       donate_argnums=(0, 1, 2))
+        xs = jax.device_put(x, NamedSharding(mesh, P("hvd")))
+        ys = jax.device_put(y, NamedSharding(mesh, P("hvd")))
+    else:
+        cfg = resnet.ResNetConfig(depth=50, num_classes=1000,
+                                  compute_dtype=dtype, sync_bn_axis=None)
+        step = jax.jit(resnet.make_train_step(cfg, sgd, axis_name=None),
+                       donate_argnums=(0, 1, 2))
+        xs, ys = jnp.asarray(x), jnp.asarray(y)
+
+    params, stats = resnet.init_params(cfg, jax.random.PRNGKey(0))
+    opt_state = (opt if framework else sgd).init(params)
+    return step, (params, stats, opt_state), (xs, ys)
+
+
+def _timed_steps(step, state, data, steps):
+    import jax
+    params, stats, opt_state = state
+    x, y = data
     for _ in range(2):
         params, stats, opt_state, loss = step(params, stats, opt_state, x, y)
     jax.block_until_ready(loss)
@@ -53,11 +171,67 @@ def bench_resnet(batch: int, steps: int, image_size: int):
     for _ in range(steps):
         params, stats, opt_state, loss = step(params, stats, opt_state, x, y)
     jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    return batch * steps / dt
+    return time.perf_counter() - t0
 
 
-def bench_llama(batch: int, steps: int):
+def _compile_with_flops(step, state, data):
+    """AOT-compile once; return (callable, per-device FLOPs or None)."""
+    params, stats, opt_state = state
+    x, y = data
+    try:
+        compiled = step.lower(params, stats, opt_state, x, y).compile()
+    except Exception:
+        return step, None
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0)) or None
+    except Exception:
+        flops = None
+    return compiled, flops
+
+
+def bench_resnet(batch, steps, image_size):
+    """Framework-path + raw-XLA ResNet-50.
+
+    ``batch`` is the GLOBAL batch (already world-scaled by main()).
+    Returns ``(ips, mfu_pct, overhead_pct, raw_ips)``.
+    """
+    import jax
+
+    import horovod_tpu as hvd
+
+    skip_raw = os.environ.get("HVD_BENCH_SKIP_RAW", "") == "1"
+    world = max(1, hvd.size())
+
+    step, state, data = _resnet_pieces(batch, image_size, framework=True)
+    step, flops = _compile_with_flops(step, state, data)
+    dt = _timed_steps(step, state, data, steps)
+    ips = batch * steps / dt
+
+    # cost_analysis() reports the post-SPMD per-device executable, so the
+    # MFU denominator is a single chip's peak.
+    mfu = None
+    peak = _peak_flops()
+    if flops and peak:
+        mfu = round(100.0 * flops * steps / dt / peak, 2)
+
+    overhead = None
+    if not skip_raw:
+        # Fair per-chip comparison: the raw step runs this chip's share of
+        # the global batch on one device, no hvd anywhere.
+        rbatch = max(1, batch // world)
+        rstep, rstate, rdata = _resnet_pieces(rbatch, image_size,
+                                              framework=False)
+        rdt = _timed_steps(rstep, rstate, rdata, steps)
+        raw_ips = rbatch * steps / rdt
+        overhead = round(100.0 * (dt - rdt) / rdt, 2)  # + = framework slower
+        return ips, mfu, overhead, round(raw_ips, 2)
+    return ips, mfu, overhead, None
+
+
+def bench_llama(batch, steps):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -90,23 +264,55 @@ def bench_llama(batch: int, steps: int):
 
 
 def main():
+    import horovod_tpu as hvd
+
+    # init() FIRST: it may need jax.distributed.initialize(), which must run
+    # before any jax.devices() query finalizes a single-process backend.
+    hvd.init()
+
     model = os.environ.get("HVD_BENCH_MODEL", "resnet50")
-    batch = int(os.environ.get("HVD_BENCH_BATCH", "32"))
-    steps = int(os.environ.get("HVD_BENCH_STEPS", "8"))
-    image = int(os.environ.get("HVD_BENCH_IMAGE", "224"))
+    on_tpu = _on_tpu()
+    # HVD_BENCH_BATCH is the PER-CHIP batch; the global batch scales with
+    # the world so per-chip work (and shard divisibility) is invariant.
+    per_chip = int(os.environ.get("HVD_BENCH_BATCH", "128" if on_tpu else "8"))
+    batch = per_chip * max(1, hvd.size())
+    steps = int(os.environ.get("HVD_BENCH_STEPS", "50" if on_tpu else "3"))
+    image = int(os.environ.get("HVD_BENCH_IMAGE", "224" if on_tpu else "64"))
+    sizes = os.environ.get("HVD_BENCH_SIZES_MB",
+                           "1,4,16,64,256" if on_tpu else "1,4")
+    sizes_mb = [int(s) for s in sizes.split(",") if s]
 
     if model == "llama":
-        tps = bench_llama(batch, steps)
+        tps = bench_llama(per_chip, steps)
         out = {"metric": "llama_tiny_train_tokens_per_sec_per_chip",
                "value": round(tps, 2), "unit": "tokens/sec",
                "vs_baseline": 0.0}
-    else:
-        ips = bench_resnet(batch, steps, image)
-        out = {"metric": "resnet50_synthetic_images_per_sec_per_chip",
-               "value": round(ips, 2), "unit": "images/sec",
-               "vs_baseline": round(ips / HOROVOD_P100_RESNET50_IMG_PER_SEC,
-                                    3)}
-    print(json.dumps(out))
+        if hvd.rank() == 0:
+            print(json.dumps(out))
+        return
+
+    busbw = None
+    if os.environ.get("HVD_BENCH_SKIP_BUSBW", "") != "1":
+        busbw = bench_busbw(sizes_mb)
+
+    ips, mfu, overhead, raw_ips = bench_resnet(batch, steps, image)
+
+    out = {
+        "metric": "resnet50_hvd_framework_images_per_sec_per_chip",
+        "value": round(ips / max(1, hvd.size()), 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips / max(1, hvd.size())
+                             / HOROVOD_P100_RESNET50_IMG_PER_SEC, 3),
+        "mfu_pct": mfu,
+        "batch": batch, "steps": steps, "image": image,
+        "world": hvd.size(),
+        "framework_path": "hvd.init+DistributedOptimizer+SyncBN(shard_map)",
+        "raw_xla_images_per_sec": raw_ips,
+        "framework_overhead_pct": overhead,
+        "allreduce_busbw_GBps": busbw,
+    }
+    if hvd.rank() == 0:
+        print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -40,7 +40,7 @@ from .ops.eager import (  # noqa: F401
     alltoall, alltoall_async,
     reducescatter, reducescatter_async,
     synchronize, poll, barrier, join,
-    stack_per_rank, replicated, to_local,
+    stack_per_rank, replicated, to_local, to_global,
 )
 from . import ops  # noqa: F401
 from .jax.optimizer import (  # noqa: F401

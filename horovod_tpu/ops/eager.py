@@ -131,6 +131,19 @@ def _as_stacked(x, ps_id: int):
     return jax.device_put(x, sharding)
 
 
+def to_global(tensor, process_set: Optional[ProcessSet] = None):
+    """Assemble the stacked global ``[world, *S]`` array for this input.
+
+    Single-process: accepts the full stacked array (host or device) and
+    returns it placed on the world mesh.  Multi-process: accepts this
+    process's LOCAL contribution (``[*S]``, or ``[local_size, *S]`` for a
+    multi-device process) and returns the global array — the public
+    counterpart of :func:`to_local` for feeding jitted/shard_map programs
+    directly.
+    """
+    return _as_stacked(tensor, _ps(process_set))
+
+
 def to_local(result):
     """This process's view of a collective result.
 
