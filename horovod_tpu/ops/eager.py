@@ -91,6 +91,14 @@ def _as_stacked(x, ps_id: int):
     per-device shards (``jax.make_array_from_single_device_arrays``), the
     TPU-native analogue of the reference's per-rank tensor submission
     (SURVEY.md §3.2).
+
+    Device arrays stay device-resident: no ``np.asarray`` round-trip (the
+    reference's fusion buffer exists to avoid exactly these host copies —
+    SURVEY.md N7, §7 hard-part #2).
+
+    Returns ``(array, owned)`` — ``owned`` is True when the array is a fresh
+    temporary this layer created (safe for the engine to donate into the
+    fused XLA program); False when it aliases the caller's array.
     """
     st = basics._get_state()
     ps = st.process_set_table.get(ps_id)
@@ -108,27 +116,30 @@ def _as_stacked(x, ps_id: int):
         local_devs = [d for d in ps.mesh.devices.flat
                       if d.process_index == jax.process_index()]
         n_local = len(local_devs)
-        x = np.asarray(x)
+        device_resident = isinstance(x, jax.Array)
+        if not device_resident:
+            x = np.asarray(x)
         if n_local > 1:
             if x.shape[0] != n_local:
                 raise ValueError(
                     f"Multi-device process: pass [local_size={n_local}, ...] "
-                    f"local contributions; got {x.shape}")
-            per_dev = [x[i][None] for i in range(n_local)]
+                    f"local contributions; got {tuple(x.shape)}")
+            per_dev = [x[i:i + 1] for i in range(n_local)]
         else:
-            per_dev = [x[None]]
+            per_dev = [x[None] if not device_resident
+                       else jnp.expand_dims(x, 0)]
         global_shape = (world,) + tuple(per_dev[0].shape[1:])
         shards = [jax.device_put(p, d) for p, d in zip(per_dev, local_devs)]
         return jax.make_array_from_single_device_arrays(
-            global_shape, sharding, shards)
+            global_shape, sharding, shards), True
     if hasattr(x, "shape") and (len(x.shape) == 0 or x.shape[0] != world):
         raise ValueError(
             f"Eager collectives take stacked per-rank tensors of shape "
             f"[world={world}, ...]; got shape {tuple(x.shape)}. Use "
             f"stack_per_rank()/replicated() to build one.")
     if isinstance(x, jax.Array) and x.sharding == sharding:
-        return x
-    return jax.device_put(x, sharding)
+        return x, False   # caller's array — never donate
+    return jax.device_put(x, sharding), True
 
 
 def to_global(tensor, process_set: Optional[ProcessSet] = None):
@@ -141,7 +152,7 @@ def to_global(tensor, process_set: Optional[ProcessSet] = None):
     counterpart of :func:`to_local` for feeding jitted/shard_map programs
     directly.
     """
-    return _as_stacked(tensor, _ps(process_set))
+    return _as_stacked(tensor, _ps(process_set))[0]
 
 
 def to_local(result):
@@ -208,10 +219,12 @@ def allreduce_async(tensor, name: Optional[str] = None,
                     postscale_factor: Optional[float] = None,
                     process_set: Optional[ProcessSet] = None) -> int:
     ps_id = _ps(process_set)
+    arr, owned = _as_stacked(tensor, ps_id)
     return _engine().enqueue(
         _auto_name("allreduce", name), CollectiveType.ALLREDUCE,
-        _as_stacked(tensor, ps_id), reduce_op=op, process_set_id=ps_id,
-        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+        arr, reduce_op=op, process_set_id=ps_id,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        donate=owned)
 
 
 def allreduce(tensor, name: Optional[str] = None,
@@ -233,11 +246,14 @@ def grouped_allreduce_async(tensors: Sequence, name: Optional[str] = None,
     gid = next(_group_counter)
     base = _auto_name("grouped_allreduce", name)
     eng = _engine()
-    return [eng.enqueue(f"{base}.{i}", CollectiveType.ALLREDUCE,
-                        _as_stacked(t, ps_id), reduce_op=op,
-                        process_set_id=ps_id, prescale_factor=prescale_factor,
-                        postscale_factor=postscale_factor, group_id=gid)
-            for i, t in enumerate(tensors)]
+    handles = []
+    for i, t in enumerate(tensors):
+        arr, owned = _as_stacked(t, ps_id)
+        handles.append(eng.enqueue(
+            f"{base}.{i}", CollectiveType.ALLREDUCE, arr, reduce_op=op,
+            process_set_id=ps_id, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, group_id=gid, donate=owned))
+    return handles
 
 
 def grouped_allreduce(tensors: Sequence, name: Optional[str] = None,
@@ -253,9 +269,10 @@ def grouped_allreduce(tensors: Sequence, name: Optional[str] = None,
 def allgather_async(tensor, name: Optional[str] = None,
                     process_set: Optional[ProcessSet] = None) -> int:
     ps_id = _ps(process_set)
+    arr, owned = _as_stacked(tensor, ps_id)
     return _engine().enqueue(_auto_name("allgather", name),
                              CollectiveType.ALLGATHER,
-                             _as_stacked(tensor, ps_id), process_set_id=ps_id)
+                             arr, process_set_id=ps_id, donate=owned)
 
 
 def allgather(tensor, name: Optional[str] = None,
@@ -267,10 +284,11 @@ def allgather(tensor, name: Optional[str] = None,
 def broadcast_async(tensor, root_rank: int = 0, name: Optional[str] = None,
                     process_set: Optional[ProcessSet] = None) -> int:
     ps_id = _ps(process_set)
+    arr, owned = _as_stacked(tensor, ps_id)
     return _engine().enqueue(_auto_name("broadcast", name),
                              CollectiveType.BROADCAST,
-                             _as_stacked(tensor, ps_id), root_rank=root_rank,
-                             process_set_id=ps_id)
+                             arr, root_rank=root_rank,
+                             process_set_id=ps_id, donate=owned)
 
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
@@ -329,9 +347,10 @@ def alltoall_async(tensor, splits=None, name: Optional[str] = None,
             "Ragged alltoall splits land with the uneven-split planner; "
             "even splits (splits=None) are supported")
     ps_id = _ps(process_set)
+    arr, owned = _as_stacked(tensor, ps_id)
     return _engine().enqueue(_auto_name("alltoall", name),
                              CollectiveType.ALLTOALL,
-                             _as_stacked(tensor, ps_id), process_set_id=ps_id)
+                             arr, process_set_id=ps_id, donate=owned)
 
 
 def alltoall(tensor, splits=None, name: Optional[str] = None,
@@ -344,10 +363,11 @@ def reducescatter_async(tensor, name: Optional[str] = None,
                         op: C.ReduceOp = C.ReduceOp.SUM,
                         process_set: Optional[ProcessSet] = None) -> int:
     ps_id = _ps(process_set)
+    arr, owned = _as_stacked(tensor, ps_id)
     return _engine().enqueue(_auto_name("reducescatter", name),
                              CollectiveType.REDUCESCATTER,
-                             _as_stacked(tensor, ps_id), reduce_op=op,
-                             process_set_id=ps_id)
+                             arr, reduce_op=op,
+                             process_set_id=ps_id, donate=owned)
 
 
 def reducescatter(tensor, name: Optional[str] = None,

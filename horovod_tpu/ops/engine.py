@@ -69,6 +69,7 @@ class TensorTableEntry:
     prescale_factor: Optional[float] = None
     postscale_factor: Optional[float] = None
     group_id: int = -1               # grouped ops execute atomically together
+    donate: bool = False             # engine owns the buffer: donate to XLA
     enqueue_time: float = 0.0
     # filled on completion:
     result: Any = None
@@ -256,13 +257,15 @@ class CollectiveEngine:
     def enqueue(self, name: str, ctype: CollectiveType, tensor,
                 reduce_op=C.ReduceOp.AVERAGE, root_rank: int = 0,
                 process_set_id: int = 0, prescale_factor=None,
-                postscale_factor=None, group_id: int = -1) -> int:
+                postscale_factor=None, group_id: int = -1,
+                donate: bool = False) -> int:
         handle = next(self._handle_counter)
         e = TensorTableEntry(
             handle=handle, name=name, ctype=ctype, tensor=tensor,
             reduce_op=reduce_op, root_rank=root_rank,
             process_set_id=process_set_id, prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor, group_id=group_id)
+            postscale_factor=postscale_factor, group_id=group_id,
+            donate=donate)
         with self._handles_lock:
             self._handles[handle] = e
         tl = self._state.timeline
@@ -431,10 +434,19 @@ class CollectiveEngine:
         mesh, axis, world = self._mesh_axis(e0.process_set_id)
         shapes = tuple(tuple(e.tensor.shape) for e in batch)
         dtypes = tuple(str(e.tensor.dtype) for e in batch)
-        key = (_fusion_key(e0), shapes, dtypes)
+        donate = tuple(e.donate for e in batch)
+        key = (_fusion_key(e0), shapes, dtypes, donate)
         fn = self.cache.get_or_build(
-            key, lambda: self._build_program(e0, shapes, dtypes, mesh, axis, world))
-        outs = fn(*[e.tensor for e in batch])
+            key, lambda: self._build_program(e0, shapes, dtypes, mesh, axis,
+                                             world, donate))
+        import warnings
+        with warnings.catch_warnings():
+            # Donation is best-effort: ops whose output cannot alias the
+            # input (e.g. allgather) make XLA drop the hint; scoped to this
+            # engine-thread dispatch so user code keeps its diagnostics.
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            outs = fn(*[e.tensor for e in batch])
         if not isinstance(outs, (list, tuple)):
             outs = [outs]
         return list(outs)
@@ -445,22 +457,36 @@ class CollectiveEngine:
     # XLA temporary in HBM — reference N7 without the memcpy machinery),
     # runs ONE collective, and splits results out.
     def _build_program(self, proto: TensorTableEntry, shapes, dtypes, mesh,
-                       axis, world):
+                       axis, world, donate=()):
         ctype = proto.ctype
+        # Engine-owned input buffers are donated to XLA so the fused
+        # program may alias them in HBM instead of allocating fresh
+        # outputs (reference N7's in-place fusion buffer, the XLA way;
+        # SURVEY.md §7 hard-part #2).  XLA ignores unusable donations.
+        dargs = tuple(i for i, d in enumerate(donate) if d)
+
+        def _jit(fn):
+            return jax.jit(fn, donate_argnums=dargs)
 
         if ctype == CollectiveType.ALLREDUCE:
-            return self._build_allreduce(proto, shapes, dtypes, mesh, axis, world)
+            return self._build_allreduce(proto, shapes, dtypes, mesh, axis,
+                                         world, _jit)
         if ctype == CollectiveType.BROADCAST:
-            return self._build_broadcast(proto, shapes, mesh, axis, world)
+            return self._build_broadcast(proto, shapes, mesh, axis, world,
+                                         _jit)
         if ctype == CollectiveType.ALLGATHER:
-            return self._build_allgather(proto, shapes, mesh, axis, world)
+            return self._build_allgather(proto, shapes, mesh, axis, world,
+                                         _jit)
         if ctype == CollectiveType.REDUCESCATTER:
-            return self._build_reducescatter(proto, shapes, mesh, axis, world)
+            return self._build_reducescatter(proto, shapes, mesh, axis,
+                                             world, _jit)
         if ctype == CollectiveType.ALLTOALL:
-            return self._build_alltoall(proto, shapes, mesh, axis, world)
+            return self._build_alltoall(proto, shapes, mesh, axis, world,
+                                        _jit)
         raise ValueError(f"Unsupported collective: {ctype}")
 
-    def _build_allreduce(self, proto, shapes, dtypes, mesh, axis, world):
+    def _build_allreduce(self, proto, shapes, dtypes, mesh, axis, world,
+                         _jit=jax.jit):
         op = proto.reduce_op
         pre, post = proto.prescale_factor, proto.postscale_factor
         per_rank_shapes = [s[1:] for s in shapes]
@@ -515,9 +541,10 @@ class CollectiveEngine:
             return shard_map(body, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=False)(*xs)
 
-        return jax.jit(wrapper)
+        return _jit(wrapper)
 
-    def _build_broadcast(self, proto, shapes, mesh, axis, world):
+    def _build_broadcast(self, proto, shapes, mesh, axis, world,
+                         _jit=jax.jit):
         root = proto.root_rank
 
         def body(*shards):
@@ -533,12 +560,13 @@ class CollectiveEngine:
                     outs.append(lax.psum(m, axis))
             return tuple(outs)
 
-        return jax.jit(shard_map(
+        return _jit(shard_map(
             body, mesh=mesh,
             in_specs=tuple(P(axis) for _ in shapes),
             out_specs=tuple(P() for _ in shapes), check_vma=False))
 
-    def _build_allgather(self, proto, shapes, mesh, axis, world):
+    def _build_allgather(self, proto, shapes, mesh, axis, world,
+                         _jit=jax.jit):
         def body(*shards):
             outs = []
             for s in shards:
@@ -546,12 +574,13 @@ class CollectiveEngine:
                 outs.append(lax.all_gather(x, axis, axis=0, tiled=True))
             return tuple(outs)
 
-        return jax.jit(shard_map(
+        return _jit(shard_map(
             body, mesh=mesh,
             in_specs=tuple(P(axis) for _ in shapes),
             out_specs=tuple(P() for _ in shapes), check_vma=False))
 
-    def _build_reducescatter(self, proto, shapes, mesh, axis, world):
+    def _build_reducescatter(self, proto, shapes, mesh, axis, world,
+                             _jit=jax.jit):
         op = proto.reduce_op
         if op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE, C.ReduceOp.MIN,
                       C.ReduceOp.MAX, C.ReduceOp.PRODUCT):
@@ -582,12 +611,13 @@ class CollectiveEngine:
                 outs.append(r[None])  # re-stack: [1, S0/world, ...]
             return tuple(outs)
 
-        return jax.jit(shard_map(
+        return _jit(shard_map(
             body, mesh=mesh,
             in_specs=tuple(P(axis) for _ in shapes),
             out_specs=tuple(P(axis) for _ in shapes), check_vma=False))
 
-    def _build_alltoall(self, proto, shapes, mesh, axis, world):
+    def _build_alltoall(self, proto, shapes, mesh, axis, world,
+                        _jit=jax.jit):
         def body(*shards):
             outs = []
             for s in shards:
@@ -597,7 +627,7 @@ class CollectiveEngine:
                 outs.append(y[None])
             return tuple(outs)
 
-        return jax.jit(shard_map(
+        return _jit(shard_map(
             body, mesh=mesh,
             in_specs=tuple(P(axis) for _ in shapes),
             out_specs=tuple(P(axis) for _ in shapes), check_vma=False))

@@ -68,6 +68,22 @@ def _to_numpy(t: torch.Tensor) -> np.ndarray:
     return t.contiguous().numpy()
 
 
+def _to_jax(t: torch.Tensor):
+    """torch -> jax via dlpack (zero host copy; reference N26's adapter
+    wrapped at::Tensor without copies — this is the XLA-side analogue).
+
+    Falls back to the numpy bit-view path for dtypes/layouts dlpack can't
+    express.  The returned jax.Array shares (or minimally copies) the torch
+    buffer; downstream the engine assembles/donates fresh device buffers, so
+    the torch tensor is never invalidated.
+    """
+    import jax.numpy as jnp
+    try:
+        return jnp.from_dlpack(t.detach().contiguous())
+    except Exception:
+        return _to_numpy(t)
+
+
 def _from_numpy(a: np.ndarray, dtype: torch.dtype,
                 device: torch.device) -> torch.Tensor:
     import ml_dtypes
@@ -96,9 +112,13 @@ def _submit(t: torch.Tensor, process_set: Optional[ProcessSet] = None):
     global array from per-process shards).  Single-process SPMD: replicate —
     the controller submits the same tensor for every rank it owns.
     """
-    arr = _to_numpy(t)
     if eager.per_process_mode():
-        return arr
+        # The real multi-chip path: keep the tensor device-resident (dlpack).
+        return _to_jax(t)
+    # Single-controller SPMD: a stride-0 numpy view replicates this tensor
+    # for every rank with zero host materialization (a dense world-sized
+    # copy would blow up host memory for large gradients).
+    arr = _to_numpy(t)
     return np.broadcast_to(arr[None], (_set_size(process_set),) + arr.shape)
 
 
