@@ -13,17 +13,26 @@
 // Wire protocol (all little-endian, length-prefixed frames):
 //   frame  := uint32 payload_len, payload
 //   C->S   := uint32 n_announce, n_announce * { uint16 required,
-//                                               uint16 len, bytes name }
+//                                               uint16 len, bytes name,
+//                                               uint16 dlen, bytes digest }
 //             (names newly enqueued on this rank since the last round;
 //              `required` = number of ranks that must announce before the
 //              tensor is ready — process-set size; 0 means the full world.
+//              `digest` describes the submission — op|dtype|shape|root —
+//              so rank 0 can reject divergent submissions (the reference
+//              controller's shape/dtype consistency checks, SURVEY.md N2).
 //              A round with nothing new sends n_announce = 0)
 //   S->C   := uint32 n_ready,   n_ready * { uint16 len, bytes name }
 //             uint32 n_warn,    n_warn  * { uint16 len, bytes text }
+//             uint32 n_err,     n_err   * { uint16 len, bytes name,
+//                                           uint16 mlen, bytes message }
 //             (ready = pending on ALL ranks, in deterministic order:
 //              first-announce round, then name; warn = stall diagnoses
 //              naming the missing ranks, the reference's stall_inspector
-//              output)
+//              output; err = per-tensor negotiation failures — digest
+//              mismatch across ranks — broadcast until every required rank
+//              has announced the name, the reference's per-tensor error
+//              Response)
 //
 // Exported C ABI (ctypes-consumed by horovod_tpu/common/native.py):
 //   hvdtpu_server_start(port, world) -> handle
@@ -141,6 +150,11 @@ struct PendingInfo {
   int required = 0;          // ranks needed (0 = full world)
   Clock::time_point first_seen;
   bool warned = false;
+  // Shape/dtype consistency: digest of the first announce, plus who
+  // announced what when a divergence appears (for rank attribution).
+  std::string digest;
+  std::map<std::string, std::set<int>> by_digest;
+  bool errored = false;
 };
 
 struct Server {
@@ -215,25 +229,62 @@ void Server::run_inner() {
       for (uint32_t i = 0; i < n && rd.ok; ++i) {
         uint16_t required = rd.u16();
         std::string name = rd.str();
+        std::string digest = rd.str();
         auto it = pending.find(name);
         if (it == pending.end()) {
           PendingInfo info;
           info.order = announce_seq++;
           info.required = required ? required : world;
           info.first_seen = Clock::now();
+          info.digest = digest;
           it = pending.emplace(name, std::move(info)).first;
         }
         it->second.ready_ranks.insert(r);
+        it->second.by_digest[digest].insert(r);
+        if (digest != it->second.digest) {
+          // Divergent submission (reference controller's consistency
+          // check).  The message is rebuilt at response time so late
+          // announcers still appear in the rank attribution.
+          it->second.errored = true;
+        }
       }
     }
     if (stop.load()) break;
 
     // Ready = reported by every rank; deterministic order by announce seq.
+    // Errored tensors are never ready: their error is broadcast every round
+    // until all required ranks have announced (so each has a local entry to
+    // fail), then dropped.
     std::vector<std::pair<uint64_t, std::string>> ready;
     std::vector<std::string> warns;
+    std::vector<std::pair<std::string, std::string>> errs;
     auto now = Clock::now();
     for (auto it = pending.begin(); it != pending.end();) {
       auto& info = it->second;
+      if (info.errored) {
+        // Per-tensor error naming every rank on each side of the
+        // divergence, rebuilt each round so late announcers are included.
+        std::string msg = "tensor '" + it->first +
+                          "' negotiation failed: mismatched submissions: ";
+        bool first_d = true;
+        for (auto& [d, ranks] : info.by_digest) {
+          if (!first_d) msg += " vs ";
+          first_d = false;
+          std::string rs;
+          for (int rr : ranks) {
+            if (!rs.empty()) rs += ",";
+            rs += std::to_string(rr);
+          }
+          msg += "ranks [" + rs + "] announced " + d;
+        }
+        errs.emplace_back(it->first, msg);
+        if (static_cast<int>(info.ready_ranks.size()) >= info.required) {
+          it = pending.erase(it);
+          continue;
+        }
+        ++it;
+        continue;
+      }
       if (static_cast<int>(info.ready_ranks.size()) >= info.required) {
         ready.emplace_back(info.order, it->first);
         it = pending.erase(it);
@@ -263,6 +314,11 @@ void Server::run_inner() {
     for (auto& [ord, name] : ready) put_str(&resp, name);
     put_u32(&resp, static_cast<uint32_t>(warns.size()));
     for (auto& w : warns) put_str(&resp, w);
+    put_u32(&resp, static_cast<uint32_t>(errs.size()));
+    for (auto& [name, msg] : errs) {
+      put_str(&resp, name);
+      put_str(&resp, msg);
+    }
     for (int r = 0; r < world; ++r) {
       if (!write_frame(fds[r].load(), resp)) { stop.store(true); break; }
     }

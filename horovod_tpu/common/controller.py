@@ -23,6 +23,16 @@ log = get_logger()
 _RESP_CAP = 4 * 1024 * 1024
 
 
+class NegotiationError(RuntimeError):
+    """A collective was submitted inconsistently across ranks (shape/dtype/
+    op divergence).  Per-tensor: raised from ``synchronize()`` of the
+    offending collective only; the runtime stays alive (reference: the
+    controller's per-tensor error Responses, SURVEY.md N2/§5).
+
+    Deliberately NOT a HorovodInternalError — an elastic wrapper must not
+    respond to an application bug by resetting the world."""
+
+
 class TCPController:
     """Engine-facing controller (engine calls ``negotiate`` each cycle)."""
 
@@ -48,15 +58,19 @@ class TCPController:
                 f"{addr}:{port}")
         self._announced: set = set()
         self._early_ready: List[str] = []
+        self._early_errors: Dict[str, str] = {}
         self._resp_buf = (ctypes.c_uint8 * _RESP_CAP)()
 
     # ------------------------------------------------------------- protocol
     def _round(self, announces: Sequence) -> tuple:
-        """announces: (name, required_ranks) pairs; required 0 = world."""
+        """announces: (name, required_ranks, digest) triples; required 0 =
+        world."""
         req = bytearray(struct.pack("<I", len(announces)))
-        for n, required in announces:
+        for n, required, digest in announces:
             nb = n.encode()
+            db = digest.encode()
             req += struct.pack("<H", required) + struct.pack("<H", len(nb)) + nb
+            req += struct.pack("<H", len(db)) + db
         buf = (ctypes.c_uint8 * len(req)).from_buffer(req) if req else \
             (ctypes.c_uint8 * 0)()
         rc = self._lib.hvdtpu_client_round(
@@ -83,9 +97,26 @@ class TCPController:
                 off += ln
             return out
 
+        def read_pairs():
+            nonlocal off
+            (n,) = struct.unpack_from("<I", data, off)
+            off += 4
+            out = []
+            for _ in range(n):
+                (ln,) = struct.unpack_from("<H", data, off)
+                off += 2
+                name = data[off:off + ln].decode()
+                off += ln
+                (ml,) = struct.unpack_from("<H", data, off)
+                off += 2
+                out.append((name, data[off:off + ml].decode()))
+                off += ml
+            return out
+
         ready = read_list()
         warns = read_list()
-        return ready, warns
+        errors = read_pairs() if off < len(data) else []
+        return ready, warns, errors
 
     # ---------------------------------------------------------- engine API
     @staticmethod
@@ -96,10 +127,34 @@ class TCPController:
         ps_id = getattr(e, "process_set_id", 0)
         return f"{ps_id}\x1f{e.name}" if ps_id else e.name
 
-    def negotiate(self, entries: List) -> List:
+    @staticmethod
+    def _digest(e) -> str:
+        """Submission consistency digest: op kind, dtype, per-rank shape,
+        reduce op, root — what the reference's Request carries for the
+        controller's shape/dtype checks (SURVEY.md N2/N5)."""
+        t = getattr(e, "tensor", None)
+        if t is None:
+            return "barrier"
+        shape = tuple(t.shape[1:]) if len(t.shape) else ()
+        ct = getattr(e, "ctype", None)
+        op = getattr(e, "reduce_op", None)
+        parts = [ct.value if ct is not None else "op",
+                 str(t.dtype), str(shape)]
+        if op is not None:
+            parts.append(op.name)
+        parts.append(str(getattr(e, "root_rank", 0)))
+        # Scale factors shape the fused program (they are in the engine's
+        # fusion key), so divergence would desync batching across ranks.
+        parts.append(str(getattr(e, "prescale_factor", None)))
+        parts.append(str(getattr(e, "postscale_factor", None)))
+        return "|".join(parts)
+
+    def negotiate(self, entries: List) -> tuple:
         """One negotiation round.  Takes this cycle's drained entries (they
-        may include requeued ones), announces the new names, and returns the
-        subset that is ready everywhere, in the server's global order."""
+        may include requeued ones), announces the new names + digests, and
+        returns ``(ready, errored)``: the subset ready everywhere in the
+        server's global order, and ``(entry, message)`` pairs for per-tensor
+        negotiation failures (digest mismatch across ranks)."""
         by_name: Dict[str, object] = {self._wire_name(e): e for e in entries}
         new = []
         for n, e in by_name.items():
@@ -112,9 +167,9 @@ class TCPController:
                 # ranks; the server readiness threshold is the set size.
                 from .basics import _get_state
                 required = _get_state().process_set_table.get(ps_id).size()
-            new.append((n, required))
-        self._announced.update(n for n, _ in new)
-        ready, warns = self._round(new)
+            new.append((n, required, self._digest(e)))
+        self._announced.update(n for n, _, _ in new)
+        ready, warns, errors = self._round(new)
         for w in warns:
             log.warning("controller: %s", w)
         # The engine requeues not-ready entries, so every announced name
@@ -136,7 +191,36 @@ class TCPController:
                 continue
             self._announced.discard(name)
             out.append(e)
-        return out
+        # Per-tensor errors: fail the local entry (waiters see the exception
+        # from synchronize()); re-broadcasts for entries already failed (or
+        # another set's tensors) are dropped.  _early_errors covers an error
+        # verdict racing ahead of the local requeue drain, like _early_ready.
+        errored = []
+        for name, msg in dict(self._early_errors).items():
+            e = by_name.pop(name, None)
+            if e is not None:
+                del self._early_errors[name]
+                self._announced.discard(name)
+                errored.append((e, msg))
+        for name, msg in errors:
+            e = by_name.pop(name, None)
+            if e is None:
+                if name in self._announced:
+                    self._early_errors[name] = msg
+                continue
+            self._announced.discard(name)
+            errored.append((e, msg))
+        return out, errored
+
+    def forget(self, e):
+        """Drop all negotiation bookkeeping for an entry failed locally
+        (e.g. group-abort) so a retry under the same name renegotiates from
+        scratch instead of consuming a stale ready/error verdict."""
+        n = self._wire_name(e)
+        self._announced.discard(n)
+        self._early_errors.pop(n, None)
+        if n in self._early_ready:
+            self._early_ready.remove(n)
 
     def interrupt(self):
         """Unblock any thread stuck in a lock-step round (socket shutdown,

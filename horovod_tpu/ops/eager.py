@@ -137,8 +137,17 @@ def _as_stacked(x, ps_id: int):
             f"Eager collectives take stacked per-rank tensors of shape "
             f"[world={world}, ...]; got shape {tuple(x.shape)}. Use "
             f"stack_per_rank()/replicated() to build one.")
-    if isinstance(x, jax.Array) and x.sharding == sharding:
-        return x, False   # caller's array — never donate
+    if isinstance(x, jax.Array):
+        # Equivalent-sharding device_put ALIASES the input buffers rather
+        # than copying, so donation would delete the caller's array — treat
+        # any equivalently-sharded input as caller-owned.
+        try:
+            aliases = x.sharding.is_equivalent_to(sharding, x.ndim)
+        except Exception:
+            aliases = x.sharding == sharding
+        if aliases:
+            return (x if x.sharding == sharding
+                    else jax.device_put(x, sharding)), False
     return jax.device_put(x, sharding), True
 
 
@@ -245,15 +254,18 @@ def grouped_allreduce_async(tensors: Sequence, name: Optional[str] = None,
     ps_id = _ps(process_set)
     gid = next(_group_counter)
     base = _auto_name("grouped_allreduce", name)
-    eng = _engine()
-    handles = []
+    items = []
     for i, t in enumerate(tensors):
         arr, owned = _as_stacked(t, ps_id)
-        handles.append(eng.enqueue(
-            f"{base}.{i}", CollectiveType.ALLREDUCE, arr, reduce_op=op,
-            process_set_id=ps_id, prescale_factor=prescale_factor,
+        items.append(dict(
+            name=f"{base}.{i}", ctype=CollectiveType.ALLREDUCE, tensor=arr,
+            reduce_op=op, process_set_id=ps_id,
+            prescale_factor=prescale_factor,
             postscale_factor=postscale_factor, group_id=gid, donate=owned))
-    return handles
+    # One atomic push: all members negotiate in the same round on every
+    # rank, which both preserves fusion atomicity and lets a negotiation
+    # error on one member abort the whole group (reference N13).
+    return _engine().enqueue_group(items)
 
 
 def grouped_allreduce(tensors: Sequence, name: Optional[str] = None,

@@ -103,14 +103,26 @@ class TensorQueue:
         self._pending_names: Dict[str, int] = {}
 
     def push(self, e: TensorTableEntry):
+        self.push_many([e])
+
+    def push_many(self, entries: Sequence[TensorTableEntry]):
+        """Atomic multi-entry push: a drain observes all or none — grouped
+        ops rely on this so members always negotiate in the same round
+        (reference: group_table N13 registers whole groups)."""
         with self._lock:
-            if e.name in self._pending_names:
-                raise ValueError(
-                    f"A tensor named {e.name!r} is already pending; Horovod "
-                    f"semantics require unique names per in-flight collective")
-            self._pending_names[e.name] = e.handle
-            e.enqueue_time = time.monotonic()
-            self._entries.append(e)
+            seen = set()
+            for e in entries:
+                if e.name in self._pending_names or e.name in seen:
+                    raise ValueError(
+                        f"A tensor named {e.name!r} is already pending; "
+                        f"Horovod semantics require unique names per "
+                        f"in-flight collective")
+                seen.add(e.name)
+            now = time.monotonic()
+            for e in entries:
+                self._pending_names[e.name] = e.handle
+                e.enqueue_time = now
+                self._entries.append(e)
 
     def drain(self) -> List[TensorTableEntry]:
         with self._lock:
@@ -150,10 +162,16 @@ class FusedProgramCache:
         self.misses = 0
 
     def get_or_build(self, key: Tuple, builder: Callable[[], Callable]) -> Callable:
+        fn, _ = self.get_or_build2(key, builder)
+        return fn
+
+    def get_or_build2(self, key: Tuple, builder: Callable[[], Callable]):
+        """Returns ``(fn, hit)`` — hit=False means fn will compile on its
+        first invocation (callers may scope compile-time-only handling)."""
         if self.capacity <= 0:
             # Caching disabled (HOROVOD_CACHE_CAPACITY=0): build every time.
             self.misses += 1
-            return builder()
+            return builder(), False
         fn = self._cache.get(key)
         if fn is None:
             self.misses += 1
@@ -162,9 +180,9 @@ class FusedProgramCache:
                 # FIFO eviction; steady-state training has a tiny working set.
                 self._cache.pop(next(iter(self._cache)))
             self._cache[key] = fn
-        else:
-            self.hits += 1
-        return fn
+            return fn, False
+        self.hits += 1
+        return fn, True
 
 
 class StallInspector:
@@ -259,21 +277,36 @@ class CollectiveEngine:
                 process_set_id: int = 0, prescale_factor=None,
                 postscale_factor=None, group_id: int = -1,
                 donate: bool = False) -> int:
-        handle = next(self._handle_counter)
-        e = TensorTableEntry(
-            handle=handle, name=name, ctype=ctype, tensor=tensor,
-            reduce_op=reduce_op, root_rank=root_rank,
-            process_set_id=process_set_id, prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor, group_id=group_id,
-            donate=donate)
+        return self.enqueue_group([dict(
+            name=name, ctype=ctype, tensor=tensor, reduce_op=reduce_op,
+            root_rank=root_rank, process_set_id=process_set_id,
+            prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+            group_id=group_id, donate=donate)])[0]
+
+    def enqueue_group(self, items: Sequence[dict]) -> List[int]:
+        """Enqueue several entries atomically w.r.t. the drain — a cycle
+        sees all of them or none, so grouped members always negotiate (and
+        batch) together (reference: group_table N13)."""
+        entries = []
+        for kw in items:
+            handle = next(self._handle_counter)
+            entries.append(TensorTableEntry(handle=handle, **kw))
         with self._handles_lock:
-            self._handles[handle] = e
+            for e in entries:
+                self._handles[e.handle] = e
+        try:
+            self.queue.push_many(entries)
+        except ValueError:
+            with self._handles_lock:
+                for e in entries:
+                    self._handles.pop(e.handle, None)
+            raise
         tl = self._state.timeline
         if tl is not None:
-            tl.start_activity(name, "QUEUE")
-        self.queue.push(e)
+            for e in entries:
+                tl.start_activity(e.name, "QUEUE")
         self._wake.set()
-        return handle
+        return [e.handle for e in entries]
 
     def synchronize(self, handle: int, timeout: Optional[float] = None):
         """Block until the handle's collective completed; return result.
@@ -356,10 +389,38 @@ class CollectiveEngine:
         """
         not_ready: List[TensorTableEntry] = []
         if self.controller is not None:
-            ready = self.controller.negotiate(entries)
-            ready_handles = {e.handle for e in ready}
-            not_ready = [e for e in entries if e.handle not in ready_handles]
-            entries = ready
+            ready, errored = self.controller.negotiate(entries)
+            # Per-tensor negotiation failures (shape/dtype divergence across
+            # ranks): fail ONLY those waiters; the runtime stays up
+            # (reference: per-tensor error Responses, SURVEY.md N2).
+            from ..common.controller import NegotiationError
+            # Grouped ops are atomic (reference N13): one member failing
+            # negotiation fails every local member of its group.  Name
+            # sequences are aligned across ranks (see enqueue naming), so
+            # every rank fails the same group deterministically.
+            bad_groups = {e.group_id for e, _ in errored if e.group_id >= 0}
+            if bad_groups:
+                by_handle = {e.handle for e, _ in errored}
+                for e in entries:
+                    if e.group_id in bad_groups and e.handle not in by_handle:
+                        errored.append((e, f"grouped collective aborted: a "
+                                        f"member of group {e.group_id} failed "
+                                        f"negotiation"))
+                        # The member may still be mid-negotiation: clear the
+                        # controller's announce bookkeeping so a retried op
+                        # reusing the name renegotiates from scratch.
+                        self.controller.forget(e)
+            tl = self._state.timeline
+            for e, msg in errored:
+                e.error = NegotiationError(msg)
+                if tl is not None:
+                    tl.end_activity(e.name, "QUEUE")
+                self.queue.mark_done(e)
+                e.done.set()
+            errored_handles = {e.handle for e, _ in errored}
+            done_handles = {e.handle for e in ready} | errored_handles
+            not_ready = [e for e in entries if e.handle not in done_handles]
+            entries = [e for e in ready if e.handle not in errored_handles]
         for e in entries:
             if self._state.timeline is not None:
                 self._state.timeline.end_activity(e.name, "QUEUE")
@@ -436,17 +497,22 @@ class CollectiveEngine:
         dtypes = tuple(str(e.tensor.dtype) for e in batch)
         donate = tuple(e.donate for e in batch)
         key = (_fusion_key(e0), shapes, dtypes, donate)
-        fn = self.cache.get_or_build(
+        fn, hit = self.cache.get_or_build2(
             key, lambda: self._build_program(e0, shapes, dtypes, mesh, axis,
                                              world, donate))
-        import warnings
-        with warnings.catch_warnings():
-            # Donation is best-effort: ops whose output cannot alias the
-            # input (e.g. allgather) make XLA drop the hint; scoped to this
-            # engine-thread dispatch so user code keeps its diagnostics.
-            warnings.filterwarnings(
-                "ignore", message="Some donated buffers were not usable")
+        if hit:
             outs = fn(*[e.tensor for e in batch])
+        else:
+            # First invocation compiles; donation is best-effort and ops
+            # whose output cannot alias the input (e.g. allgather) make XLA
+            # warn at compile time.  Suppress only around this cold-path
+            # compile — steady-state dispatch stays untouched and user
+            # code keeps its own donation diagnostics.
+            import warnings
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable")
+                outs = fn(*[e.tensor for e in batch])
         if not isinstance(outs, (list, tuple)):
             outs = [outs]
         return list(outs)

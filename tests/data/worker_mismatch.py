@@ -1,0 +1,50 @@
+"""Worker: mismatched submission shapes must fail fast, per-tensor, with
+rank attribution (reference: controller.cc shape/dtype consistency ->
+per-tensor error Response)."""
+import os
+
+# Each worker is one rank with ONE cpu device: strip the 8-virtual-device
+# flag inherited from the test process.
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common.controller import NegotiationError
+
+hvd.init()
+r = hvd.rank()
+
+# Divergent per-rank shapes under the same wire name.
+bad = np.ones((4,) if r == 0 else (8,), np.float32)
+try:
+    hvd.allreduce(bad, name="divergent", op=hvd.Sum)
+    raise SystemExit(f"rank {r}: mismatched allreduce unexpectedly succeeded")
+except NegotiationError as e:
+    msg = str(e)
+    assert "ranks [0]" in msg and "ranks [1]" in msg, msg
+    assert "(4,)" in msg and "(8,)" in msg, msg
+
+# Grouped ops are atomic: one divergent member fails the whole group.
+hs = hvd.grouped_allreduce_async(
+    [np.ones((2,), np.float32),
+     np.ones((4,) if r == 0 else (6,), np.float32)],
+    name="grp", op=hvd.Sum)
+errs = 0
+for h in hs:
+    try:
+        hvd.synchronize(h)
+    except NegotiationError:
+        errs += 1
+assert errs == 2, f"rank {r}: expected both group members to fail, got {errs}"
+
+# The runtime must survive a per-tensor failure: consistent work continues.
+good = hvd.to_local(hvd.allreduce(
+    np.full((3,), float(r + 1), np.float32), name="after", op=hvd.Sum))
+np.testing.assert_allclose(good, np.full((3,), 3.0, np.float32))
+print("MISMATCH_OK", flush=True)

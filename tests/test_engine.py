@@ -192,8 +192,8 @@ def test_requeue_preserves_entries(hvd, world_size):
         def negotiate(self, entries):
             self.calls += 1
             if self.calls == 1:
-                return []  # nothing ready yet
-            return entries
+                return [], []  # nothing ready yet
+            return entries, []
 
     eng.controller = HoldFirstCycle()
     try:

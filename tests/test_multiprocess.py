@@ -37,12 +37,21 @@ def test_native_controller_builds():
     assert lib is not None
 
 
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 def test_controller_negotiation_unit():
     """Server + 2 client threads, no jax: readiness protocol only."""
     import threading
     from horovod_tpu.common.controller import TCPController
 
-    port = 15123
+    port = _free_port()
     results = {}
 
     def worker(rank):
@@ -54,15 +63,15 @@ def test_controller_negotiation_unit():
         try:
             if rank == 0:
                 # announce a; peer announces b first, then a
-                r1 = ctl.negotiate([E("a")])
-                r2 = ctl.negotiate([E("a"), E("b")])
-                r3 = ctl.negotiate([E("b")] if not any(
+                r1, _ = ctl.negotiate([E("a")])
+                r2, _ = ctl.negotiate([E("a"), E("b")])
+                r3, _ = ctl.negotiate([E("b")] if not any(
                     e.name == "b" for e in r2) else [])
                 results[rank] = [[e.name for e in r] for r in (r1, r2, r3)]
             else:
-                r1 = ctl.negotiate([E("b")])
-                r2 = ctl.negotiate([E("b"), E("a")])
-                r3 = ctl.negotiate([E("a")] if not any(
+                r1, _ = ctl.negotiate([E("b")])
+                r2, _ = ctl.negotiate([E("b"), E("a")])
+                r3, _ = ctl.negotiate([E("a")] if not any(
                     e.name == "a" for e in r2) else [])
                 results[rank] = [[e.name for e in r] for r in (r1, r2, r3)]
         finally:
@@ -103,5 +112,71 @@ def test_torovodrun_torch_binding(np_):
     res = _run_torovodrun(np_, WORKER_TORCH)
     ok = res.stdout.count("WORKER_OK")
     assert res.returncode == 0 and ok == np_, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
+def test_controller_digest_mismatch_unit():
+    """Two client threads announce the same name with divergent shapes: both
+    get a per-tensor error naming both ranks; a later consistent collective
+    still negotiates (runtime survives)."""
+    import threading
+    import numpy as np
+    from horovod_tpu.common.controller import TCPController
+
+    port = _free_port()
+    results = {}
+
+    class E:
+        def __init__(self, name, shape):
+            self.name = name
+            self.tensor = np.zeros((2,) + shape, np.float32)
+
+    def worker(rank):
+        ctl = TCPController("127.0.0.1", port, rank=rank, world=2,
+                            stall_warn_s=60.0)
+        try:
+            shape = (4,) if rank == 0 else (8,)
+            err = None
+            for _ in range(20):
+                ready, errored = ctl.negotiate([E("t", shape)])
+                if errored:
+                    err = errored[0][1]
+                    break
+            # after the failure, a consistent name must still become ready
+            ok = []
+            for _ in range(20):
+                ready, errored = ctl.negotiate([E("t2", (3,))])
+                if ready:
+                    ok = [e.name for e in ready]
+                    break
+            results[rank] = (err, ok)
+        finally:
+            ctl.shutdown()
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert 0 in results and 1 in results, results
+    for rank in (0, 1):
+        err, ok = results[rank]
+        assert err is not None and "ranks [0]" in err and "ranks [1]" in err, \
+            results
+        assert "(4,)" in err and "(8,)" in err, results
+        assert ok == ["t2"], results
+
+
+WORKER_MISMATCH = os.path.join(REPO, "tests", "data", "worker_mismatch.py")
+
+
+def test_torovodrun_shape_mismatch_fails_fast():
+    """Full-stack parity with the reference controller's consistency check:
+    mismatched shapes under one name fail that collective on BOTH ranks with
+    rank attribution, and the world keeps working afterwards."""
+    res = _run_torovodrun(2, WORKER_MISMATCH, timeout=300)
+    ok = res.stdout.count("MISMATCH_OK")
+    assert res.returncode == 0 and ok == 2, (
         f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
         f"stderr:\n{res.stderr[-3000:]}")
