@@ -98,6 +98,9 @@ class Config:
 
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
+    # Local-axis extent for the two-level (cross x local) collectives; 0 =
+    # derive from the topology's per-process device counts (multi-host).
+    hierarchical_local_size: int = 0
 
     autotune: bool = False
     autotune_log: str = ""
@@ -138,6 +141,7 @@ class Config:
             stall_check_disable=_env_bool("STALL_CHECK_DISABLE", False),
             hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool("HIERARCHICAL_ALLGATHER", False),
+            hierarchical_local_size=_env_int("HIERARCHICAL_LOCAL_SIZE", 0),
             autotune=_env_bool("AUTOTUNE", False),
             autotune_log=_env("AUTOTUNE_LOG", "") or "",
             autotune_warmup_samples=_env_int("AUTOTUNE_WARMUP_SAMPLES", 3),
