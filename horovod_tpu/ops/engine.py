@@ -242,6 +242,9 @@ class CollectiveEngine:
                                     cfg.stall_check_disable)
         self.cycle_time_s = cfg.cycle_time_ms / 1000.0
         self.fusion_threshold = cfg.fusion_threshold_bytes
+        self.hierarchical_allreduce = cfg.hierarchical_allreduce
+        self.hierarchical_allgather = cfg.hierarchical_allgather
+        self._hier_local_size = cfg.hierarchical_local_size
         self._handle_counter = itertools.count(1)
         self._handles: Dict[int, TensorTableEntry] = {}
         self._handles_lock = threading.Lock()
@@ -488,6 +491,33 @@ class CollectiveEngine:
         ps = self._state.process_set_table.get(ps_id)
         return ps.mesh, ps.axis_name, ps.size()
 
+    def _hier_mesh(self, ps_id: int):
+        """2-D (cross, local) mesh for two-level collectives, or None.
+
+        Reference parity: ``HOROVOD_HIERARCHICAL_ALLREDUCE`` in
+        ``horovod/common/ops/nccl_operations.cc`` (SURVEY.md N17) splits the
+        world into NCCL-intra-node × MPI-cross-node; here the split is
+        local = ICI within a host, cross = DCN between hosts.  The local
+        extent comes from the topology's per-process device counts, or from
+        ``HOROVOD_HIERARCHICAL_LOCAL_SIZE`` (single-process tests / explicit
+        override).  Only the global process set is eligible — subgroup
+        process sets keep the flat path.
+        """
+        if ps_id != 0:
+            return None
+        topo = self._state.topology
+        ps = self._state.process_set_table.get(ps_id)
+        world = ps.size()
+        local = self._hier_local_size
+        if local <= 0:
+            counts = topo.local_counts if topo is not None else []
+            if len(counts) > 1 and all(c == counts[0] for c in counts):
+                local = counts[0]
+        if local <= 1 or world % local or world // local <= 1:
+            return None
+        devs = np.asarray(ps.mesh.devices).reshape(world // local, local)
+        return Mesh(devs, ("cross", "local"))
+
     def _execute_batch(self, batch: List[TensorTableEntry]) -> List[Any]:
         e0 = batch[0]
         if e0.ctype == CollectiveType.BARRIER:
@@ -496,7 +526,8 @@ class CollectiveEngine:
         shapes = tuple(tuple(e.tensor.shape) for e in batch)
         dtypes = tuple(str(e.tensor.dtype) for e in batch)
         donate = tuple(e.donate for e in batch)
-        key = (_fusion_key(e0), shapes, dtypes, donate)
+        key = (_fusion_key(e0), shapes, dtypes, donate,
+               self.hierarchical_allreduce, self.hierarchical_allgather)
         fn, hit = self.cache.get_or_build2(
             key, lambda: self._build_program(e0, shapes, dtypes, mesh, axis,
                                              world, donate))
@@ -535,12 +566,24 @@ class CollectiveEngine:
             return jax.jit(fn, donate_argnums=dargs)
 
         if ctype == CollectiveType.ALLREDUCE:
+            if (self.hierarchical_allreduce
+                    and proto.reduce_op in (C.ReduceOp.SUM,
+                                            C.ReduceOp.AVERAGE)):
+                hmesh = self._hier_mesh(proto.process_set_id)
+                if hmesh is not None:
+                    return self._build_hier_allreduce(
+                        proto, shapes, dtypes, hmesh, world, _jit)
             return self._build_allreduce(proto, shapes, dtypes, mesh, axis,
                                          world, _jit)
         if ctype == CollectiveType.BROADCAST:
             return self._build_broadcast(proto, shapes, mesh, axis, world,
                                          _jit)
         if ctype == CollectiveType.ALLGATHER:
+            if self.hierarchical_allgather:
+                hmesh = self._hier_mesh(proto.process_set_id)
+                if hmesh is not None:
+                    return self._build_hier_allgather(
+                        proto, shapes, hmesh, world, _jit)
             return self._build_allgather(proto, shapes, mesh, axis, world,
                                          _jit)
         if ctype == CollectiveType.REDUCESCATTER:
@@ -643,6 +686,74 @@ class CollectiveEngine:
         return _jit(shard_map(
             body, mesh=mesh,
             in_specs=tuple(P(axis) for _ in shapes),
+            out_specs=tuple(P() for _ in shapes), check_vma=False))
+
+    def _build_hier_allreduce(self, proto, shapes, dtypes, hmesh, world,
+                              _jit=jax.jit):
+        """Two-level fused allreduce: RS(local) → AR(cross) → AG(local).
+
+        Same fusion/dtype-grouping contract as ``_build_allreduce``, but the
+        reduction runs over a (cross, local) mesh so bytes over the slow
+        cross links drop by 1/local_size (reference N17's hierarchical
+        path; SURVEY.md §2c).
+        """
+        from ..parallel.hierarchical import hierarchical_allreduce
+        op = proto.reduce_op
+        pre, post = proto.prescale_factor, proto.postscale_factor
+        per_rank_shapes = [s[1:] for s in shapes]
+        sizes = [int(np.prod(s)) if s else 1 for s in per_rank_shapes]
+        dtype_groups: Dict[str, List[int]] = {}
+        for i, dt in enumerate(dtypes):
+            dtype_groups.setdefault(dt, []).append(i)
+        average = op == C.ReduceOp.AVERAGE
+
+        def per_shard(*xs):
+            outs: List[Any] = [None] * len(xs)
+            for dt, idxs in dtype_groups.items():
+                flat = jnp.concatenate([xs[i].reshape(-1) for i in idxs]) \
+                    if len(idxs) > 1 else xs[idxs[0]].reshape(-1)
+                avg = average and jnp.issubdtype(flat.dtype, jnp.floating)
+                red = hierarchical_allreduce(
+                    C._scale(flat, pre), "cross", "local", average=avg)
+                if average and not avg:
+                    red = red // world
+                red = C._scale(red, post)
+                off = 0
+                for i in idxs:
+                    outs[i] = red[off:off + sizes[i]].reshape(per_rank_shapes[i])
+                    off += sizes[i]
+            return tuple(outs)
+
+        in_specs = tuple(P(("cross", "local")) for _ in shapes)
+        out_specs = tuple(P() for _ in shapes)
+
+        def wrapper(*xs):
+            def body(*shards):
+                return per_shard(*[s.reshape(s.shape[1:]) for s in shards])
+            return shard_map(body, mesh=hmesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)(*xs)
+
+        return _jit(wrapper)
+
+    def _build_hier_allgather(self, proto, shapes, hmesh, world,
+                              _jit=jax.jit):
+        """Two-level allgather: AG(local) → AG(cross).
+
+        Rank order is cross-major × local-minor, matching the flat world
+        order (devices are reshaped (cross, local) from the same ordered
+        list), so results are byte-identical to the flat path.
+        """
+        def body(*shards):
+            outs = []
+            for s in shards:
+                x = s.reshape(s.shape[1:])
+                x = lax.all_gather(x, "local", axis=0, tiled=True)
+                outs.append(lax.all_gather(x, "cross", axis=0, tiled=True))
+            return tuple(outs)
+
+        return _jit(shard_map(
+            body, mesh=hmesh,
+            in_specs=tuple(P(("cross", "local")) for _ in shapes),
             out_specs=tuple(P() for _ in shapes), check_vma=False))
 
     def _build_reducescatter(self, proto, shapes, mesh, axis, world,

@@ -204,3 +204,84 @@ def test_requeue_preserves_entries(hvd, world_size):
         assert eng.controller.calls >= 2
     finally:
         eng.controller = None
+
+
+class TestHierarchicalAllreduce:
+    """HOROVOD_HIERARCHICAL_ALLREDUCE must change the executed program to
+    the RS(local)→AR(cross)→AG(local) three-phase (reference N17 parity) and
+    keep numerics identical to the flat path."""
+
+    def _reinit(self, **env):
+        import horovod_tpu as hvd
+        hvd.shutdown()
+        for k, v in env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        hvd.init()
+        return hvd
+
+    def _lower_allreduce(self, eng, x):
+        from horovod_tpu.ops import collectives as C
+        from horovod_tpu.ops.engine import CollectiveType, TensorTableEntry
+        proto = TensorTableEntry(handle=0, name="h",
+                                 ctype=CollectiveType.ALLREDUCE, tensor=None,
+                                 reduce_op=C.ReduceOp.SUM)
+        mesh, axis, world = eng._mesh_axis(0)
+        fn = eng._build_program(proto, (tuple(x.shape),), (str(x.dtype),),
+                                mesh, axis, world)
+        return fn.lower(x).as_text()
+
+    def test_flag_changes_program_and_numerics(self, world_size):
+        import horovod_tpu.ops.eager as eager
+        local = 4 if world_size % 4 == 0 else 2
+        hvd = self._reinit(HOROVOD_HIERARCHICAL_ALLREDUCE="1",
+                           HOROVOD_HIERARCHICAL_LOCAL_SIZE=str(local))
+        try:
+            eng = eager._engine()
+            hmesh = eng._hier_mesh(0)
+            assert hmesh is not None
+            assert hmesh.devices.shape == (world_size // local, local)
+
+            x = _stacked(hvd, world_size, shape=(7,), seed=11)
+            hlo = self._lower_allreduce(eng, x)
+            assert "reduce_scatter" in hlo, "no RS phase in hierarchical HLO"
+            assert "all_gather" in hlo, "no AG phase in hierarchical HLO"
+
+            out = hvd.allreduce(x, op=hvd.Average)
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.mean(np.asarray(x), 0), rtol=1e-5)
+            out = hvd.allreduce(x, op=hvd.Sum)
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.sum(np.asarray(x), 0), rtol=1e-5)
+            # allgather stays flat unless its own flag is set; result parity:
+            g = hvd.allgather(_stacked(hvd, world_size, shape=(3,), seed=12))
+            assert np.asarray(g).shape == (world_size * 3,)
+        finally:
+            hvd = self._reinit(HOROVOD_HIERARCHICAL_ALLREDUCE=None,
+                               HOROVOD_HIERARCHICAL_LOCAL_SIZE=None)
+
+    def test_flat_path_has_no_reduce_scatter(self, hvd, world_size):
+        import horovod_tpu.ops.eager as eager
+        eng = eager._engine()
+        assert eng._hier_mesh(0) is None  # single process, no override
+        x = _stacked(hvd, world_size, shape=(7,), seed=11)
+        hlo = self._lower_allreduce(eng, x)
+        assert "reduce_scatter" not in hlo
+
+    def test_hierarchical_allgather(self, world_size):
+        import horovod_tpu.ops.eager as eager
+        local = 4 if world_size % 4 == 0 else 2
+        hvd = self._reinit(HOROVOD_HIERARCHICAL_ALLGATHER="1",
+                           HOROVOD_HIERARCHICAL_LOCAL_SIZE=str(local))
+        try:
+            eng = eager._engine()
+            x = _stacked(hvd, world_size, shape=(3, 2), seed=13)
+            out = hvd.allgather(x)
+            np.testing.assert_allclose(
+                np.asarray(out),
+                np.concatenate(list(np.asarray(x)), axis=0), rtol=1e-6)
+        finally:
+            hvd = self._reinit(HOROVOD_HIERARCHICAL_ALLGATHER=None,
+                               HOROVOD_HIERARCHICAL_LOCAL_SIZE=None)
