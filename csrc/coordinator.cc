@@ -22,17 +22,27 @@
 //              so rank 0 can reject divergent submissions (the reference
 //              controller's shape/dtype consistency checks, SURVEY.md N2).
 //              A round with nothing new sends n_announce = 0)
-//   S->C   := uint32 n_ready,   n_ready * { uint16 len, bytes name }
+//   S->C   := uint32 n_ready,   n_ready * { uint16 len, bytes name,
+//                                           uint16 dlen, bytes digest }
 //             uint32 n_warn,    n_warn  * { uint16 len, bytes text }
 //             uint32 n_err,     n_err   * { uint16 len, bytes name,
 //                                           uint16 mlen, bytes message }
 //             (ready = pending on ALL ranks, in deterministic order:
-//              first-announce round, then name; warn = stall diagnoses
-//              naming the missing ranks, the reference's stall_inspector
-//              output; err = per-tensor negotiation failures — digest
-//              mismatch across ranks — broadcast until every required rank
-//              has announced the name, the reference's per-tensor error
-//              Response)
+//              first-announce round, then name; the digest rides along so
+//              JOINED ranks can synthesize zero contributions for tensors
+//              they never submitted — the reference's hvd.join() semantics;
+//              warn = stall diagnoses naming the missing ranks, the
+//              reference's stall_inspector output; err = per-tensor
+//              negotiation failures — digest mismatch across ranks —
+//              broadcast until every required rank has announced the name,
+//              the reference's per-tensor error Response)
+//
+// join protocol: announcing the reserved name "\x1f__join__" marks the
+// sender joined (reference: hvd.join, horovod/common/controller.cc's join
+// handling).  Joined ranks count as implicitly ready for every world-level
+// tensor.  When ALL ranks have joined, the server broadcasts the reserved
+// ready entry "\x1f__all_joined__" whose digest is the last joining rank,
+// then resets join state (the world resumes normal operation).
 //
 // Exported C ABI (ctypes-consumed by horovod_tpu/common/native.py):
 //   hvdtpu_server_start(port, world) -> handle
@@ -172,6 +182,8 @@ struct Server {
   std::map<std::string, PendingInfo> pending;
   uint64_t announce_seq = 0;
   double stall_warn_s = 60.0;
+  std::set<int> joined;
+  int last_joined = -1;
 
   void run();
   void run_inner();
@@ -230,6 +242,11 @@ void Server::run_inner() {
         uint16_t required = rd.u16();
         std::string name = rd.str();
         std::string digest = rd.str();
+        if (name == "\x1f__join__") {
+          joined.insert(r);
+          last_joined = r;
+          continue;
+        }
         auto it = pending.find(name);
         if (it == pending.end()) {
           PendingInfo info;
@@ -251,16 +268,25 @@ void Server::run_inner() {
     }
     if (stop.load()) break;
 
-    // Ready = reported by every rank; deterministic order by announce seq.
+    // Ready = reported by every rank (joined ranks count as implicitly
+    // ready for world-level tensors); deterministic order by announce seq.
     // Errored tensors are never ready: their error is broadcast every round
     // until all required ranks have announced (so each has a local entry to
     // fail), then dropped.
-    std::vector<std::pair<uint64_t, std::string>> ready;
+    std::vector<std::tuple<uint64_t, std::string, std::string>> ready;
     std::vector<std::string> warns;
     std::vector<std::pair<std::string, std::string>> errs;
     auto now = Clock::now();
     for (auto it = pending.begin(); it != pending.end();) {
       auto& info = it->second;
+      // Effective announce count: joined ranks are implicitly ready, but
+      // only toward the full-world threshold (join is a world-level
+      // operation; subgroup process-set collectives stay strict).
+      int have = static_cast<int>(info.ready_ranks.size());
+      if (info.required == world) {
+        for (int jr : joined)
+          if (!info.ready_ranks.count(jr)) ++have;
+      }
       if (info.errored) {
         // Per-tensor error naming every rank on each side of the
         // divergence, rebuilt each round so late announcers are included.
@@ -278,15 +304,15 @@ void Server::run_inner() {
           msg += "ranks [" + rs + "] announced " + d;
         }
         errs.emplace_back(it->first, msg);
-        if (static_cast<int>(info.ready_ranks.size()) >= info.required) {
+        if (have >= info.required) {
           it = pending.erase(it);
           continue;
         }
         ++it;
         continue;
       }
-      if (static_cast<int>(info.ready_ranks.size()) >= info.required) {
-        ready.emplace_back(info.order, it->first);
+      if (have >= info.required) {
+        ready.emplace_back(info.order, it->first, info.digest);
         it = pending.erase(it);
         continue;
       }
@@ -296,7 +322,11 @@ void Server::run_inner() {
         info.warned = true;
         std::string missing;
         for (int r = 0; r < world; ++r) {
-          if (!info.ready_ranks.count(r)) {
+          // Joined ranks are exempt only where they get implicit-ready
+          // credit (world-level tensors); for subgroup tensors a joined
+          // member really is the missing party — name it.
+          if (!info.ready_ranks.count(r) &&
+              !(info.required == world && joined.count(r))) {
             if (!missing.empty()) missing += ",";
             missing += std::to_string(r);
           }
@@ -308,10 +338,21 @@ void Server::run_inner() {
       ++it;
     }
     std::sort(ready.begin(), ready.end());
+    if (world > 0 && static_cast<int>(joined.size()) == world) {
+      // Every rank joined: announce the epoch end (digest = last joiner)
+      // and reset so the world can resume normal collectives.
+      ready.emplace_back(UINT64_MAX, "\x1f__all_joined__",
+                         std::to_string(last_joined));
+      joined.clear();
+      last_joined = -1;
+    }
 
     std::vector<uint8_t> resp;
     put_u32(&resp, static_cast<uint32_t>(ready.size()));
-    for (auto& [ord, name] : ready) put_str(&resp, name);
+    for (auto& [ord, name, digest] : ready) {
+      put_str(&resp, name);
+      put_str(&resp, digest);
+    }
     put_u32(&resp, static_cast<uint32_t>(warns.size()));
     for (auto& w : warns) put_str(&resp, w);
     put_u32(&resp, static_cast<uint32_t>(errs.size()));

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import ctypes
 import struct
+import threading
 from typing import Dict, List, Optional, Sequence
 
 from . import native
@@ -57,9 +58,17 @@ class TCPController:
                 f"rank {rank}: failed to connect to controller at "
                 f"{addr}:{port}")
         self._announced: set = set()
-        self._early_ready: List[str] = []
+        self._early_ready: List[tuple] = []       # (name, digest)
         self._early_errors: Dict[str, str] = {}
         self._resp_buf = (ctypes.c_uint8 * _RESP_CAP)()
+        # join protocol state (reference: hvd.join semantics).  While this
+        # rank is joined, `synthesizer(name, digest)` — installed by the
+        # engine — builds a zero-contribution entry for peers' collectives.
+        self._join_pending = False
+        self._joined = False
+        self._join_event = threading.Event()
+        self._join_last_rank = -1
+        self.synthesizer = None
 
     # ------------------------------------------------------------- protocol
     def _round(self, announces: Sequence) -> tuple:
@@ -113,7 +122,7 @@ class TCPController:
                 off += ml
             return out
 
-        ready = read_list()
+        ready = read_pairs()        # (name, digest) — digest feeds join zeros
         warns = read_list()
         errors = read_pairs() if off < len(data) else []
         return ready, warns, errors
@@ -147,6 +156,10 @@ class TCPController:
         # fusion key), so divergence would desync batching across ranks.
         parts.append(str(getattr(e, "prescale_factor", None)))
         parts.append(str(getattr(e, "postscale_factor", None)))
+        # Group id rides along so a JOINED rank's synthesized entries keep
+        # the peers' grouped-batching atomicity (batch splits at the fusion
+        # threshold must be identical on every process).
+        parts.append(str(getattr(e, "group_id", -1)))
         return "|".join(parts)
 
     def negotiate(self, entries: List) -> tuple:
@@ -169,6 +182,10 @@ class TCPController:
                 required = _get_state().process_set_table.get(ps_id).size()
             new.append((n, required, self._digest(e)))
         self._announced.update(n for n, _, _ in new)
+        if self._join_pending:
+            self._join_pending = False
+            self._joined = True
+            new.append(("\x1f__join__", 0, ""))
         ready, warns, errors = self._round(new)
         for w in warns:
             log.warning("controller: %s", w)
@@ -179,15 +196,27 @@ class TCPController:
         ready = self._early_ready + ready
         self._early_ready = []
         out = []
-        for name in ready:
+        for name, digest in ready:
+            if name == "\x1f__all_joined__":
+                # Every rank joined: end the join epoch (digest = last
+                # joining rank) and unblock the join() caller.
+                self._joined = False
+                self._join_last_rank = int(digest)
+                self._join_event.set()
+                continue
             e = by_name.pop(name, None)
             if e is None:
                 # The server broadcasts ready verdicts to every rank; a name
-                # this rank never announced (e.g. another process set's
-                # collective) is not ours — dropping it here keeps
-                # _early_ready from growing unboundedly on non-member ranks.
+                # this rank never announced is either another process set's
+                # collective (wire names carry a "\x1f" set prefix — not
+                # ours, drop) or — while this rank is JOINED — a world
+                # collective peers submitted, for which we synthesize a
+                # zero contribution (reference join semantics).
                 if name in self._announced:
-                    self._early_ready.append(name)
+                    self._early_ready.append((name, digest))
+                elif self._joined and "\x1f" not in name \
+                        and self.synthesizer is not None:
+                    out.append(self.synthesizer(name, digest))
                 continue
             self._announced.discard(name)
             out.append(e)
@@ -219,8 +248,24 @@ class TCPController:
         n = self._wire_name(e)
         self._announced.discard(n)
         self._early_errors.pop(n, None)
-        if n in self._early_ready:
-            self._early_ready.remove(n)
+        self._early_ready = [(rn, d) for rn, d in self._early_ready
+                             if rn != n]
+
+    # --------------------------------------------------------------- join
+    def request_join(self):
+        """Mark this rank joined as of the next negotiation round
+        (reference: hvd.join).  The engine keeps cycling; peers' world
+        collectives execute here with synthesized zero contributions until
+        every rank has joined."""
+        self._join_event.clear()
+        self._join_pending = True
+
+    def join_wait(self, timeout: Optional[float] = None) -> int:
+        """Block until every rank joined; returns the last rank to join."""
+        if not self._join_event.wait(timeout):
+            raise TimeoutError("join() did not complete: some ranks have "
+                               "not joined")
+        return self._join_last_rank
 
     def interrupt(self):
         """Unblock any thread stuck in a lock-step round (socket shutdown,

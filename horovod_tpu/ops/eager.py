@@ -355,9 +355,10 @@ def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None,
 def alltoall_async(tensor, splits=None, name: Optional[str] = None,
                    process_set: Optional[ProcessSet] = None) -> int:
     if splits is not None:
-        raise NotImplementedError(
-            "Ragged alltoall splits land with the uneven-split planner; "
-            "even splits (splits=None) are supported")
+        raise ValueError(
+            "Ragged alltoall (splits=...) requires a size-exchange prologue "
+            "and result slicing, so it has no raw async handle; call the "
+            "blocking hvd.alltoall(tensor, splits) instead")
     ps_id = _ps(process_set)
     arr, owned = _as_stacked(tensor, ps_id)
     return _engine().enqueue(_auto_name("alltoall", name),
@@ -367,7 +368,104 @@ def alltoall_async(tensor, splits=None, name: Optional[str] = None,
 
 def alltoall(tensor, splits=None, name: Optional[str] = None,
              process_set: Optional[ProcessSet] = None):
+    """Even alltoall returns the gathered rows; with ``splits`` (the ragged
+    form, reference ``hvd.alltoall(tensor, splits)``) returns
+    ``(output, received_splits)``."""
+    if splits is not None:
+        return _ragged_alltoall(tensor, splits, name, process_set)
     return synchronize(alltoall_async(tensor, splits, name, process_set))
+
+
+def _pad_chunks(x, row, world: int, m: int):
+    """[n_r, *inner] rows split per ``row`` → zero-padded [world*m, *inner]."""
+    x = np.asarray(x)
+    inner = x.shape[1:]
+    out = np.zeros((world, m) + inner, x.dtype)
+    off = 0
+    for j in range(world):
+        s = int(row[j])
+        out[j, :s] = x[off:off + s]
+        off += s
+    if off != x.shape[0]:
+        raise ValueError(
+            f"splits sum to {off} but tensor has {x.shape[0]} rows")
+    return out.reshape((world * m,) + inner)
+
+
+def _ragged_alltoall(tensor, splits, name, process_set):
+    """Uneven alltoall: size-exchange prologue, pad-to-max, ONE even
+    engine alltoall, slice (reference: ``hvd.alltoall`` with splits /
+    ``recv_splits`` — SURVEY.md §2c DLRM config #5, VERDICT missing #5).
+
+    The send matrix is exchanged first (tiny allgather), making every
+    per-destination chunk size static; the payload then rides the normal
+    negotiated/fused even-alltoall with chunks padded to the max size, and
+    receivers slice out the real rows.  Static shapes keep the compiled
+    program cacheable across steps (DLRM splits are step-invariant).
+
+    Returns ``(output, received_splits)``; single-controller mode returns
+    per-rank lists (outputs are ragged and cannot stack).
+    """
+    ps_id = _ps(process_set)
+    st = basics._get_state()
+    ps = st.process_set_table.get(ps_id)
+    world = ps.size()
+    base = _auto_name("alltoallv", name)
+
+    if per_process_mode():
+        my_ranks = [i for i, d in enumerate(ps.mesh.devices.flat)
+                    if d.process_index == jax.process_index()]
+        n_local = len(my_ranks)
+        sp = np.asarray(splits, dtype=np.int64).reshape(n_local, world)
+        # Size-exchange prologue: every rank's [world] splits row.
+        sp_in = sp if n_local > 1 else sp[0]
+        sizes = synchronize(allgather_async(
+            sp_in, name=f"{base}.splits", process_set=process_set))
+        send = np.asarray(to_local(sizes)).reshape(world, world)
+        m = max(1, int(send.max()))
+        if n_local > 1:
+            # Per-local-rank rows are ragged too: take a list of arrays.
+            locals_ = [np.asarray(t) for t in tensor]
+            if len(locals_) != n_local:
+                raise ValueError(f"Multi-device process: pass a list of "
+                                 f"{n_local} per-rank tensors")
+        else:
+            locals_ = [np.asarray(tensor)]
+        inner = locals_[0].shape[1:]
+        padded = np.stack([_pad_chunks(locals_[i], sp[i], world, m)
+                           for i in range(n_local)])
+        payload = padded if n_local > 1 else padded[0]
+        res = synchronize(alltoall_async(
+            payload, name=f"{base}.payload", process_set=process_set))
+        res = np.asarray(to_local(res)).reshape((n_local, world * m) + inner)
+        outs, rsplits = [], []
+        for i, g in enumerate(my_ranks):
+            rows = [res[i, r * m: r * m + int(send[r, g])]
+                    for r in range(world)]
+            outs.append(np.concatenate(rows, axis=0))
+            rsplits.append(send[:, g].copy())
+        if n_local == 1:
+            return outs[0], rsplits[0]
+        return outs, np.stack(rsplits)
+
+    # Single-controller mode: per-rank ragged inputs as a list (a stacked
+    # array works too when rows happen to be even).
+    tensors = (list(tensor) if isinstance(tensor, (list, tuple))
+               else [np.asarray(tensor)[r] for r in range(world)])
+    if len(tensors) != world:
+        raise ValueError(f"Expected {world} per-rank tensors, got "
+                         f"{len(tensors)}")
+    send = np.asarray(splits, dtype=np.int64).reshape(world, world)
+    m = max(1, int(send.max()))
+    padded = np.stack([_pad_chunks(tensors[r], send[r], world, m)
+                       for r in range(world)])
+    res = synchronize(alltoall_async(
+        padded, name=f"{base}.payload", process_set=process_set))
+    res = np.asarray(res)
+    outs = [np.concatenate([res[j, r * m: r * m + int(send[r, j])]
+                            for r in range(world)], axis=0)
+            for j in range(world)]
+    return outs, send.T.copy()
 
 
 # -------------------------------------------------------------- reducescatter
@@ -408,11 +506,20 @@ def barrier(process_set: Optional[ProcessSet] = None):
     return _engine().synchronize(h)
 
 
-def join() -> int:
+def join(timeout: Optional[float] = None) -> int:
     """Signal this rank is done submitting work (reference: hvd.join).
 
-    Returns the last rank to join.  In single-controller mode every rank
-    joins simultaneously, so this drains the queue and returns size()-1.
+    Multi-process mode: this rank keeps participating in peers' world-level
+    collectives with synthesized ZERO contributions (uneven final batches —
+    the reference's join use case) until every rank has joined; returns the
+    last rank to join.  In single-controller mode every rank joins
+    simultaneously, so this drains the queue and returns size()-1.
     """
-    barrier()
-    return basics.size() - 1
+    eng = _engine()
+    ctrl = eng.controller
+    if ctrl is None:
+        barrier()
+        return basics.size() - 1
+    ctrl.request_join()
+    eng._wake.set()
+    return ctrl.join_wait(timeout)

@@ -392,6 +392,7 @@ class CollectiveEngine:
         """
         not_ready: List[TensorTableEntry] = []
         if self.controller is not None:
+            self.controller.synthesizer = self._synthesize_join_entry
             ready, errored = self.controller.negotiate(entries)
             # Per-tensor negotiation failures (shape/dtype divergence across
             # ranks): fail ONLY those waiters; the runtime stays up
@@ -490,6 +491,69 @@ class CollectiveEngine:
     def _mesh_axis(self, ps_id: int):
         ps = self._state.process_set_table.get(ps_id)
         return ps.mesh, ps.axis_name, ps.size()
+
+    @staticmethod
+    def _join_fill_value(ctype: CollectiveType, op: C.ReduceOp, dt: np.dtype):
+        """A joined rank's implicit contribution: the reduction's IDENTITY
+        element, so it cannot perturb the peers' result (reference: hvd.join
+        'a tensor of zeros' — generalized to non-additive ops; plain zeros
+        would zero out a PRODUCT or clamp a MAX of negatives)."""
+        if ctype not in (CollectiveType.ALLREDUCE,
+                         CollectiveType.REDUCESCATTER):
+            return 0          # broadcast/allgather/alltoall payload: zeros
+        if op == C.ReduceOp.PRODUCT:
+            return 1
+        if op in (C.ReduceOp.MIN, C.ReduceOp.MAX):
+            hi = op == C.ReduceOp.MIN    # identity for MIN is the dtype max
+            if dt == np.bool_:
+                return hi
+            try:
+                info = np.finfo(dt)      # ml_dtypes (bf16/fp8) support finfo
+            except ValueError:
+                info = np.iinfo(dt)
+            return info.max if hi else info.min
+        return 0              # SUM / AVERAGE (divisor stays world) / ADASUM
+
+    def _synthesize_join_entry(self, name: str, digest: str) -> TensorTableEntry:
+        """Implicit-contribution entry for a peer's collective while this
+        rank is JOINED (reference: hvd.join).  The digest (the same one
+        negotiation checks for consistency) carries op/dtype/shape/root/
+        group, so this rank can build and execute the byte-identical fused
+        program with a local identity contribution.
+        """
+        handle = next(self._handle_counter)
+        now = time.monotonic()   # fresh age: must not trip the stall check
+        if digest == "barrier":
+            return TensorTableEntry(handle=handle, name=name,
+                                    ctype=CollectiveType.BARRIER, tensor=None,
+                                    enqueue_time=now)
+        parts = digest.split("|")
+        ctype = CollectiveType(parts[0])
+        try:
+            dt = np.dtype(parts[1])
+        except TypeError:
+            import ml_dtypes
+            dt = np.dtype(getattr(ml_dtypes, parts[1]))
+        import ast
+        shape = tuple(ast.literal_eval(parts[2]))
+        op = C.ReduceOp[parts[3]]
+        root = int(parts[4])
+        pre = None if parts[5] == "None" else float(parts[5])
+        post = None if parts[6] == "None" else float(parts[6])
+        group_id = int(parts[7]) if len(parts) > 7 else -1
+        ps = self._state.process_set_table.get(0)
+        sharding = NamedSharding(ps.mesh, P(ps.axis_name))
+        local_devs = [d for d in ps.mesh.devices.flat
+                      if d.process_index == jax.process_index()]
+        fill = np.full((1,) + shape,
+                       self._join_fill_value(ctype, op, dt), dt)
+        shards = [jax.device_put(fill, d) for d in local_devs]
+        arr = jax.make_array_from_single_device_arrays(
+            (ps.size(),) + shape, sharding, shards)
+        return TensorTableEntry(
+            handle=handle, name=name, ctype=ctype, tensor=arr, reduce_op=op,
+            root_rank=root, prescale_factor=pre, postscale_factor=post,
+            group_id=group_id, donate=True, enqueue_time=now)
 
     def _hier_mesh(self, ps_id: int):
         """2-D (cross, local) mesh for two-level collectives, or None.
@@ -594,20 +658,50 @@ class CollectiveEngine:
                                         _jit)
         raise ValueError(f"Unsupported collective: {ctype}")
 
-    def _build_allreduce(self, proto, shapes, dtypes, mesh, axis, world,
-                         _jit=jax.jit):
-        op = proto.reduce_op
+    def _build_fused_reduce(self, proto, shapes, dtypes, mesh_, in_spec,
+                            reduce_flat, _jit):
+        """Shared fused-reduction scaffold (flat + hierarchical allreduce):
+        flatten each tensor's per-rank shard, concatenate per dtype (one
+        reduce per distinct dtype — XLA's collective combiner merges them
+        into a single wire transfer, keeping mixed-dtype groups atomic
+        without promotion), apply pre/post scaling around ``reduce_flat``,
+        and slice results back out."""
         pre, post = proto.prescale_factor, proto.postscale_factor
         per_rank_shapes = [s[1:] for s in shapes]
         sizes = [int(np.prod(s)) if s else 1 for s in per_rank_shapes]
-        # Fuse per dtype: one concat+reduce per distinct dtype; XLA's
-        # collective combiner merges them into a single wire transfer, so
-        # mixed-dtype groups stay atomic without dtype promotion.
         dtype_groups: Dict[str, List[int]] = {}
         for i, dt in enumerate(dtypes):
             dtype_groups.setdefault(dt, []).append(i)
 
-        def _reduce_flat(flat):
+        def per_shard(*xs):
+            # xs: per-rank values, each [*S] — flatten, fuse per dtype.
+            outs: List[Any] = [None] * len(xs)
+            for dt, idxs in dtype_groups.items():
+                flat = jnp.concatenate([xs[i].reshape(-1) for i in idxs]) \
+                    if len(idxs) > 1 else xs[idxs[0]].reshape(-1)
+                red = C._scale(reduce_flat(C._scale(flat, pre)), post)
+                off = 0
+                for i in idxs:
+                    outs[i] = red[off:off + sizes[i]].reshape(per_rank_shapes[i])
+                    off += sizes[i]
+            return tuple(outs)
+
+        def wrapper(*xs):
+            # Each stacked input [world, *S] → shard [1, *S]; reshape inside.
+            def body(*shards):
+                return per_shard(*[s.reshape(s.shape[1:]) for s in shards])
+            return shard_map(body, mesh=mesh_,
+                             in_specs=tuple(in_spec for _ in shapes),
+                             out_specs=tuple(P() for _ in shapes),
+                             check_vma=False)(*xs)
+
+        return _jit(wrapper)
+
+    def _build_allreduce(self, proto, shapes, dtypes, mesh, axis, world,
+                         _jit=jax.jit):
+        op = proto.reduce_op
+
+        def reduce_flat(flat):
             if op in (C.ReduceOp.AVERAGE, C.ReduceOp.SUM):
                 red = lax.psum(flat, axis)
                 if op == C.ReduceOp.AVERAGE:
@@ -627,30 +721,8 @@ class CollectiveEngine:
                 raise ValueError(f"Unknown ReduceOp {op}")
             return red
 
-        def per_shard(*xs):
-            # xs: per-rank values, each [*S] — flatten, fuse per dtype.
-            outs: List[Any] = [None] * len(xs)
-            for dt, idxs in dtype_groups.items():
-                flat = jnp.concatenate([xs[i].reshape(-1) for i in idxs]) \
-                    if len(idxs) > 1 else xs[idxs[0]].reshape(-1)
-                red = C._scale(_reduce_flat(C._scale(flat, pre)), post)
-                off = 0
-                for i in idxs:
-                    outs[i] = red[off:off + sizes[i]].reshape(per_rank_shapes[i])
-                    off += sizes[i]
-            return tuple(outs)
-
-        in_specs = tuple(P(axis) for _ in shapes)
-        out_specs = tuple(P() for _ in shapes)
-
-        def wrapper(*xs):
-            # Each stacked input [world, *S] → shard [1, *S]; reshape inside.
-            def body(*shards):
-                return per_shard(*[s.reshape(s.shape[1:]) for s in shards])
-            return shard_map(body, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)(*xs)
-
-        return _jit(wrapper)
+        return self._build_fused_reduce(proto, shapes, dtypes, mesh, P(axis),
+                                        reduce_flat, _jit)
 
     def _build_broadcast(self, proto, shapes, mesh, axis, world,
                          _jit=jax.jit):
@@ -692,48 +764,25 @@ class CollectiveEngine:
                               _jit=jax.jit):
         """Two-level fused allreduce: RS(local) → AR(cross) → AG(local).
 
-        Same fusion/dtype-grouping contract as ``_build_allreduce``, but the
-        reduction runs over a (cross, local) mesh so bytes over the slow
-        cross links drop by 1/local_size (reference N17's hierarchical
-        path; SURVEY.md §2c).
+        Same fusion/dtype-grouping contract as ``_build_allreduce`` (via the
+        shared ``_build_fused_reduce``), but the reduction runs over a
+        (cross, local) mesh so bytes over the slow cross links drop by
+        1/local_size (reference N17's hierarchical path; SURVEY.md §2c).
         """
         from ..parallel.hierarchical import hierarchical_allreduce
         op = proto.reduce_op
-        pre, post = proto.prescale_factor, proto.postscale_factor
-        per_rank_shapes = [s[1:] for s in shapes]
-        sizes = [int(np.prod(s)) if s else 1 for s in per_rank_shapes]
-        dtype_groups: Dict[str, List[int]] = {}
-        for i, dt in enumerate(dtypes):
-            dtype_groups.setdefault(dt, []).append(i)
-        average = op == C.ReduceOp.AVERAGE
 
-        def per_shard(*xs):
-            outs: List[Any] = [None] * len(xs)
-            for dt, idxs in dtype_groups.items():
-                flat = jnp.concatenate([xs[i].reshape(-1) for i in idxs]) \
-                    if len(idxs) > 1 else xs[idxs[0]].reshape(-1)
-                avg = average and jnp.issubdtype(flat.dtype, jnp.floating)
-                red = hierarchical_allreduce(
-                    C._scale(flat, pre), "cross", "local", average=avg)
-                if average and not avg:
-                    red = red // world
-                red = C._scale(red, post)
-                off = 0
-                for i in idxs:
-                    outs[i] = red[off:off + sizes[i]].reshape(per_rank_shapes[i])
-                    off += sizes[i]
-            return tuple(outs)
+        def reduce_flat(flat):
+            avg = (op == C.ReduceOp.AVERAGE
+                   and jnp.issubdtype(flat.dtype, jnp.floating))
+            red = hierarchical_allreduce(flat, "cross", "local", average=avg)
+            if op == C.ReduceOp.AVERAGE and not avg:
+                red = red // world
+            return red
 
-        in_specs = tuple(P(("cross", "local")) for _ in shapes)
-        out_specs = tuple(P() for _ in shapes)
-
-        def wrapper(*xs):
-            def body(*shards):
-                return per_shard(*[s.reshape(s.shape[1:]) for s in shards])
-            return shard_map(body, mesh=hmesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)(*xs)
-
-        return _jit(wrapper)
+        return self._build_fused_reduce(proto, shapes, dtypes, hmesh,
+                                        P(("cross", "local")), reduce_flat,
+                                        _jit)
 
     def _build_hier_allgather(self, proto, shapes, hmesh, world,
                               _jit=jax.jit):

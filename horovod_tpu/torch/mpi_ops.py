@@ -305,18 +305,46 @@ def alltoall_async(tensor: torch.Tensor, splits=None,
                    name: Optional[str] = None,
                    process_set: Optional[ProcessSet] = None) -> int:
     world = _set_size(process_set)
+    if splits is not None:
+        raise ValueError(
+            "Ragged alltoall (splits=...) has no async handle (it needs a "
+            "size-exchange prologue); call the blocking "
+            "hvd.alltoall(tensor, splits) instead")
     if tensor.shape[0] % world != 0:
         raise ValueError(
             f"alltoall with even splits needs dim0 divisible by the "
             f"process set size ({world}); got {tuple(tensor.shape)}")
-    inner = eager.alltoall_async(_submit(tensor, process_set), splits=splits,
+    inner = eager.alltoall_async(_submit(tensor, process_set), splits=None,
                                  name=name, process_set=process_set)
     return _register(inner, tensor, postprocess=_take_my_row)
 
 
 def alltoall(tensor: torch.Tensor, splits=None, name: Optional[str] = None,
-             process_set: Optional[ProcessSet] = None) -> torch.Tensor:
-    return synchronize(alltoall_async(tensor, splits, name, process_set))
+             process_set: Optional[ProcessSet] = None):
+    """Even splits: returns the gathered tensor.  With ``splits``: returns
+    ``(output, received_splits)`` (reference ``hvd.alltoall`` ragged form)."""
+    if splits is None:
+        return synchronize(alltoall_async(tensor, splits, name, process_set))
+    world = _set_size(process_set)
+    sp = (splits.detach().cpu().numpy() if isinstance(splits, torch.Tensor)
+          else np.asarray(splits)).astype(np.int64).reshape(-1)
+    if sp.size != world:
+        raise ValueError(f"splits must have {world} entries, got {sp.size}")
+    x = _to_numpy(tensor)
+    if eager.per_process_mode():
+        out, rsp = eager.alltoall(x, splits=sp, name=name,
+                                  process_set=process_set)
+    else:
+        # Single-controller SPMD: every rank contributes this tensor+splits
+        # (the torch convention, see module docstring); this rank's output.
+        outs, rsps = eager.alltoall([x] * world,
+                                    splits=np.tile(sp, (world, 1)),
+                                    name=name, process_set=process_set)
+        r = basics.rank()
+        out, rsp = outs[r], rsps[r]
+    return (_from_numpy(np.ascontiguousarray(out), tensor.dtype,
+                        tensor.device),
+            torch.from_numpy(np.ascontiguousarray(rsp)))
 
 
 # -------------------------------------------------------------- reducescatter

@@ -112,6 +112,21 @@ def main():
                     out, np.full((2,), 10.0 * (size - 2)))
         hvd.barrier()
 
+    # Ragged alltoall (DLRM-style uneven embedding exchange, SURVEY.md §2c
+    # config #5): rank r sends (r + j + 1) rows of value 100*r + j to rank j.
+    dim = 3
+    my_splits = np.array([rank + j + 1 for j in range(size)], np.int64)
+    payload = np.concatenate(
+        [np.full((rank + j + 1, dim), 100.0 * rank + j, np.float32)
+         for j in range(size)], axis=0)
+    out, rsplits = hvd.alltoall(payload, splits=my_splits, name="a2av")
+    np.testing.assert_array_equal(
+        rsplits, np.array([r + rank + 1 for r in range(size)], np.int64))
+    expected = np.concatenate(
+        [np.full((r + rank + 1, dim), 100.0 * r + rank, np.float32)
+         for r in range(size)], axis=0)
+    np.testing.assert_array_equal(out, expected)
+
     print(f"WORKER_OK rank={rank}")
     hvd.shutdown()
 

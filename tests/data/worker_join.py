@@ -1,0 +1,75 @@
+"""Multi-process join() test: uneven batch counts across ranks
+(reference: ``hvd.join`` in ``horovod/torch/mpi_ops.py`` — a rank that runs
+out of data joins; peers keep reducing and the joined rank auto-contributes
+zeros until everyone joins).  Launched by torovodrun in
+test_multiprocess.py.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    # Rank r processes (r + 1) batches: rank 0 joins first, last rank last.
+    n_batches = rank + 1
+    x = np.full((4,), float(rank + 1), np.float32)
+    for step in range(n_batches):
+        out = hvd.to_local(hvd.allreduce(x, name=f"grad_{step}", op=hvd.Sum))
+        # Ranks with fewer batches have joined and contribute zeros.
+        expected = sum(float(r + 1) for r in range(size) if r + 1 > step)
+        np.testing.assert_allclose(out, np.full((4,), expected), rtol=1e-6,
+                                   err_msg=f"step={step} rank={rank}")
+    last = hvd.join()
+    assert last == size - 1, f"join returned {last}, want {size - 1}"
+
+    # The world resumes normal operation after everyone joined.
+    out = hvd.to_local(hvd.allreduce(x, name="after_join", op=hvd.Sum))
+    expected = sum(float(r + 1) for r in range(size))
+    np.testing.assert_allclose(out, np.full((4,), expected), rtol=1e-6)
+
+    # Epoch 2: a joined rank must contribute the reduction IDENTITY (not
+    # plain zeros: zeros would clamp a MAX of negatives / zero a PRODUCT),
+    # and synthesized grouped entries must batch exactly like the peers'.
+    if size >= 2:
+        if rank == 0:
+            last = hvd.join()
+        else:
+            active = range(1, size)
+            out = hvd.to_local(hvd.allreduce(
+                np.full((3,), -(rank + 2.0), np.float32), name="mx",
+                op=hvd.Max))
+            np.testing.assert_allclose(
+                out, np.full((3,), max(-(r + 2.0) for r in active)))
+            out = hvd.to_local(hvd.allreduce(
+                np.full((2,), float(rank + 2), np.float32), name="pr",
+                op=hvd.Product))
+            np.testing.assert_allclose(
+                out, np.full((2,), np.prod([float(r + 2) for r in active])))
+            outs = hvd.grouped_allreduce(
+                [np.full((2,), float(rank), np.float32),
+                 np.full((5,), 2.0 * rank, np.float32)],
+                name="jgrp", op=hvd.Sum)
+            np.testing.assert_allclose(
+                hvd.to_local(outs[0]), np.full((2,), sum(float(r) for r in active)))
+            np.testing.assert_allclose(
+                hvd.to_local(outs[1]), np.full((5,), sum(2.0 * r for r in active)))
+            last = hvd.join()
+        assert last == size - 1, f"epoch-2 join returned {last}"
+
+    print(f"JOIN_OK rank={rank}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

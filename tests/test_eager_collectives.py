@@ -214,3 +214,31 @@ def test_host_input_donated_path(hvd, world_size):
     np.testing.assert_allclose(np.asarray(out), stacked.sum(0), rtol=1e-6)
     out = hvd.allgather(stacked, name="donate_np_ag")
     np.testing.assert_allclose(np.asarray(out), np.concatenate(vals))
+
+
+def test_alltoall_ragged(hvd, world_size):
+    """Uneven splits (reference hvd.alltoall(tensor, splits)): rank r sends
+    (r + j + 1) rows of value 100*r + j to rank j, embedding-style [n, dim]
+    payload (DLRM exchange shape, SURVEY.md §2c config #5)."""
+    w, dim = world_size, 3
+    splits = np.array([[r + j + 1 for j in range(w)] for r in range(w)],
+                      dtype=np.int64)
+    tensors = []
+    for r in range(w):
+        rows = [np.full((r + j + 1, dim), 100.0 * r + j, np.float32)
+                for j in range(w)]
+        tensors.append(np.concatenate(rows, axis=0))
+    outs, rsplits = hvd.alltoall(tensors, splits=splits)
+    assert len(outs) == w
+    np.testing.assert_array_equal(rsplits, splits.T)
+    for j in range(w):
+        expected = np.concatenate(
+            [np.full((r + j + 1, dim), 100.0 * r + j, np.float32)
+             for r in range(w)], axis=0)
+        np.testing.assert_array_equal(outs[j], expected)
+
+
+def test_alltoall_ragged_async_rejected(hvd):
+    with pytest.raises(ValueError, match="blocking"):
+        hvd.alltoall_async(np.zeros((4, 2), np.float32),
+                           splits=np.array([1, 3]))

@@ -180,3 +180,86 @@ def test_torovodrun_shape_mismatch_fails_fast():
     assert res.returncode == 0 and ok == 2, (
         f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
         f"stderr:\n{res.stderr[-3000:]}")
+
+
+WORKER_JOIN = os.path.join(REPO, "tests", "data", "worker_join.py")
+
+
+def test_torovodrun_join_uneven_batches():
+    """Real hvd.join() semantics (VERDICT missing #6): rank r trains r+1
+    batches then joins; peers keep reducing with the joined rank
+    auto-contributing zeros; join returns the last rank; world resumes."""
+    # Tiny fusion threshold: every cluster flushes its own batch, so a
+    # joined rank that loses peers' group structure would split a grouped
+    # collective into mismatched per-process programs (and hang).
+    res = _run_torovodrun(2, WORKER_JOIN, timeout=300,
+                          extra_env={"HOROVOD_FUSION_THRESHOLD": "1"})
+    ok = res.stdout.count("JOIN_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
+def test_controller_join_unit():
+    """Protocol-level join: rank 1 joins; rank 0's tensor becomes ready on
+    both sides (rank 1 synthesizing); then rank 0 joins and both observe
+    the all-joined epoch end."""
+    import threading
+    import numpy as np
+    from horovod_tpu.common.controller import TCPController
+
+    port = _free_port()
+    results = {}
+
+    class E:
+        def __init__(self, name):
+            self.name = name
+            self.tensor = np.zeros((2, 3), np.float32)
+
+    def worker(rank):
+        ctl = TCPController("127.0.0.1", port, rank=rank, world=2,
+                            stall_warn_s=60.0)
+        synthesized = []
+        ctl.synthesizer = lambda name, digest: ("zeros", name, digest)
+        try:
+            # No background engine thread here: each side must keep driving
+            # lock-step rounds itself until the all-joined verdict lands.
+            if rank == 1:
+                ctl.request_join()
+                got = []
+                for _ in range(60):
+                    ready, _err = ctl.negotiate([])
+                    got += ready
+                    if ctl._join_event.is_set():
+                        break
+                results[1] = (got, ctl.join_wait(timeout=1))
+            else:
+                ready = []
+                announced = False
+                for _ in range(60):
+                    r, _err = ctl.negotiate(
+                        [E("t")] if not announced else [])
+                    announced = True
+                    ready += r
+                    if ready and not ctl._join_pending and not ctl._joined \
+                            and not ctl._join_event.is_set():
+                        ctl.request_join()
+                    if ctl._join_event.is_set():
+                        break
+                results[0] = ([e.name for e in ready],
+                              ctl.join_wait(timeout=1))
+        finally:
+            ctl.shutdown()
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert 0 in results and 1 in results, results
+    names, last = results[0]
+    assert names == ["t"] and last == 0, results
+    syn, last1 = results[1]
+    assert last1 == 0, results
+    assert len(syn) == 1 and syn[0][0] == "zeros" and syn[0][1] == "t", results
+    assert "float32" in syn[0][2] and "(3,)" in syn[0][2], results

@@ -388,3 +388,26 @@ def test_broadcast_parameters_writes_back_non_tensor(tvd):
     assert sd["step"] == 7
     with pytest.raises(ValueError):
         hvd_torch.broadcast_parameters(iter([("step", 7)]), root_rank=0)
+
+
+def test_alltoall_ragged(tvd):
+    """Ragged splits via the torch surface (single-controller: every rank
+    contributes this tensor; this rank's output comes back)."""
+    w = tvd.size()
+    splits = torch.tensor([j + 1 for j in range(w)])
+    n = int(splits.sum())
+    t = torch.arange(n * 2, dtype=torch.float32).reshape(n, 2)
+    out, rsplits = tvd.alltoall(t, splits=splits, name="a2av_t")
+    # identical contributions: rank r receives every rank's chunk r
+    r = tvd.rank()
+    off = int(splits[:r].sum())
+    chunk = t[off:off + r + 1]
+    assert torch.equal(rsplits, torch.full((w,), r + 1, dtype=torch.int64))
+    assert out.shape == (w * (r + 1), 2)
+    for src in range(w):
+        assert torch.equal(out[src * (r + 1):(src + 1) * (r + 1)], chunk)
+
+
+def test_alltoall_async_splits_rejected(tvd):
+    with pytest.raises(ValueError, match="blocking"):
+        tvd.alltoall_async(torch.zeros(4, 2), splits=torch.tensor([1, 3]))
