@@ -14,16 +14,29 @@
 //   frame  := uint32 payload_len, payload
 //   C->S   := uint32 n_announce, n_announce * { uint16 required,
 //                                               uint16 len, bytes name,
-//                                               uint16 dlen, bytes digest }
+//                                               uint16 dlen, bytes digest,
+//                                               uint16 glen, bytes group,
+//                                               uint16 plen, bytes datadep }
 //             (names newly enqueued on this rank since the last round;
 //              `required` = number of ranks that must announce before the
 //              tensor is ready — process-set size; 0 means the full world.
 //              `digest` describes the submission — op|dtype|shape|root —
 //              so rank 0 can reject divergent submissions (the reference
 //              controller's shape/dtype consistency checks, SURVEY.md N2).
+//              `group` is the announcer's local grouped-collective id ("-1"
+//              for ungrouped) — NOT part of the mismatch comparison, since
+//              group counters legitimately drift across ranks (uneven join
+//              epochs); the server namespaces it by first-announcer rank
+//              and echoes it so joined ranks preserve group batching.
+//              `datadep` marks collectives that need real data from
+//              specific ranks: "-1" none (reductions), "-2" every rank
+//              (allgather/alltoall), or a root rank (broadcast) — if the
+//              needed rank has JOINED the server answers with a per-tensor
+//              error instead of fabricating data.
 //              A round with nothing new sends n_announce = 0)
 //   S->C   := uint32 n_ready,   n_ready * { uint16 len, bytes name,
-//                                           uint16 dlen, bytes digest }
+//                                           uint16 dlen, bytes digest,
+//                                           uint16 glen, bytes group }
 //             uint32 n_warn,    n_warn  * { uint16 len, bytes text }
 //             uint32 n_err,     n_err   * { uint16 len, bytes name,
 //                                           uint16 mlen, bytes message }
@@ -63,6 +76,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <memory>
@@ -165,6 +179,12 @@ struct PendingInfo {
   std::string digest;
   std::map<std::string, std::set<int>> by_digest;
   bool errored = false;
+  // First announcer's group id, namespaced by their rank ("3:7"; "-1" for
+  // ungrouped) — echoed to joined ranks so synthesized entries batch
+  // exactly like the peers' grouped entries.
+  std::string group = "-1";
+  // Data dependency: -1 none, -2 needs every rank, >=0 needs that root.
+  int data_dep = -1;
 };
 
 struct Server {
@@ -242,6 +262,8 @@ void Server::run_inner() {
         uint16_t required = rd.u16();
         std::string name = rd.str();
         std::string digest = rd.str();
+        std::string group = rd.str();
+        std::string datadep = rd.str();
         if (name == "\x1f__join__") {
           joined.insert(r);
           last_joined = r;
@@ -254,6 +276,8 @@ void Server::run_inner() {
           info.required = required ? required : world;
           info.first_seen = Clock::now();
           info.digest = digest;
+          info.group = group == "-1" ? group : std::to_string(r) + ":" + group;
+          info.data_dep = datadep.empty() ? -1 : std::atoi(datadep.c_str());
           it = pending.emplace(name, std::move(info)).first;
         }
         it->second.ready_ranks.insert(r);
@@ -273,19 +297,49 @@ void Server::run_inner() {
     // Errored tensors are never ready: their error is broadcast every round
     // until all required ranks have announced (so each has a local entry to
     // fail), then dropped.
-    std::vector<std::tuple<uint64_t, std::string, std::string>> ready;
+    std::vector<std::tuple<uint64_t, std::string, std::string, std::string>>
+        ready;
     std::vector<std::string> warns;
     std::vector<std::pair<std::string, std::string>> errs;
     auto now = Clock::now();
     for (auto it = pending.begin(); it != pending.end();) {
       auto& info = it->second;
       // Effective announce count: joined ranks are implicitly ready, but
-      // only toward the full-world threshold (join is a world-level
-      // operation; subgroup process-set collectives stay strict).
+      // only toward DEFAULT-process-set world tensors (wire names of other
+      // sets carry a "\x1f" prefix the joined client cannot synthesize
+      // for; join is a world-level operation in the reference too).
+      bool world_level = info.required == world &&
+                         it->first.find('\x1f') == std::string::npos;
       int have = static_cast<int>(info.ready_ranks.size());
-      if (info.required == world) {
+      if (world_level) {
         for (int jr : joined)
           if (!info.ready_ranks.count(jr)) ++have;
+      }
+      // A collective that needs real data from a joined rank cannot be
+      // satisfied with synthesized identity values: answer with a
+      // per-tensor error instead of fabricating data (broadcast from a
+      // joined root / allgather / alltoall — the reference errors here).
+      if (!info.errored && world_level && !joined.empty() &&
+          (info.data_dep == -2 ||
+           (info.data_dep >= 0 && joined.count(info.data_dep)))) {
+        std::string who;
+        for (int jr : joined) {
+          if (info.data_dep >= 0 && jr != info.data_dep) continue;
+          if (!who.empty()) who += ",";
+          who += std::to_string(jr);
+        }
+        errs.emplace_back(
+            it->first, "tensor '" + it->first + "' requires data from " +
+                           (info.data_dep >= 0 ? "root rank [" : "ranks [") +
+                           who + "] which joined; collectives that need a "
+                           "joined rank's data cannot run until all ranks "
+                           "join");
+        if (have >= info.required) {
+          it = pending.erase(it);
+          continue;
+        }
+        ++it;
+        continue;
       }
       if (info.errored) {
         // Per-tensor error naming every rank on each side of the
@@ -312,7 +366,7 @@ void Server::run_inner() {
         continue;
       }
       if (have >= info.required) {
-        ready.emplace_back(info.order, it->first, info.digest);
+        ready.emplace_back(info.order, it->first, info.digest, info.group);
         it = pending.erase(it);
         continue;
       }
@@ -326,7 +380,7 @@ void Server::run_inner() {
           // credit (world-level tensors); for subgroup tensors a joined
           // member really is the missing party — name it.
           if (!info.ready_ranks.count(r) &&
-              !(info.required == world && joined.count(r))) {
+              !(world_level && joined.count(r))) {
             if (!missing.empty()) missing += ",";
             missing += std::to_string(r);
           }
@@ -342,16 +396,17 @@ void Server::run_inner() {
       // Every rank joined: announce the epoch end (digest = last joiner)
       // and reset so the world can resume normal collectives.
       ready.emplace_back(UINT64_MAX, "\x1f__all_joined__",
-                         std::to_string(last_joined));
+                         std::to_string(last_joined), "-1");
       joined.clear();
       last_joined = -1;
     }
 
     std::vector<uint8_t> resp;
     put_u32(&resp, static_cast<uint32_t>(ready.size()));
-    for (auto& [ord, name, digest] : ready) {
+    for (auto& [ord, name, digest, group] : ready) {
       put_str(&resp, name);
       put_str(&resp, digest);
+      put_str(&resp, group);
     }
     put_u32(&resp, static_cast<uint32_t>(warns.size()));
     for (auto& w : warns) put_str(&resp, w);

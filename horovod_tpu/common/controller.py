@@ -12,6 +12,7 @@ Rank 0 additionally hosts the server thread (native, lock-step rounds).
 from __future__ import annotations
 
 import ctypes
+import itertools
 import struct
 import threading
 from typing import Dict, List, Optional, Sequence
@@ -69,17 +70,22 @@ class TCPController:
         self._join_event = threading.Event()
         self._join_last_rank = -1
         self.synthesizer = None
+        # Peer group tags → local ids, in a high id range so a synthesized
+        # group can never collide with this rank's own group ids (a joining
+        # rank may still have un-synchronized local entries in flight).
+        self._group_tags: Dict[str, int] = {}
+        self._group_tag_counter = itertools.count(1 << 30)
 
     # ------------------------------------------------------------- protocol
     def _round(self, announces: Sequence) -> tuple:
-        """announces: (name, required_ranks, digest) triples; required 0 =
-        world."""
+        """announces: (name, required_ranks, digest, group, datadep)
+        tuples; required 0 = world."""
         req = bytearray(struct.pack("<I", len(announces)))
-        for n, required, digest in announces:
-            nb = n.encode()
-            db = digest.encode()
-            req += struct.pack("<H", required) + struct.pack("<H", len(nb)) + nb
-            req += struct.pack("<H", len(db)) + db
+        for n, required, digest, group, datadep in announces:
+            req += struct.pack("<H", required)
+            for field in (n, digest, group, datadep):
+                fb = field.encode()
+                req += struct.pack("<H", len(fb)) + fb
         buf = (ctypes.c_uint8 * len(req)).from_buffer(req) if req else \
             (ctypes.c_uint8 * 0)()
         rc = self._lib.hvdtpu_client_round(
@@ -106,25 +112,26 @@ class TCPController:
                 off += ln
             return out
 
-        def read_pairs():
+        def read_tuple(k):
             nonlocal off
             (n,) = struct.unpack_from("<I", data, off)
             off += 4
             out = []
             for _ in range(n):
-                (ln,) = struct.unpack_from("<H", data, off)
-                off += 2
-                name = data[off:off + ln].decode()
-                off += ln
-                (ml,) = struct.unpack_from("<H", data, off)
-                off += 2
-                out.append((name, data[off:off + ml].decode()))
-                off += ml
+                fields = []
+                for _f in range(k):
+                    (ln,) = struct.unpack_from("<H", data, off)
+                    off += 2
+                    fields.append(data[off:off + ln].decode())
+                    off += ln
+                out.append(tuple(fields))
             return out
 
-        ready = read_pairs()        # (name, digest) — digest feeds join zeros
+        # ready: (name, digest, group) — digest + group feed the joined
+        # rank's synthesized entries; errors: (name, message).
+        ready = read_tuple(3)
         warns = read_list()
-        errors = read_pairs() if off < len(data) else []
+        errors = read_tuple(2) if off < len(data) else []
         return ready, warns, errors
 
     # ---------------------------------------------------------- engine API
@@ -154,13 +161,27 @@ class TCPController:
         parts.append(str(getattr(e, "root_rank", 0)))
         # Scale factors shape the fused program (they are in the engine's
         # fusion key), so divergence would desync batching across ranks.
+        # Deliberately NOT here: group_id — local group counters can drift
+        # across ranks (uneven join epochs), so it travels in the announce's
+        # separate `group` field, outside the mismatch comparison.
         parts.append(str(getattr(e, "prescale_factor", None)))
         parts.append(str(getattr(e, "postscale_factor", None)))
-        # Group id rides along so a JOINED rank's synthesized entries keep
-        # the peers' grouped-batching atomicity (batch splits at the fusion
-        # threshold must be identical on every process).
-        parts.append(str(getattr(e, "group_id", -1)))
         return "|".join(parts)
+
+    @staticmethod
+    def _datadep(e) -> str:
+        """Which ranks' REAL data this collective needs: '-1' none
+        (reductions/barrier — identity contributions are valid), '-2' every
+        rank (allgather/alltoall), or the broadcast root.  The server
+        errors instead of granting joined-credit when the needed rank has
+        joined."""
+        ct = getattr(e, "ctype", None)
+        v = getattr(ct, "value", "")
+        if v in ("allgather", "alltoall"):
+            return "-2"
+        if v == "broadcast":
+            return str(getattr(e, "root_rank", 0))
+        return "-1"
 
     def negotiate(self, entries: List) -> tuple:
         """One negotiation round.  Takes this cycle's drained entries (they
@@ -180,12 +201,13 @@ class TCPController:
                 # ranks; the server readiness threshold is the set size.
                 from .basics import _get_state
                 required = _get_state().process_set_table.get(ps_id).size()
-            new.append((n, required, self._digest(e)))
-        self._announced.update(n for n, _, _ in new)
+            new.append((n, required, self._digest(e),
+                        str(getattr(e, "group_id", -1)), self._datadep(e)))
+        self._announced.update(n for n, *_ in new)
         if self._join_pending:
             self._join_pending = False
             self._joined = True
-            new.append(("\x1f__join__", 0, ""))
+            new.append(("\x1f__join__", 0, "", "-1", "-1"))
         ready, warns, errors = self._round(new)
         for w in warns:
             log.warning("controller: %s", w)
@@ -196,7 +218,7 @@ class TCPController:
         ready = self._early_ready + ready
         self._early_ready = []
         out = []
-        for name, digest in ready:
+        for name, digest, group in ready:
             if name == "\x1f__all_joined__":
                 # Every rank joined: end the join epoch (digest = last
                 # joining rank) and unblock the join() caller.
@@ -210,13 +232,14 @@ class TCPController:
                 # this rank never announced is either another process set's
                 # collective (wire names carry a "\x1f" set prefix — not
                 # ours, drop) or — while this rank is JOINED — a world
-                # collective peers submitted, for which we synthesize a
-                # zero contribution (reference join semantics).
+                # collective peers submitted, for which we synthesize an
+                # identity contribution (reference join semantics).
                 if name in self._announced:
-                    self._early_ready.append((name, digest))
+                    self._early_ready.append((name, digest, group))
                 elif self._joined and "\x1f" not in name \
                         and self.synthesizer is not None:
-                    out.append(self.synthesizer(name, digest))
+                    out.append(self.synthesizer(name, digest,
+                                                self._group_tag_id(group)))
                 continue
             self._announced.discard(name)
             out.append(e)
@@ -248,8 +271,19 @@ class TCPController:
         n = self._wire_name(e)
         self._announced.discard(n)
         self._early_errors.pop(n, None)
-        self._early_ready = [(rn, d) for rn, d in self._early_ready
-                             if rn != n]
+        self._early_ready = [t for t in self._early_ready if t[0] != n]
+
+    def _group_tag_id(self, tag: str) -> int:
+        """Server group tags ("<first-announcer-rank>:<their gid>"; "-1"
+        ungrouped) → local int group ids for the engine's batch clustering.
+        Distinct tags get distinct ids, so two different peers' groups can
+        never merge on a joined rank."""
+        if tag == "-1":
+            return -1
+        gid = self._group_tags.get(tag)
+        if gid is None:
+            gid = self._group_tags[tag] = next(self._group_tag_counter)
+        return gid
 
     # --------------------------------------------------------------- join
     def request_join(self):

@@ -1,0 +1,35 @@
+"""Keras binding: ``import horovod_tpu.keras as hvd``.
+
+Parity with the reference's Keras API (``horovod/keras/`` +
+``horovod/_keras/`` — SURVEY.md §2b P5): ``DistributedOptimizer`` (shared
+with the TF binding — it already dynamically subclasses the Keras optimizer
+class so ``model.compile`` accepts it), ``broadcast_global_variables``, and
+the Keras callbacks (:mod:`horovod_tpu.keras.callbacks`).
+
+Works with Keras 3 (``keras.Model.fit``): gradient reductions run as
+``tf.py_function`` bodies inside the compiled train step, so no
+``run_eagerly=True`` is required.
+"""
+
+from __future__ import annotations
+
+from ..common.basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size,
+)
+from ..tensorflow import (  # noqa: F401
+    Average, Compression, Max, Min, Product, ReduceOp, Sum,
+    DistributedOptimizer, allgather, allreduce, broadcast, broadcast_object,
+    broadcast_variables,
+)
+from . import callbacks  # noqa: F401
+
+
+def broadcast_global_variables(model, root_rank: int = 0):
+    """Broadcast a model's (and, when built, its optimizer's) variables
+    from ``root_rank`` (reference: ``hvd.keras.broadcast_global_variables``)."""
+    variables = list(model.weights)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None:
+        variables += [v for v in getattr(opt, "variables", [])]
+    broadcast_variables(variables, root_rank=root_rank)

@@ -514,12 +514,14 @@ class CollectiveEngine:
             return info.max if hi else info.min
         return 0              # SUM / AVERAGE (divisor stays world) / ADASUM
 
-    def _synthesize_join_entry(self, name: str, digest: str) -> TensorTableEntry:
+    def _synthesize_join_entry(self, name: str, digest: str,
+                               group_id: int = -1) -> TensorTableEntry:
         """Implicit-contribution entry for a peer's collective while this
         rank is JOINED (reference: hvd.join).  The digest (the same one
-        negotiation checks for consistency) carries op/dtype/shape/root/
-        group, so this rank can build and execute the byte-identical fused
-        program with a local identity contribution.
+        negotiation checks for consistency) carries op/dtype/shape/root,
+        and the server-echoed group id preserves grouped batching, so this
+        rank builds and executes the byte-identical fused program with a
+        local identity contribution.
         """
         handle = next(self._handle_counter)
         now = time.monotonic()   # fresh age: must not trip the stall check
@@ -540,7 +542,6 @@ class CollectiveEngine:
         root = int(parts[4])
         pre = None if parts[5] == "None" else float(parts[5])
         post = None if parts[6] == "None" else float(parts[6])
-        group_id = int(parts[7]) if len(parts) > 7 else -1
         ps = self._state.process_set_table.get(0)
         sharding = NamedSharding(ps.mesh, P(ps.axis_name))
         local_devs = [d for d in ps.mesh.devices.flat

@@ -1,0 +1,172 @@
+"""TensorFlow binding: ``import horovod_tpu.tensorflow as hvd``.
+
+Parity with the reference's TF API (``horovod/tensorflow/__init__.py`` —
+SURVEY.md §2b P4): ``DistributedOptimizer``, ``DistributedGradientTape``,
+``broadcast_variables``, the collective op surface, compression, plus the
+core ``init/rank/size`` re-exports.  Backed by the same background
+coordinator (``ops/engine.py``) as the JAX and torch bindings — TF tensors
+bridge through host numpy; the data plane stays XLA collectives over the
+device mesh.
+
+Graph mode: gradient reductions inside Keras' compiled ``train_step`` run
+as ``tf.py_function`` bodies, so out-of-graph negotiation still happens at
+step-execution time (the role the reference's ``xla_mpi_ops.cc`` custom
+call played — SURVEY.md N28).  For peak TPU throughput prefer the JAX
+binding (in-graph ``lax.psum``); this binding is the compatibility surface
+for TF/Keras codebases.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import tensorflow as tf
+
+from ..common import basics
+from ..common.basics import (  # noqa: F401  (re-export, reference parity)
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, start_timeline, stop_timeline, add_process_set,
+)
+from ..common.process_sets import ProcessSet  # noqa: F401
+from ..ops import eager
+from .compression import Compression  # noqa: F401
+from .mpi_ops import (  # noqa: F401
+    Adasum, Average, Max, Min, Product, ReduceOp, Sum,
+    allgather, allreduce, alltoall, barrier, broadcast, broadcast_object,
+    graph_safe, grouped_allreduce, join, reducescatter,
+)
+
+
+def _reduce_numpy_list(arrays, name, op, compression, process_set):
+    """Shared eager core: compress → ONE grouped allreduce → decompress."""
+    from .mpi_ops import _submit
+    comp = [compression.compress(a) for a in arrays]
+    outs = eager.grouped_allreduce(
+        [_submit(c, process_set) for c, _ in comp], name=name, op=op,
+        process_set=process_set)
+    return [compression.decompress(
+                np.asarray(eager.to_local(o)), ctx).reshape(a.shape)
+            for o, (_, ctx), a in zip(outs, comp, arrays)]
+
+
+def _allreduce_grads(grads, name, op, compression, process_set):
+    """Allreduce a (possibly nested, possibly None-holding) gradient
+    structure; safe both eagerly and inside a ``tf.function`` trace."""
+    flat = tf.nest.flatten(grads)
+    idx = [i for i, g in enumerate(flat) if g is not None]
+    if not idx:
+        return grads
+    dense = [tf.convert_to_tensor(flat[i]) for i in idx]
+
+    def _eager_call(*tensors):
+        arrays = [t.numpy() for t in tensors]
+        outs = _reduce_numpy_list(arrays, name, op, compression, process_set)
+        return [tf.constant(np.ascontiguousarray(o), dtype=t.dtype)
+                for o, t in zip(outs, tensors)]
+
+    if tf.executing_eagerly():
+        reduced = _eager_call(*dense)
+    else:
+        # Compiled train step: negotiation is out-of-graph, so it runs in a
+        # py_function body at step-execution time (reference N28's role).
+        reduced = tf.py_function(
+            lambda *ts: _eager_call(*ts), dense, [t.dtype for t in dense])
+        if not isinstance(reduced, (list, tuple)):
+            reduced = [reduced]
+        for r, t in zip(reduced, dense):
+            r.set_shape(t.shape)
+    out = list(flat)
+    for i, r in zip(idx, reduced):
+        out[i] = r
+    return tf.nest.pack_sequence_as(grads, out)
+
+
+class _DistributedGradientTape:
+    """Wraps ``tf.GradientTape`` so ``gradient()`` returns cross-rank
+    averaged gradients (reference: ``hvd.DistributedGradientTape``,
+    SURVEY.md §3.5)."""
+
+    def __init__(self, tape: tf.GradientTape, compression=Compression.none,
+                 op=Average, process_set: Optional[ProcessSet] = None,
+                 name: str = "DistributedGradientTape"):
+        self._tape = tape
+        self._compression = compression
+        self._op = op
+        self._process_set = process_set
+        self._name = name
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        return _allreduce_grads(grads, f"{self._name}.Allreduce", self._op,
+                                self._compression, self._process_set)
+
+
+def DistributedGradientTape(gradtape: tf.GradientTape,
+                            compression=Compression.none,
+                            op=Average,
+                            process_set: Optional[ProcessSet] = None):
+    return _DistributedGradientTape(gradtape, compression=compression,
+                                    op=op, process_set=process_set)
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         compression=Compression.none, op=Average,
+                         backward_passes_per_step: int = 1,
+                         process_set: Optional[ProcessSet] = None):
+    """Wrap a Keras optimizer so ``apply_gradients`` averages gradients
+    across ranks first (reference: ``hvd.DistributedOptimizer`` for TF).
+
+    Implemented as a dynamic subclass of the optimizer's own class (the
+    reference's ``horovod/_keras`` pattern) so Keras ``model.compile``
+    type checks still pass.  ``backward_passes_per_step > 1`` (local
+    gradient aggregation) is implemented natively in the JAX binding
+    (``horovod_tpu.jax.optimizer``); here it is not supported.
+    """
+    if backward_passes_per_step != 1:
+        raise NotImplementedError(
+            "backward_passes_per_step > 1 is supported in the JAX binding "
+            "(horovod_tpu.DistributedOptimizer); the TF compatibility "
+            "binding reduces every step")
+    hvd_name = name or f"Distributed{optimizer.__class__.__name__}"
+
+    cls = optimizer.__class__
+
+    class _Distributed(cls):
+        _hvd_spec = None
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            gv = list(grads_and_vars)
+            grads = [g for g, _ in gv]
+            hvars = [v for _, v in gv]
+            spec = type(self)._hvd_spec
+            reduced = _allreduce_grads(grads, f"{spec['name']}.Allreduce",
+                                       spec["op"], spec["compression"],
+                                       spec["process_set"])
+            return super().apply_gradients(
+                list(zip(reduced, hvars)), *args, **kwargs)
+
+    _Distributed.__name__ = cls.__name__
+    _Distributed.__qualname__ = cls.__qualname__
+    _Distributed._hvd_spec = dict(name=hvd_name, op=op,
+                                  compression=compression,
+                                  process_set=process_set)
+    new_opt = _Distributed.from_config(optimizer.get_config())
+    return new_opt
+
+
+def broadcast_variables(variables, root_rank: int = 0,
+                        process_set: Optional[ProcessSet] = None):
+    """Assign rank ``root_rank``'s values to every rank's variables
+    (reference: ``hvd.broadcast_variables`` — consistent init / restored
+    checkpoints across the world)."""
+    variables = list(variables)
+    if not variables:
+        return
+    vals = [v.numpy() for v in variables]
+    outs = eager.broadcast_pytree(vals, root_rank, process_set=process_set)
+    for v, o in zip(variables, outs):
+        v.assign(np.asarray(o).reshape(v.shape))

@@ -1,0 +1,65 @@
+"""Gradient compression for the TF binding.
+
+Parity: reference ``horovod/tensorflow/compression.py`` —
+``Compression.none`` / ``Compression.fp16`` with ``compress``/``decompress``
+returning a context.  On TPU the natural wire dtype is bfloat16 (fp32
+dynamic range, native MXU type), so ``Compression.bf16`` is added; ``fp16``
+is kept for API parity.  Operates on the host numpy arrays the binding
+bridges through, so the compressed dtype is what crosses into the engine.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ml_dtypes
+
+
+class Compressor:
+    @staticmethod
+    def compress(a: np.ndarray):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(a: np.ndarray, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(a: np.ndarray):
+        return a, None
+
+    @staticmethod
+    def decompress(a: np.ndarray, ctx):
+        return a
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: np.dtype
+
+    @classmethod
+    def compress(cls, a: np.ndarray):
+        if np.issubdtype(a.dtype, np.floating) or a.dtype == ml_dtypes.bfloat16:
+            return a.astype(cls.wire_dtype), a.dtype
+        return a, None
+
+    @classmethod
+    def decompress(cls, a: np.ndarray, ctx):
+        return a if ctx is None else a.astype(ctx)
+
+
+class FP16Compressor(_CastCompressor):
+    wire_dtype = np.dtype(np.float16)
+
+
+class BF16Compressor(_CastCompressor):
+    wire_dtype = np.dtype(ml_dtypes.bfloat16)
+
+
+class Compression:
+    """Reference-parity namespace: ``Compression.none`` / ``.fp16`` /
+    ``.bf16``."""
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

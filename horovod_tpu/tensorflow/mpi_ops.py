@@ -1,0 +1,202 @@
+"""TensorFlow binding: collective op surface over the shared engine.
+
+Parity with the reference's TF op layer (``horovod/tensorflow/mpi_ops.py``
+backed by ``horovod/tensorflow/mpi_ops.cc`` — SURVEY.md §2a N27, §2b P4):
+``allreduce`` / ``grouped_allreduce`` / ``allgather`` / ``broadcast`` /
+``alltoall`` / ``reducescatter`` over ``tf.Tensor``/``tf.Variable`` inputs.
+
+TPU-native design: there is no TF custom-kernel shim — TF tensors are
+bridged to host numpy and submitted to the same background coordinator
+(``ops/engine.py``) the JAX path uses, so negotiation, fusion, response
+caching, timeline and stall inspection all apply identically.  The data
+plane stays XLA collectives.  The reference's synchronous TF op semantics
+are preserved (TF has no ``*_async`` handles — asynchrony lived in TF's
+executor, which this binding does not re-create).
+
+Graph mode: ops raise a clear error under ``tf.function`` tracing unless
+wrapped — :func:`graph_safe` wraps the eager implementation in
+``tf.py_function`` so compiled Keras ``fit`` loops still negotiate
+out-of-graph at step-execution time (the reference's N28
+``HOROVOD_ENABLE_XLA_OPS`` custom-call played this role inside XLA).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import tensorflow as tf
+
+from ..common import basics
+from ..common.process_sets import ProcessSet
+from ..ops import collectives as C
+from ..ops import eager
+
+ReduceOp = C.ReduceOp
+Average = C.ReduceOp.AVERAGE
+Sum = C.ReduceOp.SUM
+Min = C.ReduceOp.MIN
+Max = C.ReduceOp.MAX
+Product = C.ReduceOp.PRODUCT
+Adasum = C.Adasum
+
+rank = basics.rank
+size = basics.size
+local_rank = basics.local_rank
+local_size = basics.local_size
+
+
+def _to_numpy(t) -> np.ndarray:
+    if isinstance(t, tf.Variable):
+        t = t.value()
+    if tf.is_tensor(t):
+        return t.numpy()
+    return np.asarray(t)
+
+
+def _submit(a: np.ndarray, process_set: Optional[ProcessSet]):
+    """This process's contribution in the eager layer's expected form.
+
+    Multi-process: the local array as-is.  Single-process SPMD: a stride-0
+    replicated view (the controller submits the same tensor for every rank
+    it owns — same convention as the torch binding)."""
+    if eager.per_process_mode():
+        return a
+    world = process_set.size() if process_set is not None else basics.size()
+    return np.broadcast_to(a, (world,) + a.shape)
+
+
+def _take_my_row(a: np.ndarray) -> np.ndarray:
+    """Stacked sharded results → this rank's row(s)."""
+    if eager.per_process_mode():
+        return a[0] if a.shape[0] == 1 else a.reshape(-1, *a.shape[2:])
+    return a[basics.rank()]
+
+
+def _to_tf(a: np.ndarray, dtype: tf.DType) -> tf.Tensor:
+    return tf.constant(np.ascontiguousarray(a), dtype=dtype)
+
+
+def _check_eager(what: str):
+    if not tf.executing_eagerly():
+        raise RuntimeError(
+            f"hvd.{what} was called inside a tf.function trace; collective "
+            f"negotiation is out-of-graph.  Wrap the call with "
+            f"horovod_tpu.tensorflow.graph_safe(...) or run the step "
+            f"eagerly (run_eagerly=True)")
+
+
+def allreduce(tensor, name: Optional[str] = None, op: ReduceOp = Average,
+              prescale_factor: Optional[float] = None,
+              postscale_factor: Optional[float] = None,
+              compression=None,
+              process_set: Optional[ProcessSet] = None) -> tf.Tensor:
+    _check_eager("allreduce")
+    from .compression import Compression
+    compression = compression or Compression.none
+    dtype = tf.as_dtype(tensor.dtype) if tf.is_tensor(tensor) else tf.float32
+    a = _to_numpy(tensor)
+    comp, ctx = compression.compress(a)
+    out = eager.allreduce(_submit(comp, process_set), name=name, op=op,
+                          prescale_factor=prescale_factor,
+                          postscale_factor=postscale_factor,
+                          process_set=process_set)
+    res = compression.decompress(np.asarray(eager.to_local(out)), ctx)
+    return _to_tf(res.reshape(a.shape), dtype)
+
+
+def grouped_allreduce(tensors: Sequence, name: Optional[str] = None,
+                      op: ReduceOp = Average,
+                      process_set: Optional[ProcessSet] = None) -> List[tf.Tensor]:
+    _check_eager("grouped_allreduce")
+    arrs = [_to_numpy(t) for t in tensors]
+    dtypes = [tf.as_dtype(t.dtype) if tf.is_tensor(t) else tf.float32
+              for t in tensors]
+    outs = eager.grouped_allreduce(
+        [_submit(a, process_set) for a in arrs], name=name, op=op,
+        process_set=process_set)
+    return [_to_tf(np.asarray(eager.to_local(o)).reshape(a.shape), dt)
+            for o, a, dt in zip(outs, arrs, dtypes)]
+
+
+def allgather(tensor, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None) -> tf.Tensor:
+    _check_eager("allgather")
+    dtype = tf.as_dtype(tensor.dtype) if tf.is_tensor(tensor) else tf.float32
+    a = _to_numpy(tensor)
+    out = eager.allgather(_submit(a, process_set), name=name,
+                          process_set=process_set)
+    return _to_tf(np.asarray(eager.to_local(out)), dtype)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None) -> tf.Tensor:
+    _check_eager("broadcast")
+    dtype = tf.as_dtype(tensor.dtype) if tf.is_tensor(tensor) else tf.float32
+    a = _to_numpy(tensor)
+    out = eager.broadcast(_submit(a, process_set), root_rank=root_rank,
+                          name=name, process_set=process_set)
+    return _to_tf(np.asarray(eager.to_local(out)).reshape(a.shape), dtype)
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set: Optional[ProcessSet] = None):
+    """Even splits: the gathered tensor.  With ``splits``: returns
+    ``(output, received_splits)`` (ragged form, same as the torch
+    binding)."""
+    _check_eager("alltoall")
+    dtype = tf.as_dtype(tensor.dtype) if tf.is_tensor(tensor) else tf.float32
+    a = _to_numpy(tensor)
+    world = process_set.size() if process_set is not None else basics.size()
+    if splits is None:
+        if a.shape[0] % world != 0:
+            raise ValueError(
+                f"alltoall with even splits needs dim0 divisible by the "
+                f"process set size ({world}); got {tuple(a.shape)}")
+        out = eager.alltoall(_submit(a, process_set), name=name,
+                             process_set=process_set)
+        return _to_tf(_take_my_row(np.asarray(eager.to_local(out))), dtype)
+    sp = _to_numpy(splits).astype(np.int64).reshape(-1)
+    if eager.per_process_mode():
+        out, rsp = eager.alltoall(a, splits=sp, name=name,
+                                  process_set=process_set)
+    else:
+        outs, rsps = eager.alltoall([a] * world,
+                                    splits=np.tile(sp, (world, 1)),
+                                    name=name, process_set=process_set)
+        out, rsp = outs[basics.rank()], rsps[basics.rank()]
+    return _to_tf(out, dtype), tf.constant(np.ascontiguousarray(rsp))
+
+
+def reducescatter(tensor, name: Optional[str] = None, op: ReduceOp = Sum,
+                  process_set: Optional[ProcessSet] = None) -> tf.Tensor:
+    _check_eager("reducescatter")
+    dtype = tf.as_dtype(tensor.dtype) if tf.is_tensor(tensor) else tf.float32
+    a = _to_numpy(tensor)
+    world = process_set.size() if process_set is not None else basics.size()
+    if a.shape[0] % world != 0:
+        raise ValueError(
+            f"reducescatter needs dim0 divisible by the process set size "
+            f"({world}); got {tuple(a.shape)}")
+    out = eager.reducescatter(_submit(a, process_set), name=name, op=op,
+                              process_set=process_set)
+    return _to_tf(_take_my_row(np.asarray(eager.to_local(out))), dtype)
+
+
+def graph_safe(fn, output_dtype: tf.DType = tf.float32):
+    """Wrap an eager collective call for use inside ``tf.function``.
+
+    Executes ``fn`` as a ``tf.py_function`` at step-execution time — the
+    out-of-graph negotiation the reference ran from a TF custom kernel's
+    ``ComputeAsync`` (N27) happens in the py_function body here.
+    """
+    def wrapped(*args):
+        def call(*np_args):
+            return fn(*np_args)
+        return tf.py_function(call, list(args), output_dtype)
+    return wrapped
+
+
+barrier = eager.barrier
+join = eager.join
+broadcast_object = eager.broadcast_object

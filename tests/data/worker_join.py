@@ -67,6 +67,46 @@ def main():
             last = hvd.join()
         assert last == size - 1, f"epoch-2 join returned {last}"
 
+    # Epoch 3a (regression): group counters have DIVERGED across ranks
+    # (rank 0 ran no grouped calls in epoch 2; others ran one) — a
+    # consistent grouped collective must still negotiate, because the group
+    # id travels outside the digest-mismatch comparison.
+    outs = hvd.grouped_allreduce(
+        [np.full((2,), 1.0 + rank, np.float32),
+         np.full((3,), 2.0 * rank, np.float32)], name="pg", op=hvd.Sum)
+    np.testing.assert_allclose(
+        hvd.to_local(outs[0]),
+        np.full((2,), sum(1.0 + r for r in range(size))))
+    np.testing.assert_allclose(
+        hvd.to_local(outs[1]),
+        np.full((3,), sum(2.0 * r for r in range(size))))
+
+    # Epoch 3b: collectives that need a joined rank's REAL data must fail
+    # fast with a clear error — never silently deliver fabricated values.
+    if size >= 2:
+        if rank == 0:
+            last = hvd.join()
+        else:
+            try:
+                hvd.broadcast(np.ones(3, np.float32), root_rank=0,
+                              name="bc_joined_root")
+                raise AssertionError(
+                    "broadcast from a joined root did not error")
+            except AssertionError:
+                raise
+            except Exception as exc:
+                assert "joined" in str(exc), exc
+            try:
+                hvd.allgather(np.ones((2,), np.float32), name="ag_joined")
+                raise AssertionError("allgather with a joined rank did "
+                                     "not error")
+            except AssertionError:
+                raise
+            except Exception as exc:
+                assert "joined" in str(exc), exc
+            last = hvd.join()
+        assert last == size - 1, f"epoch-3 join returned {last}"
+
     print(f"JOIN_OK rank={rank}")
     hvd.shutdown()
 

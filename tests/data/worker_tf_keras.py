@@ -1,0 +1,85 @@
+"""Multi-process TF/Keras worker: rank-dependent collectives through the TF
+binding, DistributedGradientTape averaging, and an mnist-style Keras fit
+with cross-rank weight sync (reference: ``test/parallel/test_tensorflow.py``
++ ``test_tensorflow2_keras.py`` — SURVEY.md §4).  Launched by torovodrun in
+test_multiprocess.py.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np
+import tensorflow as tf
+import keras
+
+import horovod_tpu.tensorflow as hvd
+import horovod_tpu.keras as khvd
+from horovod_tpu.keras import callbacks as kcb
+
+
+def main():
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+
+    # Rank-dependent allreduce through the TF surface.
+    t = tf.constant([1.0, 2.0]) * float(rank + 1)
+    out = hvd.allreduce(t, name="tf_ar", op=hvd.Sum)
+    scale = sum(r + 1 for r in range(size))
+    np.testing.assert_allclose(out.numpy(), np.array([1.0, 2.0]) * scale,
+                               rtol=1e-6)
+
+    # DistributedGradientTape: grads averaged across ranks.
+    x = tf.Variable([1.0, 2.0])
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(x * x) * float(rank + 1)
+    tape = hvd.DistributedGradientTape(tape)
+    (grad,) = tape.gradient(loss, [x])
+    expected = np.array([2.0, 4.0]) * np.mean([r + 1 for r in range(size)])
+    np.testing.assert_allclose(grad.numpy(), expected, rtol=1e-6)
+
+    # broadcast_variables: everyone ends with rank 0's values.
+    v = tf.Variable(np.full((3,), float(rank + 10), np.float32))
+    hvd.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), np.full((3,), 10.0))
+
+    # mnist-style Keras fit: per-rank data shards, distributed optimizer,
+    # broadcast + metric-average callbacks; ranks must end bit-identical.
+    rng = np.random.RandomState(100 + rank)   # DIFFERENT shard per rank
+    X = rng.randn(128, 8).astype(np.float32)
+    y = (X.sum(axis=1, keepdims=True) > 0).astype(np.float32)
+
+    keras.utils.set_random_seed(rank + 1)     # DIFFERENT init per rank
+    model = keras.Sequential([
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.Dense(1, activation="sigmoid"),
+    ])
+    opt = khvd.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.1))
+    model.compile(optimizer=opt, loss="binary_crossentropy")
+    hist = model.fit(X, y, batch_size=32, epochs=2, verbose=0, shuffle=False,
+                     callbacks=[kcb.BroadcastGlobalVariablesCallback(0),
+                                kcb.MetricAverageCallback()])
+    assert len(hist.history["loss"]) == 2
+
+    # Weight sync check: allgather a digest of the flattened weights.
+    flat = np.concatenate([w.numpy().ravel() for w in model.weights])
+    digest = np.array([flat.sum(), np.abs(flat).sum()], np.float64)
+    gathered = np.asarray(hvd.allgather(
+        tf.constant(digest), name="wdigest").numpy()).reshape(size, 2)
+    for r in range(size):
+        np.testing.assert_allclose(gathered[r], gathered[0], rtol=1e-10,
+                                   err_msg="ranks diverged after fit")
+
+    print(f"TF_OK rank={rank}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

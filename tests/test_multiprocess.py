@@ -220,7 +220,7 @@ def test_controller_join_unit():
         ctl = TCPController("127.0.0.1", port, rank=rank, world=2,
                             stall_warn_s=60.0)
         synthesized = []
-        ctl.synthesizer = lambda name, digest: ("zeros", name, digest)
+        ctl.synthesizer = lambda name, digest, gid: ("zeros", name, digest)
         try:
             # No background engine thread here: each side must keep driving
             # lock-step rounds itself until the all-joined verdict lands.
@@ -263,3 +263,17 @@ def test_controller_join_unit():
     assert last1 == 0, results
     assert len(syn) == 1 and syn[0][0] == "zeros" and syn[0][1] == "t", results
     assert "float32" in syn[0][2] and "(3,)" in syn[0][2], results
+
+
+WORKER_TF = os.path.join(REPO, "tests", "data", "worker_tf_keras.py")
+
+
+def test_torovodrun_tensorflow_keras():
+    """TF/Keras binding across real processes (VERDICT missing #2): rank-
+    dependent collectives, DistributedGradientTape averaging,
+    broadcast_variables, and a Keras fit that leaves ranks bit-identical."""
+    res = _run_torovodrun(2, WORKER_TF, timeout=420)
+    ok = res.stdout.count("TF_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")

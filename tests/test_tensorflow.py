@@ -1,0 +1,130 @@
+"""TF/Keras binding tests (single-controller tier).
+
+Models the reference's ``test/parallel/test_tensorflow.py`` +
+``test_tensorflow2_keras.py`` assertions (SURVEY.md §4) in the hermetic
+8-virtual-rank harness: single-controller mode submits the same tensor for
+every rank, so AVERAGE is the identity and SUM multiplies by size().
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = pytest.importorskip("keras")
+
+
+@pytest.fixture()
+def tfhvd(hvd):
+    import horovod_tpu.tensorflow as tfhvd
+    return tfhvd
+
+
+def test_allreduce(tfhvd):
+    w = tfhvd.size()
+    t = tf.constant([1.0, 2.0, 3.0])
+    out = tfhvd.allreduce(t, name="tf_ar", op=tfhvd.Sum)
+    assert isinstance(out, tf.Tensor) and out.dtype == tf.float32
+    np.testing.assert_allclose(out.numpy(), np.array([1, 2, 3.0]) * w)
+    out = tfhvd.allreduce(t, name="tf_ar_avg", op=tfhvd.Average)
+    np.testing.assert_allclose(out.numpy(), [1, 2, 3.0])
+
+
+def test_allreduce_compression_fp16(tfhvd):
+    t = tf.constant(np.linspace(-2, 2, 8, dtype=np.float32))
+    out = tfhvd.allreduce(t, name="tf_ar_c", op=tfhvd.Average,
+                          compression=tfhvd.Compression.fp16)
+    assert out.dtype == tf.float32
+    np.testing.assert_allclose(out.numpy(), t.numpy(), rtol=2e-3)
+
+
+def test_grouped_allreduce(tfhvd):
+    w = tfhvd.size()
+    outs = tfhvd.grouped_allreduce(
+        [tf.ones([2, 3]), tf.constant([4.0, 5.0])], name="tf_grp",
+        op=tfhvd.Sum)
+    np.testing.assert_allclose(outs[0].numpy(), np.ones((2, 3)) * w)
+    np.testing.assert_allclose(outs[1].numpy(), np.array([4.0, 5.0]) * w)
+
+
+def test_allgather_broadcast(tfhvd):
+    w = tfhvd.size()
+    out = tfhvd.allgather(tf.ones([2, 3]), name="tf_ag")
+    assert out.shape == (2 * w, 3)
+    out = tfhvd.broadcast(tf.constant([7.0, 8.0]), root_rank=0, name="tf_bc")
+    np.testing.assert_allclose(out.numpy(), [7.0, 8.0])
+
+
+def test_alltoall_even_and_ragged(tfhvd):
+    w = tfhvd.size()
+    t = tf.reshape(tf.range(w * 2, dtype=tf.float32), (w, 2))
+    out = tfhvd.alltoall(t, name="tf_a2a")
+    # identical contributions: this rank receives everyone's chunk r.
+    r = tfhvd.rank()
+    np.testing.assert_allclose(out.numpy(),
+                               np.tile(t.numpy()[r:r + 1], (w, 1)))
+    splits = tf.constant([j + 1 for j in range(w)])
+    n = int(sum(j + 1 for j in range(w)))
+    tr = tf.reshape(tf.range(n, dtype=tf.float32), (n, 1))
+    out, rsp = tfhvd.alltoall(tr, splits=splits, name="tf_a2av")
+    assert rsp.numpy().tolist() == [r + 1] * w
+    off = sum(j + 1 for j in range(r))
+    chunk = tr.numpy()[off:off + r + 1]
+    np.testing.assert_allclose(out.numpy(), np.tile(chunk, (w, 1)))
+
+
+def test_reducescatter(tfhvd):
+    w = tfhvd.size()
+    t = tf.ones([2 * w, 3])
+    out = tfhvd.reducescatter(t, name="tf_rs", op=tfhvd.Sum)
+    np.testing.assert_allclose(out.numpy(), np.ones((2, 3)) * w)
+
+
+def test_distributed_gradient_tape(tfhvd):
+    x = tf.Variable([1.0, 2.0])
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(x * x)
+    tape = tfhvd.DistributedGradientTape(tape)
+    (grad,) = tape.gradient(loss, [x])
+    # identical per-rank grads: average == local value 2x.
+    np.testing.assert_allclose(grad.numpy(), [2.0, 4.0])
+
+
+def test_distributed_optimizer_apply(tfhvd):
+    opt = tfhvd.DistributedOptimizer(keras.optimizers.SGD(learning_rate=0.5))
+    assert isinstance(opt, keras.optimizers.SGD)  # dynamic subclass
+    v = tf.Variable([1.0, 1.0])
+    opt.apply_gradients([(tf.constant([0.2, 0.4]), v)])
+    np.testing.assert_allclose(v.numpy(), [0.9, 0.8], rtol=1e-6)
+
+
+def test_broadcast_variables(tfhvd):
+    v = tf.Variable([5.0, 6.0])
+    tfhvd.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), [5.0, 6.0])
+
+
+def test_keras_fit_end_to_end(tfhvd):
+    """Keras model.fit with the horovod optimizer + callbacks: the compiled
+    train step reduces via py_function; loss decreases; callbacks attach."""
+    import horovod_tpu.keras as khvd
+    from horovod_tpu.keras import callbacks as kcb
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 4).astype(np.float32)
+    true_w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    y = X @ true_w + 0.01 * rng.randn(64, 1).astype(np.float32)
+
+    model = keras.Sequential([keras.layers.Dense(1, use_bias=False)])
+    opt = khvd.DistributedOptimizer(keras.optimizers.SGD(learning_rate=0.05))
+    model.compile(optimizer=opt, loss="mse")
+    hist = model.fit(
+        X, y, batch_size=16, epochs=3, verbose=0,
+        callbacks=[kcb.BroadcastGlobalVariablesCallback(0),
+                   kcb.MetricAverageCallback(),
+                   kcb.LearningRateWarmupCallback(initial_lr=0.05,
+                                                  warmup_epochs=2)])
+    losses = hist.history["loss"]
+    assert losses[-1] < losses[0] * 0.5, losses
+    # warmup took LR toward initial_lr * size() during epochs 0-1
+    final_lr = float(model.optimizer.learning_rate.numpy())
+    assert final_lr == pytest.approx(0.05 * tfhvd.size(), rel=1e-5)
