@@ -183,6 +183,11 @@ struct PendingInfo {
   // ungrouped) — echoed to joined ranks so synthesized entries batch
   // exactly like the peers' grouped entries.
   std::string group = "-1";
+  // Group STRUCTURE consistency: ids legitimately drift across ranks, but
+  // grouped-vs-ungrouped divergence means ranks would batch differently at
+  // the fusion threshold and execute mismatched programs — error instead.
+  std::set<int> grouped_ranks;
+  std::set<int> ungrouped_ranks;
   // Data dependency: -1 none, -2 needs every rank, >=0 needs that root.
   int data_dep = -1;
 };
@@ -282,6 +287,9 @@ void Server::run_inner() {
         }
         it->second.ready_ranks.insert(r);
         it->second.by_digest[digest].insert(r);
+        (group == "-1" ? it->second.ungrouped_ranks
+                       : it->second.grouped_ranks)
+            .insert(r);
         if (digest != it->second.digest) {
           // Divergent submission (reference controller's consistency
           // check).  The message is rebuilt at response time so late
@@ -334,6 +342,30 @@ void Server::run_inner() {
                            who + "] which joined; collectives that need a "
                            "joined rank's data cannot run until all ranks "
                            "join");
+        if (have >= info.required) {
+          it = pending.erase(it);
+          continue;
+        }
+        ++it;
+        continue;
+      }
+      if (!info.grouped_ranks.empty() && !info.ungrouped_ranks.empty()) {
+        // Grouped on some ranks, ungrouped on others: batching at the
+        // fusion threshold would diverge → mismatched fused programs.
+        std::string g, u;
+        for (int rr : info.grouped_ranks) {
+          if (!g.empty()) g += ",";
+          g += std::to_string(rr);
+        }
+        for (int rr : info.ungrouped_ranks) {
+          if (!u.empty()) u += ",";
+          u += std::to_string(rr);
+        }
+        errs.emplace_back(
+            it->first, "tensor '" + it->first +
+                           "' negotiation failed: ranks [" + g +
+                           "] submitted it as a GROUPED collective but "
+                           "ranks [" + u + "] submitted it ungrouped");
         if (have >= info.required) {
           it = pending.erase(it);
           continue;

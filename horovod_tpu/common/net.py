@@ -39,5 +39,44 @@ def remote_ports(n: int, seed: int) -> List[int]:
     return [base + i for i in range(n)]
 
 
+def routable_addr() -> str:
+    """An address REMOTE hosts can reach this machine at (for rendezvous /
+    controller endpoints): the primary outbound interface's address, or the
+    FQDN when that cannot be determined.  The UDP connect sends no packets —
+    it only makes the kernel pick a source address."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return socket.getfqdn()
+    finally:
+        s.close()
+
+
 def is_local_host(hostname: str) -> bool:
-    return hostname in ("localhost", "127.0.0.1", socket.gethostname())
+    """True when ``hostname`` refers to this machine — by name, FQDN,
+    alias, or any resolved address of either — so local coordinators named
+    by FQDN/IP still get bind-probed ports instead of blind remote ones."""
+    if hostname in ("localhost", "127.0.0.1", "::1"):
+        return True
+    local_names = {socket.gethostname(), socket.getfqdn()}
+    if hostname in local_names:
+        return True
+    try:
+        target_addrs = set(socket.gethostbyname_ex(hostname)[2])
+    except OSError:
+        return False
+    if any(a.startswith("127.") for a in target_addrs):
+        return True
+    local_addrs = set()
+    for n in local_names:
+        try:
+            local_addrs.update(socket.gethostbyname_ex(n)[2])
+        except OSError:
+            pass
+    try:
+        local_addrs.add(routable_addr())
+    except OSError:
+        pass
+    return bool(target_addrs & local_addrs)

@@ -14,6 +14,10 @@ import dataclasses
 import subprocess
 from typing import Dict, List
 
+from ..utils.logging import get_logger
+
+log = get_logger()
+
 
 @dataclasses.dataclass(frozen=True)
 class DiscoveredHost:
@@ -49,7 +53,13 @@ class HostDiscoveryScript(HostDiscovery):
                 continue
             if ":" in line:
                 name, slots = line.rsplit(":", 1)
-                h = DiscoveredHost(name.strip(), int(slots))
+                try:
+                    h = DiscoveredHost(name.strip(), int(slots))
+                except ValueError:
+                    # Truncated/garbled output from a transient poll: skip
+                    # the line rather than crash the elastic driver.
+                    log.warning("host discovery: malformed line %r", line)
+                    continue
             else:
                 h = DiscoveredHost(line, self.default_slots)
             if h.hostname in seen:

@@ -56,6 +56,11 @@ class ElasticDriver:
 
         self.registry = WorkerStateRegistry()
         self.rendezvous = RendezvousServer()
+        # Explicit address wins; otherwise picked per generation: loopback
+        # for all-local worlds, a routable driver address once any worker
+        # is remote (a remote worker long-polling ITS OWN loopback for
+        # assignments would hang until the start timeout).
+        self._rdv_addr_explicit = rendezvous_addr
         self._rdv_addr = rendezvous_addr or "127.0.0.1"
         self._procs: Dict[str, subprocess.Popen] = {}
         self._hosts: List[DiscoveredHost] = []
@@ -175,6 +180,11 @@ class ElasticDriver:
         if not assignments:
             return False
         self._assigned = assignments
+        if self._rdv_addr_explicit is None:
+            from ..common.net import routable_addr
+            self._rdv_addr = ("127.0.0.1"
+                              if all(is_local_host(h.hostname) for h in hosts)
+                              else routable_addr())
         version = self.rendezvous.publish(assignments)
         if self.verbose:
             log.warning("elastic driver: generation %s over %s", version,
@@ -200,7 +210,8 @@ class ElasticDriver:
         while True:
             try:
                 discovered = self.discovery.find_available_hosts_and_slots()
-            except RuntimeError as exc:
+            except Exception as exc:  # noqa: BLE001 - one bad poll must not
+                # kill the driver (script timeout, malformed slots line, ...)
                 log.warning("elastic driver: discovery failed: %s", exc)
                 discovered = []
             self._hosts = discovered  # raw; blacklist applied at use
@@ -260,7 +271,7 @@ class ElasticDriver:
                             != [(h.hostname, h.slots) for h in self._hosts]):
                         self._hosts = discovered
                         changed = True
-                except RuntimeError as exc:
+                except Exception as exc:  # noqa: BLE001 - transient poll
                     log.warning("elastic driver: discovery failed: %s", exc)
 
             # 4. re-form the world if needed.  The blacklist is re-applied

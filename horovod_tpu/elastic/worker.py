@@ -57,6 +57,9 @@ class WorkerNotificationService:
             except OSError:
                 return
             try:
+                # A wedged/half-open driver connection must not block the
+                # accept loop forever (timeouts surface as OSError below).
+                conn.settimeout(5.0)
                 data = conn.makefile().readline().strip()
                 if data.startswith("HOSTS_UPDATED"):
                     version = int(data.split()[1]) if " " in data else 0

@@ -96,6 +96,17 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
                  momentum_correction: bool = True, verbose: int = 0):
         self.warmup_epochs = warmup_epochs
         self.verbose = verbose
+        if momentum_correction:
+            # Accepted for reference API parity; the reference rescales SGD
+            # momentum as the LR steps during warmup, which this binding
+            # does not implement — say so instead of silently differing.
+            import warnings
+            warnings.warn(
+                "LearningRateWarmupCallback: momentum_correction is not "
+                "applied in horovod_tpu (pass momentum_correction=False to "
+                "silence); training dynamics during warmup may differ "
+                "slightly from reference Horovod with momentum optimizers",
+                stacklevel=2)
         world = basics.size()
 
         def multiplier(epoch):

@@ -1,0 +1,70 @@
+"""Shared numpy-level submit conventions for framework bindings.
+
+The torch and TF bindings both bridge framework tensors through host numpy
+into the eager layer; the SPMD conventions they must agree on live here so
+they cannot drift (reference analogue: the common ``TensorTableEntry``
+adapter layer under ``horovod/common/`` that N26/N27 both used):
+
+- multi-process mode: one process = one rank's contribution, submitted
+  as-is;
+- single-controller SPMD: the process submits on behalf of every rank it
+  owns — the same tensor replicated via a stride-0 view (no host copy);
+- stacked sharded results → this rank's row(s);
+- ragged alltoall: validate splits length, then either the local per-rank
+  call (multi-process) or the replicated single-controller form.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..common import basics
+from ..common.process_sets import ProcessSet
+from . import eager
+
+
+def set_size(process_set: Optional[ProcessSet]) -> int:
+    return process_set.size() if process_set is not None else basics.size()
+
+
+def replicate_for_controller(a: np.ndarray,
+                             process_set: Optional[ProcessSet] = None):
+    """Single-controller SPMD submission: every rank this process owns
+    contributes the same tensor — a stride-0 broadcast view, so no dense
+    world-sized host materialization."""
+    return np.broadcast_to(a, (set_size(process_set),) + a.shape)
+
+
+def submit_numpy(a: np.ndarray, process_set: Optional[ProcessSet] = None):
+    if eager.per_process_mode():
+        return a
+    return replicate_for_controller(a, process_set)
+
+
+def take_my_row(a: np.ndarray) -> np.ndarray:
+    """Stacked sharded results ([world, *S] rows = per-rank outputs, or
+    this process's [1, *S] / [local, *S] slice in multi-process mode) →
+    this rank's row(s)."""
+    if eager.per_process_mode():
+        return a[0] if a.shape[0] == 1 else a.reshape(-1, *a.shape[2:])
+    return a[basics.rank()]
+
+
+def ragged_alltoall_numpy(a: np.ndarray, splits,
+                          name: Optional[str] = None,
+                          process_set: Optional[ProcessSet] = None):
+    """Ragged alltoall for one rank's numpy contribution; returns
+    ``(output, received_splits)`` for THIS rank."""
+    world = set_size(process_set)
+    sp = np.asarray(splits).astype(np.int64).reshape(-1)
+    if sp.size != world:
+        raise ValueError(f"splits must have {world} entries, got {sp.size}")
+    if eager.per_process_mode():
+        return eager.alltoall(a, splits=sp, name=name,
+                              process_set=process_set)
+    outs, rsps = eager.alltoall([a] * world, splits=np.tile(sp, (world, 1)),
+                                name=name, process_set=process_set)
+    r = basics.rank()
+    return outs[r], rsps[r]

@@ -31,6 +31,11 @@ from ..common import basics
 from ..common.process_sets import ProcessSet
 from ..ops import collectives as C
 from ..ops import eager
+# SPMD submit conventions shared with the torch binding (one source of
+# truth for the single-controller replicate / my-row / ragged forms).
+from ..ops.bridge import (submit_numpy as _submit,
+                          take_my_row as _take_my_row,
+                          ragged_alltoall_numpy as _ragged_alltoall)
 
 ReduceOp = C.ReduceOp
 Average = C.ReduceOp.AVERAGE
@@ -54,27 +59,16 @@ def _to_numpy(t) -> np.ndarray:
     return np.asarray(t)
 
 
-def _submit(a: np.ndarray, process_set: Optional[ProcessSet]):
-    """This process's contribution in the eager layer's expected form.
-
-    Multi-process: the local array as-is.  Single-process SPMD: a stride-0
-    replicated view (the controller submits the same tensor for every rank
-    it owns — same convention as the torch binding)."""
-    if eager.per_process_mode():
-        return a
-    world = process_set.size() if process_set is not None else basics.size()
-    return np.broadcast_to(a, (world,) + a.shape)
-
-
-def _take_my_row(a: np.ndarray) -> np.ndarray:
-    """Stacked sharded results → this rank's row(s)."""
-    if eager.per_process_mode():
-        return a[0] if a.shape[0] == 1 else a.reshape(-1, *a.shape[2:])
-    return a[basics.rank()]
-
-
 def _to_tf(a: np.ndarray, dtype: tf.DType) -> tf.Tensor:
     return tf.constant(np.ascontiguousarray(a), dtype=dtype)
+
+
+def _dtype_of(tensor, a: np.ndarray) -> tf.DType:
+    """The caller's dtype: the tf dtype when given a tf tensor/variable,
+    otherwise the numpy array's own dtype (never a silent float32)."""
+    if tf.is_tensor(tensor) or isinstance(tensor, tf.Variable):
+        return tf.as_dtype(tensor.dtype)
+    return tf.as_dtype(a.dtype)
 
 
 def _check_eager(what: str):
@@ -94,8 +88,8 @@ def allreduce(tensor, name: Optional[str] = None, op: ReduceOp = Average,
     _check_eager("allreduce")
     from .compression import Compression
     compression = compression or Compression.none
-    dtype = tf.as_dtype(tensor.dtype) if tf.is_tensor(tensor) else tf.float32
     a = _to_numpy(tensor)
+    dtype = _dtype_of(tensor, a)
     comp, ctx = compression.compress(a)
     out = eager.allreduce(_submit(comp, process_set), name=name, op=op,
                           prescale_factor=prescale_factor,
@@ -110,8 +104,7 @@ def grouped_allreduce(tensors: Sequence, name: Optional[str] = None,
                       process_set: Optional[ProcessSet] = None) -> List[tf.Tensor]:
     _check_eager("grouped_allreduce")
     arrs = [_to_numpy(t) for t in tensors]
-    dtypes = [tf.as_dtype(t.dtype) if tf.is_tensor(t) else tf.float32
-              for t in tensors]
+    dtypes = [_dtype_of(t, a) for t, a in zip(tensors, arrs)]
     outs = eager.grouped_allreduce(
         [_submit(a, process_set) for a in arrs], name=name, op=op,
         process_set=process_set)
@@ -122,8 +115,8 @@ def grouped_allreduce(tensors: Sequence, name: Optional[str] = None,
 def allgather(tensor, name: Optional[str] = None,
               process_set: Optional[ProcessSet] = None) -> tf.Tensor:
     _check_eager("allgather")
-    dtype = tf.as_dtype(tensor.dtype) if tf.is_tensor(tensor) else tf.float32
     a = _to_numpy(tensor)
+    dtype = _dtype_of(tensor, a)
     out = eager.allgather(_submit(a, process_set), name=name,
                           process_set=process_set)
     return _to_tf(np.asarray(eager.to_local(out)), dtype)
@@ -132,8 +125,8 @@ def allgather(tensor, name: Optional[str] = None,
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
               process_set: Optional[ProcessSet] = None) -> tf.Tensor:
     _check_eager("broadcast")
-    dtype = tf.as_dtype(tensor.dtype) if tf.is_tensor(tensor) else tf.float32
     a = _to_numpy(tensor)
+    dtype = _dtype_of(tensor, a)
     out = eager.broadcast(_submit(a, process_set), root_rank=root_rank,
                           name=name, process_set=process_set)
     return _to_tf(np.asarray(eager.to_local(out)).reshape(a.shape), dtype)
@@ -145,8 +138,8 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
     ``(output, received_splits)`` (ragged form, same as the torch
     binding)."""
     _check_eager("alltoall")
-    dtype = tf.as_dtype(tensor.dtype) if tf.is_tensor(tensor) else tf.float32
     a = _to_numpy(tensor)
+    dtype = _dtype_of(tensor, a)
     world = process_set.size() if process_set is not None else basics.size()
     if splits is None:
         if a.shape[0] % world != 0:
@@ -156,23 +149,16 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
         out = eager.alltoall(_submit(a, process_set), name=name,
                              process_set=process_set)
         return _to_tf(_take_my_row(np.asarray(eager.to_local(out))), dtype)
-    sp = _to_numpy(splits).astype(np.int64).reshape(-1)
-    if eager.per_process_mode():
-        out, rsp = eager.alltoall(a, splits=sp, name=name,
-                                  process_set=process_set)
-    else:
-        outs, rsps = eager.alltoall([a] * world,
-                                    splits=np.tile(sp, (world, 1)),
-                                    name=name, process_set=process_set)
-        out, rsp = outs[basics.rank()], rsps[basics.rank()]
+    out, rsp = _ragged_alltoall(a, _to_numpy(splits), name=name,
+                                process_set=process_set)
     return _to_tf(out, dtype), tf.constant(np.ascontiguousarray(rsp))
 
 
 def reducescatter(tensor, name: Optional[str] = None, op: ReduceOp = Sum,
                   process_set: Optional[ProcessSet] = None) -> tf.Tensor:
     _check_eager("reducescatter")
-    dtype = tf.as_dtype(tensor.dtype) if tf.is_tensor(tensor) else tf.float32
     a = _to_numpy(tensor)
+    dtype = _dtype_of(tensor, a)
     world = process_set.size() if process_set is not None else basics.size()
     if a.shape[0] % world != 0:
         raise ValueError(

@@ -118,8 +118,8 @@ def _submit(t: torch.Tensor, process_set: Optional[ProcessSet] = None):
     # Single-controller SPMD: a stride-0 numpy view replicates this tensor
     # for every rank with zero host materialization (a dense world-sized
     # copy would blow up host memory for large gradients).
-    arr = _to_numpy(t)
-    return np.broadcast_to(arr[None], (_set_size(process_set),) + arr.shape)
+    from ..ops.bridge import replicate_for_controller
+    return replicate_for_controller(_to_numpy(t), process_set)
 
 
 def _ps(process_set: Optional[ProcessSet]):
@@ -293,12 +293,11 @@ def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None,
 
 
 # ------------------------------------------------------------------ alltoall
-def _take_my_row(t: torch.Tensor) -> torch.Tensor:
-    """Stacked sharded results ([world, *S] rows = per-rank outputs, or this
-    process's [1, *S] slice in multi-process mode) → this rank's row."""
-    if eager.per_process_mode():
-        return t[0] if t.shape[0] == 1 else t.reshape(-1, *t.shape[2:])
-    return t[basics.rank()]
+def _take_my_row(t):
+    """Stacked sharded results → this rank's row (shared bridge
+    convention)."""
+    from ..ops.bridge import take_my_row
+    return take_my_row(t)
 
 
 def alltoall_async(tensor: torch.Tensor, splits=None,
@@ -330,18 +329,9 @@ def alltoall(tensor: torch.Tensor, splits=None, name: Optional[str] = None,
           else np.asarray(splits)).astype(np.int64).reshape(-1)
     if sp.size != world:
         raise ValueError(f"splits must have {world} entries, got {sp.size}")
-    x = _to_numpy(tensor)
-    if eager.per_process_mode():
-        out, rsp = eager.alltoall(x, splits=sp, name=name,
-                                  process_set=process_set)
-    else:
-        # Single-controller SPMD: every rank contributes this tensor+splits
-        # (the torch convention, see module docstring); this rank's output.
-        outs, rsps = eager.alltoall([x] * world,
-                                    splits=np.tile(sp, (world, 1)),
-                                    name=name, process_set=process_set)
-        r = basics.rank()
-        out, rsp = outs[r], rsps[r]
+    from ..ops.bridge import ragged_alltoall_numpy
+    out, rsp = ragged_alltoall_numpy(_to_numpy(tensor), sp, name=name,
+                                     process_set=process_set)
     return (_from_numpy(np.ascontiguousarray(out), tensor.dtype,
                         tensor.device),
             torch.from_numpy(np.ascontiguousarray(rsp)))

@@ -215,3 +215,52 @@ def test_elastic_integration(tmp_path, mode):
     final_size = 2 if mode == "grow" else 1
     assert res["final_size"] == final_size, (res, out[-4000:])
     assert res["resets"] >= 1, (res, out[-4000:])
+
+
+def test_discovery_parse_malformed_line_skipped():
+    """ADVICE: a garbled slots field degrades to a warning, not a crash."""
+    d = HostDiscoveryScript("true")
+    hosts = d.parse("hostA:4\nhostB:oops\nhostC\n")
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("hostA", 4), ("hostC", 1)]
+
+
+def test_is_local_host_fqdn_and_ip():
+    """ADVICE: FQDN / resolved-IP references to this machine are local."""
+    import socket
+    from horovod_tpu.common.net import is_local_host, routable_addr
+    assert is_local_host("localhost")
+    assert is_local_host("127.0.0.1")
+    assert is_local_host(socket.gethostname())
+    assert is_local_host(socket.getfqdn())
+    addr = routable_addr()
+    if addr and addr[0].isdigit():
+        assert is_local_host(addr)
+    assert not is_local_host("definitely-not-this-host.invalid")
+
+
+def test_elastic_rendezvous_addr_routable_for_remote_hosts(monkeypatch):
+    """ADVICE (medium): with any remote worker, the published rendezvous
+    address must be a routable driver address, not 127.0.0.1."""
+    drv = ElasticDriver(HostDiscoveryScript("true"),
+                        [sys.executable, "-c", "pass"], min_np=1)
+    monkeypatch.setattr(drv, "_spawn", lambda *a, **k: None)
+    monkeypatch.setattr(drv, "_notify_workers", lambda *a, **k: None)
+    try:
+        assert drv._new_generation([DiscoveredHost("localhost", 2)])
+        assert drv._rdv_addr == "127.0.0.1"
+        assert drv._new_generation(
+            [DiscoveredHost("localhost", 1),
+             DiscoveredHost("remote-worker-1", 1)])
+        assert drv._rdv_addr != "127.0.0.1"
+        # explicit address always wins
+        drv2 = ElasticDriver(HostDiscoveryScript("true"),
+                             [sys.executable, "-c", "pass"], min_np=1,
+                             rendezvous_addr="10.0.0.7")
+        monkeypatch.setattr(drv2, "_spawn", lambda *a, **k: None)
+        monkeypatch.setattr(drv2, "_notify_workers", lambda *a, **k: None)
+        assert drv2._new_generation([DiscoveredHost("remote-worker-1", 2)])
+        assert drv2._rdv_addr == "10.0.0.7"
+        drv2.rendezvous.stop()
+    finally:
+        drv.rendezvous.stop()

@@ -277,3 +277,58 @@ def test_torovodrun_tensorflow_keras():
     assert res.returncode == 0 and ok == 2, (
         f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
         f"stderr:\n{res.stderr[-3000:]}")
+
+
+def test_controller_group_structure_mismatch_unit():
+    """Grouped on one rank, ungrouped on the other: per-tensor error naming
+    both sides (batching would diverge at the fusion threshold), while
+    legitimately drifted group IDS (both grouped) stay fine."""
+    import threading
+    import numpy as np
+    from horovod_tpu.common.controller import TCPController
+
+    port = _free_port()
+    results = {}
+
+    class E:
+        def __init__(self, name, gid):
+            self.name = name
+            self.group_id = gid
+            self.tensor = np.zeros((2, 3), np.float32)
+
+    def worker(rank):
+        ctl = TCPController("127.0.0.1", port, rank=rank, world=2,
+                            stall_warn_s=60.0)
+        try:
+            err = None
+            # "t": grouped on rank 0 (gid 5), ungrouped on rank 1 → error.
+            for _ in range(20):
+                ready, errored = ctl.negotiate(
+                    [E("t", 5 if rank == 0 else -1)])
+                if errored:
+                    err = errored[0][1]
+                    break
+            # "t2": grouped on BOTH with drifted ids → negotiates fine.
+            ok = []
+            for _ in range(20):
+                ready, errored = ctl.negotiate(
+                    [E("t2", 7 if rank == 0 else 99)])
+                assert not errored, errored
+                if ready:
+                    ok = [e.name for e in ready]
+                    break
+            results[rank] = (err, ok)
+        finally:
+            ctl.shutdown()
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert 0 in results and 1 in results, results
+    for r in (0, 1):
+        err, ok = results[r]
+        assert err is not None and "GROUPED" in err, results
+        assert "ranks [0]" in err and "ranks [1]" in err, results
+        assert ok == ["t2"], results
