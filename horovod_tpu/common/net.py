@@ -14,6 +14,7 @@ Two distinct problems, two helpers:
 
 from __future__ import annotations
 
+import functools
 import random
 import socket
 from typing import List
@@ -54,21 +55,11 @@ def routable_addr() -> str:
         s.close()
 
 
-def is_local_host(hostname: str) -> bool:
-    """True when ``hostname`` refers to this machine — by name, FQDN,
-    alias, or any resolved address of either — so local coordinators named
-    by FQDN/IP still get bind-probed ports instead of blind remote ones."""
-    if hostname in ("localhost", "127.0.0.1", "::1"):
-        return True
+@functools.lru_cache(maxsize=1)
+def _local_identity():
+    """This machine's names + resolved addresses, computed once (DNS can
+    block seconds per lookup; callers sit in polling loops)."""
     local_names = {socket.gethostname(), socket.getfqdn()}
-    if hostname in local_names:
-        return True
-    try:
-        target_addrs = set(socket.gethostbyname_ex(hostname)[2])
-    except OSError:
-        return False
-    if any(a.startswith("127.") for a in target_addrs):
-        return True
     local_addrs = set()
     for n in local_names:
         try:
@@ -79,4 +70,24 @@ def is_local_host(hostname: str) -> bool:
         local_addrs.add(routable_addr())
     except OSError:
         pass
+    return local_names, local_addrs
+
+
+@functools.lru_cache(maxsize=256)
+def is_local_host(hostname: str) -> bool:
+    """True when ``hostname`` refers to this machine — by name, FQDN,
+    alias, or any resolved address of either — so local coordinators named
+    by FQDN/IP still get bind-probed ports instead of blind remote ones.
+    Cached: resolution can block on slow DNS and callers poll."""
+    if hostname in ("localhost", "127.0.0.1", "::1"):
+        return True
+    local_names, local_addrs = _local_identity()
+    if hostname in local_names:
+        return True
+    try:
+        target_addrs = set(socket.gethostbyname_ex(hostname)[2])
+    except OSError:
+        return False
+    if any(a.startswith("127.") for a in target_addrs):
+        return True
     return bool(target_addrs & local_addrs)

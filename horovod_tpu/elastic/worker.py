@@ -64,9 +64,15 @@ class WorkerNotificationService:
                 if data.startswith("HOSTS_UPDATED"):
                     version = int(data.split()[1]) if " " in data else 0
                     self._on_hosts_updated(version)
-                conn.close()
             except (OSError, ValueError):
                 pass
+            finally:
+                # Close on EVERY path: timed-out connections would otherwise
+                # leak an fd each until accept() itself fails with EMFILE.
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
     def stop(self):
         try:

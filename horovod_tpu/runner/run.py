@@ -233,11 +233,20 @@ def ssh_command(host: str, env: Dict[str, str], command: List[str],
     return cmd
 
 
-def launch_workers(args, hosts: List[HostSpec]) -> int:
-    """Spawn all workers, wait, propagate first failure (local + ssh)."""
+def launch_workers(args, hosts: List[HostSpec],
+                   addrs: Optional[Dict[str, str]] = None) -> int:
+    """Spawn all workers, wait, propagate first failure (local + ssh).
+
+    ``addrs`` (from the bootstrap probe phase) overrides the coordinator
+    address with host 0's resolved control-plane address — this is what
+    makes ``--network-interface`` actually select the control plane."""
     ports = _free_ports(2)
-    coord = (hosts[0].hostname if hosts[0].hostname != "localhost"
-             else "127.0.0.1", ports[0], ports[1])
+    if addrs:
+        coord_host = addrs[hosts[0].hostname]
+    else:
+        coord_host = (hosts[0].hostname if hosts[0].hostname != "localhost"
+                      else "127.0.0.1")
+    coord = (coord_host, ports[0], ports[1])
     envs = worker_envs(args, hosts, coord)
     procs: List[subprocess.Popen] = []
     for rank, env in enumerate(envs):
@@ -280,4 +289,21 @@ def main(argv: Sequence[str]) -> int:
     if args.verbose:
         print(f"[torovodrun] launching np={args.np} over "
               f"{[(h.hostname, h.slots) for h in hosts]}", file=sys.stderr)
-    return launch_workers(args, hosts)
+    # Pre-launch bootstrap (reference P8): probe NICs + mutual connectivity
+    # whenever a host is remote or an explicit interface was requested —
+    # refuse fast with the exact broken pair instead of spawning workers
+    # that would hang in rendezvous.
+    addrs = None
+    from ..common.net import is_local_host
+    if args.nics or any(not is_local_host(h.hostname) for h in hosts):
+        from .bootstrap import bootstrap_hosts
+        try:
+            addrs = bootstrap_hosts(
+                hosts, nic=args.nics, ssh_port=args.ssh_port,
+                identity_file=args.ssh_identity_file,
+                timeout_s=min(args.start_timeout, 120),
+                verbose=args.verbose)
+        except RuntimeError as exc:
+            print(f"[torovodrun] {exc}", file=sys.stderr)
+            return 1
+    return launch_workers(args, hosts, addrs)

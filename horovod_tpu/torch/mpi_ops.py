@@ -324,12 +324,9 @@ def alltoall(tensor: torch.Tensor, splits=None, name: Optional[str] = None,
     ``(output, received_splits)`` (reference ``hvd.alltoall`` ragged form)."""
     if splits is None:
         return synchronize(alltoall_async(tensor, splits, name, process_set))
-    world = _set_size(process_set)
     sp = (splits.detach().cpu().numpy() if isinstance(splits, torch.Tensor)
-          else np.asarray(splits)).astype(np.int64).reshape(-1)
-    if sp.size != world:
-        raise ValueError(f"splits must have {world} entries, got {sp.size}")
-    from ..ops.bridge import ragged_alltoall_numpy
+          else np.asarray(splits))
+    from ..ops.bridge import ragged_alltoall_numpy  # validates splits length
     out, rsp = ragged_alltoall_numpy(_to_numpy(tensor), sp, name=name,
                                      process_set=process_set)
     return (_from_numpy(np.ascontiguousarray(out), tensor.dtype,

@@ -114,3 +114,110 @@ def test_local_launch_propagates_failure(tmp_path):
     args = parse_args(["-np", "2", "python", str(script)])
     rc = launch_workers(args, placement(args))
     assert rc == 3
+
+
+# ---------------------------------------------------------------- bootstrap
+class TestBootstrap:
+    """Host bootstrap services (reference P8: driver/task probe services,
+    NIC discovery, mutual connectivity matrix) — tested without a cluster
+    by running real probes on localhost, like test_run.py's style."""
+
+    def test_list_nics_has_loopback(self):
+        from horovod_tpu.runner.bootstrap import list_nics
+        nics = list_nics()
+        assert nics.get("lo") == "127.0.0.1", nics
+
+    def _probe_thread(self, port, label, nic=None):
+        import threading
+        from horovod_tpu.runner.bootstrap import probe_main
+        rc = {}
+        t = threading.Thread(
+            target=lambda: rc.setdefault(
+                "rc", probe_main("127.0.0.1", port, label, nic)),
+            daemon=True)
+        t.start()
+        return t, rc
+
+    def test_register_and_matrix_ok(self):
+        from horovod_tpu.runner.bootstrap import DriverService
+        svc = DriverService(["localhost"], timeout_s=20)
+        t, rc = self._probe_thread(svc.port, "localhost")
+        try:
+            addrs = svc.run()
+        finally:
+            svc.close()
+        t.join(timeout=10)
+        assert addrs == {"localhost": "127.0.0.1"} and rc.get("rc") == 0
+
+    def test_nic_selection_and_missing_nic(self):
+        from horovod_tpu.runner.bootstrap import DriverService
+        svc = DriverService(["localhost"], nic="lo", timeout_s=20)
+        t, _ = self._probe_thread(svc.port, "localhost", nic="lo")
+        try:
+            addrs = svc.run()
+        finally:
+            svc.close()
+        t.join(timeout=10)
+        assert addrs == {"localhost": "127.0.0.1"}
+
+        svc = DriverService(["localhost"], nic="no_such_nic0", timeout_s=20)
+        t, _ = self._probe_thread(svc.port, "localhost", nic="no_such_nic0")
+        try:
+            with pytest.raises(RuntimeError, match="no interface named"):
+                svc.run()
+        finally:
+            svc.close()
+        t.join(timeout=10)
+
+    def test_connectivity_failure_names_pair(self):
+        """A fake peer registers with a dead listen port: the launch must
+        refuse naming exactly (real host, fake host)."""
+        import json
+        import socket
+        import threading
+        from horovod_tpu.runner.bootstrap import DriverService
+
+        # A port with nothing listening:
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+        dead.close()
+
+        svc = DriverService(["localhost", "ghost"], timeout_s=30)
+        t, _ = self._probe_thread(svc.port, "localhost")
+
+        def fake_ghost():
+            s = socket.create_connection(("127.0.0.1", svc.port), timeout=10)
+            s.sendall((json.dumps(
+                {"type": "register", "host": "ghost", "nics": {},
+                 "addr": None, "listen_port": dead_port, "slots": 1,
+                 "nic_found": True}) + "\n").encode())
+            fh = s.makefile()
+            fh.readline()                      # check request
+            s.sendall((json.dumps(
+                {"type": "result", "host": "ghost",
+                 "reachable": {"localhost": True}}) + "\n").encode())
+            fh.readline()
+            s.close()
+
+        g = threading.Thread(target=fake_ghost, daemon=True)
+        g.start()
+        try:
+            with pytest.raises(RuntimeError,
+                               match="'localhost' cannot reach .*'ghost'"):
+                svc.run()
+        finally:
+            svc.close()
+        t.join(timeout=15)
+        g.join(timeout=15)
+
+    def test_timeout_names_missing_host(self):
+        from horovod_tpu.runner.bootstrap import DriverService
+        svc = DriverService(["localhost", "never-shows-up"], timeout_s=2)
+        t, _ = self._probe_thread(svc.port, "localhost")
+        try:
+            with pytest.raises(RuntimeError, match="never-shows-up"):
+                svc.run()
+        finally:
+            svc.close()
+        t.join(timeout=15)
