@@ -20,6 +20,9 @@ from __future__ import annotations
 from typing import Any, Callable, List, Optional
 
 from .store import GCSStore, LocalStore, Store  # noqa: F401
+from .estimator import (  # noqa: F401
+    JaxEstimator, JaxModel, TorchEstimator, TorchModel,
+)
 
 
 def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,

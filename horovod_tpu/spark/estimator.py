@@ -1,0 +1,370 @@
+"""Spark Estimator API: ``fit(DataFrame) -> Model`` (reference:
+``horovod/spark/torch/estimator.py``, ``horovod/spark/keras/estimator.py``,
+``horovod/spark/common/params.py`` — SURVEY.md §2b P11, VERDICT missing #3).
+
+Flow, mirroring the reference:
+
+1. **Materialize**: the DataFrame's feature/label columns are collected and
+   written as ``num_proc`` numpy shards into the :class:`Store`
+   (the reference materializes Parquet via Petastorm; numpy-npz shards are
+   the TPU-image equivalent — same Store layout, no Petastorm dependency).
+2. **Train**: ``horovod_tpu.spark.run`` executes the train function on
+   every executor; each rank reads ITS shard from the store, trains with
+   cross-rank gradient averaging through the coordinator, and rank 0
+   writes the final parameters to the store's checkpoint path.
+3. **Model**: ``fit`` returns a transformer holding the trained
+   parameters; ``transform(df)`` appends a prediction column,
+   ``predict(X)`` serves numpy directly.
+
+Backends are pluggable: the default requires pyspark (absent from the TPU
+test image), so tests inject a local in-process backend — the same
+seam the reference's ``backend`` param provides.
+
+Two frontends share the plumbing: :class:`JaxEstimator` (TPU-native
+flagship) and :class:`TorchEstimator` (the reference's headline API).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import uuid
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .store import LocalStore, Store
+
+
+def _rows_to_arrays(df, feature_cols: Sequence[str],
+                    label_cols: Sequence[str]):
+    """DataFrame-ish → (X [N, F], y [N, L]) float32 arrays.
+
+    Accepts a pyspark DataFrame (``select(...).collect()``), any object
+    with the same shape of API (the test doubles), or a plain sequence of
+    dict rows.
+    """
+    cols = list(feature_cols) + list(label_cols)
+    if hasattr(df, "select"):
+        rows = [tuple(r) for r in df.select(*cols).collect()]
+    elif hasattr(df, "collect"):
+        rows = [tuple(r[c] for c in cols) for r in df.collect()]
+    else:
+        rows = [tuple(r[c] for c in cols) for r in df]
+    nf = len(feature_cols)
+    if not rows:
+        return (np.zeros((0, nf), np.float32),
+                np.zeros((0, len(label_cols)), np.float32))
+    data = np.asarray(rows, dtype=np.float32)
+    return data[:, :nf], data[:, nf:]
+
+
+def _write_shards(store: Store, X: np.ndarray, y: np.ndarray,
+                  num_shards: int, run_id: str) -> int:
+    """Round-robin partitioned materialization into the store's train-data
+    paths (reference: the Petastorm parquet materialization step).
+
+    Every shard is padded to the SAME length by wrapping around the global
+    rows: ranks therefore run identical batch counts per epoch, which the
+    lock-step collective schedule requires (unequal counts would leave one
+    rank blocking in an allreduce its peers never join).  Paths are
+    namespaced by ``run_id`` so concurrent fits sharing a store cannot
+    overwrite each other's shards.
+    """
+    per = max(1, -(-len(X) // num_shards))      # ceil, >= 1 row per shard
+    for i in range(num_shards):
+        idxs = [(i + k * num_shards) % len(X) for k in range(per)]
+        buf = io.BytesIO()
+        np.savez(buf, X=X[idxs], y=y[idxs])
+        store.write(store.get_train_data_path(i, run_id=run_id),
+                    buf.getvalue())
+    return num_shards
+
+
+def _read_shard(store: Store, idx: int, run_id: str):
+    data = np.load(io.BytesIO(
+        store.read(store.get_train_data_path(idx, run_id=run_id))))
+    return data["X"], data["y"]
+
+
+def _local_backend(fn: Callable[[], Any], num_proc: int, env=None) -> List:
+    """In-process backend for environments without pyspark (tests / direct
+    use): runs the train function once in the current single-controller
+    world.  Refuses num_proc > 1 — training only shard 0 of a multi-shard
+    materialization would silently drop most of the data."""
+    if num_proc > 1:
+        raise RuntimeError(
+            "num_proc > 1 needs pyspark (the default Spark backend) or an "
+            "explicitly injected backend that actually runs one process "
+            "per rank; the in-process fallback would train on 1 shard of "
+            f"{num_proc} and silently discard the rest")
+    return [fn()]
+
+
+def _spark_backend(fn: Callable[[], Any], num_proc: int, env=None) -> List:
+    from . import run
+    return run(fn, num_proc=num_proc, env=env)
+
+
+class _EstimatorBase:
+    """Shared param surface (reference: ``common/params.py``) + fit
+    plumbing."""
+
+    def __init__(self, *, feature_cols: Sequence[str],
+                 label_cols: Sequence[str], store: Optional[Store] = None,
+                 num_proc: Optional[int] = None, batch_size: int = 32,
+                 epochs: int = 1, learning_rate: float = 0.01,
+                 run_id: Optional[str] = None, backend=None, seed: int = 0,
+                 verbose: int = 0):
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.store = store or LocalStore("/tmp/horovod_tpu_estimator")
+        self.num_proc = num_proc
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.run_id = run_id or f"run_{uuid.uuid4().hex[:8]}"
+        self.backend = backend
+        self.seed = seed
+        self.verbose = verbose
+
+    # Subclasses provide: _make_train_fn(num_proc) -> callable returning
+    # final params on rank 0 (written to the store) and _make_model(params).
+
+    def fit(self, df):
+        num_proc = self.num_proc or 1
+        X, y = _rows_to_arrays(df, self.feature_cols, self.label_cols)
+        if len(X) == 0:
+            raise ValueError("fit() got an empty DataFrame")
+        _write_shards(self.store, X, y, num_proc, self.run_id)
+        backend = self.backend
+        if backend is None:
+            backend = (_spark_backend if self._pyspark_available()
+                       else _local_backend)
+        ckpt_path = self.store.get_checkpoint_path(self.run_id)
+        backend(self._make_train_fn(num_proc, ckpt_path), num_proc)
+        params = pickle.loads(self.store.read(ckpt_path))
+        return self._make_model(params)
+
+    @staticmethod
+    def _pyspark_available() -> bool:
+        try:
+            import pyspark  # noqa: F401
+            return True
+        except ImportError:
+            return False
+
+
+class _ModelBase:
+    """Transformer returned by ``fit`` (reference: ``TorchModel`` /
+    ``KerasModel``): holds trained params; ``transform`` appends an
+    ``output_col`` prediction column, ``predict`` serves numpy."""
+
+    def __init__(self, params, feature_cols: Sequence[str],
+                 output_col: str = "prediction"):
+        self.params = params
+        self.feature_cols = list(feature_cols)
+        self.output_col = output_col
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def transform(self, df):
+        if hasattr(df, "withColumn"):   # pyspark DataFrame
+            import pyspark.sql.functions as F
+            from pyspark.sql.types import DoubleType
+            model = self
+
+            @F.udf(returnType=DoubleType())
+            def _predict(*features):
+                x = np.asarray(features, np.float32)[None]
+                return float(model.predict(x).reshape(-1)[0])
+
+            return df.withColumn(self.output_col,
+                                 _predict(*self.feature_cols))
+        rows = ([{c: r[c] for c in r} for r in df.collect()]
+                if hasattr(df, "collect") else
+                [dict(r) for r in df])
+        X = np.asarray([[r[c] for c in self.feature_cols] for r in rows],
+                       np.float32)
+        preds = self.predict(X).reshape(len(rows), -1)
+        for r, p in zip(rows, preds):
+            r[self.output_col] = float(p[0]) if p.size == 1 else p.tolist()
+        return rows
+
+
+# ------------------------------------------------------------------- JAX
+class JaxModel(_ModelBase):
+    def __init__(self, params, apply_fn, feature_cols, output_col="prediction"):
+        super().__init__(params, feature_cols, output_col)
+        self.apply_fn = apply_fn
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return np.asarray(self.apply_fn(self.params, np.asarray(X, np.float32)))
+
+
+class JaxEstimator(_EstimatorBase):
+    """TPU-native estimator over a (init_fn, apply_fn, loss_fn) triple.
+
+    ``init_fn(rng, sample_x) -> params``; ``apply_fn(params, X) -> pred``;
+    ``loss_fn(pred, y) -> scalar``.  Gradients are averaged across ranks
+    through the coordinator every step (the reference's DistributedOptimizer
+    contract), so each executor trains on its own shard and all end with
+    identical parameters.
+    """
+
+    def __init__(self, *, init_fn, apply_fn, loss_fn, **kwargs):
+        super().__init__(**kwargs)
+        self.init_fn = init_fn
+        self.apply_fn = apply_fn
+        self.loss_fn = loss_fn
+
+    def _make_train_fn(self, num_proc: int, ckpt_path: str):
+        store, run_id = self.store, self.run_id
+        init_fn, apply_fn, loss_fn = self.init_fn, self.apply_fn, self.loss_fn
+        batch_size, epochs, lr = self.batch_size, self.epochs, self.learning_rate
+        seed, verbose = self.seed, self.verbose
+
+        def train():
+            import jax
+            import jax.numpy as jnp
+            import optax
+            import horovod_tpu as hvd
+
+            if not hvd.is_initialized():
+                hvd.init()
+            rank = hvd.rank()
+            shard = rank if num_proc > 1 else 0
+            X, y = _read_shard(store, shard, run_id)
+            params = init_fn(jax.random.PRNGKey(seed), X[:1])
+            # Identical start everywhere (reference: broadcast_parameters).
+            from ..ops.eager import broadcast_pytree
+            params = broadcast_pytree(params, root_rank=0)
+            opt = optax.sgd(lr)
+            opt_state = opt.init(params)
+
+            @jax.jit
+            def local_grads(params, xb, yb):
+                def batch_loss(p):
+                    return jnp.mean(loss_fn(apply_fn(p, xb), yb))
+                return jax.value_and_grad(batch_loss)(params)
+
+            losses = []
+            for epoch in range(epochs):
+                for off in range(0, len(X), batch_size):
+                    xb, yb = X[off:off + batch_size], y[off:off + batch_size]
+                    loss, grads = local_grads(params, xb, yb)
+                    grads = _eager_allreduce_pytree(grads)
+                    updates, opt_state = opt.update(grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
+                    losses.append(float(loss))
+                if verbose:
+                    print(f"[estimator] rank={rank} epoch={epoch} "
+                          f"loss={losses[-1]:.4f}")
+            if rank == 0:
+                host = jax.tree_util.tree_map(np.asarray, params)
+                store.write(ckpt_path, pickle.dumps(host))
+            hvd.barrier()
+            return losses[-1]
+
+        return train
+
+    def _make_model(self, params):
+        return JaxModel(params, self.apply_fn, self.feature_cols)
+
+
+def _eager_allreduce_pytree(tree):
+    """Average a gradient pytree across ranks through the coordinator
+    (compress-free minimal version of the torch/TF bindings' hook path)."""
+    import jax
+    import horovod_tpu as hvd
+    from ..ops.bridge import submit_numpy
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrays = [np.asarray(l) for l in leaves]
+    outs = hvd.grouped_allreduce(
+        [submit_numpy(a) for a in arrays], name="estimator.grads",
+        op=hvd.Average)
+    outs = [np.asarray(hvd.to_local(o)).reshape(a.shape)
+            for o, a in zip(outs, arrays)]
+    return jax.tree_util.tree_unflatten(treedef, outs)
+
+
+# ----------------------------------------------------------------- Torch
+class TorchModel(_ModelBase):
+    def __init__(self, state_dict, model_factory, feature_cols,
+                 output_col="prediction"):
+        super().__init__(state_dict, feature_cols, output_col)
+        self.model_factory = model_factory
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        import torch
+        model = getattr(self, "_model", None)
+        if model is None:
+            # Built once and reused: the pyspark transform UDF calls
+            # predict per ROW — rebuilding the module each time would
+            # construct millions of modules on a real DataFrame.
+            model = self.model_factory()
+            model.load_state_dict(self.params)
+            model.eval()
+            self._model = model
+        with torch.no_grad():
+            return model(torch.from_numpy(
+                np.asarray(X, np.float32))).numpy()
+
+
+class TorchEstimator(_EstimatorBase):
+    """Reference-parity estimator (``horovod/spark/torch/estimator.py``):
+    ``model_factory`` builds the torch module, ``loss`` maps
+    ``(pred, target) -> scalar``; training runs under the torch binding's
+    DistributedOptimizer so gradients average across executors."""
+
+    def __init__(self, *, model_factory, loss, **kwargs):
+        super().__init__(**kwargs)
+        self.model_factory = model_factory
+        self.loss = loss
+
+    def _make_train_fn(self, num_proc: int, ckpt_path: str):
+        store, run_id = self.store, self.run_id
+        model_factory, loss_fn = self.model_factory, self.loss
+        batch_size, epochs, lr = self.batch_size, self.epochs, self.learning_rate
+        seed, verbose = self.seed, self.verbose
+
+        def train():
+            import torch
+            import horovod_tpu as hvd
+            import horovod_tpu.torch as tvd
+
+            if not hvd.is_initialized():
+                hvd.init()
+            rank = tvd.rank()
+            shard = rank if num_proc > 1 else 0
+            X, y = _read_shard(store, shard, run_id)
+            torch.manual_seed(seed)
+            model = model_factory()
+            tvd.broadcast_parameters(model.state_dict(), root_rank=0)
+            opt = tvd.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=lr),
+                named_parameters=model.named_parameters())
+            last = 0.0
+            for epoch in range(epochs):
+                for off in range(0, len(X), batch_size):
+                    xb = torch.from_numpy(X[off:off + batch_size])
+                    yb = torch.from_numpy(y[off:off + batch_size])
+                    opt.zero_grad()
+                    loss = loss_fn(model(xb), yb)
+                    loss.backward()
+                    opt.step()
+                    last = float(loss.detach())
+                if verbose:
+                    print(f"[estimator] rank={rank} epoch={epoch} "
+                          f"loss={last:.4f}")
+            if rank == 0:
+                store.write(ckpt_path, pickle.dumps(model.state_dict()))
+            tvd.barrier()
+            return last
+
+        return train
+
+    def _make_model(self, state_dict):
+        return TorchModel(state_dict, self.model_factory, self.feature_cols)

@@ -16,10 +16,10 @@ from typing import Optional
 
 
 class Store:
-    def get_train_data_path(self, idx=None) -> str:
+    def get_train_data_path(self, idx=None, run_id=None) -> str:
         raise NotImplementedError
 
-    def get_val_data_path(self, idx=None) -> str:
+    def get_val_data_path(self, idx=None, run_id=None) -> str:
         raise NotImplementedError
 
     def get_checkpoint_path(self, run_id: str) -> str:
@@ -63,13 +63,17 @@ class LocalStore(Store):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         return path
 
-    def get_train_data_path(self, idx=None) -> str:
+    def get_train_data_path(self, idx=None, run_id=None) -> str:
         suffix = f".{idx}" if idx is not None else ""
-        return self._join("intermediate_train_data" + suffix)
+        parts = ([run_id] if run_id else []) + [
+            "intermediate_train_data" + suffix]
+        return self._join(*parts)
 
-    def get_val_data_path(self, idx=None) -> str:
+    def get_val_data_path(self, idx=None, run_id=None) -> str:
         suffix = f".{idx}" if idx is not None else ""
-        return self._join("intermediate_val_data" + suffix)
+        parts = ([run_id] if run_id else []) + [
+            "intermediate_val_data" + suffix]
+        return self._join(*parts)
 
     def get_checkpoint_path(self, run_id: str) -> str:
         return self._join(run_id, "checkpoint")
@@ -86,7 +90,9 @@ class LocalStore(Store):
 
     def write(self, path: str, data: bytes):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = path + ".tmp"
+        # pid-unique tmp: concurrent writers (e.g. every estimator worker
+        # materializing the same shards) must never share a staging file.
+        tmp = f"{path}.{os.getpid()}.tmp"
         with open(tmp, "wb") as fh:
             fh.write(data)
         os.replace(tmp, path)

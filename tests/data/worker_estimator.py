@@ -1,0 +1,68 @@
+"""Multi-process estimator worker: every rank runs ``fit`` with a local
+backend over a SHARED store — each rank materializes (idempotently), reads
+its own shard, averages gradients through the coordinator, and all ranks
+end with identical learned parameters (reference:
+``horovod/spark/torch/estimator.py`` training flow).  Launched by
+torovodrun in test_multiprocess.py.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.spark import JaxEstimator, LocalStore
+
+
+class Rows:
+    def __init__(self, rows):
+        self._rows = rows
+
+    def collect(self):
+        return self._rows
+
+
+def main():
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+
+    rng = np.random.RandomState(0)        # SAME data on every rank
+    X = rng.randn(64, 3).astype(np.float32)
+    w = np.array([1.0, -2.0, 0.5], np.float32)
+    y = X @ w
+    df = Rows([{"f0": float(a), "f1": float(b), "f2": float(c),
+                "label": float(t)} for (a, b, c), t in zip(X, y)])
+
+    est = JaxEstimator(
+        init_fn=lambda r, x: {"w": jnp.zeros((x.shape[1],)),
+                              "b": jnp.zeros(())},
+        apply_fn=lambda p, Xb: Xb @ p["w"] + p["b"],
+        loss_fn=lambda pred, yb: (pred - yb.reshape(pred.shape)) ** 2,
+        feature_cols=["f0", "f1", "f2"], label_cols=["label"],
+        store=LocalStore(os.environ["EST_DIR"]), num_proc=size,
+        epochs=40, batch_size=16, learning_rate=0.1, run_id="mp",
+        backend=lambda fn, n, env=None: [fn()])
+    model = est.fit(df)
+    np.testing.assert_allclose(np.asarray(model.params["w"]), w, atol=0.1)
+
+    # All ranks must hold identical trained params (grads were averaged).
+    digest = np.array([float(np.asarray(model.params["w"]).sum()),
+                       float(model.params["b"])], np.float64)
+    g = hvd.to_local(hvd.allgather(digest, name="est_digest")).reshape(size, 2)
+    for r in range(size):
+        np.testing.assert_allclose(g[r], g[0], rtol=1e-9)
+
+    print(f"EST_OK rank={rank}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -343,3 +343,18 @@ def test_torovodrun_with_network_interface():
     assert res.returncode == 0 and ok == 2, (
         f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
         f"stderr:\n{res.stderr[-3000:]}")
+
+
+WORKER_EST = os.path.join(REPO, "tests", "data", "worker_estimator.py")
+
+
+def test_torovodrun_estimator_sharded_training(tmp_path):
+    """Estimator pipeline across real processes (VERDICT missing #3):
+    shared-store materialization, per-rank shard reads, coordinator-avg
+    gradients, identical final params on every rank."""
+    res = _run_torovodrun(2, WORKER_EST, timeout=300,
+                          extra_env={"EST_DIR": str(tmp_path)})
+    ok = res.stdout.count("EST_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")

@@ -4,6 +4,7 @@ with no cluster, SURVEY.md §4 test_ray.py/test_spark.py)."""
 
 import os
 
+import numpy as np
 import pytest
 
 from horovod_tpu.ray import NodeResources, RayExecutor, pack, spread
@@ -121,3 +122,107 @@ def test_remote_ports_deterministic():
     assert remote_ports(2, 7) != remote_ports(2, 8)
     p = remote_ports(3, 123)
     assert all(20000 <= x < 60000 for x in p)
+
+
+# ---------------------------------------------------------------- estimator
+class FakeRow(dict):
+    pass
+
+
+class FakeDataFrame:
+    """Test double with the DataFrame API surface the estimator touches
+    (reference test style: mock Spark, assert on behavior)."""
+
+    def __init__(self, rows):
+        self._rows = [FakeRow(r) for r in rows]
+
+    def select(self, *cols):
+        return FakeSelected([[r[c] for c in cols] for r in self._rows])
+
+    def collect(self):
+        return self._rows
+
+
+class FakeSelected:
+    def __init__(self, rows):
+        self._rows = rows
+
+    def collect(self):
+        return self._rows
+
+
+def _linear_df(n=64, noise=0.01, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 3).astype(np.float32)
+    w = np.array([1.0, -2.0, 0.5], np.float32)
+    y = X @ w + noise * rng.randn(n).astype(np.float32)
+    return FakeDataFrame(
+        [{"f0": float(a), "f1": float(b), "f2": float(c), "label": float(t)}
+         for (a, b, c), t in zip(X, y)])
+
+
+def test_jax_estimator_fit_transform(hvd, tmp_path):
+    """fit(df) materializes shards, trains through the coordinator, and
+    returns a transformer (VERDICT missing #3 'done' criterion)."""
+    import jax.numpy as jnp
+    from horovod_tpu.spark import JaxEstimator, JaxModel, LocalStore
+
+    def init_fn(rng, sample_x):
+        return {"w": jnp.zeros((sample_x.shape[1],)), "b": jnp.zeros(())}
+
+    def apply_fn(params, X):
+        return X @ params["w"] + params["b"]
+
+    def loss_fn(pred, y):
+        return (pred - y.reshape(pred.shape)) ** 2
+
+    store = LocalStore(str(tmp_path))
+    est = JaxEstimator(init_fn=init_fn, apply_fn=apply_fn, loss_fn=loss_fn,
+                       feature_cols=["f0", "f1", "f2"], label_cols=["label"],
+                       store=store, epochs=30, batch_size=16,
+                       learning_rate=0.1, run_id="jaxrun")
+    model = est.fit(_linear_df())
+    assert isinstance(model, JaxModel)
+    # learned ≈ the generating weights
+    np.testing.assert_allclose(np.asarray(model.params["w"]),
+                               [1.0, -2.0, 0.5], atol=0.1)
+    # materialization used the reference Store layout
+    assert store.exists(store.get_train_data_path(0, run_id="jaxrun"))
+    assert store.exists(store.get_checkpoint_path("jaxrun"))
+    # transform appends the prediction column
+    out = model.transform(_linear_df(n=8))
+    assert len(out) == 8 and all("prediction" in r for r in out)
+    preds = model.predict(np.array([[1.0, 0.0, 0.0]], np.float32))
+    assert abs(float(preds[0]) - 1.0) < 0.2
+
+
+def test_torch_estimator_fit_transform(hvd, tmp_path):
+    import torch
+    from horovod_tpu.spark import LocalStore, TorchEstimator, TorchModel
+
+    def model_factory():
+        return torch.nn.Linear(3, 1)
+
+    store = LocalStore(str(tmp_path))
+    est = TorchEstimator(model_factory=model_factory,
+                         loss=lambda p, t: torch.nn.functional.mse_loss(
+                             p, t.reshape(p.shape)),
+                         feature_cols=["f0", "f1", "f2"],
+                         label_cols=["label"], store=store, epochs=30,
+                         batch_size=16, learning_rate=0.1, run_id="torchrun")
+    model = est.fit(_linear_df())
+    assert isinstance(model, TorchModel)
+    w = model.params["weight"].numpy().reshape(-1)
+    np.testing.assert_allclose(w, [1.0, -2.0, 0.5], atol=0.15)
+    out = model.transform(_linear_df(n=5))
+    assert len(out) == 5 and all("prediction" in r for r in out)
+
+
+def test_estimator_empty_df_raises(hvd, tmp_path):
+    from horovod_tpu.spark import JaxEstimator, LocalStore
+    est = JaxEstimator(init_fn=lambda r, x: {}, apply_fn=lambda p, X: X,
+                       loss_fn=lambda p, y: p,
+                       feature_cols=["f0"], label_cols=["label"],
+                       store=LocalStore(str(tmp_path)))
+    with pytest.raises(ValueError, match="empty"):
+        est.fit(FakeDataFrame([]))
