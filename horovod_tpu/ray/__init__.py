@@ -6,3 +6,4 @@ pack/spread placement logic (usable and tested without Ray installed).
 
 from .runner import RayExecutor  # noqa: F401
 from .strategy import Allocation, NodeResources, pack, spread  # noqa: F401
+from .elastic import ElasticRayExecutor, RayHostDiscovery  # noqa: F401
