@@ -73,21 +73,33 @@ def _local_identity():
     return local_names, local_addrs
 
 
-@functools.lru_cache(maxsize=256)
+# Only SUCCESSFUL resolutions are cached: a transient DNS failure must be
+# retried on the next call, not frozen as "remote" for the process lifetime
+# (which would send the bootstrap ssh-ing to itself / picking blind remote
+# ports for a local coordinator).
+_is_local_cache: dict = {}
+
+
 def is_local_host(hostname: str) -> bool:
     """True when ``hostname`` refers to this machine — by name, FQDN,
     alias, or any resolved address of either — so local coordinators named
     by FQDN/IP still get bind-probed ports instead of blind remote ones.
-    Cached: resolution can block on slow DNS and callers poll."""
+    Cached on success only: resolution can block on slow DNS and callers
+    poll, but a failed lookup is transient and must not stick."""
     if hostname in ("localhost", "127.0.0.1", "::1"):
         return True
+    cached = _is_local_cache.get(hostname)
+    if cached is not None:
+        return cached
     local_names, local_addrs = _local_identity()
     if hostname in local_names:
+        _is_local_cache[hostname] = True
         return True
     try:
         target_addrs = set(socket.gethostbyname_ex(hostname)[2])
     except OSError:
         return False
-    if any(a.startswith("127.") for a in target_addrs):
-        return True
-    return bool(target_addrs & local_addrs)
+    result = (any(a.startswith("127.") for a in target_addrs)
+              or bool(target_addrs & local_addrs))
+    _is_local_cache[hostname] = result
+    return result

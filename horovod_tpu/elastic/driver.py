@@ -36,6 +36,14 @@ from ..common.net import is_local_host, remote_ports  # noqa: E402
 
 
 class ElasticDriver:
+    # When True, every generation change kills and respawns ALL workers —
+    # even survivors — instead of only replacing exited ones.  The process
+    # path keeps this False (surviving workers re-rank in place by
+    # long-polling the versioned rendezvous); executors whose workers are
+    # one-shot closures with env baked at spawn (Ray actors) set it True
+    # because their workers cannot pick up a new world without a restart.
+    respawn_on_generation = False
+
     def __init__(self, discovery: HostDiscovery, command: List[str],
                  min_np: int, max_np: Optional[int] = None,
                  env: Optional[Dict[str, str]] = None,
@@ -200,6 +208,13 @@ class ElasticDriver:
         self._notify_workers(version)
         for identity, a in assignments.items():
             proc = self._procs.get(identity)
+            if (proc is not None and proc.poll() is None
+                    and self.respawn_on_generation):
+                # Replace the live worker: drop it from the table first so
+                # its forced exit is never reaped as a host failure.
+                del self._procs[identity]
+                proc.terminate()
+                proc = None
             if proc is None or proc.poll() is not None:
                 self._spawn(identity, a)
         return True
@@ -316,8 +331,14 @@ def run_elastic(args) -> int:
     ``_run_elastic``)."""
     min_np = args.min_np or args.np or 1
     max_np = args.max_np
-    discovery = HostDiscoveryScript(args.host_discovery_script,
-                                    default_slots=args.slots_per_host or 1)
+    if getattr(args, "tpu_metadata_discovery", False):
+        from .discovery import TPUMetadataDiscovery
+        discovery = TPUMetadataDiscovery(
+            slots_per_host=args.slots_per_host or 0)
+    else:
+        discovery = HostDiscoveryScript(args.host_discovery_script,
+                                        default_slots=args.slots_per_host
+                                        or 1)
     extra_env = {}
     for flag, var, scale in (
             ("fusion_threshold_mb", "HOROVOD_FUSION_THRESHOLD", 1024 * 1024),

@@ -31,5 +31,10 @@ def broadcast_global_variables(model, root_rank: int = 0):
     variables = list(model.weights)
     opt = getattr(model, "optimizer", None)
     if opt is not None:
-        variables += [v for v in getattr(opt, "variables", [])]
+        # Keras 3 exposes ``optimizer.variables`` as a property; legacy
+        # tf.keras (Keras 2) optimizers expose it as a bound method.
+        opt_vars = getattr(opt, "variables", None)
+        if callable(opt_vars):
+            opt_vars = opt_vars()
+        variables += list(opt_vars or [])
     broadcast_variables(variables, root_rank=root_rank)

@@ -103,7 +103,16 @@ class _ActorProc:
 
 
 class _RayElasticDriver(ElasticDriver):
-    """ElasticDriver whose spawn creates Ray actors instead of processes."""
+    """ElasticDriver whose spawn creates Ray actors instead of processes.
+
+    Actors run ``train_fn`` as a one-shot closure with env baked at spawn,
+    so they cannot re-rank in place when the world changes the way the
+    process path's rendezvous long-poll allows — every generation therefore
+    kills and respawns the full actor set (``respawn_on_generation``) with
+    the complete world assignment in the environment.
+    """
+
+    respawn_on_generation = True
 
     def __init__(self, *args, executor: "ElasticRayExecutor", **kwargs):
         super().__init__(*args, **kwargs)
@@ -112,6 +121,19 @@ class _RayElasticDriver(ElasticDriver):
     def _spawn(self, identity: str, assignment: dict):
         env = self._worker_env(identity, assignment["hostname"],
                                assignment["local_rank"])
+        # One-shot actors see their whole world statically (no rendezvous
+        # long-poll), so the full assignment rides the environment.
+        env.update({
+            "HOROVOD_RANK": str(assignment["rank"]),
+            "HOROVOD_SIZE": str(assignment["size"]),
+            "HOROVOD_LOCAL_SIZE": str(assignment["local_size"]),
+            "HOROVOD_CROSS_RANK": str(assignment["cross_rank"]),
+            "HOROVOD_CROSS_SIZE": str(assignment["cross_size"]),
+            "HOROVOD_CONTROLLER_ADDR": assignment["controller_addr"],
+            "HOROVOD_CONTROLLER_PORT": str(assignment["controller_port"]),
+            "HOROVOD_CONTROLLER_PORT2": str(
+                assignment["controller_port2"]),
+        })
         hvd_env = {k: v for k, v in env.items()
                    if k.startswith("HOROVOD_")}
         proc = self._executor._make_actor(assignment["hostname"], hvd_env)

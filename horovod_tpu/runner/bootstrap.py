@@ -191,7 +191,13 @@ class DriverService:
                     conn, peer = self._sock.accept()
                 except socket.timeout:
                     continue
-                conn.settimeout(30.0)
+                # The probe's connectivity phase legitimately takes up to
+                # one connect timeout per unreachable peer before it can
+                # answer — scale the read timeout accordingly so a cluster
+                # with many broken pairs still gets the exact broken-pair
+                # diagnostic instead of a spurious "probe wedged".
+                conn.settimeout(
+                    30.0 + len(self.expected) * _CONNECT_TIMEOUT_S)
                 fh = conn.makefile()
                 try:
                     msg = _read_json_line(fh)

@@ -94,7 +94,70 @@ def test_local_store(tmp_path):
 
 def test_store_unknown_scheme():
     with pytest.raises(NotImplementedError):
-        Store.create("hdfs://namenode/path")
+        Store.create("abfs://container/path")
+
+
+class DictFS:
+    """Injectable filesystem double for RemoteStore (DESIGN.md: remote I/O
+    is environment-blocked; the layout + plumbing are not)."""
+
+    def __init__(self):
+        self.blobs = {}
+
+    def exists(self, path):
+        return path in self.blobs or any(
+            k.startswith(path.rstrip("/") + "/") for k in self.blobs)
+
+    def read(self, path):
+        return self.blobs[path]
+
+    def write(self, path, data):
+        self.blobs[path] = data
+
+    def delete(self, path):
+        for k in [k for k in self.blobs
+                  if k == path or k.startswith(path.rstrip("/") + "/")]:
+            del self.blobs[k]
+
+
+@pytest.mark.parametrize("cls_name,prefix", [
+    ("HDFSStore", "hdfs://namenode:9000/horovod"),
+    ("S3Store", "s3://bucket/horovod"),
+    ("GCSStore", "gs://bucket/horovod"),
+])
+def test_remote_store_layout_and_io(cls_name, prefix):
+    import horovod_tpu.spark as hs
+    fs = DictFS()
+    store = getattr(hs, cls_name)(prefix, fs=fs)
+    # Reference layout over URL joins.
+    assert store.get_train_data_path(3, run_id="r1") == \
+        f"{prefix}/r1/intermediate_train_data.3"
+    assert store.get_val_data_path(run_id="r1") == \
+        f"{prefix}/r1/intermediate_val_data"
+    ckpt = store.get_checkpoint_path("r1")
+    assert ckpt == f"{prefix}/r1/checkpoint"
+    assert store.get_logs_path("r1") != ckpt
+    # I/O round trip + recursive delete through the adapter.
+    store.write(ckpt + "/model.bin", b"\x01\x02")
+    assert store.exists(ckpt + "/model.bin") and store.exists(ckpt)
+    assert store.read(ckpt + "/model.bin") == b"\x01\x02"
+    store.delete(ckpt)
+    assert not store.exists(ckpt)
+
+
+def test_remote_store_requires_client_library():
+    """Without an injected fs, each remote store must raise a clear
+    ImportError naming the missing client (none are in the image)."""
+    from horovod_tpu.spark import HDFSStore, S3Store
+    with pytest.raises(ImportError, match="pyarrow"):
+        HDFSStore("hdfs://nn/horovod")
+    with pytest.raises(ImportError, match="boto3"):
+        S3Store("s3://bucket/horovod")
+    # Store.create dispatches schemes to the right classes.
+    with pytest.raises(ImportError):
+        Store.create("s3://bucket/horovod")
+    with pytest.raises(ImportError):
+        Store.create("hdfs://nn/horovod")
 
 
 def test_spark_task_env_consistency():
@@ -344,6 +407,55 @@ def test_ray_elastic_actor_death_resumes_reduced_world():
         a[1].done = True
     surviving = fake.actors[0]
     surviving[1].done = True
+    t.join(timeout=15)
+    assert rc.get("rc") == 0, rc
+
+
+def test_ray_elastic_coordinator_host_death_moves_world():
+    """Variant killing the *coordinator-adjacent* actor (host 0 carries the
+    controller): the world must re-form on the surviving host with the
+    controller address moved off the blacklisted node."""
+    import threading
+    import time
+    from horovod_tpu.ray import ElasticRayExecutor
+
+    fake = FakeRay([
+        {"Alive": True, "NodeManagerAddress": "nodeA",
+         "Resources": {"CPU": 1}},
+        {"Alive": True, "NodeManagerAddress": "nodeB",
+         "Resources": {"CPU": 1}},
+    ])
+    ex = ElasticRayExecutor(min_workers=1, use_accelerators=False,
+                            discovery_interval_s=0.05,
+                            start_timeout_s=20, _ray_api=fake)
+    _fake_make_actor(ex, fake)
+    rc = {}
+    t = threading.Thread(target=lambda: rc.setdefault(
+        "rc", ex.run(lambda: "trained")), daemon=True)
+    t.start()
+
+    deadline = time.monotonic() + 10
+    while len(fake.actors) < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert len(fake.actors) == 2, fake.actors
+
+    # Kill host 0's actor (rank 0 / controller host).
+    victim = next(a for a in fake.actors if a[3] == "nodeA")
+    victim[1].failed = True
+    victim[1].done = True
+
+    deadline = time.monotonic() + 10
+    while len(fake.actors) < 3 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    new = fake.actors[2:]
+    assert new and all(a[3] == "nodeB" for a in new), fake.actors
+    assert all(a[2]["HOROVOD_SIZE"] == "1" for a in new)
+    assert all(a[2]["HOROVOD_RANK"] == "0" for a in new)
+    # The controller no longer lives on the blacklisted host.
+    assert all(a[2]["HOROVOD_CONTROLLER_ADDR"] == "nodeB" for a in new)
+
+    for a in new:
+        a[1].done = True
     t.join(timeout=15)
     assert rc.get("rc") == 0, rc
 
