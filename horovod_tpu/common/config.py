@@ -73,6 +73,7 @@ class Config:
     - ``autotune_log``             <- HOROVOD_AUTOTUNE_LOG
     - ``autotune_warmup_samples``  <- HOROVOD_AUTOTUNE_WARMUP_SAMPLES
     - ``autotune_steps_per_sample``<- HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE
+    - ``autotune_max_evals``       <- HOROVOD_AUTOTUNE_MAX_EVALS
     - ``log_level``                <- HOROVOD_LOG_LEVEL
     - ``batch_d2d_memcopies``      <- HOROVOD_BATCH_D2D_MEMCOPIES
 
@@ -106,6 +107,7 @@ class Config:
     autotune_log: str = ""
     autotune_warmup_samples: int = 3
     autotune_steps_per_sample: int = 10
+    autotune_max_evals: int = 48
 
     log_level: str = "warning"
     batch_d2d_memcopies: bool = True
@@ -146,6 +148,7 @@ class Config:
             autotune_log=_env("AUTOTUNE_LOG", "") or "",
             autotune_warmup_samples=_env_int("AUTOTUNE_WARMUP_SAMPLES", 3),
             autotune_steps_per_sample=_env_int("AUTOTUNE_STEPS_PER_SAMPLE", 10),
+            autotune_max_evals=_env_int("AUTOTUNE_MAX_EVALS", 48),
             log_level=(_env("LOG_LEVEL", "warning") or "warning").lower(),
             batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),
             num_collective_streams=_env_int("NUM_STREAMS", 1),

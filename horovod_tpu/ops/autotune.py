@@ -2,52 +2,182 @@
 
 Parity: the reference's parameter manager (``horovod/common/
 parameter_manager.cc`` — SURVEY.md §2a N9): warmup discard, scored samples
-(bytes reduced per second), exploration of the (fusion-threshold,
-cycle-time) space, ``HOROVOD_AUTOTUNE`` / ``HOROVOD_AUTOTUNE_LOG`` surface.
+(bytes reduced per second), *online search* over the continuous
+(fusion-threshold, cycle-time) space — the reference uses Bayesian
+optimization; here it is coordinate descent in log-space with
+multiplicative step decay, which reaches any regime from any start (a 3×3
+multiplier grid around a bad starting point cannot), converges in tens of
+samples, and needs no GP machinery.  ``HOROVOD_AUTOTUNE`` /
+``HOROVOD_AUTOTUNE_LOG`` surface.
 
-TPU-native redesign of the distributed-consistency problem: the reference
-broadcasts every parameter update from the coordinator.  Here the
-exploration *schedule* is a pure function of the work-cycle count — which is
-identical on every rank because negotiated batches are identical — so ranks
-walk the same candidate at the same cycle with no extra messages.  Only the
-FINAL pick depends on per-rank timing, so that one decision is agreed by
-broadcasting rank 0's choice through the engine's own collective path.
+Distributed consistency (TPU-native redesign of the reference's
+coordinator-broadcast): the sample *cadence* is a pure function of the
+work-cycle count — identical on every rank because negotiated batches are
+identical — so every rank reaches each sample boundary together and
+enqueues the same agreement broadcast.  Rank 0 feeds ITS score to the
+search and broadcasts the next candidate ``[threshold, cycle, done]``
+through the engine's own collective path; all ranks apply the payload, so
+parameters never diverge even though per-rank timings do.
 """
 
 from __future__ import annotations
 
+import math
 import time
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-# Log-space multipliers explored around the configured starting point
-# (reference explores fusion 0..64MB and cycle 1..100ms in similar fashion).
-_THRESHOLD_MULTIPLIERS = (0.25, 1.0, 4.0)
-_CYCLE_MULTIPLIERS = (0.2, 1.0, 5.0)
+# Search bounds (log2-space), matching the reference's explored ranges:
+# fusion 1KB..1GB, cycle 0.1ms..100ms.
+_THR_BOUNDS = (10.0, 30.0)          # 2^10 = 1KB .. 2^30 = 1GB
+_CYC_BOUNDS = (math.log2(1e-4), math.log2(0.1))
+
+
+def _clamp(v: float, lo: float, hi: float) -> float:
+    return min(max(v, lo), hi)
+
+
+class LogCoordinateDescent:
+    """Coordinate descent over log2-space points with step decay.
+
+    Protocol: call :meth:`proposal` for the point to measure next, then
+    :meth:`record` with its score.  The first evaluation scores the
+    starting point; each later one either accepts (continue along the
+    winning direction) or moves on (opposite direction → next coordinate →
+    sweep end).  A sweep with no accepted move halves both steps; the
+    search finishes when steps drop under ``min_step`` (≈ a 1.09× factor
+    for 0.125 in log2) or ``max_evals`` is spent.
+    """
+
+    def __init__(self, start: Sequence[float],
+                 bounds: Sequence[Tuple[float, float]],
+                 init_step: float = 2.0, min_step: float = 0.125,
+                 rel_gain: float = 0.02, max_evals: int = 48):
+        self.point = [_clamp(p, *b) for p, b in zip(start, bounds)]
+        self.bounds = list(bounds)
+        self.step = [init_step] * len(self.point)
+        self.min_step = min_step
+        self.rel_gain = rel_gain
+        self.max_evals = max_evals
+        self.evals = 0
+        self.best_score: Optional[float] = None
+        self._coord = 0
+        self._dir = +1
+        self._accepted_on_line = False
+        self._improved_in_sweep = False
+        self._pending: Optional[List[float]] = list(self.point)
+        self.done = False
+
+    def proposal(self) -> Tuple[float, ...]:
+        return tuple(self._pending if self._pending is not None
+                     else self.point)
+
+    def record(self, score: float):
+        """Consume the score of the current proposal; advance the search."""
+        if self.done:
+            return
+        self.evals += 1
+        if self.best_score is None:
+            # Baseline: score of the starting point.
+            self.best_score = score
+        elif (score > self.best_score * (1.0 + self.rel_gain)
+              and self._pending is not None):
+            self.point = list(self._pending)
+            self.best_score = score
+            self._accepted_on_line = True
+            self._improved_in_sweep = True
+        else:
+            self._turn()
+        if self.evals >= self.max_evals:
+            self.done = True
+            self._pending = None
+            return
+        self._propose_next()
+
+    # ------------------------------------------------------------ internals
+    def _turn(self):
+        """Current line is exhausted: flip direction or advance coordinate."""
+        if self._dir == +1 and not self._accepted_on_line:
+            self._dir = -1
+            return
+        self._next_coord()
+
+    def _next_coord(self):
+        self._dir = +1
+        self._accepted_on_line = False
+        self._coord += 1
+        if self._coord >= len(self.point):
+            self._coord = 0
+            if not self._improved_in_sweep:
+                self.step = [s * 0.5 for s in self.step]
+                if max(self.step) < self.min_step:
+                    self.done = True
+            self._improved_in_sweep = False
+
+    def _propose_next(self):
+        """Find the next in-bounds candidate distinct from the current
+        point; skipped (clamped-away) lines count as exhausted."""
+        if self.done:
+            self._pending = None
+            return
+        for _ in range(2 * len(self.point) + 1):
+            cand = list(self.point)
+            c = self._coord
+            cand[c] = _clamp(cand[c] + self._dir * self.step[c],
+                             *self.bounds[c])
+            if abs(cand[c] - self.point[c]) > 1e-12:
+                self._pending = cand
+                return
+            # Clamped onto the current point: this direction is a wall.
+            if self._dir == +1 and not self._accepted_on_line:
+                self._dir = -1
+            else:
+                self._next_coord()
+                if self.done:
+                    self._pending = None
+                    return
+        # Every direction is a wall at this step size — decay and retry.
+        self.step = [s * 0.5 for s in self.step]
+        if max(self.step) < self.min_step:
+            self.done = True
+            self._pending = None
+        else:
+            self._propose_next()
 
 
 class ParameterManager:
+    """Engine-side sampling loop + distributed agreement around the search.
+
+    ``broadcaster(payload) -> handle`` and ``poller(handle) -> payload|None``
+    are injectable for unit tests; the defaults ride the engine's own
+    eager broadcast (root 0), exactly like the final-pick agreement the
+    grid version used — but now EVERY move is agreed, so ranks never
+    diverge mid-search.
+    """
+
     def __init__(self, engine, warmup_samples: int = 3,
                  steps_per_sample: int = 10, log_path: str = "",
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 broadcaster=None, poller=None, max_evals: int = 48):
         self._engine = engine
         self._warmup_remaining = warmup_samples
         self._steps_per_sample = steps_per_sample
         self._log_path = log_path
         self._clock = clock or time.monotonic
+        self._broadcaster = broadcaster or self._engine_broadcast
+        self._poller = poller or self._engine_poll
 
-        base_thr = float(engine.fusion_threshold)
-        base_cyc = float(engine.cycle_time_s)
-        self._candidates: List[Tuple[float, float]] = [
-            (max(1024.0, base_thr * tm), max(1e-4, base_cyc * cm))
-            for tm in _THRESHOLD_MULTIPLIERS for cm in _CYCLE_MULTIPLIERS]
-        self._scores: List[float] = []
-        self._sample_idx = -1          # -1 while warming up
+        thr0 = max(float(engine.fusion_threshold), 1024.0)
+        cyc0 = max(float(engine.cycle_time_s), 1e-4)
+        self.search = LogCoordinateDescent(
+            start=(math.log2(thr0), math.log2(cyc0)),
+            bounds=(_THR_BOUNDS, _CYC_BOUNDS), max_evals=max_evals)
+        self._sample_no = 0
         self._cycles_in_sample = 0
         self._bytes_in_sample = 0
         self._sample_start = self._clock()
-        self._finalize_handle: Optional[int] = None
+        self._move_handle = None
         self.tuning = True
         self._log_header_written = False
 
@@ -56,8 +186,8 @@ class ParameterManager:
         """Called by the engine after every cycle that processed work."""
         if not self.tuning or nbytes <= 0:
             return
-        if self._finalize_handle is not None:
-            self._poll_finalize()
+        if self._move_handle is not None:
+            self._poll_move()
             return
         self._cycles_in_sample += 1
         self._bytes_in_sample += nbytes
@@ -66,77 +196,81 @@ class ParameterManager:
 
         elapsed = max(self._clock() - self._sample_start, 1e-9)
         score = self._bytes_in_sample / elapsed
-        if self._warmup_remaining > 0:
-            self._warmup_remaining -= 1
-        else:
-            if self._sample_idx >= 0:
-                self._scores.append(score)
-                self._log_sample(score)
-            self._sample_idx += 1
-            if self._sample_idx < len(self._candidates):
-                thr, cyc = self._candidates[self._sample_idx]
-                self._engine.fusion_threshold = int(thr)
-                self._engine.cycle_time_s = cyc
-            else:
-                self._begin_finalize()
         self._cycles_in_sample = 0
         self._bytes_in_sample = 0
-        self._sample_start = self._clock()
-
-    # ------------------------------------------------------------ finalize
-    def _local_best(self) -> Tuple[float, float]:
-        best = int(np.argmax(self._scores)) if self._scores else 0
-        return self._candidates[best]
-
-    def _begin_finalize(self):
-        """Agree on rank 0's winner via the engine's own broadcast path."""
-        thr, cyc = self._local_best()
-        from . import eager
-        try:
-            value = np.asarray([thr, cyc], np.float64)
-            contrib = (value if eager.per_process_mode()
-                       else eager.replicated(value))
-            self._finalize_handle = eager.broadcast_async(
-                contrib, root_rank=0, name="__autotune.final")
-        except Exception:  # pragma: no cover - never break training
-            self._apply_final(thr, cyc)
-
-    def _poll_finalize(self):
-        from . import eager
-        if not eager.poll(self._finalize_handle):
+        if self._warmup_remaining > 0:
+            self._warmup_remaining -= 1
+            self._sample_start = self._clock()
             return
-        try:
-            out = np.asarray(eager.to_local(
-                eager.synchronize(self._finalize_handle)))
-            self._apply_final(float(out.reshape(-1)[0]),
-                              float(out.reshape(-1)[1]))
-        except Exception:  # pragma: no cover - never break training
-            thr, cyc = self._local_best()
-            self._apply_final(thr, cyc)
-        finally:
-            self._finalize_handle = None
 
-    def _apply_final(self, thr: float, cyc: float):
-        # The agreement broadcast rides f32 arrays; snap back to the exact
-        # candidate so every rank lands on identical parameters.
-        thr, cyc = min(self._candidates,
-                       key=lambda c: abs(c[0] - thr) / c[0]
-                       + abs(c[1] - cyc) / c[1])
+        # Rank 0's search consumes rank 0's score; other ranks run the
+        # same code on their local score but their proposals are
+        # overwritten by the agreement broadcast, so only the CADENCE
+        # (score-independent) must match across ranks — and it does.
+        measured = self.search.proposal()
+        self.search.record(score)
+        self._log_sample(measured, score)
+        if self.search.done:
+            thr, cyc = (2.0 ** p for p in self.search.point)
+            payload = np.asarray([thr, cyc, 1.0], np.float64)
+        else:
+            thr, cyc = (2.0 ** p for p in self.search.proposal())
+            payload = np.asarray([thr, cyc, 0.0], np.float64)
+        self._move_handle = self._broadcaster(payload)
+        self._sample_no += 1
+
+    def _poll_move(self):
+        payload = self._poller(self._move_handle)
+        if payload is None:
+            return
+        self._move_handle = None
+        try:
+            thr, cyc, done = (float(x) for x in
+                              np.asarray(payload).reshape(-1)[:3])
+        except Exception:  # pragma: no cover - never break training
+            thr, cyc, done = (2.0 ** self.search.point[0],
+                              2.0 ** self.search.point[1], 1.0)
         self._engine.fusion_threshold = int(thr)
         self._engine.cycle_time_s = cyc
-        self.tuning = False
-        self._log_line(f"# final: fusion_threshold={int(thr)} "
-                       f"cycle_time_s={cyc:.6f}\n")
+        if done >= 0.5:
+            self.tuning = False
+            self._log_line(f"# final: fusion_threshold={int(thr)} "
+                           f"cycle_time_s={cyc:.6f} "
+                           f"evals={self.search.evals}\n")
+        self._sample_start = self._clock()
+
+    # ----------------------------------------------------- engine transport
+    def _engine_broadcast(self, payload: np.ndarray):
+        from . import eager
+        try:
+            contrib = (payload if eager.per_process_mode()
+                       else eager.replicated(payload))
+            return eager.broadcast_async(
+                contrib, root_rank=0,
+                name=f"__autotune.move.{self._sample_no}")
+        except Exception:  # pragma: no cover - never break training
+            return ("local", payload)
+
+    def _engine_poll(self, handle):
+        from . import eager
+        if isinstance(handle, tuple) and handle[0] == "local":
+            return handle[1]
+        if not eager.poll(handle):
+            return None
+        try:
+            return np.asarray(eager.to_local(eager.synchronize(handle)))
+        except Exception:  # pragma: no cover - never break training
+            return np.asarray([2.0 ** self.search.point[0],
+                               2.0 ** self.search.point[1], 1.0])
 
     # ------------------------------------------------------------- logging
-    def _log_sample(self, score: float):
-        thr, cyc = self._candidates[self._sample_idx] \
-            if self._sample_idx < len(self._candidates) else self._local_best()
+    def _log_sample(self, measured, score: float):
         if not self._log_header_written:
             self._log_line("sample,fusion_threshold_bytes,cycle_time_s,"
                            "score_bytes_per_s\n")
             self._log_header_written = True
-        self._log_line(f"{self._sample_idx},{int(thr)},{cyc:.6f},"
+        thr, cyc = (2.0 ** p for p in measured)
+        self._log_line(f"{self._sample_no},{int(thr)},{cyc:.6f},"
                        f"{score:.1f}\n")
 
     def _log_line(self, line: str):

@@ -259,7 +259,8 @@ class CollectiveEngine:
             self.autotuner = ParameterManager(
                 self, warmup_samples=cfg.autotune_warmup_samples,
                 steps_per_sample=cfg.autotune_steps_per_sample,
-                log_path=cfg.autotune_log)
+                log_path=cfg.autotune_log,
+                max_evals=cfg.autotune_max_evals)
 
     # ------------------------------------------------------------- lifecycle
     def start(self):
@@ -716,8 +717,27 @@ class CollectiveEngine:
                 g = lax.all_gather(flat, axis)
                 red = jnp.prod(g, axis=0)
             elif op == C.ReduceOp.ADASUM:
-                from ..parallel.adasum import adasum_allreduce
-                red = adasum_allreduce(flat, axis)
+                if world & (world - 1) == 0 and world > 1:
+                    # Power-of-two world: true vector-halving-doubling over
+                    # collective-permute — log2(n) rounds riding ICI
+                    # neighbor links, ~2·|x| bytes per rank instead of the
+                    # gather tree's n·|x| (reference adasum_mpi_operations
+                    # VHDD; SURVEY.md §2c "re-derive halving-doubling on
+                    # the torus axes").  Rounds walk physical torus axes
+                    # innermost-first when coords exist.
+                    from ..common.topology import torus_dims
+                    from ..parallel.adasum import (adasum_allreduce_hd,
+                                                   torus_bit_order)
+                    try:
+                        dims = torus_dims(list(mesh.devices.flat))
+                    except Exception:  # pragma: no cover - cpu meshes
+                        dims = None
+                    red = adasum_allreduce_hd(
+                        flat, axis, bit_order=torus_bit_order(world, dims))
+                else:
+                    # Non-power-of-two fallback: gather + pairwise tree.
+                    from ..parallel.adasum import adasum_allreduce
+                    red = adasum_allreduce(flat, axis)
             else:
                 raise ValueError(f"Unknown ReduceOp {op}")
             return red

@@ -285,3 +285,45 @@ class TestHierarchicalAllreduce:
         finally:
             hvd = self._reinit(HOROVOD_HIERARCHICAL_ALLGATHER=None,
                                HOROVOD_HIERARCHICAL_LOCAL_SIZE=None)
+
+
+class TestAdasumEngine:
+    """The engine's ADASUM program must lower to halving-doubling
+    (collective-permute, no all-gather) on power-of-two worlds and match
+    the gather tree numerically (VERDICT r2 #3 'done' criteria)."""
+
+    def _lower_adasum(self, eng, x):
+        from horovod_tpu.ops import collectives as C
+        from horovod_tpu.ops.engine import CollectiveType, TensorTableEntry
+        proto = TensorTableEntry(handle=0, name="ad",
+                                 ctype=CollectiveType.ALLREDUCE, tensor=None,
+                                 reduce_op=C.ReduceOp.ADASUM)
+        mesh, axis, world = eng._mesh_axis(0)
+        fn = eng._build_program(proto, (tuple(x.shape),), (str(x.dtype),),
+                                mesh, axis, world)
+        return fn.lower(x).as_text()
+
+    def test_hlo_is_collective_permute_not_allgather(self, hvd, world_size):
+        import horovod_tpu.ops.eager as eager
+        if world_size & (world_size - 1):
+            pytest.skip("needs power-of-two world")
+        eng = eager._engine()
+        x = _stacked(hvd, world_size, shape=(9,), seed=21)
+        hlo = self._lower_adasum(eng, x).replace("-", "_")
+        assert "collective_permute" in hlo, "ADASUM not lowered to VHDD"
+        assert "all_gather" not in hlo, \
+            "ADASUM still uses the O(n)-bandwidth gather path"
+
+    def test_engine_adasum_matches_tree(self, hvd, world_size):
+        from horovod_tpu.parallel.adasum import _tree_reduce
+        if world_size & (world_size - 1):
+            pytest.skip("needs power-of-two world")
+        vals = np.random.RandomState(23).randn(
+            world_size, 11).astype(np.float32)
+        out = hvd.allreduce(hvd.stack_per_rank(list(vals[:, None])),
+                            op=hvd.Adasum)
+        import jax.numpy as jnp
+        expected = np.asarray(_tree_reduce(jnp.asarray(vals), world_size))
+        np.testing.assert_allclose(np.asarray(out).reshape(-1),
+                                   expected.reshape(-1),
+                                   rtol=1e-4, atol=1e-5)

@@ -130,25 +130,51 @@ def test_adasum_allreduce_eager(hvd, world_size):
     assert np.isfinite(np.asarray(out)).all()
 
 
-def test_adasum_hd_consistent():
-    """Halving-doubling Adasum: all ranks agree, output finite.
-
-    (Values differ from the gathered-tree variant by design: VHDD computes
-    per-segment coefficients, as the reference's adasum_mpi.cc does.)
-    """
-    from horovod_tpu.parallel.adasum import adasum_allreduce_hd
-    mesh = make_mesh({"hvd": 8})
-    vals = np.random.RandomState(7).randn(8, 16).astype(np.float32)
+@pytest.mark.parametrize("n", [4, 8])
+def test_adasum_hd_equals_tree(n):
+    """Halving-doubling Adasum ≡ gather-tree Adasum (VERDICT r2 #3 'done'
+    criterion): the VHDD distributes the coefficient dot products across
+    the active XOR subgroup, so its combine tree is numerically the same
+    pairing as ``_tree_reduce`` — outputs match up to fp summation order."""
+    from horovod_tpu.parallel.adasum import (_tree_reduce,
+                                             adasum_allreduce_hd)
+    mesh = make_mesh({"hvd": n}, devices=jax.devices()[:n])
+    # Odd length exercises the padding path.
+    vals = np.random.RandomState(7).randn(n, 17).astype(np.float32)
     x = jnp.asarray(vals)
 
     hd_out = jax.jit(shard_map(
         lambda x: adasum_allreduce_hd(x.reshape(-1), axis_name="hvd")[None],
         mesh=mesh, in_specs=P("hvd"), out_specs=P("hvd"),
         check_vma=False))(x)
+    expected = np.asarray(_tree_reduce(jnp.asarray(vals), n))
     assert np.isfinite(np.asarray(hd_out)).all()
-    for r in range(8):
-        np.testing.assert_allclose(np.asarray(hd_out)[r],
-                                   np.asarray(hd_out)[0], rtol=1e-5)
+    for r in range(n):
+        np.testing.assert_allclose(np.asarray(hd_out)[r], expected,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_hd_rejects_non_pow2():
+    from jax.sharding import Mesh
+    from horovod_tpu.parallel.adasum import adasum_allreduce_hd
+    mesh = Mesh(np.array(jax.devices()[:6]), ("hvd",))
+    vals = jnp.asarray(np.ones((6, 4), np.float32))
+    with pytest.raises(ValueError, match="power-of-two"):
+        jax.jit(shard_map(
+            lambda x: adasum_allreduce_hd(x.reshape(-1),
+                                          axis_name="hvd")[None],
+            mesh=mesh, in_specs=P("hvd"), out_specs=P("hvd"),
+            check_vma=False))(vals)
+
+
+def test_torus_bit_order_validation():
+    from horovod_tpu.parallel.adasum import torus_bit_order
+    assert torus_bit_order(8, (2, 2, 2)) == [0, 1, 2]
+    assert torus_bit_order(8, (4, 2)) == [0, 1, 2]
+    assert torus_bit_order(16, (4, 2)) == [0, 1, 2, 3]  # 2 cores/chip
+    assert torus_bit_order(8, (3, 3)) is None           # not pow2 extents
+    assert torus_bit_order(6, (3, 2)) is None           # world not pow2
+    assert torus_bit_order(8, None) is None
 
 
 def test_infer_mesh_axes():
