@@ -122,15 +122,16 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
 
     Implemented as a dynamic subclass of the optimizer's own class (the
     reference's ``horovod/_keras`` pattern) so Keras ``model.compile``
-    type checks still pass.  ``backward_passes_per_step > 1`` (local
-    gradient aggregation) is implemented natively in the JAX binding
-    (``horovod_tpu.jax.optimizer``); here it is not supported.
+    type checks still pass.
+
+    ``backward_passes_per_step > 1`` — local gradient aggregation
+    (reference: ``horovod/tensorflow/gradient_aggregation_eager.py``):
+    gradients accumulate into non-trainable tf.Variables, and only every
+    Nth call reduces the accumulated average across ranks and applies it;
+    intermediate calls touch no weights and move no bytes, cutting
+    communication N×.  N identical micro-batches under bpps=N therefore
+    produce exactly one bpps=1 step on the combined batch.
     """
-    if backward_passes_per_step != 1:
-        raise NotImplementedError(
-            "backward_passes_per_step > 1 is supported in the JAX binding "
-            "(horovod_tpu.DistributedOptimizer); the TF compatibility "
-            "binding reduces every step")
     hvd_name = name or f"Distributed{optimizer.__class__.__name__}"
 
     cls = optimizer.__class__
@@ -138,10 +139,14 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
     class _Distributed(cls):
         _hvd_spec = None
 
-        def apply_gradients(self, grads_and_vars, *args, **kwargs):
-            gv = list(grads_and_vars)
-            grads = [g for g, _ in gv]
-            hvars = [v for _, v in gv]
+        def _hvd_state(self):
+            # Lazy per-instance aggregation state (instances come from
+            # from_config, so __init__ customization is off the table).
+            if not hasattr(self, "_hvd_agg"):
+                self._hvd_agg = {"counter": None, "acc": None}
+            return self._hvd_agg
+
+        def _hvd_reduce_apply(self, grads, hvars, args, kwargs):
             spec = type(self)._hvd_spec
             reduced = _allreduce_grads(grads, f"{spec['name']}.Allreduce",
                                        spec["op"], spec["compression"],
@@ -149,11 +154,58 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
             return super().apply_gradients(
                 list(zip(reduced, hvars)), *args, **kwargs)
 
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            gv = list(grads_and_vars)
+            grads = [g for g, _ in gv]
+            hvars = [v for _, v in gv]
+            spec = type(self)._hvd_spec
+            bpps = spec["bpps"]
+            if bpps == 1:
+                return self._hvd_reduce_apply(grads, hvars, args, kwargs)
+
+            st = self._hvd_state()
+            if st["acc"] is None:
+                st["counter"] = tf.Variable(0, dtype=tf.int64,
+                                            trainable=False,
+                                            name=f"{spec['name']}/agg_count")
+                st["acc"] = [tf.Variable(tf.zeros_like(v), trainable=False,
+                                         name=f"{spec['name']}/agg_{i}")
+                             for i, v in enumerate(hvars)]
+                # Vars whose grad stayed None the whole window get None at
+                # the boundary too (matching bpps=1, which forwards None
+                # so e.g. AdamW weight decay skips frozen branches).
+                st["seen"] = [False] * len(hvars)
+            for i, (a, g) in enumerate(zip(st["acc"], grads)):
+                if g is not None:
+                    st["seen"][i] = True
+                    a.assign_add(tf.cast(g, a.dtype) / float(bpps))
+            st["counter"].assign_add(1)
+
+            def _boundary():
+                agg = [a.read_value() if st["seen"][i] else None
+                       for i, a in enumerate(st["acc"])]
+                res = self._hvd_reduce_apply(agg, hvars, args, kwargs)
+                for a in st["acc"]:
+                    a.assign(tf.zeros_like(a))
+                st["seen"] = [False] * len(hvars)
+                return res
+
+            if tf.executing_eagerly():
+                if int(st["counter"].numpy()) % bpps == 0:
+                    return _boundary()
+                return None
+            # Compiled train step: the skip must be a graph-level cond.
+            return tf.cond(
+                tf.equal(st["counter"] % bpps, 0),
+                lambda: (_boundary(), tf.constant(True))[1],
+                lambda: tf.constant(False))
+
     _Distributed.__name__ = cls.__name__
     _Distributed.__qualname__ = cls.__qualname__
     _Distributed._hvd_spec = dict(name=hvd_name, op=op,
                                   compression=compression,
-                                  process_set=process_set)
+                                  process_set=process_set,
+                                  bpps=int(backward_passes_per_step))
     new_opt = _Distributed.from_config(optimizer.get_config())
     return new_opt
 
