@@ -77,3 +77,79 @@ class FixedHostDiscovery(HostDiscovery):
 
     def find_available_hosts_and_slots(self) -> List[DiscoveredHost]:
         return list(self._hosts)
+
+
+class TPUMetadataDiscovery(HostDiscovery):
+    """Slice membership + preemption notices from the TPU-VM metadata
+    service (SURVEY.md §5: "discovery = GCE/TPU metadata + preemption
+    notices" — the production discovery source on TPU, where the
+    reference's ``--host-discovery-script`` is the cloud-agnostic shim).
+
+    Endpoint contract (relative to ``base_url``, which defaults to the GCE
+    metadata root and is injectable — ``HOROVOD_TPU_METADATA_URL`` — so
+    tests run against a fake HTTP server):
+
+    - ``instance/attributes/worker-network-endpoints`` — comma-separated
+      worker records; the last ``:``-field of each record is the worker
+      address (the TPU-VM format, which historically carried
+      ``id:port:ip`` triples).  This is slice membership.
+    - ``instance/attributes/preempted-workers`` — comma-separated worker
+      addresses with an active preemption notice (404 or empty = none).
+      Preempted workers are dropped from the discovered set so the
+      elastic driver re-forms the world BEFORE the hardware disappears,
+      instead of waiting to crash mid-collective.  On a real deployment a
+      per-host agent publishes this from its local
+      ``instance/preempted`` + maintenance-event signals.
+
+    ``slots_per_host`` defaults to 4 — the chips-per-host of current
+    TPU-VM generations (v4/v5p/v5e/v6e all expose 4 local chips per
+    worker) — and is overridable for asymmetric topologies.
+    """
+
+    _DEFAULT_BASE = "http://metadata.google.internal/computeMetadata/v1"
+
+    def __init__(self, base_url: str = "", slots_per_host: int = 0,
+                 timeout_s: float = 5.0):
+        import os
+        self.base_url = (base_url
+                         or os.environ.get("HOROVOD_TPU_METADATA_URL", "")
+                         or self._DEFAULT_BASE).rstrip("/")
+        self.slots_per_host = slots_per_host or 4
+        self.timeout_s = timeout_s
+
+    def _get(self, path: str, default: str = None) -> str:
+        import urllib.error
+        import urllib.request
+        req = urllib.request.Request(
+            f"{self.base_url}/{path}",
+            headers={"Metadata-Flavor": "Google"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return r.read().decode()
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404 and default is not None:
+                return default
+            raise
+
+    def find_available_hosts_and_slots(self) -> List[DiscoveredHost]:
+        endpoints = self._get("instance/attributes/worker-network-endpoints")
+        preempted = {
+            p.strip()
+            for p in self._get("instance/attributes/preempted-workers",
+                               default="").split(",") if p.strip()}
+        hosts: List[DiscoveredHost] = []
+        seen = set()
+        for rec in endpoints.split(","):
+            rec = rec.strip()
+            if not rec:
+                continue
+            addr = rec.rsplit(":", 1)[-1].strip()
+            if not addr or addr in seen:
+                continue
+            seen.add(addr)
+            if addr in preempted:
+                log.warning("tpu metadata discovery: %s has a preemption "
+                            "notice; dropping from the world", addr)
+                continue
+            hosts.append(DiscoveredHost(addr, self.slots_per_host))
+        return hosts

@@ -104,6 +104,11 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     p.add_argument("--min-np", type=int, default=None)
     p.add_argument("--max-np", type=int, default=None)
     p.add_argument("--host-discovery-script", default=None)
+    p.add_argument("--tpu-metadata-discovery", action="store_true",
+                   help="Discover slice membership + preemption notices "
+                        "from the TPU-VM metadata service instead of a "
+                        "script (elastic mode; URL override via "
+                        "HOROVOD_TPU_METADATA_URL)")
     p.add_argument("--slots-per-host", type=int, default=None)
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="Training command")
@@ -115,9 +120,11 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
         p.error("no training command given")
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
-    elastic = args.host_discovery_script is not None
+    elastic = (args.host_discovery_script is not None
+               or args.tpu_metadata_discovery)
     if args.np is None and not elastic:
-        p.error("-np is required (or elastic --host-discovery-script)")
+        p.error("-np is required (or elastic --host-discovery-script / "
+                "--tpu-metadata-discovery)")
     return args
 
 
@@ -282,7 +289,8 @@ def launch_workers(args, hosts: List[HostSpec],
 
 def main(argv: Sequence[str]) -> int:
     args = parse_args(argv)
-    if args.host_discovery_script is not None:
+    if (args.host_discovery_script is not None
+            or getattr(args, "tpu_metadata_discovery", False)):
         from ..elastic.driver import run_elastic
         return run_elastic(args)
     hosts = placement(args)
