@@ -19,7 +19,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, List, Optional
 
-from .store import GCSStore, LocalStore, Store  # noqa: F401
+from .store import (  # noqa: F401
+    GCSStore, HDFSStore, LocalStore, RemoteStore, S3Store, Store)
 from .estimator import (  # noqa: F401
     JaxEstimator, JaxModel, TorchEstimator, TorchModel,
 )
