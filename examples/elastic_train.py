@@ -1,0 +1,91 @@
+"""Elastic (fault-tolerant, auto-scaling) training with horovod_tpu.
+
+The rebuild of the reference's ``examples/elastic/pytorch/
+pytorch_mnist_elastic.py``: the training loop lives inside a function
+decorated with ``@hvd.elastic.run``; training state (params, optimizer
+state, epoch counter) lives in a ``JaxState``.  When a host joins or is
+lost (TPU preemption, scale-up), the wrapper catches the interruption,
+re-initializes the runtime over the new world, restores/syncs the state,
+and resumes from the last ``state.commit()`` — no job restart.
+
+Run with a discovery script that prints one ``hostname:slots`` per line
+(here: a file you can edit while the job runs to grow/shrink it)::
+
+    echo "localhost:2" > /tmp/hosts
+    torovodrun --host-discovery-script "cat /tmp/hosts" \
+        --min-np 1 --max-np 4 python examples/elastic_train.py
+
+On a TPU pod, ``--tpu-metadata-discovery`` instead polls the TPU metadata
+endpoint for slice membership and preemption notices.
+"""
+
+import argparse
+
+import jax
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.elastic import JaxState, run
+from horovod_tpu.models import mnist
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--n-train", type=int, default=1024)
+    return p.parse_args()
+
+
+@run
+def train(state, args, optimizer):
+    """Runs under elastic protection: any rank failure or host-set change
+    rolls back to the last commit and re-enters here with a fresh world."""
+    images, labels = mnist.synthetic_batch(args.n_train)
+    # Compiled fwd/bwd; the gradient averaging runs eagerly through the
+    # engine so it always spans the CURRENT world.
+    grad_fn = jax.jit(jax.value_and_grad(
+        lambda p, x, y: mnist.loss_fn(p, x, y, axis_name=None)))
+    apply_fn = jax.jit(optax.apply_updates)
+
+    while state.epoch < args.epochs:
+        rank, size = hvd.rank(), hvd.size()
+        # Re-shard for the current world size every epoch: membership can
+        # have changed since the last one.
+        idx = hvd.data.shard_indices(args.n_train, shuffle=True,
+                                     seed=state.epoch)
+        losses = []
+        for lo in range(0, len(idx), args.batch_size):
+            sel = idx[lo:lo + args.batch_size]
+            loss, grads = grad_fn(state.params, images[sel], labels[sel])
+            grads = hvd.allreduce_gradients(grads)
+            updates, state.opt_state = optimizer.update(
+                grads, state.opt_state, state.params)
+            state.params = apply_fn(state.params, updates)
+            losses.append(loss)
+        state.epoch += 1
+        # Commit AFTER the epoch: cheap in-memory backup; also the point
+        # where pending host updates raise HostsUpdatedInterrupt.
+        state.commit()
+        if rank == 0:
+            print(f"epoch {state.epoch}: "
+                  f"loss={float(np.mean(jax.device_get(losses))):.4f} "
+                  f"world={size}", flush=True)
+
+
+def main():
+    args = parse_args()
+    hvd.init()
+    optimizer = optax.adam(args.lr)
+    params = mnist.init_params(jax.random.PRNGKey(0))
+    state = JaxState(params=params, opt_state=optimizer.init(params), epoch=0)
+    train(state, args, optimizer)
+    if hvd.rank() == 0:
+        print("DONE", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Data-parallel MNIST with the PyTorch binding.
+
+The rebuild of the reference's ``examples/pytorch/pytorch_mnist.py``: torch
+defines the model and optimizer; horovod_tpu provides the collectives
+(gradient averaging rides the XLA/gloo data plane via the dlpack bridge).
+
+Run::
+
+    torovodrun -np 2 python examples/torch_mnist.py
+    JAX_PLATFORMS=cpu torovodrun -np 2 python examples/torch_mnist.py --epochs 1
+"""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 16, 3, padding=1)
+        self.conv2 = nn.Conv2d(16, 32, 3, padding=1)
+        self.fc1 = nn.Linear(32 * 7 * 7, 128)
+        self.fc2 = nn.Linear(128, 10)
+
+    def forward(self, x):
+        x = F.max_pool2d(F.relu(self.conv1(x)), 2)
+        x = F.max_pool2d(F.relu(self.conv2(x)), 2)
+        x = x.flatten(1)
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def synthetic_mnist(n, seed):
+    g = torch.Generator().manual_seed(seed)
+    x = torch.rand(n, 1, 28, 28, generator=g)
+    y = torch.randint(0, 10, (n,), generator=g)
+    return torch.utils.data.TensorDataset(x, y)
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--n-train", type=int, default=2048)
+    p.add_argument("--seed", type=int, default=42)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    hvd.init()
+    torch.manual_seed(args.seed)
+    rank, size = hvd.rank(), hvd.size()
+
+    dataset = synthetic_mnist(args.n_train, args.seed)
+    # DistributedSampler shards the dataset across ranks; set_epoch below
+    # reshuffles each epoch (reference: torch.utils.data.DistributedSampler).
+    sampler = torch.utils.data.DistributedSampler(
+        dataset, num_replicas=size, rank=rank)
+    loader = torch.utils.data.DataLoader(
+        dataset, batch_size=args.batch_size, sampler=sampler)
+
+    model = Net()
+    optimizer = torch.optim.SGD(model.parameters(), lr=args.lr * size,
+                                momentum=0.5)
+    # Gradient averaging hooks on every .grad as backward produces it.
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    # All ranks start from rank 0's weights and optimizer state.
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    for epoch in range(args.epochs):
+        sampler.set_epoch(epoch)
+        model.train()
+        losses = []
+        for x, y in loader:
+            optimizer.zero_grad()
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            optimizer.step()
+            losses.append(loss.item())
+        # Metric averaging across ranks.
+        mean_loss = hvd.allreduce(torch.tensor(np.mean(losses)),
+                                  name="epoch_loss")
+        if rank == 0:
+            print(f"epoch {epoch}: loss={mean_loss.item():.4f} "
+                  f"(world={size})", flush=True)
+
+    if rank == 0:
+        print("DONE", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -128,8 +128,10 @@ class ElasticDriver:
 
     # ------------------------------------------------------------ lifecycle
     def _worker_env(self, identity: str, hostname: str, local_rank: int):
+        from ..runner.run import platform_worker_env
         env = dict(os.environ)
         env.update(self.extra_env)
+        env.update(platform_worker_env(env))
         env.update({
             "HOROVOD_ELASTIC": "1",
             "HOROVOD_HOSTNAME": hostname,

@@ -49,20 +49,52 @@ def allreduce_gradients(grads, op: C.ReduceOp = C.ReduceOp.AVERAGE,
                         axis_name: str = C.DEFAULT_AXIS,
                         compression=Compression.none,
                         process_set: Optional[ProcessSet] = None):
-    """Tree-allreduce a gradient pytree in-graph.
+    """Tree-allreduce a gradient pytree.
 
-    One fused ``lax.psum`` over all leaves (XLA combines them into a single
-    collective — the compiler-native tensor fusion, reference N7), with
-    compress → reduce → decompress mirroring the reference's hook pipeline.
+    Two modes, matching how the training step was written:
+
+    - **In-graph** (inside a ``shard_map``/``pmap`` that binds ``axis_name``):
+      one fused ``lax.psum`` over all leaves (XLA combines them into a single
+      collective — the compiler-native tensor fusion, reference N7).
+    - **Eager, per-process** (torovodrun-launched, called outside any mesh
+      context): one fused grouped allreduce through the collective engine —
+      the reference's hook→background-thread path (SURVEY §3.2).
+
+    Either way compress → reduce → decompress mirrors the reference's hook
+    pipeline.  Calling this from a plain ``jax.jit`` trace in a multi-process
+    world is an error (a bare jit binds no mesh axis, so the reduce would
+    silently be the identity and replicas would diverge) — compute gradients
+    under jit but reduce/update eagerly, or use a ``shard_map`` step.
     """
     if process_set is not None:
         axis_name = process_set.axis_name
-    if not _axis_in_scope(axis_name):
-        return grads  # world of 1 / non-distributed context
     leaves, treedef = jax.tree_util.tree_flatten(grads)
-    comp = [compression.compress(g) for g in leaves]
-    reduced = C.grouped_allreduce([c[0] for c in comp], op=op,
-                                  axis_name=axis_name)
+    if _axis_in_scope(axis_name):
+        comp = [compression.compress(g) for g in leaves]
+        reduced = C.grouped_allreduce([c[0] for c in comp], op=op,
+                                      axis_name=axis_name)
+        out = [compression.decompress(r, c[1]) for r, c in zip(reduced, comp)]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    from ..ops import eager
+    if not eager.per_process_mode():
+        return grads  # single-controller SPMD: params/grads already global
+    if any(isinstance(l, jax.core.Tracer) for l in leaves):
+        raise RuntimeError(
+            "allreduce_gradients was traced under jax.jit without a bound "
+            f"mesh axis {axis_name!r} in a multi-process world: the reduce "
+            "would silently be a no-op and replicas would diverge. Either "
+            "compute gradients inside jit but call allreduce_gradients / "
+            "DistributedOptimizer.update eagerly (outside jit), or write the "
+            "train step with shard_map over the device mesh so the axis is "
+            "bound (see models.mnist.make_sharded_train_step).")
+    # Eager engine path: fused, device-resident, negotiated across processes.
+    comp = [compression.compress(jnp.asarray(g)) for g in leaves]
+    reduced = eager.grouped_allreduce([c[0] for c in comp], op=op,
+                                      name="allreduce_gradients",
+                                      process_set=process_set)
+    reduced = [jnp.asarray(eager.to_local(r)).reshape(c[0].shape)
+               .astype(c[0].dtype) for r, c in zip(reduced, comp)]
     out = [compression.decompress(r, c[1]) for r, c in zip(reduced, comp)]
     return jax.tree_util.tree_unflatten(treedef, out)
 
@@ -119,6 +151,24 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         acc = jax.tree_util.tree_map(lambda a, g: a + g, state.acc, grads)
         counter = state.counter + 1
         apply_now = (counter % k) == 0
+
+        def _do_apply_concrete(acc_, inner_):
+            mean_acc = jax.tree_util.tree_map(lambda a: a / k, acc_)
+            updates, new_inner = optimizer.update(_reduce(mean_acc), inner_,
+                                                  params)
+            zeroed = jax.tree_util.tree_map(jnp.zeros_like, acc_)
+            return updates, new_inner, zeroed
+
+        # Eager per-process calls must NOT go through lax.cond: it traces
+        # both branches, which would trace the engine allreduce.  With a
+        # concrete counter a plain Python branch is exact.
+        if not isinstance(apply_now, jax.core.Tracer):
+            if bool(apply_now):
+                updates, inner, acc = _do_apply_concrete(acc, state.inner_state)
+            else:
+                updates = jax.tree_util.tree_map(jnp.zeros_like, acc)
+                inner = state.inner_state
+            return updates, _DistOptState(inner, acc, counter)
 
         def do_apply(operand):
             acc_, inner_ = operand

@@ -175,6 +175,29 @@ def _free_ports(n: int) -> List[int]:
     return free_ports(n)
 
 
+def platform_worker_env(base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Env overrides so user scripts need no platform boilerplate when
+    launched on CPU (``JAX_PLATFORMS=cpu`` smoke runs): each worker is ONE
+    rank with one CPU device (strip any inherited virtual-device count) and
+    cross-process collectives run over gloo.  No-op for TPU workers."""
+    base = os.environ if base is None else base
+    out: Dict[str, str] = {}
+    if base.get("JAX_PLATFORMS", "").startswith("cpu"):
+        out["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = base.get(
+            "JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
+        out["XLA_FLAGS"] = " ".join(
+            f for f in base.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f)
+        # TPU site hooks (e.g. the axon sitecustomize) initialize the XLA
+        # backend at interpreter start, which forecloses jax.distributed in
+        # CPU workers — drop them from the workers' PYTHONPATH.
+        if "PYTHONPATH" in base:
+            out["PYTHONPATH"] = os.pathsep.join(
+                p for p in base["PYTHONPATH"].split(os.pathsep)
+                if p and "axon" not in p)
+    return out
+
+
 def worker_envs(args, hosts: List[HostSpec],
                 coordinator: Tuple[str, int, int]) -> List[Dict[str, str]]:
     """Compute the per-rank env injection (reference §3.3: HOROVOD_RANK,
@@ -186,7 +209,8 @@ def worker_envs(args, hosts: List[HostSpec],
         for local_rank in range(h.slots):
             if rank >= np_total:
                 break
-            env = {
+            env = platform_worker_env()
+            env |= {
                 "HOROVOD_RANK": str(rank),
                 "HOROVOD_SIZE": str(np_total),
                 "HOROVOD_LOCAL_RANK": str(local_rank),

@@ -1,0 +1,90 @@
+"""Smoke-run every user-facing example under ``torovodrun -np 2`` on CPU —
+the reference CI's examples tier (its buildkite pipelines run
+``examples/*/..._mnist.py`` on every backend; SURVEY.md §4).  Tiny sizes:
+the goal is "a new user's copy-paste works", not convergence.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run_example(script, extra_args=(), np_=2, timeout=300, launcher_args=()):
+    env = dict(os.environ)
+    other_paths = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + other_paths)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+    env.pop("HOROVOD_TIMELINE", None)
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+           *launcher_args]
+    if np_ is not None:
+        cmd += ["-np", str(np_)]
+    cmd += [sys.executable, os.path.join(EXAMPLES, script), *extra_args]
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def _assert_done(r):
+    assert r.returncode == 0, (r.stdout[-4000:], r.stderr[-4000:])
+    assert "DONE" in r.stdout, r.stdout[-4000:]
+
+
+def test_example_mnist_jax():
+    r = _run_example("mnist_jax.py",
+                     ["--epochs", "1", "--n-train", "256",
+                      "--batch-size", "32"])
+    _assert_done(r)
+    assert "epoch 0" in r.stdout
+
+
+def test_example_resnet_synthetic():
+    r = _run_example("resnet_synthetic.py",
+                     ["--depth", "18", "--image-size", "32",
+                      "--num-classes", "10", "--batch-size", "4",
+                      "--num-iters", "2", "--num-warmup", "1", "--fp32"])
+    _assert_done(r)
+    assert "img/s" in r.stdout
+
+
+def test_example_torch_mnist():
+    r = _run_example("torch_mnist.py",
+                     ["--epochs", "1", "--n-train", "256",
+                      "--batch-size", "32"])
+    _assert_done(r)
+    assert "epoch 0" in r.stdout
+
+
+def test_example_tf_keras_mnist():
+    r = _run_example("tf_keras_mnist.py",
+                     ["--epochs", "1", "--n-train", "256",
+                      "--batch-size", "32"])
+    _assert_done(r)
+
+
+def test_example_dlrm_alltoall():
+    r = _run_example("dlrm_alltoall.py",
+                     ["--steps", "2", "--batch-size", "16",
+                      "--vocab", "64", "--dim", "4"])
+    _assert_done(r)
+    assert "exchanged" in r.stdout
+
+
+def test_example_elastic_train(tmp_path):
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("localhost:2\n")
+    r = _run_example("elastic_train.py",
+                     ["--epochs", "2", "--n-train", "128",
+                      "--batch-size", "32"],
+                     np_=None,
+                     launcher_args=["--host-discovery-script",
+                                    f"cat {hostfile}",
+                                    "--min-np", "1", "--max-np", "2"])
+    _assert_done(r)
+    assert "world=2" in r.stdout

@@ -78,6 +78,25 @@ def test_worker_envs():
     assert envs[1]["HOROVOD_TIMELINE"] == "/tmp/tl.1"
 
 
+def test_platform_worker_env_cpu_hygiene():
+    """CPU launches get gloo collectives + a single-device XLA_FLAGS injected
+    by the LAUNCHER, so user scripts need no platform preamble; TPU launches
+    are untouched."""
+    from horovod_tpu.runner.run import platform_worker_env
+    base = {"JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": ("--xla_force_host_platform_device_count=8 "
+                          "--xla_dump_to=/tmp/d")}
+    env = platform_worker_env(base)
+    assert env["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] == "gloo"
+    assert "device_count" not in env["XLA_FLAGS"]
+    assert "--xla_dump_to=/tmp/d" in env["XLA_FLAGS"]
+    # explicit user choice wins
+    assert platform_worker_env(
+        {"JAX_PLATFORMS": "cpu", "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "mpi"}
+    )["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] == "mpi"
+    assert platform_worker_env({}) == {}
+
+
 def test_ssh_command_generation():
     env = {"HOROVOD_RANK": "3", "HOROVOD_SIZE": "4"}
     cmd = ssh_command("node2", env, ["python", "train.py"], ssh_port=2222,
