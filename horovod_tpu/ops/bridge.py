@@ -52,19 +52,53 @@ def take_my_row(a: np.ndarray) -> np.ndarray:
     return a[basics.rank()]
 
 
-def ragged_alltoall_numpy(a: np.ndarray, splits,
-                          name: Optional[str] = None,
-                          process_set: Optional[ProcessSet] = None):
-    """Ragged alltoall for one rank's numpy contribution; returns
-    ``(output, received_splits)`` for THIS rank."""
+class RaggedAsyncHandle:
+    """Binding-level async handle for ragged alltoall: wraps the eager
+    continuation and resolves to THIS rank's local ``(output,
+    received_splits)`` in either launch mode."""
+
+    def __init__(self, inner, controller_mode: bool):
+        self._inner = inner
+        self._controller = controller_mode
+
+    def poll(self) -> bool:
+        return eager.poll(self._inner)
+
+    def synchronize(self):
+        out, rsp = eager.synchronize(self._inner)
+        if self._controller:
+            r = basics.rank()
+            return out[r], rsp[r]
+        return out, rsp
+
+
+def _ragged_args(a: np.ndarray, splits,
+                 process_set: Optional[ProcessSet]):
     world = set_size(process_set)
     sp = np.asarray(splits).astype(np.int64).reshape(-1)
     if sp.size != world:
         raise ValueError(f"splits must have {world} entries, got {sp.size}")
     if eager.per_process_mode():
-        return eager.alltoall(a, splits=sp, name=name,
-                              process_set=process_set)
-    outs, rsps = eager.alltoall([a] * world, splits=np.tile(sp, (world, 1)),
-                                name=name, process_set=process_set)
-    r = basics.rank()
-    return outs[r], rsps[r]
+        return a, sp, False
+    return [a] * world, np.tile(sp, (world, 1)), True
+
+
+def ragged_alltoall_async_numpy(a: np.ndarray, splits,
+                                name: Optional[str] = None,
+                                process_set: Optional[ProcessSet] = None
+                                ) -> RaggedAsyncHandle:
+    """Async form of :func:`ragged_alltoall_numpy` (reference: the fully
+    async-capable ``hvd.alltoall``)."""
+    tensor, sp, controller = _ragged_args(a, splits, process_set)
+    inner = eager.alltoall_async(tensor, splits=sp, name=name,
+                                 process_set=process_set)
+    return RaggedAsyncHandle(inner, controller)
+
+
+def ragged_alltoall_numpy(a: np.ndarray, splits,
+                          name: Optional[str] = None,
+                          process_set: Optional[ProcessSet] = None):
+    """Ragged alltoall for one rank's numpy contribution; returns
+    ``(output, received_splits)`` for THIS rank."""
+    return ragged_alltoall_async_numpy(a, splits, name=name,
+                                       process_set=process_set).synchronize()

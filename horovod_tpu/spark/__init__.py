@@ -22,7 +22,8 @@ from typing import Any, Callable, List, Optional
 from .store import (  # noqa: F401
     GCSStore, HDFSStore, LocalStore, RemoteStore, S3Store, Store)
 from .estimator import (  # noqa: F401
-    JaxEstimator, JaxModel, TorchEstimator, TorchModel,
+    JaxEstimator, JaxModel, KerasEstimator, KerasModel,
+    TorchEstimator, TorchModel,
 )
 
 

@@ -368,3 +368,80 @@ class TorchEstimator(_EstimatorBase):
 
     def _make_model(self, state_dict):
         return TorchModel(state_dict, self.model_factory, self.feature_cols)
+
+
+# ----------------------------------------------------------------- Keras
+class KerasModel(_ModelBase):
+    """Transformer for a fitted Keras model (reference:
+    ``horovod/spark/keras/estimator.py KerasModel``): ``params`` is the
+    ``get_weights()`` list; the module is rebuilt once per process."""
+
+    def __init__(self, weights, model_factory, feature_cols,
+                 output_col="prediction"):
+        super().__init__(weights, feature_cols, output_col)
+        self.model_factory = model_factory
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        model = getattr(self, "_model", None)
+        if model is None:
+            model = self.model_factory()
+            model(np.zeros_like(np.asarray(X, np.float32)[:1]))  # build
+            model.set_weights(self.params)
+            self._model = model
+        return np.asarray(model(np.asarray(X, np.float32), training=False))
+
+
+class KerasEstimator(_EstimatorBase):
+    """Reference-parity Keras estimator (``horovod/spark/keras/``):
+    ``model_factory`` builds the (uncompiled) ``keras.Model``; ``loss`` is
+    a Keras loss (string or callable).  Each executor compiles with the
+    binding's ``DistributedOptimizer``, broadcasts rank 0's initial
+    weights, and fits its own shard — the Horovod Keras recipe run by the
+    Spark backend.  ``optimizer_factory`` (optional) builds the inner
+    Keras optimizer; default SGD(learning_rate).
+
+    Lightning variant: descoped — see DESIGN.md (lightning is not in the
+    image); ``TorchEstimator`` covers the torch path.
+    """
+
+    def __init__(self, *, model_factory, loss, optimizer_factory=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.model_factory = model_factory
+        self.loss = loss
+        self.optimizer_factory = optimizer_factory
+
+    def _make_train_fn(self, num_proc: int, ckpt_path: str):
+        store, run_id = self.store, self.run_id
+        model_factory, loss = self.model_factory, self.loss
+        opt_factory = self.optimizer_factory
+        batch_size, epochs, lr = self.batch_size, self.epochs, self.learning_rate
+        seed, verbose = self.seed, self.verbose
+
+        def train():
+            import keras
+            import horovod_tpu as hvd
+            import horovod_tpu.keras as khvd
+
+            khvd.init()
+            rank = khvd.rank()
+            shard = rank if num_proc > 1 else 0
+            X, y = _read_shard(store, shard, run_id)
+            keras.utils.set_random_seed(seed)
+            model = model_factory()
+            opt = (opt_factory() if opt_factory is not None
+                   else keras.optimizers.SGD(learning_rate=lr))
+            model.compile(optimizer=khvd.DistributedOptimizer(opt), loss=loss)
+            model(X[:1])  # build variables before broadcasting them
+            khvd.broadcast_global_variables(model, root_rank=0)
+            hist = model.fit(X, y, batch_size=batch_size, epochs=epochs,
+                             verbose=verbose if rank == 0 else 0)
+            if rank == 0:
+                store.write(ckpt_path, pickle.dumps(model.get_weights()))
+            hvd.barrier()
+            return float(hist.history["loss"][-1])
+
+        return train
+
+    def _make_model(self, weights):
+        return KerasModel(weights, self.model_factory, self.feature_cols)

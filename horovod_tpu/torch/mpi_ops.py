@@ -142,6 +142,11 @@ def synchronize(handle):
     if isinstance(handle, (list, tuple)):
         return [synchronize(h) for h in handle]
     op = _handles.pop(handle)
+    from ..ops.bridge import RaggedAsyncHandle
+    if isinstance(op.inner, RaggedAsyncHandle):
+        out, rsp = op.inner.synchronize()
+        return (_from_numpy(np.ascontiguousarray(out), op.dtype, op.device),
+                torch.from_numpy(np.ascontiguousarray(rsp)))
     res = eager.synchronize(op.inner)
     arr = eager.to_local(res)
     t = _from_numpy(np.asarray(arr), op.dtype, op.device)
@@ -154,7 +159,11 @@ def synchronize(handle):
 
 
 def poll(handle) -> bool:
-    return eager.poll(_handles[handle].inner)
+    inner = _handles[handle].inner
+    from ..ops.bridge import RaggedAsyncHandle
+    if isinstance(inner, RaggedAsyncHandle):
+        return inner.poll()
+    return eager.poll(inner)
 
 
 # ------------------------------------------------------------------ allreduce
@@ -305,10 +314,12 @@ def alltoall_async(tensor: torch.Tensor, splits=None,
                    process_set: Optional[ProcessSet] = None) -> int:
     world = _set_size(process_set)
     if splits is not None:
-        raise ValueError(
-            "Ragged alltoall (splits=...) has no async handle (it needs a "
-            "size-exchange prologue); call the blocking "
-            "hvd.alltoall(tensor, splits) instead")
+        from ..ops.bridge import ragged_alltoall_async_numpy
+        sp = (splits.detach().cpu().numpy()
+              if isinstance(splits, torch.Tensor) else np.asarray(splits))
+        inner = ragged_alltoall_async_numpy(_to_numpy(tensor), sp, name=name,
+                                            process_set=process_set)
+        return _register(inner, tensor)
     if tensor.shape[0] % world != 0:
         raise ValueError(
             f"alltoall with even splits needs dim0 divisible by the "
@@ -322,16 +333,7 @@ def alltoall(tensor: torch.Tensor, splits=None, name: Optional[str] = None,
              process_set: Optional[ProcessSet] = None):
     """Even splits: returns the gathered tensor.  With ``splits``: returns
     ``(output, received_splits)`` (reference ``hvd.alltoall`` ragged form)."""
-    if splits is None:
-        return synchronize(alltoall_async(tensor, splits, name, process_set))
-    sp = (splits.detach().cpu().numpy() if isinstance(splits, torch.Tensor)
-          else np.asarray(splits))
-    from ..ops.bridge import ragged_alltoall_numpy  # validates splits length
-    out, rsp = ragged_alltoall_numpy(_to_numpy(tensor), sp, name=name,
-                                     process_set=process_set)
-    return (_from_numpy(np.ascontiguousarray(out), tensor.dtype,
-                        tensor.device),
-            torch.from_numpy(np.ascontiguousarray(rsp)))
+    return synchronize(alltoall_async(tensor, splits, name, process_set))
 
 
 # -------------------------------------------------------------- reducescatter

@@ -127,6 +127,13 @@ def main():
          for r in range(size)], axis=0)
     np.testing.assert_array_equal(out, expected)
 
+    # ASYNC ragged alltoall: same exchange as above through the async
+    # handle — size exchange in flight at submit, payload chases it.
+    h = hvd.alltoall_async(payload, splits=my_splits, name="a2av_async")
+    out2, rsplits2 = hvd.synchronize(h)
+    np.testing.assert_array_equal(rsplits2, rsplits)
+    np.testing.assert_array_equal(out2, expected)
+
     # JAX DistributedOptimizer in per-process mode: the eager update must
     # average RANK-DEPENDENT gradients through the engine (a plain-jit
     # train step silently skipping the reduce was code-review finding r3#1).

@@ -14,7 +14,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = os.path.join(REPO, "examples")
 
 
-def _run_example(script, extra_args=(), np_=2, timeout=300, launcher_args=()):
+def _example_env(**extra):
     env = dict(os.environ)
     other_paths = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
                    if p and "axon" not in p]
@@ -22,6 +22,12 @@ def _run_example(script, extra_args=(), np_=2, timeout=300, launcher_args=()):
     env["JAX_PLATFORMS"] = "cpu"
     env.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
     env.pop("HOROVOD_TIMELINE", None)
+    env.update(extra)
+    return env
+
+
+def _run_example(script, extra_args=(), np_=2, timeout=300, launcher_args=()):
+    env = _example_env()
     cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
            *launcher_args]
     if np_ is not None:
@@ -74,6 +80,19 @@ def test_example_dlrm_alltoall():
                       "--vocab", "64", "--dim", "4"])
     _assert_done(r)
     assert "exchanged" in r.stdout
+
+
+def test_example_llama_spmd():
+    """Single-process SPMD flagship: dp=2 x tp=2 x sp=2 over 8 virtual CPU
+    devices (no torovodrun — one controller drives the mesh)."""
+    env = _example_env(
+        XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "llama_spmd.py"),
+         "--dp", "2", "--tp", "2", "--sp", "2", "--steps", "2", "--tiny"],
+        env=env, capture_output=True, text=True, timeout=300)
+    _assert_done(r)
+    assert "tok/s" in r.stdout
 
 
 def test_example_elastic_train(tmp_path):

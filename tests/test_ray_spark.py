@@ -281,6 +281,29 @@ def test_torch_estimator_fit_transform(hvd, tmp_path):
     assert len(out) == 5 and all("prediction" in r for r in out)
 
 
+def test_keras_estimator_fit_transform(hvd, tmp_path):
+    import keras
+    from horovod_tpu.spark import KerasEstimator, KerasModel, LocalStore
+
+    def model_factory():
+        return keras.Sequential([keras.layers.Input((3,)),
+                                 keras.layers.Dense(1, use_bias=False)])
+
+    est = KerasEstimator(model_factory=model_factory, loss="mse",
+                         feature_cols=["f0", "f1", "f2"],
+                         label_cols=["label"],
+                         store=LocalStore(str(tmp_path)), epochs=30,
+                         batch_size=16, learning_rate=0.1, run_id="kerasrun")
+    model = est.fit(_linear_df())
+    assert isinstance(model, KerasModel)
+    w = np.asarray(model.params[0]).reshape(-1)
+    np.testing.assert_allclose(w, [1.0, -2.0, 0.5], atol=0.15)
+    out = model.transform(_linear_df(n=5))
+    assert len(out) == 5 and all("prediction" in r for r in out)
+    preds = model.predict(np.array([[1.0, 0.0, 0.0]], np.float32))
+    assert abs(float(preds.reshape(-1)[0]) - 1.0) < 0.2
+
+
 def test_estimator_empty_df_raises(hvd, tmp_path):
     from horovod_tpu.spark import JaxEstimator, LocalStore
     est = JaxEstimator(init_fn=lambda r, x: {}, apply_fn=lambda p, X: X,

@@ -408,6 +408,24 @@ def test_alltoall_ragged(tvd):
         assert torch.equal(out[src * (r + 1):(src + 1) * (r + 1)], chunk)
 
 
-def test_alltoall_async_splits_rejected(tvd):
-    with pytest.raises(ValueError, match="blocking"):
-        tvd.alltoall_async(torch.zeros(4, 2), splits=torch.tensor([1, 3]))
+def test_alltoall_ragged_async(tvd):
+    """Async ragged alltoall via the torch surface resolves to the same
+    result as the blocking form (VERDICT r2 missing #7)."""
+    w = tvd.size()
+    splits = torch.tensor([j + 1 for j in range(w)])
+    n = int(splits.sum())
+    t = torch.arange(n * 2, dtype=torch.float32).reshape(n, 2)
+    h = tvd.alltoall_async(t, splits=splits, name="a2av_t_async")
+    import time
+    deadline = time.time() + 30
+    while not tvd.poll(h):
+        assert time.time() < deadline
+        time.sleep(0.01)
+    out, rsplits = tvd.synchronize(h)
+    r = tvd.rank()
+    off = int(splits[:r].sum())
+    chunk = t[off:off + r + 1]
+    assert torch.equal(rsplits, torch.full((w,), r + 1, dtype=torch.int64))
+    assert out.shape == (w * (r + 1), 2)
+    for src in range(w):
+        assert torch.equal(out[src * (r + 1):(src + 1) * (r + 1)], chunk)
