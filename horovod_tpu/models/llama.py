@@ -47,6 +47,11 @@ class LlamaConfig:
     dp_axis: Optional[str] = "dp"
     tp_axis: Optional[str] = "tp"
     sp_axis: Optional[str] = "sp"
+    # Pallas flash attention: True/False, or None = resolve from the
+    # HVD_TPU_FLASH env var at TRACE time (auto: on when running on TPU).
+    # The env var is not part of any jit cache key — to toggle after a
+    # step has compiled, change this config field (it IS traced).
+    use_flash: Optional[bool] = None
 
     @property
     def head_dim(self) -> int:
@@ -142,6 +147,22 @@ def _rope(x, positions, theta):
         [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin], axis=-1).astype(x.dtype)
 
 
+def _use_pallas_flash(cfg: "LlamaConfig") -> bool:
+    """Pallas flash attention on TPU by default (the [Tq,Tk] scores never
+    touch HBM — ops/flash_attention.py).  ``cfg.use_flash`` decides when
+    set; otherwise HVD_TPU_FLASH=1/0 forces it on (interpret mode off-TPU,
+    for tests) or off — read at TRACE time only (see LlamaConfig)."""
+    if cfg.use_flash is not None:
+        return cfg.use_flash
+    import os
+    v = os.environ.get("HVD_TPU_FLASH", "auto").lower()
+    if v in ("1", "true", "on"):
+        return True
+    if v in ("0", "false", "off"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
 def _attention(x, p, cfg: LlamaConfig, positions):
     """Self-attention on the local tp shard of heads; sp-ring over sequence."""
     B, T, D = x.shape
@@ -167,6 +188,9 @@ def _attention(x, p, cfg: LlamaConfig, positions):
     sp = lax.axis_size(cfg.sp_axis) if cfg.sp_axis else 1
     if sp > 1:
         out = ring_attention(q, kk, v, axis_name=cfg.sp_axis, causal=True)
+    elif _use_pallas_flash(cfg):
+        from ..ops.flash_attention import flash_attention
+        out = flash_attention(q, kk, v, causal=True)
     else:
         out = local_flash_attention(q, kk, v, causal=True)
     out = out.reshape(B, T, H_loc * Hd) @ p["wo"]

@@ -1,0 +1,342 @@
+"""Pallas TPU flash attention (forward + backward kernels).
+
+The hot op of the flagship Llama path (SURVEY.md §7 "pallas kernels for the
+hot ops"; no reference analogue — Horovod ships no model math).  Standard
+flash attention: the [Tq, Tk] score matrix is never materialized in HBM;
+each (batch·head, q-block) streams k/v blocks through VMEM with an
+online-softmax accumulator.  The backward pass recomputes probabilities
+blockwise from the saved logsumexp — two kernels (dq; dk/dv) so every
+accumulator lives in VMEM scratch across the inner grid dimension.
+
+Layout: ``[B, T, H, D]`` (the llama layout).  GQA is handled by the caller
+(kv heads repeated up to query heads, as in ``models/llama._attention``).
+
+On non-TPU backends the kernels run in Pallas interpret mode (tests), so
+the same code path is exercised everywhere; ``models/llama`` routes to
+this kernel on TPU and keeps the jnp reference elsewhere.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ----------------------------------------------------------------- forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k,
+                n_k, tk_valid):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    # Causal: skip k-blocks strictly above the diagonal band.
+    live = (not causal) or (k_start <= q_start + block_q - 1)
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32)            # [bq, D]
+        k = k_ref[0].astype(jnp.float32)            # [bk, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        cols = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = cols < tk_valid
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, rows >= cols)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(ki == n_k - 1)
+    def _():
+        l = l_ref[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l[:, None]).astype(o_ref.dtype)
+        # Empty rows (fully masked) store lse=0, NOT -inf: the backward
+        # computes p = exp(s - lse) with s = NEG_INF on masked entries, and
+        # exp(NEG_INF - 0) = 0 zeroes their contribution, while -inf would
+        # turn it into exp(0) = 1 and poison dk/dv.
+        lse_ref[0, :, 0] = jnp.where(l == 0.0, 0.0,
+                                     m_ref[:] + jnp.log(safe_l))
+
+
+# ---------------------------------------------------------------- backward
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, scale, causal, block_q, block_k, n_k,
+               tq_valid, tk_valid):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    live = (not causal) or (k_start <= q_start + block_q - 1)
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        cols = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        rows = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        mask = jnp.logical_and(cols < tk_valid, rows < tq_valid)
+        if causal:
+            mask = jnp.logical_and(mask, rows >= cols)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, :, :1])        # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, :, :1]) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                block_q, block_k, n_q, tq_valid, tk_valid):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    live = (not causal) or (k_start <= q_start + block_q - 1)
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        cols = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        rows = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        mask = jnp.logical_and(cols < tk_valid, rows < tq_valid)
+        if causal:
+            mask = jnp.logical_and(mask, rows >= cols)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, :, :1])        # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [bk, D]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, :, :1]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [bk, D]
+
+    @pl.when(qi == n_q - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# -------------------------------------------------------------- dispatcher
+def _pad_t(x, block):
+    t = x.shape[1]
+    pad = (-t) % block
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x
+
+
+def _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
+    """q,k,v: [BH, T, D] -> (o [BH, Tq, D], lse [BH, Tq])."""
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
+    bq, bk = min(block_q, Tq), min(block_k, Tk)
+    qp, kp, vp = _pad_t(q, bq), _pad_t(k, bk), _pad_t(v, bk)
+    Tqp, Tkp = qp.shape[1], kp.shape[1]
+    n_q, n_k = Tqp // bq, Tkp // bk
+
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                             block_q=bq, block_k=bk, n_k=n_k, tk_valid=Tk)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(BH, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            # 3D (1, bq, 1): TPU block rules need the trailing dims
+            # divisible by (8, 128) or equal to the array's — a [BH, T]
+            # row vector can't satisfy that, [BH, T, 1] can.
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tqp, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Tqp, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq,), jnp.float32),
+            pltpu.VMEM((bq,), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return o[:, :Tq], lse[:, :Tq, 0]
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Memory-efficient exact attention.
+
+    q, k, v: ``[B, T, H, D]`` (kv heads already repeated for GQA).
+    Differentiable via flash backward kernels; matches
+    ``parallel.ring_attention.local_flash_attention`` numerically.
+    """
+    B, Tq, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    interpret = _interpret_default() if interpret is None else interpret
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+
+    def from_bh(x, t):
+        return x.reshape(B, H, t, D).transpose(0, 2, 1, 3)
+
+    o = _flash_core(to_bh(q), to_bh(k), to_bh(v), scale, causal,
+                    block_q, block_k, interpret)
+    return from_bh(o, Tq)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, _ = _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
+    bq, bk = min(block_q, Tq), min(block_k, Tk)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                 # [BH, Tq]
+
+    qp, dop = _pad_t(q, bq), _pad_t(do, bq)
+    kp, vp = _pad_t(k, bk), _pad_t(v, bk)
+    pad_q = qp.shape[1] - Tq
+    # Pad with 0 (see the forward's empty-row sentinel): padded rows then
+    # produce p = exp(NEG_INF - 0) = 0 and contribute nothing.  3D
+    # [BH, T, 1] for the same block-shape rule as the forward's lse.
+    lsep = jnp.pad(lse, ((0, 0), (0, pad_q)))[..., None]
+    deltap = jnp.pad(delta, ((0, 0), (0, pad_q)))[..., None]
+    Tqp, Tkp = qp.shape[1], kp.shape[1]
+    n_q, n_k = Tqp // bq, Tkp // bk
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, n_k=n_k,
+                          tq_valid=Tq, tk_valid=Tk),
+        grid=(BH, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Tqp, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)[:, :Tq]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, n_q=n_q,
+                          tq_valid=Tq, tk_valid=Tk),
+        grid=(BH, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tkp, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Tkp, D), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+    return dq, dk[:, :Tk], dv[:, :Tk]
+
+
+_flash_core.defvjp(_flash_fwd, _flash_bwd)

@@ -81,10 +81,24 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
         src = (my - step) % n          # which rank's K/V block we now hold
         k_cur, v_cur = kv
         if causal:
-            q_pos = my * Tq + jnp.arange(Tq)
-            k_pos = src * Tk + jnp.arange(Tk)
-            bias = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, NEG_INF)
-            o, m, l = _block_attn(q, k_cur, v_cur, bias, scale)
+            def compute(args):
+                q_, k_, v_ = args
+                q_pos = my * Tq + jnp.arange(Tq)
+                k_pos = src * Tk + jnp.arange(Tk)
+                bias = jnp.where(q_pos[:, None] >= k_pos[None, :],
+                                 0.0, NEG_INF)
+                return _block_attn(q_, k_, v_, bias, scale)
+
+            def masked(args):
+                # Identity element of the online-softmax merge.
+                return (jnp.zeros((B, Tq, H, D), jnp.float32),
+                        jnp.full((B, H, Tq), NEG_INF, jnp.float32),
+                        jnp.zeros((B, H, Tq), jnp.float32))
+
+            # src = (my-step)%n > my  ⇔  my < step: this rank's queries are
+            # entirely BEFORE the held block — skip the whole block's
+            # compute (≈ halves the causal ring's FLOPs at large sp).
+            o, m, l = lax.cond(my < step, masked, compute, (q, k_cur, v_cur))
         else:
             o, m, l = _block_attn(q, k_cur, v_cur, None, scale)
         o_acc, m_acc, l_acc = merge((o_acc, m_acc, l_acc), (o, m, l))
