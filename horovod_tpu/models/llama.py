@@ -154,13 +154,8 @@ def _use_pallas_flash(cfg: "LlamaConfig") -> bool:
     for tests) or off — read at TRACE time only (see LlamaConfig)."""
     if cfg.use_flash is not None:
         return cfg.use_flash
-    import os
-    v = os.environ.get("HVD_TPU_FLASH", "auto").lower()
-    if v in ("1", "true", "on"):
-        return True
-    if v in ("0", "false", "off"):
-        return False
-    return jax.default_backend() == "tpu"
+    from ..ops.flash_attention import flash_enabled
+    return flash_enabled()
 
 
 def _attention(x, p, cfg: LlamaConfig, positions):

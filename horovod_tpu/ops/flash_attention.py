@@ -33,6 +33,19 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def flash_enabled() -> bool:
+    """Shared routing default for attention call sites (llama, Ulysses):
+    pallas flash on TPU, jnp reference elsewhere; ``HVD_TPU_FLASH=1/0``
+    forces it — read at TRACE time only (not part of any jit cache key)."""
+    import os
+    v = os.environ.get("HVD_TPU_FLASH", "auto").lower()
+    if v in ("1", "true", "on"):
+        return True
+    if v in ("0", "false", "off"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
 # ----------------------------------------------------------------- forward
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k,

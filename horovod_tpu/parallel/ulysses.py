@@ -40,10 +40,19 @@ def ulysses_attention(q, k, v, attn_fn: Optional[Callable] = None,
     Requires heads divisible by sp.
     """
     if attn_fn is None:
-        from .ring_attention import local_flash_attention
-        attn_fn = local_flash_attention
+        from ..ops.flash_attention import flash_attention, flash_enabled
+        if flash_enabled():
+            attn_fn = flash_attention   # pallas kernel on the local heads
+        else:
+            from .ring_attention import local_flash_attention
+            attn_fn = local_flash_attention
     H = q.shape[2]
     n = lax.axis_size(axis_name)
+    if H % n:
+        raise ValueError(
+            f"ulysses_attention needs heads ({H}) divisible by the "
+            f"{axis_name!r} axis size ({n}); use ring_attention for "
+            f"head counts below the sp degree")
     qh = seq_to_heads(q, axis_name)
     kh = seq_to_heads(k, axis_name)
     vh = seq_to_heads(v, axis_name)

@@ -72,6 +72,38 @@ def test_flash_tpu_lowering():
     assert len(exp.mlir_module_serialized) > 0
 
 
+def test_ulysses_routes_through_flash(monkeypatch):
+    """HVD_TPU_FLASH=1 makes Ulysses run the pallas kernel on its local
+    heads INSIDE shard_map over the sp mesh — the real sp usage."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    from horovod_tpu.parallel.ulysses import ulysses_attention
+
+    monkeypatch.setenv("HVD_TPU_FLASH", "1")
+    # Spy: if routing regresses to the jnp fallback, fail loudly instead of
+    # passing vacuously (flash and reference are numerically identical).
+    import horovod_tpu.parallel.ring_attention as ra
+
+    def _boom(*a, **k):
+        raise AssertionError("routing fell back to local_flash_attention "
+                             "despite HVD_TPU_FLASH=1")
+    monkeypatch.setattr(ra, "local_flash_attention", _boom)
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(1, 64, 8, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 64, 8, 16), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 64, 8, 16), jnp.float32)
+    ref = local_flash_attention(q, k, v, causal=True)
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("sp",))
+    out = jax.jit(shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, axis_name="sp",
+                                          causal=True),
+        mesh=mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
+        check_vma=False))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=3e-5)
+
+
 def test_llama_uses_flash_when_forced(monkeypatch):
     """HVD_TPU_FLASH=1 routes llama attention through the pallas kernel;
     logits must match the jnp-reference path."""
@@ -86,6 +118,13 @@ def test_llama_uses_flash_when_forced(monkeypatch):
     monkeypatch.setenv("HVD_TPU_FLASH", "0")
     ref = llama.forward(params, tokens, cfg)
     monkeypatch.setenv("HVD_TPU_FLASH", "1")
+    # Spy: the forced run must NOT touch the jnp fallback (otherwise this
+    # test is vacuous — both paths produce identical numbers).
+    monkeypatch.setattr(
+        llama, "local_flash_attention",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError(
+            "llama fell back to local_flash_attention under "
+            "HVD_TPU_FLASH=1")))
     out = llama.forward(params, tokens, cfg)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-4, rtol=2e-4)
