@@ -30,7 +30,7 @@ driver records it.  The first device compile gets bounded retry with backoff
 measures only the eager-allreduce bus-bw (smallest compile surface).
 
 Env overrides: HVD_BENCH_BATCH, HVD_BENCH_STEPS, HVD_BENCH_IMAGE,
-HVD_BENCH_SIZES_MB (comma list), HVD_BENCH_MODEL=resnet50|llama,
+HVD_BENCH_SIZES_MB (comma list), HVD_BENCH_MODEL=resnet50|llama|bert,
 HVD_BENCH_SKIP_RAW=1, HVD_BENCH_SKIP_BUSBW=1, HVD_BENCH_MINIMAL=1,
 HVD_BENCH_RETRIES, HVD_BENCH_RETRY_DELAY_S.
 """
@@ -327,6 +327,61 @@ def bench_llama(batch, steps):
     return batch * seq * steps / dt
 
 
+def bench_bert(batch, steps):
+    """BASELINE config #3: BERT MLM pretraining through the framework path —
+    DistributedOptimizer with fp16-compressed fused allreduce inside a
+    shard_map step over the hvd mesh.
+
+    ``batch`` is the GLOBAL batch (already world-scaled by main()), sharded
+    over the hvd axis.  ``dp_axis=None`` on the model so its own
+    ``sync_grads`` is a no-op — the data-parallel reduce under test is
+    exactly the optimizer's compressed allreduce, not a second psum.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import horovod_tpu as hvd
+    from horovod_tpu.models import bert
+
+    cfg = bert.tiny(vocab_size=8192, d_model=512, n_layers=4, n_heads=8,
+                    d_ff=2048, max_seq=512,
+                    dtype=jnp.bfloat16 if _on_tpu() else jnp.float32,
+                    dp_axis=None, tp_axis=None, sp_axis=None)
+    opt = hvd.DistributedOptimizer(optax.adam(1e-4),
+                                   compression=hvd.Compression.fp16)
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    opt_state = opt.init(params)
+    mesh = hvd.mesh()
+    step = jax.jit(shard_map(
+        bert.make_train_step(cfg, opt), mesh=mesh,
+        in_specs=(P(), P(), P("hvd"), P("hvd"), P("hvd")),
+        out_specs=(P(), P(), P()), check_vma=False),
+        donate_argnums=(0, 1))
+    rng = np.random.RandomState(0)
+    seq = 256
+    toks = jax.device_put(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32),
+        NamedSharding(mesh, P("hvd")))
+    tgts = jax.device_put(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32),
+        NamedSharding(mesh, P("hvd")))
+    mask = jax.device_put(
+        (rng.rand(batch, seq) < 0.15).astype(np.float32),
+        NamedSharding(mesh, P("hvd")))
+    for _ in range(2):
+        params, opt_state, loss = step(params, opt_state, toks, tgts, mask)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, toks, tgts, mask)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return batch * seq * steps / dt
+
+
 def _emit(out, rank):
     if rank == 0:
         print(json.dumps(out))
@@ -450,6 +505,18 @@ def _run(out, errors):
             out["value"] = round(tps, 2)
         except Exception as exc:  # noqa: BLE001 - contained like the rest
             errors["llama"] = repr(exc)
+        return
+
+    if model == "bert":
+        out.update({"metric": "bert_mlm_framework_tokens_per_sec_per_chip",
+                    "value": None, "unit": "tokens/sec",
+                    "vs_baseline": 0.0})
+        try:
+            world = max(1, hvd.size())
+            tps = bench_bert(batch, steps)       # global batch, global tps
+            out["value"] = round(tps / world, 2)
+        except Exception as exc:  # noqa: BLE001 - contained like the rest
+            errors["bert"] = repr(exc)
         return
 
     busbw = None

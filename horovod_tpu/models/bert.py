@@ -35,6 +35,9 @@ class BertConfig:
     dp_axis: Optional[str] = "dp"
     tp_axis: Optional[str] = "tp"
     sp_axis: Optional[str] = "sp"
+    # Pallas flash attention: True/False, or None = HVD_TPU_FLASH / auto at
+    # TRACE time (same semantics as LlamaConfig.use_flash).
+    use_flash: Optional[bool] = None
 
     @property
     def head_dim(self) -> int:
@@ -121,9 +124,14 @@ def _attention(x, p, cfg: BertConfig):
     v = (x @ p["wv"]).reshape(B, T, H_loc, Hd)
     sp = lax.axis_size(cfg.sp_axis) if cfg.sp_axis else 1
     if sp > 1:
+        # ulysses_attention itself routes to the pallas kernel on TPU.
         out = ulysses_attention(q, k, v, axis_name=cfg.sp_axis, causal=False)
     else:
-        out = local_flash_attention(q, k, v, causal=False)
+        from ..ops.flash_attention import flash_attention, resolve_flash
+        if resolve_flash(cfg.use_flash):
+            out = flash_attention(q, k, v, causal=False)
+        else:
+            out = local_flash_attention(q, k, v, causal=False)
     out = out.reshape(B, T, H_loc * Hd) @ p["wo"]
     if cfg.tp_axis:
         out = lax.psum(out, cfg.tp_axis)

@@ -152,10 +152,8 @@ def _use_pallas_flash(cfg: "LlamaConfig") -> bool:
     touch HBM — ops/flash_attention.py).  ``cfg.use_flash`` decides when
     set; otherwise HVD_TPU_FLASH=1/0 forces it on (interpret mode off-TPU,
     for tests) or off — read at TRACE time only (see LlamaConfig)."""
-    if cfg.use_flash is not None:
-        return cfg.use_flash
-    from ..ops.flash_attention import flash_enabled
-    return flash_enabled()
+    from ..ops.flash_attention import resolve_flash
+    return resolve_flash(cfg.use_flash)
 
 
 def _attention(x, p, cfg: LlamaConfig, positions):

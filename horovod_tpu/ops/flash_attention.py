@@ -33,10 +33,18 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def resolve_flash(override: Optional[bool] = None) -> bool:
+    """Config-first flash routing: a model config's ``use_flash`` field
+    (traced, so toggling it recompiles) wins; ``None`` falls back to
+    :func:`flash_enabled`."""
+    return flash_enabled() if override is None else override
+
+
 def flash_enabled() -> bool:
-    """Shared routing default for attention call sites (llama, Ulysses):
-    pallas flash on TPU, jnp reference elsewhere; ``HVD_TPU_FLASH=1/0``
-    forces it — read at TRACE time only (not part of any jit cache key)."""
+    """Shared routing default for attention call sites (llama, bert,
+    Ulysses): pallas flash on TPU, jnp reference elsewhere;
+    ``HVD_TPU_FLASH=1/0`` forces it — read at TRACE time only (not part of
+    any jit cache key)."""
     import os
     v = os.environ.get("HVD_TPU_FLASH", "auto").lower()
     if v in ("1", "true", "on"):

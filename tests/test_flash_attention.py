@@ -104,6 +104,27 @@ def test_ulysses_routes_through_flash(monkeypatch):
                                rtol=2e-4, atol=3e-5)
 
 
+def test_bert_uses_flash_when_forced(monkeypatch):
+    from horovod_tpu.models import bert
+
+    cfg = bert.tiny(dtype=jnp.float32,
+                    dp_axis=None, tp_axis=None, sp_axis=None)
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 24)),
+                         jnp.int32)
+    monkeypatch.setenv("HVD_TPU_FLASH", "0")
+    ref = bert.forward(params, tokens, cfg)
+    monkeypatch.setenv("HVD_TPU_FLASH", "1")
+    monkeypatch.setattr(
+        bert, "local_flash_attention",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError(
+            "bert fell back to local_flash_attention under "
+            "HVD_TPU_FLASH=1")))
+    out = bert.forward(params, tokens, cfg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
 def test_llama_uses_flash_when_forced(monkeypatch):
     """HVD_TPU_FLASH=1 routes llama attention through the pallas kernel;
     logits must match the jnp-reference path."""
