@@ -172,14 +172,16 @@ def _attention(x, p, cfg: LlamaConfig, positions):
     v = (x @ p["wv"]).reshape(B, T, K_loc, Hd)
     q = _rope(q, positions, cfg.rope_theta)
     kk = _rope(kk, positions, cfg.rope_theta)
-    # GQA: repeat kv heads up to query heads.
-    rep = H_loc // K_loc
-    if rep > 1:
-        kk = jnp.repeat(kk, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
 
     sp = lax.axis_size(cfg.sp_axis) if cfg.sp_axis else 1
     if sp > 1:
+        # The ring's blockwise accumulator is head-aligned: it needs the
+        # materialized GQA repeat; both local paths read shared kv heads
+        # natively ([B,T,K,D] in, no HBM repeat).
+        rep = H_loc // K_loc
+        if rep > 1:
+            kk = jnp.repeat(kk, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         out = ring_attention(q, kk, v, axis_name=cfg.sp_axis, causal=True)
     elif _use_pallas_flash(cfg):
         from ..ops.flash_attention import flash_attention

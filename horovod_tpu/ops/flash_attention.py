@@ -8,8 +8,9 @@ online-softmax accumulator.  The backward pass recomputes probabilities
 blockwise from the saved logsumexp — two kernels (dq; dk/dv) so every
 accumulator lives in VMEM scratch across the inner grid dimension.
 
-Layout: ``[B, T, H, D]`` (the llama layout).  GQA is handled by the caller
-(kv heads repeated up to query heads, as in ``models/llama._attention``).
+Layout: ``[B, T, H, D]`` (the llama layout).  GQA is native: pass kv with
+``K = H / rep`` heads and each q-head group reads its shared kv head
+through the kernels' block index maps — the repeat never touches HBM.
 
 On non-TPU backends the kernels run in Pallas interpret mode (tests), so
 the same code path is exercised everywhere; ``models/llama`` routes to
@@ -157,11 +158,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                block_q, block_k, n_q, tq_valid, tk_valid):
+                block_q, block_k, n_q, n_t, tq_valid, tk_valid):
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    t = pl.program_id(2)      # = r * n_q + qi over the rep q-heads (GQA)
+    qi = t % n_q
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -197,7 +199,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)      # [bk, D]
 
-    @pl.when(qi == n_q - 1)
+    @pl.when(t == n_t - 1)
     def _():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -212,8 +214,10 @@ def _pad_t(x, block):
     return x
 
 
-def _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
-    """q,k,v: [BH, T, D] -> (o [BH, Tq, D], lse [BH, Tq])."""
+def _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret, rep=1):
+    """q: [BH, T, D]; k, v: [BH // rep, T, D] (GQA: ``rep`` consecutive
+    q-heads share one kv head — remapped in the BlockSpec index, no
+    materialized repeat) -> (o [BH, Tq, D], lse [BH, Tq])."""
     BH, Tq, D = q.shape
     Tk = k.shape[1]
     bq, bk = min(block_q, Tq), min(block_k, Tk)
@@ -228,8 +232,8 @@ def _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
         grid=(BH, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b // rep, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b // rep, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
@@ -260,39 +264,52 @@ def flash_attention(q, k, v, causal: bool = False,
                     interpret: Optional[bool] = None):
     """Memory-efficient exact attention.
 
-    q, k, v: ``[B, T, H, D]`` (kv heads already repeated for GQA).
-    Differentiable via flash backward kernels; matches
+    q: ``[B, T, H, D]``; k, v: ``[B, T, K, D]`` with ``H % K == 0`` — GQA
+    is native (each group of ``H // K`` consecutive q-heads reads its kv
+    head through the kernel's block index map; the kv tensors are never
+    repeated in HBM).  Differentiable via flash backward kernels; matches
     ``parallel.ring_attention.local_flash_attention`` numerically.
     """
     B, Tq, H, D = q.shape
+    K = k.shape[2]
+    if v.shape[2] != K:
+        raise ValueError(f"k has {K} heads but v has {v.shape[2]}")
+    if H % K:
+        raise ValueError(f"q heads ({H}) must be a multiple of kv heads "
+                         f"({K}) for GQA")
+    rep = H // K
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     interpret = _interpret_default() if interpret is None else interpret
 
     def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+        h = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(B * h, x.shape[1], D)
 
     def from_bh(x, t):
         return x.reshape(B, H, t, D).transpose(0, 2, 1, 3)
 
     o = _flash_core(to_bh(q), to_bh(k), to_bh(v), scale, causal,
-                    block_q, block_k, interpret)
+                    block_q, block_k, interpret, rep)
     return from_bh(o, Tq)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, _ = _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret, rep):
+    o, _ = _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret,
+                     rep)
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret)
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, rep):
+    o, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret,
+                       rep)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+def _flash_bwd(scale, causal, block_q, block_k, interpret, rep, res, do):
     q, k, v, o, lse = res
     BH, Tq, D = q.shape
+    BK = k.shape[0]
     Tk = k.shape[1]
     bq, bk = min(block_q, Tq), min(block_k, Tk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -316,8 +333,8 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
         grid=(BH, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b // rep, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b // rep, j, 0)),
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
@@ -330,26 +347,32 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap)[:, :Tq]
 
+    # dk/dv accumulate over the rep q-heads sharing each kv head: grid is
+    # (B*K, n_k, rep*n_q) and the q-side index map walks head r = t // n_q,
+    # block qi = t % n_q of the kv head's group.
+    def _qix(b, j, t):
+        return (b * rep + t // n_q, t % n_q, 0)
+
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, n_q=n_q,
+                          block_q=bq, block_k=bk, n_q=n_q, n_t=rep * n_q,
                           tq_valid=Tq, tk_valid=Tk),
-        grid=(BH, n_k, n_q),
+        grid=(BK, n_k, rep * n_q),
         in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, D), _qix),
+            pl.BlockSpec((1, bk, D), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), _qix),
+            pl.BlockSpec((1, bq, 1), _qix),
+            pl.BlockSpec((1, bq, 1), _qix),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Tkp, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, Tkp, D), v.dtype),
+            jax.ShapeDtypeStruct((BK, Tkp, D), k.dtype),
+            jax.ShapeDtypeStruct((BK, Tkp, D), v.dtype),
         ],
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],

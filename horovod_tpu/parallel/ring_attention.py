@@ -115,9 +115,25 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
 def local_flash_attention(q, k, v, causal: bool = False,
                           scale: Optional[float] = None):
     """Single-device reference attention (same math, no ring) for tests and
-    for the sp=1 fast path."""
+    for the sp=1 fast path.  GQA is native: kv may have ``K = H / rep``
+    heads — a grouped einsum, no HBM repeat."""
     B, Tq, H, D = q.shape
+    K = k.shape[2]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    if K != H:
+        if v.shape[2] != K or H % K:
+            raise ValueError(f"GQA heads mismatch: q={H} k={K} v={v.shape[2]}")
+        qg = q.reshape(B, Tq, K, H // K, D)
+        s = jnp.einsum("bqkrd,bskd->bkrqs", qg, k,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            Tk = k.shape[1]
+            mask = jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :]
+            s = jnp.where(mask[None, None, None], s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bkrqs,bskd->bqkrd", p.astype(v.dtype), v,
+                         preferred_element_type=jnp.float32)
+        return out.reshape(B, Tq, H, D).astype(q.dtype)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:

@@ -46,6 +46,41 @@ def test_flash_matches_reference(shape, blocks, causal):
                                    atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_matches_repeated(causal):
+    """Native GQA (kv heads shared via block index maps) == materialized
+    jnp.repeat, for values and all three gradients (dk/dv accumulate over
+    the q-head group)."""
+    B, T, H, K, D = 2, 40, 4, 2, 16
+    rep = H // K
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, T, K, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, T, K, D), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       block_q=16, block_k=16) ** 2)
+
+    def loss_ref(q, k, v):
+        kr = jnp.repeat(k, rep, axis=2)
+        vr = jnp.repeat(v, rep, axis=2)
+        return jnp.sum(local_flash_attention(q, kr, vr, causal=causal) ** 2)
+
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v, causal=causal,
+                                   block_q=16, block_k=16)),
+        np.asarray(local_flash_attention(
+            q, jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2),
+            causal=causal)),
+        atol=3e-5, rtol=3e-5)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
 def test_flash_cross_attention_shapes():
     """Tq != Tk (cross attention / KV cache shapes)."""
     rng = np.random.RandomState(3)
@@ -67,8 +102,10 @@ def test_flash_tpu_lowering():
             q, k, v, causal=True, interpret=False).astype(jnp.float32)),
             argnums=(0, 1, 2))(q, k, v)
 
-    spec = jax.ShapeDtypeStruct((1, 1024, 8, 128), jnp.bfloat16)
-    exp = jax.export.export(jax.jit(f), platforms=["tpu"])(spec, spec, spec)
+    spec_q = jax.ShapeDtypeStruct((1, 1024, 8, 128), jnp.bfloat16)
+    spec_kv = jax.ShapeDtypeStruct((1, 1024, 4, 128), jnp.bfloat16)  # GQA
+    exp = jax.export.export(jax.jit(f), platforms=["tpu"])(
+        spec_q, spec_kv, spec_kv)
     assert len(exp.mlir_module_serialized) > 0
 
 
