@@ -1,0 +1,98 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over ``ppermute``.
+
+No reference analogue (SURVEY.md §2c: pipeline parallelism is ABSENT in
+Horovod) — this is a beyond-parity capability built TPU-first, the same way
+ring/Ulysses sequence parallelism were: the ``pp`` mesh axis holds one
+pipeline stage per device group, activations hop stage→stage over ICI with
+``lax.ppermute``, and the whole schedule is one ``lax.scan`` inside
+``shard_map`` — a single compiled program, no host round-trips between
+ticks.
+
+Schedule: classic GPipe fill/steady/drain.  With ``S`` stages and ``M``
+microbatches the scan runs ``S + M - 1`` ticks; at tick ``t`` stage ``s``
+processes microbatch ``m = t - s`` (when ``0 <= m < M``).  Bubble fraction
+``(S-1)/(S+M-1)`` — pick ``M >> S``.  The stage function must be
+shape-preserving (transformer blocks are), which is what lets one carry
+buffer serve every stage.
+
+Differentiable end to end: ``ppermute`` and ``scan`` have transposes, so
+``jax.grad`` of a loss on the last stage's outputs produces correct
+per-stage parameter gradients (the backward pipeline runs in the scan's
+transpose, reverse order — 1F1B-style interleaving is future work).
+
+Use inside ``shard_map`` with stage params sharded over ``pp``:
+
+    out = pipeline_apply(block_fn, stage_params, micro_x, axis_name="pp")
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def stage_index(axis_name: str = "pp"):
+    return lax.axis_index(axis_name)
+
+
+def pipeline_apply(fn: Callable, stage_params, micro_x,
+                   axis_name: str = "pp",
+                   broadcast_out: bool = False):
+    """Run microbatches through the stage pipeline.
+
+    fn: ``(stage_params, x[mb, ...]) -> y[mb, ...]`` (shape-preserving);
+    this rank applies ITS stage's params (already sharded over
+    ``axis_name`` by the enclosing shard_map).
+    micro_x: ``[M, mb, ...]`` microbatched input (consumed by stage 0).
+    Returns ``[M, mb, ...]`` outputs — valid on the LAST stage (zeros
+    elsewhere) unless ``broadcast_out``, which broadcasts them to every
+    stage with one psum (exact because every non-last stage holds zeros;
+    a schedule that leaves real data on other stages must not reuse it).
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m_total = micro_x.shape[0]
+    ticks = m_total + n - 1
+    # stage s -> s+1 (the last stage's send wraps to 0 and is ignored —
+    # stage 0 reads micro_x, never the carry).
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def tick(carry, t):
+        buf, outs = carry
+        x0 = micro_x[jnp.clip(t, 0, m_total - 1)]
+        x_in = jnp.where(idx == 0, x0, buf)
+        y = fn(stage_params, x_in)
+        m = t - idx                      # microbatch this stage holds now
+        valid = jnp.logical_and(m >= 0, m < m_total)
+        # Bubble ticks compute garbage; zero it so it can't poison the
+        # carry (NaN from fn(params, junk) would otherwise propagate).
+        y = jnp.where(valid, y, jnp.zeros_like(y))
+        outs = lax.cond(
+            jnp.logical_and(valid, idx == n - 1),
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y, jnp.clip(m, 0, m_total - 1), 0),
+            lambda o: o, outs)
+        buf = lax.ppermute(y, axis_name, perm)
+        return (buf, outs), None
+
+    buf0 = jnp.zeros_like(micro_x[0])
+    outs0 = jnp.zeros_like(micro_x)
+    (buf, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(ticks))
+
+    if broadcast_out:
+        # Every stage but the last holds zeros, so a psum over the pp axis
+        # IS the broadcast of the last stage's outputs.
+        outs = lax.psum(outs, axis_name)
+    return outs
+
+
+def microbatch(x, n_micro: int):
+    """[B, ...] -> [n_micro, B // n_micro, ...] (B must divide evenly)."""
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible into {n_micro} "
+                         f"microbatches")
+    return x.reshape((n_micro, b // n_micro) + x.shape[1:])

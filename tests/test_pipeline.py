@@ -1,0 +1,134 @@
+"""Pipeline parallelism (GPipe microbatching over ppermute): forward and
+gradient equivalence with the sequential composition of the same stages —
+beyond-reference capability (SURVEY.md §2c: PP absent in Horovod), tested
+the same way ring/Ulysses SP are."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.parallel.pipeline import microbatch, pipeline_apply
+
+S, D = 4, 8          # stages, feature dim
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:S]), ("pp",))
+
+
+def _stage_fn(stage_params, x):
+    w, b = stage_params
+    return jnp.tanh(x @ w[0] + b[0])
+
+
+def _stacked_params(seed=0):
+    rng = np.random.RandomState(seed)
+    w = jnp.asarray(rng.randn(S, D, D) * 0.5, jnp.float32)
+    b = jnp.asarray(rng.randn(S, D) * 0.1, jnp.float32)
+    return w, b
+
+
+def _sequential(params, x):
+    w, b = params
+    for s in range(S):
+        x = jnp.tanh(x @ w[s] + b[s])
+    return x
+
+
+def _pipeline_fn():
+    mesh = _mesh()
+    return jax.jit(shard_map(
+        lambda sp, mx: pipeline_apply(_stage_fn, sp, mx, axis_name="pp",
+                                      broadcast_out=True),
+        mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+        check_vma=False))
+
+
+@pytest.mark.parametrize("n_micro", [4, 8, 5])
+def test_pipeline_forward_matches_sequential(n_micro):
+    params = _stacked_params()
+    rng = np.random.RandomState(1)
+    batch = n_micro * 2
+    x = jnp.asarray(rng.randn(batch, D), jnp.float32)
+    ref = _sequential(params, x)
+
+    out = _pipeline_fn()(params, microbatch(x, n_micro))
+    np.testing.assert_allclose(np.asarray(out).reshape(batch, D),
+                               np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_gradients_match_sequential():
+    """jax.grad through the scan+ppermute schedule == grads of the
+    sequential model — each stage's parameter gradient lands correctly."""
+    params = _stacked_params(3)
+    rng = np.random.RandomState(2)
+    n_micro, batch = 4, 8
+    x = jnp.asarray(rng.randn(batch, D), jnp.float32)
+    tgt = jnp.asarray(rng.randn(batch, D), jnp.float32)
+
+    pipe = _pipeline_fn()
+
+    def loss_pipe(params):
+        out = pipe(params, microbatch(x, n_micro)).reshape(batch, D)
+        return jnp.mean((out - tgt) ** 2)
+
+    def loss_seq(params):
+        return jnp.mean((_sequential(params, x) - tgt) ** 2)
+
+    gp = jax.grad(loss_pipe)(params)
+    gs = jax.grad(loss_seq)(params)
+    for a, b in zip(gp, gs):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_llama_blocks():
+    """The flagship model's decoder blocks pipelined over pp == the same
+    blocks applied sequentially (each stage holds one layer's params)."""
+    from horovod_tpu.models import llama
+
+    cfg = llama.tiny(n_layers=S, n_heads=2, n_kv_heads=2, d_model=16,
+                     d_ff=32, vocab_size=64, dtype=jnp.float32,
+                     dp_axis=None, tp_axis=None, sp_axis=None,
+                     use_flash=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    T = 12
+    positions = jnp.arange(T)
+
+    def block(p_stacked, x):
+        p = jax.tree_util.tree_map(lambda a: a[0], p_stacked)
+        x = x + llama._attention(llama._rmsnorm(x, p["attn_norm"]), p, cfg,
+                                 positions)
+        x = x + llama._mlp(llama._rmsnorm(x, p["mlp_norm"]), p, cfg)
+        return x
+
+    stacked = jax.tree_util.tree_map(
+        lambda *ls: jnp.stack(ls), *params["layers"])
+
+    rng = np.random.RandomState(4)
+    n_micro, batch = 4, 8
+    x = jnp.asarray(rng.randn(batch, T, cfg.d_model), jnp.float32)
+
+    ref = x
+    for p in params["layers"]:
+        ref = ref + llama._attention(
+            llama._rmsnorm(ref, p["attn_norm"]), p, cfg, positions)
+        ref = ref + llama._mlp(llama._rmsnorm(ref, p["mlp_norm"]), p, cfg)
+
+    mesh = _mesh()
+    out = jax.jit(shard_map(
+        lambda sp, mx: pipeline_apply(block, sp, mx, axis_name="pp",
+                                      broadcast_out=True),
+        mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+        check_vma=False))(stacked, microbatch(x, n_micro))
+    np.testing.assert_allclose(
+        np.asarray(out).reshape(batch, T, cfg.d_model),
+        np.asarray(ref), rtol=3e-5, atol=3e-5)
+
+
+def test_microbatch_validates():
+    with pytest.raises(ValueError, match="divisible"):
+        microbatch(jnp.zeros((7, D)), 2)
