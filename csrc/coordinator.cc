@@ -84,6 +84,7 @@
 #include <set>
 #include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -205,6 +206,23 @@ struct Server {
   std::thread loop;
   std::atomic<bool> stop{false};
   std::map<std::string, PendingInfo> pending;
+  // Response cache (reference N8 response_cache.cc, re-derived for this
+  // wire protocol): steady-state training announces the same
+  // (name, digest, required, datadep) tuple every step; the server assigns
+  // each tuple a compact uint32 id on first full announce and broadcasts
+  // the assignment, after which clients send 4-byte cached announces (+
+  // their per-step group tag) instead of the full strings.
+  struct CacheRec {
+    std::string name, digest, datadep;
+    uint16_t required = 0;
+  };
+  // Bounded like the reference's capacity-limited cache, but without
+  // eviction: digest-churning workloads (varying shapes/scales) simply
+  // stop getting new ids past the cap and keep using full announces —
+  // correct either way, and memory stays bounded on multi-day runs.
+  static constexpr size_t kCacheCapacity = 65536;
+  std::unordered_map<std::string, uint32_t> cache_keys;  // key -> id
+  std::vector<CacheRec> cache_recs;                      // id -> record
   uint64_t announce_seq = 0;
   double stall_warn_s = 60.0;
   std::set<int> joined;
@@ -259,6 +277,38 @@ void Server::run_inner() {
   std::vector<uint8_t> frame;
   while (!stop.load()) {
     // One lock-step round: a frame from every rank, then a reply to all.
+    // Cache assignments created/confirmed this round, broadcast to all
+    // ranks in the response (deduped; a client only adopts assignments
+    // for names it announced itself).
+    std::map<uint32_t, std::pair<std::string, std::string>> assigns;
+    auto handle_announce = [&](int r, uint16_t required,
+                               const std::string& name,
+                               const std::string& digest,
+                               const std::string& group,
+                               const std::string& datadep) {
+      auto it = pending.find(name);
+      if (it == pending.end()) {
+        PendingInfo info;
+        info.order = announce_seq++;
+        info.required = required ? required : world;
+        info.first_seen = Clock::now();
+        info.digest = digest;
+        info.group = group == "-1" ? group : std::to_string(r) + ":" + group;
+        info.data_dep = datadep.empty() ? -1 : std::atoi(datadep.c_str());
+        it = pending.emplace(name, std::move(info)).first;
+      }
+      it->second.ready_ranks.insert(r);
+      it->second.by_digest[digest].insert(r);
+      (group == "-1" ? it->second.ungrouped_ranks
+                     : it->second.grouped_ranks)
+          .insert(r);
+      if (digest != it->second.digest) {
+        // Divergent submission (reference controller's consistency
+        // check).  The message is rebuilt at response time so late
+        // announcers still appear in the rank attribution.
+        it->second.errored = true;
+      }
+    };
     for (int r = 0; r < world; ++r) {
       if (!read_frame(fds[r].load(), &frame)) { stop.store(true); break; }
       Reader rd{frame.data(), frame.data() + frame.size()};
@@ -274,27 +324,36 @@ void Server::run_inner() {
           last_joined = r;
           continue;
         }
-        auto it = pending.find(name);
-        if (it == pending.end()) {
-          PendingInfo info;
-          info.order = announce_seq++;
-          info.required = required ? required : world;
-          info.first_seen = Clock::now();
-          info.digest = digest;
-          info.group = group == "-1" ? group : std::to_string(r) + ":" + group;
-          info.data_dep = datadep.empty() ? -1 : std::atoi(datadep.c_str());
-          it = pending.emplace(name, std::move(info)).first;
+        // Assign (or confirm) the tuple's cache id so every announcer
+        // eventually learns it and drops to the compact form.
+        std::string key = name;
+        key += '\x1f';
+        key += digest;
+        key += '\x1f';
+        key += datadep;
+        key += '\x1f';
+        key += std::to_string(required);
+        auto ck = cache_keys.find(key);
+        if (ck == cache_keys.end() &&
+            cache_recs.size() < kCacheCapacity) {
+          uint32_t id = static_cast<uint32_t>(cache_recs.size());
+          ck = cache_keys.emplace(key, id).first;
+          cache_recs.push_back(CacheRec{name, digest, datadep, required});
         }
-        it->second.ready_ranks.insert(r);
-        it->second.by_digest[digest].insert(r);
-        (group == "-1" ? it->second.ungrouped_ranks
-                       : it->second.grouped_ranks)
-            .insert(r);
-        if (digest != it->second.digest) {
-          // Divergent submission (reference controller's consistency
-          // check).  The message is rebuilt at response time so late
-          // announcers still appear in the rank attribution.
-          it->second.errored = true;
+        if (ck != cache_keys.end()) assigns[ck->second] = {name, digest};
+        handle_announce(r, required, name, digest, group, datadep);
+      }
+      // Optional compact section: cached announces (id + group tag).
+      if (rd.ok && rd.p < rd.end) {
+        uint32_t nc = rd.u32();
+        for (uint32_t i = 0; i < nc && rd.ok; ++i) {
+          uint32_t id = rd.u32();
+          std::string group = rd.str();
+          if (id < cache_recs.size()) {
+            const CacheRec& rec = cache_recs[id];
+            handle_announce(r, rec.required, rec.name, rec.digest, group,
+                            rec.datadep);
+          }
         }
       }
     }
@@ -446,6 +505,12 @@ void Server::run_inner() {
     for (auto& [name, msg] : errs) {
       put_str(&resp, name);
       put_str(&resp, msg);
+    }
+    put_u32(&resp, static_cast<uint32_t>(assigns.size()));
+    for (auto& [id, nd] : assigns) {
+      put_str(&resp, nd.first);
+      put_str(&resp, nd.second);
+      put_u32(&resp, id);
     }
     for (int r = 0; r < world; ++r) {
       if (!write_frame(fds[r].load(), resp)) { stop.store(true); break; }

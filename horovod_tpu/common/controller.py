@@ -59,6 +59,12 @@ class TCPController:
                 f"rank {rank}: failed to connect to controller at "
                 f"{addr}:{port}")
         self._announced: set = set()
+        # Response cache (reference N8): (name, digest, required, datadep)
+        # -> server-assigned uint32 id; once learned, re-announces of the
+        # same tuple send 4 bytes + the group tag instead of the strings.
+        self._cache_ids: Dict[tuple, int] = {}
+        self._awaiting_assign: Dict[tuple, tuple] = {}  # (name,digest)->key
+        self.bytes_sent = 0                      # telemetry (tests/timeline)
         self._early_ready: List[tuple] = []       # (name, digest)
         self._early_errors: Dict[str, str] = {}
         self._resp_buf = (ctypes.c_uint8 * _RESP_CAP)()
@@ -79,13 +85,34 @@ class TCPController:
     # ------------------------------------------------------------- protocol
     def _round(self, announces: Sequence) -> tuple:
         """announces: (name, required_ranks, digest, group, datadep)
-        tuples; required 0 = world."""
-        req = bytearray(struct.pack("<I", len(announces)))
-        for n, required, digest, group, datadep in announces:
+        tuples; required 0 = world.  Tuples whose cache id is known are
+        sent in the compact cached section (id + group)."""
+        full, cached = [], []
+        for a in announces:
+            n, required, digest, group, datadep = a
+            cid = self._cache_ids.get((n, digest, required, datadep))
+            if cid is None:
+                full.append(a)
+                # Bounded alongside the server's cap: digest-churning
+                # workloads stop learning ids instead of growing forever.
+                if (not n.startswith("\x1f")
+                        and len(self._awaiting_assign) < 65536
+                        and len(self._cache_ids) < 65536):
+                    self._awaiting_assign[(n, digest)] = (
+                        n, digest, required, datadep)
+            else:
+                cached.append((cid, group))
+        req = bytearray(struct.pack("<I", len(full)))
+        for n, required, digest, group, datadep in full:
             req += struct.pack("<H", required)
             for field in (n, digest, group, datadep):
                 fb = field.encode()
                 req += struct.pack("<H", len(fb)) + fb
+        req += struct.pack("<I", len(cached))
+        for cid, group in cached:
+            gb = group.encode()
+            req += struct.pack("<I", cid) + struct.pack("<H", len(gb)) + gb
+        self.bytes_sent += len(req)
         buf = (ctypes.c_uint8 * len(req)).from_buffer(req) if req else \
             (ctypes.c_uint8 * 0)()
         rc = self._lib.hvdtpu_client_round(
@@ -132,6 +159,23 @@ class TCPController:
         ready = read_tuple(3)
         warns = read_list()
         errors = read_tuple(2) if off < len(data) else []
+        # Cache-id assignments: adopt those matching a tuple this client
+        # announced in full (the server broadcasts to every rank).
+        if off < len(data):
+            (n_assign,) = struct.unpack_from("<I", data, off)
+            off += 4
+            for _ in range(n_assign):
+                fields = []
+                for _f in range(2):
+                    (ln,) = struct.unpack_from("<H", data, off)
+                    off += 2
+                    fields.append(data[off:off + ln].decode())
+                    off += ln
+                (cid,) = struct.unpack_from("<I", data, off)
+                off += 4
+                key = self._awaiting_assign.pop(tuple(fields), None)
+                if key is not None:
+                    self._cache_ids[key] = cid
         return ready, warns, errors
 
     # ---------------------------------------------------------- engine API

@@ -95,6 +95,65 @@ def test_controller_negotiation_unit():
     assert flat0 == flat1, results
 
 
+def test_controller_response_cache_shrinks_steady_state():
+    """Reference N8 (response_cache.cc): after the first announce of a
+    (name, digest) tuple, re-announces ride a 4-byte cache id — identical
+    verdicts, much smaller steady-state request frames."""
+    import threading
+    from horovod_tpu.common.controller import TCPController
+
+    port = _free_port()
+    results = {}
+
+    class E:
+        def __init__(self, name):
+            self.name = name
+
+    names = [f"grad.{i}.with.a.long.parameter.path" for i in range(16)]
+
+    def worker(rank):
+        ctl = TCPController("127.0.0.1", port, rank=rank, world=2,
+                            stall_warn_s=60.0)
+        try:
+            per_round = []
+            orders = []
+            for step in range(4):
+                before = ctl.bytes_sent
+                got = []
+                entries = [E(n) for n in names]
+                while len(got) < len(names):
+                    ready, errs = ctl.negotiate(entries)
+                    assert not errs
+                    got += [e.name for e in ready]
+                    entries = [e for e in entries
+                               if e.name not in set(got)]
+                per_round.append(ctl.bytes_sent - before)
+                orders.append(tuple(got))
+            results[rank] = (per_round, orders)
+        finally:
+            if rank != 0:
+                ctl.shutdown()
+            else:
+                import time
+                deadline = time.time() + 30
+                while len(results) < 2 and time.time() < deadline:
+                    time.sleep(0.01)   # keep the server up for the peer
+                ctl.shutdown()
+
+    t1 = threading.Thread(target=worker, args=(1,))
+    t1.start()
+    worker(0)
+    t1.join(timeout=60)
+    assert set(results) == {0, 1}
+    for rank, (per_round, orders) in results.items():
+        # Steady state (round 2+) must be far smaller than the cold round:
+        # 16 cached announces ≈ 16*(4+2+2) + 8 bytes vs full names+digests.
+        assert per_round[2] < per_round[0] / 3, (rank, per_round)
+        assert per_round[3] <= per_round[1], (rank, per_round)
+    # Verdict order identical across ranks every round.
+    assert results[0][1] == results[1][1]
+
+
 @pytest.mark.parametrize("np_", [2, 3])
 def test_torovodrun_collectives(np_):
     res = _run_torovodrun(np_, WORKER)
