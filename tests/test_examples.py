@@ -95,6 +95,14 @@ def test_example_llama_spmd():
     assert "tok/s" in r.stdout
 
 
+def test_example_adasum_train():
+    r = _run_example("adasum_train.py",
+                     ["--epochs", "1", "--n-train", "128",
+                      "--batch-size", "32"])
+    _assert_done(r)
+    assert "adasum" in r.stdout
+
+
 def test_example_elastic_train(tmp_path):
     hostfile = tmp_path / "hosts"
     hostfile.write_text("localhost:2\n")
