@@ -1,0 +1,19 @@
+"""Parallelism strategies beyond data-parallel (SURVEY.md §2c): sequence
+parallelism (ring + Ulysses), pipeline parallelism, Adasum, hierarchical
+two-level collectives, ZeRO-sharded optimizers, and the mesh/SPMD helpers
+that tie them to ``jax.sharding``."""
+
+from .mesh import DP, TP, SP, EP, PP, infer_mesh, make_mesh  # noqa: F401
+from .spmd import (  # noqa: F401
+    infer_specs_like, make_sharded_train_step, shard_params,
+)
+from .ring_attention import (  # noqa: F401
+    local_flash_attention, ring_attention,
+)
+from .ulysses import heads_to_seq, seq_to_heads, ulysses_attention  # noqa: F401
+from .pipeline import microbatch, pipeline_apply  # noqa: F401
+from .adasum import (  # noqa: F401
+    adasum_allreduce, adasum_allreduce_hd, adasum_combine, torus_bit_order,
+)
+from .hierarchical import hierarchical_allreduce  # noqa: F401
+from .zero import sharded_optimizer  # noqa: F401
