@@ -1,0 +1,66 @@
+"""bench.py smoke tier: the driver runs bench.py at end of round and its
+ONE JSON line is the round's perf record — two rounds died to bench
+breakage before this guard existed.  Runs every mode on the CPU mesh with
+tiny sizes and asserts the line parses with the expected fields."""
+
+import json
+import os
+import subprocess
+import sys
+
+from test_examples import _example_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+_WATCHDOG_S = 600
+
+
+def _run_bench(extra_env):
+    env = _example_env(
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        HVD_BENCH_TIMEOUT_S=str(_WATCHDOG_S), **extra_env)
+    # Outer timeout strictly above the internal watchdog so a wedge emits
+    # the watchdog's diagnostic JSON instead of an opaque TimeoutExpired.
+    r = subprocess.run([sys.executable, BENCH], env=env,
+                       capture_output=True, text=True,
+                       timeout=_WATCHDOG_S + 120)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout[-2000:]
+    return json.loads(lines[0])
+
+
+def test_bench_minimal_mode():
+    out = _run_bench({"HVD_BENCH_MINIMAL": "1", "HVD_BENCH_SIZES_MB": "1"})
+    assert out["metric"] == "allreduce_engine_busbw_GBps"
+    assert out["value"] and out["value"] > 0
+    assert out["errors"] == {}
+    assert out["world"] == 8
+
+
+def test_bench_default_resnet():
+    out = _run_bench({"HVD_BENCH_BATCH": "2", "HVD_BENCH_STEPS": "2",
+                      "HVD_BENCH_IMAGE": "32", "HVD_BENCH_SKIP_BUSBW": "1",
+                      "HVD_BENCH_SKIP_RAW": "1"})
+    assert out["metric"].startswith("resnet50")
+    assert out["value"] and out["value"] > 0, out
+    assert out["errors"] == {}, out
+
+
+def test_bench_llama_mode():
+    out = _run_bench({"HVD_BENCH_MODEL": "llama", "HVD_BENCH_BATCH": "2",
+                      "HVD_BENCH_STEPS": "2"})
+    assert out["metric"].startswith("llama")
+    assert out["value"] and out["value"] > 0, out
+    assert out["errors"] == {}, out
+
+
+def test_bench_bert_mode():
+    out = _run_bench({"HVD_BENCH_MODEL": "bert", "HVD_BENCH_BATCH": "2",
+                      "HVD_BENCH_STEPS": "2", "HVD_BENCH_SKIP_BUSBW": "1"})
+    assert out["metric"].startswith("bert")
+    assert out["value"] and out["value"] > 0, out
+    assert out["errors"] == {}, out
+
+
