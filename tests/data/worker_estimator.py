@@ -60,6 +60,37 @@ def main():
     for r in range(size):
         np.testing.assert_allclose(g[r], g[0], rtol=1e-9)
 
+    # TorchEstimator over the SAME shared store, with data constructed so
+    # gradient AVERAGING is observable: shard materialization is
+    # round-robin (row j -> shard j % size), and row j's target uses
+    # w + (j % size) * delta — shard r alone would converge to
+    # w + r*delta, so landing on the MEAN optimum proves the torch
+    # binding's allreduce hooks actually averaged across ranks
+    # (reference: spark/torch/estimator.py).
+    import torch
+    from horovod_tpu.spark import TorchEstimator
+
+    delta = np.array([0.8, 0.0, 0.0], np.float32)
+    y2 = np.array([X[j] @ (w + (j % size) * delta)
+                   for j in range(len(X))], np.float32)
+    df2 = Rows([{"f0": float(a), "f1": float(b), "f2": float(c),
+                 "label": float(t)} for (a, b, c), t in zip(X, y2)])
+    expected = w + delta * (size - 1) / 2.0
+
+    t_est = TorchEstimator(
+        model_factory=lambda: torch.nn.Linear(3, 1, bias=False),
+        loss=lambda p, t: torch.nn.functional.mse_loss(
+            p, t.reshape(p.shape)),
+        feature_cols=["f0", "f1", "f2"], label_cols=["label"],
+        store=LocalStore(os.environ["EST_DIR"] + "/torch"), num_proc=size,
+        epochs=40, batch_size=16, learning_rate=0.1, run_id="mp_torch",
+        backend=lambda fn, n, env=None: [fn()])
+    t_model = t_est.fit(df2)
+    got = t_model.params["weight"].numpy().reshape(-1)
+    np.testing.assert_allclose(got, expected, atol=0.15)
+    # Un-averaged training would sit at shard 0's optimum (w) — reject it.
+    assert got[0] - w[0] > 0.2, (got, w, expected)
+
     print(f"EST_OK rank={rank}")
     hvd.shutdown()
 
