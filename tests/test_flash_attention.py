@@ -119,7 +119,10 @@ def test_ulysses_routes_through_flash(monkeypatch):
     monkeypatch.setenv("HVD_TPU_FLASH", "1")
     # Spy: if routing regresses to the jnp fallback, fail loudly instead of
     # passing vacuously (flash and reference are numerically identical).
-    import horovod_tpu.parallel.ring_attention as ra
+    # NB: horovod_tpu.parallel re-exports the ring_attention FUNCTION, which
+    # shadows the submodule attribute — import the module explicitly.
+    import importlib
+    ra = importlib.import_module("horovod_tpu.parallel.ring_attention")
 
     def _boom(*a, **k):
         raise AssertionError("routing fell back to local_flash_attention "
