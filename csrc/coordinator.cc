@@ -205,6 +205,14 @@ struct Server {
   std::atomic<int> handshake_fd{-1};
   std::thread loop;
   std::atomic<bool> stop{false};
+  // Held by run_inner() across a round's compute+write phase.  server_stop
+  // acquires it (with a grace timeout) BEFORE severing client sockets, so a
+  // shutdown initiated by rank 0 the instant its own response lands can
+  // never cut off the same round's responses to the other ranks mid-write
+  // (observed: rank 0 completes the final barrier and calls shutdown while
+  // ranks 1..n-1's responses are still being written — they then die with
+  // rc=-1 and a pending entry instead of completing).
+  std::timed_mutex phase_mu;
   std::map<std::string, PendingInfo> pending;
   // Response cache (reference N8 response_cache.cc, re-derived for this
   // wire protocol): steady-state training announces the same
@@ -280,7 +288,16 @@ void Server::run_inner() {
     // Cache assignments created/confirmed this round, broadcast to all
     // ranks in the response (deduped; a client only adopts assignments
     // for names it announced itself).
-    std::map<uint32_t, std::pair<std::string, std::string>> assigns;
+    // value = the FULL cache key (name, digest, datadep, required) so a
+    // client adopting the id can match it against exactly the tuple it
+    // announced — two announces sharing (name, digest) but differing in
+    // datadep/required (same tensor name under different process sets)
+    // must not cross-adopt each other's ids.
+    struct AssignRec {
+      std::string name, digest, datadep;
+      uint16_t required;
+    };
+    std::map<uint32_t, AssignRec> assigns;
     auto handle_announce = [&](int r, uint16_t required,
                                const std::string& name,
                                const std::string& digest,
@@ -340,7 +357,8 @@ void Server::run_inner() {
           ck = cache_keys.emplace(key, id).first;
           cache_recs.push_back(CacheRec{name, digest, datadep, required});
         }
-        if (ck != cache_keys.end()) assigns[ck->second] = {name, digest};
+        if (ck != cache_keys.end())
+          assigns[ck->second] = AssignRec{name, digest, datadep, required};
         handle_announce(r, required, name, digest, group, datadep);
       }
       // Optional compact section: cached announces (id + group tag).
@@ -358,6 +376,10 @@ void Server::run_inner() {
       }
     }
     if (stop.load()) break;
+    // Compute+write under phase_mu: see the field's comment.  Reads stay
+    // outside the lock (they block on peers, and server_stop must be able
+    // to sever a blocked read).
+    std::lock_guard<std::timed_mutex> phase_lock(phase_mu);
 
     // Ready = reported by every rank (joined ranks count as implicitly
     // ready for world-level tensors); deterministic order by announce seq.
@@ -507,14 +529,21 @@ void Server::run_inner() {
       put_str(&resp, msg);
     }
     put_u32(&resp, static_cast<uint32_t>(assigns.size()));
-    for (auto& [id, nd] : assigns) {
-      put_str(&resp, nd.first);
-      put_str(&resp, nd.second);
+    for (auto& [id, rec] : assigns) {
+      put_str(&resp, rec.name);
+      put_str(&resp, rec.digest);
+      put_str(&resp, rec.datadep);
+      put_u16(&resp, rec.required);
       put_u32(&resp, id);
     }
+    // Attempt EVERY rank before honoring a failure: one dead/closing peer
+    // must not cut the survivors off from a round's computed verdicts
+    // (they may contain the ready broadcast that lets them finish cleanly).
+    bool write_failed = false;
     for (int r = 0; r < world; ++r) {
-      if (!write_frame(fds[r].load(), resp)) { stop.store(true); break; }
+      if (!write_frame(fds[r].load(), resp)) write_failed = true;
     }
+    if (write_failed) stop.store(true);
   }
   // fds are closed by hvdtpu_server_stop after the thread joins.
 }
@@ -561,10 +590,17 @@ void hvdtpu_server_stop(void* handle) {
   ::shutdown(s->listen_fd, SHUT_RDWR);
   int hs = s->handshake_fd.exchange(-2);
   if (hs >= 0) ::shutdown(hs, SHUT_RDWR);
+  // Let an in-flight round finish broadcasting its responses before
+  // severing the sockets (phase_mu comment): without this, peers whose
+  // response for the CURRENT round had not been written yet fail their
+  // round with a pending entry.  Timed: a peer wedged enough to block a
+  // small write for 5s is a dead peer; proceed and sever.
+  bool locked = s->phase_mu.try_lock_for(std::chrono::seconds(5));
   for (int i = 0; i < s->world; ++i) {
     int fd = s->fds[i].load();
     if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
   }
+  if (locked) s->phase_mu.unlock();
   if (s->loop.joinable()) s->loop.join();
   // If we took ownership of a mid-handshake fd (exchanged to -2 above),
   // run() deliberately did not close it — close it now, after the join.

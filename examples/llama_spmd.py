@@ -1,4 +1,4 @@
-"""Flagship: Llama decoder trained with dp x tp x sp sharding, SPMD-style.
+"""Flagship: Llama decoder trained with dp x tp x sp x pp sharding, SPMD.
 
 Beyond the reference's data-parallel examples — this is the TPU-first
 path for models too big (or sequences too long) for pure DP: one process
@@ -9,6 +9,9 @@ drives the whole device mesh, the train step is a single jitted
 - **tp** — Megatron-style tensor parallelism on attention/MLP blocks,
 - **sp** — ring-attention sequence parallelism for long contexts
   (`horovod_tpu/parallel/ring_attention.py`),
+- **pp** — GPipe pipeline stages: the layer stack is sharded into
+  contiguous slabs over the pp axis and microbatches flow stage-to-stage
+  over ICI ``ppermute`` (`horovod_tpu/parallel/pipeline.py`),
 
 and XLA schedules every collective over ICI.  See
 ``horovod_tpu/models/llama.py`` for the layer shardings and
@@ -17,11 +20,14 @@ and XLA schedules every collective over ICI.  See
 Run on a TPU slice (uses all local chips)::
 
     python examples/llama_spmd.py --dp 2 --tp 2 --sp 2
+    python examples/llama_spmd.py --dp 2 --pp 2 --tp 2 --micro 4
 
 CPU smoke (8 virtual devices)::
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/llama_spmd.py --dp 2 --tp 2 --sp 2 --steps 2 --tiny
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/llama_spmd.py --dp 2 --pp 2 --steps 2 --tiny
 """
 
 import argparse
@@ -36,6 +42,10 @@ def parse_args():
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel degree")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel degree (ring attention)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel degree (GPipe layer slabs)")
+    p.add_argument("--micro", type=int, default=2,
+                   help="microbatches per pipeline step (with --pp)")
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--batch", type=int, default=0,
                    help="global batch (default 2*dp)")
@@ -58,19 +68,25 @@ def main():
     from horovod_tpu.parallel import spmd
     from horovod_tpu.parallel.mesh import infer_mesh
 
-    n = args.dp * args.tp * args.sp
+    n = args.dp * args.tp * args.sp * args.pp
     if len(jax.devices()) < n:
-        raise SystemExit(f"need {n} devices for dp*tp*sp, "
+        raise SystemExit(f"need {n} devices for dp*tp*sp*pp, "
                          f"have {len(jax.devices())}")
-    mesh = infer_mesh(n, tp=args.tp, sp=args.sp, devices=jax.devices()[:n])
+    mesh = infer_mesh(n, tp=args.tp, sp=args.sp, pp=args.pp,
+                      devices=jax.devices()[:n])
 
+    pp_kw = dict(pp_axis="pp" if args.pp > 1 else None,
+                 n_microbatches=args.micro)
     if args.tiny:
         cfg = llama.tiny(n_heads=4, n_kv_heads=2, d_model=64, d_ff=128,
-                         vocab_size=256)
+                         vocab_size=256, **pp_kw)
     else:
         cfg = llama.LlamaConfig(vocab_size=32000, d_model=1024, n_layers=8,
                                 n_heads=16, n_kv_heads=8, d_ff=4096,
-                                max_seq=4096, dtype=jnp.bfloat16)
+                                max_seq=4096, dtype=jnp.bfloat16, **pp_kw)
+    if args.pp > 1 and cfg.n_layers % args.pp:
+        raise SystemExit(f"n_layers={cfg.n_layers} not divisible by "
+                         f"pp={args.pp}")
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     pspecs = llama.param_specs(cfg)
@@ -78,12 +94,17 @@ def main():
     opt_state = opt.init(params)
     os_specs = spmd.infer_specs_like(opt_state, params, pspecs)
 
+    # With pipeline stages, every stage sees the same batch shard (the
+    # schedule moves activations across pp, not data); otherwise fold the
+    # free pp axis into the batch axes.
+    batch_axes = ("dp", "ep") if args.pp > 1 else ("dp", "ep", "pp")
     step = spmd.make_sharded_train_step(
         llama.make_train_step(cfg, opt), mesh, pspecs, os_specs,
-        data_spec=P(("dp", "ep", "pp"), "sp"))
+        data_spec=P(batch_axes, "sp"))
     params = spmd.shard_params(params, pspecs, mesh)
 
-    batch = args.batch or 2 * args.dp
+    micro = args.micro if args.pp > 1 else 1
+    batch = args.batch or 2 * args.dp * micro
     seq = args.seq or 128 * args.sp
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
@@ -100,7 +121,7 @@ def main():
     jax.block_until_ready(loss)
     dt = time.time() - t0
     tok_s = batch * seq * args.steps / dt
-    print(f"mesh=(dp={args.dp},tp={args.tp},sp={args.sp}) "
+    print(f"mesh=(dp={args.dp},tp={args.tp},sp={args.sp},pp={args.pp}) "
           f"batch={batch} seq={seq}")
     print(f"loss={float(jax.device_get(loss)):.4f} "
           f"throughput={tok_s:.0f} tok/s", flush=True)

@@ -63,7 +63,12 @@ class TCPController:
         # -> server-assigned uint32 id; once learned, re-announces of the
         # same tuple send 4 bytes + the group tag instead of the strings.
         self._cache_ids: Dict[tuple, int] = {}
-        self._awaiting_assign: Dict[tuple, tuple] = {}  # (name,digest)->key
+        # Full (name, digest, required, datadep) tuples announced in full
+        # and awaiting a server id.  The server echoes the full key in the
+        # assignment broadcast, so adoption matches exactly the announced
+        # tuple — same (name, digest) under a different process set
+        # (different required/datadep) can't cross-adopt ids.
+        self._awaiting_assign: set = set()
         self.bytes_sent = 0                      # telemetry (tests/timeline)
         self._early_ready: List[tuple] = []       # (name, digest)
         self._early_errors: Dict[str, str] = {}
@@ -98,8 +103,7 @@ class TCPController:
                 if (not n.startswith("\x1f")
                         and len(self._awaiting_assign) < 65536
                         and len(self._cache_ids) < 65536):
-                    self._awaiting_assign[(n, digest)] = (
-                        n, digest, required, datadep)
+                    self._awaiting_assign.add((n, digest, required, datadep))
             else:
                 cached.append((cid, group))
         req = bytearray(struct.pack("<I", len(full)))
@@ -166,15 +170,17 @@ class TCPController:
             off += 4
             for _ in range(n_assign):
                 fields = []
-                for _f in range(2):
+                for _f in range(3):
                     (ln,) = struct.unpack_from("<H", data, off)
                     off += 2
                     fields.append(data[off:off + ln].decode())
                     off += ln
-                (cid,) = struct.unpack_from("<I", data, off)
-                off += 4
-                key = self._awaiting_assign.pop(tuple(fields), None)
-                if key is not None:
+                (required, cid) = struct.unpack_from("<HI", data, off)
+                off += 6
+                name, digest, datadep = fields
+                key = (name, digest, required, datadep)
+                if key in self._awaiting_assign:
+                    self._awaiting_assign.discard(key)
                     self._cache_ids[key] = cid
         return ready, warns, errors
 

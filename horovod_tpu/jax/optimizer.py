@@ -140,7 +140,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
 
     def _reduce(grads):
         return allreduce_gradients(grads, op=op, axis_name=axis_name,
-                                   compression=compression)
+                                   compression=compression,
+                                   process_set=process_set)
 
     def update_fn(grads, state: _DistOptState, params=None):
         if k == 1:

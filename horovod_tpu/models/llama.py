@@ -47,6 +47,17 @@ class LlamaConfig:
     dp_axis: Optional[str] = "dp"
     tp_axis: Optional[str] = "tp"
     sp_axis: Optional[str] = "sp"
+    # Pipeline parallelism (beyond-ref, SURVEY.md §2c PP row): stage =
+    # contiguous layer slab.  When set, ``init_params``/``param_specs``
+    # emit the layer stack as STACKED arrays [n_layers, ...] sharded over
+    # ``pp_axis`` (shard_map hands each stage its slab in layer order) and
+    # ``forward`` runs the GPipe schedule from parallel/pipeline.py.
+    # Composes with dp (data split) / tp (params within a layer) / sp
+    # (sequence within attention).
+    pp_axis: Optional[str] = None
+    # Microbatches for the pipeline fill/drain (bubble = (pp-1)/(pp+M-1));
+    # the per-shard batch must divide by it.  Ignored without pp_axis.
+    n_microbatches: int = 2
     # Pallas flash attention: True/False, or None = resolve from the
     # HVD_TPU_FLASH env var at TRACE time (auto: on when running on TPU).
     # The env var is not part of any jit cache key — to toggle after a
@@ -96,6 +107,11 @@ def init_params(cfg: LlamaConfig, key) -> Dict:
             "w3": dense(next(k), D, (D, F)),
             "w2": dense(next(k), F, (F, D)),
         })
+    if cfg.pp_axis:
+        # Stacked layout [n_layers, ...]: shard_map slices axis 0 over the
+        # pp axis in order, so stage i holds the contiguous layer slab
+        # [i*L/pp, (i+1)*L/pp).
+        layers = {k: jnp.stack([l[k] for l in layers]) for k in layers[0]}
     return {
         "embed": dense(next(k), D, (cfg.vocab_size, D)),
         "layers": layers,
@@ -105,8 +121,9 @@ def init_params(cfg: LlamaConfig, key) -> Dict:
 
 
 def param_specs(cfg: LlamaConfig) -> Dict:
-    """PartitionSpec tree matching ``init_params`` (tp sharding only;
-    params are replicated over dp/sp)."""
+    """PartitionSpec tree matching ``init_params`` (tp shards within a
+    layer, pp shards the stacked layer axis; params are replicated over
+    dp/sp)."""
     tp = cfg.tp_axis
     layer = {
         "attn_norm": P(),
@@ -119,9 +136,13 @@ def param_specs(cfg: LlamaConfig) -> Dict:
         "w3": P(None, tp),
         "w2": P(tp, None),
     }
+    if cfg.pp_axis:
+        layers = {k: P(cfg.pp_axis, *spec) for k, spec in layer.items()}
+    else:
+        layers = [dict(layer) for _ in range(cfg.n_layers)]
     return {
         "embed": P(),
-        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "layers": layers,
         "final_norm": P(),
         "lm_head": P(),
     }
@@ -175,14 +196,11 @@ def _attention(x, p, cfg: LlamaConfig, positions):
 
     sp = lax.axis_size(cfg.sp_axis) if cfg.sp_axis else 1
     if sp > 1:
-        # The ring's blockwise accumulator is head-aligned: it needs the
-        # materialized GQA repeat; both local paths read shared kv heads
-        # natively ([B,T,K,D] in, no HBM repeat).
-        rep = H_loc // K_loc
-        if rep > 1:
-            kk = jnp.repeat(kk, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        out = ring_attention(q, kk, v, axis_name=cfg.sp_axis, causal=True)
+        # GQA passes through un-repeated: the ring handles it on both
+        # engines (pallas reads shared kv heads through block index maps —
+        # H/K× less ring traffic; the jnp fallback repeats internally).
+        out = ring_attention(q, kk, v, axis_name=cfg.sp_axis, causal=True,
+                             use_flash=cfg.use_flash)
     elif _use_pallas_flash(cfg):
         from ..ops.flash_attention import flash_attention
         out = flash_attention(q, kk, v, causal=True)
@@ -202,9 +220,21 @@ def _mlp(x, p, cfg: LlamaConfig):
     return out
 
 
+def _layer_apply(p, x, cfg: LlamaConfig, positions):
+    x = x + _attention(_rmsnorm(x, p["attn_norm"]), p, cfg, positions)
+    x = x + _mlp(_rmsnorm(x, p["mlp_norm"]), p, cfg)
+    return x
+
+
 def forward(params, tokens, cfg: LlamaConfig):
     """Logits for local token shard [B_loc, T_loc] (call inside shard_map,
-    or directly when all axes are disabled/size-1)."""
+    or directly when all axes are disabled/size-1).
+
+    With ``pp_axis`` set, ``params["layers"]`` is this stage's slab of the
+    stacked layer arrays and the blocks run under the GPipe microbatch
+    schedule; embedding and the LM head are computed replicated on every
+    stage (cheap next to the blocks), with the head reading the last
+    stage's pipeline output broadcast via the zero-sum psum trick."""
     B, T = tokens.shape
     if cfg.sp_axis:
         sp_idx = lax.axis_index(cfg.sp_axis)
@@ -212,9 +242,23 @@ def forward(params, tokens, cfg: LlamaConfig):
     else:
         positions = jnp.arange(T)
     x = params["embed"][tokens]
-    for p in params["layers"]:
-        x = x + _attention(_rmsnorm(x, p["attn_norm"]), p, cfg, positions)
-        x = x + _mlp(_rmsnorm(x, p["mlp_norm"]), p, cfg)
+    if cfg.pp_axis:
+        from ..parallel.pipeline import microbatch, pipeline_apply
+        M = cfg.n_microbatches
+        micro_x = microbatch(x, M)           # [M, B/M, T, D]
+
+        def stage_fn(slab, xm):
+            def body(h, p):
+                return _layer_apply(p, h, cfg, positions), None
+            h, _ = lax.scan(body, xm, slab)  # this stage's layer slab
+            return h
+
+        x = pipeline_apply(stage_fn, params["layers"], micro_x,
+                           axis_name=cfg.pp_axis, broadcast_out=True)
+        x = x.reshape((B, T, -1))
+    else:
+        for p in params["layers"]:
+            x = _layer_apply(p, x, cfg, positions)
     x = _rmsnorm(x, params["final_norm"])
     return x @ params["lm_head"]
 
@@ -235,9 +279,11 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig):
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     # dp/sp factors extend the local count to the global token count; the
-    # tp factor splits the redundantly-computed loss across tp ranks.
+    # tp/pp factors split the redundantly-computed loss across ranks (every
+    # tp rank computes the full head; every pp stage computes the loss from
+    # the broadcast pipeline output).
     denom = float(nll.size)
-    for ax in (cfg.dp_axis, cfg.sp_axis, cfg.tp_axis):
+    for ax in (cfg.dp_axis, cfg.sp_axis, cfg.tp_axis, cfg.pp_axis):
         if ax:
             denom = denom * lax.axis_size(ax)
     return jnp.sum(nll) / denom
@@ -245,7 +291,7 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig):
 
 def psum_loss(loss_partial, cfg: LlamaConfig):
     """Sum per-rank partial losses into the true global mean loss."""
-    for ax in (cfg.dp_axis, cfg.sp_axis, cfg.tp_axis):
+    for ax in (cfg.dp_axis, cfg.sp_axis, cfg.tp_axis, cfg.pp_axis):
         if ax:
             loss_partial = lax.psum(loss_partial, ax)
     return loss_partial
@@ -264,8 +310,12 @@ def sync_grads(grads, cfg: LlamaConfig, specs=None):
       over tp to combine the per-shard contributions; tp-SHARDED params'
       grads are already exact for their shard (the cotangent arriving
       through the row-parallel psum's transpose is the full one).
-    The 1/(count·tp) scaling inside ``loss_fn`` makes these psums land on
-    the exact global-mean gradient.
+    - pp-replicated params (embed/lm_head/final_norm): psum over pp — the
+      embed grad is nonzero only on stage 0 (the pipeline consumes input
+      there) and the head grad is 1/pp-scaled on every stage, so the psum
+      reassembles both.  pp-SHARDED slabs are exact per stage, like tp.
+    The 1/(count·tp·pp) scaling inside ``loss_fn`` makes these psums land
+    on the exact global-mean gradient.
     """
     specs = specs or param_specs(cfg)
 
@@ -273,8 +323,9 @@ def sync_grads(grads, cfg: LlamaConfig, specs=None):
         for ax in (cfg.dp_axis, cfg.sp_axis):
             if ax:
                 g = lax.psum(g, ax)
-        if cfg.tp_axis and all(s != cfg.tp_axis for s in spec):
-            g = lax.psum(g, cfg.tp_axis)
+        for ax in (cfg.tp_axis, cfg.pp_axis):
+            if ax and all(s != ax for s in spec):
+                g = lax.psum(g, ax)
         return g
 
     return jax.tree_util.tree_map(leaf_sync, grads, specs,

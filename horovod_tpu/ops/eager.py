@@ -236,12 +236,19 @@ def allreduce_async(tensor, name: Optional[str] = None,
         donate=owned)
 
 
+def _sync_now(handle):
+    """Blocking-op epilogue: kick the engine (inline cycle in
+    single-controller mode — the small-tensor latency fast path) and wait."""
+    _engine().kick()
+    return synchronize(handle)
+
+
 def allreduce(tensor, name: Optional[str] = None,
               op: C.ReduceOp = C.ReduceOp.AVERAGE,
               prescale_factor: Optional[float] = None,
               postscale_factor: Optional[float] = None,
               process_set: Optional[ProcessSet] = None):
-    return synchronize(allreduce_async(
+    return _sync_now(allreduce_async(
         tensor, name, op, prescale_factor, postscale_factor, process_set))
 
 
@@ -273,8 +280,10 @@ def grouped_allreduce(tensors: Sequence, name: Optional[str] = None,
                       prescale_factor: Optional[float] = None,
                       postscale_factor: Optional[float] = None,
                       process_set: Optional[ProcessSet] = None):
-    return [synchronize(h) for h in grouped_allreduce_async(
-        tensors, name, op, prescale_factor, postscale_factor, process_set)]
+    handles = grouped_allreduce_async(
+        tensors, name, op, prescale_factor, postscale_factor, process_set)
+    _engine().kick()
+    return [synchronize(h) for h in handles]
 
 
 # ------------------------------------------------------------------ allgather
@@ -289,7 +298,7 @@ def allgather_async(tensor, name: Optional[str] = None,
 
 def allgather(tensor, name: Optional[str] = None,
               process_set: Optional[ProcessSet] = None):
-    return synchronize(allgather_async(tensor, name, process_set))
+    return _sync_now(allgather_async(tensor, name, process_set))
 
 
 # ------------------------------------------------------------------ broadcast
@@ -305,7 +314,7 @@ def broadcast_async(tensor, root_rank: int = 0, name: Optional[str] = None,
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
               process_set: Optional[ProcessSet] = None):
-    return synchronize(broadcast_async(tensor, root_rank, name, process_set))
+    return _sync_now(broadcast_async(tensor, root_rank, name, process_set))
 
 
 def broadcast_pytree(tree, root_rank: int = 0,
@@ -322,6 +331,7 @@ def broadcast_pytree(tree, root_rank: int = 0,
         root_rank=root_rank, name=f"bcast_pytree.{i}",
         process_set=process_set)
         for i, a in enumerate(arrays)]
+    _engine().kick()     # one inline cycle fuses all leaves
     out = [np.asarray(to_local(synchronize(h))) for h in handles]
     out = [o.astype(a.dtype).reshape(a.shape) for o, a in zip(out, arrays)]
     return jax.tree_util.tree_unflatten(treedef, out)
@@ -376,7 +386,7 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
     """Even alltoall returns the gathered rows; with ``splits`` (the ragged
     form, reference ``hvd.alltoall(tensor, splits)``) returns
     ``(output, received_splits)``."""
-    return synchronize(alltoall_async(tensor, splits, name, process_set))
+    return _sync_now(alltoall_async(tensor, splits, name, process_set))
 
 
 def _pad_chunks(x, row, world: int, m: int):
@@ -517,7 +527,9 @@ class _RaggedAlltoallHandle:
         if not self._done:
             eng = _engine()
             if self._h_payload is None:
+                eng.kick()
                 self._start_payload(eng.synchronize(self._h_sizes))
+            eng.kick()
             self._finish(eng.synchronize(self._h_payload))
         return self._result
 
@@ -539,7 +551,7 @@ def reducescatter_async(tensor, name: Optional[str] = None,
 def reducescatter(tensor, name: Optional[str] = None,
                   op: C.ReduceOp = C.ReduceOp.SUM,
                   process_set: Optional[ProcessSet] = None):
-    return synchronize(reducescatter_async(tensor, name, op, process_set))
+    return _sync_now(reducescatter_async(tensor, name, op, process_set))
 
 
 # ------------------------------------------------------------------- control
@@ -561,9 +573,11 @@ def poll(handle) -> bool:
 def barrier(process_set: Optional[ProcessSet] = None):
     """Block until all ranks reach the barrier (reference: hvd.barrier)."""
     ps_id = _ps(process_set)
-    h = _engine().enqueue(_auto_name("barrier", None), CollectiveType.BARRIER,
-                          None, process_set_id=ps_id)
-    return _engine().synchronize(h)
+    eng = _engine()
+    h = eng.enqueue(_auto_name("barrier", None), CollectiveType.BARRIER,
+                    None, process_set_id=ps_id)
+    eng.kick()
+    return eng.synchronize(h)
 
 
 def join(timeout: Optional[float] = None) -> int:

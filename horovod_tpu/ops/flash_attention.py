@@ -308,12 +308,23 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, rep):
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, rep, res, do):
     q, k, v, o, lse = res
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                 # [BH, Tq]
+    return _bwd_impl(q, k, v, do, lse, delta, scale=scale, causal=causal,
+                     block_q=block_q, block_k=block_k, interpret=interpret,
+                     rep=rep)
+
+
+def _bwd_impl(q, k, v, do, lse, delta, *, scale, causal, block_q, block_k,
+              interpret, rep=1):
+    """Flash backward over one (q-shard, kv-shard) pair: q/do [BH, Tq, D],
+    k/v [BK, Tk, D], lse/delta [BH, Tq] (lse may be the GLOBAL logsumexp —
+    that is exactly what makes this reusable as one ring-attention backward
+    step) -> (dq, dk, dv) in the input dtypes."""
     BH, Tq, D = q.shape
     BK = k.shape[0]
     Tk = k.shape[1]
     bq, bk = min(block_q, Tq), min(block_k, Tk)
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                                 # [BH, Tq]
 
     qp, dop = _pad_t(q, bq), _pad_t(do, bq)
     kp, vp = _pad_t(k, bk), _pad_t(v, bk)

@@ -48,8 +48,40 @@ def _block_attn(q, k, v, bias, scale):
 
 
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
-                   scale: Optional[float] = None):
-    """Exact (flash-equivalent) attention over an ``sp``-sharded sequence."""
+                   scale: Optional[float] = None,
+                   use_flash: Optional[bool] = None,
+                   block_q: int = 128, block_k: int = 128,
+                   interpret: Optional[bool] = None):
+    """Exact (flash-equivalent) attention over an ``sp``-sharded sequence.
+
+    q: ``[B, T_loc, H, D]``; k, v: ``[B, T_loc, K, D]`` with ``H % K == 0``
+    — GQA is supported on both paths (the pallas path reads shared kv heads
+    natively, so the ring rotates ``H/K``× less data than a materialized
+    repeat would).
+
+    Two inner engines, same numerics:
+
+    - **Pallas flash** (default on TPU; forced by ``use_flash=True`` or
+      ``HVD_TPU_FLASH=1`` — interpret mode off-TPU): every per-block
+      (o, lse) pair comes from the flash kernels in
+      ``ops/flash_attention.py``; ring steps merge the normalized pairs by
+      logsumexp weighting, and a custom VJP runs the backward ring over the
+      flash backward kernels with the GLOBAL lse (dq rides the rotating
+      tuple back to its owner; dk/dv accumulate where the kv shard lives).
+    - **jnp blockwise** (fallback): the original online-softmax ring.
+    """
+    from ..ops.flash_attention import resolve_flash, _interpret_default
+    if resolve_flash(use_flash):
+        if interpret is None:
+            interpret = _interpret_default()
+        return _ring_flash_bthd(q, k, v, axis_name, causal, scale,
+                                block_q, block_k, interpret)
+    if k.shape[2] != q.shape[2]:
+        # jnp path's accumulator is head-aligned: materialize the GQA
+        # repeat (the pallas path above avoids this).
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
@@ -110,6 +142,154 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     l_ = jnp.transpose(l_acc, (0, 2, 1))[..., None]        # [B,Tq,H,1]
     out = o_acc / jnp.maximum(l_, 1e-30)
     return out.astype(q.dtype)
+
+
+# ------------------------------------------------- pallas-flash ring engine
+def _ring_flash_bthd(q, k, v, axis_name, causal, scale, block_q, block_k,
+                     interpret):
+    """[B, T, H, D] wrapper: flatten heads into the batch dim ([BH, T, D],
+    the flash kernels' layout), run the flash ring core, restore."""
+    B, Tq, H, D = q.shape
+    K = k.shape[2]
+    if v.shape[2] != K or (K != H and H % K):
+        raise ValueError(f"GQA heads mismatch: q={H} k={K} v={v.shape[2]}")
+    rep = H // K
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+
+    def to_bh(x):
+        h = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(B * h, x.shape[1], D)
+
+    o = _ring_flash_core(to_bh(q), to_bh(k), to_bh(v), axis_name, causal,
+                         scale, block_q, block_k, interpret, rep)
+    return o.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+
+
+def _ring_flash_forward(qb, kb, vb, axis_name, causal, scale, block_q,
+                        block_k, interpret, rep):
+    """Forward ring over the flash forward kernel.  Per step the held kv
+    block is one of three STATIC cases (step is a Python int, so the kernel
+    config stays static): step 0 = the causal diagonal; step > 0 = full
+    block when this rank's queries are after the held kv (my >= step),
+    identity otherwise.  Normalized per-block (o, lse) pairs merge by
+    logsumexp weighting.  Returns (o [BH, Tq, D] in q dtype, global lse)."""
+    from ..ops.flash_attention import _fwd_impl
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    BH, Tq, D = qb.shape
+    o_acc = jnp.zeros((BH, Tq, D), jnp.float32)
+    lse_acc = jnp.full((BH, Tq), NEG_INF, jnp.float32)
+    shift = [(i, (i + 1) % n) for i in range(n)]
+
+    kv = (kb, vb)
+    for step in range(n):
+        k_cur, v_cur = kv
+        if step == 0:
+            o_i, lse_i = _fwd_impl(qb, k_cur, v_cur, scale, causal,
+                                   block_q, block_k, interpret, rep)
+            o_i = o_i.astype(jnp.float32)
+        elif causal:
+            def compute(args):
+                q_, k_, v_ = args
+                o_c, l_c = _fwd_impl(q_, k_, v_, scale, False,
+                                     block_q, block_k, interpret, rep)
+                return o_c.astype(jnp.float32), l_c
+
+            def masked(args):
+                # Identity of the (o, lse) merge.
+                return (jnp.zeros((BH, Tq, D), jnp.float32),
+                        jnp.full((BH, Tq), NEG_INF, jnp.float32))
+
+            o_i, lse_i = lax.cond(my < step, masked, compute,
+                                  (qb, k_cur, v_cur))
+        else:
+            o_i, lse_i = _fwd_impl(qb, k_cur, v_cur, scale, False,
+                                   block_q, block_k, interpret, rep)
+            o_i = o_i.astype(jnp.float32)
+        lse_new = jnp.logaddexp(lse_acc, lse_i)
+        a = jnp.exp(lse_acc - lse_new)[..., None]
+        b = jnp.exp(lse_i - lse_new)[..., None]
+        o_acc = o_acc * a + o_i * b
+        lse_acc = lse_new
+        if step != n - 1:
+            kv = (lax.ppermute(k_cur, axis_name, perm=shift),
+                  lax.ppermute(v_cur, axis_name, perm=shift))
+    return o_acc.astype(qb.dtype), lse_acc
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _ring_flash_core(qb, kb, vb, axis_name, causal, scale, block_q, block_k,
+                     interpret, rep):
+    o, _ = _ring_flash_forward(qb, kb, vb, axis_name, causal, scale,
+                               block_q, block_k, interpret, rep)
+    return o
+
+
+def _ring_flash_fwd_rule(qb, kb, vb, axis_name, causal, scale, block_q,
+                         block_k, interpret, rep):
+    o, lse = _ring_flash_forward(qb, kb, vb, axis_name, causal, scale,
+                                 block_q, block_k, interpret, rep)
+    return o, (qb, kb, vb, o, lse)
+
+
+def _ring_flash_bwd_rule(axis_name, causal, scale, block_q, block_k,
+                         interpret, rep, res, do):
+    """Backward ring: kv (and its dk/dv accumulators) stay put; the tuple
+    (q, do, lse, delta, dq) rotates.  At step t the held q belongs to rank
+    ``(my - t) % n``; with causal masking it attends this rank's kv iff
+    my < t (plus the t = 0 diagonal).  Every step uses the flash backward
+    kernels with the GLOBAL lse/delta, so per-pair contributions are exact;
+    after n rotations the dq accumulator arrives back at its owner."""
+    from ..ops.flash_attention import _bwd_impl
+    qb, kb, vb, o, lse = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    BH, Tq, D = qb.shape
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    shift = [(i, (i + 1) % n) for i in range(n)]
+
+    dk_acc = jnp.zeros(kb.shape, jnp.float32)
+    dv_acc = jnp.zeros(vb.shape, jnp.float32)
+    rot = (qb, do, lse, delta, jnp.zeros((BH, Tq, D), jnp.float32))
+    for t in range(n):
+        q_t, do_t, lse_t, delta_t, dq_t = rot
+        if t == 0:
+            dq_i, dk_i, dv_i = _bwd_impl(
+                q_t, kb, vb, do_t, lse_t, delta_t, scale=scale,
+                causal=causal, block_q=block_q, block_k=block_k,
+                interpret=interpret, rep=rep)
+        elif causal:
+            def compute(args):
+                q_, do_, lse_, delta_ = args
+                return _bwd_impl(q_, kb, vb, do_, lse_, delta_, scale=scale,
+                                 causal=False, block_q=block_q,
+                                 block_k=block_k, interpret=interpret,
+                                 rep=rep)
+
+            def skip(args):
+                return (jnp.zeros((BH, Tq, D), qb.dtype),
+                        jnp.zeros(kb.shape, kb.dtype),
+                        jnp.zeros(vb.shape, vb.dtype))
+
+            dq_i, dk_i, dv_i = lax.cond(my < t, compute, skip,
+                                        (q_t, do_t, lse_t, delta_t))
+        else:
+            dq_i, dk_i, dv_i = _bwd_impl(
+                q_t, kb, vb, do_t, lse_t, delta_t, scale=scale,
+                causal=False, block_q=block_q, block_k=block_k,
+                interpret=interpret, rep=rep)
+        dk_acc = dk_acc + dk_i.astype(jnp.float32)
+        dv_acc = dv_acc + dv_i.astype(jnp.float32)
+        rot = (q_t, do_t, lse_t, delta_t, dq_t + dq_i.astype(jnp.float32))
+        # Rotate every step (including the last) so each tuple lands back
+        # on its owner after n hops.
+        rot = tuple(lax.ppermute(x, axis_name, perm=shift) for x in rot)
+    dq_home = rot[4]
+    return (dq_home.astype(qb.dtype), dk_acc.astype(kb.dtype),
+            dv_acc.astype(vb.dtype))
+
+
+_ring_flash_core.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
 
 
 def local_flash_attention(q, k, v, causal: bool = False,

@@ -192,9 +192,18 @@ def platform_worker_env(base: Optional[Dict[str, str]] = None) -> Dict[str, str]
         # backend at interpreter start, which forecloses jax.distributed in
         # CPU workers — drop them from the workers' PYTHONPATH.
         if "PYTHONPATH" in base:
+            def _is_site_hook(p: str) -> bool:
+                # Precise match: only drop entries whose final path component
+                # is a TPU site-hook dir, or that actually ship a
+                # sitecustomize.py — never unrelated user paths that merely
+                # contain the substring (e.g. /home/maxon/lib).
+                comp = os.path.basename(os.path.normpath(p))
+                if comp in ("axon", ".axon_site"):
+                    return True
+                return os.path.isfile(os.path.join(p, "sitecustomize.py"))
             out["PYTHONPATH"] = os.pathsep.join(
                 p for p in base["PYTHONPATH"].split(os.pathsep)
-                if p and "axon" not in p)
+                if p and not _is_site_hook(p))
     return out
 
 

@@ -170,6 +170,22 @@ def main():
     np.testing.assert_allclose(np.asarray(u2["w"]),
                                np.full((3,), expected), rtol=1e-6)
 
+    # DistributedOptimizer over a SUBSET process set, eagerly: members
+    # average over the set only (advisor r3: _reduce dropped process_set,
+    # reducing over the global world and hanging non-members).
+    if size >= 3:
+        ps_opt = ps  # the [0, 1] set registered above
+        if ps_opt.included(rank):
+            opt3 = hvd.DistributedOptimizer(optax.sgd(1.0),
+                                            process_set=ps_opt)
+            st3 = opt3.init(params)
+            g3 = {"w": np.full((3,), float(rank + 1), np.float32)}
+            u3, st3 = opt3.update(g3, st3, params)
+            # mean over ranks {0,1} = (1+2)/2, NOT over the full world.
+            np.testing.assert_allclose(np.asarray(u3["w"]),
+                                       np.full((3,), -1.5), rtol=1e-6)
+        hvd.barrier()
+
     print(f"WORKER_OK rank={rank}")
     hvd.shutdown()
 

@@ -26,10 +26,10 @@ def _data(cfg, batch=8, seq=16, seed=0):
     return jnp.asarray(tokens), jnp.asarray(targets)
 
 
-def _reference_run(steps=2, batch=8, seq=16):
+def _reference_run(steps=2, batch=8, seq=16, n_layers=2):
     """Unsharded single-device ground truth (all axes disabled, f32)."""
-    cfg = llama.tiny(dtype=jnp.float32, dp_axis=None, tp_axis=None,
-                     sp_axis=None)
+    cfg = llama.tiny(dtype=jnp.float32, n_layers=n_layers, dp_axis=None,
+                     tp_axis=None, sp_axis=None)
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     opt = optax.sgd(0.1)
     opt_state = opt.init(params)
@@ -72,6 +72,56 @@ def test_sharded_matches_reference(tp, sp):
         jax.tree_util.tree_map(np.asarray, params))
     for a, b in zip(out_leaves, ref_leaves):
         np.testing.assert_allclose(a, np.asarray(b), rtol=3e-3, atol=3e-5)
+
+
+@pytest.mark.parametrize("pp,tp,sp,n_micro", [
+    (2, 1, 1, 2),   # pure pp
+    (2, 1, 1, 4),   # more microbatches than stages
+    (4, 1, 1, 2),   # deeper pipeline (stage = 1-layer slab with 4 layers)
+    (2, 2, 1, 2),   # pp × tp
+    (2, 1, 2, 2),   # pp × sp (ring attention inside a pipeline stage)
+])
+def test_pipeline_matches_reference(pp, tp, sp, n_micro):
+    """pp=k training ≡ unsharded reference: stacked layer slabs over the pp
+    axis, GPipe schedule, grads reassembled by sync_grads (VERDICT r3 weak
+    #5a: pipeline parallelism must compose with the flagship model)."""
+    n_layers = 4 if pp == 4 else 2
+    # batch 16: per-shard batch stays divisible by n_micro at every dp size.
+    ref_losses, ref_params = _reference_run(n_layers=n_layers, batch=16)
+
+    cfg = llama.tiny(dtype=jnp.float32, n_layers=n_layers,
+                     pp_axis="pp", n_microbatches=n_micro)
+    mesh = infer_mesh(8, tp=tp, sp=sp, pp=pp)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    pspecs = llama.param_specs(cfg)
+    opt = optax.sgd(0.1)
+    opt_state = opt.init(params)
+    os_specs = spmd.infer_specs_like(opt_state, params, pspecs)
+    # Batch over dp/ep only — every pipeline stage sees the same tokens.
+    data_spec = P(("dp", "ep"), "sp")
+
+    step = spmd.make_sharded_train_step(
+        llama.make_train_step(cfg, opt), mesh, pspecs, os_specs, data_spec)
+
+    params = spmd.shard_params(params, pspecs, mesh)
+    tokens, targets = _data(cfg, batch=16)
+    losses = []
+    for _ in range(2):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        losses.append(float(loss))
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4)
+    # Stacked slab layout vs the reference's per-layer list: compare
+    # layer-by-layer through the stack axis.
+    stacked = jax.tree_util.tree_map(np.asarray, params)
+    for i, ref_layer in enumerate(ref_params["layers"]):
+        for k, ref_w in ref_layer.items():
+            np.testing.assert_allclose(
+                stacked["layers"][k][i], np.asarray(ref_w),
+                rtol=3e-3, atol=3e-5, err_msg=f"layer {i} {k}")
+    for k in ("embed", "final_norm", "lm_head"):
+        np.testing.assert_allclose(stacked[k], np.asarray(ref_params[k]),
+                                   rtol=3e-3, atol=3e-5, err_msg=k)
 
 
 def test_entry_forward_single_device():
