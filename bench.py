@@ -39,8 +39,11 @@ attempts" — distinguishable from "bench slow" — within minutes per attempt,
 never a silent 900s burn.
 
 Env overrides: HVD_BENCH_BATCH, HVD_BENCH_STEPS, HVD_BENCH_IMAGE,
-HVD_BENCH_SIZES_MB (comma list), HVD_BENCH_MODEL=resnet50|llama|bert,
-HVD_BENCH_SKIP_RAW=1, HVD_BENCH_SKIP_BUSBW=1, HVD_BENCH_MINIMAL=1,
+HVD_BENCH_SIZES_MB (comma list),
+HVD_BENCH_MODEL=resnet50|llama|bert|tf_step,
+HVD_BENCH_SKIP_RAW=1, HVD_BENCH_SKIP_BUSBW=1, HVD_BENCH_SKIP_AUTOTUNE=1,
+HVD_BENCH_AUTOTUNE_STEPS, HVD_BENCH_BATCH_SWEEP (comma list of per-chip
+batches, each recorded with img/s + HBM memory analysis), HVD_BENCH_MINIMAL=1,
 HVD_BENCH_RETRIES, HVD_BENCH_RETRY_DELAY_S, HVD_BENCH_TIMEOUT_S (total
 budget), HVD_BENCH_PROBE_TIMEOUT_S (per probe attempt, default 240),
 HVD_BENCH_SKIP_PROBE=1.
@@ -313,7 +316,7 @@ def _timed_steps(step, state, data, steps, section=None, **extra):
 def _compile_with_flops(step, state, data):
     """AOT-compile once (with retry — the big first compile is the call
     most exposed to compile-service outages); return (callable, per-device
-    FLOPs or None)."""
+    FLOPs or None, memory-analysis dict or None)."""
     params, stats, opt_state = state
     x, y = data
     try:
@@ -321,7 +324,7 @@ def _compile_with_flops(step, state, data):
             lambda: step.lower(params, stats, opt_state, x, y).compile(),
             "resnet compile")
     except Exception:
-        return step, None
+        return step, None, None
     try:
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
@@ -329,7 +332,18 @@ def _compile_with_flops(step, state, data):
         flops = float(cost.get("flops", 0.0)) or None
     except Exception:
         flops = None
-    return compiled, flops
+    # HBM footprint of the executable: the first-class suspect for "bigger
+    # batch is slower" (VERDICT r3 weak #2 — batch 256 < batch 128 img/s:
+    # if temp bytes approach chip HBM, XLA spills/remats).
+    try:
+        m = compiled.memory_analysis()
+        mem = {k: int(getattr(m, k)) for k in
+               ("temp_size_in_bytes", "argument_size_in_bytes",
+                "output_size_in_bytes", "generated_code_size_in_bytes")
+               if hasattr(m, k)}
+    except Exception:
+        mem = None
+    return compiled, flops, mem
 
 
 def bench_resnet(batch, steps, image_size, errors):
@@ -347,7 +361,9 @@ def bench_resnet(batch, steps, image_size, errors):
     ips = mfu = overhead = raw_ips = None
     try:
         step, state, data = _resnet_pieces(batch, image_size, framework=True)
-        step, flops = _compile_with_flops(step, state, data)
+        step, flops, mem = _compile_with_flops(step, state, data)
+        if mem:
+            _TIMING["resnet_memory"] = mem
         dt = _timed_steps(step, state, data, steps, "resnet_framework",
                           global_batch=batch, per_device_flops=flops)
         ips = batch * steps / dt
@@ -469,6 +485,187 @@ def bench_bert(batch, steps):
     _record_timing("bert", warmup=2, iters=steps, wall_s=dt,
                    global_batch=batch, seq=seq)
     return batch * seq * steps / dt
+
+
+def bench_autotune():
+    """Exercise the reference-N9 parameter manager on a real gradient
+    workload and record what it buys (VERDICT r3 ask #8).
+
+    Drives the EAGER engine path (the thing fusion-threshold/cycle-time
+    tuning affects): each step submits the full ResNet-50 per-parameter
+    gradient set as async grouped allreduces and waits — the reference's
+    hook→background-thread regime.  Measures steps/s with default knobs,
+    then re-initializes with ``HOROVOD_AUTOTUNE=1``, runs until the search
+    converges, and measures again.  Returns a dict with the converged
+    (fusion_threshold, cycle_time) and the throughput delta.
+    """
+    import jax
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import eager as _eager
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        from horovod_tpu.models import resnet
+        cfg = resnet.ResNetConfig(depth=50, num_classes=1000,
+                                  sync_bn_axis=None)
+        params, stats = resnet.init_params(cfg, jax.random.PRNGKey(0))
+        shapes = [tuple(l.shape) for l in jax.tree_util.tree_leaves(params)]
+        del params, stats
+    else:
+        # CPU tier: a small synthetic size mix (replicating 25M params
+        # across 8 virtual ranks on one core is all collective, no signal).
+        rng0 = np.random.RandomState(0)
+        shapes = [tuple(int(x) for x in rng0.randint(8, 96, size=2))
+                  for _ in range(24)]
+
+    def make_inputs():
+        if _eager.per_process_mode():
+            return [np.ones(s, np.float32) for s in shapes]
+        return [hvd.to_global(np.ones((hvd.size(),) + s, np.float32))
+                for s in shapes]
+
+    def steps_per_s(tensors, n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            hs = hvd.grouped_allreduce_async(tensors, name="autotune_bench",
+                                             op=hvd.Sum)
+            hvd.synchronize(hs)
+        return n / (time.perf_counter() - t0)
+
+    if os.environ.get("HOROVOD_AUTOTUNE", "") == "1":
+        # The whole bench was launched tuned: a default-vs-tuned delta is
+        # unmeasurable (the "default" engine is already autotuning), and
+        # the user's opt-in must survive this section untouched.
+        return {"skipped": "HOROVOD_AUTOTUNE=1 was set for the whole run; "
+                           "no default-knob baseline exists to compare"}
+
+    n = int(os.environ.get("HVD_BENCH_AUTOTUNE_STEPS",
+                           "30" if on_tpu else "15"))
+    tensors = make_inputs()
+    steps_per_s(tensors, 3)                      # warm the program cache
+    base = steps_per_s(tensors, n)
+
+    # Fresh engine with the tuner on; bounded so the section stays minutes.
+    hvd.shutdown()
+    knob_keys = ("HOROVOD_AUTOTUNE", "HOROVOD_AUTOTUNE_WARMUP_SAMPLES",
+                 "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE",
+                 "HOROVOD_AUTOTUNE_MAX_EVALS")
+    saved = {k: os.environ.get(k) for k in knob_keys}
+    os.environ["HOROVOD_AUTOTUNE"] = "1"
+    os.environ.setdefault("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "1")
+    os.environ.setdefault("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "4")
+    os.environ.setdefault("HOROVOD_AUTOTUNE_MAX_EVALS", "16")
+    try:
+        hvd.init()
+        from horovod_tpu.common.basics import _get_state
+        eng = _get_state().engine
+        tensors = make_inputs()
+        for _ in range(400):                     # converge (bounded)
+            hs = hvd.grouped_allreduce_async(tensors, name="autotune_bench",
+                                             op=hvd.Sum)
+            hvd.synchronize(hs)
+            if eng.autotuner is None or not eng.autotuner.tuning:
+                break
+        tuned = steps_per_s(tensors, n)
+        return {
+            "converged": eng.autotuner is not None
+                         and not eng.autotuner.tuning,
+            "fusion_threshold_bytes": int(eng.fusion_threshold),
+            "cycle_time_s": round(float(eng.cycle_time_s), 6),
+            "steps_per_s_default": round(base, 2),
+            "steps_per_s_tuned": round(tuned, 2),
+            "speedup": round(tuned / base, 3) if base else None,
+            "n_tensors": len(shapes),
+        }
+    finally:
+        # Restore the pre-section env verbatim and a default-knob engine
+        # for any later section.
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        hvd.shutdown()
+        hvd.init()
+
+
+def bench_tf_step(steps):
+    """Per-step host cost of the TF binding (VERDICT r3 missing #3).
+
+    The reference's TF shim is an async C++ kernel with no per-step Python
+    round-trip (``horovod/tensorflow/mpi_ops.cc`` — SURVEY N27); this
+    repo's binding crosses TF-graph → ``tf.py_function`` → numpy → engine
+    once per compiled step.  Measures a compiled ``tf.function`` train
+    step on a ~600k-param MLP through ``hvd.DistributedOptimizer``
+    (py_function + ONE grouped engine allreduce) vs the identical step on
+    the plain optimizer (no hvd anywhere), same process.  Returns
+    ``(hvd_ms, plain_ms, overhead_pct, grouped_ms)`` — per-step wall
+    times, the binding's cost as a percentage of the plain step, and the
+    same gradient set through the eager grouped allreduce alone (isolating
+    the collective+bridge from the py_function boundary).
+    """
+    import tensorflow as tf
+    import numpy as np
+    import horovod_tpu.tensorflow as hvdtf
+
+    tf.random.set_seed(0)
+    rng = np.random.RandomState(0)
+    x = tf.constant(rng.randn(256, 512).astype(np.float32))
+    y = tf.constant(rng.randint(0, 10, 256).astype(np.int64))
+    loss_obj = tf.keras.losses.SparseCategoricalCrossentropy(
+        from_logits=True)
+
+    def build():
+        return tf.keras.Sequential([
+            tf.keras.layers.Input((512,)),
+            tf.keras.layers.Dense(512, activation="relu"),
+            tf.keras.layers.Dense(512, activation="relu"),
+            tf.keras.layers.Dense(10),
+        ])
+
+    def timed(model, opt):
+        @tf.function
+        def step(x, y):
+            with tf.GradientTape() as tape:
+                loss = loss_obj(y, model(x, training=True))
+            grads = tape.gradient(loss, model.trainable_variables)
+            opt.apply_gradients(zip(grads, model.trainable_variables))
+            return loss
+
+        for _ in range(3):
+            step(x, y)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            step(x, y)
+        return (time.perf_counter() - t0) / steps
+
+    plain = timed(build(), tf.keras.optimizers.SGD(0.01))
+    hvd_opt = hvdtf.DistributedOptimizer(tf.keras.optimizers.SGD(0.01))
+    hvd = timed(build(), hvd_opt)
+    overhead = 100.0 * (hvd / plain - 1.0)
+
+    # Isolate the pieces: the same gradient set through the binding's
+    # eager grouped allreduce (tf→numpy bridge + ONE fused engine
+    # collective, no py_function boundary).  hvd − plain − grouped ≈ the
+    # tf.function/py_function crossing itself.
+    model = build()
+    with tf.GradientTape() as tape:
+        loss = loss_obj(y, model(x, training=True))
+    grads = tape.gradient(loss, model.trainable_variables)
+    for _ in range(3):
+        hvdtf.grouped_allreduce(grads, name="tf_step_iso")
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        hvdtf.grouped_allreduce(grads, name="tf_step_iso")
+    grouped = (time.perf_counter() - t0) / steps
+
+    _record_timing("tf_step_hvd", warmup=3, iters=steps, wall_s=hvd * steps)
+    _record_timing("tf_step_plain", warmup=3, iters=steps,
+                   wall_s=plain * steps)
+    _record_timing("tf_step_grouped_allreduce", warmup=3, iters=steps,
+                   wall_s=grouped * steps)
+    return hvd * 1e3, plain * 1e3, overhead, grouped * 1e3
 
 
 def _emit(out, rank):
@@ -629,6 +826,25 @@ def _run(out, errors):
             errors["llama"] = repr(exc)
         return
 
+    if model == "tf_step":
+        out.update({"metric": "tf_binding_step_overhead_pct",
+                    "value": None, "unit": "%",
+                    "vs_baseline": None,
+                    "vs_baseline_def": "hvd-step ms ÷ plain-step ms "
+                                       "(1.0 = free binding)"})
+        try:
+            hvd_ms, plain_ms, overhead, grouped_ms = bench_tf_step(steps)
+            out.update({"value": round(overhead, 2),
+                        "tf_step_hvd_ms": round(hvd_ms, 3),
+                        "tf_step_plain_ms": round(plain_ms, 3),
+                        "tf_grouped_allreduce_ms": round(grouped_ms, 3),
+                        "tf_pyfunc_boundary_ms": round(
+                            max(0.0, hvd_ms - plain_ms - grouped_ms), 3),
+                        "vs_baseline": round(hvd_ms / plain_ms, 3)})
+        except Exception as exc:  # noqa: BLE001 - contained like the rest
+            errors["tf_step"] = repr(exc)
+        return
+
     if model == "bert":
         out.update({"metric": "bert_mlm_framework_tokens_per_sec_per_chip",
                     "value": None, "unit": "tokens/sec",
@@ -649,7 +865,43 @@ def _run(out, errors):
             errors["busbw"] = repr(exc)
     out["allreduce_busbw_GBps"] = busbw
 
+    if os.environ.get("HVD_BENCH_SKIP_AUTOTUNE", "") != "1":
+        try:
+            out["autotune"] = bench_autotune()
+        except Exception as exc:  # noqa: BLE001 - contained
+            errors["autotune"] = repr(exc)
+
     ips, mfu, overhead, raw_ips = bench_resnet(batch, steps, image, errors)
+
+    # Optional per-chip batch sweep (diagnosing the batch-vs-throughput
+    # curve, e.g. r03's batch-256 regression): framework path only, each
+    # batch recorded with its own memory analysis in timing_evidence.
+    sweep = os.environ.get("HVD_BENCH_BATCH_SWEEP", "")
+    if sweep:
+        world = max(1, hvd.size())
+        out["batch_sweep"] = {}
+        for tok in [s for s in sweep.split(",") if s]:
+            try:
+                pb = int(tok)  # inside the try: a bad token must not void
+                gbatch = pb * world  # the already-measured headline value
+                step_f, state_f, data_f = _resnet_pieces(gbatch, image,
+                                                         framework=True)
+                step_f, flops_f, mem_f = _compile_with_flops(step_f, state_f,
+                                                             data_f)
+                if mem_f:
+                    _TIMING[f"resnet_memory_b{pb}"] = mem_f
+                dt_f = _timed_steps(step_f, state_f, data_f, steps,
+                                    f"resnet_sweep_b{pb}",
+                                    global_batch=gbatch)
+                rec = {"images_per_sec_per_chip":
+                       round(gbatch * steps / dt_f / world, 2)}
+                peak = _peak_flops()
+                if flops_f and peak:
+                    rec["mfu_pct"] = round(
+                        100.0 * flops_f * steps / dt_f / peak, 2)
+                out["batch_sweep"][str(pb)] = rec
+            except Exception as exc:  # noqa: BLE001 - keep sweeping
+                errors[f"batch_sweep_{tok}"] = repr(exc)
 
     world = max(1, hvd.size())
     per_chip_ips = round(ips / world, 2) if ips is not None else None
