@@ -58,6 +58,10 @@ class LlamaConfig:
     # Microbatches for the pipeline fill/drain (bubble = (pp-1)/(pp+M-1));
     # the per-shard batch must divide by it.  Ignored without pp_axis.
     n_microbatches: int = 2
+    # Rematerialize each pipeline stage's forward in the backward scan
+    # (jax.checkpoint): activation memory stops scaling with stage depth —
+    # the 1F1B memory dividend, XLA-style (see parallel/pipeline.py).
+    remat_stages: bool = False
     # Pallas flash attention: True/False, or None = resolve from the
     # HVD_TPU_FLASH env var at TRACE time (auto: on when running on TPU).
     # The env var is not part of any jit cache key — to toggle after a
@@ -254,7 +258,8 @@ def forward(params, tokens, cfg: LlamaConfig):
             return h
 
         x = pipeline_apply(stage_fn, params["layers"], micro_x,
-                           axis_name=cfg.pp_axis, broadcast_out=True)
+                           axis_name=cfg.pp_axis, broadcast_out=True,
+                           remat=cfg.remat_stages)
         x = x.reshape((B, T, -1))
     else:
         for p in params["layers"]:

@@ -40,7 +40,8 @@ def stage_index(axis_name: str = "pp"):
 
 def pipeline_apply(fn: Callable, stage_params, micro_x,
                    axis_name: str = "pp",
-                   broadcast_out: bool = False):
+                   broadcast_out: bool = False,
+                   remat: bool = False):
     """Run microbatches through the stage pipeline.
 
     fn: ``(stage_params, x[mb, ...]) -> y[mb, ...]`` (shape-preserving);
@@ -51,7 +52,18 @@ def pipeline_apply(fn: Callable, stage_params, micro_x,
     elsewhere) unless ``broadcast_out``, which broadcasts them to every
     stage with one psum (exact because every non-last stage holds zeros;
     a schedule that leaves real data on other stages must not reuse it).
+
+    ``remat=True`` wraps the stage in ``jax.checkpoint``: the backward
+    scan recomputes each tick's stage forward from its carry instead of
+    storing every tick's intermediates — activation memory drops from
+    O(ticks · stage_depth) to O(ticks) carries + one stage recompute.
+    This is the memory dividend 1F1B buys on imperative runtimes; under
+    XLA's scan transpose (which already interleaves each tick's backward
+    with its recompute, 1F1B-style) remat is the idiomatic lever, so a
+    literal hand-scheduled 1F1B variant is deliberately not implemented.
     """
+    if remat:
+        fn = jax.checkpoint(fn)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     m_total = micro_x.shape[0]

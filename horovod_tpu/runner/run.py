@@ -110,6 +110,28 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "script (elastic mode; URL override via "
                         "HOROVOD_TPU_METADATA_URL)")
     p.add_argument("--slots-per-host", type=int, default=None)
+    # Cluster-scheduler backends (reference P7 ships jsrun/mpirun backends;
+    # the TPU equivalents live in runner/tpu_vm.py).
+    p.add_argument("--tpu", default=None,
+                   help="Launch over a (multi-host) TPU-VM slice: broadcast "
+                        "the command to every worker via gcloud tpu-vm ssh")
+    p.add_argument("--zone", default=None, help="GCE zone of --tpu")
+    p.add_argument("--project", default=None, help="GCP project of --tpu")
+    p.add_argument("--gke-jobset", default=None,
+                   help="Render a TPU-on-GKE JobSet manifest for this "
+                        "command (xpk pattern) instead of launching")
+    p.add_argument("--container-image", default=None,
+                   help="Container image for --gke-jobset")
+    p.add_argument("--gke-num-hosts", type=int, default=None,
+                   help="Hosts in the GKE slice (with --gke-jobset)")
+    p.add_argument("--gke-accelerator", default=None,
+                   help="gke-tpu-accelerator node selector, e.g. "
+                        "tpu-v5p-slice / tpu-v5-lite-podslice")
+    p.add_argument("--gke-topology", default=None,
+                   help="gke-tpu-topology node selector, e.g. 2x2x2 (v4/"
+                        "v5p are 3-D) or 4x4 (v5e/v6e)")
+    p.add_argument("--gke-chips-per-host", type=int, default=None,
+                   help="google.com/tpu resource limit per pod (default 4)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="Training command")
     args = p.parse_args(list(argv))
@@ -120,11 +142,21 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
         p.error("no training command given")
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
+    if args.tpu and not args.zone:
+        p.error("--tpu requires --zone")
+    if args.gke_jobset and not (args.container_image and args.gke_num_hosts
+                                and args.gke_accelerator
+                                and args.gke_topology):
+        p.error("--gke-jobset requires --container-image, --gke-num-hosts, "
+                "--gke-accelerator and --gke-topology (topologies are "
+                "generation-specific; this launcher will not guess)")
     elastic = (args.host_discovery_script is not None
                or args.tpu_metadata_discovery)
-    if args.np is None and not elastic:
+    if args.np is None and not elastic and not args.tpu \
+            and not args.gke_jobset:
         p.error("-np is required (or elastic --host-discovery-script / "
-                "--tpu-metadata-discovery)")
+                "--tpu-metadata-discovery, or a cluster backend "
+                "--tpu/--gke-jobset)")
     return args
 
 
@@ -207,6 +239,47 @@ def platform_worker_env(base: Optional[Dict[str, str]] = None) -> Dict[str, str]
     return out
 
 
+def tuning_env(args) -> Dict[str, str]:
+    """HOROVOD_* env derived from the launcher's tuning flags — shared by
+    every backend (local/ssh here, TPU-VM/GKE in tpu_vm.py) so a knob can
+    never work on one launch path and silently vanish on another."""
+    env: Dict[str, str] = {}
+    for flag, var, scale in (
+            ("fusion_threshold_mb", "HOROVOD_FUSION_THRESHOLD", 1024 * 1024),
+            ("cycle_time_ms", "HOROVOD_CYCLE_TIME", 1),
+            ("cache_capacity", "HOROVOD_CACHE_CAPACITY", 1),
+            ("stall_check_time", "HOROVOD_STALL_CHECK_TIME", 1),
+            ("stall_shutdown_time", "HOROVOD_STALL_SHUTDOWN_TIME", 1)):
+        val = getattr(args, flag, None)
+        if val is not None:
+            env[var] = str(int(val * scale) if scale != 1 else val)
+    if getattr(args, "timeline_mark_cycles", False):
+        env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
+    if getattr(args, "autotune", False):
+        env["HOROVOD_AUTOTUNE"] = "1"
+        if getattr(args, "autotune_log_file", None):
+            env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
+    if getattr(args, "hierarchical_allreduce", False):
+        env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    return env
+
+
+def wait_and_reap(procs: List[subprocess.Popen]) -> int:
+    """Wait for every worker, propagate the first failure, terminate
+    stragglers (shared by the local/ssh and TPU-VM backends)."""
+    rc = 0
+    try:
+        for p in procs:
+            p.wait()
+            if p.returncode != 0 and rc == 0:
+                rc = p.returncode
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+    return rc
+
+
 def worker_envs(args, hosts: List[HostSpec],
                 coordinator: Tuple[str, int, int]) -> List[Dict[str, str]]:
     """Compute the per-rank env injection (reference §3.3: HOROVOD_RANK,
@@ -231,25 +304,9 @@ def worker_envs(args, hosts: List[HostSpec],
                 "HOROVOD_CONTROLLER_PORT2": str(coordinator[2]),
                 "HOROVOD_HOSTNAME": h.hostname,
             }
-            for flag, var, scale in (
-                    ("fusion_threshold_mb", "HOROVOD_FUSION_THRESHOLD", 1024 * 1024),
-                    ("cycle_time_ms", "HOROVOD_CYCLE_TIME", 1),
-                    ("cache_capacity", "HOROVOD_CACHE_CAPACITY", 1),
-                    ("stall_check_time", "HOROVOD_STALL_CHECK_TIME", 1),
-                    ("stall_shutdown_time", "HOROVOD_STALL_SHUTDOWN_TIME", 1)):
-                val = getattr(args, flag)
-                if val is not None:
-                    env[var] = str(int(val * scale) if scale != 1 else val)
+            env |= tuning_env(args)
             if args.timeline_filename:
                 env["HOROVOD_TIMELINE"] = f"{args.timeline_filename}.{rank}"
-            if args.timeline_mark_cycles:
-                env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
-            if args.autotune:
-                env["HOROVOD_AUTOTUNE"] = "1"
-                if args.autotune_log_file:
-                    env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
-            if args.hierarchical_allreduce:
-                env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
             envs.append(env)
             rank += 1
     return envs
@@ -307,21 +364,18 @@ def launch_workers(args, hosts: List[HostSpec],
             proc = subprocess.Popen(cmd, env=os.environ.copy(),
                                     stdout=stdout, stderr=stderr)
         procs.append(proc)
-    rc = 0
-    try:
-        for p in procs:
-            p.wait()
-            if p.returncode != 0 and rc == 0:
-                rc = p.returncode
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
-    return rc
+    return wait_and_reap(procs)
 
 
 def main(argv: Sequence[str]) -> int:
     args = parse_args(argv)
+    if args.gke_jobset:
+        from .tpu_vm import render_gke_jobset
+        sys.stdout.write(render_gke_jobset(args, args.gke_num_hosts))
+        return 0
+    if args.tpu:
+        from .tpu_vm import run_tpu_vm
+        return run_tpu_vm(args)
     if (args.host_discovery_script is not None
             or getattr(args, "tpu_metadata_discovery", False)):
         from ..elastic.driver import run_elastic

@@ -124,6 +124,32 @@ def test_pipeline_matches_reference(pp, tp, sp, n_micro):
                                    rtol=3e-3, atol=3e-5, err_msg=k)
 
 
+def test_pipeline_remat_matches_reference():
+    """remat_stages=True (jax.checkpoint around each stage) must be
+    numerics-identical to the stored-activation pipeline AND the unsharded
+    reference — remat changes memory, never math."""
+    ref_losses, ref_params = _reference_run(batch=16)
+
+    cfg = llama.tiny(dtype=jnp.float32, pp_axis="pp", n_microbatches=2,
+                     remat_stages=True)
+    mesh = infer_mesh(8, pp=2)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    pspecs = llama.param_specs(cfg)
+    opt = optax.sgd(0.1)
+    opt_state = opt.init(params)
+    os_specs = spmd.infer_specs_like(opt_state, params, pspecs)
+    step = spmd.make_sharded_train_step(
+        llama.make_train_step(cfg, opt), mesh, pspecs, os_specs,
+        P(("dp", "ep"), "sp"))
+    params = spmd.shard_params(params, pspecs, mesh)
+    tokens, targets = _data(cfg, batch=16)
+    losses = []
+    for _ in range(2):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4)
+
+
 def test_entry_forward_single_device():
     """Single-chip jittable forward (the __graft_entry__ contract)."""
     cfg = llama.tiny(dtype=jnp.float32, dp_axis=None, tp_axis=None,

@@ -240,3 +240,160 @@ class TestBootstrap:
         finally:
             svc.close()
         t.join(timeout=15)
+
+
+class TestTPUVMBackend:
+    """Cluster-scheduler backends (reference P7's jsrun/mpirun analogues):
+    tested by asserting on the GENERATED commands/manifests, no cluster
+    needed — the reference's own test_run.py pattern."""
+
+    def _describe_json(self, n=4):
+        import json
+        return json.dumps({
+            "networkEndpoints": [{"ipAddress": f"10.0.0.{i + 1}"}
+                                 for i in range(n)],
+            "state": "READY"})
+
+    def _fake_runner(self, n=4):
+        import subprocess
+
+        calls = []
+
+        def runner(cmd, **kw):
+            calls.append(cmd)
+            return subprocess.CompletedProcess(cmd, 0,
+                                               stdout=self._describe_json(n),
+                                               stderr="")
+        return runner, calls
+
+    def test_describe_and_ssh_commands(self):
+        from horovod_tpu.runner.run import parse_args
+        from horovod_tpu.runner import tpu_vm
+
+        runner, calls = self._fake_runner(n=4)
+        args = parse_args(["--tpu", "myslice", "--zone", "us-central2-b",
+                           "--project", "proj", "python", "train.py"])
+        eps = tpu_vm.describe_tpu(args.tpu, args.zone, args.project,
+                                  runner=runner)
+        assert [e.internal_ip for e in eps] == [
+            "10.0.0.1", "10.0.0.2", "10.0.0.3", "10.0.0.4"]
+        assert calls[0][:6] == ["gcloud", "compute", "tpus", "tpu-vm",
+                                "describe", "myslice"]
+
+        cmds = tpu_vm.tpu_vm_ssh_commands(args, eps, ports=(29400, 29401))
+        assert len(cmds) == 4
+        for wid, cmd in enumerate(cmds):
+            assert cmd[:6] == ["gcloud", "compute", "tpus", "tpu-vm",
+                               "ssh", "myslice"]
+            assert ["--worker", str(wid)] == cmd[cmd.index("--worker"):
+                                                 cmd.index("--worker") + 2]
+            remote = cmd[cmd.index("--command") + 1]
+            # Rank layout: worker index is the cross rank; coordinator is
+            # worker 0's internal IP on every worker.
+            assert f"HOROVOD_RANK={wid}" in remote
+            assert "HOROVOD_SIZE=4" in remote
+            assert f"HOROVOD_CROSS_RANK={wid}" in remote
+            assert "HOROVOD_CONTROLLER_ADDR=10.0.0.1" in remote
+            assert remote.endswith("python train.py")
+            assert ["--project", "proj"] == cmd[-2:]
+
+    def test_tpu_vm_slots_per_host(self):
+        from horovod_tpu.runner.run import parse_args
+        from horovod_tpu.runner import tpu_vm
+
+        args = parse_args(["--tpu", "s", "--zone", "z",
+                           "--slots-per-host", "4", "python", "t.py"])
+        eps = [tpu_vm.TPUEndpoint(i, f"10.0.0.{i + 1}") for i in range(2)]
+        cmds = tpu_vm.tpu_vm_ssh_commands(args, eps, ports=(1, 2))
+        r1 = cmds[1][cmds[1].index("--command") + 1]
+        assert "HOROVOD_RANK=4" in r1          # contiguous per host
+        assert "HOROVOD_SIZE=8" in r1
+        assert "HOROVOD_LOCAL_SIZE=4" in r1
+
+    def test_run_tpu_vm_propagates_failure(self):
+        from horovod_tpu.runner.run import parse_args
+        from horovod_tpu.runner import tpu_vm
+
+        runner, _ = self._fake_runner(n=2)
+
+        class FakeProc:
+            def __init__(self, cmd):
+                self.returncode = 3 if "--worker" in cmd and \
+                    cmd[cmd.index("--worker") + 1] == "1" else 0
+
+            def wait(self):
+                return self.returncode
+
+            def poll(self):
+                return self.returncode
+
+            def terminate(self):
+                pass
+
+        args = parse_args(["--tpu", "s", "--zone", "z", "python", "t.py"])
+        rc = tpu_vm.run_tpu_vm(args, runner=runner, popen=FakeProc)
+        assert rc == 3
+
+    def test_gke_jobset_manifest(self):
+        from horovod_tpu.runner.run import parse_args
+        from horovod_tpu.runner.tpu_vm import render_gke_jobset
+
+        args = parse_args(["--gke-jobset", "train", "--container-image",
+                           "gcr.io/p/img:1", "--gke-num-hosts", "4",
+                           "--slots-per-host", "4",
+                           "--gke-accelerator", "tpu-v5p-slice",
+                           "--gke-topology", "2x2x4",
+                           "--cycle-time-ms", "5",
+                           "python", "train.py", "--lr", "0.1"])
+        y = render_gke_jobset(args, args.gke_num_hosts)
+        assert "kind: JobSet" in y
+        assert "parallelism: 4" in y and "completions: 4" in y
+        assert "completionMode: Indexed" in y
+        assert "image: gcr.io/p/img:1" in y
+        assert "gke-tpu-accelerator: tpu-v5p-slice" in y
+        assert "gke-tpu-topology: 2x2x4" in y
+        assert "HOROVOD_CROSS_RANK=$JOB_COMPLETION_INDEX" in y
+        assert "HOROVOD_SIZE=16" in y
+        assert "HOROVOD_CONTROLLER_ADDR=train-workers-0-0.train" in y
+        assert "HOROVOD_CYCLE_TIME=5" in y      # tuning knobs forwarded
+        assert "python train.py --lr 0.1" in y
+
+    def test_gke_jobset_cli_renders(self, capsys):
+        from horovod_tpu.runner.run import main
+
+        rc = main(["--gke-jobset", "j", "--container-image", "i",
+                   "--gke-num-hosts", "2",
+                   "--gke-accelerator", "tpu-v5-lite-podslice",
+                   "--gke-topology", "4x4", "python", "t.py"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "kind: JobSet" in out
+        assert "completions: 2" in out
+
+    def test_tpu_vm_forwards_tuning_knobs_and_cwd(self):
+        from horovod_tpu.runner.run import parse_args
+        from horovod_tpu.runner import tpu_vm
+        import os
+
+        args = parse_args(["--tpu", "s", "--zone", "z",
+                           "--fusion-threshold-mb", "128",
+                           "--cycle-time-ms", "5", "python", "t.py"])
+        eps = [tpu_vm.TPUEndpoint(0, "10.0.0.1")]
+        remote = tpu_vm.tpu_vm_ssh_commands(args, eps, ports=(1, 2))[0]
+        remote = remote[remote.index("--command") + 1]
+        assert f"HOROVOD_FUSION_THRESHOLD={128 * 1024 * 1024}" in remote
+        assert "HOROVOD_CYCLE_TIME=5" in remote
+        # Same cwd convention as the plain ssh backend.
+        assert remote.startswith(f"cd {os.getcwd()} && ")
+
+    def test_describe_rejects_not_ready(self):
+        import json
+        import subprocess
+        import pytest
+        from horovod_tpu.runner import tpu_vm
+
+        def runner(cmd, **kw):
+            return subprocess.CompletedProcess(cmd, 0, stdout=json.dumps(
+                {"state": "CREATING", "networkEndpoints": []}), stderr="")
+        with pytest.raises(RuntimeError, match="CREATING"):
+            tpu_vm.describe_tpu("s", "z", runner=runner)
