@@ -1,0 +1,249 @@
+"""Mixture-of-Experts with expert parallelism over the ``ep`` mesh axis.
+
+Reference position: Horovod ships the PRIMITIVE this is built on —
+``hvd.alltoall`` for DLRM-style embedding exchange (SURVEY.md §2c
+"expert/embedding parallel via alltoall", BASELINE config #5) — but no MoE
+layer; this module is the beyond-parity model family that turns the
+primitive into a working sparse layer, TPU-first:
+
+- **Static shapes everywhere** (XLA requirement): Switch-Transformer-style
+  capacity-factor routing — every expert processes exactly ``capacity``
+  token slots per source rank; over-capacity tokens are dropped (their
+  output is the residual identity), under-capacity slots are zero padding.
+- **Dispatch/combine are einsums** against a one-hot dispatch mask (the
+  standard TPU formulation — no gather/scatter, everything rides the MXU).
+- **Expert parallelism**: experts are sharded over ``ep``; the dispatched
+  [E, C, D] buffer is exchanged with ONE ``lax.all_to_all`` over ICI so
+  each rank runs only its local experts on every rank's tokens, and a
+  second all_to_all brings expert outputs home (exactly the exchange the
+  reference's DLRM config does for embeddings).
+- **Load-balancing auxiliary loss** (Shazeer/Switch): mean(gate fraction ·
+  token fraction) · E, summed across ranks by the caller's loss psum.
+
+Layout: tokens ``[S, D]`` per rank (callers flatten [B, T]); experts'
+FFN params ``{"w1": [E, D, F], "w2": [E, F, D]}`` stacked on the expert
+axis — shard over ``ep`` with ``param_specs``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 64
+    d_ff: int = 128
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    ep_axis: Optional[str] = "ep"      # None = all experts local
+    router_noise: float = 0.0          # jitter std during training
+    dtype: Any = jnp.float32
+
+    def capacity(self, tokens_per_rank: int) -> int:
+        """Per-(source-rank, expert) token slots: static by construction."""
+        return max(1, int(np.ceil(tokens_per_rank / self.n_experts
+                                  * self.capacity_factor)))
+
+
+def init_params(cfg: MoEConfig, key) -> Dict:
+    kr, k1, k2 = jax.random.split(key, 3)
+    E, D, F = cfg.n_experts, cfg.d_model, cfg.d_ff
+    s1, s2 = 1.0 / np.sqrt(D), 1.0 / np.sqrt(F)
+    return {
+        "router": (jax.random.normal(kr, (D, E), jnp.float32) * s1
+                   ).astype(cfg.dtype),
+        "w1": (jax.random.normal(k1, (E, D, F), jnp.float32) * s1
+               ).astype(cfg.dtype),
+        "w2": (jax.random.normal(k2, (E, F, D), jnp.float32) * s2
+               ).astype(cfg.dtype),
+    }
+
+
+def param_specs(cfg: MoEConfig) -> Dict:
+    ep = cfg.ep_axis
+    return {"router": P(), "w1": P(ep), "w2": P(ep)}
+
+
+def _route(x, router_w, cfg: MoEConfig, rng: Optional[jax.Array]):
+    """Top-1 routing with static capacity.
+
+    Returns (dispatch [S, E, C] one-hot, combine [S, E, C] gate-weighted,
+    aux_loss scalar).  Position of a token within its expert's capacity
+    buffer comes from a cumsum over the expert's one-hot column —
+    deterministic, order-preserving, shape-static.
+    """
+    S = x.shape[0]
+    C = cfg.capacity(S)
+    logits = (x.astype(jnp.float32)
+              @ router_w.astype(jnp.float32))          # [S, E]
+    if cfg.router_noise > 0.0:
+        if rng is None:
+            raise ValueError(
+                "MoEConfig.router_noise > 0 requires passing rng= to "
+                "moe_ffn (the bundled lm_loss training path is "
+                "deterministic and does not thread one)")
+        logits = logits + cfg.router_noise * jax.random.normal(
+            rng, logits.shape, jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                # [S]
+    onehot = jax.nn.one_hot(expert, cfg.n_experts,
+                            dtype=jnp.float32)         # [S, E]
+    gate = jnp.sum(probs * onehot, axis=-1)            # [S]
+
+    # Position within the expert's buffer; tokens past capacity drop out.
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0    # [S, E], -1 if other
+    pos_in_expert = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [S]
+    keep = (pos_in_expert < C) & (pos_in_expert >= 0)
+    pos_oh = jax.nn.one_hot(pos_in_expert, C, dtype=jnp.float32)  # [S, C]
+    dispatch = (onehot * keep[:, None])[:, :, None] * pos_oh[:, None, :]
+    combine = dispatch * gate[:, None, None]
+
+    # Switch aux loss: fraction of tokens vs fraction of router mass.
+    token_frac = jnp.mean(onehot, axis=0)              # [E]
+    prob_frac = jnp.mean(probs, axis=0)                # [E]
+    aux = jnp.sum(token_frac * prob_frac) * cfg.n_experts
+    return dispatch, combine, aux
+
+
+def moe_ffn(x, params, cfg: MoEConfig,
+            rng: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+    """Apply the MoE FFN to per-rank tokens ``x [S, D]``.
+
+    Inside shard_map with ``ep`` bound, ``params["w1"]/["w2"]`` are the
+    LOCAL expert slab [E/ep, D, F] and the dispatch/return exchanges ride
+    two ``lax.all_to_all``; without ``ep_axis`` every expert is local.
+    Returns ``(y [S, D], aux_loss)`` — dropped tokens yield zeros (callers
+    add the residual).
+    """
+    S, D = x.shape
+    E = cfg.n_experts
+    C = cfg.capacity(S)
+    dispatch, combine, aux = _route(x, params["router"], cfg, rng)
+
+    # [E, C, D] expert buffers (einsum dispatch — MXU, no scatter).
+    buf = jnp.einsum("sec,sd->ecd", dispatch.astype(x.dtype), x)
+
+    ep = lax.axis_size(cfg.ep_axis) if cfg.ep_axis else 1
+    if ep > 1:
+        if E % ep:
+            raise ValueError(f"n_experts={E} must divide by ep={ep}")
+        # Send each expert's buffer to its home rank; receive every rank's
+        # buffers for OUR local experts, stacked along capacity:
+        # [E, C, D] -> [E/ep, ep*C, D].
+        buf = lax.all_to_all(buf, cfg.ep_axis, split_axis=0, concat_axis=1,
+                             tiled=True)
+
+    h = jnp.einsum("ecd,edf->ecf", buf, params["w1"])
+    h = jax.nn.silu(h)
+    out = jnp.einsum("ecf,efd->ecd", h, params["w2"])
+
+    if ep > 1:
+        # Return trip: split the stacked capacity axis back per source
+        # rank and send each chunk home -> [E, C, D] of OUR tokens'
+        # outputs (chunk j went to rank j and comes back from rank j, so
+        # expert-block order is preserved).
+        out = lax.all_to_all(out, cfg.ep_axis, split_axis=1, concat_axis=0,
+                             tiled=True)
+
+    y = jnp.einsum("sec,ecd->sd", combine.astype(x.dtype), out)
+    return y, aux.astype(jnp.float32)
+
+
+# ----------------------------------------------------------- tiny LM model
+@dataclasses.dataclass(frozen=True)
+class MoELMConfig:
+    """Minimal MoE language model (embed → N × [attention-free mixer +
+    MoE FFN] → head) — the test/bench vehicle for expert parallelism."""
+    vocab_size: int = 256
+    d_model: int = 64
+    n_layers: int = 2
+    moe: MoEConfig = dataclasses.field(default_factory=MoEConfig)
+    aux_weight: float = 0.01
+    dp_axis: Optional[str] = "dp"
+
+
+def lm_init(cfg: MoELMConfig, key) -> Dict:
+    keys = jax.random.split(key, 2 + cfg.n_layers)
+    D = cfg.d_model
+    return {
+        "embed": (jax.random.normal(keys[0], (cfg.vocab_size, D),
+                                    jnp.float32) / np.sqrt(D)).astype(
+            cfg.moe.dtype),
+        "layers": [init_params(cfg.moe, keys[1 + i])
+                   for i in range(cfg.n_layers)],
+        "head": (jax.random.normal(keys[-1], (D, cfg.vocab_size),
+                                   jnp.float32) / np.sqrt(D)).astype(
+            cfg.moe.dtype),
+    }
+
+
+def lm_param_specs(cfg: MoELMConfig) -> Dict:
+    return {"embed": P(), "head": P(),
+            "layers": [param_specs(cfg.moe) for _ in range(cfg.n_layers)]}
+
+
+def lm_loss(params, tokens, targets, cfg: MoELMConfig):
+    """Per-rank partial mean loss (same sum-semantics convention as
+    models/llama.py): scaled so psum over dp AND ep recovers the global
+    mean — ep is a DATA split here (GShard-style: every (dp, ep)
+    coordinate routes its own token shard; only experts live on ep)."""
+    B, T = tokens.shape
+    x = params["embed"][tokens].reshape(B * T, -1)
+    aux_total = 0.0
+    for lp in params["layers"]:
+        y, aux = moe_ffn(x, lp, cfg.moe)
+        x = x + y
+        aux_total = aux_total + aux
+    logits = (x @ params["head"]).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets.reshape(-1)[:, None],
+                               axis=-1)[:, 0]
+    denom = float(nll.size)
+    for ax in (cfg.dp_axis, cfg.moe.ep_axis):
+        if ax:
+            denom = denom * lax.axis_size(ax)
+    return (jnp.sum(nll) + cfg.aux_weight * aux_total
+            * float(nll.size)) / denom
+
+
+def lm_sync_grads(grads, cfg: MoELMConfig):
+    """psum over dp for everything; over ep only for ep-REPLICATED leaves
+    (router/embed/head) — expert slabs are exact per rank (each rank
+    computed its own experts' full gradient)."""
+    specs = lm_param_specs(cfg)
+
+    def leaf(g, spec):
+        if cfg.dp_axis:
+            g = lax.psum(g, cfg.dp_axis)
+        ep = cfg.moe.ep_axis
+        if ep and all(s != ep for s in spec):
+            g = lax.psum(g, ep)
+        return g
+
+    return jax.tree_util.tree_map(leaf, grads, specs,
+                                  is_leaf=lambda s: isinstance(s, P))
+
+
+def make_train_step(cfg: MoELMConfig, optimizer):
+    import optax
+
+    def step(params, opt_state, tokens, targets):
+        loss_p, grads = jax.value_and_grad(lm_loss)(params, tokens,
+                                                    targets, cfg)
+        grads = lm_sync_grads(grads, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        for ax in (cfg.dp_axis, cfg.moe.ep_axis):
+            if ax:
+                loss_p = lax.psum(loss_p, ax)
+        return params, opt_state, loss_p
+
+    return step

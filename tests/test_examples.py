@@ -109,6 +109,18 @@ def test_example_llama_spmd_pipeline():
     assert "pp=2" in r.stdout
 
 
+def test_example_moe_expert_parallel():
+    """MoE with experts sharded over ep=4 (alltoall dispatch/return)."""
+    env = _example_env(
+        XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "moe_expert_parallel.py"),
+         "--ep", "4", "--steps", "2"],
+        env=env, capture_output=True, text=True, timeout=300)
+    _assert_done(r)
+    assert "ep=4" in r.stdout
+
+
 def test_example_adasum_train():
     r = _run_example("adasum_train.py",
                      ["--epochs", "1", "--n-train", "128",
