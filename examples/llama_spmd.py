@@ -46,6 +46,10 @@ def parse_args():
                    help="pipeline-parallel degree (GPipe layer slabs)")
     p.add_argument("--micro", type=int, default=2,
                    help="microbatches per pipeline step (with --pp)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel degree (with --experts)")
+    p.add_argument("--experts", type=int, default=0,
+                   help="MoE MLP with this many experts (0 = dense)")
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--batch", type=int, default=0,
                    help="global batch (default 2*dp)")
@@ -68,15 +72,17 @@ def main():
     from horovod_tpu.parallel import spmd
     from horovod_tpu.parallel.mesh import infer_mesh
 
-    n = args.dp * args.tp * args.sp * args.pp
+    n = args.dp * args.tp * args.sp * args.pp * args.ep
     if len(jax.devices()) < n:
-        raise SystemExit(f"need {n} devices for dp*tp*sp*pp, "
+        raise SystemExit(f"need {n} devices for dp*tp*sp*pp*ep, "
                          f"have {len(jax.devices())}")
-    mesh = infer_mesh(n, tp=args.tp, sp=args.sp, pp=args.pp,
+    mesh = infer_mesh(n, tp=args.tp, sp=args.sp, pp=args.pp, ep=args.ep,
                       devices=jax.devices()[:n])
 
     pp_kw = dict(pp_axis="pp" if args.pp > 1 else None,
-                 n_microbatches=args.micro)
+                 n_microbatches=args.micro,
+                 n_experts=args.experts,
+                 ep_axis="ep" if args.ep > 1 else None)
     if args.tiny:
         cfg = llama.tiny(n_heads=4, n_kv_heads=2, d_model=64, d_ff=128,
                          vocab_size=256, **pp_kw)
@@ -96,7 +102,8 @@ def main():
 
     # With pipeline stages, every stage sees the same batch shard (the
     # schedule moves activations across pp, not data); otherwise fold the
-    # free pp axis into the batch axes.
+    # free pp axis into the batch axes.  ep is always a batch axis (MoE
+    # experts shard over it, tokens data-split).
     batch_axes = ("dp", "ep") if args.pp > 1 else ("dp", "ep", "pp")
     step = spmd.make_sharded_train_step(
         llama.make_train_step(cfg, opt), mesh, pspecs, os_specs,
@@ -104,7 +111,7 @@ def main():
     params = spmd.shard_params(params, pspecs, mesh)
 
     micro = args.micro if args.pp > 1 else 1
-    batch = args.batch or 2 * args.dp * micro
+    batch = args.batch or 2 * args.dp * args.ep * micro
     seq = args.seq or 128 * args.sp
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
@@ -121,8 +128,8 @@ def main():
     jax.block_until_ready(loss)
     dt = time.time() - t0
     tok_s = batch * seq * args.steps / dt
-    print(f"mesh=(dp={args.dp},tp={args.tp},sp={args.sp},pp={args.pp}) "
-          f"batch={batch} seq={seq}")
+    print(f"mesh=(dp={args.dp},tp={args.tp},sp={args.sp},pp={args.pp},"
+          f"ep={args.ep}) experts={args.experts} batch={batch} seq={seq}")
     print(f"loss={float(jax.device_get(loss)):.4f} "
           f"throughput={tok_s:.0f} tok/s", flush=True)
     print("DONE", flush=True)

@@ -62,6 +62,17 @@ class LlamaConfig:
     # (jax.checkpoint): activation memory stops scaling with stage depth —
     # the 1F1B memory dividend, XLA-style (see parallel/pipeline.py).
     remat_stages: bool = False
+    # Mixture-of-Experts MLP (models/moe.py): n_experts > 0 replaces the
+    # dense w1/w3/w2 MLP with Switch-routed experts; ``ep_axis`` shards
+    # them (a DATA axis for everything else — tokens split over dp×ep, so
+    # shard the batch over ("dp", "ep")).  Composes with tp (attention
+    # stays tp-sharded; experts are not additionally tp-split) and sp;
+    # MoE + pp is not composed yet (the aux loss cannot ride the pipeline
+    # carry) and raises.
+    n_experts: int = 0
+    ep_axis: Optional[str] = None
+    capacity_factor: float = 1.25
+    aux_weight: float = 0.01           # router load-balance loss weight
     # Pallas flash attention: True/False, or None = resolve from the
     # HVD_TPU_FLASH env var at TRACE time (auto: on when running on TPU).
     # The env var is not part of any jit cache key — to toggle after a
@@ -71,6 +82,21 @@ class LlamaConfig:
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    def __post_init__(self):
+        if self.n_experts and self.pp_axis:
+            raise NotImplementedError(
+                "MoE + pipeline parallelism is not composed yet (the aux "
+                "loss cannot ride the pipeline carry); use dp/ep×tp×sp")
+
+    def moe_cfg(self):
+        """The models.moe config for this model's MoE MLP (single source
+        of truth: init/specs/forward all derive from moe.py through it)."""
+        from . import moe as _moe
+        return _moe.MoEConfig(
+            d_model=self.d_model, d_ff=self.d_ff,
+            n_experts=self.n_experts, capacity_factor=self.capacity_factor,
+            ep_axis=self.ep_axis, dtype=self.dtype)
 
 
 def tiny(vocab_size: int = 256, d_model: int = 64, n_layers: int = 2,
@@ -100,17 +126,24 @@ def init_params(cfg: LlamaConfig, key) -> Dict:
 
     layers = []
     for _ in range(cfg.n_layers):
-        layers.append({
+        layer = {
             "attn_norm": jnp.ones((D,), dt),
             "wq": dense(next(k), D, (D, H * Hd)),
             "wk": dense(next(k), D, (D, K * Hd)),
             "wv": dense(next(k), D, (D, K * Hd)),
             "wo": dense(next(k), H * Hd, (H * Hd, D)),
             "mlp_norm": jnp.ones((D,), dt),
-            "w1": dense(next(k), D, (D, F)),
-            "w3": dense(next(k), D, (D, F)),
-            "w2": dense(next(k), F, (F, D)),
-        })
+        }
+        if cfg.n_experts:
+            from . import moe as _moe
+            layer["moe"] = _moe.init_params(cfg.moe_cfg(), next(k))
+        else:
+            layer |= {
+                "w1": dense(next(k), D, (D, F)),
+                "w3": dense(next(k), D, (D, F)),
+                "w2": dense(next(k), F, (F, D)),
+            }
+        layers.append(layer)
     if cfg.pp_axis:
         # Stacked layout [n_layers, ...]: shard_map slices axis 0 over the
         # pp axis in order, so stage i holds the contiguous layer slab
@@ -136,10 +169,16 @@ def param_specs(cfg: LlamaConfig) -> Dict:
         "wv": P(None, tp),
         "wo": P(tp, None),
         "mlp_norm": P(),
-        "w1": P(None, tp),
-        "w3": P(None, tp),
-        "w2": P(tp, None),
     }
+    if cfg.n_experts:
+        from . import moe as _moe
+        layer["moe"] = _moe.param_specs(cfg.moe_cfg())
+    else:
+        layer |= {
+            "w1": P(None, tp),
+            "w3": P(None, tp),
+            "w2": P(tp, None),
+        }
     if cfg.pp_axis:
         layers = {k: P(cfg.pp_axis, *spec) for k, spec in layer.items()}
     else:
@@ -217,22 +256,40 @@ def _attention(x, p, cfg: LlamaConfig, positions):
 
 
 def _mlp(x, p, cfg: LlamaConfig):
+    """Dense SwiGLU MLP, or Switch-routed MoE when cfg.n_experts > 0.
+
+    MoE returns ``(y, aux)``; dense returns ``(y, 0.0)`` so call sites are
+    uniform.  The MoE path is NOT tp-split (experts shard over ep; every
+    tp rank computes the same routing/experts redundantly — acceptable at
+    the tp degrees attention wants, and it keeps the exchange one
+    all_to_all instead of a tp×ep lattice)."""
+    if cfg.n_experts:
+        from . import moe as _moe
+        B, T, D = x.shape
+        y, aux = _moe.moe_ffn(x.reshape(B * T, D), p["moe"], cfg.moe_cfg())
+        return y.reshape(B, T, D), aux
     h = jax.nn.silu(x @ p["w1"]) * (x @ p["w3"])
     out = h @ p["w2"]
     if cfg.tp_axis:
         out = lax.psum(out, cfg.tp_axis)
-    return out
+    return out, jnp.zeros((), jnp.float32)
 
 
 def _layer_apply(p, x, cfg: LlamaConfig, positions):
     x = x + _attention(_rmsnorm(x, p["attn_norm"]), p, cfg, positions)
-    x = x + _mlp(_rmsnorm(x, p["mlp_norm"]), p, cfg)
-    return x
+    y, aux = _mlp(_rmsnorm(x, p["mlp_norm"]), p, cfg)
+    return x + y, aux
 
 
 def forward(params, tokens, cfg: LlamaConfig):
-    """Logits for local token shard [B_loc, T_loc] (call inside shard_map,
-    or directly when all axes are disabled/size-1).
+    """Logits for local token shard (public surface; see _forward)."""
+    return _forward(params, tokens, cfg)[0]
+
+
+def _forward(params, tokens, cfg: LlamaConfig):
+    """(logits, aux) for local token shard [B_loc, T_loc] (call inside
+    shard_map, or directly when all axes are disabled/size-1).  ``aux`` is
+    the summed MoE load-balance loss (0 for dense models).
 
     With ``pp_axis`` set, ``params["layers"]`` is this stage's slab of the
     stacked layer arrays and the blocks run under the GPipe microbatch
@@ -246,14 +303,16 @@ def forward(params, tokens, cfg: LlamaConfig):
     else:
         positions = jnp.arange(T)
     x = params["embed"][tokens]
+    aux_total = jnp.zeros((), jnp.float32)
     if cfg.pp_axis:
+        # (pp + MoE is rejected in LlamaConfig.__post_init__.)
         from ..parallel.pipeline import microbatch, pipeline_apply
         M = cfg.n_microbatches
         micro_x = microbatch(x, M)           # [M, B/M, T, D]
 
         def stage_fn(slab, xm):
             def body(h, p):
-                return _layer_apply(p, h, cfg, positions), None
+                return _layer_apply(p, h, cfg, positions)[0], None
             h, _ = lax.scan(body, xm, slab)  # this stage's layer slab
             return h
 
@@ -263,9 +322,10 @@ def forward(params, tokens, cfg: LlamaConfig):
         x = x.reshape((B, T, -1))
     else:
         for p in params["layers"]:
-            x = _layer_apply(p, x, cfg, positions)
+            x, aux = _layer_apply(p, x, cfg, positions)
+            aux_total = aux_total + aux
     x = _rmsnorm(x, params["final_norm"])
-    return x @ params["lm_head"]
+    return x @ params["lm_head"], aux_total
 
 
 def loss_fn(params, tokens, targets, cfg: LlamaConfig):
@@ -280,23 +340,33 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig):
     then turns per-rank partial grads into the exact mean gradient, and
     ``psum_loss`` recovers the scalar for logging.
     """
-    logits = forward(params, tokens, cfg).astype(jnp.float32)
+    logits, aux = _forward(params, tokens, cfg)
+    logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    # dp/sp factors extend the local count to the global token count; the
-    # tp/pp factors split the redundantly-computed loss across ranks (every
-    # tp rank computes the full head; every pp stage computes the loss from
-    # the broadcast pipeline output).
+    # dp/sp/ep factors extend the local count to the global token count
+    # (ep is a data axis when MoE is on); the tp/pp factors split the
+    # redundantly-computed loss across ranks (every tp rank computes the
+    # full head; every pp stage computes the loss from the broadcast
+    # pipeline output).
     denom = float(nll.size)
-    for ax in (cfg.dp_axis, cfg.sp_axis, cfg.tp_axis, cfg.pp_axis):
+    axes_denom = 1.0
+    for ax in (cfg.dp_axis, cfg.sp_axis, cfg.tp_axis, cfg.pp_axis,
+               cfg.ep_axis):
         if ax:
-            denom = denom * lax.axis_size(ax)
-    return jnp.sum(nll) / denom
+            axes_denom = axes_denom * lax.axis_size(ax)
+    total = jnp.sum(nll) / (denom * axes_denom)
+    if cfg.n_experts:
+        # Per-rank mean router-balance loss (mean over layers), scaled so
+        # the psum over every axis yields the cross-rank mean.
+        total = total + (cfg.aux_weight * aux / cfg.n_layers) / axes_denom
+    return total
 
 
 def psum_loss(loss_partial, cfg: LlamaConfig):
     """Sum per-rank partial losses into the true global mean loss."""
-    for ax in (cfg.dp_axis, cfg.sp_axis, cfg.tp_axis, cfg.pp_axis):
+    for ax in (cfg.dp_axis, cfg.sp_axis, cfg.tp_axis, cfg.pp_axis,
+               cfg.ep_axis):
         if ax:
             loss_partial = lax.psum(loss_partial, ax)
     return loss_partial
@@ -328,7 +398,11 @@ def sync_grads(grads, cfg: LlamaConfig, specs=None):
         for ax in (cfg.dp_axis, cfg.sp_axis):
             if ax:
                 g = lax.psum(g, ax)
-        for ax in (cfg.tp_axis, cfg.pp_axis):
+        # tp/pp: redundant compute — psum replicated leaves only.
+        # ep: a data axis — non-expert leaves saw only this rank's token
+        # shard (psum), expert slabs already aggregated every ep rank's
+        # tokens through the all_to_all transpose (exact, no psum).
+        for ax in (cfg.tp_axis, cfg.pp_axis, cfg.ep_axis):
             if ax and all(s != ax for s in spec):
                 g = lax.psum(g, ax)
         return g

@@ -102,7 +102,7 @@ def test_pipeline_llama_blocks():
         p = jax.tree_util.tree_map(lambda a: a[0], p_stacked)
         x = x + llama._attention(llama._rmsnorm(x, p["attn_norm"]), p, cfg,
                                  positions)
-        x = x + llama._mlp(llama._rmsnorm(x, p["mlp_norm"]), p, cfg)
+        x = x + llama._mlp(llama._rmsnorm(x, p["mlp_norm"]), p, cfg)[0]
         return x
 
     stacked = jax.tree_util.tree_map(
@@ -116,7 +116,7 @@ def test_pipeline_llama_blocks():
     for p in params["layers"]:
         ref = ref + llama._attention(
             llama._rmsnorm(ref, p["attn_norm"]), p, cfg, positions)
-        ref = ref + llama._mlp(llama._rmsnorm(ref, p["mlp_norm"]), p, cfg)
+        ref = ref + llama._mlp(llama._rmsnorm(ref, p["mlp_norm"]), p, cfg)[0]
 
     mesh = _mesh()
     out = jax.jit(shard_map(
