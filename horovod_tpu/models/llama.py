@@ -89,6 +89,22 @@ class LlamaConfig:
                 "MoE + pipeline parallelism is not composed yet (the aux "
                 "loss cannot ride the pipeline carry); use dp/ep×tp×sp")
 
+    @property
+    def all_axes(self):
+        """Every mesh axis this model can touch — THE axis list for loss
+        scaling and loss psums (one place to extend, three consumers)."""
+        return (self.dp_axis, self.sp_axis, self.tp_axis, self.pp_axis,
+                self.ep_axis)
+
+    @property
+    def spec_gated_axes(self):
+        """Axes whose gradient psum is per-leaf spec-gated: leaves SHARDED
+        over the axis carry exact shard gradients (no psum); replicated
+        leaves' partials are summed.  tp/pp = redundant compute; ep = a
+        data axis whose expert slabs already aggregated every rank's
+        tokens through the all_to_all transpose."""
+        return (self.tp_axis, self.pp_axis, self.ep_axis)
+
     def moe_cfg(self):
         """The models.moe config for this model's MoE MLP (single source
         of truth: init/specs/forward all derive from moe.py through it)."""
@@ -351,8 +367,7 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig):
     # pipeline output).
     denom = float(nll.size)
     axes_denom = 1.0
-    for ax in (cfg.dp_axis, cfg.sp_axis, cfg.tp_axis, cfg.pp_axis,
-               cfg.ep_axis):
+    for ax in cfg.all_axes:
         if ax:
             axes_denom = axes_denom * lax.axis_size(ax)
     total = jnp.sum(nll) / (denom * axes_denom)
@@ -365,8 +380,7 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig):
 
 def psum_loss(loss_partial, cfg: LlamaConfig):
     """Sum per-rank partial losses into the true global mean loss."""
-    for ax in (cfg.dp_axis, cfg.sp_axis, cfg.tp_axis, cfg.pp_axis,
-               cfg.ep_axis):
+    for ax in cfg.all_axes:
         if ax:
             loss_partial = lax.psum(loss_partial, ax)
     return loss_partial
@@ -389,20 +403,21 @@ def sync_grads(grads, cfg: LlamaConfig, specs=None):
       embed grad is nonzero only on stage 0 (the pipeline consumes input
       there) and the head grad is 1/pp-scaled on every stage, so the psum
       reassembles both.  pp-SHARDED slabs are exact per stage, like tp.
-    The 1/(count·tp·pp) scaling inside ``loss_fn`` makes these psums land
-    on the exact global-mean gradient.
+    - ep (MoE): a data axis — non-expert leaves saw only this rank's
+      token shard (psum over ep like dp/sp), while ep-SHARDED expert
+      slabs already aggregated every ep rank's tokens through the
+      all_to_all transpose (exact, no psum).
+    The 1/(count·tp·pp·ep) scaling inside ``loss_fn`` makes these psums
+    land on the exact global-mean gradient.
     """
     specs = specs or param_specs(cfg)
+    gated = cfg.spec_gated_axes
 
     def leaf_sync(g, spec):
         for ax in (cfg.dp_axis, cfg.sp_axis):
             if ax:
                 g = lax.psum(g, ax)
-        # tp/pp: redundant compute — psum replicated leaves only.
-        # ep: a data axis — non-expert leaves saw only this rank's token
-        # shard (psum), expert slabs already aggregated every ep rank's
-        # tokens through the all_to_all transpose (exact, no psum).
-        for ax in (cfg.tp_axis, cfg.pp_axis, cfg.ep_axis):
+        for ax in gated:
             if ax and all(s != ax for s in spec):
                 g = lax.psum(g, ax)
         return g
