@@ -25,7 +25,7 @@ from .common.basics import (  # noqa: F401
     add_process_set, remove_process_set, process_set_included,
     xla_built, nccl_built, mpi_enabled, gloo_enabled, mpi_threads_supported,
     cuda_built, rocm_built, tpu_available,
-    start_timeline, stop_timeline,
+    start_timeline, stop_timeline, start_profile, stop_profile, profile_step,
     NotInitializedError,
 )
 from .common.process_sets import ProcessSet, global_process_set  # noqa: F401

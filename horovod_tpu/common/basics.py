@@ -18,6 +18,7 @@ lives inside ``shard_map`` (``ops.axis_rank``).
 from __future__ import annotations
 
 import atexit
+import contextlib
 import threading
 from typing import List, Optional, Sequence
 
@@ -324,3 +325,34 @@ def stop_timeline():
         st.timeline.close()
     from ..utils.timeline import Timeline
     st.timeline = Timeline("", mark_cycles=False)
+
+
+def start_profile(logdir: str):
+    """Start a device-level profiler trace (XProf/TensorBoard format).
+
+    The coordinator's own Chrome-trace timeline (``start_timeline``, the
+    reference's N10) covers NEGOTIATE/XLA phases per tensor; this is the
+    complementary device view SURVEY.md §5 calls for — XLA op timing, HBM
+    traffic, ICI collectives — via ``jax.profiler``.  View with
+    ``tensorboard --logdir`` or Perfetto.  One trace at a time.
+    """
+    jax.profiler.start_trace(logdir)
+
+
+def stop_profile():
+    """Stop the trace started by :func:`start_profile` and flush it."""
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def profile_step(logdir: str):
+    """Context manager profiling one region (e.g. a train step)::
+
+        with hvd.profile_step("/tmp/prof"):
+            params, opt_state, loss = train_step(...)
+    """
+    start_profile(logdir)
+    try:
+        yield
+    finally:
+        stop_profile()

@@ -26,7 +26,8 @@ import tensorflow as tf
 from ..common import basics
 from ..common.basics import (  # noqa: F401  (re-export, reference parity)
     init, shutdown, is_initialized, rank, size, local_rank, local_size,
-    cross_rank, cross_size, start_timeline, stop_timeline, add_process_set,
+    cross_rank, cross_size, start_timeline, stop_timeline,
+    start_profile, stop_profile, profile_step, add_process_set,
 )
 from ..common.process_sets import ProcessSet  # noqa: F401
 from ..ops import eager

@@ -51,3 +51,19 @@ def test_global_process_set(hvd, world_size):
     from horovod_tpu import global_process_set
     assert global_process_set.process_set_id == 0
     assert global_process_set.size() == world_size
+
+
+def test_profile_trace_writes_xplane(hvd, tmp_path):
+    """start_profile/stop_profile produce an XProf trace directory
+    (the device-level complement to the coordinator timeline)."""
+    import os
+    import numpy as np
+
+    logdir = str(tmp_path / "prof")
+    with hvd.profile_step(logdir):
+        hvd.allreduce(hvd.stack_per_rank(
+            [np.ones((4,), np.float32)] * hvd.size()), op=hvd.Sum,
+            name="profiled_ar")
+    hits = [f for _, _, files in os.walk(logdir) for f in files
+            if f.endswith(".xplane.pb")]
+    assert hits, f"no xplane trace written under {logdir}"
