@@ -29,7 +29,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.ring_attention import ring_attention, local_flash_attention
+from ..parallel.ring_attention import (NEG_INF, local_flash_attention,
+                                       ring_attention)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -424,6 +425,122 @@ def sync_grads(grads, cfg: LlamaConfig, specs=None):
 
     return jax.tree_util.tree_map(leaf_sync, grads, specs,
                                   is_leaf=lambda x: isinstance(x, P))
+
+
+# ---------------------------------------------------------------- inference
+def init_cache(cfg: LlamaConfig, batch: int, max_seq: Optional[int] = None):
+    """Per-layer KV cache ``[B, max_seq, n_kv_heads, head_dim]`` (zeros).
+
+    Beyond-reference: Horovod ships no inference path at all; this is the
+    decode half of the flagship model.  Static shape — the cache is a
+    fixed ring of ``max_seq`` slots written via dynamic_update_slice, so
+    one compiled decode step serves every position.
+    """
+    T = max_seq or cfg.max_seq
+    shape = (batch, T, cfg.n_kv_heads, cfg.head_dim)
+    return [{"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.n_layers)]
+
+
+def _check_cache_budget(t_final: int, cache_t: int):
+    """Every position is static at trace time — refuse to decode past the
+    cache instead of letting dynamic_update_slice clamp writes onto the
+    last slot (which silently corrupts every later token)."""
+    if t_final > cache_t:
+        raise ValueError(
+            f"decode would write position {t_final - 1} but the KV cache "
+            f"has only {cache_t} slots; raise max_seq (init_cache) or "
+            f"generate fewer tokens")
+
+
+def decode_step(params, cache, tokens, pos, cfg: LlamaConfig):
+    """One greedy-decode step: ``tokens [B]`` at position ``pos`` (traced
+    scalar) -> (logits [B, vocab], updated cache).
+
+    Single-device decode (axes must be disabled — decode batching is the
+    deployment-level concern; training parallelism stays in the train
+    path).  Attention over the cache is a plain masked einsum: at Tq=1
+    there is no score matrix to tile, so flash buys nothing.
+    """
+    if any(ax for ax in cfg.all_axes):
+        raise ValueError("decode_step expects a config with all mesh axes "
+                         "disabled (dp/tp/sp/pp/ep = None)")
+    B = tokens.shape[0]
+    H, K, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = params["embed"][tokens][:, None, :]          # [B, 1, D]
+    positions = jnp.full((1,), pos, jnp.int32)
+    new_cache = []
+    T = cache[0]["k"].shape[1]
+    valid = (jnp.arange(T) <= pos)[None, None, None, :]   # [1,1,1,T]
+    for p, c in zip(params["layers"], cache):
+        h = _rmsnorm(x, p["attn_norm"])
+        q = (h @ p["wq"]).reshape(B, 1, H, Hd)
+        k_new = (h @ p["wk"]).reshape(B, 1, K, Hd)
+        v_new = (h @ p["wv"]).reshape(B, 1, K, Hd)
+        q = _rope(q, positions, cfg.rope_theta)
+        k_new = _rope(k_new, positions, cfg.rope_theta)
+        ck = lax.dynamic_update_slice(c["k"], k_new.astype(c["k"].dtype),
+                                      (0, pos, 0, 0))
+        cv = lax.dynamic_update_slice(c["v"], v_new.astype(c["v"].dtype),
+                                      (0, pos, 0, 0))
+        new_cache.append({"k": ck, "v": cv})
+        # GQA: fold q heads into [K, rep] groups against the shared kv.
+        qg = q.reshape(B, K, H // K, Hd)             # Tq=1 squeezed
+        s = jnp.einsum("bkrd,btkd->bkrt", qg, ck,
+                       preferred_element_type=jnp.float32)
+        s = s / np.sqrt(Hd)
+        s = jnp.where(valid, s, NEG_INF)
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bkrt,btkd->bkrd", w.astype(cv.dtype), cv,
+                       preferred_element_type=jnp.float32)
+        o = o.reshape(B, 1, H * Hd).astype(x.dtype) @ p["wo"]
+        x = x + o
+        y, _ = _mlp(_rmsnorm(x, p["mlp_norm"]), p, cfg)
+        x = x + y
+    x = _rmsnorm(x, params["final_norm"])
+    return (x[:, 0, :] @ params["lm_head"]).astype(jnp.float32), new_cache
+
+
+def prefill(params, cache, tokens, cfg: LlamaConfig):
+    """Fill the cache from a prompt ``[B, T0]`` by scanning decode_step;
+    returns (last logits, cache).  O(T0·T) — fine for the test/bench
+    vehicle; a blockwise flash prefill is the production variant."""
+    B, T0 = tokens.shape
+    _check_cache_budget(T0, cache[0]["k"].shape[1])
+
+    def body(carry, t):
+        cache = carry
+        logits, cache = decode_step(params, cache, tokens[:, t], t, cfg)
+        return cache, logits
+
+    cache, logits = lax.scan(body, cache, jnp.arange(T0))
+    return logits[-1], cache
+
+
+def generate(params, prompt, n_tokens: int, cfg: LlamaConfig,
+             max_seq: Optional[int] = None):
+    """Greedy generation: ``prompt [B, T0]`` -> ``[B, n_tokens]``.
+
+    jit-compatible end to end (scan over a static token budget)."""
+    B, T0 = prompt.shape
+    if n_tokens < 1:
+        return jnp.zeros((B, 0), jnp.int32)
+    cache = init_cache(cfg, B, max_seq)
+    # The last generated token's own kv is never written back, hence -1.
+    _check_cache_budget(T0 + n_tokens - 1, cache[0]["k"].shape[1])
+    logits, cache = prefill(params, cache, prompt, cfg)
+
+    def body(carry, t):
+        tok, cache = carry
+        logits, cache = decode_step(params, cache, tok, t, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, cache), nxt
+
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    (_, _), rest = lax.scan(body, (first, cache),
+                            jnp.arange(T0, T0 + n_tokens - 1))
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
 
 
 def make_train_step(cfg: LlamaConfig, optimizer):

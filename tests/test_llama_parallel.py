@@ -200,6 +200,50 @@ def test_llama_moe_matches_reference(ep, tp):
                                    err_msg=str(ka))
 
 
+def test_kv_cache_decode_matches_forward():
+    """Cached greedy decode == argmax of the full-context forward at every
+    generated position (teacher-forced equivalence: the KV cache is exact,
+    not an approximation)."""
+    cfg = llama.tiny(dtype=jnp.float32, max_seq=64, dp_axis=None,
+                     tp_axis=None, sp_axis=None, use_flash=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(5))
+    rng = np.random.RandomState(6)
+    B, T0, N = 2, 7, 6
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T0)), jnp.int32)
+
+    gen = jax.jit(lambda p, t: llama.generate(p, t, N, cfg))(params, prompt)
+    assert gen.shape == (B, N)
+
+    # Reference: recompute the FULL forward over (prompt + generated so
+    # far) with no cache; its last-position argmax must reproduce each
+    # generated token.
+    seq = prompt
+    for i in range(N):
+        logits = llama.forward(params, seq, cfg)
+        nxt = np.asarray(jnp.argmax(logits[:, -1, :], axis=-1))
+        np.testing.assert_array_equal(np.asarray(gen[:, i]), nxt,
+                                      err_msg=f"token {i}")
+        seq = jnp.concatenate(
+            [seq, jnp.asarray(nxt, jnp.int32)[:, None]], axis=1)
+
+
+def test_kv_cache_decode_moe():
+    """Decode works through the MoE MLP too (routing per decoded token)."""
+    cfg = llama.tiny(dtype=jnp.float32, max_seq=32, dp_axis=None,
+                     tp_axis=None, sp_axis=None, use_flash=False,
+                     n_experts=4, capacity_factor=4.0)
+    params = llama.init_params(cfg, jax.random.PRNGKey(7))
+    prompt = jnp.asarray(
+        np.random.RandomState(8).randint(0, cfg.vocab_size, (1, 5)),
+        jnp.int32)
+    gen = jax.jit(lambda p, t: llama.generate(p, t, 4, cfg))(params, prompt)
+    assert gen.shape == (1, 4)
+    logits = llama.forward(params, prompt, cfg)
+    np.testing.assert_array_equal(
+        np.asarray(gen[:, 0]),
+        np.asarray(jnp.argmax(logits[:, -1, :], axis=-1)))
+
+
 def test_entry_forward_single_device():
     """Single-chip jittable forward (the __graft_entry__ contract)."""
     cfg = llama.tiny(dtype=jnp.float32, dp_axis=None, tp_axis=None,
@@ -209,3 +253,18 @@ def test_entry_forward_single_device():
     logits = jax.jit(lambda p, t: llama.forward(p, t, cfg))(params, tokens)
     assert logits.shape == (2, 8, cfg.vocab_size)
     assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_kv_cache_budget_enforced():
+    """Decoding past the cache raises instead of silently clamping writes
+    onto the last slot; n_tokens=0 returns an empty [B, 0]."""
+    cfg = llama.tiny(dtype=jnp.float32, max_seq=8, dp_axis=None,
+                     tp_axis=None, sp_axis=None, use_flash=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(9))
+    prompt = jnp.asarray(
+        np.random.RandomState(10).randint(0, cfg.vocab_size, (1, 6)),
+        jnp.int32)
+    with pytest.raises(ValueError, match="slots"):
+        llama.generate(params, prompt, 6, cfg)      # positions 6..11 > 8
+    assert llama.generate(params, prompt, 3, cfg).shape == (1, 3)
+    assert llama.generate(params, prompt, 0, cfg).shape == (1, 0)
