@@ -48,6 +48,11 @@ class LlamaConfig:
     dp_axis: Optional[str] = "dp"
     tp_axis: Optional[str] = "tp"
     sp_axis: Optional[str] = "sp"
+    # Sequence-parallel engine: "ring" (K/V rotate — any head count,
+    # O(T/sp) memory) or "ulysses" (two alltoalls to head-sharded layout —
+    # needs q AND kv heads per tp shard divisible by sp; wins when ICI
+    # alltoall bandwidth is plentiful).
+    sp_impl: str = "ring"
     # Pipeline parallelism (beyond-ref, SURVEY.md §2c PP row): stage =
     # contiguous layer slab.  When set, ``init_params``/``param_specs``
     # emit the layer stack as STACKED arrays [n_layers, ...] sharded over
@@ -89,6 +94,10 @@ class LlamaConfig:
             raise NotImplementedError(
                 "MoE + pipeline parallelism is not composed yet (the aux "
                 "loss cannot ride the pipeline carry); use dp/ep×tp×sp")
+        if self.sp_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"sp_impl must be 'ring' or 'ulysses', got "
+                f"{self.sp_impl!r}")
 
     @property
     def all_axes(self):
@@ -255,7 +264,16 @@ def _attention(x, p, cfg: LlamaConfig, positions):
     kk = _rope(kk, positions, cfg.rope_theta)
 
     sp = lax.axis_size(cfg.sp_axis) if cfg.sp_axis else 1
-    if sp > 1:
+    if sp > 1 and cfg.sp_impl == "ulysses":
+        # Head exchange instead of kv rotation (docs/parallelism.md for
+        # the tradeoff); GQA kv travels un-repeated through the alltoall.
+        from ..ops.flash_attention import flash_attention
+        from ..parallel.ulysses import ulysses_attention
+        attn = (flash_attention if _use_pallas_flash(cfg)
+                else local_flash_attention)   # same routing as every path
+        out = ulysses_attention(q, kk, v, attn_fn=attn,
+                                axis_name=cfg.sp_axis, causal=True)
+    elif sp > 1:
         # GQA passes through un-repeated: the ring handles it on both
         # engines (pallas reads shared kv heads through block index maps —
         # H/K× less ring traffic; the jnp fallback repeats internally).

@@ -47,12 +47,15 @@ def ulysses_attention(q, k, v, attn_fn: Optional[Callable] = None,
             from .ring_attention import local_flash_attention
             attn_fn = local_flash_attention
     H = q.shape[2]
+    K = k.shape[2]
     n = lax.axis_size(axis_name)
-    if H % n:
+    if H % n or K % n:
         raise ValueError(
-            f"ulysses_attention needs heads ({H}) divisible by the "
-            f"{axis_name!r} axis size ({n}); use ring_attention for "
-            f"head counts below the sp degree")
+            f"ulysses_attention needs q heads ({H}) AND kv heads ({K}) "
+            f"divisible by the {axis_name!r} axis size ({n}) — GQA kv "
+            f"travels un-repeated through the alltoall; use "
+            f"ring_attention when the kv head count is below the sp "
+            f"degree")
     qh = seq_to_heads(q, axis_name)
     kh = seq_to_heads(k, axis_name)
     vh = seq_to_heads(v, axis_name)

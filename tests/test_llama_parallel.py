@@ -74,6 +74,47 @@ def test_sharded_matches_reference(tp, sp):
         np.testing.assert_allclose(a, np.asarray(b), rtol=3e-3, atol=3e-5)
 
 
+@pytest.mark.parametrize("sp,tp,heads,kv_heads", [
+    (2, 1, 4, 2),
+    (2, 2, 8, 4),   # per-tp-shard kv heads (2) still divide by sp
+    (4, 1, 8, 4),
+])
+def test_ulysses_sp_matches_reference(sp, tp, heads, kv_heads):
+    """sp_impl="ulysses" (head-exchange sequence parallelism) trains
+    numerics-identical to the unsharded reference, like the ring path.
+    Ulysses needs (kv_heads / tp) % sp == 0 — GQA kv travels un-repeated."""
+    hkw = dict(n_heads=heads, n_kv_heads=kv_heads)
+    cfg_ref = llama.tiny(dtype=jnp.float32, dp_axis=None, tp_axis=None,
+                         sp_axis=None, **hkw)
+    params = llama.init_params(cfg_ref, jax.random.PRNGKey(0))
+    opt = optax.sgd(0.1)
+    opt_state = opt.init(params)
+    rstep = jax.jit(llama.make_train_step(cfg_ref, opt))
+    tokens, targets = _data(cfg_ref)
+    ref_losses = []
+    for _ in range(2):
+        params, opt_state, loss = rstep(params, opt_state, tokens, targets)
+        ref_losses.append(float(loss))
+
+    cfg = llama.tiny(dtype=jnp.float32, sp_impl="ulysses", **hkw)
+    mesh = infer_mesh(8, tp=tp, sp=sp)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    pspecs = llama.param_specs(cfg)
+    opt = optax.sgd(0.1)
+    opt_state = opt.init(params)
+    os_specs = spmd.infer_specs_like(opt_state, params, pspecs)
+    step = spmd.make_sharded_train_step(
+        llama.make_train_step(cfg, opt), mesh, pspecs, os_specs,
+        P(("dp", "ep", "pp"), "sp"))
+    params = spmd.shard_params(params, pspecs, mesh)
+    tokens, targets = _data(cfg)
+    losses = []
+    for _ in range(2):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4)
+
+
 @pytest.mark.parametrize("pp,tp,sp,n_micro", [
     (2, 1, 1, 2),   # pure pp
     (2, 1, 1, 4),   # more microbatches than stages

@@ -177,6 +177,29 @@ def test_ulysses_matches_local(causal):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_gqa(causal):
+    """GQA kv travels UN-REPEATED through Ulysses' alltoall (the local
+    attention handles shared kv heads natively): sp=4, H=8, K=4."""
+    from horovod_tpu.parallel.ring_attention import local_flash_attention
+    from horovod_tpu.parallel.ulysses import ulysses_attention
+    rng = np.random.RandomState(13)
+    B, T, H, K, D = 2, 32, 8, 4, 16
+    q = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, T, K, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, T, K, D), jnp.float32)
+    ref = local_flash_attention(q, jnp.repeat(k, 2, 2), jnp.repeat(v, 2, 2),
+                                causal=causal)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    out = jax.jit(shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, axis_name="sp",
+                                          causal=causal),
+        mesh=mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
+        check_vma=False))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_zero_sharded_optimizer_matches_plain():
     """ZeRO-sharded adam == unsharded adam on the mean gradient."""
     from horovod_tpu.parallel.zero import sharded_optimizer
