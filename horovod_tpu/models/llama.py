@@ -72,9 +72,9 @@ class LlamaConfig:
     # dense w1/w3/w2 MLP with Switch-routed experts; ``ep_axis`` shards
     # them (a DATA axis for everything else — tokens split over dp×ep, so
     # shard the batch over ("dp", "ep")).  Composes with tp (attention
-    # stays tp-sharded; experts are not additionally tp-split) and sp;
-    # MoE + pp is not composed yet (the aux loss cannot ride the pipeline
-    # carry) and raises.
+    # stays tp-sharded; experts are not additionally tp-split), sp, and
+    # pp (the router aux loss rides the pipeline carry as per-stage
+    # partials).
     n_experts: int = 0
     ep_axis: Optional[str] = None
     capacity_factor: float = 1.25
@@ -90,10 +90,6 @@ class LlamaConfig:
         return self.d_model // self.n_heads
 
     def __post_init__(self):
-        if self.n_experts and self.pp_axis:
-            raise NotImplementedError(
-                "MoE + pipeline parallelism is not composed yet (the aux "
-                "loss cannot ride the pipeline carry); use dp/ep×tp×sp")
         if self.sp_impl not in ("ring", "ulysses"):
             raise ValueError(
                 f"sp_impl must be 'ring' or 'ulysses', got "
@@ -173,8 +169,8 @@ def init_params(cfg: LlamaConfig, key) -> Dict:
     if cfg.pp_axis:
         # Stacked layout [n_layers, ...]: shard_map slices axis 0 over the
         # pp axis in order, so stage i holds the contiguous layer slab
-        # [i*L/pp, (i+1)*L/pp).
-        layers = {k: jnp.stack([l[k] for l in layers]) for k in layers[0]}
+        # [i*L/pp, (i+1)*L/pp).  tree_map so nested subtrees (MoE) stack.
+        layers = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *layers)
     return {
         "embed": dense(next(k), D, (cfg.vocab_size, D)),
         "layers": layers,
@@ -206,9 +202,13 @@ def param_specs(cfg: LlamaConfig) -> Dict:
             "w2": P(tp, None),
         }
     if cfg.pp_axis:
-        layers = {k: P(cfg.pp_axis, *spec) for k, spec in layer.items()}
+        layers = jax.tree_util.tree_map(
+            lambda spec: P(cfg.pp_axis, *spec), layer,
+            is_leaf=lambda x: isinstance(x, P))
     else:
-        layers = [dict(layer) for _ in range(cfg.n_layers)]
+        layers = [jax.tree_util.tree_map(
+            lambda s: s, layer, is_leaf=lambda x: isinstance(x, P))
+            for _ in range(cfg.n_layers)]
     return {
         "embed": P(),
         "layers": layers,
@@ -340,20 +340,27 @@ def _forward(params, tokens, cfg: LlamaConfig):
     x = params["embed"][tokens]
     aux_total = jnp.zeros((), jnp.float32)
     if cfg.pp_axis:
-        # (pp + MoE is rejected in LlamaConfig.__post_init__.)
         from ..parallel.pipeline import microbatch, pipeline_apply
         M = cfg.n_microbatches
         micro_x = microbatch(x, M)           # [M, B/M, T, D]
 
         def stage_fn(slab, xm):
-            def body(h, p):
-                return _layer_apply(p, h, cfg, positions)[0], None
-            h, _ = lax.scan(body, xm, slab)  # this stage's layer slab
-            return h
+            def body(carry, p):
+                h, aux = carry
+                h, a = _layer_apply(p, h, cfg, positions)
+                return (h, aux + a), None
+            (h, aux), _ = lax.scan(
+                body, (xm, jnp.zeros((), jnp.float32)), slab)
+            return h, aux
 
-        x = pipeline_apply(stage_fn, params["layers"], micro_x,
-                           axis_name=cfg.pp_axis, broadcast_out=True,
-                           remat=cfg.remat_stages)
+        x, aux_total = pipeline_apply(
+            stage_fn, params["layers"], micro_x, axis_name=cfg.pp_axis,
+            broadcast_out=True, remat=cfg.remat_stages, with_aux=True)
+        # moe aux is a per-token MEAN (batch-size invariant); the pipeline
+        # accumulated one per microbatch, so average — otherwise the
+        # scheduling knob n_microbatches would scale the training
+        # objective.
+        aux_total = aux_total / M
         x = x.reshape((B, T, -1))
     else:
         for p in params["layers"]:
@@ -392,8 +399,14 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig):
     total = jnp.sum(nll) / (denom * axes_denom)
     if cfg.n_experts:
         # Per-rank mean router-balance loss (mean over layers), scaled so
-        # the psum over every axis yields the cross-rank mean.
-        total = total + (cfg.aux_weight * aux / cfg.n_layers) / axes_denom
+        # the psum over every axis yields the cross-rank mean.  Unlike the
+        # nll (redundant over pp via the broadcast output), aux is
+        # PARTITIONED over pp — each stage computed only its own slab's
+        # routers — so pp's factor must not divide it.
+        aux_denom = axes_denom
+        if cfg.pp_axis:
+            aux_denom = aux_denom / lax.axis_size(cfg.pp_axis)
+        total = total + (cfg.aux_weight * aux / cfg.n_layers) / aux_denom
     return total
 
 

@@ -41,7 +41,8 @@ def stage_index(axis_name: str = "pp"):
 def pipeline_apply(fn: Callable, stage_params, micro_x,
                    axis_name: str = "pp",
                    broadcast_out: bool = False,
-                   remat: bool = False):
+                   remat: bool = False,
+                   with_aux: bool = False):
     """Run microbatches through the stage pipeline.
 
     fn: ``(stage_params, x[mb, ...]) -> y[mb, ...]`` (shape-preserving);
@@ -61,6 +62,12 @@ def pipeline_apply(fn: Callable, stage_params, micro_x,
     XLA's scan transpose (which already interleaves each tick's backward
     with its recompute, 1F1B-style) remat is the idiomatic lever, so a
     literal hand-scheduled 1F1B variant is deliberately not implemented.
+
+    ``with_aux=True``: ``fn`` returns ``(y, aux_scalar)`` and the call
+    returns ``(outs, aux_total)`` where aux_total accumulates every VALID
+    (non-bubble) tick's scalar on THIS stage — a per-stage partial (each
+    stage saw only its own layers); callers sum across pp with a psum,
+    exactly like the MoE router-balance loss wants.
     """
     if remat:
         fn = jax.checkpoint(fn)
@@ -73,31 +80,39 @@ def pipeline_apply(fn: Callable, stage_params, micro_x,
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def tick(carry, t):
-        buf, outs = carry
+        buf, outs, aux_acc = carry
         x0 = micro_x[jnp.clip(t, 0, m_total - 1)]
         x_in = jnp.where(idx == 0, x0, buf)
-        y = fn(stage_params, x_in)
+        if with_aux:
+            y, aux = fn(stage_params, x_in)
+        else:
+            y = fn(stage_params, x_in)
+            aux = 0.0
         m = t - idx                      # microbatch this stage holds now
         valid = jnp.logical_and(m >= 0, m < m_total)
         # Bubble ticks compute garbage; zero it so it can't poison the
         # carry (NaN from fn(params, junk) would otherwise propagate).
         y = jnp.where(valid, y, jnp.zeros_like(y))
+        aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
         outs = lax.cond(
             jnp.logical_and(valid, idx == n - 1),
             lambda o: lax.dynamic_update_index_in_dim(
                 o, y, jnp.clip(m, 0, m_total - 1), 0),
             lambda o: o, outs)
         buf = lax.ppermute(y, axis_name, perm)
-        return (buf, outs), None
+        return (buf, outs, aux_acc), None
 
     buf0 = jnp.zeros_like(micro_x[0])
     outs0 = jnp.zeros_like(micro_x)
-    (buf, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(ticks))
+    (buf, outs, aux_total), _ = lax.scan(
+        tick, (buf0, outs0, jnp.zeros((), jnp.float32)), jnp.arange(ticks))
 
     if broadcast_out:
         # Every stage but the last holds zeros, so a psum over the pp axis
         # IS the broadcast of the last stage's outputs.
         outs = lax.psum(outs, axis_name)
+    if with_aux:
+        return outs, aux_total
     return outs
 
 
