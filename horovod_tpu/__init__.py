@@ -36,7 +36,7 @@ from .ops.eager import (  # noqa: F401
     allreduce, allreduce_async,
     grouped_allreduce, grouped_allreduce_async,
     allgather, allgather_async,
-    broadcast, broadcast_async, broadcast_object,
+    broadcast, broadcast_async, broadcast_object, allgather_object,
     alltoall, alltoall_async,
     reducescatter, reducescatter_async,
     synchronize, poll, barrier, join,

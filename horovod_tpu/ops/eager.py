@@ -337,6 +337,70 @@ def broadcast_pytree(tree, root_rank: int = 0,
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def allgather_object(obj, name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None):
+    """Pickle-allgather arbitrary per-rank objects (reference:
+    ``horovod/torch/mpi_ops.py allgather_object``): returns the list of
+    every rank's object, identical on all ranks.
+
+    Multi-process mode: ``obj`` is THIS rank's object — or, for a process
+    driving several local devices, a list with one object per local rank
+    (like ``stack_per_rank``/the ragged alltoall).  Single-controller
+    mode: a list with one object per rank, or a single object to
+    replicate.
+    """
+    import pickle
+    st = basics._get_state()
+    ps = st.process_set_table.get(_ps(process_set))
+    world = ps.size()
+    base = _auto_name("allgather_obj", name)
+    if per_process_mode():
+        n_local = len([d for d in ps.mesh.devices.flat
+                       if d.process_index == jax.process_index()])
+        if n_local > 1:
+            objs = list(obj) if isinstance(obj, (list, tuple)) else None
+            if objs is None or len(objs) != n_local:
+                raise ValueError(
+                    f"Multi-device process: pass a list of {n_local} "
+                    f"per-local-rank objects")
+            payloads = [np.frombuffer(pickle.dumps(o), np.uint8)
+                        for o in objs]
+        else:
+            payloads = [np.frombuffer(pickle.dumps(obj), np.uint8)]
+    else:
+        objs = list(obj) if isinstance(obj, (list, tuple)) \
+            else [obj] * world
+        if len(objs) != world:
+            raise ValueError(f"Expected {world} per-rank objects, got "
+                             f"{len(objs)}")
+        payloads = [np.frombuffer(pickle.dumps(o), np.uint8) for o in objs]
+
+    # Size prologue, then pad to max and ride ONE even allgather — the
+    # same static-shape recipe as the ragged alltoall.  In multi-process
+    # mode the local contribution is [*S] for one device or
+    # [n_local, *S] rows for several, matching _as_stacked.
+    multi_row = not per_process_mode() or len(payloads) > 1
+    if multi_row:
+        sz_in = np.stack([np.array([len(p)], np.int64) for p in payloads])
+    else:
+        sz_in = np.array([len(payloads[0])], np.int64)
+    sizes = np.asarray(to_local(allgather(
+        sz_in, name=f"{base}.sizes", process_set=process_set))).reshape(-1)
+    m = max(1, int(sizes.max()))
+    if multi_row:
+        buf = np.zeros((len(payloads), m), np.uint8)
+        for i, p in enumerate(payloads):
+            buf[i, :len(p)] = p
+    else:
+        buf = np.zeros((m,), np.uint8)
+        buf[:len(payloads[0])] = payloads[0]
+    out = np.asarray(to_local(allgather(
+        buf, name=f"{base}.payload", process_set=process_set)))
+    out = out.reshape(world, m)
+    return [pickle.loads(out[r, :int(sizes[r])].tobytes())
+            for r in range(world)]
+
+
 def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None,
                      process_set: Optional[ProcessSet] = None):
     """Pickle-broadcast an arbitrary Python object (reference:

@@ -34,7 +34,8 @@ from ..ops import eager
 from .compression import Compression  # noqa: F401
 from .mpi_ops import (  # noqa: F401
     Adasum, Average, Max, Min, Product, ReduceOp, Sum,
-    allgather, allreduce, alltoall, barrier, broadcast, broadcast_object,
+    allgather, allgather_object, allreduce, alltoall, barrier,
+    broadcast, broadcast_object,
     graph_safe, grouped_allreduce, join, reducescatter,
 )
 

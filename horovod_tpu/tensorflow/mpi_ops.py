@@ -186,3 +186,4 @@ def graph_safe(fn, output_dtype: tf.DType = tf.float32):
 barrier = eager.barrier
 join = eager.join
 broadcast_object = eager.broadcast_object
+allgather_object = eager.allgather_object

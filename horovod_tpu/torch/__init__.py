@@ -26,7 +26,7 @@ from .mpi_ops import (  # noqa: F401
     grouped_allreduce_async_,
     allgather, allgather_async,
     broadcast, broadcast_, broadcast_async, broadcast_async_,
-    broadcast_object,
+    broadcast_object, allgather_object,
     alltoall, alltoall_async,
     reducescatter, reducescatter_async,
     synchronize, poll, barrier, join,

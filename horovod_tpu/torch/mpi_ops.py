@@ -301,6 +301,13 @@ def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None,
                                   process_set=process_set)
 
 
+def allgather_object(obj, name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None):
+    """List of every rank's pickled object (reference:
+    ``horovod/torch/mpi_ops.py allgather_object``)."""
+    return eager.allgather_object(obj, name=name, process_set=process_set)
+
+
 # ------------------------------------------------------------------ alltoall
 def _take_my_row(t):
     """Stacked sharded results → this rank's row (shared bridge

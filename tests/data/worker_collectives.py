@@ -82,6 +82,10 @@ def main():
     obj = hvd.broadcast_object({"rank_was": rank}, root_rank=0)
     assert obj == {"rank_was": 0}
 
+    # allgather_object: ragged pickled payloads, every rank gets the list
+    objs = hvd.allgather_object({"r": rank, "pad": "y" * (5 * (rank + 1))})
+    assert [o["r"] for o in objs] == list(range(size)), objs
+
     # Sub-process-set collective: only ranks 0,1 participate (exercises the
     # required-count negotiation — non-members never announce the name).
     if size >= 3:

@@ -297,3 +297,15 @@ def test_blocking_op_completes_inline_without_background_thread(hvd):
     finally:
         eng._shutdown.clear()
         eng.start()
+
+
+def test_allgather_object(hvd, world_size):
+    """Pickle-allgather of heterogeneous per-rank objects (reference:
+    allgather_object) — sizes differ per rank, result identical lists."""
+    objs = [{"rank": r, "blob": "x" * (10 * (r + 1))}
+            for r in range(world_size)]
+    out = hvd.allgather_object(objs)
+    assert out == objs
+    # Replicated single object form.
+    out2 = hvd.allgather_object({"same": 1})
+    assert out2 == [{"same": 1}] * world_size
