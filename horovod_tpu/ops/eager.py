@@ -301,6 +301,54 @@ def allgather(tensor, name: Optional[str] = None,
     return _sync_now(allgather_async(tensor, name, process_set))
 
 
+def _grouped_async(tensors, name, prefix, ctype, process_set, **extra):
+    """Shared grouped-enqueue core (reference N13 atomic groups): one
+    atomic push, every member negotiates/batches together."""
+    ps_id = _ps(process_set)
+    gid = next(_group_counter)
+    base = _auto_name(prefix, name)
+    items = []
+    for i, t in enumerate(tensors):
+        arr, owned = _as_stacked(t, ps_id)
+        items.append(dict(name=f"{base}.{i}", ctype=ctype, tensor=arr,
+                          process_set_id=ps_id, group_id=gid, donate=owned,
+                          **extra))
+    return _engine().enqueue_group(items)
+
+
+def grouped_allgather_async(tensors: Sequence, name: Optional[str] = None,
+                            process_set: Optional[ProcessSet] = None) -> List[int]:
+    """Reference: ``hvd.grouped_allgather`` (upstream v0.28)."""
+    return _grouped_async(tensors, name, "grouped_allgather",
+                          CollectiveType.ALLGATHER, process_set)
+
+
+def grouped_allgather(tensors: Sequence, name: Optional[str] = None,
+                      process_set: Optional[ProcessSet] = None):
+    handles = grouped_allgather_async(tensors, name, process_set)
+    _engine().kick()
+    return [synchronize(h) for h in handles]
+
+
+def grouped_reducescatter_async(tensors: Sequence,
+                                name: Optional[str] = None,
+                                op: C.ReduceOp = C.ReduceOp.SUM,
+                                process_set: Optional[ProcessSet] = None
+                                ) -> List[int]:
+    """Reference: ``hvd.grouped_reducescatter`` (upstream v0.28)."""
+    return _grouped_async(tensors, name, "grouped_reducescatter",
+                          CollectiveType.REDUCESCATTER, process_set,
+                          reduce_op=op)
+
+
+def grouped_reducescatter(tensors: Sequence, name: Optional[str] = None,
+                          op: C.ReduceOp = C.ReduceOp.SUM,
+                          process_set: Optional[ProcessSet] = None):
+    handles = grouped_reducescatter_async(tensors, name, op, process_set)
+    _engine().kick()
+    return [synchronize(h) for h in handles]
+
+
 # ------------------------------------------------------------------ broadcast
 def broadcast_async(tensor, root_rank: int = 0, name: Optional[str] = None,
                     process_set: Optional[ProcessSet] = None) -> int:
