@@ -386,7 +386,8 @@ def broadcast_pytree(tree, root_rank: int = 0,
 
 
 def allgather_object(obj, name: Optional[str] = None,
-                     process_set: Optional[ProcessSet] = None):
+                     process_set: Optional[ProcessSet] = None,
+                     per_rank: Optional[bool] = None):
     """Pickle-allgather arbitrary per-rank objects (reference:
     ``horovod/torch/mpi_ops.py allgather_object``): returns the list of
     every rank's object, identical on all ranks.
@@ -396,6 +397,13 @@ def allgather_object(obj, name: Optional[str] = None,
     (like ``stack_per_rank``/the ragged alltoall).  Single-controller
     mode: a list with one object per rank, or a single object to
     replicate.
+
+    ``per_rank`` disambiguates list payloads in single-controller mode
+    (where type-sniffing is otherwise the only signal): ``True`` means
+    ``obj`` IS the per-rank list (must have ``world`` entries), ``False``
+    means ``obj`` is one object to replicate verbatim — even when it
+    happens to be a list of length ``world``.  The default ``None``
+    keeps the legacy sniff (list/tuple of length ``world`` → per-rank).
     """
     import pickle
     st = basics._get_state()
@@ -405,6 +413,11 @@ def allgather_object(obj, name: Optional[str] = None,
     if per_process_mode():
         n_local = len([d for d in ps.mesh.devices.flat
                        if d.process_index == jax.process_index()])
+        if per_rank is not None:
+            raise ValueError(
+                "per_rank is a single-controller disambiguator; in "
+                "multi-process mode pass this rank's own object (or a "
+                "per-local-rank list for a multi-device process)")
         if n_local > 1:
             objs = list(obj) if isinstance(obj, (list, tuple)) else None
             if objs is None or len(objs) != n_local:
@@ -416,11 +429,25 @@ def allgather_object(obj, name: Optional[str] = None,
         else:
             payloads = [np.frombuffer(pickle.dumps(obj), np.uint8)]
     else:
-        objs = list(obj) if isinstance(obj, (list, tuple)) \
-            else [obj] * world
-        if len(objs) != world:
-            raise ValueError(f"Expected {world} per-rank objects, got "
-                             f"{len(objs)}")
+        if per_rank is True:
+            if not isinstance(obj, (list, tuple)) or len(obj) != world:
+                raise ValueError(
+                    f"per_rank=True: expected a list of {world} per-rank "
+                    f"objects, got "
+                    f"{type(obj).__name__}"
+                    + (f" of length {len(obj)}"
+                       if isinstance(obj, (list, tuple)) else ""))
+            objs = list(obj)
+        elif per_rank is False:
+            objs = [obj] * world
+        else:
+            objs = list(obj) if isinstance(obj, (list, tuple)) \
+                else [obj] * world
+            if len(objs) != world:
+                raise ValueError(
+                    f"Expected {world} per-rank objects, got {len(objs)} "
+                    f"(pass per_rank=False to replicate a list payload "
+                    f"verbatim)")
         payloads = [np.frombuffer(pickle.dumps(o), np.uint8) for o in objs]
 
     # Size prologue, then pad to max and ride ONE even allgather — the

@@ -144,6 +144,14 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
         args.command = args.command[1:]
     if args.tpu and not args.zone:
         p.error("--tpu requires --zone")
+    if (args.tpu or args.gke_jobset) and (args.slots_per_host or 1) > 1:
+        # One launched process per host is the TPU-VM/GKE model (the host's
+        # local chips are auto-detected by jax); advertising SIZE =
+        # hosts*slots while starting one process per host would hang every
+        # worker at rendezvous waiting for ranks that never launch.
+        p.error("--slots-per-host > 1 is not supported with --tpu/"
+                "--gke-jobset: these backends launch ONE process per host "
+                "and the process drives all local chips")
     if args.gke_jobset and not (args.container_image and args.gke_num_hosts
                                 and args.gke_accelerator
                                 and args.gke_topology):
@@ -264,19 +272,45 @@ def tuning_env(args) -> Dict[str, str]:
     return env
 
 
-def wait_and_reap(procs: List[subprocess.Popen]) -> int:
+def wait_and_reap(procs: List[subprocess.Popen],
+                  poll_interval_s: float = 0.2) -> int:
     """Wait for every worker, propagate the first failure, terminate
-    stragglers (shared by the local/ssh and TPU-VM backends)."""
+    stragglers (shared by the local/ssh and TPU-VM backends).
+
+    Polls ALL workers rather than waiting in list order: the moment any
+    worker exits nonzero, the survivors are terminated — one crashed rank
+    must not leave the rest of a slice running until their own timeouts
+    fire (the reference launcher's safe_shell_exec kills the process
+    group the same way).
+    """
+    import time
     rc = 0
+    live = list(procs)
     try:
-        for p in procs:
-            p.wait()
-            if p.returncode != 0 and rc == 0:
-                rc = p.returncode
+        while live:
+            still = []
+            for p in live:
+                code = p.poll()
+                if code is None:
+                    still.append(p)
+                elif code != 0 and rc == 0:
+                    rc = code
+            live = still
+            if rc != 0:
+                break
+            if live:
+                time.sleep(poll_interval_s)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.terminate()
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
     return rc
 
 

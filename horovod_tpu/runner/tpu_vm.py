@@ -81,22 +81,24 @@ def _coordinator_env(coord_ip: str, ports: Sequence[int]) -> Dict[str, str]:
 
 
 def tpu_vm_worker_env(args, endpoints: Sequence[TPUEndpoint],
-                      worker_id: int, slots: int,
+                      worker_id: int,
                       ports: Sequence[int]) -> Dict[str, str]:
     """The HOROVOD_* env for one slice worker.
 
-    Rank layout matches ``worker_envs`` (runner/run.py): ranks are
-    contiguous per host, cross_rank = worker index — on a TPU slice the
-    worker index IS the ICI-topology order the runtime expects.
+    One launched process per host (rank = cross_rank = worker index): on a
+    TPU slice the worker index IS the ICI-topology order the runtime
+    expects, and the process drives all of the host's local chips
+    (jax auto-detects them — no per-slot process fan-out, which is why
+    ``--slots-per-host`` is rejected for this backend at parse time).
     """
     from .run import tuning_env
     n_hosts = len(endpoints)
     env = _coordinator_env(endpoints[0].internal_ip, ports)
     env |= {
-        "HOROVOD_RANK": str(worker_id * slots),
-        "HOROVOD_SIZE": str(n_hosts * slots),
+        "HOROVOD_RANK": str(worker_id),
+        "HOROVOD_SIZE": str(n_hosts),
         "HOROVOD_LOCAL_RANK": "0",
-        "HOROVOD_LOCAL_SIZE": str(slots),
+        "HOROVOD_LOCAL_SIZE": "1",
         "HOROVOD_CROSS_RANK": str(worker_id),
         "HOROVOD_CROSS_SIZE": str(n_hosts),
         "HOROVOD_HOSTNAME": f"worker-{worker_id}",
@@ -110,7 +112,6 @@ def tpu_vm_worker_env(args, endpoints: Sequence[TPUEndpoint],
 def tpu_vm_ssh_commands(args, endpoints: Sequence[TPUEndpoint],
                         ports: Sequence[int]) -> List[List[str]]:
     """One ``gcloud compute tpus tpu-vm ssh --worker=N`` argv per worker."""
-    slots = getattr(args, "slots_per_host", None) or 1
     cmds = []
     inner = " ".join(shlex.quote(c) for c in args.command)
     # Same cwd convention as the plain ssh backend (ssh_command): the
@@ -118,7 +119,7 @@ def tpu_vm_ssh_commands(args, endpoints: Sequence[TPUEndpoint],
     # every worker (the standard TPU-VM NFS/rsync workflow).
     cwd = shlex.quote(os.getcwd())
     for ep in endpoints:
-        env = tpu_vm_worker_env(args, endpoints, ep.worker_id, slots, ports)
+        env = tpu_vm_worker_env(args, endpoints, ep.worker_id, ports)
         exports = " ".join(f"{k}={shlex.quote(v)}"
                            for k, v in sorted(env.items()))
         remote = f"cd {cwd} && env {exports} {inner}"
@@ -176,10 +177,10 @@ spec:
               args:
               - >-
                 HOROVOD_CROSS_RANK=$JOB_COMPLETION_INDEX
-                HOROVOD_RANK=$((JOB_COMPLETION_INDEX * {slots}))
-                HOROVOD_SIZE={world}
+                HOROVOD_RANK=$JOB_COMPLETION_INDEX
+                HOROVOD_SIZE={n_hosts}
                 HOROVOD_LOCAL_RANK=0
-                HOROVOD_LOCAL_SIZE={slots}
+                HOROVOD_LOCAL_SIZE=1
                 HOROVOD_CROSS_SIZE={n_hosts}
                 HOROVOD_CONTROLLER_ADDR={name}-workers-0-0.{name}
                 HOROVOD_CONTROLLER_PORT=29400
@@ -204,16 +205,16 @@ def render_gke_jobset(args, n_hosts: int) -> str:
     ``--gke-topology`` — they are REQUIRED knowledge the user has and this
     code cannot infer (topologies are generation-specific, e.g. 3-D on
     v4/v5p, 2-D on v5e/v6e).
+
+    One pod per host, rank = completion index (same one-process-per-host
+    model as the TPU-VM backend; the pod drives all its local chips).
     """
-    slots = getattr(args, "slots_per_host", None) or 1
     from .run import tuning_env
     extra_env = " ".join(
         f"{k}={v}" for k, v in sorted(tuning_env(args).items()))
     return _JOBSET_TEMPLATE.format(
         name=args.gke_jobset,
         n_hosts=n_hosts,
-        world=n_hosts * slots,
-        slots=slots,
         image=args.container_image,
         command=((extra_env + " ") if extra_env else "")
         + " ".join(shlex.quote(c) for c in args.command),

@@ -302,10 +302,12 @@ def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None,
 
 
 def allgather_object(obj, name: Optional[str] = None,
-                     process_set: Optional[ProcessSet] = None):
+                     process_set: Optional[ProcessSet] = None,
+                     per_rank: Optional[bool] = None):
     """List of every rank's pickled object (reference:
     ``horovod/torch/mpi_ops.py allgather_object``)."""
-    return eager.allgather_object(obj, name=name, process_set=process_set)
+    return eager.allgather_object(obj, name=name, process_set=process_set,
+                                  per_rank=per_rank)
 
 
 # ------------------------------------------------------------------ alltoall

@@ -309,3 +309,14 @@ def test_allgather_object(hvd, world_size):
     # Replicated single object form.
     out2 = hvd.allgather_object({"same": 1})
     assert out2 == [{"same": 1}] * world_size
+    # per_rank=False replicates a list payload VERBATIM even when its
+    # length happens to equal world (the legacy sniff would misread it
+    # as per-rank objects).
+    payload = list(range(world_size))
+    out3 = hvd.allgather_object(payload, per_rank=False)
+    assert out3 == [payload] * world_size
+    # per_rank=True demands an exact per-rank list.
+    out4 = hvd.allgather_object(objs, per_rank=True)
+    assert out4 == objs
+    with pytest.raises(ValueError, match="per_rank=True"):
+        hvd.allgather_object({"not": "a list"}, per_rank=True)

@@ -297,18 +297,31 @@ class TestTPUVMBackend:
             assert remote.endswith("python train.py")
             assert ["--project", "proj"] == cmd[-2:]
 
-    def test_tpu_vm_slots_per_host(self):
+    def test_tpu_vm_slots_per_host_rejected(self):
+        # --slots-per-host > 1 with a cluster backend would advertise
+        # SIZE=hosts*slots while launching one process per host — every
+        # worker would hang at rendezvous.  Rejected at parse time.
+        from horovod_tpu.runner.run import parse_args
+
+        with pytest.raises(SystemExit):
+            parse_args(["--tpu", "s", "--zone", "z",
+                        "--slots-per-host", "4", "python", "t.py"])
+        # slots-per-host 1 (the only coherent value) is accepted.
+        args = parse_args(["--tpu", "s", "--zone", "z",
+                           "--slots-per-host", "1", "python", "t.py"])
+        assert args.tpu == "s"
+
+    def test_tpu_vm_one_rank_per_host(self):
         from horovod_tpu.runner.run import parse_args
         from horovod_tpu.runner import tpu_vm
 
-        args = parse_args(["--tpu", "s", "--zone", "z",
-                           "--slots-per-host", "4", "python", "t.py"])
+        args = parse_args(["--tpu", "s", "--zone", "z", "python", "t.py"])
         eps = [tpu_vm.TPUEndpoint(i, f"10.0.0.{i + 1}") for i in range(2)]
         cmds = tpu_vm.tpu_vm_ssh_commands(args, eps, ports=(1, 2))
         r1 = cmds[1][cmds[1].index("--command") + 1]
-        assert "HOROVOD_RANK=4" in r1          # contiguous per host
-        assert "HOROVOD_SIZE=8" in r1
-        assert "HOROVOD_LOCAL_SIZE=4" in r1
+        assert "HOROVOD_RANK=1" in r1          # rank == worker index
+        assert "HOROVOD_SIZE=2" in r1
+        assert "HOROVOD_LOCAL_SIZE=1" in r1
 
     def test_run_tpu_vm_propagates_failure(self):
         from horovod_tpu.runner.run import parse_args
@@ -340,7 +353,6 @@ class TestTPUVMBackend:
 
         args = parse_args(["--gke-jobset", "train", "--container-image",
                            "gcr.io/p/img:1", "--gke-num-hosts", "4",
-                           "--slots-per-host", "4",
                            "--gke-accelerator", "tpu-v5p-slice",
                            "--gke-topology", "2x2x4",
                            "--cycle-time-ms", "5",
@@ -353,7 +365,8 @@ class TestTPUVMBackend:
         assert "gke-tpu-accelerator: tpu-v5p-slice" in y
         assert "gke-tpu-topology: 2x2x4" in y
         assert "HOROVOD_CROSS_RANK=$JOB_COMPLETION_INDEX" in y
-        assert "HOROVOD_SIZE=16" in y
+        assert "HOROVOD_SIZE=4" in y           # one rank per host
+        assert "HOROVOD_LOCAL_SIZE=1" in y
         assert "HOROVOD_CONTROLLER_ADDR=train-workers-0-0.train" in y
         assert "HOROVOD_CYCLE_TIME=5" in y      # tuning knobs forwarded
         assert "python train.py --lr 0.1" in y

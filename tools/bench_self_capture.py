@@ -168,7 +168,21 @@ def main():
             datetime.timezone.utc).isoformat(),
         "sections": {},
     }
-    if not args.skip_flash_check:
+    # Merge into an existing artifact: a flaky tunnel means captures run in
+    # more than one healthy window (tools/probe_loop.py re-invokes with only
+    # the still-missing modes) — a fresh doc must not wipe earlier sections.
+    try:
+        with open(args.out) as fh:
+            prior = json.load(fh)
+        doc["sections"] = prior.get("sections", {})
+        doc["captured_utc"] = prior.get("captured_utc", doc["captured_utc"])
+        doc["updated_utc"] = datetime.datetime.now(
+            datetime.timezone.utc).isoformat()
+    except (OSError, json.JSONDecodeError):
+        pass
+    flash_done = ("flash_numeric_check" in doc["sections"]
+                  and "error" not in doc["sections"]["flash_numeric_check"])
+    if not args.skip_flash_check and not flash_done:
         print("[capture] flash numeric check ...", flush=True)
         doc["sections"]["flash_numeric_check"] = flash_numeric_check()
         _write(args.out, doc)

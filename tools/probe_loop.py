@@ -1,0 +1,110 @@
+"""Chip-window catcher: probe the TPU tunnel forever, capture on success.
+
+VERDICT r4 #1: four rounds with zero driver-verified on-TPU numbers
+because the axon tunnel was down whenever a bench ran.  This loop makes
+catching the window the *strategy* rather than a hope:
+
+- every ``--interval`` seconds, probe the chip in a fresh subprocess
+  (a real ``jnp.ones @ jnp.ones`` on device, ``--probe-timeout`` cap —
+  a wedged backend cannot wedge the loop);
+- append one JSON line per attempt to ``PROBE_r05.jsonl`` (the logged
+  probe history that proves the tunnel never opened, if it never does);
+- the moment a probe succeeds, run ``tools/bench_self_capture.py`` for
+  whichever modes are still missing or errored in the output artifact,
+  then keep probing — a later healthy window retries only the failed
+  sections (the capture file is written incrementally per section).
+
+Run detached at session start:
+
+    nohup python tools/probe_loop.py --out BENCH_SELF_r05.json &
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROBE_SRC = ("import jax, jax.numpy as jnp; x = jnp.ones((8, 128)); "
+             "v = float((x @ x.T).sum()); "
+             "print('PROBE_OK', v, jax.devices()[0].device_kind)")
+
+
+def probe(timeout_s: int) -> dict:
+    t0 = time.time()
+    rec = {"ts": datetime.datetime.now(datetime.timezone.utc).isoformat()}
+    try:
+        r = subprocess.run([sys.executable, "-c", PROBE_SRC],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, cwd=REPO)
+        ok = r.returncode == 0 and "PROBE_OK" in r.stdout
+        rec |= {"ok": ok, "wall_s": round(time.time() - t0, 1)}
+        if ok:
+            rec["device_kind"] = r.stdout.split()[-1]
+        else:
+            rec["error"] = f"rc={r.returncode}: " + r.stderr[-300:]
+    except subprocess.TimeoutExpired:
+        rec |= {"ok": False, "wall_s": round(time.time() - t0, 1),
+                "error": f"probe timed out after {timeout_s}s"}
+    except Exception as exc:  # noqa: BLE001
+        rec |= {"ok": False, "error": repr(exc)}
+    return rec
+
+
+def missing_modes(out_path: str) -> list[str]:
+    """Modes not yet captured cleanly in the artifact (order preserved)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from bench_self_capture import MODES
+    try:
+        with open(out_path) as fh:
+            sections = json.load(fh).get("sections", {})
+    except (OSError, json.JSONDecodeError):
+        return list(MODES)
+    todo = []
+    for m in MODES:
+        sec = sections.get(m)
+        result = (sec or {}).get("result", {})
+        if sec is None or "error" in result:
+            todo.append(m)
+    return todo
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "BENCH_SELF_r05.json"))
+    ap.add_argument("--log", default=os.path.join(REPO, "PROBE_r05.jsonl"))
+    ap.add_argument("--interval", type=float, default=300)
+    ap.add_argument("--probe-timeout", type=int, default=240)
+    ap.add_argument("--max-hours", type=float, default=11.0)
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600
+    while time.time() < deadline:
+        rec = probe(args.probe_timeout)
+        todo = missing_modes(args.out)
+        rec["modes_pending"] = todo
+        with open(args.log, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+        print(f"[probe] {rec}", flush=True)
+        if rec.get("ok") and todo:
+            print(f"[probe] chip UP — capturing {todo}", flush=True)
+            subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "bench_self_capture.py"),
+                 "--out", args.out, "--modes", ",".join(todo)],
+                cwd=REPO)
+        elif rec.get("ok"):
+            print("[probe] chip UP and all modes captured — idling",
+                  flush=True)
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()
