@@ -98,8 +98,7 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None,
             # torovodrun spawns one process per rank (reference §3.3); a
             # one-process-per-host TPU pod sets HOROVOD_ONE_PROC_PER_HOST
             # and lets jax auto-detect instead.
-            from .config import _env_bool
-            if _env_bool("ONE_PROC_PER_HOST", False):
+            if cfg.one_proc_per_host:
                 jax.distributed.initialize()
             else:
                 jax.distributed.initialize(
@@ -199,19 +198,25 @@ def rank() -> int:
     """This process's rank.
 
     Launcher-provided HOROVOD_RANK wins (one-process-per-device launches);
-    otherwise the global rank of this process's first local device.
+    otherwise the global rank of this process's first local device.  In
+    pod mode (HOROVOD_ONE_PROC_PER_HOST) the env value describes the
+    PROCESS world for the control plane, not the device world — rank() is
+    always topology-derived there so ``dataset.shard(size(), rank())``
+    stays consistent with size() on multi-chip hosts.
     """
     t = _topo()
-    env = _cfg().rank_env
-    if env >= 0:
-        return env
+    cfg = _cfg()
+    if cfg.rank_env >= 0 and not cfg.one_proc_per_host:
+        return cfg.rank_env
     mine = t.ranks_of_process(t.my_process)
     return mine[0] if mine else 0
 
 
 def local_size() -> int:
-    env = _cfg().local_size_env
-    return env if env > 0 else _topo().local_size
+    cfg = _cfg()
+    if cfg.local_size_env > 0 and not cfg.one_proc_per_host:
+        return cfg.local_size_env
+    return _topo().local_size
 
 
 def local_rank() -> int:
@@ -219,11 +224,11 @@ def local_rank() -> int:
 
     Launcher-provided HOROVOD_LOCAL_RANK wins (it knows host boundaries
     even when several single-device processes share one physical host);
-    otherwise derived from the device topology.
+    otherwise — and always in pod mode — derived from the device topology.
     """
-    env = _cfg().local_rank_env
-    if env >= 0:
-        return env
+    cfg = _cfg()
+    if cfg.local_rank_env >= 0 and not cfg.one_proc_per_host:
+        return cfg.local_rank_env
     t = _topo()
     mine = t.ranks_of_process(t.my_process)
     if not mine:

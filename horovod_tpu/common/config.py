@@ -115,6 +115,16 @@ class Config:
     num_collective_streams: int = 1
     donate_fusion_buffers: bool = True
     mesh_axis_name: str = "hvd"
+    # Run the coordinator cycle inline on the submitting thread for blocking
+    # single-controller ops (HOROVOD_INLINE_KICK; the small-tensor latency
+    # fast path — off = legacy wake-the-cycle-thread dispatch).
+    inline_kick: bool = True
+    # Pod mode (HOROVOD_ONE_PROC_PER_HOST): one launched process drives all
+    # of its host's chips.  jax.distributed auto-detects the world, and
+    # rank()/local_rank()/local_size() come from the device topology — the
+    # launcher's env values describe the PROCESS world (control plane),
+    # not the device world.
+    one_proc_per_host: bool = False
 
     # Control plane (multi-process mode). Set by the launcher.
     controller_addr: str = ""
@@ -153,6 +163,8 @@ class Config:
             batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),
             num_collective_streams=_env_int("NUM_STREAMS", 1),
             donate_fusion_buffers=_env_bool("DONATE_FUSION_BUFFERS", True),
+            inline_kick=_env_bool("INLINE_KICK", True),
+            one_proc_per_host=_env_bool("ONE_PROC_PER_HOST", False),
             controller_addr=_env("CONTROLLER_ADDR", "") or "",
             controller_port=_env_int("CONTROLLER_PORT", 0),
             controller_port2=_env_int("CONTROLLER_PORT2", 0),

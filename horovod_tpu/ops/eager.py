@@ -398,12 +398,14 @@ def allgather_object(obj, name: Optional[str] = None,
     mode: a list with one object per rank, or a single object to
     replicate.
 
-    ``per_rank`` disambiguates list payloads in single-controller mode
-    (where type-sniffing is otherwise the only signal): ``True`` means
-    ``obj`` IS the per-rank list (must have ``world`` entries), ``False``
-    means ``obj`` is one object to replicate verbatim — even when it
-    happens to be a list of length ``world``.  The default ``None``
-    keeps the legacy sniff (list/tuple of length ``world`` → per-rank).
+    ``per_rank`` disambiguates list payloads (where type-sniffing is
+    otherwise the only signal): ``True`` means ``obj`` IS the list of
+    per-rank objects this caller speaks for (``world`` entries in
+    single-controller mode, ``n_local`` in a multi-device process);
+    ``False`` means ``obj`` is ONE object contributed verbatim for every
+    rank this caller speaks for — even when it happens to be a list of
+    the magic length.  The default ``None`` keeps the legacy sniff.
+    Portable scripts can pass ``per_rank=False`` under every launch mode.
     """
     import pickle
     st = basics._get_state()
@@ -413,20 +415,25 @@ def allgather_object(obj, name: Optional[str] = None,
     if per_process_mode():
         n_local = len([d for d in ps.mesh.devices.flat
                        if d.process_index == jax.process_index()])
-        if per_rank is not None:
-            raise ValueError(
-                "per_rank is a single-controller disambiguator; in "
-                "multi-process mode pass this rank's own object (or a "
-                "per-local-rank list for a multi-device process)")
         if n_local > 1:
-            objs = list(obj) if isinstance(obj, (list, tuple)) else None
-            if objs is None or len(objs) != n_local:
-                raise ValueError(
-                    f"Multi-device process: pass a list of {n_local} "
-                    f"per-local-rank objects")
+            if per_rank is False:
+                objs = [obj] * n_local
+            else:
+                objs = list(obj) if isinstance(obj, (list, tuple)) else None
+                if objs is None or len(objs) != n_local:
+                    raise ValueError(
+                        f"Multi-device process: pass a list of {n_local} "
+                        f"per-local-rank objects (or per_rank=False to "
+                        f"contribute one object for all local ranks)")
             payloads = [np.frombuffer(pickle.dumps(o), np.uint8)
                         for o in objs]
         else:
+            if per_rank is True:
+                if not isinstance(obj, (list, tuple)) or len(obj) != 1:
+                    raise ValueError(
+                        "per_rank=True in a single-device process: pass "
+                        "a 1-list holding this rank's object")
+                obj = obj[0]
             payloads = [np.frombuffer(pickle.dumps(obj), np.uint8)]
     else:
         if per_rank is True:

@@ -241,6 +241,7 @@ class CollectiveEngine:
                                     cfg.stall_shutdown_time_s,
                                     cfg.stall_check_disable)
         self.cycle_time_s = cfg.cycle_time_ms / 1000.0
+        self.inline_kick = cfg.inline_kick
         self.fusion_threshold = cfg.fusion_threshold_bytes
         self.hierarchical_allreduce = cfg.hierarchical_allreduce
         self.hierarchical_allgather = cfg.hierarchical_allgather
@@ -364,8 +365,13 @@ class CollectiveEngine:
         preserving fusion (a concurrent burst drains into the same cycle).
         Multi-process mode: negotiation must stay on the lock-step cycle
         thread; just wake it.
+
+        ``HOROVOD_INLINE_KICK=0`` disables the inline path (falling back to
+        waking the cycle thread) — the A/B knob behind the recorded
+        inline-vs-threaded dispatch-latency evidence
+        (``tools/latency_evidence.py``).
         """
-        if self.controller is None:
+        if self.controller is None and self.inline_kick:
             self.run_loop_once()
         else:
             self._wake.set()

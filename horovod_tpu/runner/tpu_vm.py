@@ -95,6 +95,11 @@ def tpu_vm_worker_env(args, endpoints: Sequence[TPUEndpoint],
     n_hosts = len(endpoints)
     env = _coordinator_env(endpoints[0].internal_ip, ports)
     env |= {
+        # Process-world values (control plane: TCP controller rank/world).
+        # HOROVOD_ONE_PROC_PER_HOST makes the device-world accessors
+        # (rank/local_rank/local_size) topology-derived instead — on a
+        # multi-chip host rank() must be the first local chip's global
+        # rank, not the host index.
         "HOROVOD_RANK": str(worker_id),
         "HOROVOD_SIZE": str(n_hosts),
         "HOROVOD_LOCAL_RANK": "0",
@@ -102,6 +107,7 @@ def tpu_vm_worker_env(args, endpoints: Sequence[TPUEndpoint],
         "HOROVOD_CROSS_RANK": str(worker_id),
         "HOROVOD_CROSS_SIZE": str(n_hosts),
         "HOROVOD_HOSTNAME": f"worker-{worker_id}",
+        "HOROVOD_ONE_PROC_PER_HOST": "1",
     }
     env |= tuning_env(args)   # same knob forwarding as every other backend
     if getattr(args, "timeline_filename", None):
@@ -182,6 +188,7 @@ spec:
                 HOROVOD_LOCAL_RANK=0
                 HOROVOD_LOCAL_SIZE=1
                 HOROVOD_CROSS_SIZE={n_hosts}
+                HOROVOD_ONE_PROC_PER_HOST=1
                 HOROVOD_CONTROLLER_ADDR={name}-workers-0-0.{name}
                 HOROVOD_CONTROLLER_PORT=29400
                 HOROVOD_CONTROLLER_PORT2=29401

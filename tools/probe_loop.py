@@ -31,9 +31,11 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-PROBE_SRC = ("import jax, jax.numpy as jnp; x = jnp.ones((8, 128)); "
+PROBE_SRC = ("import json, jax, jax.numpy as jnp; x = jnp.ones((8, 128)); "
              "v = float((x @ x.T).sum()); "
-             "print('PROBE_OK', v, jax.devices()[0].device_kind)")
+             "print('PROBE_OK ' + json.dumps({'matmul_sum': v, "
+             "'device_kind': jax.devices()[0].device_kind, "
+             "'platform': jax.devices()[0].platform}))")
 
 
 def probe(timeout_s: int) -> dict:
@@ -43,10 +45,12 @@ def probe(timeout_s: int) -> dict:
         r = subprocess.run([sys.executable, "-c", PROBE_SRC],
                            capture_output=True, text=True,
                            timeout=timeout_s, cwd=REPO)
-        ok = r.returncode == 0 and "PROBE_OK" in r.stdout
+        ok = r.returncode == 0 and "PROBE_OK " in r.stdout
         rec |= {"ok": ok, "wall_s": round(time.time() - t0, 1)}
         if ok:
-            rec["device_kind"] = r.stdout.split()[-1]
+            line = next(ln for ln in r.stdout.splitlines()
+                        if ln.startswith("PROBE_OK "))
+            rec["device"] = json.loads(line[len("PROBE_OK "):])
         else:
             rec["error"] = f"rc={r.returncode}: " + r.stderr[-300:]
     except subprocess.TimeoutExpired:
@@ -57,22 +61,33 @@ def probe(timeout_s: int) -> dict:
     return rec
 
 
-def missing_modes(out_path: str) -> list[str]:
-    """Modes not yet captured cleanly in the artifact (order preserved)."""
+def _degraded(result: dict) -> bool:
+    """A section is degraded if it failed outright OR its bench line
+    carries per-section errors (bench.py's watchdog still emits one JSON
+    line with a populated ``errors`` dict on partial failure)."""
+    return bool(result.get("error")) or bool(result.get("errors"))
+
+
+def pending_work(out_path: str) -> tuple[list[str], bool]:
+    """(modes still needing capture, flash-check still needing capture).
+
+    Order preserved; modes that failed in an earlier window count as
+    pending again — the retry cap lives in the caller (``attempts``)."""
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from bench_self_capture import MODES
     try:
         with open(out_path) as fh:
             sections = json.load(fh).get("sections", {})
     except (OSError, json.JSONDecodeError):
-        return list(MODES)
+        return list(MODES), True
     todo = []
     for m in MODES:
         sec = sections.get(m)
-        result = (sec or {}).get("result", {})
-        if sec is None or "error" in result:
+        if sec is None or _degraded(sec.get("result", {})):
             todo.append(m)
-    return todo
+    flash = sections.get("flash_numeric_check")
+    flash_todo = flash is None or bool(flash.get("error"))
+    return todo, flash_todo
 
 
 def main():
@@ -83,26 +98,40 @@ def main():
     ap.add_argument("--interval", type=float, default=300)
     ap.add_argument("--probe-timeout", type=int, default=240)
     ap.add_argument("--max-hours", type=float, default=11.0)
+    ap.add_argument("--max-attempts", type=int, default=3,
+                    help="capture attempts per mode before giving up "
+                         "(a persistently-failing section must not be "
+                         "re-run every probe cycle)")
     args = ap.parse_args()
 
     deadline = time.time() + args.max_hours * 3600
+    attempts: dict[str, int] = {}   # per-mode capture attempts this loop
     while time.time() < deadline:
         rec = probe(args.probe_timeout)
-        todo = missing_modes(args.out)
-        rec["modes_pending"] = todo
+        todo, flash_todo = pending_work(args.out)
+        todo = [m for m in todo if attempts.get(m, 0) < args.max_attempts]
+        flash_todo = (flash_todo
+                      and attempts.get("flash", 0) < args.max_attempts)
+        rec["modes_pending"] = todo + (["flash_numeric_check"]
+                                      if flash_todo else [])
         with open(args.log, "a") as fh:
             fh.write(json.dumps(rec) + "\n")
         print(f"[probe] {rec}", flush=True)
-        if rec.get("ok") and todo:
-            print(f"[probe] chip UP — capturing {todo}", flush=True)
-            subprocess.run(
-                [sys.executable,
-                 os.path.join(REPO, "tools", "bench_self_capture.py"),
-                 "--out", args.out, "--modes", ",".join(todo)],
-                cwd=REPO)
-        elif rec.get("ok"):
-            print("[probe] chip UP and all modes captured — idling",
+        if rec.get("ok") and (todo or flash_todo):
+            print(f"[probe] chip UP — capturing {rec['modes_pending']}",
                   flush=True)
+            for m in todo:
+                attempts[m] = attempts.get(m, 0) + 1
+            if flash_todo:
+                attempts["flash"] = attempts.get("flash", 0) + 1
+            cmd = [sys.executable,
+                   os.path.join(REPO, "tools", "bench_self_capture.py"),
+                   "--out", args.out, "--modes", ",".join(todo)]
+            if not flash_todo:
+                cmd.append("--skip-flash-check")
+            subprocess.run(cmd, cwd=REPO)
+        elif rec.get("ok"):
+            print("[probe] chip UP, nothing pending — idling", flush=True)
         time.sleep(args.interval)
 
 
