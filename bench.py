@@ -40,7 +40,7 @@ never a silent 900s burn.
 
 Env overrides: HVD_BENCH_BATCH, HVD_BENCH_STEPS, HVD_BENCH_IMAGE,
 HVD_BENCH_SIZES_MB (comma list),
-HVD_BENCH_MODEL=resnet50|llama|bert|tf_step,
+HVD_BENCH_MODEL=resnet50|llama|bert|tf_step|decode,
 HVD_BENCH_SKIP_RAW=1, HVD_BENCH_SKIP_BUSBW=1, HVD_BENCH_SKIP_AUTOTUNE=1,
 HVD_BENCH_AUTOTUNE_STEPS, HVD_BENCH_BATCH_SWEEP (comma list of per-chip
 batches, each recorded with img/s + HBM memory analysis), HVD_BENCH_MINIMAL=1,
@@ -447,6 +447,61 @@ def bench_llama(batch, steps):
     return batch * seq * steps / dt
 
 
+def bench_decode(batch, steps):
+    """Inference throughput on the flagship llama (beyond-ref: Horovod
+    ships no inference path): blockwise-flash prefill tokens/s and
+    steady-state KV-cache decode tokens/s, single chip, greedy.  The
+    prefill number is the batched-attention path (one pass over layers);
+    decode is the sequential per-token path — the two regimes a serving
+    stack cares about."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from horovod_tpu.models import llama
+    from horovod_tpu.ops.flash_attention import flash_enabled
+
+    cfg = llama.LlamaConfig(vocab_size=8192, d_model=512, n_layers=4,
+                            n_heads=8, n_kv_heads=4, d_ff=1536, max_seq=512,
+                            dtype=jnp.bfloat16 if _on_tpu() else jnp.float32,
+                            dp_axis=None, tp_axis=None, sp_axis=None)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    T0 = 256
+    n_new = max(8, steps)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, T0)),
+                         jnp.int32)
+
+    # Prefill phase alone (jitted once, timed over repeats).
+    pf = jax.jit(lambda p, c, t: llama.prefill(p, c, t, cfg))
+    cache0 = llama.init_cache(cfg, batch, T0 + n_new)
+    logits, cache = pf(params, cache0, prompt)
+    jax.block_until_ready(logits)
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        logits, cache = pf(params, cache0, prompt)
+    jax.block_until_ready(logits)
+    prefill_s = (time.perf_counter() - t0) / reps
+    prefill_tps = batch * T0 / prefill_s
+
+    # Steady-state decode: n_new sequential cached steps via generate's
+    # scan (includes the sampling argmax).
+    gen = jax.jit(lambda p, t: llama.generate(p, t, n_new, cfg,
+                                              max_seq=T0 + n_new))
+    toks = gen(params, prompt)
+    jax.block_until_ready(toks)
+    t0 = time.perf_counter()
+    toks = gen(params, prompt)
+    jax.block_until_ready(toks)
+    gen_s = time.perf_counter() - t0
+    decode_s = max(1e-9, gen_s - prefill_s)   # generate = prefill + decode
+    decode_tps = batch * n_new / decode_s
+    _record_timing("decode", warmup=1, iters=1, wall_s=gen_s,
+                   prefill_wall_s=prefill_s, batch=batch, prompt_len=T0,
+                   new_tokens=n_new, flash=flash_enabled())
+    return prefill_tps, decode_tps
+
+
 def bench_bert(batch, steps):
     """BASELINE config #3: BERT MLM pretraining through the framework path —
     DistributedOptimizer with fp16-compressed fused allreduce inside a
@@ -842,6 +897,23 @@ def _run(out, errors):
             out["value"] = round(tps / world, 2)
         except Exception as exc:  # noqa: BLE001 - contained like the rest
             errors["llama"] = repr(exc)
+        return
+
+    if model == "decode":
+        out.update({"metric": "llama_decode_tokens_per_sec",
+                    "value": None, "unit": "tokens/sec",
+                    "vs_baseline": None,
+                    "vs_baseline_def": "no reference analogue (Horovod "
+                                       "ships no inference path)"})
+        try:
+            # Decode batch is a serving-shaped batch, not the training
+            # per-chip batch.
+            dbatch = int(os.environ.get("HVD_BENCH_DECODE_BATCH", "8"))
+            prefill_tps, decode_tps = bench_decode(dbatch, steps)
+            out.update({"value": round(decode_tps, 2),
+                        "prefill_tokens_per_sec": round(prefill_tps, 2)})
+        except Exception as exc:  # noqa: BLE001 - contained like the rest
+            errors["decode"] = repr(exc)
         return
 
     if model == "tf_step":

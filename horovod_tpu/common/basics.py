@@ -94,19 +94,24 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None,
         # jax.distributed.initialize — any backend query finalizes the
         # single-process world.
         from jax._src import distributed as _jdist
-        if multi_process and _jdist.global_state.client is None:
-            # torovodrun spawns one process per rank (reference §3.3); a
-            # one-process-per-host TPU pod sets HOROVOD_ONE_PROC_PER_HOST
-            # and lets jax auto-detect instead.
-            if cfg.one_proc_per_host:
-                jax.distributed.initialize()
-            else:
+        if _jdist.global_state.client is None:
+            # torovodrun spawns one process per rank (reference §3.3) and
+            # provides the coordinator; in pod mode
+            # (HOROVOD_ONE_PROC_PER_HOST) each process drives ALL its
+            # local devices — the process world still forms at the
+            # launcher's coordinator when one is given (rank/size env are
+            # PROCESS values there), and falls back to TPU-metadata
+            # auto-detection without one (SPMD-only: the eager engine's
+            # negotiation controller needs a launcher; enqueue guards it).
+            if multi_process:
                 jax.distributed.initialize(
                     coordinator_address=(
                         f"{cfg.controller_addr}:{cfg.controller_port}"),
                     num_processes=cfg.size_env,
                     process_id=cfg.rank_env,
                 )
+            elif cfg.one_proc_per_host and not cfg.controller_addr:
+                jax.distributed.initialize()
 
         st.topology = build_topology(axis_name=axis_name, devices=devices)
         gs = st.process_set_table.initialize(

@@ -45,11 +45,30 @@ class MoEConfig:
     capacity_factor: float = 1.25
     ep_axis: Optional[str] = "ep"      # None = all experts local
     router_noise: float = 0.0          # jitter std during training
+    # Routing family: "tokens" = token-choice (each token picks its
+    # top-k experts; Switch/GShard) — "expert_choice" = each expert
+    # picks its top-C tokens (Zhou et al. 2022): perfect static load
+    # balance by construction (every expert exactly full, no aux loss
+    # needed), tokens may be served by 0..E experts (0 ⇒ residual
+    # identity, like a capacity drop).
+    router_mode: str = "tokens"
+    # Experts per token: 1 = Switch (raw top-1 gate), k>=2 = GShard-style
+    # top-k with gates NORMALIZED over the selected experts.  Token-choice
+    # only (expert_choice fixes fan-in via capacity instead).
+    router_top_k: int = 1
+    # ST-MoE router z-loss weight (mean logsumexp(logits)^2): keeps router
+    # logits small/stable in bf16 training.  0 = off.  Applied by the
+    # training paths (lm_loss here, llama.loss_fn) as an ABSOLUTE weight,
+    # like aux_weight.
+    router_z_weight: float = 0.0
     dtype: Any = jnp.float32
 
     def capacity(self, tokens_per_rank: int) -> int:
-        """Per-(source-rank, expert) token slots: static by construction."""
-        return max(1, int(np.ceil(tokens_per_rank / self.n_experts
+        """Per-(source-rank, expert) token slots: static by construction.
+        Top-k routing makes k assignments per token, so the slot budget
+        scales with k (GShard's capacity definition)."""
+        return max(1, int(np.ceil(tokens_per_rank * self.router_top_k
+                                  / self.n_experts
                                   * self.capacity_factor)))
 
 
@@ -73,60 +92,117 @@ def param_specs(cfg: MoEConfig) -> Dict:
 
 
 def _route(x, router_w, cfg: MoEConfig, rng: Optional[jax.Array]):
-    """Top-1 routing with static capacity.
+    """Top-k routing with static capacity (Switch for k=1, GShard for
+    k>=2).
 
     Returns (dispatch [S, E, C] one-hot, combine [S, E, C] gate-weighted,
-    aux_loss scalar).  Position of a token within its expert's capacity
-    buffer comes from a cumsum over the expert's one-hot column —
-    deterministic, order-preserving, shape-static.
+    aux_loss scalar, z_loss scalar).  Position of a token within its
+    expert's capacity buffer comes from a cumsum over the expert's
+    one-hot column, with later choices slotted AFTER all earlier
+    choices' tokens (choice priority: a token's second expert never
+    evicts another token's first) — deterministic, order-preserving,
+    shape-static.
+
+    Gates: k=1 uses the raw router probability (Switch); k>=2 normalizes
+    the selected probabilities to sum to 1 (GShard) so the combined
+    output is a convex mixture of the chosen experts.
     """
     S = x.shape[0]
+    E = cfg.n_experts
+    K = cfg.router_top_k
+    if cfg.router_mode not in ("tokens", "expert_choice"):
+        raise ValueError(f"router_mode must be 'tokens' or "
+                         f"'expert_choice', got {cfg.router_mode!r}")
+    if cfg.router_mode == "expert_choice" and K != 1:
+        raise ValueError("expert_choice routing fixes per-expert fan-in "
+                         "via capacity; router_top_k must stay 1")
+    if not 1 <= K <= E:
+        raise ValueError(f"router_top_k={K} must be in [1, {E}]")
     C = cfg.capacity(S)
     logits = (x.astype(jnp.float32)
               @ router_w.astype(jnp.float32))          # [S, E]
     if cfg.router_noise > 0.0:
         if rng is None:
             raise ValueError(
-                "MoEConfig.router_noise > 0 requires passing rng= to "
-                "moe_ffn (the bundled lm_loss training path is "
-                "deterministic and does not thread one)")
+                "MoEConfig.router_noise > 0 requires threading rng= "
+                "through moe_ffn / lm_loss / llama loss_fn")
         logits = logits + cfg.router_noise * jax.random.normal(
             rng, logits.shape, jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                # [S]
-    onehot = jax.nn.one_hot(expert, cfg.n_experts,
-                            dtype=jnp.float32)         # [S, E]
-    gate = jnp.sum(probs * onehot, axis=-1)            # [S]
+    # ST-MoE router z-loss: penalize large logits (logsumexp^2) — applied
+    # by the caller with cfg.router_z_weight.
+    z = jax.scipy.special.logsumexp(logits, axis=-1)   # [S]
+    z_loss = jnp.mean(jnp.square(z))
 
-    # Position within the expert's buffer; tokens past capacity drop out.
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0    # [S, E], -1 if other
-    pos_in_expert = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [S]
-    keep = (pos_in_expert < C) & (pos_in_expert >= 0)
-    pos_oh = jax.nn.one_hot(pos_in_expert, C, dtype=jnp.float32)  # [S, C]
-    dispatch = (onehot * keep[:, None])[:, :, None] * pos_oh[:, None, :]
-    combine = dispatch * gate[:, None, None]
+    if cfg.router_mode == "expert_choice":
+        if C > S:
+            raise ValueError(f"expert_choice capacity {C} exceeds tokens "
+                             f"{S}; lower capacity_factor")
+        # Each expert takes its top-C tokens by router prob: [E, C]
+        # scores + token ids.  top_k's gradient flows to the selected
+        # probs through g; selection itself is non-differentiable, as in
+        # every hard router.
+        g, idx = lax.top_k(probs.T, C)                 # [E, C]
+        dispatch = jax.nn.one_hot(idx, S,
+                                  dtype=jnp.float32)   # [E, C, S]
+        dispatch = dispatch.transpose(2, 0, 1)         # [S, E, C]
+        combine = dispatch * g[None, :, :]
+        # Perfectly balanced by construction: aux is identically its
+        # floor (1.0-equivalent) — report 0 so aux_weight has no effect.
+        return dispatch, combine, jnp.zeros((), jnp.float32), z_loss
 
-    # Switch aux loss: fraction of tokens vs fraction of router mass.
-    token_frac = jnp.mean(onehot, axis=0)              # [E]
+    # Iterative argmax over the k choices; positions are cumulative
+    # across choices via per-expert counts.
+    masked = probs
+    counts = jnp.zeros((E,), jnp.float32)
+    disp_ks, gate_ks = [], []
+    first_onehot = None
+    for k in range(K):
+        onehot = jax.nn.one_hot(jnp.argmax(masked, axis=-1), E,
+                                dtype=jnp.float32)     # [S, E]
+        if first_onehot is None:
+            first_onehot = onehot
+        gate_ks.append(jnp.sum(probs * onehot, axis=-1))   # raw prob [S]
+        pos = ((jnp.cumsum(onehot, axis=0) + counts[None, :]) * onehot
+               - 1.0)                                  # [S, E]
+        pos_in_expert = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)
+        keep = (pos_in_expert < C) & (pos_in_expert >= 0)
+        pos_oh = jax.nn.one_hot(pos_in_expert, C, dtype=jnp.float32)
+        disp_ks.append((onehot * keep[:, None])[:, :, None]
+                       * pos_oh[:, None, :])           # [S, E, C]
+        counts = counts + jnp.sum(onehot, axis=0)
+        masked = masked * (1.0 - onehot)
+
+    if K > 1:
+        denom = sum(gate_ks) + 1e-9
+        gate_ks = [g / denom for g in gate_ks]
+    dispatch = sum(disp_ks)
+    combine = sum(g[:, None, None] * d for g, d in zip(gate_ks, disp_ks))
+
+    # Load-balance aux loss (Switch/GShard): fraction of tokens whose
+    # FIRST choice is expert e vs fraction of router mass on e.
+    token_frac = jnp.mean(first_onehot, axis=0)        # [E]
     prob_frac = jnp.mean(probs, axis=0)                # [E]
-    aux = jnp.sum(token_frac * prob_frac) * cfg.n_experts
-    return dispatch, combine, aux
+    aux = jnp.sum(token_frac * prob_frac) * E
+    return dispatch, combine, aux, z_loss
 
 
 def moe_ffn(x, params, cfg: MoEConfig,
-            rng: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+            rng: Optional[jax.Array] = None
+            ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Apply the MoE FFN to per-rank tokens ``x [S, D]``.
 
     Inside shard_map with ``ep`` bound, ``params["w1"]/["w2"]`` are the
     LOCAL expert slab [E/ep, D, F] and the dispatch/return exchanges ride
     two ``lax.all_to_all``; without ``ep_axis`` every expert is local.
-    Returns ``(y [S, D], aux_loss)`` — dropped tokens yield zeros (callers
-    add the residual).
+    Returns ``(y [S, D], aux_loss, z_loss)`` — dropped tokens yield zeros
+    (callers add the residual).  ``rng`` is required iff
+    ``cfg.router_noise > 0``.
     """
     S, D = x.shape
     E = cfg.n_experts
     C = cfg.capacity(S)
-    dispatch, combine, aux = _route(x, params["router"], cfg, rng)
+    dispatch, combine, aux, z_loss = _route(x, params["router"], cfg, rng)
 
     # [E, C, D] expert buffers (einsum dispatch — MXU, no scatter).
     buf = jnp.einsum("sec,sd->ecd", dispatch.astype(x.dtype), x)
@@ -154,7 +230,7 @@ def moe_ffn(x, params, cfg: MoEConfig,
                              tiled=True)
 
     y = jnp.einsum("sec,ecd->sd", combine.astype(x.dtype), out)
-    return y, aux.astype(jnp.float32)
+    return y, aux.astype(jnp.float32), z_loss.astype(jnp.float32)
 
 
 # ----------------------------------------------------------- tiny LM model
@@ -190,18 +266,33 @@ def lm_param_specs(cfg: MoELMConfig) -> Dict:
             "layers": [param_specs(cfg.moe) for _ in range(cfg.n_layers)]}
 
 
-def lm_loss(params, tokens, targets, cfg: MoELMConfig):
+def lm_loss(params, tokens, targets, cfg: MoELMConfig,
+            rng: Optional[jax.Array] = None):
     """Per-rank partial mean loss (same sum-semantics convention as
     models/llama.py): scaled so psum over dp AND ep recovers the global
     mean — ep is a DATA split here (GShard-style: every (dp, ep)
-    coordinate routes its own token shard; only experts live on ep)."""
+    coordinate routes its own token shard; only experts live on ep).
+
+    ``rng`` threads router jitter (cfg.moe.router_noise): folded per
+    layer AND per data-axis coordinate, so every (dp, ep) rank draws
+    independent noise over its own token shard while redundant compute
+    (none here) would stay deterministic.
+    """
     B, T = tokens.shape
     x = params["embed"][tokens].reshape(B * T, -1)
+    if rng is not None:
+        for ax in (cfg.dp_axis, cfg.moe.ep_axis):
+            if ax:
+                rng = jax.random.fold_in(rng, lax.axis_index(ax))
     aux_total = 0.0
-    for lp in params["layers"]:
-        y, aux = moe_ffn(x, lp, cfg.moe)
+    z_total = 0.0
+    for i, lp in enumerate(params["layers"]):
+        layer_rng = (jax.random.fold_in(rng, i)
+                     if rng is not None else None)
+        y, aux, zl = moe_ffn(x, lp, cfg.moe, rng=layer_rng)
         x = x + y
         aux_total = aux_total + aux
+        z_total = z_total + zl
     logits = (x @ params["head"]).astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets.reshape(-1)[:, None],
@@ -210,8 +301,9 @@ def lm_loss(params, tokens, targets, cfg: MoELMConfig):
     for ax in (cfg.dp_axis, cfg.moe.ep_axis):
         if ax:
             denom = denom * lax.axis_size(ax)
-    return (jnp.sum(nll) + cfg.aux_weight * aux_total
-            * float(nll.size)) / denom
+    router_losses = (cfg.aux_weight * aux_total
+                     + cfg.moe.router_z_weight * z_total)
+    return (jnp.sum(nll) + router_losses * float(nll.size)) / denom
 
 
 def lm_sync_grads(grads, cfg: MoELMConfig):
@@ -232,12 +324,15 @@ def lm_sync_grads(grads, cfg: MoELMConfig):
                                   is_leaf=lambda s: isinstance(s, P))
 
 
-def make_train_step(cfg: MoELMConfig, optimizer):
+def make_train_step(cfg: MoELMConfig, optimizer, with_rng: bool = False):
+    """Train step; ``with_rng=True`` adds a trailing ``rng`` argument that
+    threads router jitter into ``lm_loss`` (required when
+    cfg.moe.router_noise > 0)."""
     import optax
 
-    def step(params, opt_state, tokens, targets):
+    def _step(params, opt_state, tokens, targets, rng):
         loss_p, grads = jax.value_and_grad(lm_loss)(params, tokens,
-                                                    targets, cfg)
+                                                    targets, cfg, rng)
         grads = lm_sync_grads(grads, cfg)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
@@ -245,5 +340,11 @@ def make_train_step(cfg: MoELMConfig, optimizer):
             if ax:
                 loss_p = lax.psum(loss_p, ax)
         return params, opt_state, loss_p
+
+    if with_rng:
+        return _step
+
+    def step(params, opt_state, tokens, targets):
+        return _step(params, opt_state, tokens, targets, None)
 
     return step

@@ -263,6 +263,9 @@ class CollectiveEngine:
         # each fused program before launching the next; TPU keeps the fully
         # async pipeline (its executor serializes per-core streams).
         self._serialize_launches = jax.default_backend() == "cpu"
+        # Cached off the hot dispatch path (engine is built after the jax
+        # world forms): >1 ⇒ eager ops need the negotiation controller.
+        self._world_processes = jax.process_count()
         self.autotuner = None        # reference N9 parameter manager
         if cfg.autotune:
             from .autotune import ParameterManager
@@ -301,6 +304,17 @@ class CollectiveEngine:
         """Enqueue several entries atomically w.r.t. the drain — a cycle
         sees all of them or none, so grouped members always negotiate (and
         batch) together (reference: group_table N13)."""
+        if self.controller is None and self._world_processes > 1:
+            # A multi-process world without the launcher's negotiation
+            # controller (pod auto-detect mode): eager collectives cannot
+            # coordinate safely — the SPMD shard_map path is unaffected.
+            raise RuntimeError(
+                "eager collectives need the torovodrun-launched "
+                "negotiation controller in a multi-process world; this "
+                "process joined via pod auto-detect "
+                "(HOROVOD_ONE_PROC_PER_HOST without HOROVOD_CONTROLLER_"
+                "ADDR).  Launch with torovodrun, or use the in-graph "
+                "psum/shard_map path")
         entries = []
         for kw in items:
             handle = next(self._handle_counter)

@@ -42,7 +42,8 @@ def pipeline_apply(fn: Callable, stage_params, micro_x,
                    axis_name: str = "pp",
                    broadcast_out: bool = False,
                    remat: bool = False,
-                   with_aux: bool = False):
+                   with_aux: bool = False,
+                   aux_init=None):
     """Run microbatches through the stage pipeline.
 
     fn: ``(stage_params, x[mb, ...]) -> y[mb, ...]`` (shape-preserving);
@@ -63,11 +64,14 @@ def pipeline_apply(fn: Callable, stage_params, micro_x,
     with its recompute, 1F1B-style) remat is the idiomatic lever, so a
     literal hand-scheduled 1F1B variant is deliberately not implemented.
 
-    ``with_aux=True``: ``fn`` returns ``(y, aux_scalar)`` and the call
-    returns ``(outs, aux_total)`` where aux_total accumulates every VALID
-    (non-bubble) tick's scalar on THIS stage — a per-stage partial (each
+    ``with_aux=True``: ``fn`` returns ``(y, aux)`` and the call returns
+    ``(outs, aux_total)`` where aux_total accumulates every VALID
+    (non-bubble) tick's aux on THIS stage — a per-stage partial (each
     stage saw only its own layers); callers sum across pp with a psum,
-    exactly like the MoE router-balance loss wants.
+    exactly like the MoE router-balance loss wants.  ``aux`` is a scalar
+    by default; pass ``aux_init`` (e.g. ``jnp.zeros((2,))``) when the
+    stage emits a vector of accumulators — scan demands a shape-stable
+    carry, so the init must match fn's aux shape.
     """
     if remat:
         fn = jax.checkpoint(fn)
@@ -104,8 +108,9 @@ def pipeline_apply(fn: Callable, stage_params, micro_x,
 
     buf0 = jnp.zeros_like(micro_x[0])
     outs0 = jnp.zeros_like(micro_x)
+    aux0 = jnp.zeros((), jnp.float32) if aux_init is None else aux_init
     (buf, outs, aux_total), _ = lax.scan(
-        tick, (buf0, outs0, jnp.zeros((), jnp.float32)), jnp.arange(ticks))
+        tick, (buf0, outs0, aux0), jnp.arange(ticks))
 
     if broadcast_out:
         # Every stage but the last holds zeros, so a psum over the pp axis

@@ -8,6 +8,12 @@ import os
 import subprocess
 import sys
 
+import pytest
+
+# Integration tier: real subprocess launches (see pyproject markers);
+# the fast hermetic tier excludes these with `-m 'not slow'`.
+pytestmark = pytest.mark.slow
+
 from test_examples import _example_env
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -140,6 +140,7 @@ def test_rendezvous_publish_fetch_versioning():
 
 
 # ------------------------------------------------- driver process lifecycle
+@pytest.mark.slow
 def test_driver_success_on_worker_exit_zero():
     d = ElasticDriver(
         FixedHostDiscovery([DiscoveredHost("localhost", 2)]),
@@ -148,6 +149,7 @@ def test_driver_success_on_worker_exit_zero():
     assert d.registry.success_count() >= 1
 
 
+@pytest.mark.slow
 def test_driver_gives_up_below_min_np():
     d = ElasticDriver(FixedHostDiscovery([DiscoveredHost("localhost", 1)]),
                       [sys.executable, "-c", "pass"], min_np=4,
@@ -155,6 +157,7 @@ def test_driver_gives_up_below_min_np():
     assert d.run() == 1
 
 
+@pytest.mark.slow
 def test_driver_failure_blacklists_and_aborts():
     # Workers always fail; localhost gets blacklisted; below min_np -> abort
     # with the worker's rc.
@@ -171,6 +174,7 @@ def test_driver_failure_blacklists_and_aborts():
 WORKER = os.path.join(REPO, "tests", "data", "worker_elastic.py")
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("mode", ["grow", "shrink"])
 def test_elastic_integration(tmp_path, mode):
     """Real elastic run on localhost: discovery output mutates mid-run."""
@@ -295,6 +299,7 @@ def test_tpu_metadata_discovery_missing_endpoint_raises():
         srv.stop()
 
 
+@pytest.mark.slow
 def test_elastic_integration_tpu_metadata_preemption(tmp_path):
     """Full elastic run driven by the metadata source: the fake server
     posts a preemption notice for one worker mid-run and training resumes

@@ -10,6 +10,10 @@ import sys
 
 import pytest
 
+# Integration tier: real subprocess launches (see pyproject markers);
+# the fast hermetic tier excludes these with `-m 'not slow'`.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = os.path.join(REPO, "examples")
 
@@ -107,6 +111,20 @@ def test_example_llama_spmd_pipeline():
         env=env, capture_output=True, text=True, timeout=300)
     _assert_done(r)
     assert "pp=2" in r.stdout
+
+
+def test_example_llama_generate():
+    """Inference example: tp=2 sharded generate with sampling (blockwise
+    prefill + KV-cache decode through shard_map)."""
+    env = _example_env(
+        XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "llama_generate.py"),
+         "--tiny", "--tp", "2", "--n-tokens", "6",
+         "--temperature", "0.8", "--top-p", "0.9"],
+        env=env, capture_output=True, text=True, timeout=300)
+    _assert_done(r)
+    assert "tp=2" in r.stdout and "sampled" in r.stdout
 
 
 def test_example_moe_expert_parallel():

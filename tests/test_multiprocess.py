@@ -10,6 +10,10 @@ import sys
 
 import pytest
 
+# Integration tier: real subprocess launches (see pyproject markers);
+# the fast hermetic tier excludes these with `-m 'not slow'`.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "data", "worker_collectives.py")
 
@@ -159,6 +163,24 @@ def test_torovodrun_collectives(np_):
     res = _run_torovodrun(np_, WORKER)
     ok = res.stdout.count("WORKER_OK")
     assert res.returncode == 0 and ok == np_, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
+WORKER_HIER = os.path.join(REPO, "tests", "data", "worker_hierarchical.py")
+
+
+def test_hierarchical_two_slices():
+    """Cross-slice emulation (VERDICT r4 next #6): 2 processes × 4 local
+    devices — intra-process = one slice's ICI domain, the gloo TCP hop =
+    DCN — with hierarchical allreduce RS(local)→AR(cross)→AG(local)
+    end-to-end through the engine.  The worker asserts size=8, local=4,
+    the engine flag, and flat-equivalent numerics (single + fused)."""
+    res = _run_torovodrun(2, WORKER_HIER,
+                          extra_args=("--hierarchical-allreduce",),
+                          extra_env={"HOROVOD_ONE_PROC_PER_HOST": "1"})
+    ok = res.stdout.count("WORKER_OK")
+    assert res.returncode == 0 and ok == 2, (
         f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
         f"stderr:\n{res.stderr[-3000:]}")
 

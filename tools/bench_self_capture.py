@@ -43,6 +43,8 @@ MODES = {
                       "HVD_BENCH_SKIP_BUSBW": "1"}, 1200),
     # TF binding per-step cost on the real chip.
     "tf_step": ({"HVD_BENCH_MODEL": "tf_step"}, 1200),
+    # Inference: blockwise prefill + KV-cache decode tokens/s.
+    "decode": ({"HVD_BENCH_MODEL": "decode"}, 1200),
 }
 
 

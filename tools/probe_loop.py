@@ -30,6 +30,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
 
 PROBE_SRC = ("import json, jax, jax.numpy as jnp; x = jnp.ones((8, 128)); "
              "v = float((x @ x.T).sum()); "
@@ -73,7 +74,6 @@ def pending_work(out_path: str) -> tuple[list[str], bool]:
 
     Order preserved; modes that failed in an earlier window count as
     pending again — the retry cap lives in the caller (``attempts``)."""
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from bench_self_capture import MODES
     try:
         with open(out_path) as fh:
