@@ -43,6 +43,9 @@ def parse_args():
                    help="0 = greedy; >0 samples")
     p.add_argument("--top-p", type=float, default=1.0)
     p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--n-draft", type=int, default=0,
+                   help=">0 = greedy speculative decoding with this many "
+                        "draft tokens per verify round (tp/sampling off)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--tiny", action="store_true",
                    help="tiny config for smoke tests")
@@ -78,6 +81,25 @@ def main():
     # a non-None arg keeps the shard_map in_specs pytree uniform.
     sample_rng = jax.random.PRNGKey(args.seed + 1)
     budget = args.prompt_len + args.n_tokens
+
+    if args.n_draft > 0:
+        if args.tp > 1 or args.temperature > 0:
+            raise SystemExit("--n-draft demo runs single-device greedy")
+        # Self-speculation with an independently-initialized draft: the
+        # output is still EXACTLY the target model's greedy decode — the
+        # draft only changes how many target forwards are needed.
+        draft = llama.init_params(cfg, jax.random.PRNGKey(args.seed + 7))
+        gen = jax.jit(lambda p, t: llama.speculative_generate(
+            p, draft, t, args.n_tokens, cfg, n_draft=args.n_draft))
+        t0 = time.time()
+        out = np.asarray(gen(params, prompt))
+        wall = time.time() - t0
+        print(f"generated [{args.batch}, {args.n_tokens}] tokens "
+              f"speculative(n_draft={args.n_draft}) in {wall:.2f}s "
+              f"(incl. compile)")
+        print(out)
+        print(f"DONE tokens={out.size}")
+        return
 
     def run(p, t, r):
         return llama.generate(p, t, args.n_tokens, cfg, max_seq=budget,

@@ -606,40 +606,59 @@ def decode_step(params, cache, tokens, pos, cfg: LlamaConfig):
     training param specs (wq/wk/wv column-split → this rank holds
     H/tp q heads and K/tp kv heads; wo row-split with a psum — the same
     f/g pair as ``_attention``) and the cache sharded over its head axis
-    (``cache_specs``).  Attention over the cache is a plain masked
-    einsum: at Tq=1 there is no score matrix to tile, so flash buys
-    nothing.
+    (``cache_specs``).  The Tq=1 case of ``decode_chunk`` — one
+    implementation, two entry points.  Attention over the cache is a
+    plain masked einsum: at Tq=1 there is no score matrix to tile, so
+    flash buys nothing.
     """
-    _decode_axes_check(cfg, "decode_step")
-    B = tokens.shape[0]
-    x = params["embed"][tokens][:, None, :]          # [B, 1, D]
-    positions = jnp.full((1,), pos, jnp.int32)
+    logits, cache = decode_chunk(params, cache, tokens[:, None], pos, cfg)
+    return logits[:, 0, :], cache
+
+
+def decode_chunk(params, cache, tokens, pos, cfg: LlamaConfig):
+    """Cached forward over a SHORT chunk ``tokens [B, Tq]`` starting at
+    position ``pos`` (traced scalar) -> (logits [B, Tq, vocab], cache).
+
+    The multi-token generalization of ``decode_step`` (which is the
+    Tq=1 case): chunk kv is written into the cache at [pos, pos+Tq) and
+    each chunk row i attends the cache prefix ``<= pos + i`` — the
+    verify pass of speculative decoding, and the building block for any
+    multi-token stepping.  tp-sharded like decode_step.
+    """
+    _decode_axes_check(cfg, "decode_chunk")
+    B, Tq = tokens.shape
+    x = params["embed"][tokens]                      # [B, Tq, D]
+    positions = pos + jnp.arange(Tq)
     new_cache = []
     T = cache[0]["k"].shape[1]
-    valid = (jnp.arange(T) <= pos)[None, None, None, :]   # [1,1,1,T]
+    # valid[i, t]: chunk row i sees cache positions t <= pos + i.
+    valid = (jnp.arange(T)[None, :]
+             <= (pos + jnp.arange(Tq))[:, None])     # [Tq, T]
+    valid = valid[None, None, None, :, :]            # [1,1,1,Tq,T]
     for p, c in zip(params["layers"], cache):
         h = _rmsnorm(x, p["attn_norm"])
-        q, k_new, v_new = _qkv(h, p, cfg, positions)   # local head shard
+        q, k_new, v_new = _qkv(h, p, cfg, positions)  # local head shard
         H, K, Hd = q.shape[2], k_new.shape[2], q.shape[3]
         ck = lax.dynamic_update_slice(c["k"], k_new.astype(c["k"].dtype),
                                       (0, pos, 0, 0))
         cv = lax.dynamic_update_slice(c["v"], v_new.astype(c["v"].dtype),
                                       (0, pos, 0, 0))
         new_cache.append({"k": ck, "v": cv})
-        # GQA: fold q heads into [K, rep] groups against the shared kv.
-        qg = q.reshape(B, K, H // K, Hd)             # Tq=1 squeezed
-        s = jnp.einsum("bkrd,btkd->bkrt", qg, ck,
+        # GQA groups against the shared kv, one extra chunk axis q.
+        qg = q.reshape(B, Tq, K, H // K, Hd)
+        s = jnp.einsum("bqkrd,btkd->bkrqt", qg, ck,
                        preferred_element_type=jnp.float32)
         s = s / np.sqrt(Hd)
         s = jnp.where(valid, s, NEG_INF)
         w = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bkrt,btkd->bkrd", w.astype(cv.dtype), cv,
+        o = jnp.einsum("bkrqt,btkd->bqkrd", w.astype(cv.dtype), cv,
                        preferred_element_type=jnp.float32)
-        x = x + _wo_project(o.reshape(B, 1, H, Hd).astype(x.dtype), p, cfg)
+        x = x + _wo_project(o.reshape(B, Tq, H, Hd).astype(x.dtype),
+                            p, cfg)
         y, _ = _mlp(_rmsnorm(x, p["mlp_norm"]), p, cfg)
         x = x + y
     x = _rmsnorm(x, params["final_norm"])
-    return (x[:, 0, :] @ params["lm_head"]).astype(jnp.float32), new_cache
+    return (x @ params["lm_head"]).astype(jnp.float32), new_cache
 
 
 def cache_specs(cfg: LlamaConfig):
@@ -752,6 +771,101 @@ def generate(params, prompt, n_tokens: int, cfg: LlamaConfig,
     (_, _), rest = lax.scan(body, (first, cache),
                             jnp.arange(T0, T0 + n_tokens - 1))
     return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+def speculative_generate(params, draft_params, prompt, n_tokens: int,
+                         cfg: LlamaConfig,
+                         draft_cfg: Optional[LlamaConfig] = None,
+                         n_draft: int = 4,
+                         max_seq: Optional[int] = None):
+    """Greedy speculative decoding: a cheap draft model proposes
+    ``n_draft`` tokens per round; the target model verifies them in ONE
+    ``decode_chunk`` forward and emits every leading match plus the
+    target's own correction token.
+
+    EXACT by construction: the output equals greedy
+    ``generate(params, prompt, n_tokens, cfg)`` token for token — the
+    draft only changes how many sequential target forwards are needed
+    (1 + n_accepted tokens per target forward instead of 1).  Batched:
+    acceptance is the MINIMUM leading-match length across rows, so every
+    row stays exact (for rows that matched further, the correction token
+    IS their draft token); peak speedup needs agreeing rows.
+
+    ``draft_cfg`` defaults to ``cfg`` (self-speculation layout); it must
+    share the vocabulary.  jit-compatible end to end (``while_loop``
+    over a static token budget; caches sized ``T0 + n_tokens + n_draft``
+    so the last round's chunk always fits).
+    """
+    draft_cfg = draft_cfg or cfg
+    _decode_axes_check(cfg, "speculative_generate")
+    _decode_axes_check(draft_cfg, "speculative_generate (draft)")
+    B, T0 = prompt.shape
+    if n_tokens < 1:
+        return jnp.zeros((B, 0), jnp.int32)
+    k = int(n_draft)
+    if k < 1:
+        raise ValueError("n_draft must be >= 1")
+    budget = max_seq or (T0 + n_tokens + k)
+    cache_t = init_cache(cfg, B, budget)
+    cache_d = init_cache(draft_cfg, B, budget)
+    _check_cache_budget(T0 + n_tokens + k, budget)
+
+    logits_t, cache_t = prefill(params, cache_t, prompt, cfg)
+    _, cache_d = prefill(draft_params, cache_d, prompt, draft_cfg)
+    first = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)   # [B]
+
+    PAD = n_tokens + k + 1      # rounds overwrite their garbage tail
+    out0 = jnp.zeros((B, PAD), jnp.int32)
+    out0 = lax.dynamic_update_slice(out0, first[:, None], (0, 0))
+
+    def cond(carry):
+        return carry[1] < n_tokens
+
+    def body(carry):
+        out, n_done, last, cache_t, cache_d = carry
+        p0 = T0 + n_done - 1    # position of `last`'s (unwritten) kv
+
+        # Draft k tokens sequentially on the cheap model.  k+1 steps, not
+        # k: the extra step writes d_k's own kv into the draft cache —
+        # without it a fully-accepted round leaves a zero hole at
+        # position p0+k that every later draft step would attend,
+        # silently eroding the acceptance rate (output stays exact — the
+        # target verifies — but the speedup decays).  Its proposed token
+        # is discarded.
+        def dstep(c, i):
+            cache_d, tok = c
+            logits, cache_d = decode_step(draft_params, cache_d, tok,
+                                          p0 + i, draft_cfg)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (cache_d, nxt), nxt
+
+        (cache_d, _), drafts = lax.scan(dstep, (cache_d, last),
+                                        jnp.arange(k + 1))
+        drafts = drafts.T[:, :k]                            # [B, k]
+
+        # Verify in one target forward over [last, d_1..d_k]: logits row
+        # i is the target's next-token distribution after position p0+i,
+        # so t_i aligns with draft d_{i+1}.
+        chunk = jnp.concatenate([last[:, None], drafts], axis=1)
+        logits, cache_t = decode_chunk(params, cache_t, chunk, p0, cfg)
+        targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,k+1]
+
+        m = (drafts == targets[:, :k])                      # [B, k]
+        a_row = jnp.sum(jnp.cumprod(m.astype(jnp.int32), axis=1), axis=1)
+        a = jnp.min(a_row)                                  # scalar 0..k
+        correction = lax.dynamic_index_in_dim(targets, a, axis=1,
+                                              keepdims=False)   # [B]
+        padded = jnp.concatenate(
+            [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)  # [B, k+1]
+        emit = jnp.where(jnp.arange(k + 1)[None, :] < a, padded,
+                         correction[:, None])
+        out = lax.dynamic_update_slice(out, emit, (0, n_done))
+        return out, n_done + a + 1, correction, cache_t, cache_d
+
+    out, _, _, _, _ = lax.while_loop(
+        cond, body, (out0, jnp.asarray(1, jnp.int32), first,
+                     cache_t, cache_d))
+    return out[:, :n_tokens]
 
 
 def make_train_step(cfg: LlamaConfig, optimizer, with_rng: bool = False):

@@ -463,6 +463,55 @@ def test_sampling_modes():
             assert d[b] in top3[b]
 
 
+def test_decode_chunk_matches_step_loop():
+    """decode_chunk over [B, Tq] == Tq sequential decode_steps (same
+    logits, same cache) — the verify primitive of speculative decoding."""
+    cfg = llama.tiny(dtype=jnp.float32, max_seq=32, dp_axis=None,
+                     tp_axis=None, sp_axis=None, use_flash=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(41))
+    rng = np.random.RandomState(42)
+    B, T0, Tq = 2, 4, 5
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T0)), jnp.int32)
+    chunk = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, Tq)), jnp.int32)
+
+    _, c0 = llama.prefill(params, llama.init_cache(cfg, B, 32), prompt, cfg)
+    cl, cc = llama.decode_chunk(params, c0, chunk, T0, cfg)
+
+    cs = c0
+    step_logits = []
+    for i in range(Tq):
+        li, cs = llama.decode_step(params, cs, chunk[:, i], T0 + i, cfg)
+        step_logits.append(np.asarray(li))
+    np.testing.assert_allclose(np.asarray(cl),
+                               np.stack(step_logits, axis=1),
+                               rtol=1e-5, atol=1e-5)
+    for lc, ls in zip(cc, cs):
+        np.testing.assert_allclose(np.asarray(lc["k"]), np.asarray(ls["k"]),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_speculative_generate_matches_greedy():
+    """Speculative decoding is EXACT greedy decoding: with a different
+    (disagreeing) draft model, with self-speculation (full acceptance),
+    and at n_draft=1, the output must equal plain generate()."""
+    cfg = llama.tiny(dtype=jnp.float32, max_seq=128, dp_axis=None,
+                     tp_axis=None, sp_axis=None, use_flash=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(43))
+    draft = llama.init_params(cfg, jax.random.PRNGKey(44))
+    prompt = jnp.asarray(
+        np.random.RandomState(45).randint(0, cfg.vocab_size, (2, 5)),
+        jnp.int32)
+    N = 10
+    ref = np.asarray(jax.jit(
+        lambda p, t: llama.generate(p, t, N, cfg))(params, prompt))
+
+    for dp, nd in ((draft, 3), (params, 4), (draft, 1)):
+        spec = np.asarray(jax.jit(
+            lambda p, d, t: llama.speculative_generate(
+                p, d, t, N, cfg, n_draft=nd))(params, dp, prompt))
+        np.testing.assert_array_equal(spec, ref, err_msg=f"n_draft={nd}")
+
+
 def test_kv_cache_budget_enforced():
     """Decoding past the cache raises instead of silently clamping writes
     onto the last slot; n_tokens=0 returns an empty [B, 0]."""
