@@ -412,10 +412,21 @@ def bench_llama(batch, steps):
     from horovod_tpu.models import llama
     from horovod_tpu.ops.flash_attention import flash_enabled
 
+    # HVD_BENCH_EXPERTS=E swaps the dense MLP for the top-k MoE (experts
+    # resident on the one chip — the einsum dispatch/combine cost A/B;
+    # HVD_BENCH_TOPK picks the routing k).
+    n_experts = int(os.environ.get("HVD_BENCH_EXPERTS", "0"))
+    # HVD_BENCH_WINDOW=W turns on sliding-window attention — the on-chip
+    # O(T·W) vs O(T^2) A/B for the kernel's whole-block skipping.
+    window = int(os.environ.get("HVD_BENCH_WINDOW", "0")) or None
     cfg = llama.LlamaConfig(vocab_size=8192, d_model=512, n_layers=4,
                             n_heads=8, n_kv_heads=4, d_ff=1536, max_seq=512,
                             dtype=jnp.bfloat16 if _on_tpu() else jnp.float32,
-                            dp_axis=None, tp_axis=None, sp_axis=None)
+                            dp_axis=None, tp_axis=None, sp_axis=None,
+                            n_experts=n_experts, ep_axis=None,
+                            sliding_window=window,
+                            router_top_k=int(os.environ.get(
+                                "HVD_BENCH_TOPK", "1")))
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     opt = hvd.DistributedOptimizer(optax.adam(1e-3), op=hvd.Average,
                                    axis_name="hvd")
@@ -443,7 +454,9 @@ def bench_llama(batch, steps):
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
     _record_timing("llama", warmup=2, iters=steps, wall_s=dt,
-                   global_batch=batch, seq=seq, flash=flash_enabled())
+                   global_batch=batch, seq=seq, flash=flash_enabled(),
+                   n_experts=n_experts, router_top_k=cfg.router_top_k,
+                   sliding_window=window or 0)
     return batch * seq * steps / dt
 
 

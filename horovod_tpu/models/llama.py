@@ -103,6 +103,14 @@ class LlamaConfig:
     # The env var is not part of any jit cache key — to toggle after a
     # step has compiled, change this config field (it IS traced).
     use_flash: Optional[bool] = None
+    # Sliding-window (Mistral-style) causal attention: each position
+    # attends its last ``sliding_window`` positions only.  The flash
+    # kernel skips whole out-of-window blocks (O(T·W) compute); local
+    # attention only for now — sp (ring/Ulysses) rejects it at trace
+    # time (ring-step skipping is the natural extension; the KV cache
+    # stays full-length, masked — a ring-buffer cache is the memory
+    # follow-up).
+    sliding_window: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -117,6 +125,10 @@ class LlamaConfig:
             raise ValueError(
                 f"pp_loss must be 'broadcast' or 'last_stage', got "
                 f"{self.pp_loss!r}")
+        if self.sliding_window is not None and self.sliding_window < 1:
+            raise ValueError(
+                f"sliding_window must be >= 1 (or None to disable), got "
+                f"{self.sliding_window!r}")
 
     @property
     def all_axes(self):
@@ -158,6 +170,15 @@ def tiny(vocab_size: int = 256, d_model: int = 64, n_layers: int = 2,
 
 def llama3_8b() -> LlamaConfig:
     return LlamaConfig()  # defaults above are the 8B geometry
+
+
+def mistral_7b() -> LlamaConfig:
+    """Mistral-7B geometry: the Llama architecture + sliding-window
+    attention (the flash kernel skips whole out-of-window blocks)."""
+    return LlamaConfig(vocab_size=32000, d_model=4096, n_layers=32,
+                       n_heads=32, n_kv_heads=8, d_ff=14336,
+                       max_seq=32768, rope_theta=10000.0,
+                       sliding_window=4096)
 
 
 # ------------------------------------------------------------------- params
@@ -301,11 +322,14 @@ def _wo_project(out, p, cfg: LlamaConfig):
 
 def _local_attend(q, k, v, cfg: LlamaConfig):
     """Causal local attention through the same flash routing as every
-    path (Pallas kernel on TPU, jnp fallback otherwise)."""
+    path (Pallas kernel on TPU, jnp fallback otherwise); sliding window
+    when the config asks for it."""
     if _use_pallas_flash(cfg):
         from ..ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=True)
-    return local_flash_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=True,
+                               window=cfg.sliding_window)
+    return local_flash_attention(q, k, v, causal=True,
+                                 window=cfg.sliding_window)
 
 
 def _attention(x, p, cfg: LlamaConfig, positions):
@@ -313,6 +337,10 @@ def _attention(x, p, cfg: LlamaConfig, positions):
     q, kk, v = _qkv(x, p, cfg, positions)
 
     sp = lax.axis_size(cfg.sp_axis) if cfg.sp_axis else 1
+    if sp > 1 and cfg.sliding_window:
+        raise ValueError(
+            "sliding_window composes with dp/tp/pp/ep but not (yet) with "
+            "sequence parallelism — disable sp_axis or the window")
     if sp > 1 and cfg.sp_impl == "ulysses":
         # Head exchange instead of kv rotation (docs/parallelism.md for
         # the tradeoff); GQA kv travels un-repeated through the alltoall.
@@ -631,9 +659,14 @@ def decode_chunk(params, cache, tokens, pos, cfg: LlamaConfig):
     positions = pos + jnp.arange(Tq)
     new_cache = []
     T = cache[0]["k"].shape[1]
-    # valid[i, t]: chunk row i sees cache positions t <= pos + i.
+    # valid[i, t]: chunk row i sees cache positions t <= pos + i (and,
+    # with a sliding window, only the last ``sliding_window`` of them).
     valid = (jnp.arange(T)[None, :]
              <= (pos + jnp.arange(Tq))[:, None])     # [Tq, T]
+    if cfg.sliding_window:
+        valid = jnp.logical_and(
+            valid, jnp.arange(T)[None, :]
+            > (pos + jnp.arange(Tq))[:, None] - cfg.sliding_window)
     valid = valid[None, None, None, :, :]            # [1,1,1,Tq,T]
     for p, c in zip(params["layers"], cache):
         h = _rmsnorm(x, p["attn_norm"])

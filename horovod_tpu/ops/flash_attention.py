@@ -58,7 +58,7 @@ def flash_enabled() -> bool:
 # ----------------------------------------------------------------- forward
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k,
-                n_k, tk_valid):
+                n_k, tk_valid, window):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -70,8 +70,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     q_start = qi * block_q
     k_start = ki * block_k
-    # Causal: skip k-blocks strictly above the diagonal band.
+    # Causal: skip k-blocks strictly above the diagonal band; a sliding
+    # window additionally skips blocks entirely BELOW the band (the
+    # Mistral-style O(T·W) compute shape — whole blocks outside
+    # [r-window+1, r] never touch the MXU).
     live = (not causal) or (k_start <= q_start + block_q - 1)
+    if window:
+        live = jnp.logical_and(live,
+                               k_start + block_k > q_start - window)
 
     @pl.when(live)
     def _():
@@ -87,6 +93,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             rows = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             mask = jnp.logical_and(mask, rows >= cols)
+            if window:
+                mask = jnp.logical_and(mask, rows - cols < window)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:]
@@ -115,7 +123,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 # ---------------------------------------------------------------- backward
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                acc_ref, *, scale, causal, block_q, block_k, n_k,
-               tq_valid, tk_valid):
+               tq_valid, tk_valid, window):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -126,6 +134,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     q_start = qi * block_q
     k_start = ki * block_k
     live = (not causal) or (k_start <= q_start + block_q - 1)
+    if window:
+        live = jnp.logical_and(live,
+                               k_start + block_k > q_start - window)
 
     @pl.when(live)
     def _():
@@ -142,6 +153,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         mask = jnp.logical_and(cols < tk_valid, rows < tq_valid)
         if causal:
             mask = jnp.logical_and(mask, rows >= cols)
+            if window:
+                mask = jnp.logical_and(mask, rows - cols < window)
         s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, :, :1])        # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -158,7 +171,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                block_q, block_k, n_q, n_t, tq_valid, tk_valid):
+                block_q, block_k, n_q, n_t, tq_valid, tk_valid, window):
     ki = pl.program_id(1)
     t = pl.program_id(2)      # = r * n_q + qi over the rep q-heads (GQA)
     qi = t % n_q
@@ -171,6 +184,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     q_start = qi * block_q
     k_start = ki * block_k
     live = (not causal) or (k_start <= q_start + block_q - 1)
+    if window:
+        live = jnp.logical_and(live,
+                               k_start + block_k > q_start - window)
 
     @pl.when(live)
     def _():
@@ -187,6 +203,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = jnp.logical_and(cols < tk_valid, rows < tq_valid)
         if causal:
             mask = jnp.logical_and(mask, rows >= cols)
+            if window:
+                mask = jnp.logical_and(mask, rows - cols < window)
         s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, :, :1])        # [bq, bk]
         dv_acc[:] += jax.lax.dot_general(
@@ -214,7 +232,8 @@ def _pad_t(x, block):
     return x
 
 
-def _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret, rep=1):
+def _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret, rep=1,
+              window=0):
     """q: [BH, T, D]; k, v: [BH // rep, T, D] (GQA: ``rep`` consecutive
     q-heads share one kv head — remapped in the BlockSpec index, no
     materialized repeat) -> (o [BH, Tq, D], lse [BH, Tq])."""
@@ -226,7 +245,8 @@ def _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret, rep=1):
     n_q, n_k = Tqp // bq, Tkp // bk
 
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                             block_q=bq, block_k=bk, n_k=n_k, tk_valid=Tk)
+                             block_q=bq, block_k=bk, n_k=n_k, tk_valid=Tk,
+                             window=window)
     o, lse = pl.pallas_call(
         kern,
         grid=(BH, n_q, n_k),
@@ -261,7 +281,8 @@ def _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret, rep=1):
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    window: Optional[int] = None):
     """Memory-efficient exact attention.
 
     q: ``[B, T, H, D]``; k, v: ``[B, T, K, D]`` with ``H % K == 0`` — GQA
@@ -280,6 +301,12 @@ def flash_attention(q, k, v, causal: bool = False,
     rep = H // K
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     interpret = _interpret_default() if interpret is None else interpret
+    if window is not None:
+        if not causal:
+            raise ValueError("window (sliding-window attention) requires "
+                             "causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
 
     def to_bh(x):
         h = x.shape[2]
@@ -289,34 +316,37 @@ def flash_attention(q, k, v, causal: bool = False,
         return x.reshape(B, H, t, D).transpose(0, 2, 1, 3)
 
     o = _flash_core(to_bh(q), to_bh(k), to_bh(v), scale, causal,
-                    block_q, block_k, interpret, rep)
+                    block_q, block_k, interpret, rep, window or 0)
     return from_bh(o, Tq)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret, rep):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret, rep,
+                window):
     o, _ = _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret,
-                     rep)
+                     rep, window)
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, rep):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, rep,
+               window):
     o, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret,
-                       rep)
+                       rep, window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, rep, res, do):
+def _flash_bwd(scale, causal, block_q, block_k, interpret, rep, window,
+               res, do):
     q, k, v, o, lse = res
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                                 # [BH, Tq]
     return _bwd_impl(q, k, v, do, lse, delta, scale=scale, causal=causal,
                      block_q=block_q, block_k=block_k, interpret=interpret,
-                     rep=rep)
+                     rep=rep, window=window)
 
 
 def _bwd_impl(q, k, v, do, lse, delta, *, scale, causal, block_q, block_k,
-              interpret, rep=1):
+              interpret, rep=1, window=0):
     """Flash backward over one (q-shard, kv-shard) pair: q/do [BH, Tq, D],
     k/v [BK, Tk, D], lse/delta [BH, Tq] (lse may be the GLOBAL logsumexp —
     that is exactly what makes this reusable as one ring-attention backward
@@ -340,7 +370,7 @@ def _bwd_impl(q, k, v, do, lse, delta, *, scale, causal, block_q, block_k,
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk, n_k=n_k,
-                          tq_valid=Tq, tk_valid=Tk),
+                          tq_valid=Tq, tk_valid=Tk, window=window),
         grid=(BH, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
@@ -367,7 +397,7 @@ def _bwd_impl(q, k, v, do, lse, delta, *, scale, causal, block_q, block_k,
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk, n_q=n_q, n_t=rep * n_q,
-                          tq_valid=Tq, tk_valid=Tk),
+                          tq_valid=Tq, tk_valid=Tk, window=window),
         grid=(BK, n_k, rep * n_q),
         in_specs=[
             pl.BlockSpec((1, bq, D), _qix),

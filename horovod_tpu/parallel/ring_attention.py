@@ -292,14 +292,27 @@ def _ring_flash_bwd_rule(axis_name, causal, scale, block_q, block_k,
 _ring_flash_core.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
 
 
+def _causal_mask(Tq, Tk, window: Optional[int]):
+    m = jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :]
+    if window:
+        m = jnp.logical_and(
+            m, (jnp.arange(Tq)[:, None] - jnp.arange(Tk)[None, :])
+            < window)
+    return m
+
+
 def local_flash_attention(q, k, v, causal: bool = False,
-                          scale: Optional[float] = None):
+                          scale: Optional[float] = None,
+                          window: Optional[int] = None):
     """Single-device reference attention (same math, no ring) for tests and
     for the sp=1 fast path.  GQA is native: kv may have ``K = H / rep``
-    heads — a grouped einsum, no HBM repeat."""
+    heads — a grouped einsum, no HBM repeat.  ``window`` = sliding-window
+    (Mistral-style) causal attention over the last ``window`` positions."""
     B, Tq, H, D = q.shape
     K = k.shape[2]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     if K != H:
         if v.shape[2] != K or H % K:
             raise ValueError(f"GQA heads mismatch: q={H} k={K} v={v.shape[2]}")
@@ -307,8 +320,7 @@ def local_flash_attention(q, k, v, causal: bool = False,
         s = jnp.einsum("bqkrd,bskd->bkrqs", qg, k,
                        preferred_element_type=jnp.float32) * scale
         if causal:
-            Tk = k.shape[1]
-            mask = jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :]
+            mask = _causal_mask(Tq, k.shape[1], window)
             s = jnp.where(mask[None, None, None], s, NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum("bkrqs,bskd->bqkrd", p.astype(v.dtype), v,
@@ -317,8 +329,7 @@ def local_flash_attention(q, k, v, causal: bool = False,
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
-        Tk = k.shape[1]
-        mask = jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :]
+        mask = _causal_mask(Tq, k.shape[1], window)
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,

@@ -512,6 +512,54 @@ def test_speculative_generate_matches_greedy():
         np.testing.assert_array_equal(spec, ref, err_msg=f"n_draft={nd}")
 
 
+def test_sliding_window_train_and_decode(monkeypatch):
+    """Mistral-style sliding-window llama: flash path == jnp path for the
+    loss, cached decode == full-context forward argmax, and sp rejects
+    the window with a clear error."""
+    kw = dict(dtype=jnp.float32, max_seq=64, dp_axis=None, tp_axis=None,
+              sp_axis=None, sliding_window=6)
+    cfg_jnp = llama.tiny(use_flash=False, **kw)
+    cfg_flash = llama.tiny(use_flash=True, **kw)
+    params = llama.init_params(cfg_jnp, jax.random.PRNGKey(51))
+    tokens, targets = _data(cfg_jnp, batch=2, seq=24)
+
+    l_jnp = float(llama.loss_fn(params, tokens, targets, cfg_jnp))
+    l_flash = float(llama.loss_fn(params, tokens, targets, cfg_flash))
+    np.testing.assert_allclose(l_flash, l_jnp, rtol=2e-5)
+    # The window changes the math (vs full causal attention).
+    cfg_full = llama.tiny(use_flash=False, dtype=jnp.float32, max_seq=64,
+                          dp_axis=None, tp_axis=None, sp_axis=None)
+    l_full = float(llama.loss_fn(params, tokens, targets, cfg_full))
+    assert abs(l_full - l_jnp) > 1e-6
+
+    # Cached decode under the window == windowed full-context forward.
+    prompt = tokens[:, :7]
+    gen = jax.jit(lambda p, t: llama.generate(p, t, 5, cfg_jnp))(
+        params, prompt)
+    seq = prompt
+    for i in range(5):
+        logits = llama.forward(params, seq, cfg_jnp)
+        nxt = np.asarray(jnp.argmax(logits[:, -1, :], axis=-1))
+        np.testing.assert_array_equal(np.asarray(gen[:, i]), nxt,
+                                      err_msg=f"token {i}")
+        seq = jnp.concatenate(
+            [seq, jnp.asarray(nxt, jnp.int32)[:, None]], axis=1)
+
+    # sp × window is rejected at trace time.
+    cfg_sp = llama.tiny(dtype=jnp.float32, sliding_window=6)
+    mesh = infer_mesh(8, sp=2)
+    pspecs = llama.param_specs(cfg_sp)
+    sp_params = llama.init_params(cfg_sp, jax.random.PRNGKey(52))
+    from jax import shard_map
+    sp_tokens, _ = _data(cfg_sp, batch=8, seq=16, seed=53)
+    with pytest.raises(ValueError, match="sliding_window"):
+        jax.jit(shard_map(
+            lambda p, t: llama.forward(p, t, cfg_sp), mesh=mesh,
+            in_specs=(pspecs, P(("dp", "ep", "pp"), "sp")),
+            out_specs=P(("dp", "ep", "pp"), "sp"), check_vma=False))(
+            sp_params, sp_tokens).block_until_ready()
+
+
 def test_kv_cache_budget_enforced():
     """Decoding past the cache raises instead of silently clamping writes
     onto the last slot; n_tokens=0 returns an empty [B, 0]."""
