@@ -107,10 +107,16 @@ class LlamaConfig:
     # attends its last ``sliding_window`` positions only.  The flash
     # kernel skips whole out-of-window blocks (O(T·W) compute); local
     # attention only for now — sp (ring/Ulysses) rejects it at trace
-    # time (ring-step skipping is the natural extension; the KV cache
-    # stays full-length, masked — a ring-buffer cache is the memory
-    # follow-up).
+    # time (ring-step skipping is the natural extension).
     sliding_window: Optional[int] = None
+    # Rolling KV cache for windowed decode: the cache becomes a ring of
+    # ``sliding_window + rolling_slack`` slots (position p lives at slot
+    # p mod R) instead of max_seq — O(W) serving memory and UNBOUNDED
+    # generation length.  The slack keeps a chunked write (decode_chunk,
+    # speculative verify) from overwriting slots its own earlier rows
+    # still attend: any chunk up to ``rolling_slack`` tokens is safe.
+    rolling_cache: bool = False
+    rolling_slack: int = 8
 
     @property
     def head_dim(self) -> int:
@@ -129,6 +135,13 @@ class LlamaConfig:
             raise ValueError(
                 f"sliding_window must be >= 1 (or None to disable), got "
                 f"{self.sliding_window!r}")
+        if self.rolling_cache:
+            if not self.sliding_window:
+                raise ValueError("rolling_cache requires sliding_window "
+                                 "(a full-attention model needs every "
+                                 "past position)")
+            if self.rolling_slack < 1:
+                raise ValueError("rolling_slack must be >= 1")
 
     @property
     def all_axes(self):
@@ -577,7 +590,12 @@ def init_cache(cfg: LlamaConfig, batch: int, max_seq: Optional[int] = None,
     fixed ring of ``max_seq`` slots written via dynamic_update_slice, so
     one compiled decode step serves every position.
     """
-    T = max_seq or cfg.max_seq
+    if cfg.rolling_cache:
+        # Ring of W + slack slots (position p -> slot p mod R): O(W)
+        # memory, unbounded generation.  max_seq is irrelevant here.
+        T = cfg.sliding_window + cfg.rolling_slack
+    else:
+        T = max_seq or cfg.max_seq
     K = cfg.n_kv_heads
     if cfg.tp_axis:
         # Inside shard_map (tp decode) each rank holds its K/tp kv-head
@@ -601,10 +619,14 @@ def init_cache(cfg: LlamaConfig, batch: int, max_seq: Optional[int] = None,
             for _ in range(cfg.n_layers)]
 
 
-def _check_cache_budget(t_final: int, cache_t: int):
+def _check_cache_budget(t_final: int, cache_t: int,
+                        cfg: Optional[LlamaConfig] = None):
     """Every position is static at trace time — refuse to decode past the
     cache instead of letting dynamic_update_slice clamp writes onto the
-    last slot (which silently corrupts every later token)."""
+    last slot (which silently corrupts every later token).  A rolling
+    cache has no length budget (positions wrap)."""
+    if cfg is not None and cfg.rolling_cache:
+        return
     if t_final > cache_t:
         raise ValueError(
             f"decode would write position {t_final - 1} but the KV cache "
@@ -659,23 +681,61 @@ def decode_chunk(params, cache, tokens, pos, cfg: LlamaConfig):
     positions = pos + jnp.arange(Tq)
     new_cache = []
     T = cache[0]["k"].shape[1]
-    # valid[i, t]: chunk row i sees cache positions t <= pos + i (and,
-    # with a sliding window, only the last ``sliding_window`` of them).
-    valid = (jnp.arange(T)[None, :]
-             <= (pos + jnp.arange(Tq))[:, None])     # [Tq, T]
-    if cfg.sliding_window:
-        valid = jnp.logical_and(
-            valid, jnp.arange(T)[None, :]
-            > (pos + jnp.arange(Tq))[:, None] - cfg.sliding_window)
+    if cfg.rolling_cache:
+        if Tq > cfg.rolling_slack:
+            raise ValueError(
+                f"decode_chunk of {Tq} tokens exceeds rolling_slack="
+                f"{cfg.rolling_slack}: earlier chunk rows would attend "
+                f"slots the later writes just overwrote; raise "
+                f"rolling_slack")
+        # Slot j holds position p_j = the largest p ≤ (chunk end) with
+        # p ≡ j (mod R); row i attends p_j in (pos+i-W, pos+i].  The
+        # explicit p_j >= 0 term masks never-written slots — without it
+        # a context SHORTER than the window would attend zero-filled
+        # slots (their derived p_j is negative, but so is qpos-W then).
+        R = T
+        end = pos + Tq - 1
+        j = jnp.arange(R)[None, :]
+        p_j = end - ((end - j) % R)                  # [1, R]
+        qpos = (pos + jnp.arange(Tq))[:, None]       # [Tq, 1]
+        valid = (p_j >= 0) & (p_j <= qpos) \
+            & (p_j > qpos - cfg.sliding_window)
+        write_slots = (pos + jnp.arange(Tq)) % R     # [Tq]
+    else:
+        # valid[i, t]: chunk row i sees cache positions t <= pos + i
+        # (and, with a sliding window, only the last W of them).
+        valid = (jnp.arange(T)[None, :]
+                 <= (pos + jnp.arange(Tq))[:, None])     # [Tq, T]
+        if cfg.sliding_window:
+            valid = jnp.logical_and(
+                valid, jnp.arange(T)[None, :]
+                > (pos + jnp.arange(Tq))[:, None] - cfg.sliding_window)
     valid = valid[None, None, None, :, :]            # [1,1,1,Tq,T]
     for p, c in zip(params["layers"], cache):
         h = _rmsnorm(x, p["attn_norm"])
         q, k_new, v_new = _qkv(h, p, cfg, positions)  # local head shard
         H, K, Hd = q.shape[2], k_new.shape[2], q.shape[3]
-        ck = lax.dynamic_update_slice(c["k"], k_new.astype(c["k"].dtype),
-                                      (0, pos, 0, 0))
-        cv = lax.dynamic_update_slice(c["v"], v_new.astype(c["v"].dtype),
-                                      (0, pos, 0, 0))
+        if cfg.rolling_cache:
+            if Tq == 1:
+                # Hot decode loop: a single position is always a
+                # contiguous write — dynamic_update_slice at pos % R
+                # avoids scatter lowering per layer per token.
+                ck = lax.dynamic_update_slice(
+                    c["k"], k_new.astype(c["k"].dtype),
+                    (0, pos % T, 0, 0))
+                cv = lax.dynamic_update_slice(
+                    c["v"], v_new.astype(c["v"].dtype),
+                    (0, pos % T, 0, 0))
+            else:
+                ck = c["k"].at[:, write_slots].set(
+                    k_new.astype(c["k"].dtype))
+                cv = c["v"].at[:, write_slots].set(
+                    v_new.astype(c["v"].dtype))
+        else:
+            ck = lax.dynamic_update_slice(
+                c["k"], k_new.astype(c["k"].dtype), (0, pos, 0, 0))
+            cv = lax.dynamic_update_slice(
+                c["v"], v_new.astype(c["v"].dtype), (0, pos, 0, 0))
         new_cache.append({"k": ck, "v": cv})
         # GQA groups against the shared kv, one extra chunk axis q.
         qg = q.reshape(B, Tq, K, H // K, Hd)
@@ -717,17 +777,29 @@ def prefill(params, cache, tokens, cfg: LlamaConfig):
     """
     _decode_axes_check(cfg, "prefill")
     B, T0 = tokens.shape
-    _check_cache_budget(T0, cache[0]["k"].shape[1])
+    _check_cache_budget(T0, cache[0]["k"].shape[1], cfg)
     positions = jnp.arange(T0)
     x = params["embed"][tokens]                      # [B, T0, D]
     new_cache = []
     for p, c in zip(params["layers"], cache):
         h = _rmsnorm(x, p["attn_norm"])
         q, k, v = _qkv(h, p, cfg, positions)         # local head shard
-        ck = lax.dynamic_update_slice(c["k"], k.astype(c["k"].dtype),
-                                      (0, 0, 0, 0))
-        cv = lax.dynamic_update_slice(c["v"], v.astype(c["v"].dtype),
-                                      (0, 0, 0, 0))
+        if cfg.rolling_cache:
+            # Only the last min(T0, R) prompt positions can ever be
+            # attended again — write just those, at their ring slots
+            # (static indices: T0 and R are trace-time constants).
+            R = c["k"].shape[1]
+            keep = min(T0, R)
+            slots = np.arange(T0 - keep, T0) % R
+            ck = c["k"].at[:, slots].set(
+                k[:, T0 - keep:].astype(c["k"].dtype))
+            cv = c["v"].at[:, slots].set(
+                v[:, T0 - keep:].astype(c["v"].dtype))
+        else:
+            ck = lax.dynamic_update_slice(c["k"], k.astype(c["k"].dtype),
+                                          (0, 0, 0, 0))
+            cv = lax.dynamic_update_slice(c["v"], v.astype(c["v"].dtype),
+                                          (0, 0, 0, 0))
         new_cache.append({"k": ck, "v": cv})
         x = x + _wo_project(_local_attend(q, k, v, cfg), p, cfg)
         y, _ = _mlp(_rmsnorm(x, p["mlp_norm"]), p, cfg)
@@ -786,7 +858,7 @@ def generate(params, prompt, n_tokens: int, cfg: LlamaConfig,
         raise ValueError("temperature > 0 requires rng=")
     cache = init_cache(cfg, B, max_seq)
     # The last generated token's own kv is never written back, hence -1.
-    _check_cache_budget(T0 + n_tokens - 1, cache[0]["k"].shape[1])
+    _check_cache_budget(T0 + n_tokens - 1, cache[0]["k"].shape[1], cfg)
     logits, cache = prefill(params, cache, prompt, cfg)
 
     def pick(logits, t):
@@ -841,7 +913,12 @@ def speculative_generate(params, draft_params, prompt, n_tokens: int,
     budget = max_seq or (T0 + n_tokens + k)
     cache_t = init_cache(cfg, B, budget)
     cache_d = init_cache(draft_cfg, B, budget)
-    _check_cache_budget(T0 + n_tokens + k, budget)
+    # Both caches have budgets of their own: a rolling target does not
+    # exempt a fixed-length draft cache (whose clamped writes would
+    # silently corrupt the draft and erode acceptance).
+    _check_cache_budget(T0 + n_tokens + k, cache_t[0]["k"].shape[1], cfg)
+    _check_cache_budget(T0 + n_tokens + k, cache_d[0]["k"].shape[1],
+                        draft_cfg)
 
     logits_t, cache_t = prefill(params, cache_t, prompt, cfg)
     _, cache_d = prefill(draft_params, cache_d, prompt, draft_cfg)

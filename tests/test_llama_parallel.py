@@ -560,6 +560,75 @@ def test_sliding_window_train_and_decode(monkeypatch):
             sp_params, sp_tokens).block_until_ready()
 
 
+def test_rolling_cache_matches_full_cache():
+    """Rolling (ring-buffer) KV cache for windowed decode: O(W+slack)
+    memory, positions wrap — must generate EXACTLY what the full-length
+    masked cache generates, across multiple ring wraps, with prompts
+    longer than the ring, through speculative decoding, and BEYOND
+    max_seq (the unbounded-generation property)."""
+    W, slack = 8, 4
+    base = dict(dtype=jnp.float32, dp_axis=None, tp_axis=None,
+                sp_axis=None, sliding_window=W, use_flash=False)
+    cfg_full = llama.tiny(max_seq=64, **base)
+    cfg_roll = llama.tiny(max_seq=64, rolling_cache=True,
+                          rolling_slack=slack, **base)
+    params = llama.init_params(cfg_full, jax.random.PRNGKey(61))
+    rng = np.random.RandomState(62)
+    prompt = jnp.asarray(rng.randint(0, cfg_full.vocab_size, (2, 10)),
+                         jnp.int32)
+    N = 20                                   # ring R=12 wraps twice
+    ref = np.asarray(jax.jit(
+        lambda p, t: llama.generate(p, t, N, cfg_full))(params, prompt))
+    roll = np.asarray(jax.jit(
+        lambda p, t: llama.generate(p, t, N, cfg_roll))(params, prompt))
+    np.testing.assert_array_equal(roll, ref)
+    # Ring memory really is O(W + slack).
+    c = llama.init_cache(cfg_roll, 2)
+    assert c[0]["k"].shape[1] == W + slack
+
+    # Prompt longer than the ring.
+    prompt2 = jnp.asarray(rng.randint(0, cfg_full.vocab_size, (1, 20)),
+                          jnp.int32)
+    ref2 = np.asarray(llama.generate(params, prompt2, 6, cfg_full))
+    roll2 = np.asarray(llama.generate(params, prompt2, 6, cfg_roll))
+    np.testing.assert_array_equal(roll2, ref2)
+
+    # Prompt SHORTER than the window: never-written ring slots derive
+    # negative positions and must be masked — qpos-W is negative too in
+    # this regime, so the p_j >= 0 term is what excludes them (the
+    # review-caught dilution bug).
+    prompt3 = jnp.asarray(rng.randint(0, cfg_full.vocab_size, (2, 3)),
+                          jnp.int32)
+    ref3 = np.asarray(llama.generate(params, prompt3, 8, cfg_full))
+    roll3 = np.asarray(llama.generate(params, prompt3, 8, cfg_roll))
+    np.testing.assert_array_equal(roll3, ref3)
+
+    # Speculative decoding on the rolling cache (chunk 3 <= slack).
+    draft = llama.init_params(cfg_full, jax.random.PRNGKey(63))
+    spec = np.asarray(llama.speculative_generate(
+        params, draft, prompt, N, cfg_roll, n_draft=2))
+    np.testing.assert_array_equal(spec, ref)
+
+    # Chunks beyond the slack are rejected (their earlier rows would
+    # attend freshly-overwritten slots).
+    cache = llama.init_cache(cfg_roll, 1)
+    big = jnp.zeros((1, slack + 1), jnp.int32)
+    with pytest.raises(ValueError, match="rolling_slack"):
+        llama.decode_chunk(params, cache, big, 0, cfg_roll)
+
+    # Unbounded generation: past max_seq, where the full cache refuses.
+    cfg_small = llama.tiny(max_seq=16, **base)
+    cfg_small_roll = llama.tiny(max_seq=16, rolling_cache=True,
+                                rolling_slack=slack, **base)
+    with pytest.raises(ValueError, match="slots"):
+        llama.generate(params, prompt, 30, cfg_small)
+    long_out = llama.generate(params, prompt, 30, cfg_small_roll)
+    assert long_out.shape == (2, 30)
+    np.testing.assert_array_equal(
+        np.asarray(long_out[:, :N]),
+        np.asarray(llama.generate(params, prompt, N, cfg_full)))
+
+
 def test_kv_cache_budget_enforced():
     """Decoding past the cache raises instead of silently clamping writes
     onto the last slot; n_tokens=0 returns an empty [B, 0]."""
