@@ -1,0 +1,160 @@
+"""Weight conversion: HuggingFace Llama/Mistral checkpoints -> llama.py.
+
+Beyond the reference (Horovod ships no models, so no loaders either):
+a user switching over brings their weights — this module maps the HF
+``LlamaForCausalLM`` / ``MistralForCausalLM`` state-dict naming onto
+``models/llama.py``'s parameter pytree, handling the two real layout
+differences:
+
+- **Linear orientation**: HF ``nn.Linear`` stores ``[out, in]``; this
+  repo's matmuls are ``x @ W`` with ``W [in, out]`` — every projection
+  transposes.
+- **Rotary layout**: none needed — HF's ``rotate_half`` rope is the
+  same half-split convention as ``_rope`` here (cos/sin over
+  ``arange(0, d, 2)/d`` ≡ ``arange(d/2)/(d/2)``), so q/k convert by
+  transpose alone.  (The per-head interleave "unpermute" from the
+  original conversion scripts applies to META-format checkpoints, which
+  HF's own converter already normalized — parity is pinned against
+  ``transformers`` logits in ``tests/test_convert.py``.)
+
+Input: any mapping of ``str -> array`` (a ``safetensors`` file opened
+with ``numpy``, a ``torch.load`` state dict, or a dict of numpy arrays —
+tensors are converted via ``np.asarray``; torch tensors are accepted
+without importing torch).  Output: the exact pytree ``init_params``
+produces, ready for ``shard_params``/``cache_specs``/decode.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import LlamaConfig
+
+
+def _np(x) -> np.ndarray:
+    """Accept numpy / jax / torch tensors without importing torch."""
+    if hasattr(x, "detach"):          # torch.Tensor
+        x = x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def from_hf_state_dict(sd: Mapping[str, Any], cfg: LlamaConfig) -> Dict:
+    """Map an HF Llama/Mistral state dict onto ``init_params``'s pytree.
+
+    Expects the standard names (``model.layers.N.self_attn.q_proj.weight``
+    etc.); raises KeyError naming the first missing tensor and ValueError
+    on UNCONSUMED tensors (a 32-layer checkpoint against n_layers=16, or
+    attention biases this architecture doesn't have, must not convert
+    silently into a wrong model).  Output dtypes follow ``cfg.dtype``;
+    norms stay as stored.  Match ``cfg.norm_eps`` to the checkpoint's
+    ``rms_norm_eps``.
+    """
+    if cfg.n_experts:
+        raise ValueError(
+            "from_hf_state_dict maps dense Llama/Mistral checkpoints; "
+            "MoE (n_experts > 0) checkpoints have a different layer "
+            "shape — convert with n_experts=0 or write a Mixtral mapper")
+    dt = cfg.dtype
+    consumed = set()
+
+    def get(name):
+        if name not in sd:
+            raise KeyError(
+                f"state dict is missing {name!r} — is this a "
+                f"LlamaForCausalLM/MistralForCausalLM checkpoint with "
+                f"n_layers={cfg.n_layers}?")
+        consumed.add(name)
+        return _np(sd[name])
+
+    def linear(name):
+        return get(name).T          # HF [out, in] -> x @ W [in, out]
+
+    layers = []
+    for i in range(cfg.n_layers):
+        pre = f"model.layers.{i}."
+        layers.append({
+            "attn_norm": jnp.asarray(
+                get(pre + "input_layernorm.weight"), dt),
+            "wq": jnp.asarray(linear(pre + "self_attn.q_proj.weight"), dt),
+            "wk": jnp.asarray(linear(pre + "self_attn.k_proj.weight"), dt),
+            "wv": jnp.asarray(linear(pre + "self_attn.v_proj.weight"), dt),
+            "wo": jnp.asarray(linear(pre + "self_attn.o_proj.weight"), dt),
+            "mlp_norm": jnp.asarray(
+                get(pre + "post_attention_layernorm.weight"), dt),
+            "w1": jnp.asarray(linear(pre + "mlp.gate_proj.weight"), dt),
+            "w3": jnp.asarray(linear(pre + "mlp.up_proj.weight"), dt),
+            "w2": jnp.asarray(linear(pre + "mlp.down_proj.weight"), dt),
+        })
+    if cfg.pp_axis:
+        import jax
+        layers = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *layers)
+
+    embed = jnp.asarray(get("model.embed_tokens.weight"), dt)
+    if "lm_head.weight" in sd:
+        head = jnp.asarray(linear("lm_head.weight"), dt)
+    else:
+        # Tied embeddings (tie_word_embeddings=True).
+        head = embed.T.astype(dt)
+    norm = jnp.asarray(get("model.norm.weight"), dt)
+
+    extra = [k for k in sd
+             if k not in consumed and "rotary_emb.inv_freq" not in k]
+    if extra:
+        raise ValueError(
+            f"{len(extra)} checkpoint tensor(s) were not consumed — the "
+            f"config does not describe this checkpoint (wrong n_layers? "
+            f"an architecture with biases?).  First few: "
+            f"{sorted(extra)[:4]}")
+    return {
+        "embed": embed,
+        "layers": layers,
+        "final_norm": norm,
+        "lm_head": head,
+    }
+
+
+def to_hf_state_dict(params: Dict, cfg: LlamaConfig,
+                     tied_embeddings: bool = False
+                     ) -> Dict[str, np.ndarray]:
+    """The inverse mapping (round-trip tested): this repo's pytree back to
+    HF naming/orientation — for exporting fine-tuned weights.
+    ``tied_embeddings=True`` omits ``lm_head.weight`` (the
+    tie_word_embeddings checkpoint shape from_hf_state_dict accepts)."""
+    if cfg.pp_axis:
+        raise ValueError("export from the stacked pp layout is not "
+                         "supported; rebuild params with pp_axis=None")
+    if cfg.n_experts:
+        raise ValueError("to_hf_state_dict maps the dense layer shape; "
+                         "MoE params have no HF Llama/Mistral layout")
+    sd: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"],
+                                                np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    if not tied_embeddings:
+        sd["lm_head.weight"] = np.asarray(params["lm_head"],
+                                          np.float32).T
+    for i, lp in enumerate(params["layers"]):
+        pre = f"model.layers.{i}."
+        sd[pre + "input_layernorm.weight"] = np.asarray(
+            lp["attn_norm"], np.float32)
+        sd[pre + "post_attention_layernorm.weight"] = np.asarray(
+            lp["mlp_norm"], np.float32)
+        sd[pre + "self_attn.q_proj.weight"] = np.asarray(
+            lp["wq"], np.float32).T
+        sd[pre + "self_attn.k_proj.weight"] = np.asarray(
+            lp["wk"], np.float32).T
+        sd[pre + "self_attn.v_proj.weight"] = np.asarray(
+            lp["wv"], np.float32).T
+        sd[pre + "self_attn.o_proj.weight"] = np.asarray(
+            lp["wo"], np.float32).T
+        sd[pre + "mlp.gate_proj.weight"] = np.asarray(
+            lp["w1"], np.float32).T
+        sd[pre + "mlp.up_proj.weight"] = np.asarray(
+            lp["w3"], np.float32).T
+        sd[pre + "mlp.down_proj.weight"] = np.asarray(
+            lp["w2"], np.float32).T
+    return sd

@@ -117,6 +117,10 @@ class LlamaConfig:
     # still attend: any chunk up to ``rolling_slack`` tokens is safe.
     rolling_cache: bool = False
     rolling_slack: int = 8
+    # RMSNorm epsilon — checkpoint-dependent (Llama-3: 1e-5; several
+    # families use 1e-6); models/convert.py parity depends on matching
+    # the source checkpoint's value.
+    norm_eps: float = 1e-5
 
     @property
     def head_dim(self) -> int:
@@ -398,8 +402,10 @@ def _mlp(x, p, cfg: LlamaConfig, rng=None):
 
 
 def _layer_apply(p, x, cfg: LlamaConfig, positions, rng=None):
-    x = x + _attention(_rmsnorm(x, p["attn_norm"]), p, cfg, positions)
-    y, aux = _mlp(_rmsnorm(x, p["mlp_norm"]), p, cfg, rng=rng)
+    x = x + _attention(_rmsnorm(x, p["attn_norm"], cfg.norm_eps), p, cfg,
+                       positions)
+    y, aux = _mlp(_rmsnorm(x, p["mlp_norm"], cfg.norm_eps), p, cfg,
+                  rng=rng)
     return x + y, aux
 
 
@@ -476,7 +482,7 @@ def _forward(params, tokens, cfg: LlamaConfig, rng=None):
                     if rng is not None else None)
             x, aux = _layer_apply(p, x, cfg, positions, rng=lrng)
             aux_total = aux_total + aux
-    x = _rmsnorm(x, params["final_norm"])
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
     return x @ params["lm_head"], aux_total
 
 
@@ -712,7 +718,7 @@ def decode_chunk(params, cache, tokens, pos, cfg: LlamaConfig):
                 > (pos + jnp.arange(Tq))[:, None] - cfg.sliding_window)
     valid = valid[None, None, None, :, :]            # [1,1,1,Tq,T]
     for p, c in zip(params["layers"], cache):
-        h = _rmsnorm(x, p["attn_norm"])
+        h = _rmsnorm(x, p["attn_norm"], cfg.norm_eps)
         q, k_new, v_new = _qkv(h, p, cfg, positions)  # local head shard
         H, K, Hd = q.shape[2], k_new.shape[2], q.shape[3]
         if cfg.rolling_cache:
@@ -748,9 +754,9 @@ def decode_chunk(params, cache, tokens, pos, cfg: LlamaConfig):
                        preferred_element_type=jnp.float32)
         x = x + _wo_project(o.reshape(B, Tq, H, Hd).astype(x.dtype),
                             p, cfg)
-        y, _ = _mlp(_rmsnorm(x, p["mlp_norm"]), p, cfg)
+        y, _ = _mlp(_rmsnorm(x, p["mlp_norm"], cfg.norm_eps), p, cfg)
         x = x + y
-    x = _rmsnorm(x, params["final_norm"])
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"]).astype(jnp.float32), new_cache
 
 
@@ -782,7 +788,7 @@ def prefill(params, cache, tokens, cfg: LlamaConfig):
     x = params["embed"][tokens]                      # [B, T0, D]
     new_cache = []
     for p, c in zip(params["layers"], cache):
-        h = _rmsnorm(x, p["attn_norm"])
+        h = _rmsnorm(x, p["attn_norm"], cfg.norm_eps)
         q, k, v = _qkv(h, p, cfg, positions)         # local head shard
         if cfg.rolling_cache:
             # Only the last min(T0, R) prompt positions can ever be
@@ -802,9 +808,9 @@ def prefill(params, cache, tokens, cfg: LlamaConfig):
                                           (0, 0, 0, 0))
         new_cache.append({"k": ck, "v": cv})
         x = x + _wo_project(_local_attend(q, k, v, cfg), p, cfg)
-        y, _ = _mlp(_rmsnorm(x, p["mlp_norm"]), p, cfg)
+        y, _ = _mlp(_rmsnorm(x, p["mlp_norm"], cfg.norm_eps), p, cfg)
         x = x + y
-    x = _rmsnorm(x, params["final_norm"])
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
     return ((x[:, -1, :] @ params["lm_head"]).astype(jnp.float32),
             new_cache)
 
