@@ -35,9 +35,14 @@ from .llama import LlamaConfig
 
 
 def _np(x) -> np.ndarray:
-    """Accept numpy / jax / torch tensors without importing torch."""
+    """Accept numpy / jax / torch tensors without importing torch.
+    Real checkpoints ship bf16, which numpy cannot represent — upcast to
+    float32 (exact: every bf16 value is a float32)."""
     if hasattr(x, "detach"):          # torch.Tensor
-        x = x.detach().cpu().numpy()
+        x = x.detach().cpu()
+        if str(x.dtype) == "torch.bfloat16":
+            x = x.float()
+        x = x.numpy()
     return np.asarray(x)
 
 
@@ -52,11 +57,12 @@ def from_hf_state_dict(sd: Mapping[str, Any], cfg: LlamaConfig) -> Dict:
     norms stay as stored.  Match ``cfg.norm_eps`` to the checkpoint's
     ``rms_norm_eps``.
     """
-    if cfg.n_experts:
+    if cfg.n_experts and not (cfg.moe_gated and cfg.router_top_k >= 2):
         raise ValueError(
-            "from_hf_state_dict maps dense Llama/Mistral checkpoints; "
-            "MoE (n_experts > 0) checkpoints have a different layer "
-            "shape — convert with n_experts=0 or write a Mixtral mapper")
+            "MoE conversion expects the Mixtral shape: moe_gated=True "
+            "(SwiGLU experts) with router_top_k >= 2 (normalized top-k "
+            "gates — top-1 Switch routing over top-2-trained weights "
+            "would be silently wrong) — see mixtral_8x7b()")
     dt = cfg.dtype
     consumed = set()
 
@@ -75,7 +81,7 @@ def from_hf_state_dict(sd: Mapping[str, Any], cfg: LlamaConfig) -> Dict:
     layers = []
     for i in range(cfg.n_layers):
         pre = f"model.layers.{i}."
-        layers.append({
+        layer = {
             "attn_norm": jnp.asarray(
                 get(pre + "input_layernorm.weight"), dt),
             "wq": jnp.asarray(linear(pre + "self_attn.q_proj.weight"), dt),
@@ -84,10 +90,33 @@ def from_hf_state_dict(sd: Mapping[str, Any], cfg: LlamaConfig) -> Dict:
             "wo": jnp.asarray(linear(pre + "self_attn.o_proj.weight"), dt),
             "mlp_norm": jnp.asarray(
                 get(pre + "post_attention_layernorm.weight"), dt),
-            "w1": jnp.asarray(linear(pre + "mlp.gate_proj.weight"), dt),
-            "w3": jnp.asarray(linear(pre + "mlp.up_proj.weight"), dt),
-            "w2": jnp.asarray(linear(pre + "mlp.down_proj.weight"), dt),
-        })
+        }
+        if cfg.n_experts:
+            # MixtralForCausalLM sparse block: per-expert SwiGLU
+            # (w1 gate, w3 up, w2 down — each nn.Linear [out, in]) plus
+            # the router gate.  Stacked onto this repo's [E, ...] slabs.
+            moe_pre = pre + "block_sparse_moe."
+            layer["moe"] = {
+                "router": jnp.asarray(linear(moe_pre + "gate.weight"), dt),
+                "w1": jnp.asarray(np.stack(
+                    [linear(f"{moe_pre}experts.{e}.w1.weight")
+                     for e in range(cfg.n_experts)]), dt),
+                "w3": jnp.asarray(np.stack(
+                    [linear(f"{moe_pre}experts.{e}.w3.weight")
+                     for e in range(cfg.n_experts)]), dt),
+                "w2": jnp.asarray(np.stack(
+                    [linear(f"{moe_pre}experts.{e}.w2.weight")
+                     for e in range(cfg.n_experts)]), dt),
+            }
+        else:
+            layer |= {
+                "w1": jnp.asarray(linear(pre + "mlp.gate_proj.weight"),
+                                  dt),
+                "w3": jnp.asarray(linear(pre + "mlp.up_proj.weight"), dt),
+                "w2": jnp.asarray(linear(pre + "mlp.down_proj.weight"),
+                                  dt),
+            }
+        layers.append(layer)
     if cfg.pp_axis:
         import jax
         layers = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *layers)
@@ -134,7 +163,17 @@ def to_hf_state_dict(params: Dict, cfg: LlamaConfig,
                                                 np.float32),
         "model.norm.weight": np.asarray(params["final_norm"], np.float32),
     }
-    if not tied_embeddings:
+    if tied_embeddings:
+        # Refuse to silently drop a head that diverged from the
+        # embedding (fine-tuning breaks the tie).
+        if not np.allclose(np.asarray(params["lm_head"], np.float32),
+                           np.asarray(params["embed"], np.float32).T,
+                           atol=1e-6):
+            raise ValueError(
+                "tied_embeddings=True but params['lm_head'] != "
+                "embed.T — exporting would discard trained head "
+                "weights; export untied instead")
+    else:
         sd["lm_head.weight"] = np.asarray(params["lm_head"],
                                           np.float32).T
     for i, lp in enumerate(params["layers"]):

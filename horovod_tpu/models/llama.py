@@ -98,6 +98,7 @@ class LlamaConfig:
     router_top_k: int = 1              # 1 = Switch, >=2 = GShard top-k
     router_z_weight: float = 0.0       # ST-MoE z-loss weight (0 = off)
     router_noise: float = 0.0          # router jitter std (needs rng=)
+    moe_gated: bool = False            # SwiGLU experts (Mixtral shape)
     # Pallas flash attention: True/False, or None = resolve from the
     # HVD_TPU_FLASH env var at TRACE time (auto: on when running on TPU).
     # The env var is not part of any jit cache key — to toggle after a
@@ -173,7 +174,8 @@ class LlamaConfig:
             ep_axis=self.ep_axis, router_mode=self.router_mode,
             router_top_k=self.router_top_k,
             router_z_weight=self.router_z_weight,
-            router_noise=self.router_noise, dtype=self.dtype)
+            router_noise=self.router_noise, gated=self.moe_gated,
+            dtype=self.dtype)
 
 
 def tiny(vocab_size: int = 256, d_model: int = 64, n_layers: int = 2,
@@ -187,6 +189,16 @@ def tiny(vocab_size: int = 256, d_model: int = 64, n_layers: int = 2,
 
 def llama3_8b() -> LlamaConfig:
     return LlamaConfig()  # defaults above are the 8B geometry
+
+
+def mixtral_8x7b() -> LlamaConfig:
+    """Mixtral-8x7B geometry: Mistral attention + 8 SwiGLU experts with
+    normalized top-2 routing (models/moe.py gated experts)."""
+    return LlamaConfig(vocab_size=32000, d_model=4096, n_layers=32,
+                       n_heads=32, n_kv_heads=8, d_ff=14336,
+                       max_seq=32768, rope_theta=1e6,
+                       n_experts=8, router_top_k=2, moe_gated=True,
+                       ep_axis="ep")
 
 
 def mistral_7b() -> LlamaConfig:

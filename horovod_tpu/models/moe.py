@@ -61,6 +61,10 @@ class MoEConfig:
     # training paths (lm_loss here, llama.loss_fn) as an ABSOLUTE weight,
     # like aux_weight.
     router_z_weight: float = 0.0
+    # SwiGLU experts (Mixtral / the dense llama MLP shape): each expert
+    # gains an up-projection w3 and computes (silu(x·w1) ⊙ (x·w3))·w2
+    # instead of silu(x·w1)·w2.
+    gated: bool = False
     dtype: Any = jnp.float32
 
     def capacity(self, tokens_per_rank: int) -> int:
@@ -73,10 +77,10 @@ class MoEConfig:
 
 
 def init_params(cfg: MoEConfig, key) -> Dict:
-    kr, k1, k2 = jax.random.split(key, 3)
+    kr, k1, k2, k3 = jax.random.split(key, 4)
     E, D, F = cfg.n_experts, cfg.d_model, cfg.d_ff
     s1, s2 = 1.0 / np.sqrt(D), 1.0 / np.sqrt(F)
-    return {
+    p = {
         "router": (jax.random.normal(kr, (D, E), jnp.float32) * s1
                    ).astype(cfg.dtype),
         "w1": (jax.random.normal(k1, (E, D, F), jnp.float32) * s1
@@ -84,11 +88,18 @@ def init_params(cfg: MoEConfig, key) -> Dict:
         "w2": (jax.random.normal(k2, (E, F, D), jnp.float32) * s2
                ).astype(cfg.dtype),
     }
+    if cfg.gated:
+        p["w3"] = (jax.random.normal(k3, (E, D, F), jnp.float32) * s1
+                   ).astype(cfg.dtype)
+    return p
 
 
 def param_specs(cfg: MoEConfig) -> Dict:
     ep = cfg.ep_axis
-    return {"router": P(), "w1": P(ep), "w2": P(ep)}
+    specs = {"router": P(), "w1": P(ep), "w2": P(ep)}
+    if cfg.gated:
+        specs["w3"] = P(ep)
+    return specs
 
 
 def _route(x, router_w, cfg: MoEConfig, rng: Optional[jax.Array]):
@@ -217,8 +228,9 @@ def moe_ffn(x, params, cfg: MoEConfig,
         buf = lax.all_to_all(buf, cfg.ep_axis, split_axis=0, concat_axis=1,
                              tiled=True)
 
-    h = jnp.einsum("ecd,edf->ecf", buf, params["w1"])
-    h = jax.nn.silu(h)
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", buf, params["w1"]))
+    if cfg.gated:
+        h = h * jnp.einsum("ecd,edf->ecf", buf, params["w3"])
     out = jnp.einsum("ecf,efd->ecd", h, params["w2"])
 
     if ep > 1:

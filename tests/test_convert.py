@@ -70,6 +70,39 @@ def test_hf_round_trip_lossless():
                                    err_msg=k)
 
 
+def test_hf_mixtral_conversion_matches_transformers():
+    """The whole MoE stack (normalized top-2 routing, SwiGLU experts,
+    einsum dispatch) against transformers' MixtralForCausalLM from the
+    SAME weights — capacity_factor = n_experts so no token drops and the
+    static-capacity formulation must match Mixtral's dense-gather math
+    exactly."""
+    import torch
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    hf_cfg = MixtralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False, num_local_experts=4,
+        num_experts_per_tok=2)
+    torch.manual_seed(0)
+    model = MixtralForCausalLM(hf_cfg).eval()
+    cfg = llama.LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=64, rope_theta=10000.0, dtype=jnp.float32,
+        n_experts=4, router_top_k=2, moe_gated=True, ep_axis=None,
+        capacity_factor=4.0, dp_axis=None, tp_axis=None, sp_axis=None,
+        use_flash=False)
+    params = convert.from_hf_state_dict(model.state_dict(), cfg)
+
+    tokens = np.random.RandomState(5).randint(0, cfg.vocab_size, (2, 10))
+    ours = np.asarray(llama.forward(params,
+                                    jnp.asarray(tokens, jnp.int32), cfg))
+    with torch.no_grad():
+        theirs = model(torch.tensor(tokens)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=3e-4, rtol=3e-4)
+
+
 def test_hf_missing_key_is_clear():
     _, cfg = _cfgs()
     with pytest.raises(KeyError, match="state dict is missing"):
@@ -89,8 +122,9 @@ def test_tied_embeddings_fallback_and_round_trip():
 
 
 def test_norm_eps_matters_and_propagates():
-    """A 1e-6 checkpoint converts exactly when cfg.norm_eps matches —
-    and measurably diverges when it does not (the silent-drift guard)."""
+    """A non-default-eps checkpoint (1e-4 here; 1e-6 families behave the
+    same way) converts exactly when cfg.norm_eps matches — and measurably
+    diverges when it does not (the silent-drift guard)."""
     import torch
     model, cfg = _cfgs(rms_eps=1e-4)
     params = convert.from_hf_state_dict(model.state_dict(), cfg)
