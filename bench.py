@@ -454,7 +454,7 @@ def bench_llama(batch, steps):
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
     _record_timing("llama", warmup=2, iters=steps, wall_s=dt,
-                   global_batch=batch, seq=seq, flash=flash_enabled(),
+                   global_batch=batch, seq=seq, flash=flash_enabled(seq=seq),
                    n_experts=n_experts, router_top_k=cfg.router_top_k,
                    sliding_window=window or 0)
     return batch * seq * steps / dt
@@ -511,7 +511,11 @@ def bench_decode(batch, steps):
     decode_tps = batch * n_new / decode_s
     _record_timing("decode", warmup=1, iters=1, wall_s=gen_s,
                    prefill_wall_s=prefill_s, batch=batch, prompt_len=T0,
-                   new_tokens=n_new, flash=flash_enabled())
+                   new_tokens=n_new,
+                   # Routing provenance: prefill decides on the PROMPT
+                   # length (decode's per-token cached path never uses
+                   # the flash kernel).
+                   prefill_flash=flash_enabled(seq=T0))
     return prefill_tps, decode_tps
 
 

@@ -128,7 +128,7 @@ def _attention(x, p, cfg: BertConfig):
         out = ulysses_attention(q, k, v, axis_name=cfg.sp_axis, causal=False)
     else:
         from ..ops.flash_attention import flash_attention, resolve_flash
-        if resolve_flash(cfg.use_flash):
+        if resolve_flash(cfg.use_flash, seq=T):
             out = flash_attention(q, k, v, causal=False)
         else:
             out = local_flash_attention(q, k, v, causal=False)

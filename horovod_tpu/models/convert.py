@@ -156,8 +156,11 @@ def to_hf_state_dict(params: Dict, cfg: LlamaConfig,
         raise ValueError("export from the stacked pp layout is not "
                          "supported; rebuild params with pp_axis=None")
     if cfg.n_experts:
-        raise ValueError("to_hf_state_dict maps the dense layer shape; "
-                         "MoE params have no HF Llama/Mistral layout")
+        raise ValueError("to_hf_state_dict export for the MoE/Mixtral "
+                         "layout (block_sparse_moe.*) is not yet "
+                         "implemented — only the dense Llama/Mistral "
+                         "shape exports; import via from_hf_state_dict "
+                         "supports both")
     sd: Dict[str, np.ndarray] = {
         "model.embed_tokens.weight": np.asarray(params["embed"],
                                                 np.float32),

@@ -100,9 +100,12 @@ class LlamaConfig:
     router_noise: float = 0.0          # router jitter std (needs rng=)
     moe_gated: bool = False            # SwiGLU experts (Mixtral shape)
     # Pallas flash attention: True/False, or None = resolve from the
-    # HVD_TPU_FLASH env var at TRACE time (auto: on when running on TPU).
-    # The env var is not part of any jit cache key — to toggle after a
-    # step has compiled, change this config field (it IS traced).
+    # HVD_TPU_FLASH env var at TRACE time (auto: on TPU for sequences at
+    # or past the measured crossover HVD_TPU_FLASH_MIN_SEQ, default 1024
+    # — below it XLA's fused attention is faster, see
+    # ops/flash_attention.flash_min_seq).  The env vars are not part of
+    # any jit cache key — to toggle after a step has compiled, change
+    # this config field (it IS traced).
     use_flash: Optional[bool] = None
     # Sliding-window (Mistral-style) causal attention: each position
     # attends its last ``sliding_window`` positions only.  The flash
@@ -193,12 +196,19 @@ def llama3_8b() -> LlamaConfig:
 
 def mixtral_8x7b() -> LlamaConfig:
     """Mixtral-8x7B geometry: Mistral attention + 8 SwiGLU experts with
-    normalized top-2 routing (models/moe.py gated experts)."""
+    normalized top-2 routing (models/moe.py gated experts).
+
+    ``capacity_factor=4.0`` (= n_experts / top_k) gives every expert
+    worst-case capacity, so NO token is ever capacity-dropped and a
+    converted checkpoint reproduces HF logits exactly (Mixtral itself
+    has no capacity drops).  Training at scale usually wants a tighter
+    factor (1.25–2.0) — override ``capacity_factor`` for that; drops
+    then fall back to the residual path."""
     return LlamaConfig(vocab_size=32000, d_model=4096, n_layers=32,
                        n_heads=32, n_kv_heads=8, d_ff=14336,
                        max_seq=32768, rope_theta=1e6,
                        n_experts=8, router_top_k=2, moe_gated=True,
-                       ep_axis="ep")
+                       capacity_factor=4.0, ep_axis="ep")
 
 
 def mistral_7b() -> LlamaConfig:
@@ -313,13 +323,15 @@ def _rope(x, positions, theta):
         [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin], axis=-1).astype(x.dtype)
 
 
-def _use_pallas_flash(cfg: "LlamaConfig") -> bool:
-    """Pallas flash attention on TPU by default (the [Tq,Tk] scores never
-    touch HBM — ops/flash_attention.py).  ``cfg.use_flash`` decides when
-    set; otherwise HVD_TPU_FLASH=1/0 forces it on (interpret mode off-TPU,
-    for tests) or off — read at TRACE time only (see LlamaConfig)."""
+def _use_pallas_flash(cfg: "LlamaConfig", seq: Optional[int] = None) -> bool:
+    """Pallas flash attention on TPU by default for sequences past the
+    measured crossover (the [Tq,Tk] scores never touch HBM —
+    ops/flash_attention.py; below it XLA's fused attention is faster,
+    see flash_min_seq).  ``cfg.use_flash`` decides when set; otherwise
+    HVD_TPU_FLASH=1/0 forces it on (interpret mode off-TPU, for tests)
+    or off — read at TRACE time only (see LlamaConfig)."""
     from ..ops.flash_attention import resolve_flash
-    return resolve_flash(cfg.use_flash)
+    return resolve_flash(cfg.use_flash, seq=seq)
 
 
 def _qkv(x, p, cfg: LlamaConfig, positions):
@@ -353,7 +365,7 @@ def _local_attend(q, k, v, cfg: LlamaConfig):
     """Causal local attention through the same flash routing as every
     path (Pallas kernel on TPU, jnp fallback otherwise); sliding window
     when the config asks for it."""
-    if _use_pallas_flash(cfg):
+    if _use_pallas_flash(cfg, seq=q.shape[1]):
         from ..ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=True,
                                window=cfg.sliding_window)
@@ -375,7 +387,8 @@ def _attention(x, p, cfg: LlamaConfig, positions):
         # the tradeoff); GQA kv travels un-repeated through the alltoall.
         from ..ops.flash_attention import flash_attention
         from ..parallel.ulysses import ulysses_attention
-        attn = (flash_attention if _use_pallas_flash(cfg)
+        # Ulysses attends the FULL gathered sequence on local heads.
+        attn = (flash_attention if _use_pallas_flash(cfg, seq=q.shape[1] * sp)
                 else local_flash_attention)   # same routing as every path
         out = ulysses_attention(q, kk, v, attn_fn=attn,
                                 axis_name=cfg.sp_axis, causal=True)

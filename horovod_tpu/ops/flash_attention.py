@@ -34,25 +34,44 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def resolve_flash(override: Optional[bool] = None) -> bool:
+def resolve_flash(override: Optional[bool] = None,
+                  seq: Optional[int] = None) -> bool:
     """Config-first flash routing: a model config's ``use_flash`` field
     (traced, so toggling it recompiles) wins; ``None`` falls back to
-    :func:`flash_enabled`."""
-    return flash_enabled() if override is None else override
+    :func:`flash_enabled` with the caller's sequence length."""
+    return flash_enabled(seq) if override is None else override
 
 
-def flash_enabled() -> bool:
+def flash_min_seq() -> int:
+    """Auto-mode crossover: below this sequence length XLA's fused
+    attention beats the Pallas kernel on real v5e hardware (measured —
+    BENCH_SELF_r05 llama A/B at T=512: flash 330k vs XLA 552k tok/s; the
+    [T, T] score tile still fits on-chip so flash's online-softmax
+    machinery is pure overhead).  ``HVD_TPU_FLASH_MIN_SEQ`` overrides;
+    tools/flash_sweep.py measures the crossover per chip."""
+    import os
+    try:
+        v = int(os.environ.get("HVD_TPU_FLASH_MIN_SEQ", "1024"))
+        return v if v >= 0 else 1024
+    except ValueError:
+        return 1024
+
+
+def flash_enabled(seq: Optional[int] = None) -> bool:
     """Shared routing default for attention call sites (llama, bert,
-    Ulysses): pallas flash on TPU, jnp reference elsewhere;
-    ``HVD_TPU_FLASH=1/0`` forces it — read at TRACE time only (not part of
-    any jit cache key)."""
+    Ulysses, ring): pallas flash on TPU for sequences past the measured
+    crossover (:func:`flash_min_seq`), jnp reference elsewhere;
+    ``HVD_TPU_FLASH=1/0`` forces it globally — all read at TRACE time
+    only (not part of any jit cache key)."""
     import os
     v = os.environ.get("HVD_TPU_FLASH", "auto").lower()
     if v in ("1", "true", "on"):
         return True
     if v in ("0", "false", "off"):
         return False
-    return jax.default_backend() == "tpu"
+    if jax.default_backend() != "tpu":
+        return False
+    return seq is None or seq >= flash_min_seq()
 
 
 # ----------------------------------------------------------------- forward
@@ -278,9 +297,29 @@ def _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret, rep=1,
     return o[:, :Tq], lse[:, :Tq, 0]
 
 
+def _block_defaults() -> tuple:
+    """Kernel tile defaults, env-overridable for per-chip tuning
+    (``HVD_TPU_FLASH_BLOCK_Q`` / ``HVD_TPU_FLASH_BLOCK_K`` — read at
+    trace time; tools/flash_sweep.py measures the candidates)."""
+    import os
+
+    def _get(name, dflt):
+        try:
+            v = int(os.environ.get(name, str(dflt)))
+            # Bad, too-small, or TPU-tile-misaligned (sublane rule: block
+            # sizes must be multiples of 8) values keep the default
+            # instead of dying in Mosaic lowering.
+            return v if v >= 8 and v % 8 == 0 else dflt
+        except ValueError:
+            return dflt
+    return (_get("HVD_TPU_FLASH_BLOCK_Q", 128),
+            _get("HVD_TPU_FLASH_BLOCK_K", 128))
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None,
                     window: Optional[int] = None):
     """Memory-efficient exact attention.
@@ -300,6 +339,10 @@ def flash_attention(q, k, v, causal: bool = False,
                          f"({K}) for GQA")
     rep = H // K
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    if block_q is None or block_k is None:
+        dq, dk = _block_defaults()
+        block_q = dq if block_q is None else block_q
+        block_k = dk if block_k is None else block_k
     interpret = _interpret_default() if interpret is None else interpret
     if window is not None:
         if not causal:

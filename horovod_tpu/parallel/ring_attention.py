@@ -50,7 +50,8 @@ def _block_attn(q, k, v, bias, scale):
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
                    scale: Optional[float] = None,
                    use_flash: Optional[bool] = None,
-                   block_q: int = 128, block_k: int = 128,
+                   block_q: Optional[int] = None,
+                   block_k: Optional[int] = None,
                    interpret: Optional[bool] = None):
     """Exact (flash-equivalent) attention over an ``sp``-sharded sequence.
 
@@ -70,10 +71,20 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
       tuple back to its owner; dk/dv accumulate where the kv shard lives).
     - **jnp blockwise** (fallback): the original online-softmax ring.
     """
-    from ..ops.flash_attention import resolve_flash, _interpret_default
+    from ..ops.flash_attention import (resolve_flash, _interpret_default,
+                                       _block_defaults)
+    # No seq threshold here: the alternative to the pallas ring engine is
+    # the jnp blockwise ring below (full per-step [B,H,Tq,Tk] scores in
+    # HBM + a materialized GQA repeat), NOT XLA's fused single-device
+    # attention — so the single-device crossover (flash_min_seq) does not
+    # apply and TPU auto mode always takes the flash engine.
     if resolve_flash(use_flash):
         if interpret is None:
             interpret = _interpret_default()
+        if block_q is None or block_k is None:
+            dq_, dk_ = _block_defaults()   # same tile knobs as every path
+            block_q = dq_ if block_q is None else block_q
+            block_k = dk_ if block_k is None else block_k
         return _ring_flash_bthd(q, k, v, axis_name, causal, scale,
                                 block_q, block_k, interpret)
     if k.shape[2] != q.shape[2]:

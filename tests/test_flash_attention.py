@@ -273,3 +273,46 @@ def test_llama_uses_flash_when_forced(monkeypatch):
     out = llama.forward(params, tokens, cfg)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_flash_auto_seq_threshold(monkeypatch):
+    """Auto routing is sequence-aware (BENCH_SELF_r05: flash LOSES to
+    XLA's fused attention below the crossover on real v5e — 330k vs 552k
+    tok/s at T=512): on TPU, auto mode picks flash only at/above
+    HVD_TPU_FLASH_MIN_SEQ; explicit forces ignore the threshold."""
+    from horovod_tpu.ops import flash_attention as fa
+
+    monkeypatch.delenv("HVD_TPU_FLASH", raising=False)
+    monkeypatch.setenv("HVD_TPU_FLASH_MIN_SEQ", "1024")
+    monkeypatch.setattr(fa.jax, "default_backend", lambda: "tpu")
+    assert fa.flash_enabled(seq=512) is False
+    assert fa.flash_enabled(seq=1024) is True
+    assert fa.flash_enabled(seq=4096) is True
+    assert fa.flash_enabled() is True          # unknown seq: legacy default
+    assert fa.resolve_flash(None, seq=512) is False
+    assert fa.resolve_flash(True, seq=512) is True    # config force wins
+    assert fa.resolve_flash(False, seq=8192) is False
+
+    monkeypatch.setenv("HVD_TPU_FLASH", "1")   # env force beats threshold
+    assert fa.flash_enabled(seq=128) is True
+    monkeypatch.setenv("HVD_TPU_FLASH", "0")
+    assert fa.flash_enabled(seq=8192) is False
+
+    # Off-TPU auto stays off at any length.
+    monkeypatch.delenv("HVD_TPU_FLASH", raising=False)
+    monkeypatch.setattr(fa.jax, "default_backend", lambda: "cpu")
+    assert fa.flash_enabled(seq=8192) is False
+
+
+def test_flash_block_env_defaults(monkeypatch):
+    """HVD_TPU_FLASH_BLOCK_Q/K tune the kernel tiles without a code
+    change (tools/flash_sweep.py feeds these); unset keeps 128x128."""
+    from horovod_tpu.ops import flash_attention as fa
+    monkeypatch.delenv("HVD_TPU_FLASH_BLOCK_Q", raising=False)
+    monkeypatch.delenv("HVD_TPU_FLASH_BLOCK_K", raising=False)
+    assert fa._block_defaults() == (128, 128)
+    monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_Q", "256")
+    monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_K", "512")
+    assert fa._block_defaults() == (256, 512)
+    monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_Q", "junk")
+    assert fa._block_defaults()[0] == 128

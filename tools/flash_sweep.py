@@ -1,0 +1,124 @@
+"""On-chip flash-vs-XLA attention sweep: find the crossover + best blocks.
+
+BENCH_SELF_r05 exposed that the Pallas flash kernel LOSES to XLA's fused
+attention at the llama bench shape (seq=512: 330k vs 552k tok/s) — the
+flash rescaling machinery costs more than it saves while the [T,T] score
+tile still fits comfortably on-chip.  Flash exists for the memory wall at
+LONG sequence; this sweep measures exactly where that wall is on the real
+chip and which block sizes the kernel wants there, so the auto routing
+(``flash_enabled`` / ``LlamaConfig.use_flash``) can pick the winner per
+shape instead of a blanket platform default.
+
+Per (seq, impl) it times a jitted fwd+bwd (grads wrt q,k,v — the training
+shape that the llama bench exercises) of causal GQA attention at fixed
+token count (B*T = const), bf16 inputs:
+
+    python tools/flash_sweep.py --out FLASH_SWEEP.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SEQS = [512, 1024, 2048, 4096, 8192]
+BLOCKS = [(128, 128), (256, 256), (512, 512), (128, 512), (256, 1024)]
+TOKENS = 64 * 1024          # B = TOKENS // T  (fixed work per measurement)
+H, K, D = 8, 4, 64          # the llama bench head geometry
+
+
+def _loss_fn(attn):
+    def loss(q, k, v):
+        return attn(q, k, v).astype(jnp.float32).sum()
+    return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+
+def _time(fn, args, iters=10, warmup=2):
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+
+def sweep(seqs, iters, tokens=TOKENS):
+    from horovod_tpu.ops.flash_attention import flash_attention
+    from horovod_tpu.parallel.ring_attention import local_flash_attention
+
+    rng = np.random.RandomState(0)
+    rows = []
+    for T in seqs:
+        B = max(tokens // T, 1)
+        q = jnp.asarray(rng.randn(B, T, H, D), jnp.bfloat16)
+        k = jnp.asarray(rng.randn(B, T, K, D), jnp.bfloat16)
+        v = jnp.asarray(rng.randn(B, T, K, D), jnp.bfloat16)
+        row = {"seq": T, "batch": B, "tokens": B * T, "ms": {}}
+
+        xla = _loss_fn(functools.partial(local_flash_attention, causal=True))
+        try:
+            row["ms"]["xla"] = round(_time(xla, (q, k, v), iters), 3)
+        except Exception as exc:  # noqa: BLE001 — OOM at long T is the point
+            row["ms"]["xla"] = None
+            row.setdefault("errors", {})["xla"] = repr(exc)[:200]
+
+        for bq, bk in BLOCKS:
+            if bq > T or bk > T:
+                continue
+            fl = _loss_fn(functools.partial(
+                flash_attention, causal=True, block_q=bq, block_k=bk))
+            key = f"flash_{bq}x{bk}"
+            try:
+                row["ms"][key] = round(_time(fl, (q, k, v), iters), 3)
+            except Exception as exc:  # noqa: BLE001
+                row["ms"][key] = None
+                row.setdefault("errors", {})[key] = repr(exc)[:200]
+
+        timed = [(v, k) for k, v in row["ms"].items() if v is not None]
+        best = min(timed) if timed else (None, None)
+        row["best"] = best[1]
+        row["flash_best_vs_xla"] = (
+            round(row["ms"]["xla"] / best[0], 3)
+            if row["ms"].get("xla") and best[1]
+            and not best[1].startswith("xla") else None)
+        rows.append(row)
+        print(json.dumps(row))
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="FLASH_SWEEP.json")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--seqs", default=",".join(map(str, SEQS)))
+    ap.add_argument("--tokens", type=int, default=TOKENS,
+                    help="tokens per measurement (smoke tests shrink this)")
+    args = ap.parse_args()
+    seqs = [int(s) for s in args.seqs.split(",")]
+
+    dev = jax.devices()[0]
+    rows = sweep(seqs, args.iters, args.tokens)
+    out = {
+        "provenance": "tools/flash_sweep.py — jitted fwd+bwd causal GQA "
+                      f"attention, bf16, H={H} K={K} D={D}, fixed "
+                      f"{args.tokens} tokens per shape",
+        "captured_utc": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(),
+        "device": {"kind": dev.device_kind, "platform": dev.platform},
+        "rows": rows,
+    }
+    with open(args.out, "w") as fh:
+        json.dump(out, fh, indent=2)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
