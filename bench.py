@@ -400,8 +400,10 @@ def bench_llama(batch, steps):
     """Llama decoder training through the FRAMEWORK path (like the bert
     mode): hvd.DistributedOptimizer gradient averaging inside a shard_map
     step over the hvd mesh.  ``batch`` is the GLOBAL batch.  Flash
-    attention follows HVD_TPU_FLASH (on by default on TPU), so this mode
-    is the flash on/off A/B vehicle."""
+    attention follows HVD_TPU_FLASH; auto mode is sequence-aware and at
+    this mode's seq=512 picks the XLA path (crossover default 1024), so
+    the flash side of the A/B needs an explicit HVD_TPU_FLASH=1 — which
+    is exactly how tools/bench_self_capture.py drives both sides."""
     import jax
     import jax.numpy as jnp
     import numpy as np

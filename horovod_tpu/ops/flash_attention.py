@@ -42,6 +42,17 @@ def resolve_flash(override: Optional[bool] = None,
     return flash_enabled(seq) if override is None else override
 
 
+def _env_int(name: str, dflt: int, valid=lambda v: True) -> int:
+    """Env-tunable integer knob: bad, unparseable, or out-of-contract
+    values keep the default instead of dying at trace time."""
+    import os
+    try:
+        v = int(os.environ.get(name, str(dflt)))
+        return v if valid(v) else dflt
+    except ValueError:
+        return dflt
+
+
 def flash_min_seq() -> int:
     """Auto-mode crossover: below this sequence length XLA's fused
     attention beats the Pallas kernel on real v5e hardware (measured —
@@ -49,12 +60,7 @@ def flash_min_seq() -> int:
     [T, T] score tile still fits on-chip so flash's online-softmax
     machinery is pure overhead).  ``HVD_TPU_FLASH_MIN_SEQ`` overrides;
     tools/flash_sweep.py measures the crossover per chip."""
-    import os
-    try:
-        v = int(os.environ.get("HVD_TPU_FLASH_MIN_SEQ", "1024"))
-        return v if v >= 0 else 1024
-    except ValueError:
-        return 1024
+    return _env_int("HVD_TPU_FLASH_MIN_SEQ", 1024, lambda v: v >= 0)
 
 
 def flash_enabled(seq: Optional[int] = None) -> bool:
@@ -100,8 +106,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(live)
     def _():
-        q = q_ref[0].astype(jnp.float32)            # [bq, D]
-        k = k_ref[0].astype(jnp.float32)            # [bk, D]
+        # Dots take the RAW input dtype (bf16 in training) with an f32
+        # accumulator: bf16×bf16 products are exact in f32 accumulation,
+        # so this matches the old cast-to-f32-first numerics while running
+        # the MXU at full bf16 rate instead of the ~4x-slower f32 path
+        # (the measured BENCH_SELF_r05 flash regression).
+        q = q_ref[0]                                # [bq, D]
+        k = k_ref[0]                                # [bk, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
@@ -121,8 +132,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1)
+        # p is quantized to the value dtype for the second MXU pass (the
+        # standard TPU flash formulation; exact when inputs are f32).
         acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = m_new
 
@@ -159,10 +172,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(live)
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # Raw-dtype MXU operands + f32 accumulators (see _fwd_kernel): the
+        # f32 intermediates p/ds are quantized back to the operand dtype
+        # for their second matmuls.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         cols = k_start + jax.lax.broadcasted_iota(
@@ -178,7 +194,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse_ref[0, :, :1])        # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, :, :1]) * scale
+        ds = (p * (dp - delta_ref[0, :, :1]) * scale).astype(k.dtype)
         acc_ref[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -209,10 +225,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # Raw-dtype MXU operands + f32 accumulators (see _fwd_kernel).
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         cols = k_start + jax.lax.broadcasted_iota(
@@ -227,11 +244,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, :, :1])        # [bq, bk]
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)      # [bk, D]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, :, :1]) * scale
+        ds = (p * (dp - delta_ref[0, :, :1]) * scale).astype(q.dtype)
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)      # [bk, D]
@@ -300,20 +317,25 @@ def _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret, rep=1,
 def _block_defaults() -> tuple:
     """Kernel tile defaults, env-overridable for per-chip tuning
     (``HVD_TPU_FLASH_BLOCK_Q`` / ``HVD_TPU_FLASH_BLOCK_K`` — read at
-    trace time; tools/flash_sweep.py measures the candidates)."""
-    import os
+    trace time; tools/flash_sweep.py measures the candidates).  512x512
+    won or tied every shape in the on-chip sweep (FLASH_SWEEP_r05.json:
+    1.3-2.1x faster than the old 128x128 at T>=1024, 5x at T=8192 —
+    bigger tiles amortize the grid/rescale overhead and keep the MXU
+    fed).  The sublane rule (multiples of 8) is enforced here so a bad
+    value keeps the default instead of dying in Mosaic lowering."""
+    ok = lambda v: v >= 8 and v % 8 == 0  # noqa: E731
+    return (_env_int("HVD_TPU_FLASH_BLOCK_Q", 512, ok),
+            _env_int("HVD_TPU_FLASH_BLOCK_K", 512, ok))
 
-    def _get(name, dflt):
-        try:
-            v = int(os.environ.get(name, str(dflt)))
-            # Bad, too-small, or TPU-tile-misaligned (sublane rule: block
-            # sizes must be multiples of 8) values keep the default
-            # instead of dying in Mosaic lowering.
-            return v if v >= 8 and v % 8 == 0 else dflt
-        except ValueError:
-            return dflt
-    return (_get("HVD_TPU_FLASH_BLOCK_Q", 128),
-            _get("HVD_TPU_FLASH_BLOCK_K", 128))
+
+def resolve_blocks(block_q: Optional[int],
+                   block_k: Optional[int]) -> tuple:
+    """Fill ``None`` tile sizes from :func:`_block_defaults` — the one
+    resolution point shared by every flash call site (single-device,
+    Ulysses, ring)."""
+    dq, dk = _block_defaults()
+    return (dq if block_q is None else block_q,
+            dk if block_k is None else block_k)
 
 
 def flash_attention(q, k, v, causal: bool = False,
@@ -337,12 +359,14 @@ def flash_attention(q, k, v, causal: bool = False,
     if H % K:
         raise ValueError(f"q heads ({H}) must be a multiple of kv heads "
                          f"({K}) for GQA")
+    if k.dtype != q.dtype or v.dtype != q.dtype:
+        # The kernels feed RAW operands to the MXU (bf16 at full rate) —
+        # mixed dtypes would die with a cryptic dot_general trace error.
+        raise ValueError(f"q/k/v must share one dtype, got {q.dtype}/"
+                         f"{k.dtype}/{v.dtype}; cast before the call")
     rep = H // K
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
-    if block_q is None or block_k is None:
-        dq, dk = _block_defaults()
-        block_q = dq if block_q is None else block_q
-        block_k = dk if block_k is None else block_k
+    block_q, block_k = resolve_blocks(block_q, block_k)
     interpret = _interpret_default() if interpret is None else interpret
     if window is not None:
         if not causal:
@@ -383,6 +407,9 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, rep, window,
     q, k, v, o, lse = res
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                                 # [BH, Tq]
+    # The backward kernels dot do against v/q — same raw-dtype contract
+    # as the forward (an f32 cotangent over bf16 primals is legal in jax).
+    do = do.astype(q.dtype)
     return _bwd_impl(q, k, v, do, lse, delta, scale=scale, causal=causal,
                      block_q=block_q, block_k=block_k, interpret=interpret,
                      rep=rep, window=window)

@@ -72,7 +72,7 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     - **jnp blockwise** (fallback): the original online-softmax ring.
     """
     from ..ops.flash_attention import (resolve_flash, _interpret_default,
-                                       _block_defaults)
+                                       resolve_blocks)
     # No seq threshold here: the alternative to the pallas ring engine is
     # the jnp blockwise ring below (full per-step [B,H,Tq,Tk] scores in
     # HBM + a materialized GQA repeat), NOT XLA's fused single-device
@@ -81,10 +81,7 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     if resolve_flash(use_flash):
         if interpret is None:
             interpret = _interpret_default()
-        if block_q is None or block_k is None:
-            dq_, dk_ = _block_defaults()   # same tile knobs as every path
-            block_q = dq_ if block_q is None else block_q
-            block_k = dk_ if block_k is None else block_k
+        block_q, block_k = resolve_blocks(block_q, block_k)
         return _ring_flash_bthd(q, k, v, axis_name, causal, scale,
                                 block_q, block_k, interpret)
     if k.shape[2] != q.shape[2]:

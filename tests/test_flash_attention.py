@@ -306,13 +306,14 @@ def test_flash_auto_seq_threshold(monkeypatch):
 
 def test_flash_block_env_defaults(monkeypatch):
     """HVD_TPU_FLASH_BLOCK_Q/K tune the kernel tiles without a code
-    change (tools/flash_sweep.py feeds these); unset keeps 128x128."""
+    change (tools/flash_sweep.py feeds these); unset keeps the measured
+    512x512 default (FLASH_SWEEP_r05: best or tied at every shape)."""
     from horovod_tpu.ops import flash_attention as fa
     monkeypatch.delenv("HVD_TPU_FLASH_BLOCK_Q", raising=False)
     monkeypatch.delenv("HVD_TPU_FLASH_BLOCK_K", raising=False)
-    assert fa._block_defaults() == (128, 128)
+    assert fa._block_defaults() == (512, 512)
     monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_Q", "256")
-    monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_K", "512")
-    assert fa._block_defaults() == (256, 512)
+    monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_K", "1024")
+    assert fa._block_defaults() == (256, 1024)
     monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_Q", "junk")
-    assert fa._block_defaults()[0] == 128
+    assert fa._block_defaults()[0] == 512

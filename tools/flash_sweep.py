@@ -22,7 +22,11 @@ import argparse
 import datetime
 import functools
 import json
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -34,20 +38,47 @@ TOKENS = 64 * 1024          # B = TOKENS // T  (fixed work per measurement)
 H, K, D = 8, 4, 64          # the llama bench head geometry
 
 
-def _loss_fn(attn):
-    def loss(q, k, v):
-        return attn(q, k, v).astype(jnp.float32).sum()
-    return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+def _loss_fn(attn, iters):
+    """One jitted dispatch running ``iters`` fwd+bwd steps in a lax.scan.
+
+    Two hazards this shape dodges: (1) the axon remote-execution path can
+    CACHE a dispatch whose inputs are bit-identical, so a naive
+    time-10-identical-calls loop measures the cache, not the MXU (the
+    first sweep's 0.02 ms "results"); the scan carry perturbs q every
+    iteration from the previous step's gradients, so no two executions
+    see the same input.  (2) XLA would DCE any grad the carry ignores —
+    dk/dv come from a separate Pallas call than dq — so the carry folds
+    an element of all three."""
+    grad = jax.grad(lambda q, k, v: attn(q, k, v).astype(jnp.float32)
+                    .sum(), argnums=(0, 1, 2))
+
+    @jax.jit
+    def many(q, k, v, seed):
+        def body(t, i):
+            dq, dk, dv = grad(q + t.astype(q.dtype), k, v)
+            t_new = ((dq.ravel()[0] + dk.ravel()[0] + dv.ravel()[0])
+                     .astype(jnp.float32) * 1e-6 + i.astype(jnp.float32)
+                     * 1e-3)
+            return t_new, ()
+        t, _ = jax.lax.scan(body, seed, jnp.arange(iters))
+        return t
+    return many
 
 
-def _time(fn, args, iters=10, warmup=2):
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
+def _time(fn, args, iters=10, warmup=1):
+    """The ``seed`` argument makes every dispatch's input set unique —
+    the warmup and timed calls must NOT be bit-identical or the axon
+    remote-execution cache serves the timed call in ~0 time (both the
+    naive 10-identical-calls loop and a seedless scan measured 0.01 ms
+    "steps" that are physically ~1000x off).  float() fetches the result
+    to host as a second sync barrier."""
+    for w in range(warmup):
+        jax.block_until_ready(fn(*args, jnp.float32(w)))
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
+    out = fn(*args, jnp.float32(warmup))
     jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters * 1e3  # ms
+    float(out)
+    return (time.perf_counter() - t0) / iters * 1e3  # ms per inner step
 
 
 def sweep(seqs, iters, tokens=TOKENS):
@@ -63,7 +94,8 @@ def sweep(seqs, iters, tokens=TOKENS):
         v = jnp.asarray(rng.randn(B, T, K, D), jnp.bfloat16)
         row = {"seq": T, "batch": B, "tokens": B * T, "ms": {}}
 
-        xla = _loss_fn(functools.partial(local_flash_attention, causal=True))
+        xla = _loss_fn(functools.partial(local_flash_attention,
+                                         causal=True), iters)
         try:
             row["ms"]["xla"] = round(_time(xla, (q, k, v), iters), 3)
         except Exception as exc:  # noqa: BLE001 — OOM at long T is the point
@@ -74,7 +106,8 @@ def sweep(seqs, iters, tokens=TOKENS):
             if bq > T or bk > T:
                 continue
             fl = _loss_fn(functools.partial(
-                flash_attention, causal=True, block_q=bq, block_k=bk))
+                flash_attention, causal=True, block_q=bq, block_k=bk),
+                iters)
             key = f"flash_{bq}x{bk}"
             try:
                 row["ms"][key] = round(_time(fl, (q, k, v), iters), 3)
