@@ -40,7 +40,8 @@ never a silent 900s burn.
 
 Env overrides: HVD_BENCH_BATCH, HVD_BENCH_STEPS, HVD_BENCH_IMAGE,
 HVD_BENCH_SIZES_MB (comma list),
-HVD_BENCH_MODEL=resnet50|llama|bert|tf_step|decode,
+HVD_BENCH_MODEL=resnet50|llama|bert|tf_step|decode, HVD_BENCH_SEQ
+(llama context length, default 512),
 HVD_BENCH_SKIP_RAW=1, HVD_BENCH_SKIP_BUSBW=1, HVD_BENCH_SKIP_AUTOTUNE=1,
 HVD_BENCH_AUTOTUNE_STEPS, HVD_BENCH_BATCH_SWEEP (comma list of per-chip
 batches, each recorded with img/s + HBM memory analysis), HVD_BENCH_MINIMAL=1,
@@ -421,8 +422,13 @@ def bench_llama(batch, steps):
     # HVD_BENCH_WINDOW=W turns on sliding-window attention — the on-chip
     # O(T·W) vs O(T^2) A/B for the kernel's whole-block skipping.
     window = int(os.environ.get("HVD_BENCH_WINDOW", "0")) or None
+    # HVD_BENCH_SEQ stretches the context (default 512) — the long-context
+    # regime (>=1024) is where auto routing picks the Pallas flash kernel
+    # and XLA's fused attention eventually cannot even compile
+    # (FLASH_SWEEP_r05: T=8192 OOMs the XLA path, flash runs).
+    seq = int(os.environ.get("HVD_BENCH_SEQ", "512"))
     cfg = llama.LlamaConfig(vocab_size=8192, d_model=512, n_layers=4,
-                            n_heads=8, n_kv_heads=4, d_ff=1536, max_seq=512,
+                            n_heads=8, n_kv_heads=4, d_ff=1536, max_seq=seq,
                             dtype=jnp.bfloat16 if _on_tpu() else jnp.float32,
                             dp_axis=None, tp_axis=None, sp_axis=None,
                             n_experts=n_experts, ep_axis=None,
@@ -440,7 +446,6 @@ def bench_llama(batch, steps):
         out_specs=(P(), P(), P()), check_vma=False),
         donate_argnums=(0, 1))
     rng = np.random.RandomState(0)
-    seq = 512
     tokens = jax.device_put(
         rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32),
         NamedSharding(mesh, P("hvd")))
@@ -456,7 +461,8 @@ def bench_llama(batch, steps):
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
     _record_timing("llama", warmup=2, iters=steps, wall_s=dt,
-                   global_batch=batch, seq=seq, flash=flash_enabled(seq=seq),
+                   global_batch=batch, seq=seq,
+                   flash=flash_enabled(seq=seq, causal=True),
                    n_experts=n_experts, router_top_k=cfg.router_top_k,
                    sliding_window=window or 0)
     return batch * seq * steps / dt
@@ -517,7 +523,7 @@ def bench_decode(batch, steps):
                    # Routing provenance: prefill decides on the PROMPT
                    # length (decode's per-token cached path never uses
                    # the flash kernel).
-                   prefill_flash=flash_enabled(seq=T0))
+                   prefill_flash=flash_enabled(seq=T0, causal=True))
     return prefill_tps, decode_tps
 
 

@@ -331,7 +331,7 @@ def _use_pallas_flash(cfg: "LlamaConfig", seq: Optional[int] = None) -> bool:
     HVD_TPU_FLASH=1/0 forces it on (interpret mode off-TPU, for tests)
     or off — read at TRACE time only (see LlamaConfig)."""
     from ..ops.flash_attention import resolve_flash
-    return resolve_flash(cfg.use_flash, seq=seq)
+    return resolve_flash(cfg.use_flash, seq=seq, causal=True)
 
 
 def _qkv(x, p, cfg: LlamaConfig, positions):

@@ -35,11 +35,13 @@ def _interpret_default() -> bool:
 
 
 def resolve_flash(override: Optional[bool] = None,
-                  seq: Optional[int] = None) -> bool:
+                  seq: Optional[int] = None,
+                  causal: bool = False) -> bool:
     """Config-first flash routing: a model config's ``use_flash`` field
     (traced, so toggling it recompiles) wins; ``None`` falls back to
-    :func:`flash_enabled` with the caller's sequence length."""
-    return flash_enabled(seq) if override is None else override
+    :func:`flash_enabled` with the caller's sequence length and
+    causality."""
+    return flash_enabled(seq, causal) if override is None else override
 
 
 def _env_int(name: str, dflt: int, valid=lambda v: True) -> int:
@@ -53,22 +55,31 @@ def _env_int(name: str, dflt: int, valid=lambda v: True) -> int:
         return dflt
 
 
-def flash_min_seq() -> int:
-    """Auto-mode crossover: below this sequence length XLA's fused
-    attention beats the Pallas kernel on real v5e hardware (measured —
-    BENCH_SELF_r05 llama A/B at T=512: flash 330k vs XLA 552k tok/s; the
-    [T, T] score tile still fits on-chip so flash's online-softmax
-    machinery is pure overhead).  ``HVD_TPU_FLASH_MIN_SEQ`` overrides;
-    tools/flash_sweep.py measures the crossover per chip."""
-    return _env_int("HVD_TPU_FLASH_MIN_SEQ", 1024, lambda v: v >= 0)
+def flash_min_seq(causal: bool = False) -> int:
+    """Auto-mode crossover, measured on real v5e (BENCH_SELF_r05, full
+    in-model A/B with the raw-bf16 kernels and 512x512 tiles):
+
+    - **causal** (llama family): flash already wins at T=512
+      (623k vs 552k tok/s) — whole-block causal skipping halves the
+      work, so the crossover default is 512.
+    - **non-causal** (bert): XLA's fused attention still wins at T=512
+      (774k vs 651k tok/s) — no blocks to skip, and flash's rescaling
+      machinery is pure overhead while the [T, T] score tile fits
+      on-chip — so the default stays 1024.
+
+    ``HVD_TPU_FLASH_MIN_SEQ`` overrides BOTH; tools/flash_sweep.py
+    re-measures the crossover per chip."""
+    return _env_int("HVD_TPU_FLASH_MIN_SEQ", 512 if causal else 1024,
+                    lambda v: v >= 0)
 
 
-def flash_enabled(seq: Optional[int] = None) -> bool:
+def flash_enabled(seq: Optional[int] = None,
+                  causal: bool = False) -> bool:
     """Shared routing default for attention call sites (llama, bert,
     Ulysses, ring): pallas flash on TPU for sequences past the measured
-    crossover (:func:`flash_min_seq`), jnp reference elsewhere;
-    ``HVD_TPU_FLASH=1/0`` forces it globally — all read at TRACE time
-    only (not part of any jit cache key)."""
+    crossover (:func:`flash_min_seq` — causality-aware), jnp reference
+    elsewhere; ``HVD_TPU_FLASH=1/0`` forces it globally — all read at
+    TRACE time only (not part of any jit cache key)."""
     import os
     v = os.environ.get("HVD_TPU_FLASH", "auto").lower()
     if v in ("1", "true", "on"):
@@ -77,7 +88,7 @@ def flash_enabled(seq: Optional[int] = None) -> bool:
         return False
     if jax.default_backend() != "tpu":
         return False
-    return seq is None or seq >= flash_min_seq()
+    return seq is None or seq >= flash_min_seq(causal)
 
 
 # ----------------------------------------------------------------- forward

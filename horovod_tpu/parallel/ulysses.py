@@ -42,7 +42,8 @@ def ulysses_attention(q, k, v, attn_fn: Optional[Callable] = None,
     if attn_fn is None:
         from ..ops.flash_attention import flash_attention, flash_enabled
         # The inner attention sees the FULL gathered sequence (T_local·sp).
-        if flash_enabled(seq=q.shape[1] * lax.axis_size(axis_name)):
+        if flash_enabled(seq=q.shape[1] * lax.axis_size(axis_name),
+                         causal=causal):
             attn_fn = flash_attention   # pallas kernel on the local heads
         else:
             from .ring_attention import local_flash_attention

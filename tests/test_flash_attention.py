@@ -293,6 +293,20 @@ def test_flash_auto_seq_threshold(monkeypatch):
     assert fa.resolve_flash(True, seq=512) is True    # config force wins
     assert fa.resolve_flash(False, seq=8192) is False
 
+    # Causality-aware defaults (BENCH_SELF_r05 in-model A/B with the
+    # raw-bf16 kernels): causal crossover 512, non-causal stays 1024.
+    monkeypatch.delenv("HVD_TPU_FLASH_MIN_SEQ", raising=False)
+    assert fa.flash_min_seq(causal=True) == 512
+    assert fa.flash_min_seq(causal=False) == 1024
+    assert fa.flash_enabled(seq=512, causal=True) is True
+    assert fa.flash_enabled(seq=256, causal=True) is False
+    assert fa.flash_enabled(seq=512, causal=False) is False
+    assert fa.flash_enabled(seq=1024, causal=False) is True
+    monkeypatch.setenv("HVD_TPU_FLASH_MIN_SEQ", "2048")  # overrides BOTH
+    assert fa.flash_enabled(seq=1024, causal=True) is False
+    assert fa.flash_enabled(seq=2048, causal=False) is True
+    monkeypatch.setenv("HVD_TPU_FLASH_MIN_SEQ", "1024")
+
     monkeypatch.setenv("HVD_TPU_FLASH", "1")   # env force beats threshold
     assert fa.flash_enabled(seq=128) is True
     monkeypatch.setenv("HVD_TPU_FLASH", "0")

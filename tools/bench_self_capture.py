@@ -41,6 +41,16 @@ MODES = {
                     "HVD_BENCH_SKIP_BUSBW": "1"}, 1200),
     "bert_noflash": ({"HVD_BENCH_MODEL": "bert", "HVD_TPU_FLASH": "0",
                       "HVD_BENCH_SKIP_BUSBW": "1"}, 1200),
+    # Long context (T=4096, same 64k tokens/step as the T=512 modes): the
+    # regime auto routing picks flash for; the noflash side measures what
+    # the XLA path costs there (at 8192 it cannot even compile —
+    # FLASH_SWEEP_r05).
+    "llama_long_flash": ({"HVD_BENCH_MODEL": "llama", "HVD_BENCH_SEQ": "4096",
+                          "HVD_BENCH_BATCH": "16", "HVD_TPU_FLASH": "1"},
+                         1500),
+    "llama_long_noflash": ({"HVD_BENCH_MODEL": "llama",
+                            "HVD_BENCH_SEQ": "4096", "HVD_BENCH_BATCH": "16",
+                            "HVD_TPU_FLASH": "0"}, 1500),
     # TF binding per-step cost on the real chip.
     "tf_step": ({"HVD_BENCH_MODEL": "tf_step"}, 1200),
     # Inference: blockwise prefill + KV-cache decode tokens/s.
