@@ -51,6 +51,22 @@ MODES = {
     "llama_long_noflash": ({"HVD_BENCH_MODEL": "llama",
                             "HVD_BENCH_SEQ": "4096", "HVD_BENCH_BATCH": "16",
                             "HVD_TPU_FLASH": "0"}, 1500),
+    # Sliding-window (Mistral-style) at long context: the flash kernels
+    # skip whole blocks outside the band, so W=1024 at T=4096 should beat
+    # the full-causal llama_long_flash number — the on-chip O(T*W) proof.
+    "llama_long_window": ({"HVD_BENCH_MODEL": "llama",
+                           "HVD_BENCH_SEQ": "4096", "HVD_BENCH_BATCH": "16",
+                           "HVD_BENCH_WINDOW": "1024",
+                           "HVD_TPU_FLASH": "1"}, 1500),
+    # MoE llama (8 experts, top-2 GShard routing, experts resident on the
+    # one chip): the einsum dispatch/combine + capacity machinery cost.
+    # B=16, not the dense modes' 128: the [S, E, C] one-hot dispatch is
+    # quadratic in per-rank tokens (C grows with S), so 65k tokens/rank
+    # cannot compile on one chip — 8k tokens/rank keeps it ~335 MB.
+    # Compare per-token against llama_flash, not per-step.
+    "moe": ({"HVD_BENCH_MODEL": "llama", "HVD_BENCH_EXPERTS": "8",
+             "HVD_BENCH_TOPK": "2", "HVD_BENCH_BATCH": "16",
+             "HVD_TPU_FLASH": "1"}, 1500),
     # TF binding per-step cost on the real chip.
     "tf_step": ({"HVD_BENCH_MODEL": "tf_step"}, 1200),
     # Inference: blockwise prefill + KV-cache decode tokens/s.
