@@ -460,11 +460,38 @@ def bench_llama(batch, steps):
         params, opt_state, loss = step(params, opt_state, tokens, targets)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
+    # Analytic train FLOPs (XLA's cost_analysis cannot see inside the
+    # Pallas custom calls, so the flash side would undercount): 6*P per
+    # token for the dense/MoE-active params + 12*L*T*H*Dh per token of
+    # causal attention (qk+pv, fwd+bwd), halved for causality, banded
+    # for sliding window.
+    leaves = jax.tree_util.tree_leaves(params)
+    n_params = sum(x.size for x in leaves)
+    flop_params = float(n_params)
+    if n_experts:
+        # Experts are [E, ., .] leaves; the einsum runs over every E*C
+        # capacity slot, so the per-token active multiplier is
+        # top_k * capacity_factor of ONE expert, not all E.
+        ep = sum(x.size for x in leaves
+                 if getattr(x, "ndim", 0) == 3 and x.shape[0] == n_experts)
+        cf = cfg.moe_cfg().capacity_factor
+        flop_params = (n_params - ep) + ep / n_experts * cfg.router_top_k * cf
+    t_eff = min(window, seq) if window else seq
+    attn_frac = (t_eff / seq) * (1.0 if window else 0.5)
+    attn_flops = (12 * cfg.n_layers * batch * seq * seq
+                  * cfg.n_heads * cfg.head_dim * attn_frac)
+    step_flops = 6.0 * flop_params * batch * seq + attn_flops
+    world = max(1, len(jax.devices()))
+    peak = _peak_flops()
+    mfu = (step_flops / world / (dt / steps) / peak * 100
+           if peak else None)
     _record_timing("llama", warmup=2, iters=steps, wall_s=dt,
                    global_batch=batch, seq=seq,
                    flash=flash_enabled(seq=seq, causal=True),
                    n_experts=n_experts, router_top_k=cfg.router_top_k,
-                   sliding_window=window or 0)
+                   sliding_window=window or 0, n_params=int(n_params),
+                   analytic_step_flops=step_flops,
+                   mfu_pct=round(mfu, 2) if mfu else None)
     return batch * seq * steps / dt
 
 
