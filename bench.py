@@ -433,6 +433,8 @@ def bench_llama(batch, steps):
                             dp_axis=None, tp_axis=None, sp_axis=None,
                             n_experts=n_experts, ep_axis=None,
                             sliding_window=window,
+                            remat_layers=os.environ.get(
+                                "HVD_BENCH_REMAT", "") == "1",
                             router_top_k=int(os.environ.get(
                                 "HVD_BENCH_TOPK", "1")))
     params = llama.init_params(cfg, jax.random.PRNGKey(0))

@@ -68,6 +68,12 @@ class LlamaConfig:
     # (jax.checkpoint): activation memory stops scaling with stage depth —
     # the 1F1B memory dividend, XLA-style (see parallel/pipeline.py).
     remat_stages: bool = False
+    # Rematerialize each transformer layer in the NON-pipelined forward:
+    # activation memory per layer collapses to the layer input, at ~1/3
+    # extra forward FLOPs.  The measured lever for the large-batch HBM
+    # falloff (docs/benchmarks.md "Llama batch scaling"): per-chip
+    # throughput decays past B=16 at T=512 without it.
+    remat_layers: bool = False
     # Where the LM loss is computed under pp (docs/parallelism.md):
     # "broadcast"  — psum the [M, mb, T, D] pipeline output to every
     #                stage; each computes final-norm+head+nll redundantly
@@ -502,10 +508,14 @@ def _forward(params, tokens, cfg: LlamaConfig, rng=None):
         aux_total = aux_total / M
         x = x.reshape((B, T, -1))
     else:
+        def _apply(p, h, positions, lrng):
+            return _layer_apply(p, h, cfg, positions, rng=lrng)
+        if cfg.remat_layers:
+            _apply = jax.checkpoint(_apply)
         for i, p in enumerate(params["layers"]):
             lrng = (jax.random.fold_in(rng, i)
                     if rng is not None else None)
-            x, aux = _layer_apply(p, x, cfg, positions, rng=lrng)
+            x, aux = _apply(p, x, positions, lrng)
             aux_total = aux_total + aux
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
     return x @ params["lm_head"], aux_total
