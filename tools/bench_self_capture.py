@@ -51,6 +51,11 @@ MODES = {
     "llama_long_noflash": ({"HVD_BENCH_MODEL": "llama",
                             "HVD_BENCH_SEQ": "4096", "HVD_BENCH_BATCH": "16",
                             "HVD_TPU_FLASH": "0"}, 1500),
+    # T=8192 — double the XLA compile wall, still one chip (T=16384 also
+    # measured by hand, 107k tok/s; see docs/benchmarks.md).
+    "llama_8k": ({"HVD_BENCH_MODEL": "llama", "HVD_BENCH_SEQ": "8192",
+                  "HVD_BENCH_BATCH": "8", "HVD_BENCH_STEPS": "20",
+                  "HVD_TPU_FLASH": "1"}, 1500),
     # Sliding-window (Mistral-style) at long context: the flash kernels
     # skip whole blocks outside the band, so W=1024 at T=4096 should beat
     # the full-causal llama_long_flash number — the on-chip O(T*W) proof.
