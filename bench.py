@@ -608,8 +608,20 @@ def bench_bert(batch, steps):
         params, opt_state, loss = step(params, opt_state, toks, tgts, mask)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
+    # Same analytic MFU accounting as bench_llama (non-causal: full
+    # [T, T] attention, no banding).
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    attn_flops = (12 * cfg.n_layers * batch * seq * seq
+                  * cfg.n_heads * (cfg.d_model // cfg.n_heads))
+    step_flops = 6.0 * n_params * batch * seq + attn_flops
+    world = max(1, len(jax.devices()))
+    peak = _peak_flops()
+    mfu = (step_flops / world / (dt / steps) / peak * 100
+           if peak else None)
     _record_timing("bert", warmup=2, iters=steps, wall_s=dt,
-                   global_batch=batch, seq=seq)
+                   global_batch=batch, seq=seq, n_params=int(n_params),
+                   analytic_step_flops=step_flops,
+                   mfu_pct=round(mfu, 2) if mfu else None)
     return batch * seq * steps / dt
 
 
