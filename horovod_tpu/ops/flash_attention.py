@@ -62,10 +62,11 @@ def flash_min_seq(causal: bool = False) -> int:
     - **causal** (llama family): flash already wins at T=512
       (623k vs 552k tok/s) — whole-block causal skipping halves the
       work, so the crossover default is 512.
-    - **non-causal** (bert): XLA's fused attention still wins at T=512
-      (774k vs 651k tok/s) — no blocks to skip, and flash's rescaling
-      machinery is pure overhead while the [T, T] score tile fits
-      on-chip — so the default stays 1024.
+    - **non-causal** (bert, T=256 bench shape): XLA's fused attention
+      still wins (789k vs 649k tok/s) — no blocks to skip, and flash's
+      rescaling machinery is pure overhead while the [T, T] score tile
+      fits on-chip — so the default stays 1024 (the kernel-level sweep's
+      non-causal crossover region).
 
     ``HVD_TPU_FLASH_MIN_SEQ`` overrides BOTH; tools/flash_sweep.py
     re-measures the crossover per chip."""
