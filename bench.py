@@ -575,8 +575,11 @@ def bench_bert(batch, steps):
     import horovod_tpu as hvd
     from horovod_tpu.models import bert
 
+    # HVD_BENCH_SEQ stretches the context (default 256) — the in-model
+    # evidence for the NON-causal routing crossover.
+    seq = int(os.environ.get("HVD_BENCH_SEQ", "256"))
     cfg = bert.tiny(vocab_size=8192, d_model=512, n_layers=4, n_heads=8,
-                    d_ff=2048, max_seq=512,
+                    d_ff=2048, max_seq=max(512, seq),
                     dtype=jnp.bfloat16 if _on_tpu() else jnp.float32,
                     dp_axis=None, tp_axis=None, sp_axis=None)
     opt = hvd.DistributedOptimizer(optax.adam(1e-4),
@@ -590,7 +593,6 @@ def bench_bert(batch, steps):
         out_specs=(P(), P(), P()), check_vma=False),
         donate_argnums=(0, 1))
     rng = np.random.RandomState(0)
-    seq = 256
     toks = jax.device_put(
         rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32),
         NamedSharding(mesh, P("hvd")))

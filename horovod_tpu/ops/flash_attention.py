@@ -62,11 +62,11 @@ def flash_min_seq(causal: bool = False) -> int:
     - **causal** (llama family): flash already wins at T=512
       (623k vs 552k tok/s) — whole-block causal skipping halves the
       work, so the crossover default is 512.
-    - **non-causal** (bert, T=256 bench shape): XLA's fused attention
-      still wins (789k vs 649k tok/s) — no blocks to skip, and flash's
-      rescaling machinery is pure overhead while the [T, T] score tile
-      fits on-chip — so the default stays 1024 (the kernel-level sweep's
-      non-causal crossover region).
+    - **non-causal** (bert): XLA's fused attention wins at T=256
+      (789k vs 649k tok/s — no blocks to skip, flash's rescaling
+      machinery is pure overhead) and flash wins at T=1024 (544k vs
+      424k), bracketing the crossover — the default stays 1024, now
+      measured in-model on both sides.
 
     ``HVD_TPU_FLASH_MIN_SEQ`` overrides BOTH; tools/flash_sweep.py
     re-measures the crossover per chip."""

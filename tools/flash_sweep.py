@@ -81,7 +81,7 @@ def _time(fn, args, iters=10, warmup=1):
     return (time.perf_counter() - t0) / iters * 1e3  # ms per inner step
 
 
-def sweep(seqs, iters, tokens=TOKENS):
+def sweep(seqs, iters, tokens=TOKENS, causal=True):
     from horovod_tpu.ops.flash_attention import flash_attention
     from horovod_tpu.parallel.ring_attention import local_flash_attention
 
@@ -92,10 +92,11 @@ def sweep(seqs, iters, tokens=TOKENS):
         q = jnp.asarray(rng.randn(B, T, H, D), jnp.bfloat16)
         k = jnp.asarray(rng.randn(B, T, K, D), jnp.bfloat16)
         v = jnp.asarray(rng.randn(B, T, K, D), jnp.bfloat16)
-        row = {"seq": T, "batch": B, "tokens": B * T, "ms": {}}
+        row = {"seq": T, "batch": B, "tokens": B * T,
+               "causal": causal, "ms": {}}
 
         xla = _loss_fn(functools.partial(local_flash_attention,
-                                         causal=True), iters)
+                                         causal=causal), iters)
         try:
             row["ms"]["xla"] = round(_time(xla, (q, k, v), iters), 3)
         except Exception as exc:  # noqa: BLE001 — OOM at long T is the point
@@ -106,7 +107,7 @@ def sweep(seqs, iters, tokens=TOKENS):
             if bq > T or bk > T:
                 continue
             fl = _loss_fn(functools.partial(
-                flash_attention, causal=True, block_q=bq, block_k=bk),
+                flash_attention, causal=causal, block_q=bq, block_k=bk),
                 iters)
             key = f"flash_{bq}x{bk}"
             try:
@@ -131,6 +132,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="FLASH_SWEEP.json")
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--no-causal", action="store_true",
+                    help="sweep NON-causal attention (the bert-family "
+                         "routing default's evidence)")
     ap.add_argument("--seqs", default=",".join(map(str, SEQS)))
     ap.add_argument("--tokens", type=int, default=TOKENS,
                     help="tokens per measurement (smoke tests shrink this)")
@@ -138,9 +142,11 @@ def main():
     seqs = [int(s) for s in args.seqs.split(",")]
 
     dev = jax.devices()[0]
-    rows = sweep(seqs, args.iters, args.tokens)
+    rows = sweep(seqs, args.iters, args.tokens,
+                 causal=not args.no_causal)
     out = {
-        "provenance": "tools/flash_sweep.py — jitted fwd+bwd causal GQA "
+        "provenance": "tools/flash_sweep.py — jitted fwd+bwd "
+                      f"{'causal' if not args.no_causal else 'non-causal'} GQA "
                       f"attention, bf16, H={H} K={K} D={D}, fixed "
                       f"{args.tokens} tokens per shape",
         "captured_utc": datetime.datetime.now(
