@@ -51,6 +51,14 @@ MODES = {
     "llama_long_noflash": ({"HVD_BENCH_MODEL": "llama",
                             "HVD_BENCH_SEQ": "4096", "HVD_BENCH_BATCH": "16",
                             "HVD_TPU_FLASH": "0"}, 1500),
+    # Non-causal crossover, in-model, both sides of the 1024 default
+    # (docs/benchmarks.md "Non-causal crossover"): T=1024 flash vs XLA.
+    "bert_1k_flash": ({"HVD_BENCH_MODEL": "bert", "HVD_BENCH_SEQ": "1024",
+                       "HVD_BENCH_BATCH": "32", "HVD_TPU_FLASH": "1",
+                       "HVD_BENCH_SKIP_BUSBW": "1"}, 1200),
+    "bert_1k_noflash": ({"HVD_BENCH_MODEL": "bert", "HVD_BENCH_SEQ": "1024",
+                         "HVD_BENCH_BATCH": "32", "HVD_TPU_FLASH": "0",
+                         "HVD_BENCH_SKIP_BUSBW": "1"}, 1200),
     # T=8192 — double the XLA compile wall, still one chip (T=16384 also
     # measured by hand, 107k tok/s; see docs/benchmarks.md).
     "llama_8k": ({"HVD_BENCH_MODEL": "llama", "HVD_BENCH_SEQ": "8192",
