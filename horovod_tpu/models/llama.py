@@ -107,8 +107,8 @@ class LlamaConfig:
     moe_gated: bool = False            # SwiGLU experts (Mixtral shape)
     # Pallas flash attention: True/False, or None = resolve from the
     # HVD_TPU_FLASH env var at TRACE time (auto: on TPU for sequences at
-    # or past the measured crossover HVD_TPU_FLASH_MIN_SEQ, default 1024
-    # — below it XLA's fused attention is faster, see
+    # or past the measured crossover HVD_TPU_FLASH_MIN_SEQ — causal
+    # default 512; below it XLA's fused attention is faster, see
     # ops/flash_attention.flash_min_seq).  The env vars are not part of
     # any jit cache key — to toggle after a step has compiled, change
     # this config field (it IS traced).
