@@ -80,3 +80,37 @@ def test_bench_bert_mode():
     assert out["errors"] == {}, out
 
 
+def test_bench_llama_seq_and_evidence_knobs():
+    """HVD_BENCH_SEQ stretches the llama context; the record carries the
+    analytic-FLOPs/MFU evidence fields and the requested seq/remat."""
+    out = _run_bench({"HVD_BENCH_MODEL": "llama", "HVD_BENCH_BATCH": "2",
+                      "HVD_BENCH_STEPS": "2", "HVD_BENCH_SEQ": "256",
+                      "HVD_BENCH_REMAT": "1"})
+    assert out["value"] and out["value"] > 0, out
+    te = out["timing_evidence"]["llama"]
+    assert te["seq"] == 256
+    assert te["n_params"] > 0
+    assert te["analytic_step_flops"] > 0
+    assert out["errors"] == {}, out
+
+
+def test_bench_bert_seq_knob():
+    """HVD_BENCH_SEQ reaches the bert mode too (the non-causal crossover
+    bench vehicle) with the same evidence fields."""
+    out = _run_bench({"HVD_BENCH_MODEL": "bert", "HVD_BENCH_BATCH": "2",
+                      "HVD_BENCH_STEPS": "2", "HVD_BENCH_SEQ": "128",
+                      "HVD_BENCH_SKIP_BUSBW": "1"})
+    assert out["value"] and out["value"] > 0, out
+    te = out["timing_evidence"]["bert"]
+    assert te["seq"] == 128
+    assert te["n_params"] > 0
+    assert out["errors"] == {}, out
+
+
+def test_bench_decode_mode():
+    """Inference mode: prefill + KV-cache decode through the flagship."""
+    out = _run_bench({"HVD_BENCH_MODEL": "decode", "HVD_BENCH_STEPS": "2",
+                      "HVD_BENCH_DECODE_BATCH": "2"})
+    assert out["metric"] == "llama_decode_tokens_per_sec"
+    assert out["value"] and out["value"] > 0, out
+    assert out["errors"] == {}, out
