@@ -331,3 +331,35 @@ def test_flash_block_env_defaults(monkeypatch):
     assert fa._block_defaults() == (256, 1024)
     monkeypatch.setenv("HVD_TPU_FLASH_BLOCK_Q", "junk")
     assert fa._block_defaults()[0] == 512
+
+
+def test_flash_rejects_mixed_dtypes():
+    """The kernels feed raw operands to the MXU, so mixed q/k/v dtypes
+    must fail with the explicit entry-point error, not a cryptic
+    dot_general trace error."""
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 16, 2, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 16, 2, 8), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(1, 16, 2, 8), jnp.bfloat16)
+    with pytest.raises(ValueError, match="share one dtype"):
+        flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+
+
+def test_flash_bwd_casts_f32_cotangent():
+    """An f32 cotangent over bf16 primals is legal in jax; the backward
+    must cast it rather than die on the raw-dtype contract."""
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 32, 2, 8), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(1, 32, 2, 8), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(1, 32, 2, 8), jnp.bfloat16)
+
+    def loss(q, k, v):
+        # .astype(f32) before the reduction makes the incoming cotangent
+        # of the flash output an f32 array.
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=16,
+                                       block_k=16).astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a in g:
+        assert a.dtype == jnp.bfloat16
+        assert np.all(np.isfinite(np.asarray(a, np.float32)))
