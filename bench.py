@@ -196,23 +196,27 @@ def bench_busbw(sizes_mb, iters=10, errors=None, engine_only=False):
         elems = max(1, int(mb * (1 << 20)) // 4)
         label = f"{mb:g}MB"
         try:
-            if multi_proc:
-                # Per-process mode: eager ops take this rank's LOCAL
-                # contribution — [local_size, elems] for multi-device
-                # processes.
-                x = np.ones((n_local, elems) if n_local > 1 else (elems,),
-                            np.float32)
-            else:
-                x = jax.device_put(np.ones((n, elems), np.float32),
-                                   NamedSharding(m, P("hvd")))
+            shape = ((n_local, elems) if n_local > 1 else (elems,)) \
+                if multi_proc else (n, elems)
+            # DISTINCT buffer per timed iteration: repeated bit-identical
+            # dispatches can be served by the axon remote-execution cache
+            # instead of the interconnect (see tools/README.md — this
+            # corrupted the first decode capture), and distinct inputs
+            # are also what a real training step submits.
+            def make(i):
+                a = np.full(shape, 1.0 + i * 1e-6, np.float32)
+                return a if multi_proc else jax.device_put(
+                    a, NamedSharding(m, P("hvd")))
+            x = make(-1)
+            xs = [make(i) for i in range(iters)]
 
             # Eager engine path: enqueue -> negotiate -> fused program.
             for _ in range(3):
                 r = hvd.allreduce(x, name="busbw_warm", op=hvd.Sum)
             jax.block_until_ready(r)
             t0 = time.perf_counter()
-            for _ in range(iters):
-                r = hvd.allreduce(x, name="busbw", op=hvd.Sum)
+            for xi in xs:
+                r = hvd.allreduce(xi, name="busbw", op=hvd.Sum)
             jax.block_until_ready(r)
             wall = time.perf_counter() - t0
             dt = wall / iters
@@ -237,11 +241,12 @@ def bench_busbw(sizes_mb, iters=10, errors=None, engine_only=False):
                                   out_specs=P(), check_vma=False))
             if multi_proc:
                 x = hvd.to_global(x)
+                xs = [hvd.to_global(xi) for xi in xs]
             y = f(x)
             jax.block_until_ready(y)
             t0 = time.perf_counter()
-            for _ in range(iters):
-                y = f(x)
+            for xi in xs:          # distinct buffers (see engine path)
+                y = f(xi)
             jax.block_until_ready(y)
             wall = time.perf_counter() - t0
             dt = wall / iters
@@ -670,16 +675,24 @@ def bench_autotune():
         shapes = [tuple(int(x) for x in rng0.randint(8, 96, size=2))
                   for _ in range(24)]
 
-    def make_inputs():
+    def make_inputs(value=1.0):
         if _eager.per_process_mode():
-            return [np.ones(s, np.float32) for s in shapes]
-        return [hvd.to_global(np.ones((hvd.size(),) + s, np.float32))
+            return [np.full(s, value, np.float32) for s in shapes]
+        return [hvd.to_global(np.full((hvd.size(),) + s, value, np.float32))
                 for s in shapes]
 
-    def steps_per_s(tensors, n):
+    def make_sets(count):
+        # DISTINCT tensor set per step: bit-identical repeated dispatches
+        # can be served by the axon remote-execution cache instead of the
+        # engine actually executing (see tools/README.md) — and distinct
+        # gradients are what training submits anyway.
+        return [make_inputs(1.0 + j * 1e-6) for j in range(count)]
+
+    def steps_per_s(sets, n):
         t0 = time.perf_counter()
-        for _ in range(n):
-            hs = hvd.grouped_allreduce_async(tensors, name="autotune_bench",
+        for i in range(n):
+            hs = hvd.grouped_allreduce_async(sets[i % len(sets)],
+                                             name="autotune_bench",
                                              op=hvd.Sum)
             hvd.synchronize(hs)
         return n / (time.perf_counter() - t0)
@@ -693,9 +706,9 @@ def bench_autotune():
 
     n = int(os.environ.get("HVD_BENCH_AUTOTUNE_STEPS",
                            "30" if on_tpu else "15"))
-    tensors = make_inputs()
-    steps_per_s(tensors, 3)                      # warm the program cache
-    base = steps_per_s(tensors, n)
+    sets = make_sets(n)
+    steps_per_s(sets[:1], 3)                     # warm the program cache
+    base = steps_per_s(sets, n)
 
     # Fresh engine with the tuner on; bounded so the section stays minutes.
     hvd.shutdown()
@@ -711,14 +724,19 @@ def bench_autotune():
         hvd.init()
         from horovod_tpu.common.basics import _get_state
         eng = _get_state().engine
-        tensors = make_inputs()
-        for _ in range(400):                     # converge (bounded)
-            hs = hvd.grouped_allreduce_async(tensors, name="autotune_bench",
+        # The convergence loop cycles the distinct sets (a full per-step
+        # pool for 400 steps would be GBs); repeats recur only after
+        # len(sets) steps, so the tuner's samples stay dominated by real
+        # executions.
+        sets = make_sets(n)
+        for i in range(400):                     # converge (bounded)
+            hs = hvd.grouped_allreduce_async(sets[i % len(sets)],
+                                             name="autotune_bench",
                                              op=hvd.Sum)
             hvd.synchronize(hs)
             if eng.autotuner is None or not eng.autotuner.tuning:
                 break
-        tuned = steps_per_s(tensors, n)
+        tuned = steps_per_s(sets, n)
         return {
             "converged": eng.autotuner is not None
                          and not eng.autotuner.tuning,
@@ -804,11 +822,15 @@ def bench_tf_step(steps):
     with tf.GradientTape() as tape:
         loss = loss_obj(y, model(x, training=True))
     grads = tape.gradient(loss, model.trainable_variables)
+    # Distinct gradient set per timed call (axon dispatch-cache hazard,
+    # see tools/README.md) — precomputed outside the timed region.
+    grad_sets = [[g + tf.constant(i * 1e-6) for g in grads]
+                 for i in range(steps)]
     for _ in range(3):
         hvdtf.grouped_allreduce(grads, name="tf_step_iso")
     t0 = time.perf_counter()
-    for _ in range(steps):
-        hvdtf.grouped_allreduce(grads, name="tf_step_iso")
+    for gs in grad_sets:
+        hvdtf.grouped_allreduce(gs, name="tf_step_iso")
     grouped = (time.perf_counter() - t0) / steps
 
     _record_timing("tf_step_hvd", warmup=3, iters=steps, wall_s=hvd * steps)
