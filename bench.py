@@ -41,7 +41,10 @@ never a silent 900s burn.
 Env overrides: HVD_BENCH_BATCH, HVD_BENCH_STEPS, HVD_BENCH_IMAGE,
 HVD_BENCH_SIZES_MB (comma list),
 HVD_BENCH_MODEL=resnet50|llama|bert|tf_step|decode, HVD_BENCH_SEQ
-(llama/bert context length; defaults 512/256),
+(llama/bert context length; defaults 512/256), HVD_BENCH_REMAT=1
+(remat_layers on the llama step), HVD_BENCH_EXPERTS / HVD_BENCH_TOPK /
+HVD_BENCH_WINDOW (MoE / sliding-window llama variants),
+HVD_BENCH_DECODE_BATCH / HVD_BENCH_DECODE_PROMPT (decode mode),
 HVD_BENCH_SKIP_RAW=1, HVD_BENCH_SKIP_BUSBW=1, HVD_BENCH_SKIP_AUTOTUNE=1,
 HVD_BENCH_AUTOTUNE_STEPS, HVD_BENCH_BATCH_SWEEP (comma list of per-chip
 batches, each recorded with img/s + HBM memory analysis), HVD_BENCH_MINIMAL=1,
