@@ -1,0 +1,208 @@
+"""Vision Transformer (ViT) classification family.
+
+Beyond the reference's model zoo (Horovod ships only wrapper examples —
+SURVEY.md P14): an image-classification transformer that REUSES the bert
+encoder blocks (`bert._attention` / `bert._ffn` / `bert._layernorm`) so
+the Megatron-style tp sharding, flash routing, and layernorm numerics
+have one source of truth.  The ViT-specific pieces are patch
+embedding (a single [P*P*C, D] matmul — space-to-depth then project,
+which XLA fuses; no conv needed), a CLS token, learned positional
+embeddings, and a classification head.
+
+Sharding: dp over the batch, tp through the reused encoder blocks
+(column-split qkv/w_in, row-split wo/w_out with psum).  The patch
+sequence is short (e.g. 197 at 224/16), so sequence parallelism is
+deliberately unsupported here — set ``sp_axis=None``; long-context
+machinery lives in the llama/bert families.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from . import bert as _bert
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    n_classes: int = 1000
+    d_model: int = 768           # ViT-Base
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    dtype: Any = jnp.bfloat16
+    dp_axis: Optional[str] = "dp"
+    tp_axis: Optional[str] = "tp"
+    # Required by the reused bert blocks; ViT keeps it None (short
+    # patch sequences — see module docstring).
+    sp_axis: Optional[str] = None
+    use_flash: Optional[bool] = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def n_patches(self) -> int:
+        g = self.image_size // self.patch_size
+        return g * g
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size:
+            raise ValueError(f"image_size {self.image_size} not divisible "
+                             f"by patch_size {self.patch_size}")
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must divide by n_heads")
+        if self.sp_axis is not None:
+            raise ValueError("ViT does not support sequence parallelism "
+                             "(short patch sequences); set sp_axis=None")
+
+
+def vit_b16() -> ViTConfig:
+    return ViTConfig()
+
+
+def tiny(**kw) -> ViTConfig:
+    defaults = dict(image_size=32, patch_size=8, channels=3, n_classes=10,
+                    d_model=64, n_layers=2, n_heads=4, d_ff=128)
+    defaults.update(kw)
+    return ViTConfig(**defaults)
+
+
+def init_params(cfg: ViTConfig, key) -> Dict:
+    k = iter(jax.random.split(key, 4 + 6 * cfg.n_layers))
+    D, H, Hd, F = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+    dt = cfg.dtype
+    pdim = cfg.patch_size * cfg.patch_size * cfg.channels
+
+    def dense(key, fan_in, shape):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / np.sqrt(fan_in))).astype(dt)
+
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append({
+            "ln1_scale": jnp.ones((D,), dt), "ln1_bias": jnp.zeros((D,), dt),
+            "wq": dense(next(k), D, (D, H * Hd)),
+            "wk": dense(next(k), D, (D, H * Hd)),
+            "wv": dense(next(k), D, (D, H * Hd)),
+            "wo": dense(next(k), H * Hd, (H * Hd, D)),
+            "ln2_scale": jnp.ones((D,), dt), "ln2_bias": jnp.zeros((D,), dt),
+            "w_in": dense(next(k), D, (D, F)),
+            "b_in": jnp.zeros((F,), dt),
+            "w_out": dense(next(k), F, (F, D)),
+            "b_out": jnp.zeros((D,), dt),
+        })
+    return {
+        "patch_proj": dense(next(k), pdim, (pdim, D)),
+        "cls": jnp.zeros((1, 1, D), dt),
+        "pos_embed": dense(next(k), D, (cfg.n_patches + 1, D)),
+        "layers": layers,
+        "final_ln_scale": jnp.ones((D,), dt),
+        "final_ln_bias": jnp.zeros((D,), dt),
+        "head": dense(next(k), D, (D, cfg.n_classes)),
+    }
+
+
+def param_specs(cfg: ViTConfig) -> Dict:
+    tp = cfg.tp_axis
+    layer = {
+        "ln1_scale": P(), "ln1_bias": P(),
+        "wq": P(None, tp), "wk": P(None, tp), "wv": P(None, tp),
+        "wo": P(tp, None),
+        "ln2_scale": P(), "ln2_bias": P(),
+        "w_in": P(None, tp), "b_in": P(tp),
+        "w_out": P(tp, None), "b_out": P(),
+    }
+    return {
+        "patch_proj": P(), "cls": P(), "pos_embed": P(),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "final_ln_scale": P(), "final_ln_bias": P(),
+        "head": P(),
+    }
+
+
+def _patchify(images, cfg: ViTConfig):
+    """[B, H, W, C] -> [B, N, P*P*C] (space-to-depth, pure reshape /
+    transpose — XLA fuses it into the projection matmul)."""
+    B, Himg, Wimg, C = images.shape
+    Ps = cfg.patch_size
+    g_h, g_w = Himg // Ps, Wimg // Ps
+    x = images.reshape(B, g_h, Ps, g_w, Ps, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, g_h * g_w, Ps * Ps * C)
+
+
+def forward(params, images, cfg: ViTConfig):
+    """CLS-token encoder state for the local image shard
+    [B_loc, H, W, C] -> [B_loc, D]."""
+    x = _patchify(images.astype(cfg.dtype), cfg) @ params["patch_proj"]
+    B, N, D = x.shape
+    cls = jnp.broadcast_to(params["cls"], (B, 1, D)).astype(x.dtype)
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"][None]
+    for p in params["layers"]:
+        x = x + _bert._attention(
+            _bert._layernorm(x, p["ln1_scale"], p["ln1_bias"]), p, cfg)
+        x = x + _bert._ffn(
+            _bert._layernorm(x, p["ln2_scale"], p["ln2_bias"]), p, cfg)
+    x = _bert._layernorm(x, params["final_ln_scale"],
+                         params["final_ln_bias"])
+    return x[:, 0]
+
+
+def logits(params, images, cfg: ViTConfig):
+    return (forward(params, images, cfg)
+            @ params["head"]).astype(jnp.float32)
+
+
+def loss_fn(params, images, labels, cfg: ViTConfig):
+    """Partial cross-entropy (sum-semantics, like bert.mlm_loss_fn): the
+    denominator is the GLOBAL example count (psum over dp) times tp for
+    the redundant tensor-parallel compute."""
+    lg = logits(params, images, cfg)
+    logp = jax.nn.log_softmax(lg, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    local_sum = jnp.sum(nll)
+    denom = jnp.asarray(labels.shape[0], jnp.float32)
+    if cfg.dp_axis:
+        denom = lax.psum(denom, cfg.dp_axis)
+    if cfg.tp_axis:
+        denom = denom * lax.axis_size(cfg.tp_axis)
+    return local_sum / denom
+
+
+def psum_loss(loss_partial, cfg: ViTConfig):
+    for ax in (cfg.dp_axis, cfg.tp_axis):
+        if ax:
+            loss_partial = lax.psum(loss_partial, ax)
+    return loss_partial
+
+
+def sync_grads(grads, cfg: ViTConfig, specs=None):
+    # bert.sync_grads reads only dp/sp/tp axis names + the specs tree, so
+    # it serves ViT verbatim with ViT's own specs.
+    return _bert.sync_grads(grads, cfg, specs=specs or param_specs(cfg))
+
+
+def make_train_step(cfg: ViTConfig, optimizer):
+    import optax
+
+    def step(params, opt_state, images, labels):
+        loss_partial, grads = jax.value_and_grad(loss_fn)(
+            params, images, labels, cfg)
+        grads = sync_grads(grads, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, psum_loss(loss_partial, cfg)
+
+    return step
