@@ -40,7 +40,7 @@ never a silent 900s burn.
 
 Env overrides: HVD_BENCH_BATCH, HVD_BENCH_STEPS, HVD_BENCH_IMAGE,
 HVD_BENCH_SIZES_MB (comma list),
-HVD_BENCH_MODEL=resnet50|llama|bert|tf_step|decode, HVD_BENCH_SEQ
+HVD_BENCH_MODEL=resnet50|llama|bert|vit|tf_step|decode, HVD_BENCH_SEQ
 (llama/bert context length; defaults 512/256), HVD_BENCH_REMAT=1
 (remat_layers on the llama step), HVD_BENCH_EXPERTS / HVD_BENCH_TOPK /
 HVD_BENCH_WINDOW (MoE / sliding-window llama variants),
@@ -646,6 +646,68 @@ def bench_bert(batch, steps):
     return batch * seq * steps / dt
 
 
+def bench_vit(batch, steps):
+    """ViT-Base/16 ImageNet-shape classification through the framework
+    path (beyond-ref models row): DistributedOptimizer gradient
+    averaging inside a shard_map step, synthetic images.  ``batch`` is
+    the GLOBAL batch."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import horovod_tpu as hvd
+    from horovod_tpu.models import vit
+
+    image = int(os.environ.get("HVD_BENCH_IMAGE", "224"))
+    cfg = vit.ViTConfig(image_size=image, patch_size=16,
+                        n_classes=1000,
+                        dtype=jnp.bfloat16 if _on_tpu() else jnp.float32,
+                        dp_axis=None, tp_axis=None)
+    params = vit.init_params(cfg, jax.random.PRNGKey(0))
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3), op=hvd.Average,
+                                   axis_name="hvd")
+    opt_state = opt.init(params)
+    mesh = hvd.mesh()
+    step = jax.jit(shard_map(
+        vit.make_train_step(cfg, opt), mesh=mesh,
+        in_specs=(P(), P(), P("hvd"), P("hvd")),
+        out_specs=(P(), P(), P()), check_vma=False),
+        donate_argnums=(0, 1))
+    rng = np.random.RandomState(0)
+    images = jax.device_put(
+        rng.randn(batch, image, image, 3).astype(np.float32),
+        NamedSharding(mesh, P("hvd")))
+    labels = jax.device_put(
+        rng.randint(0, 1000, batch).astype(np.int32),
+        NamedSharding(mesh, P("hvd")))
+    for _ in range(2):
+        params, opt_state, loss = step(params, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    # Analytic MFU: 6*P per image-token over the (1 + n_patches) sequence
+    # plus full non-causal attention (same accounting as bench_bert).
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    seq = cfg.n_patches + 1
+    attn_flops = (12 * cfg.n_layers * batch * seq * seq
+                  * cfg.n_heads * cfg.head_dim)
+    step_flops = 6.0 * n_params * batch * seq + attn_flops
+    world = max(1, len(jax.devices()))
+    peak = _peak_flops()
+    mfu = (step_flops / world / (dt / steps) / peak * 100
+           if peak else None)
+    _record_timing("vit", warmup=2, iters=steps, wall_s=dt,
+                   global_batch=batch, image=image, seq=seq,
+                   n_params=int(n_params), analytic_step_flops=step_flops,
+                   mfu_pct=round(mfu, 2) if mfu else None)
+    return batch * steps / dt
+
+
 def bench_autotune():
     """Exercise the reference-N9 parameter manager on a real gradient
     workload and record what it buys (VERDICT r3 ask #8).
@@ -1049,6 +1111,18 @@ def _run(out, errors):
             out["value"] = round(tps / world, 2)
         except Exception as exc:  # noqa: BLE001 - contained like the rest
             errors["bert"] = repr(exc)
+        return
+
+    if model == "vit":
+        out.update({"metric": "vit_b16_framework_images_per_sec_per_chip",
+                    "value": None, "unit": "images/sec",
+                    "vs_baseline": None})
+        try:
+            world = max(1, hvd.size())
+            ips = bench_vit(batch, steps)        # global batch, global ips
+            out["value"] = round(ips / world, 2)
+        except Exception as exc:  # noqa: BLE001 - contained like the rest
+            errors["vit"] = repr(exc)
         return
 
     busbw = None

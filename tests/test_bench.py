@@ -114,3 +114,13 @@ def test_bench_decode_mode():
     assert out["metric"] == "llama_decode_tokens_per_sec"
     assert out["value"] and out["value"] > 0, out
     assert out["errors"] == {}, out
+
+
+def test_bench_vit_mode():
+    out = _run_bench({"HVD_BENCH_MODEL": "vit", "HVD_BENCH_BATCH": "1",
+                      "HVD_BENCH_STEPS": "2", "HVD_BENCH_IMAGE": "32"})
+    assert out["metric"].startswith("vit")
+    assert out["value"] and out["value"] > 0, out
+    te = out["timing_evidence"]["vit"]
+    assert te["n_params"] > 0 and te["seq"] == 5  # 32/16 grid + CLS
+    assert out["errors"] == {}, out

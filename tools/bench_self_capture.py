@@ -80,6 +80,8 @@ MODES = {
     "moe": ({"HVD_BENCH_MODEL": "llama", "HVD_BENCH_EXPERTS": "8",
              "HVD_BENCH_TOPK": "2", "HVD_BENCH_BATCH": "16",
              "HVD_TPU_FLASH": "1"}, 1500),
+    # ViT-Base/16 at 224 (86.5M params): the vision-transformer headline.
+    "vit": ({"HVD_BENCH_MODEL": "vit", "HVD_BENCH_BATCH": "64"}, 1500),
     # TF binding per-step cost on the real chip.
     "tf_step": ({"HVD_BENCH_MODEL": "tf_step"}, 1200),
     # Inference: blockwise prefill + KV-cache decode tokens/s.
