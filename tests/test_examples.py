@@ -159,3 +159,11 @@ def test_example_elastic_train(tmp_path):
                                     "--min-np", "1", "--max-np", "2"])
     _assert_done(r)
     assert "world=2" in r.stdout
+
+
+def test_example_vit_classify():
+    r = _run_example("vit_classify.py",
+                     ["--tiny", "--num-iters", "2", "--num-warmup", "1",
+                      "--batch-size", "4"])
+    _assert_done(r)
+    assert "img/s" in r.stdout
