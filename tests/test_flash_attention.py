@@ -363,3 +363,25 @@ def test_flash_bwd_casts_f32_cotangent():
     for a in g:
         assert a.dtype == jnp.bfloat16
         assert np.all(np.isfinite(np.asarray(a, np.float32)))
+
+
+def test_vit_uses_flash_when_forced(monkeypatch):
+    """HVD_TPU_FLASH=1 routes ViT's (reused bert) attention through the
+    pallas kernel; logits must match the jnp-reference path."""
+    from horovod_tpu.models import vit, bert
+
+    cfg = vit.tiny(dtype=jnp.float32, dp_axis=None, tp_axis=None)
+    params = vit.init_params(cfg, jax.random.PRNGKey(0))
+    images = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3),
+                         jnp.float32)
+    monkeypatch.setenv("HVD_TPU_FLASH", "0")
+    ref = vit.logits(params, images, cfg)
+    monkeypatch.setenv("HVD_TPU_FLASH", "1")
+    monkeypatch.setattr(
+        bert, "local_flash_attention",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError(
+            "vit fell back to local_flash_attention under "
+            "HVD_TPU_FLASH=1")))
+    out = vit.logits(params, images, cfg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
