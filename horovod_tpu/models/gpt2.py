@@ -1,0 +1,285 @@
+"""GPT-2 decoder family (learned positions, pre-LN, tied LM head).
+
+Beyond the reference's model zoo (Horovod ships only wrapper examples —
+SURVEY.md P14): the third transformer family, covering the architecture
+axis llama does not — learned positional embeddings instead of rope,
+LayerNorm with biases instead of RMSNorm, biased projections, tanh-GELU,
+and a vocabulary-tied LM head.  Causal attention rides the same
+routing as llama (`resolve_flash(..., causal=True)` → the Pallas flash
+kernels on TPU at/past the measured crossover).
+
+Sharding: dp over the batch, Megatron tp through attention and MLP
+(column-split q/k/v and w_in with their biases, row-split wo/w_out with
+a psum and replicated output biases).  Embeddings, layernorms and the
+tied head are replicated.  Sequence parallelism is not wired for this
+family (use llama for long context).
+
+``from_hf_state_dict`` maps HuggingFace ``GPT2LMHeadModel`` weights
+onto this pytree; HF's ``Conv1D`` stores ``[in, out]`` exactly like
+this module's ``x @ W`` convention, so conversion is a fused-qkv split
+plus renames — no transposes.  Parity is pinned against ``transformers``
+logits in tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    d_model: int = 768           # gpt2 (124M)
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq: int = 1024
+    dtype: Any = jnp.bfloat16
+    dp_axis: Optional[str] = "dp"
+    tp_axis: Optional[str] = "tp"
+    use_flash: Optional[bool] = None
+    ln_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def __post_init__(self):
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must divide by n_heads")
+
+
+def gpt2() -> GPT2Config:
+    return GPT2Config()
+
+
+def tiny(**kw) -> GPT2Config:
+    defaults = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                    d_ff=128, max_seq=64)
+    defaults.update(kw)
+    return GPT2Config(**defaults)
+
+
+def init_params(cfg: GPT2Config, key) -> Dict:
+    k = iter(jax.random.split(key, 3 + 6 * cfg.n_layers))
+    D, H, Hd, F = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+    dt = cfg.dtype
+
+    def dense(key, fan_in, shape):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / np.sqrt(fan_in))).astype(dt)
+
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append({
+            "ln1_scale": jnp.ones((D,), dt), "ln1_bias": jnp.zeros((D,), dt),
+            "wq": dense(next(k), D, (D, H * Hd)),
+            "bq": jnp.zeros((H * Hd,), dt),
+            "wk": dense(next(k), D, (D, H * Hd)),
+            "bk": jnp.zeros((H * Hd,), dt),
+            "wv": dense(next(k), D, (D, H * Hd)),
+            "bv": jnp.zeros((H * Hd,), dt),
+            "wo": dense(next(k), H * Hd, (H * Hd, D)),
+            "bo": jnp.zeros((D,), dt),
+            "ln2_scale": jnp.ones((D,), dt), "ln2_bias": jnp.zeros((D,), dt),
+            "w_in": dense(next(k), D, (D, F)), "b_in": jnp.zeros((F,), dt),
+            "w_out": dense(next(k), F, (F, D)), "b_out": jnp.zeros((D,), dt),
+        })
+    return {
+        "wte": dense(next(k), D, (cfg.vocab_size, D)),
+        "wpe": dense(next(k), D, (cfg.max_seq, D)),
+        "layers": layers,
+        "lnf_scale": jnp.ones((D,), dt),
+        "lnf_bias": jnp.zeros((D,), dt),
+        # LM head is TIED to wte (logits = x @ wte.T) — no extra param.
+    }
+
+
+def param_specs(cfg: GPT2Config) -> Dict:
+    tp = cfg.tp_axis
+    layer = {
+        "ln1_scale": P(), "ln1_bias": P(),
+        "wq": P(None, tp), "bq": P(tp),
+        "wk": P(None, tp), "bk": P(tp),
+        "wv": P(None, tp), "bv": P(tp),
+        "wo": P(tp, None), "bo": P(),
+        "ln2_scale": P(), "ln2_bias": P(),
+        "w_in": P(None, tp), "b_in": P(tp),
+        "w_out": P(tp, None), "b_out": P(),
+    }
+    return {
+        "wte": P(), "wpe": P(),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "lnf_scale": P(), "lnf_bias": P(),
+    }
+
+
+def _layernorm(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + eps)).astype(x.dtype) * scale + bias
+
+
+def _attention(x, p, cfg: GPT2Config):
+    from ..ops.flash_attention import flash_attention, resolve_flash
+    from ..parallel.ring_attention import local_flash_attention
+
+    B, T, D = x.shape
+    tp = lax.axis_size(cfg.tp_axis) if cfg.tp_axis else 1
+    if cfg.n_heads % tp:
+        raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp}")
+    H_loc, Hd = cfg.n_heads // tp, cfg.head_dim
+    q = (x @ p["wq"] + p["bq"]).reshape(B, T, H_loc, Hd)
+    k = (x @ p["wk"] + p["bk"]).reshape(B, T, H_loc, Hd)
+    v = (x @ p["wv"] + p["bv"]).reshape(B, T, H_loc, Hd)
+    if resolve_flash(cfg.use_flash, seq=T, causal=True):
+        out = flash_attention(q, k, v, causal=True)
+    else:
+        out = local_flash_attention(q, k, v, causal=True)
+    out = out.reshape(B, T, H_loc * Hd) @ p["wo"]
+    if cfg.tp_axis:
+        out = lax.psum(out, cfg.tp_axis)
+    return out + p["bo"]
+
+
+def _mlp(x, p, cfg: GPT2Config):
+    # GPT-2's activation is the tanh-approximate GELU ("gelu_new").
+    h = jax.nn.gelu(x @ p["w_in"] + p["b_in"], approximate=True)
+    out = h @ p["w_out"]
+    if cfg.tp_axis:
+        out = lax.psum(out, cfg.tp_axis)
+    return out + p["b_out"]
+
+
+def forward(params, tokens, cfg: GPT2Config):
+    """Logits [B_loc, T, vocab] for the local token shard (tied head)."""
+    B, T = tokens.shape
+    x = params["wte"][tokens] + params["wpe"][jnp.arange(T)][None]
+    x = x.astype(cfg.dtype)
+    for p in params["layers"]:
+        x = x + _attention(
+            _layernorm(x, p["ln1_scale"], p["ln1_bias"], cfg.ln_eps), p, cfg)
+        x = x + _mlp(
+            _layernorm(x, p["ln2_scale"], p["ln2_bias"], cfg.ln_eps), p, cfg)
+    x = _layernorm(x, params["lnf_scale"], params["lnf_bias"], cfg.ln_eps)
+    return (x @ params["wte"].T).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, targets, cfg: GPT2Config):
+    """Partial causal-LM loss (sum semantics — see bert.mlm_loss_fn):
+    global-token denominator psum'd over dp, times tp for the redundant
+    tensor-parallel compute."""
+    logits = forward(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    local_sum = jnp.sum(nll)
+    denom = jnp.asarray(tokens.shape[0] * tokens.shape[1], jnp.float32)
+    if cfg.dp_axis:
+        denom = lax.psum(denom, cfg.dp_axis)
+    if cfg.tp_axis:
+        denom = denom * lax.axis_size(cfg.tp_axis)
+    return local_sum / denom
+
+
+def psum_loss(loss_partial, cfg: GPT2Config):
+    for ax in (cfg.dp_axis, cfg.tp_axis):
+        if ax:
+            loss_partial = lax.psum(loss_partial, ax)
+    return loss_partial
+
+
+def sync_grads(grads, cfg: GPT2Config, specs=None):
+    specs = specs or param_specs(cfg)
+
+    def leaf_sync(g, spec):
+        if cfg.dp_axis:
+            g = lax.psum(g, cfg.dp_axis)
+        if cfg.tp_axis and all(s != cfg.tp_axis for s in spec):
+            g = lax.psum(g, cfg.tp_axis)
+        return g
+
+    return jax.tree_util.tree_map(leaf_sync, grads, specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+def make_train_step(cfg: GPT2Config, optimizer):
+    import optax
+
+    def step(params, opt_state, tokens, targets):
+        loss_partial, grads = jax.value_and_grad(loss_fn)(
+            params, tokens, targets, cfg)
+        grads = sync_grads(grads, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, psum_loss(loss_partial, cfg)
+
+    return step
+
+
+# ------------------------------------------------------------- HF convert
+def _np_arr(x) -> np.ndarray:
+    if hasattr(x, "detach"):          # torch.Tensor, without importing torch
+        x = x.detach().cpu()
+        if str(x.dtype) == "torch.bfloat16":
+            x = x.float()
+        x = x.numpy()
+    return np.asarray(x)
+
+
+def from_hf_state_dict(sd: Mapping[str, Any], cfg: GPT2Config) -> Dict:
+    """HuggingFace ``GPT2LMHeadModel`` state dict -> this pytree.
+
+    HF's ``Conv1D`` stores weights ``[in, out]`` (x @ W + b), matching
+    this module — the only structural work is splitting the fused
+    ``attn.c_attn`` ``[D, 3D]`` into wq/wk/wv (+biases).  Keys may carry
+    the ``transformer.`` prefix (GPT2LMHeadModel) or not (GPT2Model).
+    """
+    dt = cfg.dtype
+    pref = "transformer." if any(k.startswith("transformer.") for k in sd) \
+        else ""
+
+    def get(name):
+        return _np_arr(sd[pref + name])
+
+    D = cfg.d_model
+    layers = []
+    for i in range(cfg.n_layers):
+        b = f"h.{i}."
+        ca_w = get(b + "attn.c_attn.weight")      # [D, 3D]
+        ca_b = get(b + "attn.c_attn.bias")        # [3D]
+        wq, wk, wv = np.split(ca_w, 3, axis=1)
+        bq, bk, bv = np.split(ca_b, 3, axis=0)
+        layers.append({
+            "ln1_scale": jnp.asarray(get(b + "ln_1.weight"), dt),
+            "ln1_bias": jnp.asarray(get(b + "ln_1.bias"), dt),
+            "wq": jnp.asarray(wq, dt), "bq": jnp.asarray(bq, dt),
+            "wk": jnp.asarray(wk, dt), "bk": jnp.asarray(bk, dt),
+            "wv": jnp.asarray(wv, dt), "bv": jnp.asarray(bv, dt),
+            "wo": jnp.asarray(get(b + "attn.c_proj.weight"), dt),
+            "bo": jnp.asarray(get(b + "attn.c_proj.bias"), dt),
+            "ln2_scale": jnp.asarray(get(b + "ln_2.weight"), dt),
+            "ln2_bias": jnp.asarray(get(b + "ln_2.bias"), dt),
+            "w_in": jnp.asarray(get(b + "mlp.c_fc.weight"), dt),
+            "b_in": jnp.asarray(get(b + "mlp.c_fc.bias"), dt),
+            "w_out": jnp.asarray(get(b + "mlp.c_proj.weight"), dt),
+            "b_out": jnp.asarray(get(b + "mlp.c_proj.bias"), dt),
+        })
+    wte = get("wte.weight")
+    if wte.shape != (cfg.vocab_size, D):
+        raise ValueError(f"wte {wte.shape} != config "
+                         f"({cfg.vocab_size}, {D})")
+    return {
+        "wte": jnp.asarray(wte, dt),
+        "wpe": jnp.asarray(get("wpe.weight")[:cfg.max_seq], dt),
+        "layers": layers,
+        "lnf_scale": jnp.asarray(get("ln_f.weight"), dt),
+        "lnf_bias": jnp.asarray(get("ln_f.bias"), dt),
+    }

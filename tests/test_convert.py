@@ -153,3 +153,37 @@ def test_mismatched_checkpoint_rejected():
         convert.from_hf_state_dict(
             model.state_dict(),
             dataclasses.replace(cfg, n_experts=4))
+
+
+# ----------------------------------------------------------------- GPT-2
+def test_gpt2_hf_conversion_matches_transformers():
+    """Converted HF GPT2LMHeadModel weights reproduce transformers'
+    logits — the parity pin for the Conv1D no-transpose convention, the
+    fused-qkv split, tanh-GELU, and the tied head."""
+    import torch
+    from transformers import GPT2Config as HFGPT2Config
+    from transformers import GPT2LMHeadModel
+    from horovod_tpu.models import gpt2
+
+    hf_cfg = HFGPT2Config(vocab_size=256, n_positions=64, n_embd=64,
+                          n_layer=2, n_head=4,
+                          resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    hf = GPT2LMHeadModel(hf_cfg).eval()
+    cfg = gpt2.tiny(dtype=jnp.float32, dp_axis=None, tp_axis=None)
+    params = gpt2.from_hf_state_dict(hf.state_dict(), cfg)
+
+    tokens = np.random.RandomState(0).randint(0, 256, (2, 40))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(gpt2.forward(params, jnp.asarray(tokens), cfg))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_gpt2_missing_key_is_clear():
+    from horovod_tpu.models import gpt2
+
+    with pytest.raises(KeyError):
+        gpt2.from_hf_state_dict({"transformer.wte.weight":
+                                 np.zeros((256, 64))},
+                                gpt2.tiny())
