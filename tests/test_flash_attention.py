@@ -385,3 +385,27 @@ def test_vit_uses_flash_when_forced(monkeypatch):
     out = vit.logits(params, images, cfg)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_gpt2_uses_flash_when_forced(monkeypatch):
+    """HVD_TPU_FLASH=1 routes GPT-2's causal attention through the
+    pallas kernel; logits must match the jnp-reference path."""
+    from horovod_tpu.models import gpt2
+
+    cfg = gpt2.tiny(dtype=jnp.float32, dp_axis=None, tp_axis=None)
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 24)),
+                         jnp.int32)
+    monkeypatch.setenv("HVD_TPU_FLASH", "0")
+    ref = gpt2.forward(params, tokens, cfg)
+    monkeypatch.setenv("HVD_TPU_FLASH", "1")
+    import importlib
+    ra = importlib.import_module("horovod_tpu.parallel.ring_attention")
+    monkeypatch.setattr(
+        ra, "local_flash_attention",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError(
+            "gpt2 fell back to local_flash_attention under "
+            "HVD_TPU_FLASH=1")))
+    out = gpt2.forward(params, tokens, cfg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
