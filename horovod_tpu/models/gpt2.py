@@ -283,3 +283,87 @@ def from_hf_state_dict(sd: Mapping[str, Any], cfg: GPT2Config) -> Dict:
         "lnf_scale": jnp.asarray(get("ln_f.weight"), dt),
         "lnf_bias": jnp.asarray(get("ln_f.bias"), dt),
     }
+
+
+# ------------------------------------------------------------- serving
+def init_cache(cfg: GPT2Config, batch: int,
+               max_seq: Optional[int] = None):
+    """Per-layer KV cache [B, T_max, H, Hd] (single-device serving; the
+    tp-sharded and rolling variants live in the flagship llama family)."""
+    T = max_seq or cfg.max_seq
+    shape = (batch, T, cfg.n_heads, cfg.head_dim)
+    return [{"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)} for _ in range(cfg.n_layers)]
+
+
+def decode_step(params, cache, tokens, pos, cfg: GPT2Config):
+    """One cached decode step: ``tokens [B]`` at position ``pos`` (traced
+    scalar) -> (logits [B, vocab], updated cache).  Attention over the
+    cache is a masked einsum — at Tq=1 there is no score tile to stream,
+    so flash buys nothing (same analysis as llama.decode_step)."""
+    if cfg.dp_axis or cfg.tp_axis:
+        raise ValueError("gpt2 decode is single-device; build the config "
+                         "with dp_axis=None, tp_axis=None")
+    B = tokens.shape[0]
+    T = cache[0]["k"].shape[1]
+    x = (params["wte"][tokens] + params["wpe"][pos][None]).astype(cfg.dtype)
+    valid = (jnp.arange(T) <= pos)[None, None, :]        # [1, 1, T]
+    new_cache = []
+    for p, c in zip(params["layers"], cache):
+        h = _layernorm(x, p["ln1_scale"], p["ln1_bias"], cfg.ln_eps)
+        q = (h @ p["wq"] + p["bq"]).reshape(B, cfg.n_heads, cfg.head_dim)
+        k1 = (h @ p["wk"] + p["bk"]).reshape(B, 1, cfg.n_heads,
+                                             cfg.head_dim)
+        v1 = (h @ p["wv"] + p["bv"]).reshape(B, 1, cfg.n_heads,
+                                             cfg.head_dim)
+        ck = lax.dynamic_update_slice(c["k"], k1.astype(c["k"].dtype),
+                                      (0, pos, 0, 0))
+        cv = lax.dynamic_update_slice(c["v"], v1.astype(c["v"].dtype),
+                                      (0, pos, 0, 0))
+        new_cache.append({"k": ck, "v": cv})
+        s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+                       ck.astype(jnp.float32)) / np.sqrt(cfg.head_dim)
+        s = jnp.where(valid, s, -1e30)
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bht,bthd->bhd", w, cv.astype(jnp.float32))
+        o = o.reshape(B, cfg.n_heads * cfg.head_dim).astype(cfg.dtype)
+        att = o @ p["wo"] + p["bo"]
+        x = x + att
+        h2 = _layernorm(x, p["ln2_scale"], p["ln2_bias"], cfg.ln_eps)
+        x = x + _mlp(h2, p, cfg)
+    x = _layernorm(x, params["lnf_scale"], params["lnf_bias"], cfg.ln_eps)
+    return (x @ params["wte"].T).astype(jnp.float32), new_cache
+
+
+def generate(params, prompt, n_tokens: int, cfg: GPT2Config,
+             max_seq: Optional[int] = None):
+    """Greedy generation: prompt [B, T0] -> [B, n_tokens] (jit-compatible;
+    the whole loop is one lax.scan on device)."""
+    B, T0 = prompt.shape
+    T = max_seq or (T0 + n_tokens)
+    cache = init_cache(cfg, B, T)
+
+    def feed(carry, i):
+        tok, cache = carry
+        logits, cache = decode_step(params, cache, tok, i, cfg)
+        return (jnp.argmax(logits, axis=-1).astype(jnp.int32), cache), None
+
+    # Prefill: feed prompt tokens sequentially through the cache (the
+    # minimal variant; the blockwise-flash prefill lives in llama).
+    carry = (prompt[:, 0], cache)
+    for i in range(1, T0):
+        (nxt, cache) = feed((prompt[:, i - 1], carry[1]),
+                            jnp.asarray(i - 1))[0]
+        carry = (prompt[:, i], cache)
+    first, cache = feed((prompt[:, T0 - 1], carry[1]),
+                        jnp.asarray(T0 - 1))[0]
+
+    def body(carry, i):
+        tok, cache = carry
+        logits, cache = decode_step(params, cache, tok, i, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, cache), tok
+
+    (_, _), toks = lax.scan(body, (first, cache),
+                            T0 + jnp.arange(n_tokens))
+    return jnp.moveaxis(toks, 0, 1)                      # [B, n_tokens]

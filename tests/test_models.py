@@ -289,3 +289,25 @@ def test_gpt2_sharded_matches_reference():
         p, s, l = step(p, s, jnp.asarray(tokens), jnp.asarray(targets))
         losses.append(float(l))
     np.testing.assert_allclose(losses, ref_losses, rtol=2e-4)
+
+
+def test_gpt2_generate_matches_full_forward():
+    """Greedy KV-cache generation == argmax over full re-forward, token
+    for token (the decode-path exactness contract, GPT-2 edition)."""
+    from horovod_tpu.models import gpt2
+
+    cfg = gpt2.tiny(dtype=jnp.float32, dp_axis=None, tp_axis=None)
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 8)),
+                         jnp.int32)
+    out = gpt2.generate(params, prompt, 6, cfg)
+    seq = prompt
+    for _ in range(6):
+        lg = gpt2.forward(params, seq, cfg)
+        nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq[:, 8:]))
+
+    with pytest.raises(ValueError, match="single-device"):
+        gpt2.decode_step(params, gpt2.init_cache(gpt2.tiny(), 2),
+                         prompt[:, 0], 0, gpt2.tiny())
