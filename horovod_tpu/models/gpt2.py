@@ -367,3 +367,41 @@ def generate(params, prompt, n_tokens: int, cfg: GPT2Config,
     (_, _), toks = lax.scan(body, (first, cache),
                             T0 + jnp.arange(n_tokens))
     return jnp.moveaxis(toks, 0, 1)                      # [B, n_tokens]
+
+
+def to_hf_state_dict(params: Dict, cfg: GPT2Config,
+                     prefix: str = "transformer.") -> Dict[str, np.ndarray]:
+    """This pytree -> HuggingFace ``GPT2LMHeadModel`` naming (numpy
+    float32).  Exact inverse of :func:`from_hf_state_dict` (the fused
+    c_attn re-concatenates); includes the tied ``lm_head.weight``."""
+    sd: Dict[str, np.ndarray] = {}
+
+    def put(name, arr):
+        sd[prefix + name] = np.asarray(arr, np.float32)
+
+    put("wte.weight", params["wte"])
+    put("wpe.weight", params["wpe"])
+    for i, p in enumerate(params["layers"]):
+        b = f"h.{i}."
+        put(b + "ln_1.weight", p["ln1_scale"])
+        put(b + "ln_1.bias", p["ln1_bias"])
+        put(b + "attn.c_attn.weight",
+            np.concatenate([np.asarray(p["wq"], np.float32),
+                            np.asarray(p["wk"], np.float32),
+                            np.asarray(p["wv"], np.float32)], axis=1))
+        put(b + "attn.c_attn.bias",
+            np.concatenate([np.asarray(p["bq"], np.float32),
+                            np.asarray(p["bk"], np.float32),
+                            np.asarray(p["bv"], np.float32)], axis=0))
+        put(b + "attn.c_proj.weight", p["wo"])
+        put(b + "attn.c_proj.bias", p["bo"])
+        put(b + "ln_2.weight", p["ln2_scale"])
+        put(b + "ln_2.bias", p["ln2_bias"])
+        put(b + "mlp.c_fc.weight", p["w_in"])
+        put(b + "mlp.c_fc.bias", p["b_in"])
+        put(b + "mlp.c_proj.weight", p["w_out"])
+        put(b + "mlp.c_proj.bias", p["b_out"])
+    put("ln_f.weight", params["lnf_scale"])
+    put("ln_f.bias", params["lnf_bias"])
+    sd["lm_head.weight"] = np.asarray(params["wte"], np.float32)  # tied
+    return sd

@@ -187,3 +187,23 @@ def test_gpt2_missing_key_is_clear():
         gpt2.from_hf_state_dict({"transformer.wte.weight":
                                  np.zeros((256, 64))},
                                 gpt2.tiny())
+
+
+def test_gpt2_round_trip_lossless():
+    """from_hf -> to_hf reproduces every tensor bit-exactly (fp32)."""
+    import torch
+    from transformers import GPT2Config as HFGPT2Config
+    from transformers import GPT2LMHeadModel
+    from horovod_tpu.models import gpt2
+
+    hf_cfg = HFGPT2Config(vocab_size=256, n_positions=64, n_embd=64,
+                          n_layer=2, n_head=4)
+    torch.manual_seed(1)
+    hf = GPT2LMHeadModel(hf_cfg).eval()
+    cfg = gpt2.tiny(dtype=jnp.float32, dp_axis=None, tp_axis=None)
+    params = gpt2.from_hf_state_dict(hf.state_dict(), cfg)
+    back = gpt2.to_hf_state_dict(params, cfg)
+    sd = hf.state_dict()
+    for name, arr in back.items():
+        ref = sd[name].detach().float().numpy()
+        np.testing.assert_array_equal(arr, ref, err_msg=name)
