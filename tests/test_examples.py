@@ -167,3 +167,9 @@ def test_example_vit_classify():
                       "--batch-size", "4"])
     _assert_done(r)
     assert "img/s" in r.stdout
+
+
+def test_example_gpt2_import_generate():
+    r = _run_example("gpt2_import_generate.py", np_=1)
+    _assert_done(r)
+    assert "logits parity" in r.stdout
