@@ -179,7 +179,8 @@ def bench_busbw(sizes_mb, iters=10, errors=None, engine_only=False):
     records an error and the sweep continues — partial results beat none."""
     import jax
     import numpy as np
-    from jax import lax, shard_map
+    from jax import lax
+    from horovod_tpu.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
     import horovod_tpu as hvd
 
@@ -269,7 +270,7 @@ def _resnet_pieces(batch, image_size, framework: bool):
     import jax
     import jax.numpy as jnp
     import optax
-    from jax import shard_map
+    from horovod_tpu.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
     from horovod_tpu.models import resnet
     import horovod_tpu as hvd
@@ -417,7 +418,7 @@ def bench_llama(batch, steps):
     import jax.numpy as jnp
     import numpy as np
     import optax
-    from jax import shard_map
+    from horovod_tpu.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
     import horovod_tpu as hvd
     from horovod_tpu.models import llama
@@ -589,7 +590,7 @@ def bench_bert(batch, steps):
     import jax.numpy as jnp
     import numpy as np
     import optax
-    from jax import shard_map
+    from horovod_tpu.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
     import horovod_tpu as hvd
     from horovod_tpu.models import bert
@@ -655,7 +656,7 @@ def bench_vit(batch, steps):
     import jax.numpy as jnp
     import numpy as np
     import optax
-    from jax import shard_map
+    from horovod_tpu.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
     import horovod_tpu as hvd
     from horovod_tpu.models import vit

@@ -56,7 +56,7 @@ def main():
     args = parse_args()
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from horovod_tpu.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from horovod_tpu.models import llama

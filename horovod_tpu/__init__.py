@@ -52,3 +52,4 @@ from . import elastic  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import data  # noqa: F401
+from . import analysis  # noqa: F401  (collective-correctness analyzer)

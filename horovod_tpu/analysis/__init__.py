@@ -1,0 +1,34 @@
+"""Collective-correctness analyzer: lint + trace check + runtime sanitizer.
+
+Three layers, one rule catalog (see ``findings.RULES`` and
+``docs/analysis.md``):
+
+- :mod:`.collective_lint` — AST lint of training scripts (and this repo),
+  no jax required.  CLI: ``python -m horovod_tpu.analysis <paths>``.
+- :mod:`.trace_check` — jaxpr-level collective ledger audit of a traced
+  step function.
+- :mod:`.runtime_sanitizer` — ``HVD_TPU_SANITIZER=1`` run-time ledger and
+  cross-rank order/signature check through the negotiation controller.
+
+Framework bindings expose this as ``DistributedOptimizer(..., check=...)``
+(see :mod:`.hooks`).
+"""
+
+from .findings import Finding, Rule, RULES, Severity, summarize  # noqa: F401
+from .collective_lint import (  # noqa: F401
+    COLLECTIVE_NAMES, lint_file, lint_paths, lint_source,
+)
+
+__all__ = [
+    "Finding", "Rule", "RULES", "Severity", "summarize",
+    "COLLECTIVE_NAMES", "lint_file", "lint_paths", "lint_source",
+    "analyze_paths",
+]
+
+
+def analyze_paths(paths, include_warnings: bool = True):
+    """Lint files/dirs; returns findings (errors first, then warnings)."""
+    findings = lint_paths(paths)
+    if not include_warnings:
+        findings = [f for f in findings if f.is_error]
+    return sorted(findings, key=lambda f: (not f.is_error, f.path, f.line))

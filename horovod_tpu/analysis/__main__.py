@@ -1,0 +1,79 @@
+"""CLI: ``python -m horovod_tpu.analysis [paths...]``.
+
+Lints the given files/directories for deadlock-prone collective patterns
+and prints findings with severity and fix hints.  Exit status: 0 clean (or
+warnings only, unless ``--strict``), 1 on error-severity findings, 2 on
+usage errors.
+
+The lint layer is pure AST analysis: nothing is executed, no runtime is
+initialized and no device is touched — safe to run in CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .collective_lint import lint_paths
+from .findings import RULES, summarize
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis",
+        description="Static collective-correctness linter for horovod_tpu "
+                    "training scripts.")
+    ap.add_argument("paths", nargs="*",
+                    help="Python files or directories to lint")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--no-fix-hints", action="store_true",
+                    help="omit fix guidance lines")
+    ap.add_argument("--disable", default="",
+                    help="comma-separated rule IDs to ignore (e.g. "
+                         "HVD105,HVD103)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero on warnings too")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES.values():
+            print(f"{rule.id} [{rule.severity.value}] {rule.title}")
+            print(f"    {rule.rationale}")
+            print(f"    fix: {rule.fix_hint}")
+        return 0
+
+    if not args.paths:
+        ap.print_usage()
+        return 2
+
+    disabled = {s.strip().upper() for s in args.disable.split(",") if s.strip()}
+    try:
+        findings = [f for f in lint_paths(args.paths) if f.rule not in disabled]
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps([{
+            "rule": f.rule, "severity": f.severity.value, "path": f.path,
+            "line": f.line, "col": f.col, "message": f.message,
+            "fix_hint": f.fix_hint,
+        } for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render(show_fix=not args.no_fix_hints))
+        print(summarize(findings))
+
+    if any(f.is_error for f in findings):
+        return 1
+    if args.strict and findings:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,458 @@
+"""AST linter for deadlock-prone collective patterns (rules HVD101–HVD107).
+
+The static half of what the reference's controller + stall inspector catch
+at runtime (SURVEY.md §L2): ranks disagreeing on the sequence, signature or
+process set of a collective.  Works on source text only — no jax import, no
+initialization — so it can gate CI and be run over user training scripts
+before a job ever touches a TPU.
+
+Suppression: a ``# hvd-lint: disable=HVD101`` comment on the flagged line
+(or comma-separated IDs, or ``disable=all``) silences that line.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding, Severity
+
+# ---------------------------------------------------------------------------
+# Name tables
+# ---------------------------------------------------------------------------
+
+# Every public spelling of a collective submission across the bindings
+# (ops/eager.py, torch/mpi_ops.py, tensorflow/mpi_ops.py, jax/optimizer.py).
+_BASE_COLLECTIVES = {
+    "allreduce", "allgather", "broadcast", "alltoall", "reducescatter",
+    "barrier",
+}
+COLLECTIVE_NAMES: Set[str] = set()
+for _b in _BASE_COLLECTIVES:
+    for _v in (_b, f"{_b}_", f"{_b}_async", f"{_b}_async_",
+               f"grouped_{_b}", f"grouped_{_b}_async",
+               f"grouped_{_b}_async_", f"grouped_{_b}_"):
+        COLLECTIVE_NAMES.add(_v)
+# NB: hvd.join() is deliberately NOT here — it is the sanctioned way for
+# ranks to stop submitting at different times (uneven final batches), so
+# rank-divergent calls to it are correct, not a bug.
+COLLECTIVE_NAMES |= {
+    "broadcast_object", "allgather_object", "broadcast_pytree",
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "broadcast_variables", "allreduce_gradients",
+}
+
+# Functions that perform the rank-0 state sync HVD103 wants to see.
+_SYNC_CALLS = {
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "broadcast_variables", "broadcast_object", "broadcast_pytree",
+    "BroadcastGlobalVariablesCallback",
+}
+
+# Rank-identity accessors whose results make control flow rank-divergent.
+_RANK_CALLS = {"rank", "local_rank", "cross_rank", "process_index"}
+
+# Host-sync / callback spellings flagged inside jit (HVD106).
+_HOST_SYNC_CALLS = {
+    "block_until_ready", "io_callback", "pure_callback", "call_host",
+    "host_callback", "device_get",
+}
+
+# Gradient-reducing wrappers whose presence means "this is a training
+# script" for HVD103.
+_TRAINING_WRAPPERS = {
+    "DistributedOptimizer", "DistributedGradientTape", "allreduce_gradients",
+}
+
+_DISABLE_RE = re.compile(r"hvd-lint\s*:\s*disable\s*=\s*([A-Za-z0-9_,\s]+)")
+
+
+def _call_name(node: ast.AST) -> Optional[str]:
+    """Last dotted segment of a call target: ``hvd.ops.allreduce`` → ``allreduce``."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_collective_call(node: ast.Call) -> bool:
+    return _call_name(node) in COLLECTIVE_NAMES
+
+
+def _mentions_rank(expr: ast.AST, tainted: Set[str]) -> bool:
+    """True when the expression reads rank identity — a direct
+    rank()/local_rank() call or a variable assigned from one."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call) and _call_name(sub) in _RANK_CALLS:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return True
+    return False
+
+
+def _iter_over_set_or_dict(it: ast.AST) -> Optional[str]:
+    """Classify a for-loop iterable: 'set', 'dict', or None.
+
+    ``sorted(...)`` anywhere at the top neutralizes the order hazard.
+    """
+    if isinstance(it, ast.Call) and _call_name(it) == "sorted":
+        return None
+    if isinstance(it, (ast.Set, ast.SetComp)):
+        return "set"
+    if isinstance(it, ast.Call):
+        name = _call_name(it)
+        if name == "set":
+            return "set"
+        if name in ("keys", "values", "items"):
+            return "dict"
+        if name in ("enumerate", "list", "tuple", "reversed"):
+            return _iter_over_set_or_dict(it.args[0]) if it.args else None
+    return None
+
+
+def _jit_decorated(fn: ast.AST) -> bool:
+    """True for ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)`` /
+    ``@functools.partial(jit, ...)`` decorations."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    for dec in fn.decorator_list:
+        name = _call_name(dec)
+        if name == "jit":
+            return True
+        if name == "partial" and isinstance(dec, ast.Call) and dec.args:
+            if _call_name(dec.args[0]) == "jit":
+                return True
+    return False
+
+
+class _FunctionFacts(ast.NodeVisitor):
+    """Collect per-function taint (names holding rank identity)."""
+
+    def __init__(self):
+        self.tainted: Set[str] = set()
+
+    def visit_Assign(self, node: ast.Assign):
+        self._track(node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._track([node.target], node.value)
+        self.generic_visit(node)
+
+    def _track(self, targets, value):
+        def taints(v) -> bool:
+            return (isinstance(v, ast.Call)
+                    and _call_name(v) in _RANK_CALLS) or \
+                   (isinstance(v, ast.Name) and v.id in self.tainted)
+
+        vals: List[ast.AST]
+        if isinstance(value, ast.Tuple):
+            vals = list(value.elts)
+        else:
+            vals = [value]
+        for tgt in targets:
+            tgts = list(tgt.elts) if isinstance(tgt, ast.Tuple) else [tgt]
+            if len(tgts) == len(vals):
+                for t, v in zip(tgts, vals):
+                    if isinstance(t, ast.Name) and taints(v):
+                        self.tainted.add(t.id)
+            elif len(tgts) == 1 and isinstance(tgts[0], ast.Name) \
+                    and any(taints(v) for v in vals):
+                self.tainted.add(tgts[0].id)
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.findings: List[Finding] = []
+        self.source = source
+        # Module facts for HVD102/HVD103.
+        self.has_init = False
+        self.has_subgroup_sets = False
+        self.has_sync = False
+        self.has_training_wrapper = False
+        self.uses_elastic_state = False
+        self.init_line = 0
+        self.first_training_line = 0
+        self.collectives_without_ps: List[ast.Call] = []
+        # Stack state while walking.
+        self._fn_stack: List[dict] = []
+        self._jit_depth = 0
+        self._divergent_if_depth = 0
+        # Per-function: line after which a rank-divergent early exit makes
+        # later collectives subset-only.
+        self._early_exit_after: List[Optional[int]] = []
+
+    # -------------------------------------------------------------- helpers
+    def _emit(self, rule: str, node: ast.AST, message: str):
+        self.findings.append(Finding(
+            rule=rule, path=self.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message))
+
+    def _tainted(self) -> Set[str]:
+        return self._fn_stack[-1]["tainted"] if self._fn_stack else \
+            self._module_tainted
+
+    # ------------------------------------------------------------ functions
+    def visit_Module(self, node: ast.Module):
+        facts = _FunctionFacts()
+        facts.visit(node)
+        self._module_tainted = facts.tainted
+        self.generic_visit(node)
+
+    def _visit_function(self, node):
+        facts = _FunctionFacts()
+        facts.visit(node)
+        # @hvd.elastic.run / @run (imported from horovod_tpu.elastic):
+        # elastic-protected training syncs state on restore, which
+        # satisfies HVD103's broadcast requirement.
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            dotted = []
+            n = target
+            while isinstance(n, ast.Attribute):
+                dotted.append(n.attr)
+                n = n.value
+            if isinstance(n, ast.Name):
+                dotted.append(n.id)
+            if dotted and dotted[0] == "run" and (
+                    len(dotted) == 1 or "elastic" in dotted):
+                self.uses_elastic_state = True
+        jit = _jit_decorated(node)
+        self._fn_stack.append({"tainted": facts.tainted, "node": node})
+        self._early_exit_after.append(None)
+        if jit:
+            self._jit_depth += 1
+        self.generic_visit(node)
+        if jit:
+            self._jit_depth -= 1
+        self._early_exit_after.pop()
+        self._fn_stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    # ------------------------------------------------- rank-divergent flow
+    def _branch_has_exit(self, body: Sequence[ast.stmt]) -> bool:
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, (ast.Return, ast.Raise, ast.Continue,
+                                    ast.Break)):
+                    return True
+                if isinstance(sub, ast.Call) and _call_name(sub) in (
+                        "exit", "_exit", "abort"):
+                    return True
+        return False
+
+    def visit_If(self, node: ast.If):
+        divergent = _mentions_rank(node.test, self._tainted())
+        if divergent:
+            self._divergent_if_depth += 1
+        self.generic_visit(node)
+        if divergent:
+            self._divergent_if_depth -= 1
+            if self._early_exit_after and self._early_exit_after[-1] is None \
+                    and (self._branch_has_exit(node.body)
+                         or (node.orelse
+                             and self._branch_has_exit(node.orelse))):
+                self._early_exit_after[-1] = node.end_lineno or node.lineno
+
+    def visit_While(self, node: ast.While):
+        divergent = _mentions_rank(node.test, self._tainted())
+        if divergent:
+            self._divergent_if_depth += 1
+        self.generic_visit(node)
+        if divergent:
+            self._divergent_if_depth -= 1
+
+    def visit_IfExp(self, node: ast.IfExp):
+        divergent = _mentions_rank(node.test, self._tainted())
+        if divergent:
+            self._divergent_if_depth += 1
+        self.generic_visit(node)
+        if divergent:
+            self._divergent_if_depth -= 1
+
+    # ------------------------------------------------------------ for loops
+    def visit_For(self, node: ast.For):
+        kind = _iter_over_set_or_dict(node.iter)
+        if kind is not None:
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call) and _is_collective_call(sub):
+                        rule = "HVD104" if kind == "set" else "HVD105"
+                        self._emit(
+                            rule, sub,
+                            f"collective {_call_name(sub)!r} is submitted in "
+                            f"{kind}-iteration order (loop at line "
+                            f"{node.lineno}); ranks that build the {kind} "
+                            f"differently submit in different order")
+                        break
+                else:
+                    continue
+                break
+        self.generic_visit(node)
+
+    # ---------------------------------------------------------------- calls
+    def visit_Call(self, node: ast.Call):
+        name = _call_name(node)
+        if name == "init":
+            self.has_init = True
+            self.init_line = self.init_line or node.lineno
+        elif name == "add_process_set":
+            self.has_subgroup_sets = True
+        elif name in _SYNC_CALLS:
+            self.has_sync = True
+        elif name in ("JaxState", "TorchState", "TensorFlowKerasState"):
+            # hvd.elastic state management syncs on restore.
+            self.uses_elastic_state = True
+        if name in _TRAINING_WRAPPERS:
+            self.has_training_wrapper = True
+            self.first_training_line = self.first_training_line or node.lineno
+
+        if name in _HOST_SYNC_CALLS and self._jit_depth > 0:
+            self._emit("HVD106", node,
+                       f"{name!r} inside a jit-decorated function forces a "
+                       f"host round-trip at trace/run time")
+
+        if _is_collective_call(node):
+            self._check_collective(node, name)
+        self.generic_visit(node)
+
+    def _check_collective(self, node: ast.Call, name: str):
+        if self._jit_depth > 0 and name in COLLECTIVE_NAMES \
+                and not self._in_graph_spelling(node):
+            self._emit("HVD107", node,
+                       f"eager collective {name!r} inside a jit-decorated "
+                       f"function submits to the engine at trace time")
+        if self._divergent_if_depth > 0:
+            self._emit("HVD101", node,
+                       f"collective {name!r} is inside rank-divergent "
+                       f"control flow: only a subset of ranks submits it, "
+                       f"the rest of the world blocks in negotiation")
+        elif self._early_exit_after and self._early_exit_after[-1] is not None \
+                and node.lineno > self._early_exit_after[-1]:
+            self._emit("HVD101", node,
+                       f"collective {name!r} at line {node.lineno} is only "
+                       f"reached by ranks that did not take the early "
+                       f"return/raise under the rank-divergent branch ending "
+                       f"at line {self._early_exit_after[-1]}")
+        if not any(kw.arg == "process_set" for kw in node.keywords):
+            self.collectives_without_ps.append(node)
+
+    @staticmethod
+    def _in_graph_spelling(node: ast.Call) -> bool:
+        """In-graph collectives (``ops.collectives`` riding lax.psum) are
+        jit-safe.  Recognized by an explicit ``axis_name=`` kwarg, or by the
+        conventional receiver names for that module (``C.allreduce(x)``
+        relying on the DEFAULT_AXIS default is correct in-graph code and
+        must not fire HVD107)."""
+        if any(kw.arg == "axis_name" for kw in node.keywords):
+            return True
+        func = node.func
+        if isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                          ast.Name):
+            return func.value.id in ("C", "collectives")
+        return False
+
+    # ------------------------------------------------------------ wrap-up
+    def finish(self):
+        if self.has_subgroup_sets:
+            for node in self.collectives_without_ps:
+                self._emit(
+                    "HVD102", node,
+                    f"collective {_call_name(node)!r} omits process_set= in "
+                    f"a module that registers subgroup process sets; it "
+                    f"targets the GLOBAL set — a deadlock if only subgroup "
+                    f"members reach this call")
+        if (self.has_init and self.has_training_wrapper
+                and not self.has_sync and not self.uses_elastic_state):
+            self.findings.append(Finding(
+                rule="HVD103", path=self.path,
+                line=self.first_training_line or self.init_line, col=1,
+                message="training script calls init() and reduces gradients "
+                        "but never broadcasts initial state from rank 0; "
+                        "ranks train divergent models"))
+
+
+def _suppressed_lines(source: str) -> Dict[int, Set[str]]:
+    """Map line → suppressed rule IDs from ``# hvd-lint: disable=...``."""
+    out: Dict[int, Set[str]] = {}
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in toks:
+            if tok.type == tokenize.COMMENT:
+                m = _DISABLE_RE.search(tok.string)
+                if m:
+                    ids = {s.strip().upper() for s in m.group(1).split(",")}
+                    out.setdefault(tok.start[0], set()).update(ids)
+    except (tokenize.TokenError, SyntaxError):  # pragma: no cover
+        pass
+    return out
+
+
+def lint_source(source: str, path: str = "<string>") -> List[Finding]:
+    """Lint one module's source; returns findings sorted by line."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(rule="HVD100", path=path, line=e.lineno or 1,
+                        col=(e.offset or 0) + 1,
+                        message=f"syntax error: {e.msg}",
+                        severity=Severity.ERROR,
+                        fix_hint="fix the syntax error; the linter cannot "
+                                 "analyze this file")]
+    linter = _Linter(path, source)
+    linter.visit(tree)
+    linter.finish()
+    suppressed = _suppressed_lines(source)
+    out = []
+    for f in linter.findings:
+        ids = suppressed.get(f.line, set())
+        if "ALL" in ids or f.rule in ids:
+            continue
+        out.append(f)
+    return sorted(out, key=lambda f: (f.line, f.col, f.rule))
+
+
+def lint_file(path: str) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return lint_source(fh.read(), path)
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    """Expand files/dirs to lintable files.  Directories contribute their
+    ``.py`` trees; an explicitly named file is linted regardless of suffix
+    (a suffix-less training script is still Python); a missing path raises
+    so the CLI can report a usage error instead of a clean verdict."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        elif os.path.isfile(p):
+            out.append(p)
+        else:
+            raise FileNotFoundError(f"no such file or directory: {p}")
+    return out
+
+
+def lint_paths(paths: Iterable[str]) -> List[Finding]:
+    """Lint every ``.py`` file under the given files/directories."""
+    findings: List[Finding] = []
+    for f in iter_python_files(paths):
+        findings.extend(lint_file(f))
+    return findings

@@ -1,0 +1,206 @@
+"""Finding model + rule catalog for the collective-correctness analyzer.
+
+The reference devotes C++ runtime machinery (message-table negotiation in
+``controller.cc``, the stall inspector — SURVEY.md §L2) to diagnosing ranks
+that disagree about collectives.  In the TPU rebuild most of those bugs are
+visible in the Python source or the traced jaxpr, so each known failure mode
+gets a *rule* here and the three analyzer layers (``collective_lint``,
+``trace_check``, ``runtime_sanitizer``) emit :class:`Finding` records
+against this shared catalog.
+
+This module and the linter are deliberately jax-free: the lint path only
+parses source text, so ``python -m horovod_tpu.analysis`` never executes
+user code, initializes the runtime, or touches a device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+from typing import Dict, List, Optional
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def is_package_frame(filename: str) -> bool:
+    """True when a stack frame's file belongs to the horovod_tpu package.
+
+    Shared by the ``check=`` hook's caller discovery and the runtime
+    sanitizer's call-site attribution.  Matched by path prefix, NOT
+    substring — a user's ``~/horovod_tpu/train.py`` is user code.
+    """
+    return filename == _PKG_DIR or filename.startswith(_PKG_DIR + os.sep)
+
+
+class Severity(enum.Enum):
+    ERROR = "error"      # will deadlock / corrupt numerics on some worlds
+    WARNING = "warning"  # divergence-prone; needs human judgement
+
+    def __str__(self) -> str:  # pragma: no cover - repr sugar
+        return self.value
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: Severity
+    title: str
+    rationale: str
+    fix_hint: str
+
+
+# The catalog.  IDs are stable API: suppression comments, allowlists and the
+# docs reference them.  1xx = source lint, 2xx = jaxpr trace, 3xx = runtime.
+RULES: Dict[str, Rule] = {r.id: r for r in [
+    Rule(
+        "HVD101", Severity.ERROR,
+        "collective under rank-divergent control flow",
+        "A collective inside an `if rank() == 0:`-style branch (or after an "
+        "early return taken only by some ranks) is submitted by a subset of "
+        "ranks; the peers block in negotiation forever and the job wedges "
+        "with no diagnostics — the reference's #1 stall-inspector report.",
+        "Hoist the collective out of the branch so every rank submits it, "
+        "or restrict it to a registered process_set whose members all take "
+        "the branch.",
+    ),
+    Rule(
+        "HVD102", Severity.WARNING,
+        "collective missing process_set while subgroup sets exist",
+        "Once add_process_set() carves subgroups, a collective that omits "
+        "process_set= targets the GLOBAL set.  If only the subgroup's ranks "
+        "reach the call site, the rest of the world never submits and the "
+        "job deadlocks at the readiness threshold.",
+        "Pass process_set= explicitly on every collective issued from code "
+        "paths only subgroup members execute.",
+    ),
+    Rule(
+        "HVD103", Severity.WARNING,
+        "missing broadcast_parameters after init()",
+        "Training starts from per-rank random init: without a rank-0 "
+        "broadcast of params/optimizer state after init(), ranks average "
+        "gradients of DIFFERENT models and silently diverge (reference: "
+        "Usage step 4, broadcast_parameters/broadcast_optimizer_state).",
+        "Call broadcast_parameters(...) (and broadcast_optimizer_state) "
+        "right after init(), or manage state through hvd.elastic state "
+        "sync.",
+    ),
+    Rule(
+        "HVD104", Severity.ERROR,
+        "collective ordered by set iteration",
+        "Python set iteration order is hash-randomized across processes "
+        "(PYTHONHASHSEED): each rank submits the collectives in a different "
+        "sequence, scrambling fusion-bucket order and pairing different "
+        "tensors under one negotiated name — deadlock or silent corruption.",
+        "Iterate over sorted(the_set) so every rank submits in one order.",
+    ),
+    Rule(
+        "HVD105", Severity.WARNING,
+        "collective ordered by dict iteration",
+        "Dict iteration follows insertion order, which drifts across ranks "
+        "whenever the dicts were built differently (conditionally inserted "
+        "keys, checkpoint-restored vs fresh).  Divergent submission order "
+        "scrambles fusion buckets across ranks.",
+        "Iterate over sorted(d.items()) — the reference does exactly this "
+        "for named_parameters.",
+    ),
+    Rule(
+        "HVD106", Severity.ERROR,
+        "host sync/callback inside jit",
+        "block_until_ready / io_callback / pure_callback inside a jitted "
+        "function forces a host round-trip per step (or traces to a stub): "
+        "on multi-process TPU the host sync point can interleave "
+        "differently per rank and wedge the collective schedule.",
+        "Move host syncs outside the jitted step; use jax.debug.print for "
+        "in-graph debugging.",
+    ),
+    Rule(
+        "HVD107", Severity.ERROR,
+        "eager engine collective traced under jit",
+        "hvd.allreduce()-family eager ops submit to the background engine "
+        "at TRACE time, not run time: under jit the collective runs once "
+        "during compilation and never again, so ranks diverge after the "
+        "first step (and re-traces deadlock peers).",
+        "Use the in-graph form (lax.psum / C.allreduce with axis_name "
+        "inside shard_map), or call the eager op outside jit.",
+    ),
+    Rule(
+        "HVD201", Severity.ERROR,
+        "collective over unknown mesh axis",
+        "A traced lax collective names an axis_name the surrounding mesh "
+        "does not bind; under pjit/shard_map this fails at lowering — or "
+        "worse, silently reduces over a 1-sized axis on a differently-"
+        "built mesh.",
+        "Make the collective's axis_name match an axis of the mesh the "
+        "step is shard_map'ped over.",
+    ),
+    Rule(
+        "HVD202", Severity.ERROR,
+        "axis_index_groups do not partition the axis",
+        "psum/all_gather with axis_index_groups that skip or repeat a rank "
+        "make the skipped ranks wait on a collective they never joined.",
+        "Every rank 0..axis_size-1 must appear in exactly one group.",
+    ),
+    Rule(
+        "HVD203", Severity.WARNING,
+        "host callback primitive in traced step",
+        "The traced step contains a host callback (io_callback / "
+        "pure_callback / debug_callback): per-step host round-trips "
+        "serialize the device pipeline and order differently per rank.",
+        "Keep callbacks out of the hot step; aggregate on device and "
+        "fetch outside.",
+    ),
+    Rule(
+        "HVD301", Severity.ERROR,
+        "cross-rank collective order/signature divergence",
+        "At runtime, ranks submitted different collectives (or the same "
+        "ones in different order / from different call sites) under one "
+        "negotiated sequence slot.",
+        "Inspect the two call sites named in the message; make every rank "
+        "issue the same collective sequence.",
+    ),
+    Rule(
+        "HVD302", Severity.WARNING,
+        "collective stalled waiting on laggard ranks",
+        "A submitted collective has waited past the sanitizer timeout; the "
+        "named ranks have not submitted their contribution.",
+        "Check the laggard ranks' logs for the branch they took instead; "
+        "the ledger tail in this report shows the last calls they made.",
+    ),
+]}
+
+
+@dataclasses.dataclass
+class Finding:
+    """One analyzer result, printable as ``path:line:col: ID severity msg``."""
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    severity: Optional[Severity] = None
+    fix_hint: Optional[str] = None
+
+    def __post_init__(self):
+        r = RULES.get(self.rule)
+        if self.severity is None:
+            self.severity = r.severity if r else Severity.WARNING
+        if self.fix_hint is None and r is not None:
+            self.fix_hint = r.fix_hint
+
+    @property
+    def is_error(self) -> bool:
+        return self.severity == Severity.ERROR
+
+    def render(self, show_fix: bool = True) -> str:
+        s = f"{self.path}:{self.line}:{self.col}: {self.rule} " \
+            f"{self.severity.value}: {self.message}"
+        if show_fix and self.fix_hint:
+            s += f"\n    fix: {self.fix_hint}"
+        return s
+
+
+def summarize(findings: List[Finding]) -> str:
+    errs = sum(1 for f in findings if f.is_error)
+    warns = len(findings) - errs
+    return f"{len(findings)} finding(s): {errs} error(s), {warns} warning(s)"

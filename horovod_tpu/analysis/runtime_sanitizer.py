@@ -1,0 +1,226 @@
+"""Opt-in runtime collective sanitizer (``HVD_TPU_SANITIZER=1``).
+
+The dynamic third layer of the analyzer: what the linter and trace checker
+cannot see (data-dependent branches, order decided at run time) is caught
+here, the way the reference's message-table negotiation catches it — but
+with *call-site attribution*.
+
+Mechanism:
+
+- Every entry submitted through the engine (``ops/eager.py`` →
+  ``ops/engine.py`` ``enqueue_group``) is recorded in a bounded per-rank
+  **ledger**: sequence number, wire name, signature digest, and the user
+  call site that issued it (first stack frame outside horovod_tpu).
+- Each entry is stamped with a ``sanitizer_tag`` (``seq=<i>;site=<f:l>``)
+  which the controller appends to its negotiation digest
+  (``common/controller.py _digest``).  Two ranks submitting different
+  collectives — or the same ones in a different order, or from different
+  call sites — under one negotiated name now produce a digest mismatch,
+  and the existing per-tensor NegotiationError names the divergent ranks
+  AND both call sites.  No new wire protocol; the reference's consistency
+  check does the transport.
+- The engine's stall inspector is tightened to
+  ``HVD_TPU_SANITIZER_TIMEOUT`` seconds (default 30) and, when a stall
+  fires, the report carries the ledger tail so the laggard ranks' last
+  submissions (with call sites) are visible next to the stuck tensor.
+
+Env vars:
+  HVD_TPU_SANITIZER=1          enable
+  HVD_TPU_SANITIZER_TIMEOUT=s  stall warn threshold (default 30)
+  HVD_TPU_SANITIZER_LEDGER=n   ledger capacity (default 512)
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import threading
+import traceback
+from typing import Deque, List, Optional, Sequence
+
+from .findings import is_package_frame
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+
+def enabled() -> bool:
+    return os.environ.get("HVD_TPU_SANITIZER", "").strip() in ("1", "true",
+                                                               "on", "yes")
+
+
+@dataclasses.dataclass(frozen=True)
+class LedgerEntry:
+    seq: int
+    name: str
+    digest: str
+    site: str
+
+    def render(self) -> str:
+        return f"#{self.seq} {self.name} [{self.digest}] at {self.site}"
+
+
+def _caller_site() -> str:
+    """First stack frame outside the horovod_tpu package — the user call
+    that issued the collective (``findings.is_package_frame`` decides what
+    counts as package code).  Basename only, so the tag (which rides the
+    negotiation digest) matches across ranks with different install
+    paths."""
+    for frame in reversed(traceback.extract_stack()):
+        if not is_package_frame(frame.filename):
+            return f"{os.path.basename(frame.filename)}:{frame.lineno}"
+    return "<internal>"
+
+
+class CollectiveSanitizer:
+    """Per-engine ledger recorder + digest tagger."""
+
+    def __init__(self, capacity: int = 512):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        # Sequence counters are PER PROCESS SET: subgroup collectives are
+        # legitimately submitted only by member ranks, so a single global
+        # counter would drift on non-members and every later world
+        # collective would false-positive.  Within one set, every member
+        # submits the same sequence — which is exactly what the tag checks.
+        self._seq: dict = collections.defaultdict(int)
+        self.ledger: Deque[LedgerEntry] = collections.deque(maxlen=capacity)
+
+    # ------------------------------------------------------------- recording
+    def observe(self, entries: Sequence, site: Optional[str] = None) -> None:
+        """Record and tag freshly built engine entries (pre-negotiation)."""
+        site = site or _caller_site()
+        with self._lock:
+            for e in entries:
+                ps = getattr(e, "process_set_id", 0)
+                seq = self._seq[ps]
+                self._seq[ps] = seq + 1
+                digest = self._entry_digest(e)
+                tag = f"seq={ps}:{seq};site={site}"
+                # Stamped onto the entry: the controller appends it to the
+                # negotiation digest, turning order/call-site divergence
+                # into an attributable per-tensor mismatch error.
+                e.sanitizer_tag = tag
+                self.ledger.append(LedgerEntry(
+                    seq=seq, name=e.name, digest=digest, site=site))
+
+    def rollback(self, entries: Sequence) -> None:
+        """Undo :meth:`observe` for entries whose queue push was rejected
+        (rank-local duplicate-name error): peers never see them, so their
+        seq advances must not stand.  Entries are unwound newest-first;
+        if another thread observed in between (non-contiguous counter),
+        the unwind stops and a warning notes the possible skew."""
+        with self._lock:
+            for e in reversed(list(entries)):
+                tag = getattr(e, "sanitizer_tag", "")
+                try:
+                    ps_s, seq_s = tag.split(";", 1)[0][len("seq="):].split(":")
+                    ps, seq = int(ps_s), int(seq_s)
+                except (ValueError, IndexError):  # pragma: no cover
+                    continue
+                if self._seq[ps] == seq + 1:
+                    self._seq[ps] = seq
+                    if self.ledger and self.ledger[-1].seq == seq \
+                            and self.ledger[-1].name == e.name:
+                        self.ledger.pop()
+                else:
+                    log.warning(
+                        "sanitizer: cannot roll back seq %d:%d for %r "
+                        "(concurrent submissions interleaved); cross-rank "
+                        "seq tags may skew from here", ps, seq, e.name)
+                    break
+
+    def observe_synthesized(self, entry) -> None:
+        """Account for an entry synthesized while this rank is JOINED
+        (engine._synthesize_join_entry): the peer advanced its counter by
+        submitting, so this rank must too, or every post-join collective
+        would mismatch on seq.  Synthesized entries are never announced, so
+        the tag itself doesn't hit the wire — only the counter matters."""
+        self.observe([entry], site="<joined:synthesized>")
+
+    @staticmethod
+    def _entry_digest(e) -> str:
+        t = getattr(e, "tensor", None)
+        ct = getattr(e, "ctype", None)
+        parts = [getattr(ct, "value", "op")]
+        if t is not None:
+            shape = tuple(t.shape[1:]) if len(t.shape) else ()
+            parts += [str(t.dtype), str(shape)]
+        op = getattr(e, "reduce_op", None)
+        if op is not None:
+            parts.append(op.name)
+        return "|".join(parts)
+
+    # ------------------------------------------------------------- reporting
+    def tail(self, n: int = 8) -> List[LedgerEntry]:
+        with self._lock:
+            return list(self.ledger)[-n:]
+
+    def render_tail(self, n: int = 8) -> str:
+        entries = self.tail(n)
+        if not entries:
+            return "(collective ledger empty)"
+        return "last submissions on this rank:\n  " + \
+            "\n  ".join(e.render() for e in entries)
+
+
+class SanitizerStallInspector:
+    """Drop-in wrapper for the engine's StallInspector: tightened timeout,
+    ledger-tail attribution on every stall report (HVD302), laggard rank
+    names passed through from negotiation."""
+
+    def __init__(self, inner, sanitizer: CollectiveSanitizer,
+                 warn_after_s: float):
+        self._inner = inner
+        self._sanitizer = sanitizer
+        # The sanitizer timeout is authoritative in BOTH directions: the
+        # README documents HVD_TPU_SANITIZER_TIMEOUT as the stall-report
+        # threshold, so raising it past HOROVOD_STALL_CHECK_TIME must work
+        # (slow first steps), not silently clamp to the smaller value.
+        self._inner.warn_after_s = warn_after_s
+        # An explicit HOROVOD_STALL_CHECK_DISABLE wins: the sanitizer then
+        # provides ledger/digest checks only, no stall policing.
+        if inner.disabled:
+            log.info("sanitizer: stall reporting stays OFF "
+                     "(HOROVOD_STALL_CHECK_DISABLE is set)")
+        # Mirrored so the engine's config reads keep working.
+        self.warn_after_s = self._inner.warn_after_s
+        self.shutdown_after_s = inner.shutdown_after_s
+        self.disabled = inner.disabled
+
+    def check(self, waiting, missing_ranks=None):
+        before = set(self._inner._warned)
+        try:
+            self._inner.check(waiting, missing_ranks)
+        except RuntimeError as exc:
+            raise RuntimeError(
+                f"{exc}\nHVD302 sanitizer: {self._sanitizer.render_tail()}"
+            ) from None
+        newly = set(self._inner._warned) - before
+        if newly:
+            tags = {e.name: getattr(e, "sanitizer_tag", "") for e in waiting}
+            for name in sorted(newly):
+                site = tags.get(name, "")
+                site = site.split("site=", 1)[1] if "site=" in site else "?"
+                log.warning(
+                    "HVD302 sanitizer: collective %r (submitted at %s) is "
+                    "stalled%s; %s", name, site,
+                    (f" waiting on ranks {missing_ranks[name]}"
+                     if missing_ranks and name in missing_ranks else ""),
+                    self._sanitizer.render_tail())
+
+
+def maybe_install(engine) -> Optional[CollectiveSanitizer]:
+    """Attach a sanitizer to a freshly built CollectiveEngine when the env
+    opts in; returns it (or None).  Called from the engine constructor so
+    every init()'d runtime — JAX, torch or TF binding — is covered."""
+    if not enabled():
+        return None
+    capacity = int(os.environ.get("HVD_TPU_SANITIZER_LEDGER", "512") or 512)
+    timeout = float(os.environ.get("HVD_TPU_SANITIZER_TIMEOUT", "30") or 30)
+    sanitizer = CollectiveSanitizer(capacity=capacity)
+    engine.stall = SanitizerStallInspector(engine.stall, sanitizer, timeout)
+    log.info("collective sanitizer enabled (timeout=%.1fs, ledger=%d)",
+             timeout, capacity)
+    return sanitizer

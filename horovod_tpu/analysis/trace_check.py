@@ -1,0 +1,237 @@
+"""Jaxpr-level collective checker (rules HVD201–HVD203).
+
+The static analogue of the controller's negotiation in
+``common/controller.py``: trace a step function (under its real mesh or an
+abstract stand-in) and build a **collective ledger** — the ordered sequence
+of (primitive, axes, shape, dtype) every rank will execute.  Because SPMD
+traces once for all ranks, the ledger is consistent by construction; what
+can still go wrong statically is checked here:
+
+- HVD201: a collective names an ``axis_name`` no enclosing mesh binds;
+- HVD202: ``axis_index_groups`` that do not partition the axis;
+- HVD203: host-callback primitives buried in the traced step.
+
+``compare_ledgers`` diffs two ledgers (e.g. a refactored step against the
+golden one, or per-process ledgers recorded by the runtime sanitizer) and
+names the first divergence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .findings import Finding
+
+# Primitive names that move data across mesh axes.
+COLLECTIVE_PRIMITIVES = {
+    "psum", "psum2", "pmin", "pmax", "ppermute", "pbroadcast",
+    "all_gather", "all_to_all", "reduce_scatter", "psum_scatter",
+}
+# Reads rank identity; tracked in the ledger (order matters for fusion) but
+# moves no bytes.
+INDEX_PRIMITIVES = {"axis_index"}
+# Host-callback primitives (HVD203).
+CALLBACK_PRIMITIVES = {
+    "pure_callback", "io_callback", "debug_callback", "outside_call",
+    "host_callback_call",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveRecord:
+    """One traced collective: the static twin of the controller digest."""
+    index: int
+    primitive: str
+    axes: Tuple[str, ...]
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[str, ...]
+    axis_index_groups: Optional[Tuple[Tuple[int, ...], ...]] = None
+
+    def digest(self) -> str:
+        """Signature string, comparable across ranks/versions — the same
+        role the controller's ``_digest`` plays on the wire."""
+        return "|".join([self.primitive, ",".join(self.axes),
+                         str(self.shapes), str(self.dtypes),
+                         str(self.axis_index_groups)])
+
+
+@dataclasses.dataclass
+class TraceReport:
+    ledger: List[CollectiveRecord]
+    findings: List[Finding]
+    bound_axes: Dict[str, int]
+
+    @property
+    def ok(self) -> bool:
+        return not any(f.is_error for f in self.findings)
+
+
+def _normalize_axes(val: Any) -> Tuple[str, ...]:
+    if val is None:
+        return ()
+    if isinstance(val, (tuple, list)):
+        return tuple(str(a) for a in val if isinstance(a, (str,)) or a)
+    return (str(val),)
+
+
+def _named_axes(val: Any) -> Tuple[str, ...]:
+    """Keep only *named* axes: psum over positional ints (vmapped axes)
+    moves nothing across the mesh."""
+    if val is None:
+        return ()
+    vals = val if isinstance(val, (tuple, list)) else [val]
+    return tuple(a for a in vals if isinstance(a, str))
+
+
+def _sub_jaxprs(params: Dict[str, Any]):
+    """Yield (jaxpr, extra_bound_axes) for every sub-jaxpr in an eqn's
+    params — pjit/closed_call carry ClosedJaxprs, scan/while/cond carry them
+    in lists, shard_map carries its mesh (which binds new axes)."""
+    extra: Dict[str, int] = {}
+    mesh = params.get("mesh")
+    if mesh is not None and hasattr(mesh, "shape"):
+        try:
+            extra = dict(mesh.shape)
+        except Exception:  # pragma: no cover - exotic mesh types
+            extra = {}
+    axis_name = params.get("axis_name")
+    if axis_name is not None and "global_axis_size" in params:  # pmap
+        for a in _normalize_axes(axis_name):
+            extra[a] = params.get("global_axis_size") or 0
+    for v in params.values():
+        items = v if isinstance(v, (tuple, list)) else [v]
+        for item in items:
+            if hasattr(item, "eqns"):                      # raw Jaxpr
+                yield item, extra
+            elif hasattr(item, "jaxpr") and hasattr(item.jaxpr, "eqns"):
+                yield item.jaxpr, extra                    # ClosedJaxpr
+
+
+def _walk(jaxpr, bound: Dict[str, int], ledger: List[CollectiveRecord],
+          findings: List[Finding], path: str):
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        params = eqn.params
+        if name in COLLECTIVE_PRIMITIVES or name in INDEX_PRIMITIVES:
+            axes = _named_axes(params.get("axes",
+                                          params.get("axis_name")))
+            shapes = tuple(tuple(getattr(v.aval, "shape", ()))
+                           for v in eqn.invars if hasattr(v, "aval"))
+            dtypes = tuple(str(getattr(v.aval, "dtype", "?"))
+                           for v in eqn.invars if hasattr(v, "aval"))
+            groups = params.get("axis_index_groups")
+            groups_t = tuple(tuple(g) for g in groups) if groups else None
+            rec = CollectiveRecord(index=len(ledger), primitive=name,
+                                   axes=axes, shapes=shapes, dtypes=dtypes,
+                                   axis_index_groups=groups_t)
+            ledger.append(rec)
+            for ax in axes:
+                if ax not in bound:
+                    findings.append(Finding(
+                        rule="HVD201", path=path, line=rec.index, col=1,
+                        message=f"collective #{rec.index} ({name}) reduces "
+                                f"over axis {ax!r}, but the mesh only binds "
+                                f"axes {sorted(bound)} — this fails at "
+                                f"lowering or silently no-ops"))
+            if groups_t is not None and axes:
+                ax = axes[0]
+                size = bound.get(ax)
+                if size:
+                    flat = [r for g in groups_t for r in g]
+                    if sorted(flat) != list(range(size)):
+                        findings.append(Finding(
+                            rule="HVD202", path=path, line=rec.index, col=1,
+                            message=f"collective #{rec.index} ({name}) has "
+                                    f"axis_index_groups {groups_t} which do "
+                                    f"not partition axis {ax!r} of size "
+                                    f"{size}: ranks left out of every group "
+                                    f"wait forever"))
+        elif name in CALLBACK_PRIMITIVES:
+            findings.append(Finding(
+                rule="HVD203", path=path, line=len(ledger), col=1,
+                message=f"host callback primitive {name!r} inside the "
+                        f"traced step (after collective #{len(ledger) - 1})"))
+        for sub, extra in _sub_jaxprs(params):
+            inner = dict(bound)
+            inner.update(extra)
+            _walk(sub, inner, ledger, findings, path)
+
+
+def check_step_fn(fn, *example_args, mesh=None,
+                  axis_sizes: Optional[Dict[str, int]] = None,
+                  path: str = "<trace>") -> TraceReport:
+    """Trace ``fn(*example_args)`` and audit its collective ledger.
+
+    ``mesh``: the Mesh the step runs under (optional if fn contains its own
+    shard_map, whose mesh binds the axes).  ``axis_sizes``: extra name→size
+    bindings, for step fns written to run under an outer pmap/shard_map
+    supplied elsewhere.  Example args may be arrays or ShapeDtypeStructs —
+    tracing is abstract, nothing executes.
+    """
+    import jax
+
+    bound: Dict[str, int] = {}
+    if mesh is not None and hasattr(mesh, "shape"):
+        bound.update(dict(mesh.shape))
+    if axis_sizes:
+        bound.update(axis_sizes)
+
+    findings: List[Finding] = []
+    # Only the explicitly-requested outer bindings go into the trace's
+    # axis_env: mesh axes are bound by the step's own shard_map — binding
+    # them twice would shadow/collide.
+    axis_env = list(axis_sizes.items()) if axis_sizes else None
+    try:
+        closed = jax.make_jaxpr(fn, axis_env=axis_env)(*example_args)
+    except NameError as e:
+        # lax collectives raise NameError("unbound axis name: ...") at
+        # trace time — the step names an axis neither the mesh nor any
+        # inner shard_map binds.
+        findings.append(Finding(
+            rule="HVD201", path=path, line=0, col=1,
+            message=f"step references an axis no mesh binds "
+                    f"(bound: {sorted(bound)}): {e}"))
+        return TraceReport(ledger=[], findings=findings, bound_axes=bound)
+    except Exception as e:  # surface trace failures as findings, not crashes
+        findings.append(Finding(
+            rule="HVD201", path=path, line=0, col=1,
+            message=f"step function failed to trace: {type(e).__name__}: "
+                    f"{e}"))
+        return TraceReport(ledger=[], findings=findings, bound_axes=bound)
+
+    ledger: List[CollectiveRecord] = []
+    _walk(closed.jaxpr, bound, ledger, findings, path)
+    return TraceReport(ledger=ledger, findings=findings, bound_axes=bound)
+
+
+def compare_ledgers(a: Sequence[CollectiveRecord],
+                    b: Sequence[CollectiveRecord],
+                    names: Tuple[str, str] = ("rank A", "rank B"),
+                    path: str = "<ledger>") -> List[Finding]:
+    """Diff two collective ledgers; findings name the first divergence.
+
+    The offline twin of the controller's per-tensor digest mismatch check:
+    run it over ledgers recorded by the runtime sanitizer, or over two
+    traced variants of a step that must stay wire-compatible.
+    """
+    findings: List[Finding] = []
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        if ra.digest() != rb.digest():
+            findings.append(Finding(
+                rule="HVD301", path=path, line=i, col=1,
+                message=f"ledgers diverge at collective #{i}: "
+                        f"{names[0]} submitted {ra.digest()} but "
+                        f"{names[1]} submitted {rb.digest()}"))
+            break
+    else:
+        if len(a) != len(b):
+            longer, shorter = (names[0], names[1]) if len(a) > len(b) \
+                else (names[1], names[0])
+            extra = (a if len(a) > len(b) else b)[min(len(a), len(b))]
+            findings.append(Finding(
+                rule="HVD301", path=path, line=min(len(a), len(b)), col=1,
+                message=f"{longer} submitted {abs(len(a) - len(b))} more "
+                        f"collective(s) than {shorter}, starting with "
+                        f"{extra.digest()} — {shorter} will block forever"))
+    return findings

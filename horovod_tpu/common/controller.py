@@ -100,7 +100,12 @@ class TCPController:
                 full.append(a)
                 # Bounded alongside the server's cap: digest-churning
                 # workloads stop learning ids instead of growing forever.
+                # Sanitizer-tagged digests (";site=") carry a per-submission
+                # seq and so NEVER repeat — learning ids for them would only
+                # fill both maps with dead entries; skip (the sanitizer is a
+                # debug mode: full announces are its accepted overhead).
                 if (not n.startswith("\x1f")
+                        and ";site=" not in digest
                         and len(self._awaiting_assign) < 65536
                         and len(self._cache_ids) < 65536):
                     self._awaiting_assign.add((n, digest, required, datadep))
@@ -216,6 +221,15 @@ class TCPController:
         # separate `group` field, outside the mismatch comparison.
         parts.append(str(getattr(e, "prescale_factor", None)))
         parts.append(str(getattr(e, "postscale_factor", None)))
+        # Sanitizer mode (HVD_TPU_SANITIZER=1): the per-entry seq/call-site
+        # tag rides the digest, so ranks submitting different collectives
+        # under one negotiated name — or the same ones in divergent order —
+        # fail the existing mismatch check with call-site attribution.
+        # Appended LAST: joined ranks parse digest fields positionally in
+        # _synthesize_join_entry and ignore trailing parts.
+        tag = getattr(e, "sanitizer_tag", None)
+        if tag:
+            parts.append(tag)
         return "|".join(parts)
 
     @staticmethod

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax import lax
+from ..compat import axis_size as compat_axis_size
 
 from .compression import Compression
 from ..ops import collectives as C
@@ -37,7 +38,7 @@ from ..common.process_sets import ProcessSet
 def _axis_in_scope(axis_name) -> bool:
     """True when `axis_name` is bound by an enclosing shard_map/pmap trace."""
     try:
-        lax.axis_size(axis_name)
+        compat_axis_size(axis_name)
         return True
     except NameError:
         return False
@@ -112,6 +113,7 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          backward_passes_per_step: int = 1,
                          axis_name: str = C.DEFAULT_AXIS,
                          process_set: Optional[ProcessSet] = None,
+                         check=False,
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with cross-rank gradient averaging.
 
@@ -125,8 +127,15 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     accumulate locally and the (single) allreduce happens every k-th step.
     ``named_parameters`` is accepted for API parity and unused (pytrees are
     self-describing).
+
+    ``check=True`` lints the calling script for deadlock-prone collective
+    patterns at wrap time (``check="strict"`` raises on errors) — see
+    ``horovod_tpu.analysis`` and docs/analysis.md.
     """
     del named_parameters
+    if check:
+        from ..analysis.hooks import run_check_hook
+        run_check_hook(check)
     if process_set is not None:
         axis_name = process_set.axis_name
     k = backward_passes_per_step

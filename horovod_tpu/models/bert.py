@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from ..compat import axis_size as compat_axis_size
 
 from ..parallel.ring_attention import local_flash_attention
 from ..parallel.ulysses import ulysses_attention
@@ -115,14 +116,14 @@ def _layernorm(x, scale, bias, eps=1e-5):
 
 def _attention(x, p, cfg: BertConfig):
     B, T, D = x.shape
-    tp = lax.axis_size(cfg.tp_axis) if cfg.tp_axis else 1
+    tp = compat_axis_size(cfg.tp_axis) if cfg.tp_axis else 1
     if cfg.n_heads % tp:
         raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp}")
     H_loc, Hd = cfg.n_heads // tp, cfg.head_dim
     q = (x @ p["wq"]).reshape(B, T, H_loc, Hd)
     k = (x @ p["wk"]).reshape(B, T, H_loc, Hd)
     v = (x @ p["wv"]).reshape(B, T, H_loc, Hd)
-    sp = lax.axis_size(cfg.sp_axis) if cfg.sp_axis else 1
+    sp = compat_axis_size(cfg.sp_axis) if cfg.sp_axis else 1
     if sp > 1:
         # ulysses_attention itself routes to the pallas kernel on TPU.
         out = ulysses_attention(q, k, v, axis_name=cfg.sp_axis, causal=False)
@@ -182,7 +183,7 @@ def mlm_loss_fn(params, tokens, targets, mask, cfg: BertConfig):
             denom = lax.psum(denom, ax)
     denom = jnp.maximum(denom, 1.0)
     if cfg.tp_axis:
-        denom = denom * lax.axis_size(cfg.tp_axis)
+        denom = denom * compat_axis_size(cfg.tp_axis)
     return local_sum / denom
 
 

@@ -22,6 +22,7 @@ import numpy as np
 import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from ..compat import axis_size as compat_axis_size
 
 
 @dataclasses.dataclass(frozen=True)
@@ -103,7 +104,7 @@ def _embedding_exchange(tables_local, sparse_ids, cfg: DLRMConfig):
 
     tables_local: [n_tables/ep, rows, dim]; sparse_ids: [B_loc, n_tables].
     """
-    ep = lax.axis_size(cfg.ep_axis) if cfg.ep_axis else 1
+    ep = compat_axis_size(cfg.ep_axis) if cfg.ep_axis else 1
     t_loc = tables_local.shape[0]
     if not cfg.ep_axis or ep == 1:
         looked = jax.vmap(lambda tbl, ids: tbl[ids], in_axes=(0, 1),
@@ -139,7 +140,7 @@ def loss_fn(params, dense, sparse_ids, labels, cfg: DLRMConfig):
     denom = float(bce.size)
     for ax in (cfg.dp_axis, cfg.ep_axis):
         if ax:
-            denom = denom * lax.axis_size(ax)
+            denom = denom * compat_axis_size(ax)
     return jnp.sum(bce) / denom
 
 

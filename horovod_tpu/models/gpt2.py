@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from ..compat import axis_size as compat_axis_size
 
 
 @dataclasses.dataclass(frozen=True)
@@ -133,7 +134,7 @@ def _attention(x, p, cfg: GPT2Config):
     from ..parallel.ring_attention import local_flash_attention
 
     B, T, D = x.shape
-    tp = lax.axis_size(cfg.tp_axis) if cfg.tp_axis else 1
+    tp = compat_axis_size(cfg.tp_axis) if cfg.tp_axis else 1
     if cfg.n_heads % tp:
         raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp}")
     H_loc, Hd = cfg.n_heads // tp, cfg.head_dim
@@ -185,7 +186,7 @@ def loss_fn(params, tokens, targets, cfg: GPT2Config):
     if cfg.dp_axis:
         denom = lax.psum(denom, cfg.dp_axis)
     if cfg.tp_axis:
-        denom = denom * lax.axis_size(cfg.tp_axis)
+        denom = denom * compat_axis_size(cfg.tp_axis)
     return local_sum / denom
 
 

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from ..compat import axis_size as compat_axis_size
 
 from ..parallel.ring_attention import (NEG_INF, local_flash_attention,
                                        ring_attention)
@@ -345,7 +346,7 @@ def _qkv(x, p, cfg: LlamaConfig, positions):
     by training attention, blockwise prefill and decode_step so the
     three paths cannot drift (tp head split, rope on q and k)."""
     B, T, _ = x.shape
-    tp = lax.axis_size(cfg.tp_axis) if cfg.tp_axis else 1
+    tp = compat_axis_size(cfg.tp_axis) if cfg.tp_axis else 1
     if cfg.n_heads % tp or cfg.n_kv_heads % tp:
         raise ValueError(f"n_heads={cfg.n_heads}/n_kv_heads={cfg.n_kv_heads} "
                          f"must be divisible by tp={tp}")
@@ -383,7 +384,7 @@ def _attention(x, p, cfg: LlamaConfig, positions):
     """Self-attention on the local tp shard of heads; sp-ring over sequence."""
     q, kk, v = _qkv(x, p, cfg, positions)
 
-    sp = lax.axis_size(cfg.sp_axis) if cfg.sp_axis else 1
+    sp = compat_axis_size(cfg.sp_axis) if cfg.sp_axis else 1
     if sp > 1 and cfg.sliding_window:
         raise ValueError(
             "sliding_window composes with dp/tp/pp/ep but not (yet) with "
@@ -547,14 +548,14 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig, rng=None):
     axes_denom = 1.0
     for ax in cfg.all_axes:
         if ax:
-            axes_denom = axes_denom * lax.axis_size(ax)
+            axes_denom = axes_denom * compat_axis_size(ax)
     nll_sum = jnp.sum(nll)
     if cfg.pp_axis and cfg.pp_loss == "last_stage":
         # Only the final stage's pipeline output is real (no activation
         # broadcast); mask the garbage nll elsewhere and undo pp's share
         # of the redundancy factor — the loss is no longer computed pp×
         # redundantly, it exists once.
-        pp_n = lax.axis_size(cfg.pp_axis)
+        pp_n = compat_axis_size(cfg.pp_axis)
         is_last = (lax.axis_index(cfg.pp_axis) == pp_n - 1)
         nll_sum = jnp.where(is_last, nll_sum, 0.0) * pp_n
     total = nll_sum / (denom * axes_denom)
@@ -566,7 +567,7 @@ def loss_fn(params, tokens, targets, cfg: LlamaConfig, rng=None):
         # routers — so pp's factor must not divide them.
         aux_denom = axes_denom
         if cfg.pp_axis:
-            aux_denom = aux_denom / lax.axis_size(cfg.pp_axis)
+            aux_denom = aux_denom / compat_axis_size(cfg.pp_axis)
         router_losses = (cfg.aux_weight * router[0]
                          + cfg.router_z_weight * router[1])
         total = total + (router_losses / cfg.n_layers) / aux_denom
@@ -645,11 +646,11 @@ def init_cache(cfg: LlamaConfig, batch: int, max_seq: Optional[int] = None,
         # (which keys on the axis name being bound at trace time).
         if sharded is None:
             try:
-                tp = lax.axis_size(cfg.tp_axis)
+                tp = compat_axis_size(cfg.tp_axis)
             except NameError:       # axis unbound → outside shard_map
                 tp = 1
         else:
-            tp = lax.axis_size(cfg.tp_axis) if sharded else 1
+            tp = compat_axis_size(cfg.tp_axis) if sharded else 1
         if cfg.n_kv_heads % tp:
             raise ValueError(f"n_kv_heads={cfg.n_kv_heads} must divide "
                              f"by tp={tp} for the sharded cache")

@@ -15,8 +15,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import lax, shard_map
+from jax import lax
+
+from ..compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+from ..compat import axis_size as compat_axis_size
 
 
 def init_params(key, dtype=jnp.float32):
@@ -62,7 +65,7 @@ def loss_fn(params, x, y, axis_name: Optional[str] = "hvd"):
     nll = -jnp.take_along_axis(logp, y[:, None], axis=-1)[:, 0]
     denom = float(nll.size)
     if axis_name:
-        denom = denom * lax.axis_size(axis_name)
+        denom = denom * compat_axis_size(axis_name)
     return jnp.sum(nll) / denom
 
 

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from ..compat import axis_size as compat_axis_size
 
 
 @dataclasses.dataclass(frozen=True)
@@ -218,7 +219,7 @@ def moe_ffn(x, params, cfg: MoEConfig,
     # [E, C, D] expert buffers (einsum dispatch — MXU, no scatter).
     buf = jnp.einsum("sec,sd->ecd", dispatch.astype(x.dtype), x)
 
-    ep = lax.axis_size(cfg.ep_axis) if cfg.ep_axis else 1
+    ep = compat_axis_size(cfg.ep_axis) if cfg.ep_axis else 1
     if ep > 1:
         if E % ep:
             raise ValueError(f"n_experts={E} must divide by ep={ep}")
@@ -312,7 +313,7 @@ def lm_loss(params, tokens, targets, cfg: MoELMConfig,
     denom = float(nll.size)
     for ax in (cfg.dp_axis, cfg.moe.ep_axis):
         if ax:
-            denom = denom * lax.axis_size(ax)
+            denom = denom * compat_axis_size(ax)
     router_losses = (cfg.aux_weight * aux_total
                      + cfg.moe.router_z_weight * z_total)
     return (jnp.sum(nll) + router_losses * float(nll.size)) / denom

@@ -18,8 +18,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import lax, shard_map
+from jax import lax
+
+from ..compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+from ..compat import axis_size as compat_axis_size
 
 BLOCKS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3),
           101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
@@ -108,7 +111,7 @@ def _batch_norm(x, bn, stats, cfg: ResNetConfig, train: bool):
         mean = jnp.mean(xf, axis=axes)
         mean2 = jnp.mean(jnp.square(xf), axis=axes)
         if cfg.sync_bn_axis:
-            n = lax.axis_size(cfg.sync_bn_axis)
+            n = compat_axis_size(cfg.sync_bn_axis)
             mean = lax.psum(mean, cfg.sync_bn_axis) / n
             mean2 = lax.psum(mean2, cfg.sync_bn_axis) / n
         var = mean2 - jnp.square(mean)
@@ -181,7 +184,7 @@ def loss_fn(params, stats, images, labels, cfg: ResNetConfig,
     nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
     denom = float(nll.size)
     if axis_name:
-        denom = denom * lax.axis_size(axis_name)
+        denom = denom * compat_axis_size(axis_name)
     return jnp.sum(nll) / denom, new_stats
 
 

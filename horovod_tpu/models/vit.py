@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from ..compat import axis_size as compat_axis_size
 
 from . import bert as _bert
 
@@ -177,7 +178,7 @@ def loss_fn(params, images, labels, cfg: ViTConfig):
     if cfg.dp_axis:
         denom = lax.psum(denom, cfg.dp_axis)
     if cfg.tp_axis:
-        denom = denom * lax.axis_size(cfg.tp_axis)
+        denom = denom * compat_axis_size(cfg.tp_axis)
     return local_sum / denom
 
 

@@ -21,6 +21,7 @@ from typing import Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 from jax import lax
+from ..compat import axis_size as compat_axis_size
 
 AxisName = Union[str, Sequence[str]]
 DEFAULT_AXIS = "hvd"
@@ -50,7 +51,7 @@ Product = ReduceOp.PRODUCT
 
 
 def axis_size(axis_name: AxisName = DEFAULT_AXIS):
-    return lax.axis_size(axis_name)
+    return compat_axis_size(axis_name)
 
 
 def axis_rank(axis_name: AxisName = DEFAULT_AXIS):
@@ -82,7 +83,7 @@ def allreduce(x, op: ReduceOp = ReduceOp.AVERAGE,
     if op in (ReduceOp.AVERAGE, ReduceOp.SUM):
         out = lax.psum(x, axis_name)
         if op == ReduceOp.AVERAGE:
-            n = lax.axis_size(axis_name)
+            n = compat_axis_size(axis_name)
             out = out / jnp.asarray(n, dtype=out.dtype) if jnp.issubdtype(
                 out.dtype, jnp.floating) else out // n
     elif op == ReduceOp.MIN:
@@ -116,7 +117,7 @@ def grouped_allreduce(xs, op: ReduceOp = ReduceOp.AVERAGE,
     if op in (ReduceOp.AVERAGE, ReduceOp.SUM):
         outs = lax.psum(tuple(xs), axis_name)
         if op == ReduceOp.AVERAGE:
-            n = lax.axis_size(axis_name)
+            n = compat_axis_size(axis_name)
             outs = tuple(o / jnp.asarray(n, o.dtype) for o in outs)
     else:
         outs = tuple(allreduce(x, op=op, axis_name=axis_name) for x in xs)
@@ -174,7 +175,7 @@ def reducescatter(x, op: ReduceOp = ReduceOp.SUM,
         raise ValueError("reducescatter supports SUM and AVERAGE")
     out = lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
     if op == ReduceOp.AVERAGE:
-        out = out / jnp.asarray(lax.axis_size(axis_name), out.dtype)
+        out = out / jnp.asarray(compat_axis_size(axis_name), out.dtype)
     return out
 
 
@@ -190,7 +191,7 @@ def ppermute(x, perm, axis_name: AxisName = DEFAULT_AXIS):
 
 def neighbor_shift(x, shift: int = 1, axis_name: AxisName = DEFAULT_AXIS):
     """Shift values around the ring by ``shift`` positions (wrapping)."""
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm=perm)
 

@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..compat import shard_map
 
 from . import collectives as C
 from ..utils.logging import get_logger
@@ -266,6 +266,14 @@ class CollectiveEngine:
         # Cached off the hot dispatch path (engine is built after the jax
         # world forms): >1 ⇒ eager ops need the negotiation controller.
         self._world_processes = jax.process_count()
+        # Opt-in runtime collective sanitizer (HVD_TPU_SANITIZER=1):
+        # records the per-rank submission ledger and stamps entries with
+        # seq/call-site tags the controller folds into its negotiation
+        # digest, so cross-rank order divergence fails fast with call-site
+        # attribution (analysis/runtime_sanitizer.py).  May replace
+        # self.stall with a tightened, ledger-reporting inspector.
+        from ..analysis import runtime_sanitizer as _rts
+        self.sanitizer = _rts.maybe_install(self)
         self.autotuner = None        # reference N9 parameter manager
         if cfg.autotune:
             from .autotune import ParameterManager
@@ -319,6 +327,11 @@ class CollectiveEngine:
         for kw in items:
             handle = next(self._handle_counter)
             entries.append(TensorTableEntry(handle=handle, **kw))
+        if self.sanitizer is not None:
+            # BEFORE the push: the cycle thread may drain a pushed entry
+            # within microseconds, and an untagged digest racing a tagged
+            # peer announce would be a false mismatch.
+            self.sanitizer.observe(entries)
         with self._handles_lock:
             for e in entries:
                 self._handles[e.handle] = e
@@ -328,6 +341,11 @@ class CollectiveEngine:
             with self._handles_lock:
                 for e in entries:
                     self._handles.pop(e.handle, None)
+            if self.sanitizer is not None:
+                # Duplicate-name rejection is rank-local: peers never see
+                # these entries, so the advanced seq counters must be
+                # rolled back or every later tag skews cross-rank.
+                self.sanitizer.rollback(entries)
             raise
         tl = self._state.timeline
         if tl is not None:
@@ -579,9 +597,14 @@ class CollectiveEngine:
         handle = next(self._handle_counter)
         now = time.monotonic()   # fresh age: must not trip the stall check
         if digest == "barrier":
-            return TensorTableEntry(handle=handle, name=name,
-                                    ctype=CollectiveType.BARRIER, tensor=None,
-                                    enqueue_time=now)
+            e = TensorTableEntry(handle=handle, name=name,
+                                 ctype=CollectiveType.BARRIER, tensor=None,
+                                 enqueue_time=now)
+            if self.sanitizer is not None:
+                # The peer advanced its per-set seq by submitting; advance
+                # ours too or every post-join collective mismatches on seq.
+                self.sanitizer.observe_synthesized(e)
+            return e
         parts = digest.split("|")
         ctype = CollectiveType(parts[0])
         try:
@@ -604,10 +627,13 @@ class CollectiveEngine:
         shards = [jax.device_put(fill, d) for d in local_devs]
         arr = jax.make_array_from_single_device_arrays(
             (ps.size(),) + shape, sharding, shards)
-        return TensorTableEntry(
+        e = TensorTableEntry(
             handle=handle, name=name, ctype=ctype, tensor=arr, reduce_op=op,
             root_rank=root, prescale_factor=pre, postscale_factor=post,
             group_id=group_id, donate=True, enqueue_time=now)
+        if self.sanitizer is not None:
+            self.sanitizer.observe_synthesized(e)
+        return e
 
     def _hier_mesh(self, ps_id: int):
         """2-D (cross, local) mesh for two-level collectives, or None.

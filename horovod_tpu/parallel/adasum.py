@@ -28,6 +28,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
+from ..compat import axis_size as compat_axis_size
 
 
 def _dots(a, b):
@@ -72,7 +73,7 @@ def _tree_reduce(stack, n):
 
 def adasum_allreduce(x, axis_name="hvd"):
     """Adasum allreduce usable inside shard_map/jit (any world size)."""
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     g = lax.all_gather(x, axis_name)  # [n, ...]
     return _tree_reduce(g, n)
 
@@ -129,7 +130,7 @@ def adasum_allreduce_hd(x, axis_name="hvd", bit_order=None, eps=1e-30):
     each round exchanges over, so rounds walk physical torus axes
     innermost-first; default is the identity order.
     """
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     # Static world size: shard_map gives a concrete int at trace time.
     n_static = int(n) if not isinstance(n, int) else n
     if n_static & (n_static - 1):

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 from jax import lax
+from ..compat import axis_size as compat_axis_size
 
 
 def hierarchical_allreduce(x, cross_axis: str = "cross",
@@ -23,7 +24,7 @@ def hierarchical_allreduce(x, cross_axis: str = "cross",
                            average: bool = False):
     """Two-level allreduce; call inside shard_map over a 2-D mesh."""
     orig_shape, orig_dtype = x.shape, x.dtype
-    n_local = lax.axis_size(local_axis)
+    n_local = compat_axis_size(local_axis)
     flat = x.reshape(-1)
     pad = (-flat.shape[0]) % n_local
     if pad:
@@ -38,6 +39,6 @@ def hierarchical_allreduce(x, cross_axis: str = "cross",
         full = full[:-pad]
     out = full.reshape(orig_shape)
     if average:
-        world = n_local * lax.axis_size(cross_axis)
+        world = n_local * compat_axis_size(cross_axis)
         out = out / jnp.asarray(world, out.dtype)
     return out.astype(orig_dtype)

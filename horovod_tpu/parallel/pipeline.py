@@ -32,6 +32,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
+from ..compat import axis_size as compat_axis_size
 
 
 def stage_index(axis_name: str = "pp"):
@@ -75,7 +76,7 @@ def pipeline_apply(fn: Callable, stage_params, micro_x,
     """
     if remat:
         fn = jax.checkpoint(fn)
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     m_total = micro_x.shape[0]
     ticks = m_total + n - 1

@@ -25,6 +25,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from ..compat import axis_size as compat_axis_size
 
 NEG_INF = -1e30
 
@@ -90,7 +91,7 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
         rep = q.shape[2] // k.shape[2]
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
@@ -182,7 +183,7 @@ def _ring_flash_forward(qb, kb, vb, axis_name, causal, scale, block_q,
     identity otherwise.  Normalized per-block (o, lse) pairs merge by
     logsumexp weighting.  Returns (o [BH, Tq, D] in q dtype, global lse)."""
     from ..ops.flash_attention import _fwd_impl
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     my = lax.axis_index(axis_name)
     BH, Tq, D = qb.shape
     o_acc = jnp.zeros((BH, Tq, D), jnp.float32)
@@ -250,7 +251,7 @@ def _ring_flash_bwd_rule(axis_name, causal, scale, block_q, block_k,
     after n rotations the dq accumulator arrives back at its owner."""
     from ..ops.flash_attention import _bwd_impl
     qb, kb, vb, o, lse = res
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     my = lax.axis_index(axis_name)
     BH, Tq, D = qb.shape
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)

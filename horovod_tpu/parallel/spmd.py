@@ -16,7 +16,7 @@ from typing import Any, Callable
 
 import jax
 import numpy as np
-from jax import shard_map
+from ..compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 

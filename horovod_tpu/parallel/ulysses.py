@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from jax import lax
+from ..compat import axis_size as compat_axis_size
 
 
 def seq_to_heads(x, axis_name: str = "sp"):
@@ -42,7 +43,7 @@ def ulysses_attention(q, k, v, attn_fn: Optional[Callable] = None,
     if attn_fn is None:
         from ..ops.flash_attention import flash_attention, flash_enabled
         # The inner attention sees the FULL gathered sequence (T_local·sp).
-        if flash_enabled(seq=q.shape[1] * lax.axis_size(axis_name),
+        if flash_enabled(seq=q.shape[1] * compat_axis_size(axis_name),
                          causal=causal):
             attn_fn = flash_attention   # pallas kernel on the local heads
         else:
@@ -50,7 +51,7 @@ def ulysses_attention(q, k, v, attn_fn: Optional[Callable] = None,
             attn_fn = local_flash_attention
     H = q.shape[2]
     K = k.shape[2]
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     if H % n or K % n:
         raise ValueError(
             f"ulysses_attention needs q heads ({H}) AND kv heads ({K}) "

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax import lax
+from ..compat import axis_size as compat_axis_size
 
 
 class _ZeroState(NamedTuple):
@@ -30,7 +31,7 @@ class _ZeroState(NamedTuple):
 
 
 def _shard_leaf(g, axis_name):
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     flat = g.reshape(-1)
     pad = (-flat.shape[0]) % n
     if pad:
@@ -58,7 +59,7 @@ def sharded_optimizer(inner: optax.GradientTransformation,
         return _ZeroState(inner.init(sharded_params), ())
 
     def update_fn(grads, state: _ZeroState, params=None):
-        n = lax.axis_size(axis_name)
+        n = compat_axis_size(axis_name)
         leaves, treedef = jax.tree_util.tree_flatten(grads)
         shapes = [g.shape for g in leaves]
         shard_pairs = [_shard_leaf(g, axis_name) for g in leaves]

@@ -118,9 +118,14 @@ def DistributedGradientTape(gradtape: tf.GradientTape,
 def DistributedOptimizer(optimizer, name: Optional[str] = None,
                          compression=Compression.none, op=Average,
                          backward_passes_per_step: int = 1,
-                         process_set: Optional[ProcessSet] = None):
+                         process_set: Optional[ProcessSet] = None,
+                         check=False):
     """Wrap a Keras optimizer so ``apply_gradients`` averages gradients
     across ranks first (reference: ``hvd.DistributedOptimizer`` for TF).
+
+    ``check=True`` lints the calling script for deadlock-prone collective
+    patterns at wrap time (``check="strict"`` raises on errors) — see
+    ``horovod_tpu.analysis`` and docs/analysis.md.
 
     Implemented as a dynamic subclass of the optimizer's own class (the
     reference's ``horovod/_keras`` pattern) so Keras ``model.compile``
@@ -134,6 +139,9 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
     communication N×.  N identical micro-batches under bpps=N therefore
     produce exactly one bpps=1 step on the combined batch.
     """
+    if check:
+        from ..analysis.hooks import run_check_hook
+        run_check_hook(check)
     hvd_name = name or f"Distributed{optimizer.__class__.__name__}"
 
     cls = optimizer.__class__

@@ -216,13 +216,21 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          backward_passes_per_step=1,
                          op=mpi_ops.Average,
                          gradient_predivide_factor=1.0,
-                         process_set: Optional[ProcessSet] = None):
+                         process_set: Optional[ProcessSet] = None,
+                         check=False):
     """Wrap a torch optimizer so ``step()`` applies globally averaged
     gradients (reference: ``hvd.DistributedOptimizer``).
 
     Built dynamically as a subclass of the wrapped optimizer's class (the
     reference's pattern), so ``isinstance(opt, torch.optim.SGD)`` holds.
+
+    ``check=True`` lints the calling script for deadlock-prone collective
+    patterns at wrap time (``check="strict"`` raises on errors) — see
+    ``horovod_tpu.analysis`` and docs/analysis.md.
     """
+    if check:
+        from ..analysis.hooks import run_check_hook
+        run_check_hook(check)
     if gradient_predivide_factor != 1.0 and op != mpi_ops.Average:
         raise ValueError(
             "gradient_predivide_factor not supported with op != Average")

@@ -1,0 +1,58 @@
+"""Sanitizer divergence worker: ranks deliberately submit the SAME two
+allreduces in OPPOSITE order from different call sites.
+
+Shapes, dtypes and ops all match, so plain negotiation cannot tell the
+submissions apart — without the sanitizer the run "succeeds" while pairing
+rank 0's first tensor with rank 1's second (silent numeric corruption).
+With ``HVD_TPU_SANITIZER=1`` the per-entry seq/call-site tag rides the
+negotiation digest and the divergence fails fast as a NegotiationError
+naming both ranks and both call sites.
+
+Prints ``SANITIZER_OK`` when the divergence is caught with full
+attribution, ``SANITIZER_MISSED`` when the run completes undetected.
+"""
+
+import os
+
+# Each worker is one rank with ONE cpu device: strip the 8-virtual-device
+# flag inherited from the test process, use gloo for cross-process XLA
+# collectives (same preamble as worker_collectives.py).
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common.controller import NegotiationError
+
+
+def main():
+    hvd.init()
+    rank = hvd.rank()
+    a = np.ones(4, np.float32)
+    b = np.full((4,), 2.0, np.float32)
+
+    try:
+        if rank == 0:   # hvd-lint: disable=HVD101  (deliberate divergence)
+            h1 = hvd.allreduce_async(a)
+            h2 = hvd.allreduce_async(b)
+        else:
+            h1 = hvd.allreduce_async(b)
+            h2 = hvd.allreduce_async(a)
+        hvd.synchronize([h1, h2])
+        print("SANITIZER_MISSED", flush=True)
+    except NegotiationError as e:
+        msg = str(e)
+        assert "ranks [0]" in msg and "ranks [1]" in msg, msg
+        assert "site=worker_sanitizer.py" in msg, msg
+        assert "seq=" in msg, msg
+        print("SANITIZER_OK", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

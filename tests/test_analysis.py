@@ -1,0 +1,629 @@
+"""Tests for the collective-correctness analyzer (horovod_tpu.analysis).
+
+Per lint rule: one violating fixture that must fire and one clean fixture
+that must stay quiet.  Plus: trace_check over a toy shard_map step, ledger
+comparison, the runtime sanitizer's recording/tagging layer, the CLI, and
+the bindings' ``check=`` hook.
+"""
+
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from horovod_tpu.analysis import lint_source, RULES, Severity
+from horovod_tpu.analysis.findings import Finding, summarize
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def lint(snippet):
+    return lint_source(textwrap.dedent(snippet), "fixture.py")
+
+
+# ---------------------------------------------------------------- HVD101
+def test_hvd101_fires_on_rank_guarded_collective():
+    findings = lint("""
+        import horovod_tpu as hvd
+        hvd.init()
+        if hvd.rank() == 0:
+            hvd.broadcast(x, root_rank=0)
+    """)
+    assert "HVD101" in rules_of(findings)
+    assert any(f.is_error for f in findings if f.rule == "HVD101")
+
+
+def test_hvd101_fires_via_tainted_variable_and_local_rank():
+    findings = lint("""
+        import horovod_tpu as hvd
+        rank = hvd.local_rank()
+        if rank != 0:
+            hvd.allreduce(x)
+    """)
+    assert "HVD101" in rules_of(findings)
+
+
+def test_hvd101_fires_after_rank_divergent_early_return():
+    findings = lint("""
+        import horovod_tpu as hvd
+        def save(x):
+            rank = hvd.rank()
+            if rank != 0:
+                return None
+            return hvd.allgather(x)
+    """)
+    assert "HVD101" in rules_of(findings)
+
+
+def test_hvd101_quiet_on_print_only_branch():
+    findings = lint("""
+        import horovod_tpu as hvd
+        loss = hvd.allreduce(x, name="loss")
+        if hvd.rank() == 0:
+            print(loss)
+    """)
+    assert "HVD101" not in rules_of(findings)
+
+
+def test_hvd101_quiet_on_join():
+    # join() is the sanctioned rank-divergent call (uneven final batches).
+    findings = lint("""
+        import horovod_tpu as hvd
+        if hvd.rank() == 1:
+            hvd.join()
+    """)
+    assert "HVD101" not in rules_of(findings)
+
+
+def test_hvd101_suppression_comment():
+    findings = lint("""
+        import horovod_tpu as hvd
+        if hvd.rank() == 0:
+            hvd.broadcast(x, root_rank=0)  # hvd-lint: disable=HVD101
+    """)
+    assert "HVD101" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------- HVD102
+def test_hvd102_fires_when_subgroup_sets_exist():
+    findings = lint("""
+        import horovod_tpu as hvd
+        evens = hvd.add_process_set([0, 2])
+        hvd.allreduce(x, process_set=evens)
+        hvd.allreduce(y)
+    """)
+    hits = [f for f in findings if f.rule == "HVD102"]
+    assert len(hits) == 1 and hits[0].line == 5
+
+
+def test_hvd102_quiet_without_subgroup_sets():
+    findings = lint("""
+        import horovod_tpu as hvd
+        hvd.allreduce(y)
+    """)
+    assert "HVD102" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------- HVD103
+def test_hvd103_fires_on_unbroadcast_training_script():
+    findings = lint("""
+        import horovod_tpu as hvd
+        hvd.init()
+        opt = hvd.DistributedOptimizer(opt)
+    """)
+    assert "HVD103" in rules_of(findings)
+
+
+def test_hvd103_quiet_with_broadcast_parameters():
+    findings = lint("""
+        import horovod_tpu as hvd
+        hvd.init()
+        opt = hvd.DistributedOptimizer(opt)
+        params = hvd.broadcast_parameters(params, root_rank=0)
+    """)
+    assert "HVD103" not in rules_of(findings)
+
+
+def test_hvd103_quiet_with_elastic_state():
+    findings = lint("""
+        import horovod_tpu as hvd
+        from horovod_tpu.elastic import JaxState
+        hvd.init()
+        opt = hvd.DistributedOptimizer(opt)
+        state = JaxState(params=params, opt_state=s, epoch=0)
+    """)
+    assert "HVD103" not in rules_of(findings)
+
+
+def test_hvd103_quiet_with_elastic_run_decorator():
+    findings = lint("""
+        import horovod_tpu as hvd
+        from horovod_tpu.elastic import run
+        @run
+        def train(state):
+            pass
+        hvd.init()
+        opt = hvd.DistributedOptimizer(opt)
+    """)
+    assert "HVD103" not in rules_of(findings)
+
+
+def test_hvd103_not_suppressed_by_unrelated_run_call():
+    findings = lint("""
+        import horovod_tpu as hvd
+        hvd.init()
+        opt = hvd.DistributedOptimizer(opt)
+        app.run()
+    """)
+    assert "HVD103" in rules_of(findings)
+
+
+# ------------------------------------------------------------ HVD104/105
+def test_hvd104_fires_on_set_iteration():
+    findings = lint("""
+        import horovod_tpu as hvd
+        for name in {"b", "a"}:
+            hvd.allreduce_async(grads[name], name=name)
+    """)
+    assert "HVD104" in rules_of(findings)
+
+
+def test_hvd104_fires_on_set_call():
+    findings = lint("""
+        import horovod_tpu as hvd
+        for name in set(grads):
+            hvd.allreduce_async(grads[name], name=name)
+    """)
+    assert "HVD104" in rules_of(findings)
+
+
+def test_hvd105_fires_on_dict_items():
+    findings = lint("""
+        import horovod_tpu as hvd
+        for k, v in params.items():
+            hvd.broadcast_async(v, name=k)
+    """)
+    hits = [f for f in findings if f.rule == "HVD105"]
+    assert hits and not hits[0].is_error  # warning severity
+
+
+def test_hvd104_105_quiet_when_sorted():
+    findings = lint("""
+        import horovod_tpu as hvd
+        for name in sorted(set(grads)):
+            hvd.allreduce_async(grads[name], name=name)
+        for k, v in sorted(params.items()):
+            hvd.broadcast_async(v, name=k)
+    """)
+    assert not ({"HVD104", "HVD105"} & rules_of(findings))
+
+
+def test_hvd104_quiet_on_list_iteration():
+    findings = lint("""
+        import horovod_tpu as hvd
+        for t in tensors:
+            hvd.allreduce_async(t)
+    """)
+    assert not ({"HVD104", "HVD105"} & rules_of(findings))
+
+
+# ------------------------------------------------------------ HVD106/107
+def test_hvd106_fires_on_block_until_ready_in_jit():
+    findings = lint("""
+        import jax
+        @jax.jit
+        def step(x):
+            jax.block_until_ready(x)
+            return x
+    """)
+    assert "HVD106" in rules_of(findings)
+
+
+def test_hvd106_fires_under_partial_jit():
+    findings = lint("""
+        import jax, functools
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(x):
+            jax.io_callback(cb, None, x)
+            return x
+    """)
+    assert "HVD106" in rules_of(findings)
+
+
+def test_hvd106_quiet_outside_jit():
+    findings = lint("""
+        import jax
+        def step(x):
+            jax.block_until_ready(x)
+            return x
+    """)
+    assert "HVD106" not in rules_of(findings)
+
+
+def test_hvd107_fires_on_eager_collective_in_jit():
+    findings = lint("""
+        import jax
+        import horovod_tpu as hvd
+        @jax.jit
+        def step(x):
+            return hvd.allreduce(x)
+    """)
+    assert "HVD107" in rules_of(findings)
+
+
+def test_hvd107_quiet_on_in_graph_collective():
+    # axis_name= marks the in-graph lax.psum spelling — jit-safe.
+    findings = lint("""
+        import jax
+        from horovod_tpu.ops import collectives as C
+        @jax.jit
+        def step(x):
+            return C.allreduce(x, axis_name="hvd")
+    """)
+    assert "HVD107" not in rules_of(findings)
+
+
+def test_hvd107_quiet_on_in_graph_default_axis():
+    # C.allreduce(x) relying on DEFAULT_AXIS is correct in-graph code.
+    findings = lint("""
+        import jax
+        from horovod_tpu.ops import collectives as C
+        @jax.jit
+        def step(x):
+            return C.allreduce(x)
+    """)
+    assert "HVD107" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------- misc lint
+def test_lint_source_handles_syntax_error():
+    findings = lint_source("def broken(:\n", "bad.py")
+    assert findings and findings[0].rule == "HVD100" and findings[0].is_error
+
+
+def test_rule_catalog_ids_and_severities():
+    # ≥ 6 distinct lint rule classes, each with catalog metadata.
+    lint_ids = {"HVD101", "HVD102", "HVD103", "HVD104", "HVD105",
+                "HVD106", "HVD107"}
+    assert lint_ids <= set(RULES)
+    assert RULES["HVD101"].severity is Severity.ERROR
+    assert RULES["HVD105"].severity is Severity.WARNING
+    assert summarize([Finding("HVD101", "f.py", 1, 1, "m")]).startswith("1 ")
+
+
+# ================================================================ trace_check
+def test_trace_check_clean_toy_shard_map_step(world_size):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from horovod_tpu.compat import shard_map
+    from horovod_tpu.analysis.trace_check import check_step_fn
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    def body(x):
+        g = jax.lax.psum(x, "dp")
+        return g + jax.lax.axis_index("dp")
+
+    step = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                     check_vma=False)
+    report = check_step_fn(step, jnp.zeros((world_size, 4)), mesh=mesh)
+    assert report.ok, [f.render() for f in report.findings]
+    prims = [r.primitive for r in report.ledger]
+    assert "psum" in prims and "axis_index" in prims
+    psum = report.ledger[prims.index("psum")]
+    assert psum.axes == ("dp",)
+    assert psum.dtypes == ("float32",)
+
+
+def test_trace_check_flags_unknown_axis():
+    import jax.numpy as jnp
+    from jax import lax
+    from horovod_tpu.analysis.trace_check import check_step_fn
+
+    def step(x):
+        return lax.psum(x, "tp")          # only "dp" is bound
+
+    report = check_step_fn(step, jnp.zeros((4,)), axis_sizes={"dp": 8})
+    assert not report.ok
+    assert any(f.rule == "HVD201" for f in report.findings)
+    assert any("tp" in f.message for f in report.findings)
+
+
+def test_trace_check_flags_bad_axis_index_groups():
+    import jax.numpy as jnp
+    from jax import lax
+    from horovod_tpu.analysis.trace_check import check_step_fn
+
+    def step(x):
+        # groups cover ranks 0-3 of an 8-wide axis: 4-7 wait forever.
+        return lax.psum(x, "dp", axis_index_groups=[[0, 1], [2, 3]])
+
+    report = check_step_fn(step, jnp.zeros((4,)), axis_sizes={"dp": 8})
+    assert any(f.rule == "HVD202" for f in report.findings)
+
+
+def test_trace_check_flags_host_callback():
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu.analysis.trace_check import check_step_fn
+
+    def step(x):
+        return jax.pure_callback(
+            lambda v: v, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+    report = check_step_fn(step, jnp.zeros((4,)))
+    assert any(f.rule == "HVD203" for f in report.findings)
+
+
+def test_compare_ledgers_names_first_divergence():
+    import jax.numpy as jnp
+    from jax import lax
+    from horovod_tpu.analysis.trace_check import (check_step_fn,
+                                                  compare_ledgers)
+
+    def step_a(x):
+        y = lax.psum(x, "dp")
+        return lax.pmax(y, "dp")
+
+    def step_b(x):
+        y = lax.pmax(x, "dp")             # reordered vs step_a
+        return lax.psum(y, "dp")
+
+    x = jnp.zeros((4,))
+    la = check_step_fn(step_a, x, axis_sizes={"dp": 8}).ledger
+    lb = check_step_fn(step_b, x, axis_sizes={"dp": 8}).ledger
+    same = compare_ledgers(la, la)
+    assert not same
+    diff = compare_ledgers(la, lb, names=("rank 0", "rank 1"))
+    assert diff and diff[0].rule == "HVD301"
+    assert "#0" in diff[0].message and "rank 0" in diff[0].message
+
+
+def test_compare_ledgers_flags_extra_collective():
+    import jax.numpy as jnp
+    from jax import lax
+    from horovod_tpu.analysis.trace_check import (check_step_fn,
+                                                  compare_ledgers)
+
+    def one(x):
+        return lax.psum(x, "dp")
+
+    def two(x):
+        return lax.pmax(lax.psum(x, "dp"), "dp")
+
+    x = jnp.zeros((4,))
+    la = check_step_fn(one, x, axis_sizes={"dp": 8}).ledger
+    lb = check_step_fn(two, x, axis_sizes={"dp": 8}).ledger
+    diff = compare_ledgers(la, lb)
+    assert diff and diff[0].rule == "HVD301"
+    assert "block forever" in diff[0].message
+
+
+# ============================================================ runtime sanitizer
+class _FakeEntry:
+    def __init__(self, name, shape=(4,), dtype=np.float32):
+        self.name = name
+        self.tensor = np.zeros((2,) + shape, dtype)
+        from horovod_tpu.ops.engine import CollectiveType
+        from horovod_tpu.ops import collectives as C
+        self.ctype = CollectiveType.ALLREDUCE
+        self.reduce_op = C.ReduceOp.AVERAGE
+        self.root_rank = 0
+        self.process_set_id = 0
+        self.prescale_factor = None
+        self.postscale_factor = None
+
+
+def test_sanitizer_records_and_tags():
+    from horovod_tpu.analysis.runtime_sanitizer import CollectiveSanitizer
+
+    s = CollectiveSanitizer(capacity=8)
+    e1, e2 = _FakeEntry("a"), _FakeEntry("b", shape=(8,))
+    s.observe([e1, e2])
+    assert e1.sanitizer_tag.startswith("seq=0:0;site=")
+    assert e2.sanitizer_tag.startswith("seq=0:1;site=")
+    # The call site is THIS test file, not engine internals.
+    assert "test_analysis.py" in e1.sanitizer_tag
+    tail = s.tail()
+    assert [t.name for t in tail] == ["a", "b"]
+    assert "(8,)" in tail[1].digest
+    assert "last submissions" in s.render_tail()
+
+
+def test_sanitizer_seq_is_per_process_set():
+    """Subgroup collectives are only submitted by member ranks; a global
+    counter would drift on non-members and false-positive every later
+    world collective.  Counters are therefore per process set."""
+    from horovod_tpu.analysis.runtime_sanitizer import CollectiveSanitizer
+
+    s = CollectiveSanitizer()
+    world = _FakeEntry("w0")
+    sub = _FakeEntry("s0")
+    sub.process_set_id = 7
+    world2 = _FakeEntry("w1")
+    s.observe([world])
+    s.observe([sub])
+    s.observe([world2])
+    assert world.sanitizer_tag.startswith("seq=0:0;")
+    assert sub.sanitizer_tag.startswith("seq=7:0;")
+    assert world2.sanitizer_tag.startswith("seq=0:1;")  # not 0:2
+
+
+def test_sanitizer_synthesized_entries_keep_seq_aligned():
+    """hvd.join: a joined rank synthesizes identity entries for peers'
+    collectives; the counter must advance as if it had submitted, or every
+    post-join collective mismatches on seq."""
+    from horovod_tpu.analysis.runtime_sanitizer import CollectiveSanitizer
+
+    s = CollectiveSanitizer()
+    s.observe([_FakeEntry("pre")])
+    s.observe_synthesized(_FakeEntry("peer.0"))
+    post = _FakeEntry("post")
+    s.observe([post])
+    assert post.sanitizer_tag.startswith("seq=0:2;")
+    assert s.tail()[1].site == "<joined:synthesized>"
+
+
+def test_sanitizer_rollback_on_rejected_push():
+    """Duplicate-name queue rejection is rank-local: the seq advance must
+    be undone or every later tag skews against the peers'."""
+    from horovod_tpu.analysis.runtime_sanitizer import CollectiveSanitizer
+
+    s = CollectiveSanitizer()
+    ok = _FakeEntry("ok")
+    s.observe([ok])
+    rejected = _FakeEntry("dup")
+    s.observe([rejected])
+    s.rollback([rejected])
+    after = _FakeEntry("after")
+    s.observe([after])
+    assert after.sanitizer_tag.startswith("seq=0:1;")   # reused the slot
+    assert [t.name for t in s.tail()] == ["ok", "after"]
+
+
+def test_sanitizer_ledger_is_bounded():
+    from horovod_tpu.analysis.runtime_sanitizer import CollectiveSanitizer
+
+    s = CollectiveSanitizer(capacity=4)
+    for i in range(10):
+        s.observe([_FakeEntry(f"t{i}")])
+    assert len(s.ledger) == 4
+    assert s.tail(2)[-1].seq == 9  # seq keeps counting past eviction
+
+
+def test_controller_digest_carries_sanitizer_tag():
+    from horovod_tpu.common.controller import TCPController
+
+    e = _FakeEntry("t")
+    base = TCPController._digest(e)
+    e.sanitizer_tag = "seq=3;site=train.py:17"
+    tagged = TCPController._digest(e)
+    assert tagged == base + "|seq=3;site=train.py:17"
+    # Divergent call sites → divergent digests (what negotiation compares).
+    e2 = _FakeEntry("t")
+    e2.sanitizer_tag = "seq=3;site=train.py:99"
+    assert TCPController._digest(e2) != tagged
+
+
+def test_sanitizer_disabled_by_default(monkeypatch):
+    from horovod_tpu.analysis import runtime_sanitizer as rts
+
+    monkeypatch.delenv("HVD_TPU_SANITIZER", raising=False)
+    assert not rts.enabled()
+
+    class _Eng:
+        stall = None
+    assert rts.maybe_install(_Eng()) is None
+
+
+def test_sanitizer_stall_wrapper_reports_ledger():
+    import logging
+    from horovod_tpu.analysis.runtime_sanitizer import (
+        CollectiveSanitizer, SanitizerStallInspector)
+    from horovod_tpu.ops.engine import StallInspector
+
+    s = CollectiveSanitizer()
+    e = _FakeEntry("slow")
+    s.observe([e])
+    e.enqueue_time = -1e9  # ancient: guaranteed past any threshold
+    inner = StallInspector(warn_after_s=10.0, shutdown_after_s=0)
+    wrapped = SanitizerStallInspector(inner, s, warn_after_s=0.001)
+    # The project logger doesn't propagate; capture with our own handler.
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger = logging.getLogger("horovod_tpu")
+    cap = _Capture()
+    logger.addHandler(cap)
+    try:
+        wrapped.check([e], missing_ranks={"slow": [1]})
+    finally:
+        logger.removeHandler(cap)
+    text = "\n".join(records)
+    assert "HVD302" in text and "slow" in text
+    assert "ranks [1]" in text
+    assert "test_analysis.py" in text  # divergent call site named
+
+    # Shutdown path: RuntimeError carries the ledger tail.
+    inner2 = StallInspector(warn_after_s=0.001, shutdown_after_s=0.002)
+    wrapped2 = SanitizerStallInspector(inner2, s, warn_after_s=0.001)
+    with pytest.raises(RuntimeError, match="HVD302"):
+        wrapped2.check([e])
+
+
+# ===================================================================== CLI
+def test_cli_exit_codes_and_output(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import horovod_tpu as hvd
+        if hvd.rank() == 0:
+            hvd.broadcast(x, root_rank=0)
+    """))
+    clean = tmp_path / "clean.py"
+    clean.write_text("import horovod_tpu as hvd\nhvd.allreduce(x)\n")
+
+    from horovod_tpu.analysis.__main__ import main
+
+    assert main([str(clean)]) == 0
+    assert main([str(bad)]) == 1
+    assert main([str(bad), "--disable", "HVD101"]) == 0
+    assert main([]) == 2
+    assert main(["--list-rules"]) == 0
+    assert main([str(bad), "--json"]) == 1
+    # Missing path: usage error, not a crash or a clean verdict.
+    assert main([str(tmp_path / "nonexistent.py")]) == 2
+    # Explicit suffix-less file is linted, not silently skipped.
+    noext = tmp_path / "trainscript"
+    noext.write_text(bad.read_text())
+    assert main([str(noext)]) == 1
+
+
+def test_cli_subprocess_entrypoint(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import horovod_tpu as hvd\n"
+        "if hvd.rank() == 0:\n"
+        "    hvd.barrier()\n")
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis", str(bad)],
+        capture_output=True, text=True)
+    assert res.returncode == 1, res.stderr
+    assert "HVD101" in res.stdout
+
+
+# ============================================================== check= hook
+def test_check_hook_strict_raises_on_caller_errors(tmp_path, monkeypatch):
+    from horovod_tpu.analysis.hooks import (CollectiveCheckError,
+                                            run_check_hook)
+
+    bad = tmp_path / "train.py"
+    bad.write_text(
+        "import horovod_tpu as hvd\n"
+        "if hvd.rank() == 0:\n"
+        "    hvd.broadcast(x, root_rank=0)\n")
+    with pytest.raises(CollectiveCheckError) as ei:
+        run_check_hook("strict", caller_file=str(bad))
+    assert any(f.rule == "HVD101" for f in ei.value.findings)
+
+    # warn mode: findings returned, no raise
+    findings = run_check_hook(True, caller_file=str(bad))
+    assert any(f.rule == "HVD101" for f in findings)
+    assert run_check_hook(False, caller_file=str(bad)) == []
+
+
+def test_distributed_optimizer_check_hook(hvd, tmp_path):
+    import optax
+    # check=True on a clean caller (this test file): must not raise and
+    # must return a working optimizer.
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1), check=True)
+    params = {"w": np.zeros(3, np.float32)}
+    state = opt.init(params)
+    assert state is not None

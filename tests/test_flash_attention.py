@@ -5,6 +5,7 @@ the identical kernel compiles on TPU.
 """
 
 import jax
+import jax.export  # noqa: F401  (not auto-imported on jax<=0.4)
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -196,7 +197,7 @@ def test_prefill_tpu_lowering(monkeypatch):
 def test_ulysses_routes_through_flash(monkeypatch):
     """HVD_TPU_FLASH=1 makes Ulysses run the pallas kernel on its local
     heads INSIDE shard_map over the sp mesh — the real sp usage."""
-    from jax import shard_map
+    from horovod_tpu.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
     from horovod_tpu.parallel.ulysses import ulysses_attention
 

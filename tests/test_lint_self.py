@@ -1,0 +1,58 @@
+"""The analyzer gates this repo: lint horovod_tpu/ + examples/ in tier-1.
+
+Any new deadlock-prone collective pattern introduced by a future PR fails
+here with the finding's rule ID, location and fix hint.  Known, reviewed
+findings go in the inline allowlist below — each entry must carry a reason.
+"""
+
+import os
+
+from horovod_tpu.analysis import lint_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (rule, path-suffix, line) -> reason.  Line numbers keep the allowlist
+# honest: moving/duplicating an allowlisted pattern re-fails the gate.
+ALLOWLIST = {
+    # (example)
+    # ("HVD101", "horovod_tpu/foo.py", 42): "rank-guard is matched by a "
+    #     "process_set covering exactly those ranks",
+}
+
+
+def _key(finding):
+    rel = os.path.relpath(finding.path, REPO)
+    return (finding.rule, rel.replace(os.sep, "/"), finding.line)
+
+
+def test_self_lint_errors_gate():
+    findings = lint_paths([os.path.join(REPO, "horovod_tpu"),
+                           os.path.join(REPO, "examples")])
+    errors = [f for f in findings
+              if f.is_error and _key(f) not in ALLOWLIST]
+    assert not errors, (
+        "new collective-correctness errors (fix them or allowlist with a "
+        "reason):\n" + "\n".join(f.render() for f in errors))
+
+
+def test_self_lint_warning_budget():
+    """Warnings don't fail the gate, but silent growth does: a PR adding
+    warning-severity findings must either fix them or consciously raise
+    this budget in the same diff."""
+    findings = lint_paths([os.path.join(REPO, "horovod_tpu"),
+                           os.path.join(REPO, "examples")])
+    warnings = [f for f in findings
+                if not f.is_error and _key(f) not in ALLOWLIST]
+    budget = 0   # current state: repo lints clean
+    assert len(warnings) <= budget, (
+        f"warning count {len(warnings)} exceeds budget {budget}:\n"
+        + "\n".join(f.render() for f in warnings))
+
+
+def test_allowlist_entries_still_fire():
+    """Stale allowlist entries (fixed code, moved lines) must be pruned."""
+    findings = lint_paths([os.path.join(REPO, "horovod_tpu"),
+                           os.path.join(REPO, "examples")])
+    live = {_key(f) for f in findings}
+    stale = [k for k in ALLOWLIST if k not in live]
+    assert not stale, f"allowlist entries no longer fire, remove them: {stale}"

@@ -369,7 +369,7 @@ def test_tp_decode_matches_single_device():
     """tp=2 decode (heads split, psum at wo, cache sharded over its head
     axis) must produce the SAME logits as single-device decode at every
     step — prefill included (VERDICT r4 ask #4)."""
-    from jax import shard_map
+    from horovod_tpu.compat import shard_map
 
     cfg0 = llama.tiny(dtype=jnp.float32, max_seq=32, dp_axis=None,
                       tp_axis=None, sp_axis=None, use_flash=False)
@@ -550,7 +550,7 @@ def test_sliding_window_train_and_decode(monkeypatch):
     mesh = infer_mesh(8, sp=2)
     pspecs = llama.param_specs(cfg_sp)
     sp_params = llama.init_params(cfg_sp, jax.random.PRNGKey(52))
-    from jax import shard_map
+    from horovod_tpu.compat import shard_map
     sp_tokens, _ = _data(cfg_sp, batch=8, seq=16, seed=53)
     with pytest.raises(ValueError, match="sliding_window"):
         jax.jit(shard_map(

@@ -428,6 +428,34 @@ def test_torovodrun_with_network_interface():
         f"stderr:\n{res.stderr[-3000:]}")
 
 
+WORKER_SANITIZER = os.path.join(REPO, "tests", "data", "worker_sanitizer.py")
+
+
+def test_sanitizer_catches_divergent_collective_order():
+    """HVD_TPU_SANITIZER=1 acceptance: two ranks submit identical-signature
+    allreduces in opposite order from different call sites; the sanitizer's
+    seq/call-site digest tag turns it into a fail-fast NegotiationError
+    naming the diverging ranks and both call sites (the worker asserts the
+    attribution, then prints SANITIZER_OK)."""
+    res = _run_torovodrun(2, WORKER_SANITIZER, timeout=300,
+                          extra_env={"HVD_TPU_SANITIZER": "1"})
+    ok = res.stdout.count("SANITIZER_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
+def test_sanitizer_off_misses_divergent_order():
+    """Control run: without the sanitizer the same divergence sails through
+    negotiation (signatures match) and corrupts silently — the documented
+    gap the sanitizer exists to close."""
+    res = _run_torovodrun(2, WORKER_SANITIZER, timeout=300)
+    ok = res.stdout.count("SANITIZER_MISSED")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
 WORKER_EST = os.path.join(REPO, "tests", "data", "worker_estimator.py")
 
 

@@ -3,11 +3,12 @@ allreduce, Adasum — each against a locally computed reference.
 """
 
 import jax
+import jax.export  # noqa: F401  (not auto-imported on jax<=0.4)
 import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax import shard_map
+from horovod_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from horovod_tpu.parallel.mesh import make_mesh, infer_mesh
@@ -137,9 +138,9 @@ def test_ring_flash_tpu_lowering():
     so a CPU host proves ring_attention on TPU lowers to the pallas
     kernels (VERDICT r3 ask #5 'assert on lowered HLO/stablehlo')."""
     import importlib
-    from jax.sharding import AbstractMesh
+    from horovod_tpu.compat import abstract_mesh
     ra = importlib.import_module("horovod_tpu.parallel.ring_attention")
-    mesh = AbstractMesh((4,), ("sp",))
+    mesh = abstract_mesh((4,), ("sp",))
 
     def f(q, k, v):
         def loss(q, k, v):

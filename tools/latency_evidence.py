@@ -34,10 +34,17 @@ ARM_SRC = r"""
 import json, statistics, sys, time
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Old JAX (<= 0.4.x) has no such option; the launcher sets XLA_FLAGS
+    # --xla_force_host_platform_device_count=8 in the arm env instead.
+    pass
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import lax, shard_map
+from jax import lax
+
+from horovod_tpu.compat import shard_map
 import horovod_tpu as hvd
 
 iters = int(sys.argv[1])
@@ -89,6 +96,12 @@ def run_arm(inline: bool, iters: int) -> dict:
     # Hermetic CPU arm: the axon site hook would pin the TPU backend.
     env["PYTHONPATH"] = REPO
     env.pop("JAX_PLATFORMS", None)
+    # Old JAX ignores jax_num_cpu_devices (see ARM_SRC): force the 8-device
+    # CPU mesh from the environment, which works on every version.
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     r = subprocess.run([sys.executable, "-c", ARM_SRC, str(iters)],
                        capture_output=True, text=True, timeout=1800,
                        env=env, cwd=REPO)
