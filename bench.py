@@ -174,6 +174,72 @@ def _probe_subprocess_loop(deadline, out):
             time.sleep(retry_delay)
 
 
+def _control_plane_stats():
+    """Steady-state control-plane overhead for the JSON line: per-cycle
+    negotiation microseconds and the response-cache hit rate.  Nulls in
+    single-controller mode (no negotiation round exists there) — the point
+    is that the perf trajectory captures host-side coordinator overhead,
+    not just bus bandwidth."""
+    from horovod_tpu.common import basics as _basics
+    eng = _basics._get_state().engine
+    cycles = getattr(eng, "negotiation_cycles", 0)
+    per_cycle = (round(eng.negotiation_us_total / cycles, 2)
+                 if cycles else None)
+    ctl = getattr(eng, "controller", None)
+    rate = ctl.cache_stats.hit_rate() if ctl is not None else None
+    return {"negotiation_us_per_cycle": per_cycle,
+            "response_cache_hit_rate":
+                round(rate, 4) if rate is not None else None}
+
+
+def bench_response_cache(iters=30, n_tensors=8, errors=None):
+    """Eager steady-state with the negotiation response cache ON vs OFF
+    (client-side A/B: the slot tables stay coordinated either way): bus-bw
+    for a fixed small tensor set, per-cycle negotiation microseconds, and
+    the warm-path hit rate.  Multi-process only — the single-controller
+    engine has no negotiation round to cache."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics as _basics
+
+    eng = _basics._get_state().engine
+    ctl = eng.controller
+    out = {"available": ctl is not None}
+    if ctl is None:
+        return out
+    elems = 1 << 14
+    xs = [np.full(elems, 1.0 + j * 1e-6, np.float32)
+          for j in range(n_tensors)]
+
+    def phase(n_iter):
+        us0, c0 = eng.negotiation_us_total, eng.negotiation_cycles
+        h0, m0 = ctl.cache_stats.hits, ctl.cache_stats.misses
+        t0 = time.perf_counter()
+        for _ in range(n_iter):
+            outs = hvd.grouped_allreduce(xs, name="rcache_bench",
+                                         op=hvd.Sum)
+        del outs
+        wall = time.perf_counter() - t0
+        cyc = max(1, eng.negotiation_cycles - c0)
+        hits = ctl.cache_stats.hits - h0
+        misses = ctl.cache_stats.misses - m0
+        return {
+            "step_ms": round(wall / n_iter * 1e3, 3),
+            "negotiation_us_per_cycle":
+                round((eng.negotiation_us_total - us0) / cyc, 2),
+            "hit_rate": round(hits / max(1, hits + misses), 4),
+        }
+
+    phase(3)                                   # warm: learn the slots
+    out["on"] = phase(iters)
+    try:
+        ctl.cache_enabled = False              # client-side A/B only: the
+        out["off"] = phase(iters)              # server keeps its table, so
+    finally:                                   # peers/verdicts stay sound
+        ctl.cache_enabled = True
+    return out
+
+
 def bench_busbw(sizes_mb, iters=10, errors=None, engine_only=False):
     """Allreduce bus-bandwidth sweep over both data planes.  A failing size
     records an error and the sweep continues — partial results beat none."""
@@ -992,6 +1058,13 @@ def main():
     except BaseException as exc:  # noqa: BLE001 - the line must still print
         errors["fatal"] = repr(exc)
         out["traceback"] = traceback.format_exc()[-2000:]
+    # Control-plane trajectory keys ride EVERY JSON line (all model paths,
+    # minimal mode, even partial failures): negotiation overhead is what
+    # the response-cache work moves, so it must be visible per round.
+    try:
+        out.update(_control_plane_stats())
+    except Exception:  # noqa: BLE001 - never void the line for telemetry
+        pass
     # Rank is resolved on success AND failure paths so a fatal error in a
     # multi-process world still yields exactly one JSON line.
     try:
@@ -1006,6 +1079,19 @@ def main():
 
 def _run(out, errors):
     import horovod_tpu as hvd
+
+    # CPU multi-process smoke runs (torovodrun -np N bench.py): cross-
+    # process XLA collectives need gloo — the test workers opt in
+    # explicitly, and this jax build ignores the launcher's env hint — so
+    # do the same here or every engine/psum section errors with
+    # "Multiprocess computations aren't implemented on the CPU backend".
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") and \
+            int(os.environ.get("HOROVOD_SIZE", "1") or 1) > 1:
+        try:
+            import jax
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 - never void the line for a hint
+            pass
 
     out["timing_evidence"] = _TIMING  # filled in-place by each section
 
@@ -1050,6 +1136,10 @@ def _run(out, errors):
                                "on device; null = no data",
             "allreduce_busbw_GBps": busbw,
         })
+        try:
+            out["response_cache"] = bench_response_cache(errors=errors)
+        except Exception as exc:  # noqa: BLE001 - contained
+            errors["response_cache"] = repr(exc)
         return
 
     if model == "llama":
@@ -1133,6 +1223,11 @@ def _run(out, errors):
         except Exception as exc:  # noqa: BLE001 - whole-section failure
             errors["busbw"] = repr(exc)
     out["allreduce_busbw_GBps"] = busbw
+
+    try:
+        out["response_cache"] = bench_response_cache(errors=errors)
+    except Exception as exc:  # noqa: BLE001 - contained
+        errors["response_cache"] = repr(exc)
 
     if os.environ.get("HVD_BENCH_SKIP_AUTOTUNE", "") != "1":
         try:

@@ -16,7 +16,19 @@
 //                                               uint16 len, bytes name,
 //                                               uint16 dlen, bytes digest,
 //                                               uint16 glen, bytes group,
-//                                               uint16 plen, bytes datadep }
+//                                               uint16 plen, bytes datadep,
+//                                               uint16 tlen, bytes tag }
+//             uint32 bv_len, bytes bitvec       (bit i = cache slot i pending)
+//             uint32 n_tag, n_tag * { uint32 slot, uint16 len, bytes tag }
+//             (the bitvector is the steady-state fast path: a slot id is a
+//              replicated handle for a (name, digest, required, datadep,
+//              grouped) tuple the server assigned on its first full
+//              announce; a round in the warm regime carries ONLY the
+//              fixed-size bitvector — no per-tensor metadata.  `tag` is the
+//              runtime sanitizer's seq/call-site tag: on the full path it
+//              used to ride inside the digest, now it travels beside it so
+//              the slot key stays step-invariant while divergence detection
+//              keeps working on the cached path via the sparse tag section)
 //             (names newly enqueued on this rank since the last round;
 //              `required` = number of ranks that must announce before the
 //              tensor is ready — process-set size; 0 means the full world.
@@ -40,6 +52,19 @@
 //             uint32 n_warn,    n_warn  * { uint16 len, bytes text }
 //             uint32 n_err,     n_err   * { uint16 len, bytes name,
 //                                           uint16 mlen, bytes message }
+//             uint32 n_assign,  n_assign * { name, digest, datadep,
+//                                            uint16 required,
+//                                            uint16 grouped, uint32 id }
+//             uint32 bv_len, bytes ready_bitvec (bit i = slot i ready; only
+//                                                used while no rank is
+//                                                joined — joined ranks need
+//                                                the digest strings to
+//                                                synthesize contributions)
+//             uint32 n_evict, n_evict * uint32 slot
+//             (evictions are broadcast in the same lock-step round on every
+//              rank, so client slot tables can never diverge; a join epoch
+//              flushes ALL slots — full renegotiation while the world is
+//              uneven, and fresh slot state afterwards)
 //             (ready = pending on ALL ranks, in deterministic order:
 //              first-announce round, then name; the digest rides along so
 //              JOINED ranks can synthesize zero contributions for tensors
@@ -58,7 +83,7 @@
 // then resets join state (the world resumes normal operation).
 //
 // Exported C ABI (ctypes-consumed by horovod_tpu/common/native.py):
-//   hvdtpu_server_start(port, world) -> handle
+//   hvdtpu_server_start(port, world, stall_warn_s, cache_capacity) -> handle
 //   hvdtpu_server_stop(handle)
 //   hvdtpu_client_connect(host, port, rank, timeout_ms) -> handle
 //   hvdtpu_client_round(handle, req, req_len, resp_buf, resp_cap) -> resp_len
@@ -180,6 +205,10 @@ struct PendingInfo {
   std::string digest;
   std::map<std::string, std::set<int>> by_digest;
   bool errored = false;
+  // Cache slot this pending instance may be answered through (-1 = must use
+  // the string path: no slot exists, a full announcer could not be assigned
+  // one, or a join epoch flushed the table mid-negotiation).
+  int64_t slot = INT64_MIN;  // INT64_MIN = unset
   // First announcer's group id, namespaced by their rank ("3:7"; "-1" for
   // ungrouped) — echoed to joined ranks so synthesized entries batch
   // exactly like the peers' grouped entries.
@@ -216,21 +245,35 @@ struct Server {
   std::map<std::string, PendingInfo> pending;
   // Response cache (reference N8 response_cache.cc, re-derived for this
   // wire protocol): steady-state training announces the same
-  // (name, digest, required, datadep) tuple every step; the server assigns
-  // each tuple a compact uint32 id on first full announce and broadcasts
-  // the assignment, after which clients send 4-byte cached announces (+
-  // their per-step group tag) instead of the full strings.
+  // (name, digest, required, datadep, grouped) tuple every step; the server
+  // assigns each tuple a compact uint32 slot on first full announce and
+  // broadcasts the assignment, after which clients announce via a single
+  // fixed-size bitvector (bit i = slot i pending) — zero per-tensor
+  // metadata in the warm regime.  `group` remembers the first announcer's
+  // namespaced group tag so joined ranks batch synthesized entries exactly
+  // like the peers' grouped entries; grouped-ness is part of the slot key,
+  // so a rank flipping a tensor grouped<->ungrouped misses the cache, full-
+  // announces, and trips the existing structure-divergence error.
   struct CacheRec {
-    std::string name, digest, datadep;
+    std::string name, digest, datadep, group;
     uint16_t required = 0;
+    bool live = false;
+    uint64_t last_used = 0;  // round counter, for LRU eviction
   };
-  // Bounded like the reference's capacity-limited cache, but without
-  // eviction: digest-churning workloads (varying shapes/scales) simply
-  // stop getting new ids past the cap and keep using full announces —
-  // correct either way, and memory stays bounded on multi-day runs.
-  static constexpr size_t kCacheCapacity = 65536;
-  std::unordered_map<std::string, uint32_t> cache_keys;  // key -> id
-  std::vector<CacheRec> cache_recs;                      // id -> record
+  // Bounded like the reference's capacity-limited cache; at capacity the
+  // least-recently-used non-pending slot is evicted and the eviction is
+  // broadcast, so client tables track the server's exactly.  An evicted
+  // slot's RECORD stays intact and its id is only reusable from the NEXT
+  // round: a client that bit-announced the slot in the same round the
+  // eviction happened (it could not have known yet) must still resolve
+  // against the old tuple — via the string verdict path — never against a
+  // freshly reassigned one.
+  size_t cache_capacity = 65536;
+  size_t cache_live = 0;
+  std::unordered_map<std::string, uint32_t> cache_keys;  // key -> slot
+  std::vector<CacheRec> cache_recs;                      // slot -> record
+  std::vector<uint32_t> cache_free;                      // reusable slots
+  uint64_t round_no = 0;
   uint64_t announce_seq = 0;
   double stall_warn_s = 60.0;
   std::set<int> joined;
@@ -284,6 +327,7 @@ void Server::run_inner() {
 
   std::vector<uint8_t> frame;
   while (!stop.load()) {
+    ++round_no;
     // One lock-step round: a frame from every rank, then a reply to all.
     // Cache assignments created/confirmed this round, broadcast to all
     // ranks in the response (deduped; a client only adopts assignments
@@ -296,13 +340,19 @@ void Server::run_inner() {
     struct AssignRec {
       std::string name, digest, datadep;
       uint16_t required;
+      uint16_t grouped;  // part of the slot key; echoed so clients adopt
+                         // against exactly the tuple they announced
     };
     std::map<uint32_t, AssignRec> assigns;
+    std::vector<uint32_t> evictions;   // ids freed this round: broadcast,
+                                       // reusable only from the next round
+    bool join_started = false;
+    // slot: >= 0 answers may ride the ready bitvector; -1 forces strings.
     auto handle_announce = [&](int r, uint16_t required,
                                const std::string& name,
                                const std::string& digest,
                                const std::string& group,
-                               const std::string& datadep) {
+                               const std::string& datadep, int64_t slot) {
       auto it = pending.find(name);
       if (it == pending.end()) {
         PendingInfo info;
@@ -319,6 +369,13 @@ void Server::run_inner() {
       (group == "-1" ? it->second.ungrouped_ranks
                      : it->second.grouped_ranks)
           .insert(r);
+      // Slot eligibility is sticky-downward: every announcing rank must be
+      // able to resolve a slot-bit verdict (slot known or assigned this
+      // same round), else the verdict stays on the string path.
+      if (slot < 0 || (it->second.slot != INT64_MIN && it->second.slot < 0))
+        it->second.slot = -1;
+      else
+        it->second.slot = slot;
       if (digest != it->second.digest) {
         // Divergent submission (reference controller's consistency
         // check).  The message is rebuilt at response time so late
@@ -326,9 +383,73 @@ void Server::run_inner() {
         it->second.errored = true;
       }
     };
+    // Evictions reclaim least-recently-used live slots not referenced by
+    // a pending negotiation; broadcast so clients drop them in lock-step.
+    // ONE candidate scan + sort per round (built lazily, only under
+    // capacity pressure), validated per pop — so a digest-churning
+    // workload (new key every announce, table pinned at capacity) costs
+    // one O(capacity log capacity) pass per round, and the per-round
+    // budget degrades the overflow to string-path negotiation (correct
+    // either way) instead of burning the rank-0 hot path.
+    int evict_budget = 256;
+    std::vector<uint32_t> evict_queue;   // LRU-ascending candidates
+    size_t evict_pos = 0;
+    bool evict_queue_built = false;
+    auto evict_lru = [&]() -> bool {
+      if (evict_budget <= 0) return false;
+      if (!evict_queue_built) {
+        evict_queue_built = true;
+        std::vector<std::pair<uint64_t, uint32_t>> cands;
+        cands.reserve(cache_live);
+        for (size_t i = 0; i < cache_recs.size(); ++i)
+          if (cache_recs[i].live)
+            cands.emplace_back(cache_recs[i].last_used,
+                               static_cast<uint32_t>(i));
+        std::sort(cands.begin(), cands.end());
+        evict_queue.reserve(cands.size());
+        for (auto& c : cands) evict_queue.push_back(c.second);
+      }
+      while (evict_pos < evict_queue.size()) {
+        uint32_t victim = evict_queue[evict_pos++];
+        CacheRec& rec = cache_recs[victim];
+        // Revalidate at pop time: the slot may have been used (bit
+        // announce / confirm) or referenced by a fresh pending entry
+        // since the queue was built.
+        if (!rec.live || rec.last_used == round_no) continue;
+        bool in_use = false;
+        for (auto& [n, info] : pending)
+          if (info.slot == static_cast<int64_t>(victim)) {
+            in_use = true;
+            break;
+          }
+        if (in_use) continue;
+        --evict_budget;
+        std::string key = rec.name;
+        key += '\x1f';
+        key += rec.digest;
+        key += '\x1f';
+        key += rec.datadep;
+        key += '\x1f';
+        key += std::to_string(rec.required);
+        key += '\x1f';
+        key += rec.group == "-1" ? '0' : '1';
+        cache_keys.erase(key);
+        rec.live = false;  // record kept intact for same-round bit
+        --cache_live;      // resolves; id reusable only after the round
+        evictions.push_back(victim);
+        return true;
+      }
+      evict_budget = 0;    // candidates exhausted: stop for this round
+      return false;
+    };
     for (int r = 0; r < world; ++r) {
       if (!read_frame(fds[r].load(), &frame)) { stop.store(true); break; }
       Reader rd{frame.data(), frame.data() + frame.size()};
+      // Sanitizer tag side-channel for this rank's bitvector announces
+      // (slot -> tag); parsed after the bitvector but needed while
+      // resolving it, so the sections are walked full -> bits -> tags and
+      // bit announces are resolved afterwards.
+      std::vector<uint32_t> bit_slots;
       uint32_t n = rd.u32();
       for (uint32_t i = 0; i < n && rd.ok; ++i) {
         uint16_t required = rd.u16();
@@ -336,13 +457,28 @@ void Server::run_inner() {
         std::string digest = rd.str();
         std::string group = rd.str();
         std::string datadep = rd.str();
+        std::string tag = rd.str();
         if (name == "\x1f__join__") {
           joined.insert(r);
           last_joined = r;
+          join_started = true;
           continue;
         }
-        // Assign (or confirm) the tuple's cache id so every announcer
-        // eventually learns it and drops to the compact form.
+        // Assign (or confirm) the tuple's cache slot so every announcer
+        // eventually learns it and drops to the bitvector form.  The key
+        // excludes the sanitizer tag (per-submission, never repeats) but
+        // includes grouped-ness (see CacheRec comment).  No assignments
+        // while any rank is joined: the epoch started with a table flush,
+        // and relearning mid-epoch would freeze per-step group tags into
+        // slot records while the joined rank's synthesizer still consumes
+        // them — full announces (with CURRENT tags) for the whole epoch
+        // keep grouped batching exact; slots relearn once the world
+        // resumes.
+        if (!joined.empty()) {
+          std::string eff0 = tag.empty() ? digest : digest + "|" + tag;
+          handle_announce(r, required, name, eff0, group, datadep, -1);
+          continue;
+        }
         std::string key = name;
         key += '\x1f';
         key += digest;
@@ -350,32 +486,122 @@ void Server::run_inner() {
         key += datadep;
         key += '\x1f';
         key += std::to_string(required);
+        key += '\x1f';
+        key += group == "-1" ? '0' : '1';
         auto ck = cache_keys.find(key);
-        if (ck == cache_keys.end() &&
-            cache_recs.size() < kCacheCapacity) {
-          uint32_t id = static_cast<uint32_t>(cache_recs.size());
-          ck = cache_keys.emplace(key, id).first;
-          cache_recs.push_back(CacheRec{name, digest, datadep, required});
-        }
-        if (ck != cache_keys.end())
-          assigns[ck->second] = AssignRec{name, digest, datadep, required};
-        handle_announce(r, required, name, digest, group, datadep);
-      }
-      // Optional compact section: cached announces (id + group tag).
-      if (rd.ok && rd.p < rd.end) {
-        uint32_t nc = rd.u32();
-        for (uint32_t i = 0; i < nc && rd.ok; ++i) {
-          uint32_t id = rd.u32();
-          std::string group = rd.str();
-          if (id < cache_recs.size()) {
-            const CacheRec& rec = cache_recs[id];
-            handle_announce(r, rec.required, rec.name, rec.digest, group,
-                            rec.datadep);
+        if (ck == cache_keys.end()) {
+          if (cache_live >= cache_capacity && cache_capacity > 0)
+            evict_lru();
+          if (cache_live < cache_capacity) {
+            uint32_t id;
+            if (!cache_free.empty()) {
+              id = cache_free.back();
+              cache_free.pop_back();
+            } else {
+              id = static_cast<uint32_t>(cache_recs.size());
+              cache_recs.push_back(CacheRec{});
+            }
+            std::string g = group == "-1"
+                ? group : std::to_string(r) + ":" + group;
+            cache_recs[id] = CacheRec{name, digest, datadep, g, required,
+                                      true, round_no};
+            cache_keys.emplace(key, id);
+            ++cache_live;
+            ck = cache_keys.find(key);
           }
+        }
+        int64_t slot = -1;
+        if (ck != cache_keys.end()) {
+          slot = ck->second;
+          cache_recs[ck->second].last_used = round_no;
+          assigns[ck->second] = AssignRec{
+              name, digest, datadep, required,
+              static_cast<uint16_t>(group == "-1" ? 0 : 1)};
+        }
+        std::string eff = tag.empty() ? digest : digest + "|" + tag;
+        handle_announce(r, required, name, eff, group, datadep, slot);
+      }
+      // Bitvector section: slot i pending on this rank.
+      if (rd.ok && rd.p < rd.end) {
+        uint32_t nbytes = rd.u32();
+        for (uint32_t b = 0; b < nbytes && rd.ok; ++b) {
+          if (rd.p >= rd.end) { rd.ok = false; break; }
+          uint8_t byte = *rd.p++;
+          for (int bit = 0; bit < 8; ++bit)
+            if (byte & (1u << bit)) bit_slots.push_back(b * 8 + bit);
+        }
+      }
+      // Sanitizer tag side-channel (sparse; empty outside sanitizer mode).
+      std::map<uint32_t, std::string> bit_tags;
+      if (rd.ok && rd.p < rd.end) {
+        uint32_t nt = rd.u32();
+        for (uint32_t i = 0; i < nt && rd.ok; ++i) {
+          uint32_t slot = rd.u32();
+          bit_tags[slot] = rd.str();
+        }
+      }
+      for (uint32_t id : bit_slots) {
+        // A non-live slot with an intact record was evicted THIS round
+        // (ids are only reused from the next round, and the announcing
+        // client sees the eviction broadcast before its next request):
+        // the announce must still count — resolved via the old tuple,
+        // answered on the string path (slot hint -1) — or the tensor
+        // would wedge with the client believing it announced.
+        if (id >= cache_recs.size() || cache_recs[id].name.empty())
+          continue;
+        CacheRec& rec = cache_recs[id];
+        int64_t hint = rec.live ? static_cast<int64_t>(id) : -1;
+        if (rec.live) rec.last_used = round_no;
+        auto tg = bit_tags.find(id);
+        std::string eff = tg == bit_tags.end()
+            ? rec.digest : rec.digest + "|" + tg->second;
+        // rec.group is already namespaced by its first announcer; pass
+        // "-1" vs non-"-1" through (handle_announce re-namespaces only
+        // raw tags, so hand it the raw suffix when grouped).
+        auto it = pending.find(rec.name);
+        bool fresh = it == pending.end();
+        if (fresh) {
+          PendingInfo info;
+          info.order = announce_seq++;
+          info.required = rec.required ? rec.required : world;
+          info.first_seen = Clock::now();
+          info.digest = eff;
+          info.group = rec.group;
+          info.data_dep =
+              rec.datadep.empty() ? -1 : std::atoi(rec.datadep.c_str());
+          info.slot = hint;
+          it = pending.emplace(rec.name, std::move(info)).first;
+        }
+        it->second.ready_ranks.insert(r);
+        it->second.by_digest[eff].insert(r);
+        (rec.group == "-1" ? it->second.ungrouped_ranks
+                           : it->second.grouped_ranks)
+            .insert(r);
+        if (!fresh) {
+          if (hint < 0)
+            it->second.slot = -1;
+          else if (it->second.slot == INT64_MIN)
+            it->second.slot = hint;
+          if (eff != it->second.digest) it->second.errored = true;
         }
       }
     }
     if (stop.load()) break;
+    if (join_started) {
+      // A join epoch begins: flush every slot (broadcast as evictions) so
+      // the whole epoch renegotiates in full — joined ranks need digest
+      // strings to synthesize, and stale per-step group structure must not
+      // outlive the epoch.  Clients relearn slots once the world resumes.
+      for (size_t i = 0; i < cache_recs.size(); ++i) {
+        if (!cache_recs[i].live) continue;
+        cache_recs[i].live = false;
+        evictions.push_back(static_cast<uint32_t>(i));
+      }
+      cache_keys.clear();
+      cache_live = 0;
+      assigns.clear();
+      for (auto& [n, info] : pending) info.slot = -1;
+    }
     // Compute+write under phase_mu: see the field's comment.  Reads stay
     // outside the lock (they block on peers, and server_stop must be able
     // to sever a blocked read).
@@ -388,6 +614,7 @@ void Server::run_inner() {
     // fail), then dropped.
     std::vector<std::tuple<uint64_t, std::string, std::string, std::string>>
         ready;
+    std::vector<uint32_t> ready_slots;
     std::vector<std::string> warns;
     std::vector<std::pair<std::string, std::string>> errs;
     auto now = Clock::now();
@@ -479,7 +706,14 @@ void Server::run_inner() {
         continue;
       }
       if (have >= info.required) {
-        ready.emplace_back(info.order, it->first, info.digest, info.group);
+        // Slot-bit verdict only when every rank can resolve it: the slot
+        // exists, every announcer was (or is being, via this round's
+        // assigns broadcast) taught it, and no rank is joined (joined
+        // ranks need the digest string to synthesize a contribution).
+        if (joined.empty() && info.slot >= 0)
+          ready_slots.push_back(static_cast<uint32_t>(info.slot));
+        else
+          ready.emplace_back(info.order, it->first, info.digest, info.group);
         it = pending.erase(it);
         continue;
       }
@@ -534,8 +768,19 @@ void Server::run_inner() {
       put_str(&resp, rec.digest);
       put_str(&resp, rec.datadep);
       put_u16(&resp, rec.required);
+      put_u16(&resp, rec.grouped);
       put_u32(&resp, id);
     }
+    // Ready bitvector (steady-state fast path) + coordinated evictions.
+    uint32_t max_slot = 0;
+    for (uint32_t s : ready_slots) max_slot = std::max(max_slot, s + 1);
+    uint32_t bv_bytes = (max_slot + 7) / 8;
+    put_u32(&resp, bv_bytes);
+    size_t bv_off = resp.size();
+    resp.resize(resp.size() + bv_bytes, 0);
+    for (uint32_t s : ready_slots) resp[bv_off + s / 8] |= (1u << (s % 8));
+    put_u32(&resp, static_cast<uint32_t>(evictions.size()));
+    for (uint32_t s : evictions) put_u32(&resp, s);
     // Attempt EVERY rank before honoring a failure: one dead/closing peer
     // must not cut the survivors off from a round's computed verdicts
     // (they may contain the ready broadcast that lets them finish cleanly).
@@ -544,6 +789,11 @@ void Server::run_inner() {
       if (!write_frame(fds[r].load(), resp)) write_failed = true;
     }
     if (write_failed) stop.store(true);
+    // Freed slot ids become reusable only now that every client has (or
+    // will, before its next request) processed the eviction broadcast —
+    // a same-round reassignment could otherwise collide with in-flight
+    // bit announces for the old tuple.
+    for (uint32_t s : evictions) cache_free.push_back(s);
   }
   // fds are closed by hvdtpu_server_stop after the thread joins.
 }
@@ -556,7 +806,8 @@ struct Client {
 
 extern "C" {
 
-void* hvdtpu_server_start(int port, int world, double stall_warn_s) {
+void* hvdtpu_server_start(int port, int world, double stall_warn_s,
+                          int cache_capacity) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return nullptr;
   int one = 1;
@@ -574,6 +825,8 @@ void* hvdtpu_server_start(int port, int world, double stall_warn_s) {
   s->listen_fd = fd;
   s->world = world;
   s->stall_warn_s = stall_warn_s;
+  s->cache_capacity = cache_capacity < 0 ? 0
+      : static_cast<size_t>(cache_capacity);
   s->fds = std::make_unique<std::atomic<int>[]>(world);
   for (int i = 0; i < world; ++i) s->fds[i].store(-1);
   s->loop = std::thread([s] { s->run(); });

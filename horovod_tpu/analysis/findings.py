@@ -151,6 +151,19 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
         "fetch outside.",
     ),
     Rule(
+        "HVD204", Severity.ERROR,
+        "ppermute permutation is not a bijection over the axis",
+        "lax.ppermute with a perm that repeats a source/destination, names "
+        "a rank outside the axis, or leaves ranks uncovered makes the "
+        "uncovered/over-covered ranks exchange with partners that never "
+        "send — the same deadlock shape as bad axis_index_groups (HVD202). "
+        "JAX's single-host semantics mask it (missing pairs read zeros); "
+        "a multi-host launch wedges.",
+        "Make perm a bijection: every rank 0..axis_size-1 appears exactly "
+        "once as a source and exactly once as a destination (e.g. a full "
+        "ring [(i, (i + 1) % n) for i in range(n)]).",
+    ),
+    Rule(
         "HVD301", Severity.ERROR,
         "cross-rank collective order/signature divergence",
         "At runtime, ranks submitted different collectives (or the same "

@@ -12,13 +12,17 @@ Mechanism:
   **ledger**: sequence number, wire name, signature digest, and the user
   call site that issued it (first stack frame outside horovod_tpu).
 - Each entry is stamped with a ``sanitizer_tag`` (``seq=<i>;site=<f:l>``)
-  which the controller appends to its negotiation digest
-  (``common/controller.py _digest``).  Two ranks submitting different
-  collectives — or the same ones in a different order, or from different
-  call sites — under one negotiated name now produce a digest mismatch,
+  which the controller sends BESIDE its step-invariant negotiation digest
+  (the announce's separate tag field on the full path; the sparse
+  slot/tag side-channel next to the bitvector on the response-cache fast
+  path — ``common/controller.py _round``).  The rank-0 server folds the
+  tag back into its effective-digest comparison, so two ranks submitting
+  different collectives — or the same ones in a different order, or from
+  different call sites — under one negotiated name produce a mismatch,
   and the existing per-tensor NegotiationError names the divergent ranks
-  AND both call sites.  No new wire protocol; the reference's consistency
-  check does the transport.
+  AND both call sites.  Keeping the tag out of the digest itself means
+  the response-cache slot key stays valid across steps: sanitizer runs
+  keep the steady-state fast path (docs/performance.md).
 - The engine's stall inspector is tightened to
   ``HVD_TPU_SANITIZER_TIMEOUT`` seconds (default 30) and, when a stall
   fires, the report carries the ledger tail so the laggard ranks' last
@@ -98,9 +102,11 @@ class CollectiveSanitizer:
                 self._seq[ps] = seq + 1
                 digest = self._entry_digest(e)
                 tag = f"seq={ps}:{seq};site={site}"
-                # Stamped onto the entry: the controller appends it to the
-                # negotiation digest, turning order/call-site divergence
-                # into an attributable per-tensor mismatch error.
+                # Stamped onto the entry: the controller ships it beside
+                # the digest (full announce tag field / bitvector side-
+                # channel) and the server folds it into its mismatch
+                # comparison — order/call-site divergence becomes an
+                # attributable per-tensor error on either wire path.
                 e.sanitizer_tag = tag
                 self.ledger.append(LedgerEntry(
                     seq=seq, name=e.name, digest=digest, site=site))

@@ -9,7 +9,10 @@ can still go wrong statically is checked here:
 
 - HVD201: a collective names an ``axis_name`` no enclosing mesh binds;
 - HVD202: ``axis_index_groups`` that do not partition the axis;
-- HVD203: host-callback primitives buried in the traced step.
+- HVD203: host-callback primitives buried in the traced step;
+- HVD204: a ``ppermute`` whose perm is not a bijection over the axis
+  (non-bijective perms deadlock on multi-host exactly like bad
+  ``axis_index_groups`` — JAX's zero-fill semantics mask it locally).
 
 ``compare_ledgers`` diffs two ledgers (e.g. a refactored step against the
 golden one, or per-process ledgers recorded by the runtime sanitizer) and
@@ -108,6 +111,64 @@ def _sub_jaxprs(params: Dict[str, Any]):
                 yield item.jaxpr, extra                    # ClosedJaxpr
 
 
+def _check_ppermute(rec: CollectiveRecord, perm, bound: Dict[str, int],
+                    findings: List[Finding], path: str):
+    """HVD204: a ppermute's perm must be a bijection over its axis —
+    every rank appears exactly once as a source and once as a destination,
+    all within [0, axis_size).  Non-bijective perms deadlock on multi-host
+    runtimes the way bad axis_index_groups do (HVD202); JAX's local
+    zero-fill semantics hide the bug until the pod launch."""
+    if perm is None or not rec.axes:
+        return
+    # ppermute over several named axes indexes ranks over the axes'
+    # flattened PRODUCT — validating against axes[0] alone would flag
+    # valid rings on multi-axis meshes.
+    sizes = [bound.get(a) for a in rec.axes]
+    if any(not s for s in sizes):
+        return
+    size = 1
+    for s in sizes:
+        size *= s
+    ax = rec.axes[0] if len(rec.axes) == 1 else tuple(rec.axes)
+    pairs = [tuple(p) for p in perm]
+    srcs = [p[0] for p in pairs]
+    dsts = [p[1] for p in pairs]
+
+    def _fail(detail: str, severity=None):
+        findings.append(Finding(
+            rule="HVD204", path=path, line=rec.index, col=1,
+            severity=severity,
+            message=f"collective #{rec.index} (ppermute) over axis {ax!r} "
+                    f"of size {size} is not a bijection: {detail}"))
+
+    oob = sorted({r for r in srcs + dsts if r < 0 or r >= size})
+    if oob:
+        _fail(f"ranks {oob} are outside [0, {size})")
+        return
+    dup_src = sorted({s for s in srcs if srcs.count(s) > 1})
+    dup_dst = sorted({d for d in dsts if dsts.count(d) > 1})
+    if dup_src or dup_dst:
+        detail = []
+        if dup_src:
+            detail.append(f"sources {dup_src} send more than once")
+        if dup_dst:
+            detail.append(f"destinations {dup_dst} receive more than once")
+        _fail("; ".join(detail))
+        return
+    missing = sorted(set(range(size)) - set(srcs))
+    if missing:
+        # WARNING, not error: partial perms are defined JAX semantics
+        # (uncovered destinations read zeros) and XLA's CollectivePermute
+        # accepts them — but they are the classic accident behind
+        # wedge-shaped halo/pipeline bugs, and point-to-point emulations
+        # over eager runtimes deadlock on them, so they stay flagged.
+        from .findings import Severity
+        _fail(f"ranks {missing} appear in no (src, dst) pair (valid "
+              f"zero-fill semantics under XLA, but deadlock-prone on "
+              f"point-to-point runtimes; make the ring explicit if the "
+              f"gap is intended)", severity=Severity.WARNING)
+
+
 def _walk(jaxpr, bound: Dict[str, int], ledger: List[CollectiveRecord],
           findings: List[Finding], path: str):
     for eqn in jaxpr.eqns:
@@ -147,6 +208,9 @@ def _walk(jaxpr, bound: Dict[str, int], ledger: List[CollectiveRecord],
                                     f"not partition axis {ax!r} of size "
                                     f"{size}: ranks left out of every group "
                                     f"wait forever"))
+            if name == "ppermute":
+                _check_ppermute(rec, params.get("perm"), bound, findings,
+                                path)
         elif name in CALLBACK_PRIMITIVES:
             findings.append(Finding(
                 rule="HVD203", path=path, line=len(ledger), col=1,

@@ -139,7 +139,8 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None,
                 cfg.controller_addr, ctrl_port,
                 rank=cfg.rank_env, world=cfg.size_env,
                 stall_warn_s=cfg.stall_check_time_s
-                if not cfg.stall_check_disable else 1e18)
+                if not cfg.stall_check_disable else 1e18,
+                cache_capacity=cfg.response_cache_capacity)
             st.engine.controller = st.controller
         st.engine.start()
 

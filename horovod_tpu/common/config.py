@@ -61,7 +61,10 @@ class Config:
 
     - ``fusion_threshold_bytes``   <- HOROVOD_FUSION_THRESHOLD (default 64 MB)
     - ``cycle_time_ms``            <- HOROVOD_CYCLE_TIME
-    - ``cache_capacity``           <- HOROVOD_CACHE_CAPACITY (response cache)
+    - ``cache_capacity``           <- HOROVOD_CACHE_CAPACITY (fused program
+      cache)
+    - ``response_cache_capacity``  <- HOROVOD_RESPONSE_CACHE_CAPACITY
+      (negotiation response cache: the steady-state bitvector fast path)
     - ``timeline_filename``        <- HOROVOD_TIMELINE
     - ``timeline_mark_cycles``     <- HOROVOD_TIMELINE_MARK_CYCLES
     - ``stall_check_time_s``       <- HOROVOD_STALL_CHECK_TIME
@@ -89,6 +92,11 @@ class Config:
     cycle_time_ms: float = 1.0
     cache_capacity: int = 1024
     cache_enabled: bool = True
+    # Negotiation response cache (HOROVOD_RESPONSE_CACHE_CAPACITY, upstream
+    # HOROVOD_CACHE_CAPACITY's role): slot-table size for the steady-state
+    # bitvector fast path, client-side AND server-side.  0 disables (every
+    # cycle does full metadata negotiation).  Runtime-tunable via autotune.
+    response_cache_capacity: int = 2048
 
     timeline_filename: str = ""
     timeline_mark_cycles: bool = False
@@ -146,6 +154,7 @@ class Config:
             fusion_threshold_bytes=_env_int("FUSION_THRESHOLD", 64 * 1024 * 1024),
             cycle_time_ms=_env_float("CYCLE_TIME", 1.0),
             cache_capacity=_env_int("CACHE_CAPACITY", 1024),
+            response_cache_capacity=_env_int("RESPONSE_CACHE_CAPACITY", 2048),
             timeline_filename=_env("TIMELINE", "") or "",
             timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
             stall_check_time_s=_env_float("STALL_CHECK_TIME", 60.0),

@@ -12,10 +12,11 @@ Rank 0 additionally hosts the server thread (native, lock-step rounds).
 from __future__ import annotations
 
 import ctypes
+import dataclasses
 import itertools
 import struct
 import threading
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import native
 from ..utils.logging import get_logger
@@ -23,6 +24,28 @@ from ..utils.logging import get_logger
 log = get_logger()
 
 _RESP_CAP = 4 * 1024 * 1024
+
+
+@dataclasses.dataclass
+class ResponseCacheStats:
+    """Client-side response-cache telemetry (timeline/bench/tests).
+
+    ``hits``/``misses`` count per-tensor announces by wire form (bitvector
+    vs full metadata); ``invalidations`` counts slots dropped for any
+    reason — server-coordinated evictions, ``forget()``, or local capacity
+    trims; ``full_announces``/``bit_announces`` are the cumulative frame
+    contents the tier-1 regression guard asserts on."""
+    hits: int = 0
+    misses: int = 0
+    invalidations: int = 0       # slots this client actually dropped
+    evictions: int = 0           # server eviction broadcasts seen (counted
+                                 # even when a local trim got there first)
+    full_announces: int = 0
+    bit_announces: int = 0
+
+    def hit_rate(self) -> Optional[float]:
+        total = self.hits + self.misses
+        return (self.hits / total) if total else None
 
 
 class NegotiationError(RuntimeError):
@@ -39,14 +62,16 @@ class TCPController:
     """Engine-facing controller (engine calls ``negotiate`` each cycle)."""
 
     def __init__(self, addr: str, port: int, rank: int, world: int,
-                 stall_warn_s: float = 60.0, connect_timeout_ms: int = 60000):
+                 stall_warn_s: float = 60.0, connect_timeout_ms: int = 60000,
+                 cache_capacity: int = 2048):
         self._lib = native.load()
         self.rank = rank
         self.world = world
         self._server = None
         if rank == 0:
             self._server = self._lib.hvdtpu_server_start(
-                port, world, ctypes.c_double(stall_warn_s))
+                port, world, ctypes.c_double(stall_warn_s),
+                int(cache_capacity))
             if not self._server:
                 raise RuntimeError(f"Failed to start controller server on "
                                    f"port {port}")
@@ -59,15 +84,28 @@ class TCPController:
                 f"rank {rank}: failed to connect to controller at "
                 f"{addr}:{port}")
         self._announced: set = set()
-        # Response cache (reference N8): (name, digest, required, datadep)
-        # -> server-assigned uint32 id; once learned, re-announces of the
-        # same tuple send 4 bytes + the group tag instead of the strings.
-        self._cache_ids: Dict[tuple, int] = {}
-        # Full (name, digest, required, datadep) tuples announced in full
-        # and awaiting a server id.  The server echoes the full key in the
-        # assignment broadcast, so adoption matches exactly the announced
-        # tuple — same (name, digest) under a different process set
-        # (different required/datadep) can't cross-adopt ids.
+        # Response cache (reference N8 response_cache.cc): slot table
+        # replicated across ranks.  (name, digest, required, datadep,
+        # grouped) -> server-assigned uint32 slot; once learned, steady-
+        # state announces ride a fixed-size bitvector (bit = slot pending)
+        # instead of per-tensor metadata frames.  Any miss — shape/dtype
+        # change (new digest), grouped<->ungrouped flip, forget(), or a
+        # coordinated eviction — falls back to a full announce, which
+        # (re)learns the slot.  Insertion order doubles as LRU order:
+        # hits reinsert at the end, capacity trims pop from the front.
+        self.cache_capacity = max(0, int(cache_capacity))
+        self.cache_enabled = self.cache_capacity > 0
+        self.cache_stats = ResponseCacheStats()
+        self._slots: Dict[tuple, int] = {}
+        self._slot_keys: Dict[int, tuple] = {}
+        # Full key tuples announced in full and awaiting a server slot.
+        # The server echoes the full key in the assignment broadcast, so
+        # adoption matches exactly the announced tuple — same (name,
+        # digest) under a different process set (different required/
+        # datadep) or grouped-ness can't cross-adopt slots.  Every full
+        # announce MUST register here: a slot-bit ready verdict is only
+        # resolvable if the announcer adopted the slot in the same round
+        # the server learned it.
         self._awaiting_assign: set = set()
         self.bytes_sent = 0                      # telemetry (tests/timeline)
         self._early_ready: List[tuple] = []       # (name, digest)
@@ -89,38 +127,60 @@ class TCPController:
 
     # ------------------------------------------------------------- protocol
     def _round(self, announces: Sequence) -> tuple:
-        """announces: (name, required_ranks, digest, group, datadep)
-        tuples; required 0 = world.  Tuples whose cache id is known are
-        sent in the compact cached section (id + group)."""
-        full, cached = [], []
+        """announces: (name, required_ranks, digest, group, datadep, tag)
+        tuples; required 0 = world.  Tuples whose slot is known ride the
+        fixed-size bitvector (the steady-state fast path); the sanitizer
+        tag — when present — travels in the sparse side-channel so order
+        divergence is still caught on the cached path."""
+        full, bits, tags = [], [], []
+        stats = self.cache_stats
         for a in announces:
-            n, required, digest, group, datadep = a
-            cid = self._cache_ids.get((n, digest, required, datadep))
-            if cid is None:
+            n, required, digest, group, datadep, tag = a
+            key = (n, digest, required, datadep, group != "-1")
+            slot = self._slots.get(key) if self.cache_enabled else None
+            if slot is None:
                 full.append(a)
-                # Bounded alongside the server's cap: digest-churning
-                # workloads stop learning ids instead of growing forever.
-                # Sanitizer-tagged digests (";site=") carry a per-submission
-                # seq and so NEVER repeat — learning ids for them would only
-                # fill both maps with dead entries; skip (the sanitizer is a
-                # debug mode: full announces are its accepted overhead).
-                if (not n.startswith("\x1f")
-                        and ";site=" not in digest
-                        and len(self._awaiting_assign) < 65536
-                        and len(self._cache_ids) < 65536):
-                    self._awaiting_assign.add((n, digest, required, datadep))
+                if not n.startswith("\x1f"):
+                    stats.misses += 1
+                    # EVERY cacheable full announce registers for adoption
+                    # (see _awaiting_assign comment) — even with the local
+                    # cache disabled: the server may still answer through a
+                    # slot bit (peers use the fast path), and resolving it
+                    # needs the mapping.  cache_enabled only gates the
+                    # bit-ANNOUNCE path above.  The soft cap bounds
+                    # pathological digest churn; the slot table itself is
+                    # LRU-bounded by cache_capacity.
+                    if len(self._awaiting_assign) < (1 << 20):
+                        self._awaiting_assign.add(key)
             else:
-                cached.append((cid, group))
+                # LRU touch: reinsert at the end of the dict order.
+                self._slots.pop(key)
+                self._slots[key] = slot
+                bits.append(slot)
+                if tag:
+                    tags.append((slot, tag))
+                stats.hits += 1
         req = bytearray(struct.pack("<I", len(full)))
-        for n, required, digest, group, datadep in full:
+        for n, required, digest, group, datadep, tag in full:
             req += struct.pack("<H", required)
-            for field in (n, digest, group, datadep):
+            for field in (n, digest, group, datadep, tag):
                 fb = field.encode()
                 req += struct.pack("<H", len(fb)) + fb
-        req += struct.pack("<I", len(cached))
-        for cid, group in cached:
-            gb = group.encode()
-            req += struct.pack("<I", cid) + struct.pack("<H", len(gb)) + gb
+        if bits:
+            nb = max(bits) // 8 + 1
+            bv = bytearray(nb)
+            for s in bits:
+                bv[s // 8] |= 1 << (s % 8)
+        else:
+            nb, bv = 0, b""
+        req += struct.pack("<I", nb) + bytes(bv)
+        req += struct.pack("<I", len(tags))
+        for slot, tag in tags:
+            tb = tag.encode()
+            req += struct.pack("<IH", slot, len(tb)) + tb
+        stats.full_announces += sum(1 for a in full
+                                    if not a[0].startswith("\x1f"))
+        stats.bit_announces += len(bits)
         self.bytes_sent += len(req)
         buf = (ctypes.c_uint8 * len(req)).from_buffer(req) if req else \
             (ctypes.c_uint8 * 0)()
@@ -168,8 +228,10 @@ class TCPController:
         ready = read_tuple(3)
         warns = read_list()
         errors = read_tuple(2) if off < len(data) else []
-        # Cache-id assignments: adopt those matching a tuple this client
+        # Slot assignments: adopt those matching a tuple this client
         # announced in full (the server broadcasts to every rank).
+        # Processed BEFORE the ready bitvector so a slot assigned and made
+        # ready in the same round resolves.
         if off < len(data):
             (n_assign,) = struct.unpack_from("<I", data, off)
             off += 4
@@ -180,14 +242,74 @@ class TCPController:
                     off += 2
                     fields.append(data[off:off + ln].decode())
                     off += ln
-                (required, cid) = struct.unpack_from("<HI", data, off)
-                off += 6
+                (required, grouped, slot) = struct.unpack_from(
+                    "<HHI", data, off)
+                off += 8
                 name, digest, datadep = fields
-                key = (name, digest, required, datadep)
+                key = (name, digest, required, datadep, bool(grouped))
                 if key in self._awaiting_assign:
                     self._awaiting_assign.discard(key)
-                    self._cache_ids[key] = cid
+                    self._adopt_slot(key, slot)
+        # Ready bitvector: slot verdicts, appended after the string
+        # verdicts in increasing slot order.  Every client applies the
+        # same rule, so the reconstructed order is identical on all ranks
+        # (which is all the engine's deterministic batching needs).
+        # Unknown slots are other process sets' tensors — not ours.
+        if off < len(data):
+            (nb,) = struct.unpack_from("<I", data, off)
+            off += 4
+            bv = data[off:off + nb]
+            off += nb
+            for i in range(nb * 8):
+                if not (bv[i // 8] >> (i % 8)) & 1:
+                    continue
+                key = self._slot_keys.get(i)
+                if key is not None:
+                    ready.append((key[0], key[1], "-1"))
+        # Coordinated evictions: drop the named slots so this table can
+        # never diverge from the server's (or any peer's).
+        if off < len(data):
+            (n_evict,) = struct.unpack_from("<I", data, off)
+            off += 4
+            for _ in range(n_evict):
+                (slot,) = struct.unpack_from("<I", data, off)
+                off += 4
+                # Server-authoritative count: a local capacity trim may
+                # have dropped the slot already (invalidations covered
+                # that); the eviction still happened fleet-wide.
+                self.cache_stats.evictions += 1
+                key = self._slot_keys.pop(slot, None)
+                if key is not None:
+                    self._slots.pop(key, None)
+                    self.cache_stats.invalidations += 1
         return ready, warns, errors
+
+    def _adopt_slot(self, key: tuple, slot: int):
+        old = self._slot_keys.pop(slot, None)
+        if old is not None:
+            self._slots.pop(old, None)
+        self._trim_slots(len(self._slots) + 1)
+        self._slots[key] = slot
+        self._slot_keys[slot] = key
+
+    def _trim_slots(self, size: Optional[int] = None):
+        """Enforce the (runtime-tunable) local capacity, LRU-first.  Slots
+        whose tensor is still in flight are skipped: dropping one would
+        make a later slot-bit ready verdict unresolvable."""
+        if size is None:
+            size = len(self._slots)
+        if size <= max(1, self.cache_capacity):
+            return
+        excess = size - max(1, self.cache_capacity)
+        for lru_key in list(self._slots):
+            if excess <= 0:
+                break
+            if lru_key[0] in self._announced:
+                continue
+            lru_slot = self._slots.pop(lru_key)
+            self._slot_keys.pop(lru_slot, None)
+            self.cache_stats.invalidations += 1
+            excess -= 1
 
     # ---------------------------------------------------------- engine API
     @staticmethod
@@ -201,8 +323,13 @@ class TCPController:
     @staticmethod
     def _digest(e) -> str:
         """Submission consistency digest: op kind, dtype, per-rank shape,
-        reduce op, root — what the reference's Request carries for the
-        controller's shape/dtype checks (SURVEY.md N2/N5)."""
+        reduce op, root, scale factors, wire compression — what the
+        reference's Request carries for the controller's shape/dtype checks
+        (SURVEY.md N2/N5).  Step-invariant by construction: the sanitizer's
+        per-submission tag travels in the announce's separate ``tag`` field
+        (the server folds it back into its mismatch comparison), so the
+        digest can key a response-cache slot that stays valid across
+        steps even in sanitizer mode."""
         t = getattr(e, "tensor", None)
         if t is None:
             return "barrier"
@@ -221,15 +348,12 @@ class TCPController:
         # separate `group` field, outside the mismatch comparison.
         parts.append(str(getattr(e, "prescale_factor", None)))
         parts.append(str(getattr(e, "postscale_factor", None)))
-        # Sanitizer mode (HVD_TPU_SANITIZER=1): the per-entry seq/call-site
-        # tag rides the digest, so ranks submitting different collectives
-        # under one negotiated name — or the same ones in divergent order —
-        # fail the existing mismatch check with call-site attribution.
-        # Appended LAST: joined ranks parse digest fields positionally in
-        # _synthesize_join_entry and ignore trailing parts.
-        tag = getattr(e, "sanitizer_tag", None)
-        if tag:
-            parts.append(tag)
+        # Wire compression shapes the fused program (cast-down before the
+        # reduce, cast-up after): divergence across ranks would execute
+        # mismatched programs, so it is part of the consistency check.
+        # Joined ranks parse digest fields positionally and rely on this
+        # slot being parts[7] (see engine._synthesize_join_entry).
+        parts.append(str(getattr(e, "compression", None) or "none"))
         return "|".join(parts)
 
     @staticmethod
@@ -266,12 +390,14 @@ class TCPController:
                 from .basics import _get_state
                 required = _get_state().process_set_table.get(ps_id).size()
             new.append((n, required, self._digest(e),
-                        str(getattr(e, "group_id", -1)), self._datadep(e)))
+                        str(getattr(e, "group_id", -1)), self._datadep(e),
+                        getattr(e, "sanitizer_tag", None) or ""))
         self._announced.update(n for n, *_ in new)
+        self._trim_slots()
         if self._join_pending:
             self._join_pending = False
             self._joined = True
-            new.append(("\x1f__join__", 0, "", "-1", "-1"))
+            new.append(("\x1f__join__", 0, "", "-1", "-1", ""))
         ready, warns, errors = self._round(new)
         for w in warns:
             log.warning("controller: %s", w)
@@ -331,11 +457,19 @@ class TCPController:
     def forget(self, e):
         """Drop all negotiation bookkeeping for an entry failed locally
         (e.g. group-abort) so a retry under the same name renegotiates from
-        scratch instead of consuming a stale ready/error verdict."""
+        scratch instead of consuming a stale ready/error verdict.  Also an
+        explicit response-cache invalidation: the name's slots are dropped,
+        so the retry takes the full-announce path (and relearns)."""
         n = self._wire_name(e)
         self._announced.discard(n)
         self._early_errors.pop(n, None)
         self._early_ready = [t for t in self._early_ready if t[0] != n]
+        for key in [k for k in self._slots if k[0] == n]:
+            slot = self._slots.pop(key)
+            self._slot_keys.pop(slot, None)
+            self.cache_stats.invalidations += 1
+        self._awaiting_assign = {k for k in self._awaiting_assign
+                                 if k[0] != n}
 
     def _group_tag_id(self, tag: str) -> int:
         """Server group tags ("<first-announcer-rank>:<their gid>"; "-1"

@@ -62,7 +62,7 @@ def load() -> ctypes.CDLL:
         lib = ctypes.CDLL(path)
         lib.hvdtpu_server_start.restype = ctypes.c_void_p
         lib.hvdtpu_server_start.argtypes = [ctypes.c_int, ctypes.c_int,
-                                            ctypes.c_double]
+                                            ctypes.c_double, ctypes.c_int]
         lib.hvdtpu_server_stop.argtypes = [ctypes.c_void_p]
         lib.hvdtpu_client_connect.restype = ctypes.c_void_p
         lib.hvdtpu_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,

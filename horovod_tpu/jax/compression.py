@@ -18,7 +18,15 @@ import jax.numpy as jnp
 
 
 class Compressor:
-    """Interface matching the reference's Compressor base class."""
+    """Interface matching the reference's Compressor base class.
+
+    ``wire_mode`` (``"bf16"``/``"fp16"``/``None``): set on cast-style
+    compressors so the optimizer bindings route them through the engine's
+    FUSED wire compression (cast-down/cast-up inside the jitted collective
+    program) instead of calling compress/decompress as separate passes.
+    Custom compressors leave it ``None`` and keep the explicit hooks."""
+
+    wire_mode = None
 
     @staticmethod
     def compress(tensor):
@@ -42,6 +50,8 @@ class NoneCompressor(Compressor):
 class BF16Compressor(Compressor):
     """Cast floating tensors to bfloat16 for transfer, restore dtype after."""
 
+    wire_mode = "bf16"
+
     @staticmethod
     def compress(tensor):
         if jnp.issubdtype(tensor.dtype, jnp.floating):
@@ -55,6 +65,8 @@ class BF16Compressor(Compressor):
 
 class FP16Compressor(Compressor):
     """Strict float16 transfer (byte-parity with the reference's fp16)."""
+
+    wire_mode = "fp16"
 
     @staticmethod
     def compress(tensor):

@@ -90,6 +90,20 @@ def allreduce_gradients(grads, op: C.ReduceOp = C.ReduceOp.AVERAGE,
             "train step with shard_map over the device mesh so the axis is "
             "bound (see models.mnist.make_sharded_train_step).")
     # Eager engine path: fused, device-resident, negotiated across processes.
+    wire = getattr(compression, "wire_mode", None)
+    if wire is not None:
+        # Cast-style compression rides INSIDE the fused program (cast-down
+        # before the psum, cast-up after): results come back in the
+        # gradients' own dtype with half the wire bytes and no extra
+        # launches.
+        arrs = [jnp.asarray(g) for g in leaves]
+        reduced = eager.grouped_allreduce(arrs, op=op,
+                                          name="allreduce_gradients",
+                                          process_set=process_set,
+                                          compression=wire)
+        out = [jnp.asarray(eager.to_local(r)).reshape(a.shape)
+               .astype(a.dtype) for r, a in zip(reduced, arrs)]
+        return jax.tree_util.tree_unflatten(treedef, out)
     comp = [compression.compress(jnp.asarray(g)) for g in leaves]
     reduced = eager.grouped_allreduce([c[0] for c in comp], op=op,
                                       name="allreduce_gradients",

@@ -32,6 +32,11 @@ import numpy as np
 # fusion 1KB..1GB, cycle 0.1ms..100ms.
 _THR_BOUNDS = (10.0, 30.0)          # 2^10 = 1KB .. 2^30 = 1GB
 _CYC_BOUNDS = (math.log2(1e-4), math.log2(0.1))
+# Response-cache capacity (client-side slot budget), lower bound 16: too
+# small churns the steady-state bitvector path back to full announces.  The
+# upper bound is the server's configured capacity (the client can't ride
+# more slots than the server assigns — anything above it is a dead knob).
+_CAP_LO = 4.0
 
 
 def _clamp(v: float, lo: float, hi: float) -> float:
@@ -170,9 +175,26 @@ class ParameterManager:
 
         thr0 = max(float(engine.fusion_threshold), 1024.0)
         cyc0 = max(float(engine.cycle_time_s), 1e-4)
+        starts = [math.log2(thr0), math.log2(cyc0)]
+        bounds = [_THR_BOUNDS, _CYC_BOUNDS]
+        # Third tunable — negotiation response-cache capacity — only when
+        # a multi-process controller exists (single-controller mode has no
+        # negotiation) AND the cache is enabled (capacity 0 is an explicit
+        # opt-out: tuning a dead knob would waste a third of the eval
+        # budget).  Every rank takes the same branch (same env config), so
+        # the agreement payload shape is consistent.
+        ctl = getattr(engine, "controller", None)
+        self._tune_cache = ctl is not None and getattr(ctl, "cache_enabled",
+                                                       False)
+        if self._tune_cache:
+            # The config capacity is both the starting point and the upper
+            # bound: the rank-0 server's slot table was sized from the same
+            # config, so larger client budgets cannot increase coverage.
+            cap0 = max(float(ctl.cache_capacity), 16.0)
+            starts.append(math.log2(cap0))
+            bounds.append((_CAP_LO, max(_CAP_LO + 1.0, math.log2(cap0))))
         self.search = LogCoordinateDescent(
-            start=(math.log2(thr0), math.log2(cyc0)),
-            bounds=(_THR_BOUNDS, _CYC_BOUNDS), max_evals=max_evals)
+            start=tuple(starts), bounds=tuple(bounds), max_evals=max_evals)
         self._sample_no = 0
         self._cycles_in_sample = 0
         self._bytes_in_sample = 0
@@ -210,14 +232,22 @@ class ParameterManager:
         measured = self.search.proposal()
         self.search.record(score)
         self._log_sample(measured, score)
-        if self.search.done:
-            thr, cyc = (2.0 ** p for p in self.search.point)
-            payload = np.asarray([thr, cyc, 1.0], np.float64)
-        else:
-            thr, cyc = (2.0 ** p for p in self.search.proposal())
-            payload = np.asarray([thr, cyc, 0.0], np.float64)
+        point = self.search.point if self.search.done \
+            else self.search.proposal()
+        params = [2.0 ** p for p in point]
+        payload = np.asarray(params + [1.0 if self.search.done else 0.0],
+                             np.float64)
         self._move_handle = self._broadcaster(payload)
         self._sample_no += 1
+
+    def _apply_params(self, params):
+        self._engine.fusion_threshold = int(params[0])
+        self._engine.cycle_time_s = float(params[1])
+        if self._tune_cache and len(params) >= 3:
+            # Client-side slot budget: shrinking trims LRU slots (safe —
+            # a dropped slot simply full-announces and relearns), growing
+            # lets more tuples ride the bitvector.
+            self._engine.controller.cache_capacity = max(1, int(params[2]))
 
     def _poll_move(self):
         payload = self._poller(self._move_handle)
@@ -225,17 +255,20 @@ class ParameterManager:
             return
         self._move_handle = None
         try:
-            thr, cyc, done = (float(x) for x in
-                              np.asarray(payload).reshape(-1)[:3])
+            values = [float(x) for x in np.asarray(payload).reshape(-1)]
+            params, done = values[:-1], values[-1]
+            if len(params) < 2:
+                raise ValueError("short payload")
         except Exception:  # pragma: no cover - never break training
-            thr, cyc, done = (2.0 ** self.search.point[0],
-                              2.0 ** self.search.point[1], 1.0)
-        self._engine.fusion_threshold = int(thr)
-        self._engine.cycle_time_s = cyc
+            params = [2.0 ** p for p in self.search.point]
+            done = 1.0
+        self._apply_params(params)
         if done >= 0.5:
             self.tuning = False
-            self._log_line(f"# final: fusion_threshold={int(thr)} "
-                           f"cycle_time_s={cyc:.6f} "
+            extra = (f" response_cache_capacity={int(params[2])}"
+                     if self._tune_cache and len(params) >= 3 else "")
+            self._log_line(f"# final: fusion_threshold={int(params[0])} "
+                           f"cycle_time_s={params[1]:.6f}{extra} "
                            f"evals={self.search.evals}\n")
         self._sample_start = self._clock()
 
@@ -266,12 +299,16 @@ class ParameterManager:
     # ------------------------------------------------------------- logging
     def _log_sample(self, measured, score: float):
         if not self._log_header_written:
-            self._log_line("sample,fusion_threshold_bytes,cycle_time_s,"
-                           "score_bytes_per_s\n")
+            cap_col = (",response_cache_capacity" if self._tune_cache
+                       else "")
+            self._log_line(f"sample,fusion_threshold_bytes,cycle_time_s"
+                           f"{cap_col},score_bytes_per_s\n")
             self._log_header_written = True
-        thr, cyc = (2.0 ** p for p in measured)
-        self._log_line(f"{self._sample_no},{int(thr)},{cyc:.6f},"
-                       f"{score:.1f}\n")
+        params = [2.0 ** p for p in measured]
+        cap = f",{int(params[2])}" if self._tune_cache and len(params) >= 3 \
+            else ""
+        self._log_line(f"{self._sample_no},{int(params[0])},"
+                       f"{params[1]:.6f}{cap},{score:.1f}\n")
 
     def _log_line(self, line: str):
         if not self._log_path:

@@ -69,6 +69,34 @@ def _auto_name(prefix: str, name: Optional[str]) -> str:
     return name if name else f"{prefix}.noname.{next(_name_counter)}"
 
 
+def _wire_mode(compression) -> Optional[str]:
+    """Normalize a ``compression=`` argument to an engine wire-dtype mode.
+
+    Accepts ``None``/``"none"`` (off), ``"bf16"``/``"bfloat16"`` and
+    ``"fp16"``/``"float16"``.  The framework bindings map their Compressor
+    classes to these strings themselves (see jax/torch/tensorflow
+    optimizers), so the cast pair fuses INTO the jitted collective program
+    instead of running as separate host/device launches."""
+    if compression is None:
+        return None
+    if hasattr(compression, "wire_mode"):
+        # A Compressor class from any binding (the upstream calling
+        # convention: compression=hvd.Compression.fp16).  Cast-style ones
+        # carry their wire mode; NoneCompressor maps to off.
+        return _wire_mode(compression.wire_mode)
+    if isinstance(compression, str):
+        c = compression.strip().lower()
+        if c in ("", "none"):
+            return None
+        if c in ("fp16", "float16"):
+            return "fp16"
+        if c in ("bf16", "bfloat16"):
+            return "bf16"
+    raise ValueError(
+        f"unsupported compression {compression!r}: expected None, 'none', "
+        f"'fp16', 'bf16', or a Compression.* cast compressor")
+
+
 def per_process_mode() -> bool:
     """True when this process contributes as ONE rank (torovodrun-launched,
     including an elastic world that currently has a single process) rather
@@ -226,14 +254,18 @@ def allreduce_async(tensor, name: Optional[str] = None,
                     op: C.ReduceOp = C.ReduceOp.AVERAGE,
                     prescale_factor: Optional[float] = None,
                     postscale_factor: Optional[float] = None,
-                    process_set: Optional[ProcessSet] = None) -> int:
+                    process_set: Optional[ProcessSet] = None,
+                    compression=None) -> int:
+    """``compression="bf16"``/``"fp16"`` casts floating tensors to the wire
+    dtype inside the fused program (before the reduce) and back after —
+    half the ICI bytes, zero extra launches, result in the input dtype."""
     ps_id = _ps(process_set)
     arr, owned = _as_stacked(tensor, ps_id)
     return _engine().enqueue(
         _auto_name("allreduce", name), CollectiveType.ALLREDUCE,
         arr, reduce_op=op, process_set_id=ps_id,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-        donate=owned)
+        donate=owned, compression=_wire_mode(compression))
 
 
 def _sync_now(handle):
@@ -247,18 +279,22 @@ def allreduce(tensor, name: Optional[str] = None,
               op: C.ReduceOp = C.ReduceOp.AVERAGE,
               prescale_factor: Optional[float] = None,
               postscale_factor: Optional[float] = None,
-              process_set: Optional[ProcessSet] = None):
+              process_set: Optional[ProcessSet] = None,
+              compression=None):
     return _sync_now(allreduce_async(
-        tensor, name, op, prescale_factor, postscale_factor, process_set))
+        tensor, name, op, prescale_factor, postscale_factor, process_set,
+        compression))
 
 
 def grouped_allreduce_async(tensors: Sequence, name: Optional[str] = None,
                             op: C.ReduceOp = C.ReduceOp.AVERAGE,
                             prescale_factor: Optional[float] = None,
                             postscale_factor: Optional[float] = None,
-                            process_set: Optional[ProcessSet] = None) -> List[int]:
+                            process_set: Optional[ProcessSet] = None,
+                            compression=None) -> List[int]:
     """Enqueue a group that fuses/executes atomically (reference: N13)."""
     ps_id = _ps(process_set)
+    comp = _wire_mode(compression)
     gid = next(_group_counter)
     base = _auto_name("grouped_allreduce", name)
     items = []
@@ -268,7 +304,8 @@ def grouped_allreduce_async(tensors: Sequence, name: Optional[str] = None,
             name=f"{base}.{i}", ctype=CollectiveType.ALLREDUCE, tensor=arr,
             reduce_op=op, process_set_id=ps_id,
             prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor, group_id=gid, donate=owned))
+            postscale_factor=postscale_factor, group_id=gid, donate=owned,
+            compression=comp))
     # One atomic push: all members negotiate in the same round on every
     # rank, which both preserves fusion atomicity and lets a negotiation
     # error on one member abort the whole group (reference N13).
@@ -279,9 +316,11 @@ def grouped_allreduce(tensors: Sequence, name: Optional[str] = None,
                       op: C.ReduceOp = C.ReduceOp.AVERAGE,
                       prescale_factor: Optional[float] = None,
                       postscale_factor: Optional[float] = None,
-                      process_set: Optional[ProcessSet] = None):
+                      process_set: Optional[ProcessSet] = None,
+                      compression=None):
     handles = grouped_allreduce_async(
-        tensors, name, op, prescale_factor, postscale_factor, process_set)
+        tensors, name, op, prescale_factor, postscale_factor, process_set,
+        compression)
     _engine().kick()
     return [synchronize(h) for h in handles]
 

@@ -70,6 +70,12 @@ class TensorTableEntry:
     postscale_factor: Optional[float] = None
     group_id: int = -1               # grouped ops execute atomically together
     donate: bool = False             # engine owns the buffer: donate to XLA
+    # Wire-dtype compression fused into the jitted program ("bf16"/"fp16"/
+    # None): cast-down before the collective, cast-up after — halves ICI
+    # bytes with zero extra launches (reference N18's cast kernels, done
+    # the XLA way).  Reduction ops only; part of the fusion key AND the
+    # negotiation digest (divergence would execute mismatched programs).
+    compression: Optional[str] = None
     enqueue_time: float = 0.0
     # filled on completion:
     result: Any = None
@@ -87,7 +93,7 @@ def _fusion_key(e: TensorTableEntry) -> Tuple:
     table N13 semantics).
     """
     return (e.ctype, e.reduce_op, e.root_rank, e.process_set_id,
-            e.prescale_factor, e.postscale_factor)
+            e.prescale_factor, e.postscale_factor, e.compression)
 
 
 class TensorQueue:
@@ -146,13 +152,12 @@ class TensorQueue:
 
 
 class FusedProgramCache:
-    """Compiled fused-collective cache (reference: response_cache.cc N8).
-
-    The reference's response cache turns steady-state negotiation into a
-    bit-vector allreduce; here, the same role — skip per-step planning — is
-    played by caching the jitted fused executable keyed on the *shape
-    signature* of the batch.  Hit == zero Python planning + zero XLA
-    recompile: dispatch cost is one cached-executable launch.
+    """Compiled fused-collective cache (the data-plane half of the steady-
+    state fast path; the control-plane half is the controller's response
+    cache).  Keyed on the *shape signature* of the batch (fusion key +
+    shapes + dtypes + donation + wire compression).  Hit == zero Python
+    planning + zero XLA recompile: dispatch cost is one cached-executable
+    launch.
     """
 
     def __init__(self, capacity: int = 1024):
@@ -160,6 +165,10 @@ class FusedProgramCache:
         self._cache: Dict[Tuple, Callable] = {}
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._cache)
 
     def get_or_build(self, key: Tuple, builder: Callable[[], Callable]) -> Callable:
         fn, _ = self.get_or_build2(key, builder)
@@ -177,10 +186,16 @@ class FusedProgramCache:
             self.misses += 1
             fn = builder()
             while len(self._cache) >= self.capacity:
-                # FIFO eviction; steady-state training has a tiny working set.
+                # LRU eviction (hits reinsert at the end of the dict order):
+                # an A/B-alternating working set one entry over capacity
+                # must not thrash the way FIFO would.
                 self._cache.pop(next(iter(self._cache)))
+                self.evictions += 1
             self._cache[key] = fn
             return fn, False
+        # LRU touch: move to the end of the insertion order.
+        self._cache.pop(key)
+        self._cache[key] = fn
         self.hits += 1
         return fn, True
 
@@ -255,6 +270,13 @@ class CollectiveEngine:
         self._thread: Optional[threading.Thread] = None
         self._cycle_index = 0
         self.controller = None       # multi-process TCP controller (optional)
+        # Control-plane observability: cumulative negotiation wall time and
+        # round count (multi-process mode only — single-controller cycles
+        # have no negotiation).  bench.py derives negotiation_us_per_cycle;
+        # the timeline gets a per-cycle counter track.
+        self.negotiation_us_total = 0.0
+        self.negotiation_cycles = 0
+        self.last_negotiation_us = 0.0
         # XLA:CPU executes collectives via blocking rendezvous on a shared
         # Eigen pool; back-to-back ASYNC launches can starve a participant
         # thread and abort the process ("Expected N threads to join the
@@ -301,12 +323,12 @@ class CollectiveEngine:
                 reduce_op=C.ReduceOp.AVERAGE, root_rank: int = 0,
                 process_set_id: int = 0, prescale_factor=None,
                 postscale_factor=None, group_id: int = -1,
-                donate: bool = False) -> int:
+                donate: bool = False, compression: Optional[str] = None) -> int:
         return self.enqueue_group([dict(
             name=name, ctype=ctype, tensor=tensor, reduce_op=reduce_op,
             root_rank=root_rank, process_set_id=process_set_id,
             prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-            group_id=group_id, donate=donate)])[0]
+            group_id=group_id, donate=donate, compression=compression)])[0]
 
     def enqueue_group(self, items: Sequence[dict]) -> List[int]:
         """Enqueue several entries atomically w.r.t. the drain — a cycle
@@ -464,7 +486,19 @@ class CollectiveEngine:
         not_ready: List[TensorTableEntry] = []
         if self.controller is not None:
             self.controller.synthesizer = self._synthesize_join_entry
+            t0 = time.perf_counter()
             ready, errored = self.controller.negotiate(entries)
+            dt_us = (time.perf_counter() - t0) * 1e6
+            self.negotiation_us_total += dt_us
+            self.negotiation_cycles += 1
+            self.last_negotiation_us = dt_us
+            tl0 = self._state.timeline
+            if tl0 is not None and tl0.enabled:
+                st = self.controller.cache_stats
+                tl0.counter("negotiation", {
+                    "us": round(dt_us, 1), "cache_hits": st.hits,
+                    "cache_misses": st.misses,
+                    "cache_invalidations": st.invalidations})
             # Per-tensor negotiation failures (shape/dtype divergence across
             # ranks): fail ONLY those waiters; the runtime stays up
             # (reference: per-tensor error Responses, SURVEY.md N2).
@@ -618,6 +652,12 @@ class CollectiveEngine:
         root = int(parts[4])
         pre = None if parts[5] == "None" else float(parts[5])
         post = None if parts[6] == "None" else float(parts[6])
+        comp = None
+        if len(parts) > 7 and parts[7] in ("bf16", "fp16"):
+            # parts[7] is the wire-compression slot ("none" when off); the
+            # server may append the sanitizer tag after it — trailing
+            # parts stay ignored as before.
+            comp = parts[7]
         ps = self._state.process_set_table.get(0)
         sharding = NamedSharding(ps.mesh, P(ps.axis_name))
         local_devs = [d for d in ps.mesh.devices.flat
@@ -630,7 +670,8 @@ class CollectiveEngine:
         e = TensorTableEntry(
             handle=handle, name=name, ctype=ctype, tensor=arr, reduce_op=op,
             root_rank=root, prescale_factor=pre, postscale_factor=post,
-            group_id=group_id, donate=True, enqueue_time=now)
+            group_id=group_id, donate=True, compression=comp,
+            enqueue_time=now)
         if self.sanitizer is not None:
             self.sanitizer.observe_synthesized(e)
         return e
@@ -747,13 +788,29 @@ class CollectiveEngine:
         reduce per distinct dtype — XLA's collective combiner merges them
         into a single wire transfer, keeping mixed-dtype groups atomic
         without promotion), apply pre/post scaling around ``reduce_flat``,
-        and slice results back out."""
+        and slice results back out.
+
+        Wire compression (``proto.compression``): floating dtype groups are
+        cast down to the wire dtype right before ``reduce_flat`` and cast
+        back up right after, INSIDE the jitted program — XLA fuses both
+        casts into the collective's producer/consumer, so the bytes over
+        ICI halve with zero extra launches.  Prescale happens in the
+        original dtype (before the down-cast) and postscale after the
+        up-cast, keeping the lossy window as narrow as possible."""
         pre, post = proto.prescale_factor, proto.postscale_factor
+        wire = {"bf16": jnp.bfloat16, "fp16": jnp.float16}.get(
+            proto.compression)
         per_rank_shapes = [s[1:] for s in shapes]
         sizes = [int(np.prod(s)) if s else 1 for s in per_rank_shapes]
         dtype_groups: Dict[str, List[int]] = {}
         for i, dt in enumerate(dtypes):
             dtype_groups.setdefault(dt, []).append(i)
+
+        def reduce_wire(flat):
+            if (wire is not None and flat.dtype != wire
+                    and jnp.issubdtype(flat.dtype, jnp.floating)):
+                return reduce_flat(flat.astype(wire)).astype(flat.dtype)
+            return reduce_flat(flat)
 
         def per_shard(*xs):
             # xs: per-rank values, each [*S] — flatten, fuse per dtype.
@@ -761,7 +818,7 @@ class CollectiveEngine:
             for dt, idxs in dtype_groups.items():
                 flat = jnp.concatenate([xs[i].reshape(-1) for i in idxs]) \
                     if len(idxs) > 1 else xs[idxs[0]].reshape(-1)
-                red = C._scale(reduce_flat(C._scale(flat, pre)), post)
+                red = C._scale(reduce_wire(C._scale(flat, pre)), post)
                 off = 0
                 for i in idxs:
                     outs[i] = red[off:off + sizes[i]].reshape(per_rank_shapes[i])

@@ -59,16 +59,48 @@ def shard_params(params, param_specs, mesh: Mesh):
 
 def make_sharded_train_step(step_fn: Callable, mesh: Mesh,
                             param_specs, opt_state_specs,
-                            data_spec) -> Callable:
+                            data_spec, check=False) -> Callable:
     """Compile ``step_fn(params, opt_state, tokens, targets)`` over the mesh.
 
     ``step_fn`` is per-shard (explicit collectives inside); in/out specs:
     params+opt_state per their spec trees, data per ``data_spec``, loss
     replicated.
+
+    ``check=True`` runs :func:`analysis.trace_check.check_step_fn` over the
+    step at trace time (the first call, abstractly — nothing executes) and
+    logs any HVD2xx findings; ``check="strict"`` raises on error findings
+    instead.  This is the jaxpr twin of the optimizers' ``check=`` lint
+    hook: unknown axes, bad ``axis_index_groups``, non-bijective ppermute
+    perms and host callbacks are caught before the program ever reaches a
+    pod, where they would deadlock instead of erroring.
     """
     sharded = shard_map(
         step_fn, mesh=mesh,
         in_specs=(param_specs, opt_state_specs, data_spec, data_spec),
         out_specs=(param_specs, opt_state_specs, P()),
         check_vma=False)
-    return jax.jit(sharded, donate_argnums=(0, 1))
+    jitted = jax.jit(sharded, donate_argnums=(0, 1))
+    if not check:
+        return jitted
+
+    from ..analysis import trace_check
+    from ..utils.logging import get_logger
+    checked = []
+
+    def checking_step(params, opt_state, tokens, targets):
+        if not checked:
+            checked.append(True)
+            report = trace_check.check_step_fn(
+                sharded, params, opt_state, tokens, targets, mesh=mesh,
+                path="<make_sharded_train_step>")
+            errors = [f for f in report.findings if f.is_error]
+            if errors and check == "strict":
+                raise RuntimeError(
+                    "make_sharded_train_step(check='strict'): the traced "
+                    "step failed the collective audit:\n"
+                    + "\n".join(f.render() for f in errors))
+            for f in report.findings:
+                get_logger().warning("trace check: %s", f.render())
+        return jitted(params, opt_state, tokens, targets)
+
+    return checking_step

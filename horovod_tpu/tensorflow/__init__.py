@@ -41,8 +41,19 @@ from .mpi_ops import (  # noqa: F401
 
 
 def _reduce_numpy_list(arrays, name, op, compression, process_set):
-    """Shared eager core: compress → ONE grouped allreduce → decompress."""
+    """Shared eager core: compress → ONE grouped allreduce → decompress.
+
+    Cast-style compressors (fp16/bf16) skip the host-side cast pair: the
+    engine fuses the wire-dtype casts into the jitted collective program,
+    and results come back in the inputs' own dtype."""
     from .mpi_ops import _submit
+    wire = getattr(compression, "wire_mode", None)
+    if wire is not None:
+        outs = eager.grouped_allreduce(
+            [_submit(a, process_set) for a in arrays], name=name, op=op,
+            process_set=process_set, compression=wire)
+        return [np.asarray(eager.to_local(o)).reshape(a.shape)
+                .astype(a.dtype) for o, a in zip(outs, arrays)]
     comp = [compression.compress(a) for a in arrays]
     outs = eager.grouped_allreduce(
         [_submit(c, process_set) for c, _ in comp], name=name, op=op,

@@ -16,6 +16,11 @@ import ml_dtypes
 
 
 class Compressor:
+    # Cast-style compressors set wire_mode ("bf16"/"fp16") so the binding
+    # routes them through the engine's fused wire compression (see
+    # jax/compression.py); custom compressors keep the explicit hooks.
+    wire_mode = None
+
     @staticmethod
     def compress(a: np.ndarray):
         raise NotImplementedError
@@ -51,10 +56,12 @@ class _CastCompressor(Compressor):
 
 class FP16Compressor(_CastCompressor):
     wire_dtype = np.dtype(np.float16)
+    wire_mode = "fp16"
 
 
 class BF16Compressor(_CastCompressor):
     wire_dtype = np.dtype(ml_dtypes.bfloat16)
+    wire_mode = "bf16"
 
 
 class Compression:

@@ -13,6 +13,11 @@ import torch
 
 
 class Compressor:
+    # Cast-style compressors set wire_mode ("bf16"/"fp16") so the optimizer
+    # routes them through the engine's fused wire compression (see
+    # jax/compression.py); custom compressors keep the explicit hooks.
+    wire_mode = None
+
     @staticmethod
     def compress(tensor: torch.Tensor):
         raise NotImplementedError
@@ -33,6 +38,8 @@ class NoneCompressor(Compressor):
 
 
 class FP16Compressor(Compressor):
+    wire_mode = "fp16"
+
     @staticmethod
     def compress(tensor: torch.Tensor):
         if tensor.dtype.is_floating_point:
@@ -45,6 +52,8 @@ class FP16Compressor(Compressor):
 
 
 class BF16Compressor(Compressor):
+    wire_mode = "bf16"
+
     @staticmethod
     def compress(tensor: torch.Tensor):
         if tensor.dtype.is_floating_point:

@@ -171,11 +171,15 @@ def allreduce_async(tensor: torch.Tensor, name: Optional[str] = None,
                     op: ReduceOp = Average,
                     prescale_factor: Optional[float] = None,
                     postscale_factor: Optional[float] = None,
-                    process_set: Optional[ProcessSet] = None) -> int:
+                    process_set: Optional[ProcessSet] = None,
+                    compression: Optional[str] = None) -> int:
+    """``compression="bf16"``/``"fp16"``: wire-dtype cast fused into the
+    engine's collective program; the result returns in the input dtype."""
     inner = eager.allreduce_async(_submit(tensor, process_set), name=name, op=op,
                                   prescale_factor=prescale_factor,
                                   postscale_factor=postscale_factor,
-                                  process_set=process_set)
+                                  process_set=process_set,
+                                  compression=compression)
     return _register(inner, tensor)
 
 
@@ -183,9 +187,11 @@ def allreduce(tensor: torch.Tensor, name: Optional[str] = None,
               op: ReduceOp = Average,
               prescale_factor: Optional[float] = None,
               postscale_factor: Optional[float] = None,
-              process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+              process_set: Optional[ProcessSet] = None,
+              compression: Optional[str] = None) -> torch.Tensor:
     return synchronize(allreduce_async(tensor, name, op, prescale_factor,
-                                       postscale_factor, process_set))
+                                       postscale_factor, process_set,
+                                       compression))
 
 
 def allreduce_async_(tensor: torch.Tensor, name: Optional[str] = None,

@@ -136,6 +136,18 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 prescale = (prescale or 1.0) / self.backward_passes_per_step
         else:
             wire_op = self.op
+        # Cast-style compressors (wire_mode attr) ride the fused wire-
+        # compression path: the cast pair lives inside the jitted
+        # collective program, the result comes back in the gradient's
+        # dtype (ctx None → decompress is the identity).  Custom
+        # compressors keep the explicit compress/decompress hooks.
+        wire = getattr(self._compression, "wire_mode", None)
+        if wire is not None:
+            handle = mpi_ops.allreduce_async(
+                tensor, name=f"allreduce.{name}", op=wire_op,
+                prescale_factor=prescale, postscale_factor=postscale,
+                process_set=self.process_set, compression=wire)
+            return handle, None
         tensor_compressed, ctx = self._compression.compress(tensor)
         handle = mpi_ops.allreduce_async(
             tensor_compressed, name=f"allreduce.{name}", op=wire_op,

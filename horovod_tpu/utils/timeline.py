@@ -84,6 +84,15 @@ class Timeline:
         self._emit({"name": name, "ph": "i", "pid": 0, "tid": 0,
                     "ts": self._now_us(), "s": "g", "args": args or {}})
 
+    def counter(self, name: str, values: dict):
+        """Chrome-trace counter track (ph="C"): per-cycle scalar series —
+        negotiation microseconds, response-cache hit/miss/invalidation
+        counts — rendered as stacked area lanes in Perfetto."""
+        if self._fh is None:
+            return
+        self._emit({"name": name, "ph": "C", "pid": 0,
+                    "ts": self._now_us(), "args": values})
+
     def mark_cycle(self, cycle_index: int):
         if self._fh is None or not self._mark_cycles:
             return

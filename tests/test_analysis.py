@@ -496,18 +496,22 @@ def test_sanitizer_ledger_is_bounded():
     assert s.tail(2)[-1].seq == 9  # seq keeps counting past eviction
 
 
-def test_controller_digest_carries_sanitizer_tag():
+def test_controller_digest_is_step_invariant_tag_rides_beside():
+    """Since the response-cache fast path, the sanitizer tag no longer
+    rides INSIDE the digest (that would churn the slot key every step): the
+    digest stays step-invariant and the tag travels in the announce's
+    separate field — the server folds it back into its effective-digest
+    comparison (csrc/coordinator.cc), so divergence detection is
+    unchanged, now also on the cached/bitvector path
+    (tests/test_response_cache.py)."""
     from horovod_tpu.common.controller import TCPController
 
     e = _FakeEntry("t")
     base = TCPController._digest(e)
     e.sanitizer_tag = "seq=3;site=train.py:17"
-    tagged = TCPController._digest(e)
-    assert tagged == base + "|seq=3;site=train.py:17"
-    # Divergent call sites → divergent digests (what negotiation compares).
-    e2 = _FakeEntry("t")
-    e2.sanitizer_tag = "seq=3;site=train.py:99"
-    assert TCPController._digest(e2) != tagged
+    assert TCPController._digest(e) == base  # tag NOT in the slot key
+    # negotiate() sends the tag as the announce's 6th field; the server
+    # compares digest + "|" + tag — same mismatch semantics as before.
 
 
 def test_sanitizer_disabled_by_default(monkeypatch):
@@ -627,3 +631,156 @@ def test_distributed_optimizer_check_hook(hvd, tmp_path):
     params = {"w": np.zeros(3, np.float32)}
     state = opt.init(params)
     assert state is not None
+
+
+# ================================================== HVD204: ppermute perms
+def test_hvd204_clean_on_full_ring():
+    import jax.numpy as jnp
+    from jax import lax
+    from horovod_tpu.analysis.trace_check import check_step_fn
+
+    def step(x):
+        return lax.ppermute(x, "dp", perm=[(i, (i + 1) % 8)
+                                           for i in range(8)])
+
+    report = check_step_fn(step, jnp.zeros((4,)), axis_sizes={"dp": 8})
+    assert not any(f.rule == "HVD204" for f in report.findings), \
+        [f.render() for f in report.findings]
+    assert any(r.primitive == "ppermute" for r in report.ledger)
+
+
+def test_hvd204_fires_on_duplicate_destination():
+    import jax.numpy as jnp
+    from jax import lax
+    from horovod_tpu.analysis.trace_check import check_step_fn
+
+    def step(x):
+        # ranks 0 and 1 both send to 0; rank 1 never receives.
+        return lax.ppermute(x, "dp", perm=[(0, 0), (1, 0)])
+
+    report = check_step_fn(step, jnp.zeros((4,)), axis_sizes={"dp": 2})
+    f204 = [f for f in report.findings if f.rule == "HVD204"]
+    assert f204 and f204[0].is_error
+    assert "receive more than once" in f204[0].message
+
+
+def test_hvd204_fires_on_out_of_range_rank():
+    import jax.numpy as jnp
+    from jax import lax
+    from horovod_tpu.analysis.trace_check import check_step_fn
+
+    def step(x):
+        return lax.ppermute(x, "dp", perm=[(0, 1), (1, 7)])  # axis size 2
+
+    report = check_step_fn(step, jnp.zeros((4,)), axis_sizes={"dp": 2})
+    f204 = [f for f in report.findings if f.rule == "HVD204"]
+    assert f204 and "outside" in f204[0].message
+
+
+def test_hvd204_fires_on_uncovered_ranks():
+    import jax.numpy as jnp
+    from jax import lax
+    from horovod_tpu.analysis.trace_check import check_step_fn
+
+    def step(x):
+        # Partial shift: rank 7 never sends, rank 0 never receives — a
+        # multi-host launch deadlocks exactly like bad axis_index_groups.
+        return lax.ppermute(x, "dp", perm=[(i, i + 1) for i in range(7)])
+
+    report = check_step_fn(step, jnp.zeros((4,)), axis_sizes={"dp": 8})
+    f204 = [f for f in report.findings if f.rule == "HVD204"]
+    assert f204 and "[7]" in f204[0].message
+    # Partial perms are valid (zero-fill) JAX — flagged, but not an error,
+    # so check="strict" never rejects a correct non-wrapping shift.
+    assert not f204[0].is_error
+
+
+def test_repo_ring_and_pipeline_perms_are_bijective(world_size):
+    """The repo's own ppermute users (pipeline ring, adasum VHDD) must lint
+    clean under HVD204 — they are full bijections by construction."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from horovod_tpu.compat import shard_map
+    from horovod_tpu.analysis.trace_check import check_step_fn
+    from horovod_tpu.parallel.pipeline import pipeline_apply
+
+    mesh = Mesh(np.array(jax.devices()), ("pp",))
+
+    def body(xs):
+        return pipeline_apply(lambda p, x: x * 2.0, jnp.zeros(()), xs,
+                              axis_name="pp")
+
+    step = shard_map(body, mesh=mesh, in_specs=P(None, "pp"),
+                     out_specs=P(None, "pp"), check_vma=False)
+    report = check_step_fn(
+        step, jnp.zeros((4, world_size, 2)), mesh=mesh)
+    assert not any(f.rule == "HVD204" for f in report.findings), \
+        [f.render() for f in report.findings]
+
+
+# ============================================= spmd check= trace-time audit
+def _toy_spmd_pieces(world_size, bad_perm=False):
+    import jax
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("hvd",))
+
+    def step(params, opt_state, tokens, targets):
+        g = lax.psum(jnp.mean((tokens - params) ** 2), "hvd")
+        if bad_perm:
+            tokens = lax.ppermute(tokens, "hvd", perm=[(0, 0), (1, 0)])
+        loss = g + jnp.sum(tokens * 0.0) + jnp.sum(targets * 0.0)
+        return params - 0.1 * g, opt_state, loss
+
+    import jax.numpy as jnp  # noqa: F401 - used in step closure
+    params = jax.device_put(np.zeros((), np.float32))
+    opt_state = jax.device_put(np.zeros((), np.float32))
+    data = np.ones((world_size, 2), np.float32)
+    return mesh, step, params, opt_state, data
+
+
+def test_spmd_check_true_runs_clean_step(world_size):
+    import jax.numpy as jnp  # noqa: F401
+    from horovod_tpu.parallel import spmd
+    from jax.sharding import PartitionSpec as P
+
+    mesh, step, params, opt_state, data = _toy_spmd_pieces(world_size)
+    fn = spmd.make_sharded_train_step(step, mesh, P(), P(), P("hvd"),
+                                      check=True)
+    p, o, loss = fn(params, opt_state, data, data)
+    assert float(loss) == float(loss)  # ran, finite-path
+
+
+def test_spmd_check_strict_raises_on_bad_ppermute(world_size):
+    import jax.numpy as jnp  # noqa: F401
+    from horovod_tpu.parallel import spmd
+    from jax.sharding import PartitionSpec as P
+
+    if world_size < 2:
+        pytest.skip("needs >= 2 devices")
+    mesh, step, params, opt_state, data = _toy_spmd_pieces(world_size,
+                                                           bad_perm=True)
+    fn = spmd.make_sharded_train_step(step, mesh, P(), P(), P("hvd"),
+                                      check="strict")
+    with pytest.raises(RuntimeError, match="HVD204"):
+        fn(params, opt_state, data, data)
+
+
+def test_hvd204_clean_on_multi_axis_ring():
+    """Ranks in a multi-axis ppermute index the axes' flattened product:
+    a full 4-ring over a 2x2 ('a','b') mesh must not be flagged."""
+    import jax.numpy as jnp
+    from jax import lax
+    from horovod_tpu.analysis.trace_check import check_step_fn
+
+    def step(x):
+        return lax.ppermute(x, ("a", "b"),
+                            perm=[(i, (i + 1) % 4) for i in range(4)])
+
+    report = check_step_fn(step, jnp.zeros((4,)),
+                           axis_sizes={"a": 2, "b": 2})
+    assert not any(f.rule == "HVD204" for f in report.findings), \
+        [f.render() for f in report.findings]

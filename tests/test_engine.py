@@ -388,3 +388,127 @@ class TestAdasumEngine:
         np.testing.assert_allclose(np.asarray(out).reshape(-1),
                                    expected.reshape(-1),
                                    rtol=1e-4, atol=1e-5)
+
+
+# ============================================== steady-state fast path (PR 2)
+def test_fused_program_cache_lru_eviction():
+    """LRU, not FIFO: a hit refreshes an entry's recency, so an A/B working
+    set one entry over capacity evicts the stale key, not the hot one."""
+    from horovod_tpu.ops.engine import FusedProgramCache
+
+    c = FusedProgramCache(capacity=2)
+    assert c.get_or_build(("A",), lambda: "fa") == "fa"
+    assert c.get_or_build(("B",), lambda: "fb") == "fb"
+    assert c.get_or_build(("A",), lambda: "WRONG") == "fa"   # hit: A is MRU
+    assert c.get_or_build(("C",), lambda: "fc") == "fc"      # evicts B (LRU)
+    assert c.evictions == 1
+    assert c.get_or_build(("A",), lambda: "WRONG") == "fa"   # survived
+    misses0 = c.misses
+    assert c.get_or_build(("B",), lambda: "fb2") == "fb2"    # B was evicted
+    assert c.misses == misses0 + 1
+    assert len(c) == 2
+
+
+def test_tensor_queue_requeue_ordering_under_interleaved_push():
+    """Requeued (drained-but-not-ready) entries must come back BEFORE pushes
+    that landed while they were out: negotiation order across cycles stays
+    the submission order, which every rank's batching depends on."""
+    from horovod_tpu.ops.engine import (CollectiveType, TensorQueue,
+                                        TensorTableEntry)
+
+    def mk(name, h):
+        return TensorTableEntry(handle=h, name=name,
+                                ctype=CollectiveType.BARRIER, tensor=None)
+
+    q = TensorQueue()
+    a, b = mk("a", 1), mk("b", 2)
+    q.push_many([a, b])
+    assert [e.name for e in q.drain()] == ["a", "b"]
+    q.push(mk("c", 3))                   # lands while a, b are in flight
+    q.requeue([a, b])
+    assert [e.name for e in q.drain()] == ["a", "b", "c"]
+    # Names of requeued entries stay registered: resubmission is rejected
+    # until mark_done, exactly like a still-pending entry.
+    q.requeue([a])
+    with pytest.raises(ValueError):
+        q.push(mk("a", 9))
+    assert [e.name for e in q.drain()] == ["a"]
+    q.mark_done(a)
+    q.push(mk("a", 10))                  # completed name is reusable
+    assert [e.name for e in q.drain()] == ["a"]
+    assert q.pending_count() == 0
+
+
+def test_allreduce_wire_compression_matches_fp32(hvd, world_size):
+    """compression="bf16"/"fp16" halves the wire dtype INSIDE the fused
+    program: result matches the fp32 reduce within cast tolerance, comes
+    back as fp32, and the compressed program caches separately and is
+    reused across steps."""
+    from horovod_tpu.common import basics
+
+    eng = basics._get_state().engine
+    x = _stacked(hvd, world_size, shape=(257,), seed=31)
+    base = np.asarray(hvd.allreduce(x, name="wc32", op=hvd.Sum))
+    for mode, tol in (("bf16", 3e-2), ("fp16", 5e-3)):
+        out = np.asarray(hvd.allreduce(x, name=f"wc_{mode}", op=hvd.Sum,
+                                       compression=mode))
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(out, base, rtol=tol, atol=tol)
+        # And NOT bit-identical: the wire cast must actually have happened.
+        assert not np.array_equal(out, base), mode
+    # Program reuse: a second compressed submission with the same shape
+    # signature must be a cache hit (single cached program).
+    misses0, hits0 = eng.cache.misses, eng.cache.hits
+    out2 = np.asarray(hvd.allreduce(x, name="wc_bf16_2", op=hvd.Sum,
+                                    compression="bf16"))
+    assert eng.cache.misses == misses0 and eng.cache.hits == hits0 + 1
+    np.testing.assert_allclose(out2, base, rtol=3e-2, atol=3e-2)
+
+
+def test_grouped_wire_compression_mixed_dtypes(hvd, world_size):
+    """Wire compression only touches floating leaves: an int32 member of
+    the same atomic group reduces exactly."""
+    a = _stacked(hvd, world_size, shape=(16,), seed=32)
+    b = hvd.stack_per_rank(
+        [np.full((8,), r + 1, np.int32) for r in range(world_size)])
+    outs = hvd.grouped_allreduce([a, b], name="wcg", op=hvd.Sum,
+                                 compression="bf16")
+    np.testing.assert_allclose(np.asarray(outs[0]), np.sum(np.asarray(a), 0),
+                               rtol=3e-2, atol=3e-2)
+    np.testing.assert_array_equal(
+        np.asarray(outs[1]),
+        np.full((8,), sum(range(1, world_size + 1)), np.int32))
+
+
+def test_wire_compression_average_and_scale(hvd, world_size):
+    """AVERAGE + pre/postscale compose with the wire cast (prescale in the
+    original dtype, cast, reduce, cast up, postscale)."""
+    x = _stacked(hvd, world_size, shape=(64,), seed=33)
+    base = np.asarray(hvd.allreduce(x, name="was32", prescale_factor=0.5,
+                                    postscale_factor=2.0))
+    out = np.asarray(hvd.allreduce(x, name="was_c", prescale_factor=0.5,
+                                   postscale_factor=2.0,
+                                   compression="bf16"))
+    np.testing.assert_allclose(out, base, rtol=3e-2, atol=3e-2)
+
+
+def test_wire_compression_rejects_unknown_mode(hvd, world_size):
+    x = _stacked(hvd, world_size)
+    with pytest.raises(ValueError, match="compression"):
+        hvd.allreduce(x, name="wbad", compression="int8")
+
+
+def test_wire_compression_accepts_compressor_classes(hvd, world_size):
+    """Upstream calling convention: compression=Compression.fp16 (a class)
+    routes through the fused wire path via its wire_mode attribute."""
+    from horovod_tpu.jax.compression import Compression
+
+    x = _stacked(hvd, world_size, shape=(32,), seed=41)
+    base = np.asarray(hvd.allreduce(x, name="cc32", op=hvd.Sum))
+    out = np.asarray(hvd.allreduce(x, name="cc_cls", op=hvd.Sum,
+                                   compression=Compression.fp16))
+    np.testing.assert_allclose(out, base, rtol=3e-2, atol=3e-2)
+    # NoneCompressor maps to off (exact).
+    out2 = np.asarray(hvd.allreduce(x, name="cc_none", op=hvd.Sum,
+                                    compression=Compression.none))
+    np.testing.assert_array_equal(out2, base)

@@ -469,3 +469,30 @@ def test_torovodrun_estimator_sharded_training(tmp_path):
     assert res.returncode == 0 and ok == 2, (
         f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
         f"stderr:\n{res.stderr[-3000:]}")
+
+
+WORKER_CACHE = os.path.join(REPO, "tests", "data", "worker_cache.py")
+
+
+def test_torovodrun_response_cache_steady_state():
+    """PR 2 acceptance: after warm-up, steady-state cycles exchange only
+    the bitvector frame (frame-count assertion inside the worker), a shape
+    change falls back to full negotiation on all ranks, and bf16-wire
+    allreduce matches fp32 while reusing one cached program."""
+    res = _run_torovodrun(2, WORKER_CACHE, timeout=300)
+    ok = res.stdout.count("CACHE_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
+def test_torovodrun_sanitizer_catches_divergence_on_cached_path():
+    """PR 2 acceptance: HVD_TPU_SANITIZER=1 still catches divergent
+    submission order when both ranks are on the cached/bitvector path (the
+    worker asserts zero full announces during the divergent cycle)."""
+    res = _run_torovodrun(2, WORKER_CACHE, timeout=300,
+                          extra_env={"HVD_TPU_SANITIZER": "1"})
+    ok = res.stdout.count("CACHE_SANITIZER_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")

@@ -1,0 +1,259 @@
+"""Negotiation response-cache fast path (tier-1 regression guards).
+
+Server + two client threads, no jax: after warm-up, steady-state cycles
+must exchange ONLY the fixed-size bitvector frame — zero per-tensor
+metadata.  A future refactor that silently reverts the controller to full
+negotiation fails these assertions.  Also covered: every invalidation path
+(shape change, ``forget()``, coordinated eviction), capacity-0 disable,
+and the sanitizer tag side-channel catching order divergence while both
+ranks stay on the cached path.
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.controller import TCPController
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class E:
+    """Minimal negotiable entry (the controller only getattr-probes it)."""
+
+    def __init__(self, name, shape=(4,), gid=-1, tag=None):
+        self.name = name
+        self.tensor = np.zeros((2,) + tuple(shape), np.float32)
+        self.group_id = gid
+        if tag is not None:
+            self.sanitizer_tag = tag
+
+
+def _pair(fn, cache_capacity=2048):
+    """Run ``fn(ctl, rank)`` on two connected controller clients (rank 0
+    hosts the server and keeps it alive until rank 1 finishes)."""
+    port = _free_port()
+    results, errors = {}, {}
+    peer_done = threading.Event()
+
+    def worker(rank):
+        ctl = TCPController("127.0.0.1", port, rank=rank, world=2,
+                            stall_warn_s=60.0,
+                            cache_capacity=cache_capacity)
+        try:
+            results[rank] = fn(ctl, rank)
+        except Exception as exc:  # noqa: BLE001 - surfaced by the assert
+            errors[rank] = exc
+        finally:
+            if rank == 1:
+                peer_done.set()
+                ctl.shutdown()
+            else:
+                peer_done.wait(timeout=20)
+                ctl.shutdown()
+
+    t1 = threading.Thread(target=worker, args=(1,), daemon=True)
+    t1.start()
+    worker(0)
+    t1.join(timeout=20)
+    assert not errors, errors
+    assert set(results) == {0, 1}, results
+    return results
+
+
+def _steps(ctl, make_entries, n_steps, max_rounds=20):
+    """Drive ``n_steps`` submit->negotiate-until-ready cycles.  Both ranks
+    announce everything in their first round of a step, so verdicts land in
+    one lock-step round and the per-rank round counts always match."""
+    orders = []
+    for _ in range(n_steps):
+        entries = list(make_entries())
+        got = []
+        for _round in range(max_rounds):
+            if not entries:
+                break
+            ready, errs = ctl.negotiate(entries)
+            assert not errs, errs
+            got += [e.name for e in ready]
+            entries = [e for e in entries if e.name not in set(got)]
+        assert not entries, f"never became ready: {[e.name for e in entries]}"
+        orders.append(tuple(got))
+    return orders
+
+
+# --------------------------------------------------------------- fast path
+def test_steady_state_exchanges_no_per_tensor_metadata():
+    """THE regression guard: after warm-up, N steady-state cycles send zero
+    full (per-tensor metadata) announces — only bitvector frames — and the
+    per-cycle request stays a fixed handful of bytes regardless of names."""
+    names = [f"grad.{i}.block.with.a.long.parameter.path" for i in range(12)]
+
+    def fn(ctl, rank):
+        mk = lambda: [E(n) for n in names]           # noqa: E731
+        _steps(ctl, mk, 2)                           # warm-up: learn slots
+        st = ctl.cache_stats
+        full_before = st.full_announces
+        bytes_before = ctl.bytes_sent
+        orders = _steps(ctl, mk, 5)
+        assert st.full_announces == full_before, (
+            "steady-state cycles sent per-tensor metadata frames")
+        assert st.bit_announces >= 5 * len(names)
+        # 4B n_full + 4B bv_len + 2B bitvec + 4B n_tag per cycle.
+        per_cycle = (ctl.bytes_sent - bytes_before) / 5
+        assert per_cycle <= 16, per_cycle
+        assert st.hit_rate() > 0.5
+        return orders
+
+    res = _pair(fn)
+    # Verdict order identical across ranks every steady cycle.
+    assert res[0] == res[1]
+
+
+def test_cold_path_learns_then_hits():
+    def fn(ctl, rank):
+        mk = lambda: [E("t", (4,))]                  # noqa: E731
+        _steps(ctl, mk, 1)
+        st = ctl.cache_stats
+        assert st.misses == 1 and st.hits == 0
+        _steps(ctl, mk, 3)
+        assert st.misses == 1 and st.hits == 3
+        return True
+
+    _pair(fn)
+
+
+# ------------------------------------------------------------ invalidation
+def test_shape_change_falls_back_to_full_negotiation():
+    """A new digest (shape change) misses the cache on every rank, rides a
+    full announce, errors nowhere, and the new tuple re-caches."""
+
+    def fn(ctl, rank):
+        _steps(ctl, lambda: [E("t", (4,))], 2)
+        st = ctl.cache_stats
+        f0 = st.full_announces
+        _steps(ctl, lambda: [E("t", (8,))], 1)       # miss -> full
+        assert st.full_announces == f0 + 1
+        b0 = st.bit_announces
+        _steps(ctl, lambda: [E("t", (8,))], 2)       # relearned -> bits
+        assert st.full_announces == f0 + 1
+        assert st.bit_announces == b0 + 2
+        return True
+
+    _pair(fn)
+
+
+def test_forget_invalidates_slot():
+    def fn(ctl, rank):
+        _steps(ctl, lambda: [E("t")], 2)
+        st = ctl.cache_stats
+        inv0, f0 = st.invalidations, st.full_announces
+        ctl.forget(E("t"))
+        assert st.invalidations == inv0 + 1
+        _steps(ctl, lambda: [E("t")], 1)             # renegotiates in full
+        assert st.full_announces == f0 + 1
+        _steps(ctl, lambda: [E("t")], 1)             # ...and re-caches
+        assert st.full_announces == f0 + 1
+        return True
+
+    _pair(fn)
+
+
+def test_eviction_is_coordinated_across_ranks():
+    """Server capacity 4, working set A then B: assigning B's slots evicts
+    A's; the eviction broadcast drops them from EVERY client's table in the
+    same round, so A renegotiates in full everywhere — no divergence, no
+    hang."""
+    A = [f"a.{i}" for i in range(4)]
+    B = [f"b.{i}" for i in range(4)]
+
+    def fn(ctl, rank):
+        oA = _steps(ctl, lambda: [E(n) for n in A], 2)
+        st = ctl.cache_stats
+        assert st.bit_announces >= 4
+        ev0 = st.evictions
+        oB = _steps(ctl, lambda: [E(n) for n in B], 2)
+        assert st.evictions >= ev0 + 4, "A's slots were not evicted"
+        f0 = st.full_announces
+        oA2 = _steps(ctl, lambda: [E(n) for n in A], 2)
+        assert st.full_announces > f0  # relearned from scratch
+        return (oA, oB, oA2)
+
+    res = _pair(fn, cache_capacity=4)
+    assert res[0] == res[1]
+
+
+def test_capacity_zero_disables_fast_path():
+    def fn(ctl, rank):
+        _steps(ctl, lambda: [E("t")], 3)
+        st = ctl.cache_stats
+        assert st.bit_announces == 0 and st.hits == 0
+        assert st.full_announces == 3
+        return True
+
+    _pair(fn, cache_capacity=0)
+
+
+# --------------------------------------------------------------- sanitizer
+def test_sanitizer_catches_divergence_on_cached_path():
+    """The sanitizer tag rides the sparse side-channel next to the
+    bitvector: both ranks stay on the cached path (zero full announces in
+    the divergent cycle) AND swapped submission order still fails fast with
+    call-site attribution."""
+
+    def mk(tag_a, tag_b):
+        return [E("a", tag=tag_a), E("b", tag=tag_b)]
+
+    def fn(ctl, rank):
+        _steps(ctl, lambda: mk("seq=0:0;site=train.py:10",
+                               "seq=0:1;site=train.py:11"), 1)
+        _steps(ctl, lambda: mk("seq=0:2;site=train.py:10",
+                               "seq=0:3;site=train.py:11"), 1)
+        st = ctl.cache_stats
+        f0 = st.full_announces
+        # Divergence: rank 1 submits b before a (seq/site tags swap).
+        if rank == 0:
+            entries = mk("seq=0:4;site=train.py:10",
+                         "seq=0:5;site=train.py:11")
+        else:
+            entries = mk("seq=0:5;site=eval.py:77",
+                         "seq=0:4;site=eval.py:76")
+        errs = []
+        for _round in range(6):
+            ready, errored = ctl.negotiate(entries)
+            entries = []
+            errs += errored
+            if len(errs) >= 2:
+                break
+        assert len(errs) == 2, errs
+        msgs = " ".join(m for _e, m in errs)
+        assert "ranks [0]" in msgs and "ranks [1]" in msgs, msgs
+        assert "site=" in msgs, msgs
+        assert st.full_announces == f0, (
+            "divergence check fell off the cached path")
+        return True
+
+    _pair(fn)
+
+
+def test_matching_tags_stay_ready_on_cached_path():
+    """Control: identical per-step tags on both ranks negotiate cleanly
+    through the bitvector + tag side-channel."""
+
+    def fn(ctl, rank):
+        for step in range(4):
+            tag_a = f"seq=0:{2 * step};site=train.py:10"
+            tag_b = f"seq=0:{2 * step + 1};site=train.py:11"
+            _steps(ctl, lambda: [E("a", tag=tag_a), E("b", tag=tag_b)], 1)
+        st = ctl.cache_stats
+        assert st.bit_announces >= 6
+        return True
+
+    _pair(fn)
