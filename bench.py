@@ -187,9 +187,18 @@ def _control_plane_stats():
                  if cycles else None)
     ctl = getattr(eng, "controller", None)
     rate = ctl.cache_stats.hit_rate() if ctl is not None else None
+    # Pipelined data plane telemetry: average chunk count per fused
+    # dispatch and the in-flight window high-water mark (0 = inline
+    # settling — single-controller mode or MAX_INFLIGHT=1).
+    dispatches = getattr(eng, "pipeline_dispatches", 0)
+    chunks = (round(getattr(eng, "pipeline_chunks_total", 0) / dispatches, 3)
+              if dispatches else None)
+    ring = getattr(eng, "_inflight", None)
     return {"negotiation_us_per_cycle": per_cycle,
             "response_cache_hit_rate":
-                round(rate, 4) if rate is not None else None}
+                round(rate, 4) if rate is not None else None,
+            "chunks_per_cycle": chunks,
+            "inflight_depth": ring.high_water if ring is not None else 0}
 
 
 def bench_response_cache(iters=30, n_tensors=8, errors=None):
@@ -237,6 +246,75 @@ def bench_response_cache(iters=30, n_tensors=8, errors=None):
         out["off"] = phase(iters)              # server keeps its table, so
     finally:                                   # peers/verdicts stay sound
         ctl.cache_enabled = True
+    return out
+
+
+def bench_pipeline(iters=20, errors=None):
+    """Pipelined data plane ON vs OFF A/B: the same eager fused-allreduce
+    workload with (a) a single-chunk batch (pipeline must be ≥ parity —
+    the chunked program degenerates to the legacy one) and (b) a
+    multi-chunk fused batch (where chunked cast/reduce/cast overlap and
+    the in-flight window should win).  Works in any mode — chunking is
+    rank-local; the in-flight window additionally needs a controller."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics as _basics
+
+    import jax
+
+    eng = _basics._get_state().engine
+    out = {"max_inflight": eng.max_inflight}
+    # Two workloads: "small" fits one chunk either way; "large" splits into
+    # several chunks when the pipeline is on.  Input shape follows the
+    # launch mode, like bench_busbw: stacked [world, elems] in single-
+    # controller mode, the local contribution per process otherwise.
+    multi_proc = jax.process_count() > 1
+    m = hvd.mesh()
+    n_local = len([d for d in m.devices.flat
+                   if d.process_index == jax.process_index()])
+
+    def make(elems):
+        shape = ((n_local, elems) if n_local > 1 else (elems,)) \
+            if multi_proc else (hvd.size(), elems)
+        return [np.full(shape, 1.0 + j * 1e-6, np.float32)
+                for j in range(4)]
+
+    small, large = make(1 << 12), make(1 << 20)
+    chunk_on = 1 << 20            # 1 MB chunks -> 16 chunks for `large`
+
+    def phase(xs, label, n_iter):
+        d0, c0 = eng.pipeline_dispatches, eng.pipeline_chunks_total
+        t0 = time.perf_counter()
+        for _ in range(n_iter):
+            outs = hvd.grouped_allreduce(xs, name=f"pipe_bench_{label}",
+                                         op=hvd.Sum)
+        del outs
+        wall = time.perf_counter() - t0
+        d = max(1, eng.pipeline_dispatches - d0)
+        rec = {"step_ms": round(wall / n_iter * 1e3, 3),
+               "chunks_per_cycle":
+                   round((eng.pipeline_chunks_total - c0) / d, 2),
+               "inflight_depth": (eng._inflight.high_water
+                                  if eng._inflight is not None else 0)}
+        _record_timing(f"pipeline_{label}", warmup=2, iters=n_iter,
+                       wall_s=wall)
+        return rec
+
+    saved_chunk, saved_infl = eng.pipeline_chunk_bytes, eng.max_inflight
+    try:
+        for wl_name, xs in (("single_chunk", small), ("multi_chunk", large)):
+            sec = {}
+            eng.pipeline_chunk_bytes = 0      # off: one chunk, inline window
+            eng.max_inflight = 1
+            phase(xs, f"{wl_name}_off", 2)
+            sec["off"] = phase(xs, f"{wl_name}_off", iters)
+            eng.pipeline_chunk_bytes = chunk_on
+            eng.max_inflight = max(2, saved_infl)
+            phase(xs, f"{wl_name}_on", 2)
+            sec["on"] = phase(xs, f"{wl_name}_on", iters)
+            out[wl_name] = sec
+    finally:
+        eng.pipeline_chunk_bytes, eng.max_inflight = saved_chunk, saved_infl
     return out
 
 
@@ -1140,6 +1218,10 @@ def _run(out, errors):
             out["response_cache"] = bench_response_cache(errors=errors)
         except Exception as exc:  # noqa: BLE001 - contained
             errors["response_cache"] = repr(exc)
+        try:
+            out["pipeline"] = bench_pipeline(errors=errors)
+        except Exception as exc:  # noqa: BLE001 - contained
+            errors["pipeline"] = repr(exc)
         return
 
     if model == "llama":
@@ -1228,6 +1310,11 @@ def _run(out, errors):
         out["response_cache"] = bench_response_cache(errors=errors)
     except Exception as exc:  # noqa: BLE001 - contained
         errors["response_cache"] = repr(exc)
+
+    try:
+        out["pipeline"] = bench_pipeline(errors=errors)
+    except Exception as exc:  # noqa: BLE001 - contained
+        errors["pipeline"] = repr(exc)
 
     if os.environ.get("HVD_BENCH_SKIP_AUTOTUNE", "") != "1":
         try:

@@ -409,20 +409,8 @@ void Server::run_inner() {
         evict_queue.reserve(cands.size());
         for (auto& c : cands) evict_queue.push_back(c.second);
       }
-      while (evict_pos < evict_queue.size()) {
-        uint32_t victim = evict_queue[evict_pos++];
+      auto evict_one = [&](uint32_t victim) {
         CacheRec& rec = cache_recs[victim];
-        // Revalidate at pop time: the slot may have been used (bit
-        // announce / confirm) or referenced by a fresh pending entry
-        // since the queue was built.
-        if (!rec.live || rec.last_used == round_no) continue;
-        bool in_use = false;
-        for (auto& [n, info] : pending)
-          if (info.slot == static_cast<int64_t>(victim)) {
-            in_use = true;
-            break;
-          }
-        if (in_use) continue;
         --evict_budget;
         std::string key = rec.name;
         key += '\x1f';
@@ -437,6 +425,50 @@ void Server::run_inner() {
         rec.live = false;  // record kept intact for same-round bit
         --cache_live;      // resolves; id reusable only after the round
         evictions.push_back(victim);
+      };
+      while (evict_pos < evict_queue.size()) {
+        uint32_t victim = evict_queue[evict_pos++];
+        CacheRec& rec = cache_recs[victim];
+        // Revalidate at pop time: the slot may have been used (bit
+        // announce / confirm) or referenced by a fresh pending entry
+        // since the queue was built.
+        if (!rec.live || rec.last_used == round_no) continue;
+        // GROUP-ATOMIC eviction: every live record sharing the victim's
+        // group tag goes with it.  A group announces atomically, so all
+        // its records were learned in the same round and their frozen
+        // tags agree ("same tag ⇒ same version"); a PARTIAL eviction
+        // breaks that — the relearned member freezes a fresh per-step
+        // tag while survivors keep the old one, and in the one boundary
+        // round where a join announce lands beside peers' bit announces
+        // the joined rank's synthesizer would see one logical group
+        // under two tags (split clusters, divergent batching at the
+        // fusion threshold).  Evicting the whole group keeps the
+        // invariant: live same-group records always carry one tag.
+        std::vector<uint32_t> victims;
+        victims.push_back(victim);
+        if (rec.group != "-1") {
+          victims.clear();
+          for (size_t i = 0; i < cache_recs.size(); ++i)
+            if (cache_recs[i].live && cache_recs[i].group == rec.group)
+              victims.push_back(static_cast<uint32_t>(i));
+        }
+        bool blocked = false;
+        for (uint32_t v : victims) {
+          if (cache_recs[v].last_used == round_no) {
+            blocked = true;  // a sibling is hot this round: skip the group
+            break;
+          }
+          for (auto& [n, info] : pending)
+            if (info.slot == static_cast<int64_t>(v)) {
+              blocked = true;
+              break;
+            }
+          if (blocked) break;
+        }
+        if (blocked) continue;
+        // The whole group is evicted even when it overruns the per-round
+        // budget — a partial group eviction is exactly the hazard.
+        for (uint32_t v : victims) evict_one(v);
         return true;
       }
       evict_budget = 0;    // candidates exhausted: stop for this round

@@ -195,6 +195,12 @@ class SanitizerStallInspector:
         self.shutdown_after_s = inner.shutdown_after_s
         self.disabled = inner.disabled
 
+    def progressed(self, name: str):
+        """Completion epilogue passthrough (the engine calls this on every
+        settle): clears the inner inspector's warned latch so a later
+        collective reusing the name warns afresh."""
+        self._inner.progressed(name)
+
     def check(self, waiting, missing_ranks=None):
         before = set(self._inner._warned)
         try:

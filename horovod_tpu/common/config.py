@@ -65,6 +65,10 @@ class Config:
       cache)
     - ``response_cache_capacity``  <- HOROVOD_RESPONSE_CACHE_CAPACITY
       (negotiation response cache: the steady-state bitvector fast path)
+    - ``pipeline_chunk_bytes``     <- HOROVOD_PIPELINE_CHUNK (fused-reduce
+      chunk size for pipelined cast/reduce/cast; 0 = single chunk)
+    - ``max_inflight``             <- HOROVOD_MAX_INFLIGHT (bounded window
+      of dispatched-but-unsettled fused batches, multi-process mode)
     - ``timeline_filename``        <- HOROVOD_TIMELINE
     - ``timeline_mark_cycles``     <- HOROVOD_TIMELINE_MARK_CYCLES
     - ``stall_check_time_s``       <- HOROVOD_STALL_CHECK_TIME
@@ -97,6 +101,18 @@ class Config:
     # bitvector fast path, client-side AND server-side.  0 disables (every
     # cycle does full metadata negotiation).  Runtime-tunable via autotune.
     response_cache_capacity: int = 2048
+
+    # Pipelined data plane (HOROVOD_PIPELINE_CHUNK / HOROVOD_MAX_INFLIGHT).
+    # pipeline_chunk_bytes splits each fused reduction buffer into chunks so
+    # the cast-down → reduce → cast-up stages overlap across chunks inside
+    # the jitted program; 0 (default) = one chunk per fused batch, i.e. the
+    # batch-sized single collective (fused batches already split at the
+    # fusion threshold).  max_inflight bounds the dispatched-but-unsettled
+    # window in multi-process mode: >1 lets the cycle thread negotiate
+    # round N+1 while the device executes round N.  Both are autotune
+    # coordinates when a controller exists.
+    pipeline_chunk_bytes: int = 0
+    max_inflight: int = 2
 
     timeline_filename: str = ""
     timeline_mark_cycles: bool = False
@@ -155,6 +171,8 @@ class Config:
             cycle_time_ms=_env_float("CYCLE_TIME", 1.0),
             cache_capacity=_env_int("CACHE_CAPACITY", 1024),
             response_cache_capacity=_env_int("RESPONSE_CACHE_CAPACITY", 2048),
+            pipeline_chunk_bytes=_env_int("PIPELINE_CHUNK", 0),
+            max_inflight=_env_int("MAX_INFLIGHT", 2),
             timeline_filename=_env("TIMELINE", "") or "",
             timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
             stall_check_time_s=_env_float("STALL_CHECK_TIME", 60.0),

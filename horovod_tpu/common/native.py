@@ -10,6 +10,7 @@ installable without a build step.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -21,36 +22,58 @@ _lib: Optional[ctypes.CDLL] = None
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SRC = os.path.join(os.path.dirname(_PKG_DIR), "csrc", "coordinator.cc")
 _OUT_DIR = os.path.join(_PKG_DIR, "lib")
-_OUT = os.path.join(_OUT_DIR, "libhvdtpu_coord.so")
+
+
+def _out_path() -> str:
+    """Artifact path keyed on a SOURCE CONTENT hash, not mtime.
+
+    An mtime-keyed rebuild swaps semantics mid-suite: editing
+    ``coordinator.cc`` during an in-flight pytest run made the next
+    ``load()`` in a *different* process rebuild over the path the first
+    process had dlopen'd by name, so one run mixed two protocol versions.
+    Hashing the source into the artifact NAME makes every source version a
+    distinct file — an already-running process keeps its version, a new
+    process builds (or reuses) exactly the version its source says.
+    """
+    with open(_SRC, "rb") as fh:
+        digest = hashlib.sha256(fh.read()).hexdigest()[:16]
+    return os.path.join(_OUT_DIR, f"libhvdtpu_coord.{digest}.so")
 
 
 def _build() -> str:
     os.makedirs(_OUT_DIR, exist_ok=True)
-
-    def fresh():
-        return (os.path.exists(_OUT)
-                and os.path.getmtime(_OUT) >= os.path.getmtime(_SRC))
-
-    if fresh():
-        return _OUT
-    # Several worker processes can hit a stale .so simultaneously (e.g. a
-    # local -np N launch after touching the source): serialize builds with an
-    # flock and write to a pid-unique tmp so a racing process can never
-    # observe (or produce) a half-written library.
+    out = _out_path()
+    if os.path.exists(out):
+        return out
+    # Several worker processes can race to build (e.g. a local -np N launch
+    # on fresh source): serialize builds with an flock and write to a
+    # pid-unique tmp so a racing process can never observe (or produce) a
+    # half-written library.
     import fcntl
-    with open(_OUT + ".lock", "w") as lockf:
+    with open(os.path.join(_OUT_DIR, "build.lock"), "w") as lockf:
         fcntl.flock(lockf, fcntl.LOCK_EX)
-        if not fresh():
-            tmp = f"{_OUT}.{os.getpid()}.tmp"
+        if not os.path.exists(out):
+            tmp = f"{out}.{os.getpid()}.tmp"
             cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
                    _SRC, "-o", tmp]
             try:
                 subprocess.run(cmd, check=True, capture_output=True, text=True)
-                os.replace(tmp, _OUT)
+                os.replace(tmp, out)
             finally:
                 if os.path.exists(tmp):
                     os.unlink(tmp)
-    return _OUT
+            # Best-effort GC of superseded versions (and the legacy
+            # unhashed artifact): a process still running an old version
+            # keeps its dlopen handle — unlinking is safe on Linux.
+            base = os.path.basename(out)
+            for f in os.listdir(_OUT_DIR):
+                if (f.startswith("libhvdtpu_coord.") and f.endswith(".so")
+                        and f != base):
+                    try:
+                        os.unlink(os.path.join(_OUT_DIR, f))
+                    except OSError:
+                        pass
+    return out
 
 
 def load() -> ctypes.CDLL:

@@ -44,6 +44,30 @@ except ImportError:
         return _jax_core.axis_frame(axis_name)
 
 
+def set_host_device_count(n: int):
+    """Declare ``n`` virtual CPU devices — portably, BEFORE backend init.
+
+    New JAX spells this ``jax.config.update("jax_num_cpu_devices", n)``;
+    0.4.x does not know that option and only honors the
+    ``--xla_force_host_platform_device_count`` XLA flag.  Either way it
+    must run before the CPU backend initializes (first ``jax.devices()``
+    etc.); an already-initialized backend keeps its device count and this
+    call has no effect on it.
+    """
+    import os
+
+    import jax
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+        return
+    except Exception:  # noqa: BLE001 - option unknown on jax <= 0.4.x
+        pass
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={int(n)}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+
 def tpu_compiler_params(**kwargs):
     """Pallas-TPU compiler params across the rename.
 

@@ -346,6 +346,8 @@ def run_elastic(args) -> int:
             ("fusion_threshold_mb", "HOROVOD_FUSION_THRESHOLD", 1024 * 1024),
             ("cycle_time_ms", "HOROVOD_CYCLE_TIME", 1),
             ("cache_capacity", "HOROVOD_CACHE_CAPACITY", 1),
+            ("pipeline_chunk_mb", "HOROVOD_PIPELINE_CHUNK", 1024 * 1024),
+            ("max_inflight", "HOROVOD_MAX_INFLIGHT", 1),
             ("stall_check_time", "HOROVOD_STALL_CHECK_TIME", 1),
             ("stall_shutdown_time", "HOROVOD_STALL_SHUTDOWN_TIME", 1)):
         val = getattr(args, flag, None)

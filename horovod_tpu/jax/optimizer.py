@@ -90,6 +90,12 @@ def allreduce_gradients(grads, op: C.ReduceOp = C.ReduceOp.AVERAGE,
             "train step with shard_map over the device mesh so the axis is "
             "bound (see models.mnist.make_sharded_train_step).")
     # Eager engine path: fused, device-resident, negotiated across processes.
+    # Reverse-registration priority: leaf 0 (the earliest-registered layer,
+    # the one the next forward pass touches first) drains first even though
+    # backprop produces its gradient last — ByteScheduler-style priority
+    # scheduling through the engine's priority queue.  Pytree flatten order
+    # is identical on every rank, so the stamps agree.
+    prios = [len(leaves) - i for i in range(len(leaves))]
     wire = getattr(compression, "wire_mode", None)
     if wire is not None:
         # Cast-style compression rides INSIDE the fused program (cast-down
@@ -100,14 +106,16 @@ def allreduce_gradients(grads, op: C.ReduceOp = C.ReduceOp.AVERAGE,
         reduced = eager.grouped_allreduce(arrs, op=op,
                                           name="allreduce_gradients",
                                           process_set=process_set,
-                                          compression=wire)
+                                          compression=wire,
+                                          priorities=prios)
         out = [jnp.asarray(eager.to_local(r)).reshape(a.shape)
                .astype(a.dtype) for r, a in zip(reduced, arrs)]
         return jax.tree_util.tree_unflatten(treedef, out)
     comp = [compression.compress(jnp.asarray(g)) for g in leaves]
     reduced = eager.grouped_allreduce([c[0] for c in comp], op=op,
                                       name="allreduce_gradients",
-                                      process_set=process_set)
+                                      process_set=process_set,
+                                      priorities=prios)
     reduced = [jnp.asarray(eager.to_local(r)).reshape(c[0].shape)
                .astype(c[0].dtype) for r, c in zip(reduced, comp)]
     out = [compression.decompress(r, c[1]) for r, c in zip(reduced, comp)]

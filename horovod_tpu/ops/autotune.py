@@ -37,6 +37,12 @@ _CYC_BOUNDS = (math.log2(1e-4), math.log2(0.1))
 # upper bound is the server's configured capacity (the client can't ride
 # more slots than the server assigns — anything above it is a dead knob).
 _CAP_LO = 4.0
+# Pipeline coordinates (multi-process only, like the cache coordinate):
+# fused-reduce chunk size 64KB..1GB — below 64KB per-chunk collective
+# overhead always dominates; in-flight window 1..8 fused batches (log2
+# space, rounded to an integer on apply).
+_CHUNK_BOUNDS = (16.0, 30.0)
+_INFLIGHT_BOUNDS = (0.0, 3.0)
 
 
 def _clamp(v: float, lo: float, hi: float) -> float:
@@ -193,6 +199,19 @@ class ParameterManager:
             cap0 = max(float(ctl.cache_capacity), 16.0)
             starts.append(math.log2(cap0))
             bounds.append((_CAP_LO, max(_CAP_LO + 1.0, math.log2(cap0))))
+        # Pipeline coordinates — gated exactly like the cache coordinate
+        # (multi-process only): chunking/in-flight only matter where a
+        # negotiation round exists to overlap, and single-controller runs
+        # must not waste eval budget on dead knobs.  Every rank reads the
+        # same engine config, so the agreement payload shape matches.
+        self._tune_pipeline = ctl is not None
+        if self._tune_pipeline:
+            chunk0 = max(float(engine.pipeline_chunk_bytes
+                               or engine.fusion_threshold), 1024.0)
+            starts.append(math.log2(chunk0))
+            bounds.append(_CHUNK_BOUNDS)
+            starts.append(math.log2(max(float(engine.max_inflight), 1.0)))
+            bounds.append(_INFLIGHT_BOUNDS)
         self.search = LogCoordinateDescent(
             start=tuple(starts), bounds=tuple(bounds), max_evals=max_evals)
         self._sample_no = 0
@@ -243,11 +262,20 @@ class ParameterManager:
     def _apply_params(self, params):
         self._engine.fusion_threshold = int(params[0])
         self._engine.cycle_time_s = float(params[1])
-        if self._tune_cache and len(params) >= 3:
+        idx = 2
+        if self._tune_cache and len(params) > idx:
             # Client-side slot budget: shrinking trims LRU slots (safe —
             # a dropped slot simply full-announces and relearns), growing
             # lets more tuples ride the bitvector.
-            self._engine.controller.cache_capacity = max(1, int(params[2]))
+            self._engine.controller.cache_capacity = max(1, int(params[idx]))
+            idx += 1
+        if self._tune_pipeline and len(params) > idx + 1:
+            # Chunk plans re-key the program cache by COUNT, so walking
+            # this knob recompiles at most once per distinct plan; the
+            # in-flight bound applies from the next dispatch (the ring
+            # reads its depth live).
+            self._engine.pipeline_chunk_bytes = int(params[idx])
+            self._engine.max_inflight = max(1, int(round(params[idx + 1])))
 
     def _poll_move(self):
         payload = self._poller(self._move_handle)
@@ -265,8 +293,15 @@ class ParameterManager:
         self._apply_params(params)
         if done >= 0.5:
             self.tuning = False
-            extra = (f" response_cache_capacity={int(params[2])}"
-                     if self._tune_cache and len(params) >= 3 else "")
+            extra = ""
+            idx = 2
+            if self._tune_cache and len(params) > idx:
+                extra += f" response_cache_capacity={int(params[idx])}"
+                idx += 1
+            if self._tune_pipeline and len(params) > idx + 1:
+                extra += (f" pipeline_chunk_bytes={int(params[idx])}"
+                          f" max_inflight="
+                          f"{max(1, int(round(params[idx + 1])))}")
             self._log_line(f"# final: fusion_threshold={int(params[0])} "
                            f"cycle_time_s={params[1]:.6f}{extra} "
                            f"evals={self.search.evals}\n")
@@ -299,16 +334,25 @@ class ParameterManager:
     # ------------------------------------------------------------- logging
     def _log_sample(self, measured, score: float):
         if not self._log_header_written:
-            cap_col = (",response_cache_capacity" if self._tune_cache
-                       else "")
+            cols = ""
+            if self._tune_cache:
+                cols += ",response_cache_capacity"
+            if self._tune_pipeline:
+                cols += ",pipeline_chunk_bytes,max_inflight"
             self._log_line(f"sample,fusion_threshold_bytes,cycle_time_s"
-                           f"{cap_col},score_bytes_per_s\n")
+                           f"{cols},score_bytes_per_s\n")
             self._log_header_written = True
         params = [2.0 ** p for p in measured]
-        cap = f",{int(params[2])}" if self._tune_cache and len(params) >= 3 \
-            else ""
+        extra = ""
+        idx = 2
+        if self._tune_cache and len(params) > idx:
+            extra += f",{int(params[idx])}"
+            idx += 1
+        if self._tune_pipeline and len(params) > idx + 1:
+            extra += (f",{int(params[idx])}"
+                      f",{max(1, int(round(params[idx + 1])))}")
         self._log_line(f"{self._sample_no},{int(params[0])},"
-                       f"{params[1]:.6f}{cap},{score:.1f}\n")
+                       f"{params[1]:.6f}{extra},{score:.1f}\n")
 
     def _log_line(self, line: str):
         if not self._log_path:

@@ -255,17 +255,23 @@ def allreduce_async(tensor, name: Optional[str] = None,
                     prescale_factor: Optional[float] = None,
                     postscale_factor: Optional[float] = None,
                     process_set: Optional[ProcessSet] = None,
-                    compression=None) -> int:
+                    compression=None, priority: int = 0) -> int:
     """``compression="bf16"``/``"fp16"`` casts floating tensors to the wire
     dtype inside the fused program (before the reduce) and back after —
-    half the ICI bytes, zero extra launches, result in the input dtype."""
+    half the ICI bytes, zero extra launches, result in the input dtype.
+
+    ``priority``: higher drains first from the coordinator queue (stable
+    within equal priority).  Must be stamped identically on every rank —
+    the DistributedOptimizer bindings use reverse registration order so
+    first-needed gradients lead each cycle."""
     ps_id = _ps(process_set)
     arr, owned = _as_stacked(tensor, ps_id)
     return _engine().enqueue(
         _auto_name("allreduce", name), CollectiveType.ALLREDUCE,
         arr, reduce_op=op, process_set_id=ps_id,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-        donate=owned, compression=_wire_mode(compression))
+        donate=owned, compression=_wire_mode(compression),
+        priority=priority)
 
 
 def _sync_now(handle):
@@ -280,10 +286,10 @@ def allreduce(tensor, name: Optional[str] = None,
               prescale_factor: Optional[float] = None,
               postscale_factor: Optional[float] = None,
               process_set: Optional[ProcessSet] = None,
-              compression=None):
+              compression=None, priority: int = 0):
     return _sync_now(allreduce_async(
         tensor, name, op, prescale_factor, postscale_factor, process_set,
-        compression))
+        compression, priority))
 
 
 def grouped_allreduce_async(tensors: Sequence, name: Optional[str] = None,
@@ -291,12 +297,23 @@ def grouped_allreduce_async(tensors: Sequence, name: Optional[str] = None,
                             prescale_factor: Optional[float] = None,
                             postscale_factor: Optional[float] = None,
                             process_set: Optional[ProcessSet] = None,
-                            compression=None) -> List[int]:
-    """Enqueue a group that fuses/executes atomically (reference: N13)."""
+                            compression=None,
+                            priorities: Optional[Sequence[int]] = None
+                            ) -> List[int]:
+    """Enqueue a group that fuses/executes atomically (reference: N13).
+
+    ``priorities`` (one int per tensor, same on every rank): drain
+    priority per member — the group still executes atomically, but its
+    position among OTHER clusters in the cycle follows its members'
+    priorities."""
     ps_id = _ps(process_set)
     comp = _wire_mode(compression)
     gid = next(_group_counter)
     base = _auto_name("grouped_allreduce", name)
+    if priorities is not None and len(priorities) != len(tensors):
+        raise ValueError(
+            f"priorities must have one entry per tensor: got "
+            f"{len(priorities)} for {len(tensors)} tensors")
     items = []
     for i, t in enumerate(tensors):
         arr, owned = _as_stacked(t, ps_id)
@@ -305,7 +322,8 @@ def grouped_allreduce_async(tensors: Sequence, name: Optional[str] = None,
             reduce_op=op, process_set_id=ps_id,
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor, group_id=gid, donate=owned,
-            compression=comp))
+            compression=comp,
+            priority=int(priorities[i]) if priorities is not None else 0))
     # One atomic push: all members negotiate in the same round on every
     # rank, which both preserves fusion atomicity and lets a negotiation
     # error on one member abort the whole group (reference N13).
@@ -317,10 +335,11 @@ def grouped_allreduce(tensors: Sequence, name: Optional[str] = None,
                       prescale_factor: Optional[float] = None,
                       postscale_factor: Optional[float] = None,
                       process_set: Optional[ProcessSet] = None,
-                      compression=None):
+                      compression=None,
+                      priorities: Optional[Sequence[int]] = None):
     handles = grouped_allreduce_async(
         tensors, name, op, prescale_factor, postscale_factor, process_set,
-        compression)
+        compression, priorities)
     _engine().kick()
     return [synchronize(h) for h in handles]
 

@@ -32,7 +32,7 @@ import enum
 import itertools
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +42,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..compat import shard_map
 
 from . import collectives as C
+from .scheduler import (  # noqa: F401  (re-export: public engine surface)
+    FusedProgramCache, InflightRing, StallInspector, TensorQueue,
+)
 from ..utils.logging import get_logger
 
 log = get_logger()
@@ -76,6 +79,11 @@ class TensorTableEntry:
     # the XLA way).  Reduction ops only; part of the fusion key AND the
     # negotiation digest (divergence would execute mismatched programs).
     compression: Optional[str] = None
+    # Drain priority (higher drains first; default 0 = FIFO).  Stamped by
+    # the DistributedOptimizer bindings with reverse-registration order so
+    # first-needed gradients lead each cycle (ByteScheduler-style priority
+    # scheduling); must be identical across ranks for a given name.
+    priority: int = 0
     enqueue_time: float = 0.0
     # filled on completion:
     result: Any = None
@@ -96,145 +104,14 @@ def _fusion_key(e: TensorTableEntry) -> Tuple:
             e.prescale_factor, e.postscale_factor, e.compression)
 
 
-class TensorQueue:
-    """Thread-safe queue of pending entries (reference: tensor_queue.cc N6).
-
-    Duplicate-name detection mirrors the reference's error on submitting a
-    tensor name twice before completion.
-    """
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._entries: List[TensorTableEntry] = []
-        self._pending_names: Dict[str, int] = {}
-
-    def push(self, e: TensorTableEntry):
-        self.push_many([e])
-
-    def push_many(self, entries: Sequence[TensorTableEntry]):
-        """Atomic multi-entry push: a drain observes all or none — grouped
-        ops rely on this so members always negotiate in the same round
-        (reference: group_table N13 registers whole groups)."""
-        with self._lock:
-            seen = set()
-            for e in entries:
-                if e.name in self._pending_names or e.name in seen:
-                    raise ValueError(
-                        f"A tensor named {e.name!r} is already pending; "
-                        f"Horovod semantics require unique names per "
-                        f"in-flight collective")
-                seen.add(e.name)
-            now = time.monotonic()
-            for e in entries:
-                self._pending_names[e.name] = e.handle
-                e.enqueue_time = now
-                self._entries.append(e)
-
-    def drain(self) -> List[TensorTableEntry]:
-        with self._lock:
-            out, self._entries = self._entries, []
-            return out
-
-    def mark_done(self, e: TensorTableEntry):
-        with self._lock:
-            self._pending_names.pop(e.name, None)
-
-    def requeue(self, entries: Sequence[TensorTableEntry]):
-        """Put drained-but-not-ready entries back for the next cycle
-        (reference: ComputeResponseList re-queues tensors not yet ready on
-        all ranks).  Names are still registered, so no duplicate check."""
-        with self._lock:
-            self._entries = list(entries) + self._entries
-
-    def pending_count(self) -> int:
-        with self._lock:
-            return len(self._entries)
-
-
-class FusedProgramCache:
-    """Compiled fused-collective cache (the data-plane half of the steady-
-    state fast path; the control-plane half is the controller's response
-    cache).  Keyed on the *shape signature* of the batch (fusion key +
-    shapes + dtypes + donation + wire compression).  Hit == zero Python
-    planning + zero XLA recompile: dispatch cost is one cached-executable
-    launch.
-    """
-
-    def __init__(self, capacity: int = 1024):
-        self.capacity = capacity
-        self._cache: Dict[Tuple, Callable] = {}
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
-
-    def __len__(self) -> int:
-        return len(self._cache)
-
-    def get_or_build(self, key: Tuple, builder: Callable[[], Callable]) -> Callable:
-        fn, _ = self.get_or_build2(key, builder)
-        return fn
-
-    def get_or_build2(self, key: Tuple, builder: Callable[[], Callable]):
-        """Returns ``(fn, hit)`` — hit=False means fn will compile on its
-        first invocation (callers may scope compile-time-only handling)."""
-        if self.capacity <= 0:
-            # Caching disabled (HOROVOD_CACHE_CAPACITY=0): build every time.
-            self.misses += 1
-            return builder(), False
-        fn = self._cache.get(key)
-        if fn is None:
-            self.misses += 1
-            fn = builder()
-            while len(self._cache) >= self.capacity:
-                # LRU eviction (hits reinsert at the end of the dict order):
-                # an A/B-alternating working set one entry over capacity
-                # must not thrash the way FIFO would.
-                self._cache.pop(next(iter(self._cache)))
-                self.evictions += 1
-            self._cache[key] = fn
-            return fn, False
-        # LRU touch: move to the end of the insertion order.
-        self._cache.pop(key)
-        self._cache[key] = fn
-        self.hits += 1
-        return fn, True
-
-
-class StallInspector:
-    """Warns when entries sit unexecuted too long (reference: N11).
-
-    In single-controller mode entries execute next cycle, so stalls indicate
-    an engine bug; in multi-process mode a stall names the ranks that have
-    not submitted a tensor the others are waiting on — the reference's #1
-    user-facing failure diagnosis (SURVEY.md §5 "race detection").
-    """
-
-    def __init__(self, warn_after_s: float, shutdown_after_s: float,
-                 disabled: bool = False):
-        self.warn_after_s = warn_after_s
-        self.shutdown_after_s = shutdown_after_s
-        self.disabled = disabled
-        self._warned: set = set()
-
-    def check(self, waiting: Sequence[TensorTableEntry],
-              missing_ranks: Optional[Dict[str, List[int]]] = None):
-        if self.disabled:
-            return
-        now = time.monotonic()
-        for e in waiting:
-            age = now - e.enqueue_time
-            if age > self.warn_after_s and e.name not in self._warned:
-                self._warned.add(e.name)
-                extra = ""
-                if missing_ranks and e.name in missing_ranks:
-                    extra = f"; ranks not yet submitted: {missing_ranks[e.name]}"
-                log.warning(
-                    "Stall detected: tensor %r has waited %.1fs for "
-                    "negotiation/execution%s", e.name, age, extra)
-            if (self.shutdown_after_s > 0 and age > self.shutdown_after_s):
-                raise RuntimeError(
-                    f"Collective on tensor {e.name!r} stalled for {age:.1f}s "
-                    f"(> HOROVOD_STALL_SHUTDOWN_TIME); aborting")
+def _np_dtype(name: str) -> np.dtype:
+    """numpy dtype from its string form, including ml_dtypes extensions
+    (bfloat16/fp8) that ``np.dtype`` alone does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
 
 
 class CollectiveEngine:
@@ -258,6 +135,22 @@ class CollectiveEngine:
         self.cycle_time_s = cfg.cycle_time_ms / 1000.0
         self.inline_kick = cfg.inline_kick
         self.fusion_threshold = cfg.fusion_threshold_bytes
+        # Pipelined data plane (HOROVOD_PIPELINE_CHUNK / HOROVOD_MAX_
+        # INFLIGHT).  chunk 0 = off: one chunk per fused batch, the legacy
+        # single-collective program (a true off, because atomic clusters
+        # can exceed the fusion threshold — see _chunk_plan); >0 splits
+        # the fusion buffer so cast-down → reduce → cast-up stages overlap
+        # across chunks inside the jitted program.  Both runtime-tunable
+        # (autotune coordinates in multi-process mode).
+        self.pipeline_chunk_bytes = cfg.pipeline_chunk_bytes
+        self.max_inflight = cfg.max_inflight
+        self._inflight: Optional[InflightRing] = None
+        # Pipeline observability (bench.py emits chunks_per_cycle /
+        # inflight_depth on every JSON line; the timeline gets a per-cycle
+        # "pipeline" counter track).
+        self.pipeline_chunks_total = 0
+        self.pipeline_dispatches = 0
+        self.last_cycle_chunks = 0
         self.hierarchical_allreduce = cfg.hierarchical_allreduce
         self.hierarchical_allgather = cfg.hierarchical_allgather
         self._hier_local_size = cfg.hierarchical_local_size
@@ -317,18 +210,25 @@ class CollectiveEngine:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        if self._inflight is not None:
+            # Settles every dispatched batch first: a waiter blocked in
+            # synchronize() must never outlive the watcher unsignalled.
+            self._inflight.stop()
+            self._inflight = None
 
     # ------------------------------------------------------------- submit API
     def enqueue(self, name: str, ctype: CollectiveType, tensor,
                 reduce_op=C.ReduceOp.AVERAGE, root_rank: int = 0,
                 process_set_id: int = 0, prescale_factor=None,
                 postscale_factor=None, group_id: int = -1,
-                donate: bool = False, compression: Optional[str] = None) -> int:
+                donate: bool = False, compression: Optional[str] = None,
+                priority: int = 0) -> int:
         return self.enqueue_group([dict(
             name=name, ctype=ctype, tensor=tensor, reduce_op=reduce_op,
             root_rank=root_rank, process_set_id=process_set_id,
             prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-            group_id=group_id, donate=donate, compression=compression)])[0]
+            group_id=group_id, donate=donate, compression=compression,
+            priority=priority)])[0]
 
     def enqueue_group(self, items: Sequence[dict]) -> List[int]:
         """Enqueue several entries atomically w.r.t. the drain — a cycle
@@ -464,8 +364,16 @@ class CollectiveEngine:
             return
         if not_ready:
             self.queue.requeue(not_ready)
+        cycle_chunks = 0
         for batch in responses:
-            self._perform_operation(batch)
+            cycle_chunks += self._perform_operation(batch)
+        if responses:
+            self.last_cycle_chunks = cycle_chunks
+            if tl is not None and tl.enabled:
+                tl.counter("pipeline", {
+                    "chunks": cycle_chunks,
+                    "inflight": len(self._inflight)
+                    if self._inflight is not None else 0})
         if self.autotuner is not None and self.autotuner.tuning:
             nbytes = sum(e.tensor.nbytes for b in responses for e in b
                          if e.tensor is not None)
@@ -573,25 +481,86 @@ class CollectiveEngine:
         return batches, not_ready
 
     # ----------------------------------------------------------- execution
-    def _perform_operation(self, batch: List[TensorTableEntry]):
+    def _perform_operation(self, batch: List[TensorTableEntry]) -> int:
+        """Dispatch one fused batch; returns its chunk count.
+
+        With the in-flight window active (multi-process, MAX_INFLIGHT > 1)
+        the entries are NOT settled here: the async launch enters the
+        bounded ring and the completion watcher settles ``e.done`` off this
+        thread, so the cycle thread proceeds straight to negotiating the
+        next round while the device executes this one."""
         tl = self._state.timeline
         for e in batch:
             if tl is not None:
                 tl.end_activity(e.name, f"NEGOTIATE_{e.ctype.name}")
                 tl.start_activity(e.name, f"XLA_{e.ctype.name}")
         try:
-            results = self._execute_batch(batch)
+            results, chunks = self._execute_batch(batch)
+        except BaseException as exc:  # noqa: BLE001 - propagate to waiters
+            self._settle_batch(batch, None, exc)
+            return 0
+        self.pipeline_chunks_total += chunks
+        self.pipeline_dispatches += 1
+        ring = self._inflight_ring()
+        if ring is None:
+            self._settle_batch(batch, results)
+        else:
+            if tl is not None:
+                for e in batch:
+                    tl.start_activity(e.name, "INFLIGHT")
+            ring.submit(batch, results)
+        return chunks
+
+    def _settle_batch(self, batch: List[TensorTableEntry], results,
+                      error: Optional[BaseException] = None,
+                      inflight: bool = False):
+        """Completion epilogue (cycle thread inline, or the in-flight
+        watcher): assign results/error, close timeline lanes, release
+        waiters.  Must never raise — a lost settle hangs synchronize()."""
+        tl = self._state.timeline
+        if error is None:
             for e, r in zip(batch, results):
                 e.result = r
-        except BaseException as exc:  # noqa: BLE001 - propagate to waiters
+        else:
             for e in batch:
-                e.error = exc
-        finally:
-            for e in batch:
+                e.error = error
+        for e in batch:
+            try:
                 if tl is not None:
+                    if inflight:
+                        tl.end_activity(e.name, "INFLIGHT")
                     tl.end_activity(e.name, f"XLA_{e.ctype.name}")
                 self.queue.mark_done(e)
+                self.stall.progressed(e.name)
+            except Exception:  # noqa: BLE001 - keep settling the rest
+                # Timeline I/O (disk full, closed file) must never cost a
+                # waiter its done signal — a lost set() is a hang, and on
+                # the watcher thread it would take the whole window down.
+                log.exception("settle bookkeeping failed for %r", e.name)
+            finally:
                 e.done.set()
+
+    def _inflight_ring(self) -> Optional[InflightRing]:
+        """The bounded dispatch window, or None for inline settling.
+
+        Only the multi-process engine pipelines: single-controller cycles
+        have no negotiation to overlap, and the inline-kick latency path
+        relies on same-thread settling.  (The controller attaches after
+        construction, hence the lazy build.)  CPU keeps launches serialized
+        via ``_serialize_launches`` — the ring then only moves *settling*
+        off the cycle thread, which still exercises the full machinery in
+        the hermetic tier without the rendezvous-starvation hazard."""
+        if self.max_inflight <= 1 or self.controller is None:
+            return None
+        if self._inflight is None:
+            self._inflight = InflightRing(
+                jax.block_until_ready,
+                lambda b, r, err: self._settle_batch(b, r, err,
+                                                     inflight=True),
+                depth=self.max_inflight)
+        else:
+            self._inflight.depth = max(1, int(self.max_inflight))
+        return self._inflight
 
     def _mesh_axis(self, ps_id: int):
         ps = self._state.process_set_table.get(ps_id)
@@ -613,9 +582,16 @@ class CollectiveEngine:
             if dt == np.bool_:
                 return hi
             try:
-                info = np.finfo(dt)      # ml_dtypes (bf16/fp8) support finfo
+                info = np.finfo(dt)
             except ValueError:
-                info = np.iinfo(dt)
+                # numpy's finfo rejects ml_dtypes (bf16/fp8: "not inexact")
+                # and iinfo rejects them too ("invalid integer data type V")
+                # — ml_dtypes ships its own finfo for exactly this.
+                try:
+                    import ml_dtypes
+                    info = ml_dtypes.finfo(dt)
+                except ValueError:
+                    info = np.iinfo(dt)
             return info.max if hi else info.min
         return 0              # SUM / AVERAGE (divisor stays world) / ADASUM
 
@@ -641,11 +617,7 @@ class CollectiveEngine:
             return e
         parts = digest.split("|")
         ctype = CollectiveType(parts[0])
-        try:
-            dt = np.dtype(parts[1])
-        except TypeError:
-            import ml_dtypes
-            dt = np.dtype(getattr(ml_dtypes, parts[1]))
+        dt = _np_dtype(parts[1])
         import ast
         shape = tuple(ast.literal_eval(parts[2]))
         op = C.ReduceOp[parts[3]]
@@ -703,19 +675,52 @@ class CollectiveEngine:
         devs = np.asarray(ps.mesh.devices).reshape(world // local, local)
         return Mesh(devs, ("cross", "local"))
 
-    def _execute_batch(self, batch: List[TensorTableEntry]) -> List[Any]:
+    def _chunk_plan(self, ctype: CollectiveType, shapes, dtypes) -> Tuple:
+        """Per-dtype-group chunk counts for a fused reduction.
+
+        A pure function of (chunk knob, per-rank shapes, dtypes): every rank
+        computes the same plan from the same negotiated batch, so the fused
+        programs stay byte-identical.  The *counts* — not the raw chunk
+        byte values — key the program cache: retuning the knob only
+        recompiles when the plan actually changes, keeping program count
+        bounded.  Empty plan = chunking off or a non-reduction op (gathers
+        and permutes have no cast/reduce/cast stages to overlap).
+
+        Knob 0 is a true OFF, not "fusion-threshold-sized chunks": an
+        atomic cluster (one grouped_allreduce of the whole model, or a
+        single oversized tensor) is never split by the batch planner, so
+        it can exceed the threshold — deriving chunks from it would
+        silently chunk default-config workloads."""
+        if ctype != CollectiveType.ALLREDUCE or self.pipeline_chunk_bytes <= 0:
+            return ()
+        chunk = max(1, int(self.pipeline_chunk_bytes))
+        groups: Dict[str, Tuple[int, int]] = {}   # dtype -> (elems, bytes)
+        for s, dt in zip(shapes, dtypes):
+            n = int(np.prod(s[1:])) if len(s) > 1 else 1
+            b = n * _np_dtype(dt).itemsize
+            e_, b_ = groups.get(dt, (0, 0))
+            groups[dt] = (e_ + n, b_ + b)
+        return tuple(min(max(1, -(-b // chunk)), max(1, e))
+                     for e, b in groups.values())
+
+    def _execute_batch(self, batch: List[TensorTableEntry]):
+        """Build-or-fetch the fused program and launch it; returns
+        ``(results, chunk_count)`` — results may still be async (the
+        in-flight watcher blocks on them) unless ``_serialize_launches``."""
         e0 = batch[0]
         if e0.ctype == CollectiveType.BARRIER:
-            return [None for _ in batch]
+            return [None for _ in batch], 0
         mesh, axis, world = self._mesh_axis(e0.process_set_id)
         shapes = tuple(tuple(e.tensor.shape) for e in batch)
         dtypes = tuple(str(e.tensor.dtype) for e in batch)
         donate = tuple(e.donate for e in batch)
+        plan = self._chunk_plan(e0.ctype, shapes, dtypes)
         key = (_fusion_key(e0), shapes, dtypes, donate,
-               self.hierarchical_allreduce, self.hierarchical_allgather)
+               self.hierarchical_allreduce, self.hierarchical_allgather,
+               plan)
         fn, hit = self.cache.get_or_build2(
             key, lambda: self._build_program(e0, shapes, dtypes, mesh, axis,
-                                             world, donate))
+                                             world, donate, plan))
         if hit:
             outs = fn(*[e.tensor for e in batch])
         else:
@@ -733,7 +738,7 @@ class CollectiveEngine:
             outs = [outs]
         if self._serialize_launches:
             jax.block_until_ready(outs)
-        return list(outs)
+        return list(outs), (sum(plan) if plan else 1)
 
     # Builders: one jitted micro-program per (fusion key, shape set).  The
     # fused allreduce flattens every tensor's per-rank shard, concatenates
@@ -741,7 +746,7 @@ class CollectiveEngine:
     # XLA temporary in HBM — reference N7 without the memcpy machinery),
     # runs ONE collective, and splits results out.
     def _build_program(self, proto: TensorTableEntry, shapes, dtypes, mesh,
-                       axis, world, donate=()):
+                       axis, world, donate=(), plan=()):
         ctype = proto.ctype
         # Engine-owned input buffers are donated to XLA so the fused
         # program may alias them in HBM instead of allocating fresh
@@ -759,9 +764,9 @@ class CollectiveEngine:
                 hmesh = self._hier_mesh(proto.process_set_id)
                 if hmesh is not None:
                     return self._build_hier_allreduce(
-                        proto, shapes, dtypes, hmesh, world, _jit)
+                        proto, shapes, dtypes, hmesh, world, _jit, plan)
             return self._build_allreduce(proto, shapes, dtypes, mesh, axis,
-                                         world, _jit)
+                                         world, _jit, plan)
         if ctype == CollectiveType.BROADCAST:
             return self._build_broadcast(proto, shapes, mesh, axis, world,
                                          _jit)
@@ -782,7 +787,7 @@ class CollectiveEngine:
         raise ValueError(f"Unsupported collective: {ctype}")
 
     def _build_fused_reduce(self, proto, shapes, dtypes, mesh_, in_spec,
-                            reduce_flat, _jit):
+                            reduce_flat, _jit, plan=()):
         """Shared fused-reduction scaffold (flat + hierarchical allreduce):
         flatten each tensor's per-rank shard, concatenate per dtype (one
         reduce per distinct dtype — XLA's collective combiner merges them
@@ -796,7 +801,15 @@ class CollectiveEngine:
         casts into the collective's producer/consumer, so the bytes over
         ICI halve with zero extra launches.  Prescale happens in the
         original dtype (before the down-cast) and postscale after the
-        up-cast, keeping the lossy window as narrow as possible."""
+        up-cast, keeping the lossy window as narrow as possible.
+
+        Chunked pipelining (``plan``, one chunk count per dtype group in
+        first-occurrence order): the fused flat buffer is split into even
+        chunks and each chunk rides its own cast-down → reduce → cast-up
+        stage, so XLA overlaps chunk i+1's casts with chunk i's collective
+        (software-pipelined ICI).  Chunk boundaries never change which
+        ranks reduce which element, so results are bitwise-identical to
+        the single-chunk program."""
         pre, post = proto.prescale_factor, proto.postscale_factor
         wire = {"bf16": jnp.bfloat16, "fp16": jnp.float16}.get(
             proto.compression)
@@ -805,6 +818,7 @@ class CollectiveEngine:
         dtype_groups: Dict[str, List[int]] = {}
         for i, dt in enumerate(dtypes):
             dtype_groups.setdefault(dt, []).append(i)
+        chunk_counts = list(plan) if plan else [1] * len(dtype_groups)
 
         def reduce_wire(flat):
             if (wire is not None and flat.dtype != wire
@@ -812,13 +826,22 @@ class CollectiveEngine:
                 return reduce_flat(flat.astype(wire)).astype(flat.dtype)
             return reduce_flat(flat)
 
+        def reduce_chunked(flat, nch):
+            if nch <= 1 or flat.shape[0] <= 1:
+                return reduce_wire(flat)
+            per = -(-flat.shape[0] // nch)     # ceil; last chunk shorter
+            return jnp.concatenate(
+                [reduce_wire(flat[i * per:(i + 1) * per])
+                 for i in range(nch)])
+
         def per_shard(*xs):
             # xs: per-rank values, each [*S] — flatten, fuse per dtype.
             outs: List[Any] = [None] * len(xs)
-            for dt, idxs in dtype_groups.items():
+            for (dt, idxs), nch in zip(dtype_groups.items(), chunk_counts):
                 flat = jnp.concatenate([xs[i].reshape(-1) for i in idxs]) \
                     if len(idxs) > 1 else xs[idxs[0]].reshape(-1)
-                red = C._scale(reduce_wire(C._scale(flat, pre)), post)
+                red = C._scale(reduce_chunked(C._scale(flat, pre), nch),
+                               post)
                 off = 0
                 for i in idxs:
                     outs[i] = red[off:off + sizes[i]].reshape(per_rank_shapes[i])
@@ -837,7 +860,7 @@ class CollectiveEngine:
         return _jit(wrapper)
 
     def _build_allreduce(self, proto, shapes, dtypes, mesh, axis, world,
-                         _jit=jax.jit):
+                         _jit=jax.jit, plan=()):
         op = proto.reduce_op
 
         def reduce_flat(flat):
@@ -880,7 +903,7 @@ class CollectiveEngine:
             return red
 
         return self._build_fused_reduce(proto, shapes, dtypes, mesh, P(axis),
-                                        reduce_flat, _jit)
+                                        reduce_flat, _jit, plan)
 
     def _build_broadcast(self, proto, shapes, mesh, axis, world,
                          _jit=jax.jit):
@@ -919,7 +942,7 @@ class CollectiveEngine:
             out_specs=tuple(P() for _ in shapes), check_vma=False))
 
     def _build_hier_allreduce(self, proto, shapes, dtypes, hmesh, world,
-                              _jit=jax.jit):
+                              _jit=jax.jit, plan=()):
         """Two-level fused allreduce: RS(local) → AR(cross) → AG(local).
 
         Same fusion/dtype-grouping contract as ``_build_allreduce`` (via the
@@ -940,7 +963,7 @@ class CollectiveEngine:
 
         return self._build_fused_reduce(proto, shapes, dtypes, hmesh,
                                         P(("cross", "local")), reduce_flat,
-                                        _jit)
+                                        _jit, plan)
 
     def _build_hier_allgather(self, proto, shapes, hmesh, world,
                               _jit=jax.jit):

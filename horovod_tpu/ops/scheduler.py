@@ -1,0 +1,279 @@
+"""Data-plane scheduling primitives (no jax imports).
+
+The pieces of the collective engine that are pure host-side scheduling —
+the pending-tensor queue, the compiled-program cache, the stall inspector,
+and the in-flight dispatch window — live here so the scheduler logic is
+unit-testable without touching a jax backend (the fast test tier drives
+these classes directly; ``ops/engine.py`` composes them with the XLA data
+plane).
+
+Reference mapping (SURVEY.md §2a): ``TensorQueue`` ← tensor_queue.cc N6,
+``FusedProgramCache`` ← fusion_buffer_cache.cc N7 (as a compiled-executable
+cache), ``StallInspector`` ← stall inspector N11, ``InflightRing`` ← the
+in-flight response window ByteScheduler-style schedulers bound (Peng et
+al., SOSP 2019) — here a bounded ring between the dispatching cycle thread
+and a completion watcher.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+
+class TensorQueue:
+    """Thread-safe queue of pending entries (reference: tensor_queue.cc N6).
+
+    Duplicate-name detection mirrors the reference's error on submitting a
+    tensor name twice before completion.
+
+    **Priority drain**: entries carry an integer ``priority`` (default 0);
+    ``drain()`` returns higher priorities first, *stable within equal
+    priority* (arrival order).  The DistributedOptimizer bindings stamp
+    gradients with reverse-registration priority so the tensors the next
+    forward pass needs first lead each cycle (the ByteScheduler insight:
+    layer-0 grads arrive last from backprop but are needed first).
+    Priorities must be stamped identically on every rank — like names,
+    they are part of the deterministic announce order.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: List = []
+        self._pending_names: Dict[str, int] = {}
+
+    def push(self, e):
+        self.push_many([e])
+
+    def push_many(self, entries: Sequence):
+        """Atomic multi-entry push: a drain observes all or none — grouped
+        ops rely on this so members always negotiate in the same round
+        (reference: group_table N13 registers whole groups)."""
+        with self._lock:
+            seen = set()
+            for e in entries:
+                if e.name in self._pending_names or e.name in seen:
+                    raise ValueError(
+                        f"A tensor named {e.name!r} is already pending; "
+                        f"Horovod semantics require unique names per "
+                        f"in-flight collective")
+                seen.add(e.name)
+            now = time.monotonic()
+            for e in entries:
+                self._pending_names[e.name] = e.handle
+                e.enqueue_time = now
+                self._entries.append(e)
+
+    def drain(self) -> List:
+        with self._lock:
+            out, self._entries = self._entries, []
+        # Stable sort: equal priorities keep arrival order, so the default
+        # (all zero) is byte-identical to the historical FIFO drain.
+        out.sort(key=lambda e: -getattr(e, "priority", 0))
+        return out
+
+    def mark_done(self, e):
+        with self._lock:
+            self._pending_names.pop(e.name, None)
+
+    def requeue(self, entries: Sequence):
+        """Put drained-but-not-ready entries back for the next cycle
+        (reference: ComputeResponseList re-queues tensors not yet ready on
+        all ranks).  Names are still registered, so no duplicate check."""
+        with self._lock:
+            self._entries = list(entries) + self._entries
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class FusedProgramCache:
+    """Compiled fused-collective cache (the data-plane half of the steady-
+    state fast path; the control-plane half is the controller's response
+    cache).  Keyed on the *shape signature* of the batch (fusion key +
+    shapes + dtypes + donation + wire compression + chunk counts — counts,
+    never raw chunk byte values, so retuning ``HOROVOD_PIPELINE_CHUNK``
+    only recompiles when the resulting chunk plan actually changes).  Hit
+    == zero Python planning + zero XLA recompile: dispatch cost is one
+    cached-executable launch.
+    """
+
+    def __init__(self, capacity: int = 1024):
+        self.capacity = capacity
+        self._cache: Dict[Tuple, Callable] = {}
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+    def get_or_build(self, key: Tuple, builder: Callable[[], Callable]) -> Callable:
+        fn, _ = self.get_or_build2(key, builder)
+        return fn
+
+    def get_or_build2(self, key: Tuple, builder: Callable[[], Callable]):
+        """Returns ``(fn, hit)`` — hit=False means fn will compile on its
+        first invocation (callers may scope compile-time-only handling)."""
+        if self.capacity <= 0:
+            # Caching disabled (HOROVOD_CACHE_CAPACITY=0): build every time.
+            self.misses += 1
+            return builder(), False
+        fn = self._cache.get(key)
+        if fn is None:
+            self.misses += 1
+            fn = builder()
+            while len(self._cache) >= self.capacity:
+                # LRU eviction (hits reinsert at the end of the dict order):
+                # an A/B-alternating working set one entry over capacity
+                # must not thrash the way FIFO would.
+                self._cache.pop(next(iter(self._cache)))
+                self.evictions += 1
+            self._cache[key] = fn
+            return fn, False
+        # LRU touch: move to the end of the insertion order.
+        self._cache.pop(key)
+        self._cache[key] = fn
+        self.hits += 1
+        return fn, True
+
+
+class StallInspector:
+    """Warns when entries sit unexecuted too long (reference: N11).
+
+    In single-controller mode entries execute next cycle, so stalls indicate
+    an engine bug; in multi-process mode a stall names the ranks that have
+    not submitted a tensor the others are waiting on — the reference's #1
+    user-facing failure diagnosis (SURVEY.md §5 "race detection").
+    """
+
+    def __init__(self, warn_after_s: float, shutdown_after_s: float,
+                 disabled: bool = False):
+        self.warn_after_s = warn_after_s
+        self.shutdown_after_s = shutdown_after_s
+        self.disabled = disabled
+        self._warned: set = set()
+
+    def check(self, waiting: Sequence,
+              missing_ranks: Optional[Dict[str, List[int]]] = None):
+        if self.disabled:
+            return
+        now = time.monotonic()
+        for e in waiting:
+            age = now - e.enqueue_time
+            if age > self.warn_after_s and e.name not in self._warned:
+                self._warned.add(e.name)
+                extra = ""
+                if missing_ranks and e.name in missing_ranks:
+                    extra = f"; ranks not yet submitted: {missing_ranks[e.name]}"
+                log.warning(
+                    "Stall detected: tensor %r has waited %.1fs for "
+                    "negotiation/execution%s", e.name, age, extra)
+            if (self.shutdown_after_s > 0 and age > self.shutdown_after_s):
+                raise RuntimeError(
+                    f"Collective on tensor {e.name!r} stalled for {age:.1f}s "
+                    f"(> HOROVOD_STALL_SHUTDOWN_TIME); aborting")
+
+    def progressed(self, name: str):
+        """A once-stalled tensor completed: clear its warned latch so a
+        *later* collective reusing the name (steady-state training reuses
+        gradient names every step) warns afresh instead of being silently
+        swallowed by the first step's latch."""
+        self._warned.discard(name)
+
+
+class InflightRing:
+    """Bounded window of dispatched-but-unsettled fused batches.
+
+    The cycle thread dispatches a fused program (an async XLA launch) and
+    hands ``(batch, results)`` here instead of blocking on device results;
+    the watcher thread waits for completion and settles the waiters
+    (``e.done``) off the cycle thread, so host-side negotiation of cycle
+    N+1 overlaps device execution of cycle N.  ``depth`` bounds how many
+    batches may be in flight (``HOROVOD_MAX_INFLIGHT``); a full ring makes
+    ``submit`` block — the back-pressure that keeps HBM from filling with
+    queued fused buffers.  ``depth`` is runtime-tunable (autotune
+    coordinate): shrinking simply delays the next submit until the window
+    drains below the new bound.
+
+    ``waiter(results)`` blocks until device results are real (the engine
+    passes ``jax.block_until_ready``); ``settler(batch, results, error)``
+    assigns results and releases waiters.  Both injectable, so the ring is
+    testable without jax.
+    """
+
+    def __init__(self, waiter: Callable, settler: Callable, depth: int = 2):
+        self.depth = max(1, int(depth))
+        self._waiter = waiter
+        self._settler = settler
+        self._cv = threading.Condition()
+        self._items: deque = deque()
+        self._stop = False
+        self.high_water = 0
+        self.dispatched = 0
+        self._thread = threading.Thread(
+            target=self._watch, name="hvd-tpu-inflight", daemon=True)
+        self._thread.start()
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._items)
+
+    def submit(self, batch, results):
+        with self._cv:
+            while len(self._items) >= max(1, self.depth) and not self._stop:
+                self._cv.wait(0.1)
+            self._items.append((batch, results))
+            self.dispatched += 1
+            self.high_water = max(self.high_water, len(self._items))
+            self._cv.notify_all()
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Block until every submitted batch has settled."""
+        with self._cv:
+            return self._cv.wait_for(lambda: not self._items, timeout)
+
+    def stop(self):
+        """Settle everything already submitted, then stop the watcher —
+        waiters must never hang across an engine shutdown."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=10)
+
+    def _watch(self):
+        while True:
+            with self._cv:
+                while not self._items and not self._stop:
+                    self._cv.wait(0.2)
+                if not self._items:
+                    return          # stopped and drained
+                batch, results = self._items[0]
+            error = None
+            try:
+                self._waiter(results)
+            except BaseException as exc:  # noqa: BLE001 - fail the waiters
+                error = exc
+            try:
+                self._settler(batch, results, error)
+            except BaseException:  # noqa: BLE001 - watcher must survive
+                # A raising settler would otherwise kill this thread and
+                # deadlock every later submit against a never-draining
+                # window.  The settler owns waiter release; all the ring
+                # can do is keep the pipeline alive and make the failure
+                # visible.
+                log.exception("in-flight settle failed")
+            finally:
+                # Pop AFTER settling so the window bounds dispatched-but-
+                # unsettled work (a popped-then-settling batch would let
+                # depth+1 launches pile up).
+                with self._cv:
+                    self._items.popleft()
+                    self._cv.notify_all()

@@ -92,6 +92,12 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     p.add_argument("--fusion-threshold-mb", type=int, default=None)
     p.add_argument("--cycle-time-ms", type=float, default=None)
     p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--pipeline-chunk-mb", type=float, default=None,
+                   help="Chunk size (MB) for pipelined fused reductions; "
+                        "0 = one chunk per fused batch (no chunking)")
+    p.add_argument("--max-inflight", type=int, default=None,
+                   help="Bound on dispatched-but-unsettled fused batches "
+                        "(1 = settle inline, no overlap)")
     p.add_argument("--timeline-filename", default=None)
     p.add_argument("--timeline-mark-cycles", action="store_true")
     p.add_argument("--stall-check-time", type=float, default=None)
@@ -256,6 +262,8 @@ def tuning_env(args) -> Dict[str, str]:
             ("fusion_threshold_mb", "HOROVOD_FUSION_THRESHOLD", 1024 * 1024),
             ("cycle_time_ms", "HOROVOD_CYCLE_TIME", 1),
             ("cache_capacity", "HOROVOD_CACHE_CAPACITY", 1),
+            ("pipeline_chunk_mb", "HOROVOD_PIPELINE_CHUNK", 1024 * 1024),
+            ("max_inflight", "HOROVOD_MAX_INFLIGHT", 1),
             ("stall_check_time", "HOROVOD_STALL_CHECK_TIME", 1),
             ("stall_shutdown_time", "HOROVOD_STALL_SHUTDOWN_TIME", 1)):
         val = getattr(args, flag, None)

@@ -47,17 +47,21 @@ def _reduce_numpy_list(arrays, name, op, compression, process_set):
     engine fuses the wire-dtype casts into the jitted collective program,
     and results come back in the inputs' own dtype."""
     from .mpi_ops import _submit
+    # Reverse-registration priority (first variable = highest): the grads
+    # the next forward pass needs first lead the coordinator cycle.  The
+    # variable order is identical across ranks, so the stamps agree.
+    prios = [len(arrays) - i for i in range(len(arrays))]
     wire = getattr(compression, "wire_mode", None)
     if wire is not None:
         outs = eager.grouped_allreduce(
             [_submit(a, process_set) for a in arrays], name=name, op=op,
-            process_set=process_set, compression=wire)
+            process_set=process_set, compression=wire, priorities=prios)
         return [np.asarray(eager.to_local(o)).reshape(a.shape)
                 .astype(a.dtype) for o, a in zip(outs, arrays)]
     comp = [compression.compress(a) for a in arrays]
     outs = eager.grouped_allreduce(
         [_submit(c, process_set) for c, _ in comp], name=name, op=op,
-        process_set=process_set)
+        process_set=process_set, priorities=prios)
     return [compression.decompress(
                 np.asarray(eager.to_local(o)), ctx).reshape(a.shape)
             for o, (_, ctx), a in zip(outs, comp, arrays)]

@@ -172,14 +172,18 @@ def allreduce_async(tensor: torch.Tensor, name: Optional[str] = None,
                     prescale_factor: Optional[float] = None,
                     postscale_factor: Optional[float] = None,
                     process_set: Optional[ProcessSet] = None,
-                    compression: Optional[str] = None) -> int:
+                    compression: Optional[str] = None,
+                    priority: int = 0) -> int:
     """``compression="bf16"``/``"fp16"``: wire-dtype cast fused into the
-    engine's collective program; the result returns in the input dtype."""
+    engine's collective program; the result returns in the input dtype.
+    ``priority``: coordinator drain priority (higher first; must match
+    across ranks — see the engine's priority queue)."""
     inner = eager.allreduce_async(_submit(tensor, process_set), name=name, op=op,
                                   prescale_factor=prescale_factor,
                                   postscale_factor=postscale_factor,
                                   process_set=process_set,
-                                  compression=compression)
+                                  compression=compression,
+                                  priority=priority)
     return _register(inner, tensor)
 
 

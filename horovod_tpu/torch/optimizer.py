@@ -63,6 +63,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             raise ValueError(f"Parameter names are not unique: {dups}")
 
         self._parameter_names = {v: k for k, v in named_parameters}
+        # Reverse-registration drain priority: the first-registered
+        # parameter (first layer touched by the next forward pass) gets the
+        # highest priority, so its gradient — produced LAST by backprop —
+        # still leads the next coordinator cycle (ByteScheduler-style
+        # scheduling).  Registration order matches across ranks, so the
+        # stamps agree.
+        self._priorities = {p: len(named_parameters) - i
+                            for i, (_, p) in enumerate(named_parameters)}
         self._handles = {}
         self._grad_accs = []
         self._requires_update = set()
@@ -141,18 +149,20 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         # collective program, the result comes back in the gradient's
         # dtype (ctx None → decompress is the identity).  Custom
         # compressors keep the explicit compress/decompress hooks.
+        prio = self._priorities.get(p, 0)
         wire = getattr(self._compression, "wire_mode", None)
         if wire is not None:
             handle = mpi_ops.allreduce_async(
                 tensor, name=f"allreduce.{name}", op=wire_op,
                 prescale_factor=prescale, postscale_factor=postscale,
-                process_set=self.process_set, compression=wire)
+                process_set=self.process_set, compression=wire,
+                priority=prio)
             return handle, None
         tensor_compressed, ctx = self._compression.compress(tensor)
         handle = mpi_ops.allreduce_async(
             tensor_compressed, name=f"allreduce.{name}", op=wire_op,
             prescale_factor=prescale, postscale_factor=postscale,
-            process_set=self.process_set)
+            process_set=self.process_set, priority=prio)
         return handle, ctx
 
     # ----------------------------------------------------------- step

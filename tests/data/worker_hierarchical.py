@@ -15,13 +15,16 @@ Launched by test_multiprocess.py::test_hierarchical_two_slices with
 import os
 
 # 4 virtual CPU devices per process — the "slice" (the launcher strips the
-# inherited 8-device flag; each worker declares its own local world).
+# inherited 8-device flag; each worker declares its own local world).  The
+# device count goes through the compat shim: ``jax_num_cpu_devices`` does
+# not exist on jax 0.4.x, where only the XLA flag works.
 os.environ["XLA_FLAGS"] = " ".join(
     f for f in os.environ.get("XLA_FLAGS", "").split()
     if "xla_force_host_platform_device_count" not in f)
 import jax
+from horovod_tpu.compat import set_host_device_count
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+set_host_device_count(4)
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 import numpy as np

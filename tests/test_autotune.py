@@ -153,6 +153,56 @@ def test_parameter_manager_ignores_idle_cycles():
     assert pm.search.evals == 0
 
 
+def test_parameter_manager_pipeline_coordinates(tmp_path):
+    """With a controller present the search gains the response-cache,
+    chunk-bytes and in-flight coordinates (5-point search, 6-float
+    agreement payload); every agreed move lands on the engine knobs and
+    stays inside the coordinate bounds."""
+
+    class FakeCtl:
+        cache_enabled = True
+        cache_capacity = 256
+
+    eng = FakeEngine(thr=1 << 20, cyc=0.001)
+    eng.controller = FakeCtl()
+    eng.pipeline_chunk_bytes = 0           # start derives from threshold
+    eng.max_inflight = 2
+    clock = FakeClock()
+    bc, poll, sent = _loopback_transport()
+    log = tmp_path / "autotune_pipeline.csv"
+    pm = ParameterManager(eng, warmup_samples=0, steps_per_sample=1,
+                          log_path=str(log), clock=clock,
+                          broadcaster=bc, poller=poll, max_evals=10)
+    assert pm._tune_cache and pm._tune_pipeline
+    assert len(pm.search.point) == 5
+    for _ in range(40):
+        if not pm.tuning:
+            break
+        _drive_sample(pm, clock, 1 << 20, 0.01)
+    assert sent and all(len(p) == 6 for p in sent), \
+        [len(p) for p in sent]               # [thr,cyc,cap,chunk,infl,done]
+    assert 1 <= eng.max_inflight <= 8
+    assert (1 << 16) <= eng.pipeline_chunk_bytes <= (1 << 30)
+    assert 1 <= eng.controller.cache_capacity <= 256
+    header = log.read_text().splitlines()[0]
+    assert "pipeline_chunk_bytes" in header and "max_inflight" in header
+
+
+def test_parameter_manager_single_controller_skips_pipeline_coords():
+    """No controller -> the legacy 2-coordinate search and 3-float
+    payload: single-controller mode must not tune dead knobs."""
+    eng = FakeEngine()
+    clock = FakeClock()
+    bc, poll, sent = _loopback_transport()
+    pm = ParameterManager(eng, warmup_samples=0, steps_per_sample=1,
+                          clock=clock, broadcaster=bc, poller=poll,
+                          max_evals=4)
+    assert not pm._tune_cache and not pm._tune_pipeline
+    assert len(pm.search.point) == 2
+    _drive_sample(pm, clock, 1 << 20, 0.01)
+    assert sent and all(len(p) == 3 for p in sent)
+
+
 def test_autotune_end_to_end(monkeypatch):
     """Real engine under HOROVOD_AUTOTUNE=1: tuning completes (including the
     per-move rank-0 agreement broadcasts through the engine itself) and

@@ -1,0 +1,161 @@
+"""Pipelined data plane, hermetic tier: chunked fused reductions must be
+bitwise-identical to the single-chunk program, chunk COUNTS (not raw chunk
+bytes) must key the program cache, and the priority drain must order
+dispatch.  Runs on the 8-virtual-device CPU mesh (single-controller mode —
+the in-flight window itself is multi-process-only and covered by
+tests/data/worker_pipeline.py plus the no-jax ring tests in
+test_scheduler.py)."""
+
+import numpy as np
+import pytest
+
+
+def _engine(hvd):
+    from horovod_tpu.common import basics
+    return basics._get_state().engine
+
+
+@pytest.fixture()
+def chunk_knob(hvd):
+    """Save/restore the engine's pipeline knobs around a test."""
+    eng = _engine(hvd)
+    saved = (eng.pipeline_chunk_bytes, eng.max_inflight)
+    yield eng
+    eng.pipeline_chunk_bytes, eng.max_inflight = saved
+
+
+def _stacked(world, shape, seed, scale=1.0):
+    rng = np.random.RandomState(seed)
+    return np.stack([rng.randn(*shape).astype(np.float32) * scale * (r + 1)
+                     for r in range(world)])
+
+
+def test_chunked_allreduce_bitwise_matches_single_chunk(hvd, world_size,
+                                                        chunk_knob):
+    """Chunk boundaries never change which ranks reduce which element, so
+    the chunked program's results are bitwise-identical — fp32 and with
+    bf16 wire compression."""
+    eng = chunk_knob
+    xs = [_stacked(world_size, (257,), 0), _stacked(world_size, (33, 5), 1)]
+    for comp in (None, "bf16"):
+        eng.pipeline_chunk_bytes = 0          # single chunk (legacy)
+        base = [np.asarray(o) for o in hvd.grouped_allreduce(
+            [x.copy() for x in xs], name=f"chunk_base_{comp}", op=hvd.Sum,
+            compression=comp)]
+        eng.pipeline_chunk_bytes = 256        # 64 elems/chunk -> many chunks
+        out = [np.asarray(o) for o in hvd.grouped_allreduce(
+            [x.copy() for x in xs], name=f"chunk_on_{comp}", op=hvd.Sum,
+            compression=comp)]
+        for b, o in zip(base, out):
+            np.testing.assert_array_equal(b, o)
+
+
+def test_chunked_average_and_scale_factors(hvd, world_size, chunk_knob):
+    eng = chunk_knob
+    x = _stacked(world_size, (129,), 2)
+    eng.pipeline_chunk_bytes = 0
+    base = np.asarray(hvd.allreduce(x.copy(), name="chunk_avg_base",
+                                    op=hvd.Average, prescale_factor=0.5,
+                                    postscale_factor=3.0))
+    eng.pipeline_chunk_bytes = 128
+    out = np.asarray(hvd.allreduce(x.copy(), name="chunk_avg_on",
+                                   op=hvd.Average, prescale_factor=0.5,
+                                   postscale_factor=3.0))
+    np.testing.assert_array_equal(base, out)
+
+
+def test_chunk_count_not_chunk_bytes_keys_program_cache(hvd, world_size,
+                                                        chunk_knob):
+    """Two knob values that produce the SAME chunk plan must share one
+    compiled program; a different plan compiles a new one.  This is what
+    bounds program count while autotune walks the knob."""
+    eng = chunk_knob
+    x = _stacked(world_size, (64,), 3)        # 256 bytes per rank shard
+    eng.pipeline_chunk_bytes = 128            # -> 2 chunks
+    hvd.allreduce(x.copy(), name="keying_a", op=hvd.Sum)
+    misses = eng.cache.misses
+    eng.pipeline_chunk_bytes = 130            # still ceil(256/130) = 2
+    hvd.allreduce(x.copy(), name="keying_b", op=hvd.Sum)
+    assert eng.cache.misses == misses, (
+        "same chunk plan under a different byte knob recompiled")
+    eng.pipeline_chunk_bytes = 64             # -> 4 chunks: a new plan
+    hvd.allreduce(x.copy(), name="keying_c", op=hvd.Sum)
+    assert eng.cache.misses == misses + 1
+
+
+def test_chunk_plan_is_count_per_dtype_group(hvd, world_size, chunk_knob):
+    from horovod_tpu.ops.engine import CollectiveType
+    eng = chunk_knob
+    eng.pipeline_chunk_bytes = 1024
+    shapes = ((world_size, 512), (world_size, 512), (world_size, 100))
+    dtypes = ("float32", "float32", "int32")
+    # fp32 group: 2*512*4 = 4096 B -> 4 chunks; int32 group: 400 B -> 1.
+    assert eng._chunk_plan(CollectiveType.ALLREDUCE, shapes, dtypes) == (4, 1)
+    # Non-reduction ops never chunk.
+    assert eng._chunk_plan(CollectiveType.ALLGATHER, shapes, dtypes) == ()
+    # Degenerate: chunk bound never exceeds the element count.
+    eng.pipeline_chunk_bytes = 1
+    assert eng._chunk_plan(
+        CollectiveType.ALLREDUCE, ((world_size, 3),), ("float32",)) == (3,)
+
+
+def test_priority_orders_single_controller_dispatch(hvd, world_size):
+    """Two non-fusible ops enqueued low-priority-first must dispatch
+    high-priority-first: the compiled-program cache records build order."""
+    from horovod_tpu.ops import eager
+    eng = _engine(hvd)
+    # Enqueue while HOLDING the cycle lock: the background thread (woken by
+    # enqueue) blocks at run_loop_once until we have drained both entries
+    # in one deterministic cycle of our own.
+    with eng._cycle_lock:
+        x = _stacked(world_size, (977,), 4)   # unseen shape: both ops miss
+        h_lo = eager.allreduce_async(x.copy(), name="prio.lo", op=hvd.Max,
+                                     priority=0)
+        h_hi = eager.allreduce_async(x.copy(), name="prio.hi", op=hvd.Min,
+                                     priority=7)
+        before = list(eng.cache._cache)
+        eng._run_cycle_locked()
+    eager.synchronize([h_lo, h_hi])
+    new = [k for k in eng.cache._cache if k not in before]
+    ops = [k[0][1] for k in new]             # fusion key -> reduce_op
+    from horovod_tpu.ops import collectives as C
+    assert ops == [C.ReduceOp.MIN, C.ReduceOp.MAX], (
+        f"high-priority entry did not dispatch first: {ops}")
+
+
+@pytest.mark.parametrize("opname", ["SUM", "AVERAGE", "PRODUCT", "MIN",
+                                    "MAX"])
+@pytest.mark.parametrize("dtname", ["float32", "float16", "bfloat16",
+                                    "int32", "int64", "bool"])
+def test_join_fill_value_is_reduction_identity(opname, dtname):
+    """Property: a joined rank's synthesized contribution must be the true
+    identity of the reduction — reducing it with ANY value x returns x —
+    for every (op, dtype) combination."""
+    import ml_dtypes
+    from horovod_tpu.ops import collectives as C
+    from horovod_tpu.ops.engine import CollectiveEngine, CollectiveType
+
+    op = C.ReduceOp[opname]
+    dt = np.dtype(getattr(ml_dtypes, dtname, None) or dtname)
+    fill = CollectiveEngine._join_fill_value(CollectiveType.ALLREDUCE, op, dt)
+    fill_arr = np.full((16,), fill, dt)
+
+    rng = np.random.RandomState(hash((opname, dtname)) % (1 << 31))
+    if dt == np.bool_:
+        x = rng.rand(16) > 0.5
+    elif np.issubdtype(dt, np.integer):
+        x = rng.randint(-50, 50, 16).astype(dt)
+    else:
+        x = (rng.randn(16) * 10).astype(dt)
+
+    if op in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE):
+        # AVERAGE divides by world AFTER the sum, so the identity
+        # requirement is on the sum itself.
+        reduced = x + fill_arr if dt != np.bool_ else x | fill_arr
+    elif op == C.ReduceOp.PRODUCT:
+        reduced = x * fill_arr if dt != np.bool_ else x & fill_arr
+    elif op == C.ReduceOp.MIN:
+        reduced = np.minimum(x, fill_arr)
+    else:
+        reduced = np.maximum(x, fill_arr)
+    np.testing.assert_array_equal(reduced.astype(dt), x)

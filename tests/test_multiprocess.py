@@ -486,6 +486,23 @@ def test_torovodrun_response_cache_steady_state():
         f"stderr:\n{res.stderr[-3000:]}")
 
 
+WORKER_PIPELINE = os.path.join(REPO, "tests", "data", "worker_pipeline.py")
+
+
+def test_torovodrun_pipeline():
+    """PR 3 acceptance: chunked fused collectives + in-flight dispatch
+    window + priority drain produce bitwise-identical results vs the
+    legacy single-chunk inline path (with and without bf16 wire
+    compression), the steady-state response-cache frame guarantee holds
+    with the pipeline on, and the FusedProgramCache stays bounded by
+    chunk-count keying (assertions live in the worker)."""
+    res = _run_torovodrun(2, WORKER_PIPELINE, timeout=300)
+    ok = res.stdout.count("PIPELINE_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
 def test_torovodrun_sanitizer_catches_divergence_on_cached_path():
     """PR 2 acceptance: HVD_TPU_SANITIZER=1 still catches divergent
     submission order when both ranks are on the cached/bitvector path (the

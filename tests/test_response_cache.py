@@ -130,6 +130,48 @@ def test_cold_path_learns_then_hits():
     _pair(fn)
 
 
+def test_steady_state_frames_hold_with_priority_drain():
+    """Pipeline-on variant of THE regression guard: entries drained through
+    the priority TensorQueue (reverse-registration stamps, the order the
+    DistributedOptimizer bindings produce) must keep the steady-state
+    guarantee — zero per-tensor metadata after warm-up — and verdict order
+    must stay identical across ranks.  Priority reordering changes the
+    ANNOUNCE order, which must be just another steady-state order to the
+    slot table, never a cache-churning event."""
+    from horovod_tpu.ops.scheduler import TensorQueue
+
+    n = 8
+    names = [f"grad.{i}" for i in range(n)]
+
+    def drained_entries():
+        # Backprop arrival order (grad.N-1 first) + reverse-registration
+        # priority: the drain flips it to grad.0-first on every rank.
+        q = TensorQueue()
+        entries = []
+        for i in reversed(range(n)):
+            e = E(names[i])
+            e.handle = i + 1
+            e.priority = n - i
+            entries.append(e)
+        q.push_many(entries)
+        out = q.drain()
+        assert [e.name for e in out] == names
+        return out
+
+    def fn(ctl, rank):
+        _steps(ctl, drained_entries, 2)          # warm-up: learn slots
+        st = ctl.cache_stats
+        full_before = st.full_announces
+        orders = _steps(ctl, drained_entries, 5)
+        assert st.full_announces == full_before, (
+            "priority-drained steady state sent per-tensor metadata")
+        assert st.bit_announces >= 5 * n
+        return orders
+
+    res = _pair(fn)
+    assert res[0] == res[1]
+
+
 # ------------------------------------------------------------ invalidation
 def test_shape_change_falls_back_to_full_negotiation():
     """A new digest (shape change) misses the cache on every rank, rides a

@@ -1,0 +1,260 @@
+"""Scheduler primitives, no jax backend: the priority TensorQueue, the
+StallInspector thresholds, and the InflightRing window — the host-side
+scheduling logic of the pipelined data plane, covered on the fast tier
+(``horovod_tpu/ops/scheduler.py`` deliberately imports no jax so these run
+in milliseconds)."""
+
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.ops.scheduler import (
+    FusedProgramCache, InflightRing, StallInspector, TensorQueue,
+)
+
+
+class E:
+    """Minimal queue entry (the scheduler only getattr-probes it)."""
+
+    _next = iter(range(1, 1 << 20)).__next__
+
+    def __init__(self, name, priority=0):
+        self.name = name
+        self.handle = E._next()
+        self.priority = priority
+        self.enqueue_time = 0.0
+
+
+# -------------------------------------------------------------- TensorQueue
+def test_drain_fifo_when_priorities_equal():
+    q = TensorQueue()
+    q.push_many([E("a"), E("b"), E("c")])
+    assert [e.name for e in q.drain()] == ["a", "b", "c"]
+
+
+def test_drain_priority_order_stable_within_equal():
+    q = TensorQueue()
+    q.push_many([E("low.0", 0), E("hi.0", 5), E("mid", 3),
+                 E("hi.1", 5), E("low.1", 0)])
+    # Higher priority first; arrival order preserved inside each level.
+    assert [e.name for e in q.drain()] == \
+        ["hi.0", "hi.1", "mid", "low.0", "low.1"]
+
+
+def test_reverse_registration_priority_reorders_backprop_arrival():
+    """The binding contract: backprop produces grad.N first and grad.0
+    last, but reverse-registration stamps make grad.0 lead the drain."""
+    q = TensorQueue()
+    n = 6
+    for i in reversed(range(n)):            # arrival: grad.5 ... grad.0
+        q.push(E(f"grad.{i}", priority=n - i))
+    assert [e.name for e in q.drain()] == [f"grad.{i}" for i in range(n)]
+
+
+def test_requeued_entries_resort_with_new_arrivals():
+    q = TensorQueue()
+    q.push_many([E("old.lo", 0), E("old.hi", 2)])
+    drained = q.drain()
+    assert [e.name for e in drained] == ["old.hi", "old.lo"]
+    q.requeue(drained)
+    q.push(E("new.top", 9))
+    assert [e.name for e in q.drain()] == ["new.top", "old.hi", "old.lo"]
+
+
+def test_duplicate_name_rejected_until_done():
+    q = TensorQueue()
+    a = E("t")
+    q.push(a)
+    with pytest.raises(ValueError, match="already pending"):
+        q.push(E("t"))
+    q.drain()
+    with pytest.raises(ValueError, match="already pending"):
+        q.push(E("t"))                       # drained but not done yet
+    q.mark_done(a)
+    q.push(E("t"))                           # completed: name reusable
+
+
+# ------------------------------------------------------------ StallInspector
+def _aged(name, age_s, priority=0):
+    e = E(name, priority)
+    e.enqueue_time = time.monotonic() - age_s
+    return e
+
+
+@pytest.fixture()
+def warnings_log():
+    """Captured messages from the package logger (it sets propagate=False,
+    so pytest's caplog never sees them)."""
+    import logging
+
+    from horovod_tpu.utils.logging import get_logger
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = Capture()
+    logger = get_logger()
+    logger.addHandler(handler)
+    yield records
+    logger.removeHandler(handler)
+
+
+def test_stall_warn_threshold(warnings_log):
+    insp = StallInspector(warn_after_s=1.0, shutdown_after_s=0.0)
+    insp.check([_aged("young", 0.01)])
+    assert not warnings_log
+    insp.check([_aged("stalled", 5.0)])
+    assert any("stalled" in m for m in warnings_log)
+    n = len(warnings_log)
+    insp.check([_aged("stalled", 6.0)])      # warned latch: no re-warn
+    assert len(warnings_log) == n
+
+
+def test_stall_shutdown_threshold():
+    insp = StallInspector(warn_after_s=0.5, shutdown_after_s=2.0)
+    insp.check([_aged("ok", 1.0)])           # warned, below shutdown
+    with pytest.raises(RuntimeError, match="stalled"):
+        insp.check([_aged("dead", 3.0)])
+
+
+def test_stall_disabled_never_warns_or_raises(warnings_log):
+    insp = StallInspector(warn_after_s=0.1, shutdown_after_s=0.2,
+                          disabled=True)
+    insp.check([_aged("late", 10.0)])
+    assert not warnings_log
+
+
+def test_stall_progress_resets_warned_latch(warnings_log):
+    """Steady-state training reuses gradient names: once a stalled tensor
+    completes, a LATER collective under the same name must warn afresh."""
+    insp = StallInspector(warn_after_s=1.0, shutdown_after_s=0.0)
+    insp.check([_aged("grad.0", 5.0)])
+    assert len(warnings_log) == 1
+    insp.progressed("grad.0")                # completion epilogue
+    insp.check([_aged("grad.0", 5.0)])       # next step's stall
+    assert len(warnings_log) == 2
+
+
+def test_stall_missing_ranks_named(warnings_log):
+    insp = StallInspector(warn_after_s=1.0, shutdown_after_s=0.0)
+    insp.check([_aged("t", 5.0)], missing_ranks={"t": [1, 3]})
+    assert any("[1, 3]" in m for m in warnings_log)
+
+
+# -------------------------------------------------------------- InflightRing
+def _mk_ring(depth=2, wait_evt=None):
+    """Ring whose waiter optionally blocks on an event (device stand-in)."""
+    settled = []
+
+    def waiter(results):
+        if wait_evt is not None:
+            assert wait_evt.wait(5.0)
+        if isinstance(results, Exception):
+            raise results
+
+    def settler(batch, results, error):
+        settled.append((tuple(e.name for e in batch), error))
+        for e in batch:
+            e.done = error
+
+    ring = InflightRing(waiter, settler, depth=depth)
+    return ring, settled
+
+
+def test_ring_settles_in_dispatch_order():
+    ring, settled = _mk_ring(depth=4)
+    for i in range(5):
+        ring.submit([E(f"b{i}")], i)
+    assert ring.flush(timeout=5.0)
+    assert [s[0] for s in settled] == [(f"b{i}",) for i in range(5)]
+    assert all(err is None for _, err in settled)
+    assert ring.dispatched == 5
+    ring.stop()
+
+
+def test_ring_bounds_inflight_window():
+    """A full ring back-pressures submit until the watcher settles."""
+    gate = threading.Event()
+    ring, settled = _mk_ring(depth=2, wait_evt=gate)
+    ring.submit([E("a")], 0)
+    ring.submit([E("b")], 1)                 # window now full
+    blocked = threading.Event()
+
+    def third():
+        ring.submit([E("c")], 2)
+        blocked.set()
+
+    t = threading.Thread(target=third, daemon=True)
+    t.start()
+    assert not blocked.wait(0.3), "submit did not block on a full window"
+    assert ring.high_water == 2
+    gate.set()                               # device "completes"
+    assert blocked.wait(5.0)
+    assert ring.flush(timeout=5.0)
+    assert [s[0] for s in settled] == [("a",), ("b",), ("c",)]
+    ring.stop()
+
+
+def test_ring_error_propagates_to_settler():
+    ring, settled = _mk_ring(depth=2)
+    boom = RuntimeError("device error")
+    ring.submit([E("bad")], boom)
+    ring.submit([E("good")], 1)
+    assert ring.flush(timeout=5.0)
+    assert settled[0] == (("bad",), boom)
+    assert settled[1] == (("good",), None)
+    ring.stop()
+
+
+def test_ring_stop_drains_pending():
+    """stop() must settle already-submitted batches — a synchronize()
+    waiter can never be left hanging across shutdown."""
+    ring, settled = _mk_ring(depth=8)
+    for i in range(4):
+        ring.submit([E(f"s{i}")], i)
+    ring.stop()
+    assert len(settled) == 4
+
+
+def test_ring_depth_shrink_applies_to_next_submit():
+    gate = threading.Event()
+    ring, settled = _mk_ring(depth=3, wait_evt=gate)
+    ring.submit([E("a")], 0)
+    ring.depth = 1                           # runtime retune (autotune)
+    blocked = threading.Event()
+
+    def nxt():
+        ring.submit([E("b")], 1)
+        blocked.set()
+
+    threading.Thread(target=nxt, daemon=True).start()
+    assert not blocked.wait(0.3), "shrunken window did not back-pressure"
+    gate.set()
+    assert blocked.wait(5.0)
+    ring.flush(timeout=5.0)
+    ring.stop()
+
+
+# --------------------------------------------------- FusedProgramCache keys
+def test_program_cache_distinguishes_chunk_plans():
+    """Chunk COUNTS key the cache: two knob values mapping to the same
+    plan share one entry; a different plan compiles a new one."""
+    cache = FusedProgramCache(capacity=8)
+    built = []
+
+    def builder(tag):
+        def b():
+            built.append(tag)
+            return tag
+        return b
+
+    base = ("fusion-key", ((8, 16),), ("float32",), (False,), False, False)
+    cache.get_or_build(base + ((2,),), builder("two-chunk"))
+    cache.get_or_build(base + ((2,),), builder("two-chunk-again"))
+    cache.get_or_build(base + ((4,),), builder("four-chunk"))
+    assert built == ["two-chunk", "four-chunk"]
+    assert len(cache) == 2 and cache.hits == 1
