@@ -194,11 +194,31 @@ def _control_plane_stats():
     chunks = (round(getattr(eng, "pipeline_chunks_total", 0) / dispatches, 3)
               if dispatches else None)
     ring = getattr(eng, "_inflight", None)
+    # Monitor-plane telemetry (HOROVOD_MONITOR=1): aggregated cycle-time
+    # spread / slowest rank from the cross-rank side-channel, plus the
+    # frame bytes the new plane itself cost — so BENCH_*.json tracks the
+    # monitoring plane's overhead on every line.  Nulls when the monitor
+    # (or the multi-rank table) is off — absence of data, not zero cost.
+    mon = getattr(_basics._get_state(), "monitor", None)
+    if mon is not None:
+        skew = mon.aggregator.skew()
+        monitor = {
+            "enabled": True,
+            "ranks_reporting": len(mon.aggregator.ranks()),
+            "cycle_us_spread": skew.get("cycle_us_spread"),
+            "slowest_rank": skew.get("slowest_rank"),
+            "frames_sent": mon.frames_sent,
+            "metrics_frame_bytes":
+                getattr(ctl, "monitor_bytes_sent", 0) if ctl else 0,
+        }
+    else:
+        monitor = {"enabled": False}
     return {"negotiation_us_per_cycle": per_cycle,
             "response_cache_hit_rate":
                 round(rate, 4) if rate is not None else None,
             "chunks_per_cycle": chunks,
-            "inflight_depth": ring.high_water if ring is not None else 0}
+            "inflight_depth": ring.high_water if ring is not None else 0,
+            "monitor": monitor}
 
 
 def bench_response_cache(iters=30, n_tensors=8, errors=None):
@@ -315,6 +335,85 @@ def bench_pipeline(iters=20, errors=None):
             out[wl_name] = sec
     finally:
         eng.pipeline_chunk_bytes, eng.max_inflight = saved_chunk, saved_infl
+    return out
+
+
+def bench_monitor(iters=30, n_tensors=8, errors=None):
+    """Telemetry plane ON vs OFF A/B: the same eager steady-state workload
+    with no MonitorAgent attached, then with one attached at an aggressive
+    reporting interval (so frames actually ride the rounds during the
+    measured window).  The claim under test — metrics frames never delay
+    negotiation — is recorded as ``within_noise``: the ON step time must
+    stay within jitter of OFF.  Works in any mode; the side-channel half
+    (frame bytes) additionally needs a controller."""
+    import jax
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics as _basics
+    from horovod_tpu.monitor.agent import MonitorAgent
+
+    eng = _basics._get_state().engine
+    ctl = eng.controller
+    preexisting = _basics._get_state().monitor
+    out = {"already_enabled": preexisting is not None}
+    if preexisting is not None:
+        # The whole bench was launched with HOROVOD_MONITOR=1: no
+        # un-monitored baseline exists, and the user's agent must survive.
+        return out
+    # Input shape follows the launch mode, like bench_pipeline: stacked
+    # [world, elems] in single-controller mode, the local contribution
+    # per process otherwise.
+    multi_proc = jax.process_count() > 1
+    m = hvd.mesh()
+    n_local = len([d for d in m.devices.flat
+                   if d.process_index == jax.process_index()])
+    elems = 1 << 14
+    shape = ((n_local, elems) if n_local > 1 else (elems,)) \
+        if multi_proc else (hvd.size(), elems)
+    xs = [np.full(shape, 1.0 + j * 1e-6, np.float32)
+          for j in range(n_tensors)]
+
+    def phase(n_iter):
+        t0 = time.perf_counter()
+        for _ in range(n_iter):
+            outs = hvd.grouped_allreduce(xs, name="monitor_bench",
+                                         op=hvd.Sum)
+        del outs
+        return round((time.perf_counter() - t0) / n_iter * 1e3, 3)
+
+    phase(3)                                    # warm: slots + programs
+    off_ms = phase(iters)
+    world = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
+    rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
+    agent = MonitorAgent(engine=eng, controller=ctl, rank=rank,
+                         world=max(1, world), interval_s=0.05)
+    try:
+        phase(3)
+        on_ms = phase(iters)
+        out.update({
+            "off_step_ms": off_ms, "on_step_ms": on_ms,
+            "overhead_pct": round(100.0 * (on_ms / off_ms - 1.0), 2)
+            if off_ms else None,
+            "frames_sent": agent.frames_sent,
+            "metrics_frame_bytes":
+                getattr(ctl, "monitor_bytes_sent", 0) if ctl else 0,
+        })
+        # "Within noise": ON stays inside the jitter band repeated
+        # identical phases show (15% or 0.2 ms, whichever is larger).
+        # Only a GROSS miss (1.5x + 1 ms) lands in errors[] — the bench
+        # never hard-fails, and the single-core CPU smoke tier is too
+        # jittery to treat the tight band as an error there; the A/B
+        # history tracks within_noise either way.
+        within = (on_ms <= off_ms * 1.15) or (on_ms - off_ms <= 0.2)
+        out["within_noise"] = bool(within)
+        if errors is not None and on_ms > off_ms * 1.5 + 1.0:
+            errors["monitor_overhead"] = (
+                f"monitoring ON step {on_ms}ms vs OFF {off_ms}ms "
+                f"(gross regression, far beyond noise)")
+    finally:
+        agent.close()
+    _record_timing("monitor_ab", warmup=3, iters=iters,
+                   wall_s=(off_ms + on_ms) * iters / 1e3)
     return out
 
 
@@ -1222,6 +1321,10 @@ def _run(out, errors):
             out["pipeline"] = bench_pipeline(errors=errors)
         except Exception as exc:  # noqa: BLE001 - contained
             errors["pipeline"] = repr(exc)
+        try:
+            out["monitor_ab"] = bench_monitor(errors=errors)
+        except Exception as exc:  # noqa: BLE001 - contained
+            errors["monitor_ab"] = repr(exc)
         return
 
     if model == "llama":
@@ -1315,6 +1418,11 @@ def _run(out, errors):
         out["pipeline"] = bench_pipeline(errors=errors)
     except Exception as exc:  # noqa: BLE001 - contained
         errors["pipeline"] = repr(exc)
+
+    try:
+        out["monitor_ab"] = bench_monitor(errors=errors)
+    except Exception as exc:  # noqa: BLE001 - contained
+        errors["monitor_ab"] = repr(exc)
 
     if os.environ.get("HVD_BENCH_SKIP_AUTOTUNE", "") != "1":
         try:

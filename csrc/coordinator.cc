@@ -20,6 +20,15 @@
 //                                               uint16 tlen, bytes tag }
 //             uint32 bv_len, bytes bitvec       (bit i = cache slot i pending)
 //             uint32 n_tag, n_tag * { uint32 slot, uint16 len, bytes tag }
+//             [optional, protocol v3] uint32 magic "MON1",
+//                                     uint32 blen, bytes monitor_blob
+//             (the monitor side-channel: an opaque telemetry snapshot the
+//              rank ships at its HOROVOD_MONITOR_INTERVAL — absent on most
+//              rounds.  A pre-v3 server never parses past the tag section,
+//              so the trailing bytes are ignored: old servers tolerate new
+//              clients.  Low priority by construction: the blob rides the
+//              same lock-step frame, so it can never delay a negotiation
+//              verdict — it only adds bytes to rounds that carry it)
 //             (the bitvector is the steady-state fast path: a slot id is a
 //              replicated handle for a (name, digest, required, datadep,
 //              grouped) tuple the server assigned on its first full
@@ -61,6 +70,16 @@
 //                                                the digest strings to
 //                                                synthesize contributions)
 //             uint32 n_evict, n_evict * uint32 slot
+//             [protocol v3] uint32 magic "MON1", uint32 n_blob,
+//                           n_blob * { uint32 rank, uint32 blen, bytes }
+//             (store-and-forward of the monitor blobs received THIS round,
+//              re-broadcast to every rank so each process — most usefully
+//              rank 0's HTTP exporter — can hold the fleet-wide telemetry
+//              table.  Always appended (even empty): the magic doubles as
+//              the server's protocol-v3 capability advertisement, which is
+//              how clients version-gate their own monitor frames.  Pre-v3
+//              clients stop parsing after the eviction section and ignore
+//              the trailing bytes)
 //             (evictions are broadcast in the same lock-step round on every
 //              rank, so client slot tables can never diverge; a join epoch
 //              flushes ALL slots — full renegotiation while the world is
@@ -115,6 +134,18 @@
 namespace {
 
 using Clock = std::chrono::steady_clock;
+
+// Monitor side-channel section marker ("MON1" little-endian).  Doubles as
+// the protocol-v3 capability advertisement in responses.
+constexpr uint32_t kMonMagic = 0x314e4f4d;
+// Per-blob and per-response caps for the monitor section: the aggregate
+// re-broadcast must stay well inside the client's fixed 4MB receive
+// buffer (_RESP_CAP in common/controller.py) no matter how many ranks
+// report in one round — telemetry that overflows is dropped, never a
+// negotiation failure.  Dropped blobs are naturally retried: the rank
+// re-reports at its next interval.
+constexpr uint32_t kMonBlobCap = 64 * 1024;
+constexpr size_t kMonSectionCap = 1024 * 1024;
 
 // ---------------------------------------------------------------- framing
 bool read_exact(int fd, void* buf, size_t n) {
@@ -346,6 +377,11 @@ void Server::run_inner() {
     std::map<uint32_t, AssignRec> assigns;
     std::vector<uint32_t> evictions;   // ids freed this round: broadcast,
                                        // reusable only from the next round
+    // Monitor blobs received this round (rank, opaque payload) — pure
+    // store-and-forward: re-broadcast in this round's response so every
+    // client's aggregation table tracks the fleet.  The server never
+    // parses the payload.
+    std::vector<std::pair<int, std::string>> mon_blobs;
     bool join_started = false;
     // slot: >= 0 answers may ride the ready bitvector; -1 forces strings.
     auto handle_announce = [&](int r, uint16_t required,
@@ -570,6 +606,25 @@ void Server::run_inner() {
         for (uint32_t i = 0; i < nt && rd.ok; ++i) {
           uint32_t slot = rd.u32();
           bit_tags[slot] = rd.str();
+        }
+      }
+      // Optional monitor section (protocol v3): an opaque telemetry blob
+      // for store-and-forward.  A malformed/truncated section is dropped
+      // without failing the round — telemetry must never cost negotiation.
+      // Oversized blobs (> kMonBlobCap) are dropped for the same reason:
+      // the re-broadcast must never push a response past the client's
+      // fixed receive buffer (telemetry is lossy by design; the rank
+      // simply reports again next interval).
+      if (rd.ok && rd.p + 8 <= rd.end) {
+        uint32_t magic = rd.u32();
+        if (magic == kMonMagic) {
+          uint32_t blen = rd.u32();
+          if (rd.ok && rd.p + blen <= rd.end) {
+            if (blen <= kMonBlobCap)
+              mon_blobs.emplace_back(
+                  r, std::string(reinterpret_cast<const char*>(rd.p), blen));
+            rd.p += blen;
+          }
         }
       }
       for (uint32_t id : bit_slots) {
@@ -813,6 +868,27 @@ void Server::run_inner() {
     for (uint32_t s : ready_slots) resp[bv_off + s / 8] |= (1u << (s % 8));
     put_u32(&resp, static_cast<uint32_t>(evictions.size()));
     for (uint32_t s : evictions) put_u32(&resp, s);
+    // Monitor section (protocol v3): this round's blobs, re-broadcast to
+    // every rank.  Appended even when empty — the magic is the server's
+    // capability advertisement clients version-gate on.  Bounded by
+    // kMonSectionCap: at very large worlds a synchronized reporting
+    // interval lands every rank's blob in one round, and the section must
+    // stay far from the client receive cap — the overflow is dropped
+    // (those ranks' tables lag one interval, nothing worse).
+    size_t mon_budget = kMonSectionCap;
+    std::vector<std::pair<int, std::string>*> mon_send;
+    for (auto& b : mon_blobs) {
+      if (b.second.size() + 8 > mon_budget) continue;
+      mon_budget -= b.second.size() + 8;
+      mon_send.push_back(&b);
+    }
+    put_u32(&resp, kMonMagic);
+    put_u32(&resp, static_cast<uint32_t>(mon_send.size()));
+    for (auto* b : mon_send) {
+      put_u32(&resp, static_cast<uint32_t>(b->first));
+      put_u32(&resp, static_cast<uint32_t>(b->second.size()));
+      resp.insert(resp.end(), b->second.begin(), b->second.end());
+    }
     // Attempt EVERY rank before honoring a failure: one dead/closing peer
     // must not cut the survivors off from a round's computed verdicts
     // (they may contain the ready broadcast that lets them finish cleanly).

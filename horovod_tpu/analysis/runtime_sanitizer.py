@@ -28,8 +28,27 @@ Mechanism:
   fires, the report carries the ledger tail so the laggard ranks' last
   submissions (with call sites) are visible next to the stuck tensor.
 
+- **Content-hash mode** (``HVD_TPU_SANITIZER=hash``): additionally folds a
+  device→host content digest of each entry's local contribution into the
+  tag (``;h=<16hex>``), closing the same-site blind spot — two ranks
+  submitting divergent *data* through one call site and sequence (e.g. a
+  loop over differently-ordered lists of same-shaped tensors under
+  auto-names) match on every structural field, and only the content can
+  tell them apart.  The check compares LOCAL contributions across ranks,
+  so it is sound exactly where contributions are expected replicated
+  (hyperparameters, schedules, reproduction runs with mirrored data);
+  ordinary data-parallel gradients legitimately differ per rank and will
+  flag — hash mode is a targeted debugging tool, not a production mode
+  (docs/analysis.md "content-hash mode").
+- With the monitor subsystem on (``HOROVOD_MONITOR=1``), HVD302 stall
+  reports also quote the *laggard ranks'* ledger tails, pulled from the
+  cross-rank aggregation table (``horovod_tpu.monitor``,
+  docs/monitoring.md) — the stalling rank no longer has to ssh into the
+  peer's logs to see what it last submitted.
+
 Env vars:
-  HVD_TPU_SANITIZER=1          enable
+  HVD_TPU_SANITIZER=1          enable (tag mode)
+  HVD_TPU_SANITIZER=hash       enable + content-hash the local contribution
   HVD_TPU_SANITIZER_TIMEOUT=s  stall warn threshold (default 30)
   HVD_TPU_SANITIZER_LEDGER=n   ledger capacity (default 512)
 """
@@ -49,9 +68,19 @@ from ..utils.logging import get_logger
 log = get_logger()
 
 
+def mode() -> Optional[str]:
+    """``"tag"`` (HVD_TPU_SANITIZER=1), ``"hash"`` (=hash — tag plus a
+    device→host content digest of the local contribution), or None."""
+    v = os.environ.get("HVD_TPU_SANITIZER", "").strip().lower()
+    if v in ("1", "true", "on", "yes"):
+        return "tag"
+    if v == "hash":
+        return "hash"
+    return None
+
+
 def enabled() -> bool:
-    return os.environ.get("HVD_TPU_SANITIZER", "").strip() in ("1", "true",
-                                                               "on", "yes")
+    return mode() is not None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,8 +109,13 @@ def _caller_site() -> str:
 class CollectiveSanitizer:
     """Per-engine ledger recorder + digest tagger."""
 
-    def __init__(self, capacity: int = 512):
+    def __init__(self, capacity: int = 512, content_hash: bool = False):
         self.capacity = capacity
+        # HVD_TPU_SANITIZER=hash: fold a content digest of each entry's
+        # LOCAL contribution into the tag.  Costs one device→host copy per
+        # submission — the documented price of closing the same-site
+        # blind spot.
+        self.content_hash = content_hash
         self._lock = threading.Lock()
         # Sequence counters are PER PROCESS SET: subgroup collectives are
         # legitimately submitted only by member ranks, so a single global
@@ -92,9 +126,21 @@ class CollectiveSanitizer:
         self.ledger: Deque[LedgerEntry] = collections.deque(maxlen=capacity)
 
     # ------------------------------------------------------------- recording
-    def observe(self, entries: Sequence, site: Optional[str] = None) -> None:
-        """Record and tag freshly built engine entries (pre-negotiation)."""
+    def observe(self, entries: Sequence, site: Optional[str] = None,
+                hash_content: bool = True) -> None:
+        """Record and tag freshly built engine entries (pre-negotiation).
+
+        ``hash_content=False`` skips the content digest even in hash mode
+        (synthesized join entries: never announced, and their identity
+        fill would pointlessly pay the host copy)."""
         site = site or _caller_site()
+        hashes = {}
+        if self.content_hash and hash_content:
+            # Outside the lock: device→host copies can be slow and must
+            # not serialize concurrent submitters more than they already
+            # do.  Entries are not yet shared with the engine queue here.
+            for e in entries:
+                hashes[id(e)] = self._content_hash(e)
         with self._lock:
             for e in entries:
                 ps = getattr(e, "process_set_id", 0)
@@ -102,6 +148,9 @@ class CollectiveSanitizer:
                 self._seq[ps] = seq + 1
                 digest = self._entry_digest(e)
                 tag = f"seq={ps}:{seq};site={site}"
+                h = hashes.get(id(e))
+                if h is not None:
+                    tag += f";h={h}"
                 # Stamped onto the entry: the controller ships it beside
                 # the digest (full announce tag field / bitvector side-
                 # channel) and the server folds it into its mismatch
@@ -143,7 +192,32 @@ class CollectiveSanitizer:
         submitting, so this rank must too, or every post-join collective
         would mismatch on seq.  Synthesized entries are never announced, so
         the tag itself doesn't hit the wire — only the counter matters."""
-        self.observe([entry], site="<joined:synthesized>")
+        self.observe([entry], site="<joined:synthesized>", hash_content=False)
+
+    @staticmethod
+    def _content_hash(e) -> Optional[str]:
+        """Digest of this rank's LOCAL contribution (the addressable
+        shards of a multi-process global array; the whole array in
+        single-controller mode).  Returns None when the entry carries no
+        tensor (barrier) or the copy fails — the tag then simply omits
+        the hash field, and the server compares what both sides sent."""
+        t = getattr(e, "tensor", None)
+        if t is None:
+            return None
+        import hashlib
+        import numpy as np
+        h = hashlib.blake2b(digest_size=8)
+        try:
+            shards = getattr(t, "addressable_shards", None)
+            if shards:
+                for s in shards:
+                    h.update(np.ascontiguousarray(
+                        np.asarray(s.data)).tobytes())
+            else:
+                h.update(np.ascontiguousarray(np.asarray(t)).tobytes())
+        except Exception:  # noqa: BLE001 - diagnostics must not kill submit
+            return None
+        return h.hexdigest()
 
     @staticmethod
     def _entry_digest(e) -> str:
@@ -180,6 +254,12 @@ class SanitizerStallInspector:
                  warn_after_s: float):
         self._inner = inner
         self._sanitizer = sanitizer
+        # Installed by the monitor subsystem (horovod_tpu.monitor
+        # MonitorAgent): a zero-arg callable returning the PEER ranks'
+        # ledger tails from the cross-rank aggregation table, so a stall
+        # report shows what the laggard last submitted — not only this
+        # rank's own tail (the ROADMAP ledger-exchange item).
+        self.peer_ledger_source = None
         # The sanitizer timeout is authoritative in BOTH directions: the
         # README documents HVD_TPU_SANITIZER_TIMEOUT as the stall-report
         # threshold, so raising it past HOROVOD_STALL_CHECK_TIME must work
@@ -201,6 +281,20 @@ class SanitizerStallInspector:
         collective reusing the name warns afresh."""
         self._inner.progressed(name)
 
+    @property
+    def stalled(self):
+        """Live stall state passthrough (monitor /health export)."""
+        return self._inner.stalled
+
+    def _peer_report(self) -> str:
+        if self.peer_ledger_source is None:
+            return ""
+        try:
+            report = self.peer_ledger_source()
+        except Exception:  # noqa: BLE001 - diagnostics only
+            return ""
+        return f"\n{report}" if report else ""
+
     def check(self, waiting, missing_ranks=None):
         before = set(self._inner._warned)
         try:
@@ -208,6 +302,7 @@ class SanitizerStallInspector:
         except RuntimeError as exc:
             raise RuntimeError(
                 f"{exc}\nHVD302 sanitizer: {self._sanitizer.render_tail()}"
+                f"{self._peer_report()}"
             ) from None
         newly = set(self._inner._warned) - before
         if newly:
@@ -217,22 +312,24 @@ class SanitizerStallInspector:
                 site = site.split("site=", 1)[1] if "site=" in site else "?"
                 log.warning(
                     "HVD302 sanitizer: collective %r (submitted at %s) is "
-                    "stalled%s; %s", name, site,
+                    "stalled%s; %s%s", name, site,
                     (f" waiting on ranks {missing_ranks[name]}"
                      if missing_ranks and name in missing_ranks else ""),
-                    self._sanitizer.render_tail())
+                    self._sanitizer.render_tail(), self._peer_report())
 
 
 def maybe_install(engine) -> Optional[CollectiveSanitizer]:
     """Attach a sanitizer to a freshly built CollectiveEngine when the env
     opts in; returns it (or None).  Called from the engine constructor so
     every init()'d runtime — JAX, torch or TF binding — is covered."""
-    if not enabled():
+    m = mode()
+    if m is None:
         return None
     capacity = int(os.environ.get("HVD_TPU_SANITIZER_LEDGER", "512") or 512)
     timeout = float(os.environ.get("HVD_TPU_SANITIZER_TIMEOUT", "30") or 30)
-    sanitizer = CollectiveSanitizer(capacity=capacity)
+    sanitizer = CollectiveSanitizer(capacity=capacity,
+                                    content_hash=(m == "hash"))
     engine.stall = SanitizerStallInspector(engine.stall, sanitizer, timeout)
-    log.info("collective sanitizer enabled (timeout=%.1fs, ledger=%d)",
-             timeout, capacity)
+    log.info("collective sanitizer enabled (mode=%s, timeout=%.1fs, "
+             "ledger=%d)", m, timeout, capacity)
     return sanitizer

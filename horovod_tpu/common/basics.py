@@ -51,6 +51,7 @@ class GlobalState:
         self.engine = None          # ops.engine.CollectiveEngine
         self.timeline = None        # utils.timeline.Timeline
         self.controller = None      # multi-process TCP controller client
+        self.monitor = None         # monitor.MonitorAgent (HOROVOD_MONITOR)
         self._lock = threading.Lock()
 
 
@@ -142,6 +143,31 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None,
                 if not cfg.stall_check_disable else 1e18,
                 cache_capacity=cfg.response_cache_capacity)
             st.engine.controller = st.controller
+
+        if cfg.monitor:
+            # Cross-rank telemetry & health subsystem (docs/monitoring.md):
+            # per-rank registry + coordinator side-channel aggregation; the
+            # HTTP exporter serves /metrics + /health on rank 0 when a
+            # port is configured.  Installed before engine.start() so the
+            # very first cycle is observed.
+            from ..monitor.agent import MonitorAgent
+            mon_rank = cfg.rank_env if cfg.rank_env >= 0 else 0
+            mon_world = cfg.size_env if (multi_process
+                                         and cfg.size_env > 0) else 1
+            st.monitor = MonitorAgent(
+                engine=st.engine, controller=st.controller,
+                rank=mon_rank, world=mon_world,
+                interval_s=cfg.monitor_interval_s, timeline=st.timeline)
+            if cfg.monitor_port > 0 and mon_rank == 0:
+                try:
+                    st.monitor.serve_http(cfg.monitor_port)
+                except OSError as exc:
+                    # A taken port must not kill training — the telemetry
+                    # plane is strictly best-effort.
+                    from ..utils.logging import get_logger
+                    get_logger().warning(
+                        "monitor: could not bind HTTP port %d (%s); "
+                        "exporter disabled", cfg.monitor_port, exc)
         st.engine.start()
 
         st.initialized = True
@@ -159,6 +185,9 @@ def shutdown() -> None:
         if st.engine is not None:
             st.engine.stop()
             st.engine = None
+        if st.monitor is not None:
+            st.monitor.close()
+            st.monitor = None
         if st.controller is not None:
             st.controller.shutdown()
             st.controller = None

@@ -114,6 +114,16 @@ class Config:
     pipeline_chunk_bytes: int = 0
     max_inflight: int = 2
 
+    # Cross-rank telemetry & health subsystem (horovod_tpu.monitor,
+    # docs/monitoring.md).  HOROVOD_MONITOR=1 enables the per-rank metric
+    # registry + the coordinator monitor side-channel (protocol v3);
+    # HOROVOD_MONITOR_PORT > 0 additionally serves /metrics (Prometheus) +
+    # /health (JSON) over HTTP on rank 0; HOROVOD_MONITOR_INTERVAL is the
+    # snapshot reporting period in seconds.
+    monitor: bool = False
+    monitor_port: int = 0
+    monitor_interval_s: float = 5.0
+
     timeline_filename: str = ""
     timeline_mark_cycles: bool = False
 
@@ -173,6 +183,9 @@ class Config:
             response_cache_capacity=_env_int("RESPONSE_CACHE_CAPACITY", 2048),
             pipeline_chunk_bytes=_env_int("PIPELINE_CHUNK", 0),
             max_inflight=_env_int("MAX_INFLIGHT", 2),
+            monitor=_env_bool("MONITOR", False),
+            monitor_port=_env_int("MONITOR_PORT", 0),
+            monitor_interval_s=_env_float("MONITOR_INTERVAL", 5.0),
             timeline_filename=_env("TIMELINE", "") or "",
             timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
             stall_check_time_s=_env_float("STALL_CHECK_TIME", 60.0),

@@ -25,6 +25,10 @@ log = get_logger()
 
 _RESP_CAP = 4 * 1024 * 1024
 
+# Monitor side-channel section marker ("MON1" little-endian) — protocol v3.
+# Matches kMonMagic in csrc/coordinator.cc.
+_MON_MAGIC = 0x314E4F4D
+
 
 @dataclasses.dataclass
 class ResponseCacheStats:
@@ -108,6 +112,22 @@ class TCPController:
         # the server learned it.
         self._awaiting_assign: set = set()
         self.bytes_sent = 0                      # telemetry (tests/timeline)
+        # Monitor side-channel (protocol v3, horovod_tpu.monitor): when a
+        # MonitorAgent is attached, `monitor_source()` may yield an opaque
+        # snapshot blob to append to this round's request (interval-gated
+        # by the agent — absent on most rounds), and `monitor_sink(blobs)`
+        # receives the server's re-broadcast of every rank's fresh blobs.
+        # `peer_monitor_proto` latches once the server advertises the v3
+        # monitor section in a response — the agent's version gate: against
+        # a pre-v3 server it stops paying frame bytes after a grace window.
+        # Telemetry must NEVER fail negotiation: both callbacks are guarded.
+        self.monitor_source = None
+        self.monitor_sink = None
+        self.on_join_epoch = None     # monitor aggregation-table flush hook
+        self.monitor_bytes_sent = 0   # subset of bytes_sent (frame guard
+                                      # tests subtract it)
+        self.peer_monitor_proto = False
+        self.rounds = 0
         self._early_ready: List[tuple] = []       # (name, digest)
         self._early_errors: Dict[str, str] = {}
         self._resp_buf = (ctypes.c_uint8 * _RESP_CAP)()
@@ -178,6 +198,19 @@ class TCPController:
         for slot, tag in tags:
             tb = tag.encode()
             req += struct.pack("<IH", slot, len(tb)) + tb
+        # Monitor side-channel (absent on most rounds — the agent interval-
+        # gates it).  A pre-v3 server stops parsing after the tag section,
+        # so the trailing bytes are simply ignored there.
+        self.rounds += 1
+        if self.monitor_source is not None:
+            try:
+                blob = self.monitor_source()
+            except Exception:  # noqa: BLE001 - telemetry never fails a round
+                log.exception("monitor source failed")
+                blob = None
+            if blob:
+                req += struct.pack("<II", _MON_MAGIC, len(blob)) + blob
+                self.monitor_bytes_sent += 8 + len(blob)
         stats.full_announces += sum(1 for a in full
                                     if not a[0].startswith("\x1f"))
         stats.bit_announces += len(bits)
@@ -282,6 +315,28 @@ class TCPController:
                 if key is not None:
                     self._slots.pop(key, None)
                     self.cache_stats.invalidations += 1
+        # Monitor section (protocol v3): the server's re-broadcast of this
+        # round's fleet snapshots.  The magic is also its capability
+        # advertisement — seeing it latches peer_monitor_proto, which the
+        # agent's version gate reads.
+        if off + 8 <= len(data):
+            (magic,) = struct.unpack_from("<I", data, off)
+            if magic == _MON_MAGIC:
+                off += 4
+                (n_blob,) = struct.unpack_from("<I", data, off)
+                off += 4
+                blobs = []
+                for _ in range(n_blob):
+                    (mr, ln) = struct.unpack_from("<II", data, off)
+                    off += 8
+                    blobs.append((mr, data[off:off + ln]))
+                    off += ln
+                self.peer_monitor_proto = True
+                if blobs and self.monitor_sink is not None:
+                    try:
+                        self.monitor_sink(blobs)
+                    except Exception:  # noqa: BLE001 - telemetry only
+                        log.exception("monitor sink failed")
         return ready, warns, errors
 
     def _adopt_slot(self, key: tuple, slot: int):
@@ -414,6 +469,14 @@ class TCPController:
                 # joining rank) and unblock the join() caller.
                 self._joined = False
                 self._join_last_rank = int(digest)
+                if self.on_join_epoch is not None:
+                    # Monitor aggregation-table flush: snapshots captured
+                    # while the world was uneven must not survive the
+                    # epoch (mirrors the server's slot-table flush).
+                    try:
+                        self.on_join_epoch(self._join_last_rank)
+                    except Exception:  # noqa: BLE001 - telemetry only
+                        log.exception("join-epoch monitor hook failed")
                 self._join_event.set()
                 continue
             e = by_name.pop(name, None)

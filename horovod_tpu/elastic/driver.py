@@ -341,18 +341,14 @@ def run_elastic(args) -> int:
         discovery = HostDiscoveryScript(args.host_discovery_script,
                                         default_slots=args.slots_per_host
                                         or 1)
-    extra_env = {}
-    for flag, var, scale in (
-            ("fusion_threshold_mb", "HOROVOD_FUSION_THRESHOLD", 1024 * 1024),
-            ("cycle_time_ms", "HOROVOD_CYCLE_TIME", 1),
-            ("cache_capacity", "HOROVOD_CACHE_CAPACITY", 1),
-            ("pipeline_chunk_mb", "HOROVOD_PIPELINE_CHUNK", 1024 * 1024),
-            ("max_inflight", "HOROVOD_MAX_INFLIGHT", 1),
-            ("stall_check_time", "HOROVOD_STALL_CHECK_TIME", 1),
-            ("stall_shutdown_time", "HOROVOD_STALL_SHUTDOWN_TIME", 1)):
-        val = getattr(args, flag, None)
-        if val is not None:
-            extra_env[var] = str(int(val * scale) if scale != 1 else val)
+    # One knob table for every launch path: tuning_env covers the fusion/
+    # cycle/cache/pipeline/stall/monitor/autotune flags, so a knob can
+    # never work on the static path and silently vanish on the elastic
+    # one (this loop used to be a drifting hand copy).  A join epoch also
+    # flushes each worker's monitor aggregation table — that hook lives in
+    # the controller client, so re-ranked survivors start clean.
+    from ..runner.run import tuning_env
+    extra_env = tuning_env(args)
     if getattr(args, "timeline_filename", None):
         extra_env["HOROVOD_TIMELINE"] = args.timeline_filename
     driver = ElasticDriver(

@@ -1,0 +1,382 @@
+"""MonitorAgent: wires the telemetry subsystem into a live runtime.
+
+One agent per initialized process (``hvd.init()`` with ``HOROVOD_MONITOR=1``
+— see ``common/basics.py``).  Everything here is duck-typed against the
+engine/controller/sanitizer objects and imports no jax, so the agent (and
+the whole ``horovod_tpu.monitor`` package) stays importable on the jax-free
+fast test tier.
+
+Responsibilities:
+
+- own the per-rank :class:`~.registry.MetricRegistry` and register the
+  collectors that refresh it from the engine, scheduler primitives,
+  response cache, in-flight ring and sanitizer;
+- encode this rank's periodic snapshot for the controller's low-priority
+  monitor frames (``monitor_source``) and decode peers' re-broadcast
+  snapshots into the :class:`~.aggregator.RankAggregator`
+  (``monitor_sink``), flushing the table at join-epoch boundaries;
+- version-gated fallback: a v2 server never echoes the monitor section, so
+  after a grace window the agent stops attaching frames and logs once —
+  local metrics keep working, cross-rank aggregation reports unavailable;
+- feed the sanitizer's HVD302 stall reports with the *laggards'* ledger
+  tails (``peer_ledger_report``) and the timeline with a ``monitor``
+  counter track;
+- serve ``/metrics`` + ``/health`` over HTTP on rank 0 when a port is
+  configured.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .aggregator import RankAggregator
+from .registry import MetricRegistry
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+# Rounds to keep attaching monitor frames while waiting for the server to
+# prove it speaks protocol v3 (echoing the MON1 section).  Generous: the
+# very first response already carries the echo on a v3 server.
+_PROTO_GRACE_ROUNDS = 64
+
+
+class MonitorAgent:
+    """Cross-rank telemetry agent for one runtime process."""
+
+    def __init__(self, engine=None, controller=None, rank: int = 0,
+                 world: int = 1, interval_s: float = 5.0, timeline=None,
+                 registry: Optional[MetricRegistry] = None):
+        self.rank = int(rank)
+        self.world = max(1, int(world))
+        self.interval_s = max(0.05, float(interval_s))
+        self.registry = registry if registry is not None else MetricRegistry()
+        self.aggregator = RankAggregator(self.world)
+        self._engine = engine
+        self._controller = controller
+        self._timeline = timeline
+        self._lock = threading.Lock()
+        self._last_frame = 0.0            # monotonic; 0 = send immediately
+        self._last_self_update = 0.0
+        self._proto_warned = False
+        self.frames_sent = 0
+        self.frames_received = 0
+        self._tl_last = 0.0
+        self._http = None
+        self._stall = None
+        self._peer_cb = self.peer_ledger_report    # stable bound-method ref
+        if engine is not None:
+            self._register_collectors(engine, controller)
+            engine.monitor = self
+            stall = getattr(engine, "stall", None)
+            if stall is not None and hasattr(stall, "peer_ledger_source"):
+                # Sanitizer mode: HVD302 reports quote the laggards'
+                # ledger tails from the aggregation table.
+                stall.peer_ledger_source = self._peer_cb
+                self._stall = stall
+        if controller is not None:
+            controller.monitor_source = self.encode_frame
+            controller.monitor_sink = self.on_frames
+            controller.on_join_epoch = self.on_join_epoch
+
+    # ----------------------------------------------------------- collectors
+    def _register_collectors(self, engine, controller) -> None:
+        reg = self.registry
+        self.cycle_hist = reg.histogram(
+            "hvd_cycle_time_us", "coordinator cycle wall time (us)")
+
+        def collect(reg: MetricRegistry) -> None:
+            reg.counter("hvd_cycles_total",
+                        "coordinator cycles run").set_total(
+                getattr(engine, "cycle_count", 0))
+            cyc = max(1, getattr(engine, "cycle_count", 0))
+            reg.gauge("hvd_cycle_us_avg",
+                      "mean coordinator cycle wall time (us)").set(
+                round(getattr(engine, "cycle_us_total", 0.0) / cyc, 2))
+            last = getattr(engine, "last_cycle_ts", 0.0)
+            reg.gauge("hvd_last_cycle_age_s",
+                      "seconds since the last coordinator cycle").set(
+                round(time.time() - last, 3) if last else -1)
+            reg.counter("hvd_negotiation_us_total",
+                        "cumulative negotiation wall time (us)").set_total(
+                getattr(engine, "negotiation_us_total", 0.0))
+            reg.counter("hvd_negotiation_cycles_total",
+                        "negotiation rounds run").set_total(
+                getattr(engine, "negotiation_cycles", 0))
+            reg.counter("hvd_pipeline_chunks_total",
+                        "fused-reduce chunks dispatched").set_total(
+                getattr(engine, "pipeline_chunks_total", 0))
+            reg.counter("hvd_pipeline_dispatches_total",
+                        "fused batches dispatched").set_total(
+                getattr(engine, "pipeline_dispatches", 0))
+            queue = getattr(engine, "queue", None)
+            if queue is not None:
+                reg.gauge("hvd_queue_pending",
+                          "entries awaiting negotiation").set(
+                    queue.pending_count())
+            cache = getattr(engine, "cache", None)
+            if cache is not None:
+                reg.counter("hvd_program_cache_hits_total",
+                            "fused-program cache hits").set_total(cache.hits)
+                reg.counter("hvd_program_cache_misses_total",
+                            "fused-program cache misses").set_total(
+                    cache.misses)
+                reg.counter("hvd_program_cache_evictions_total",
+                            "fused-program cache evictions").set_total(
+                    cache.evictions)
+                reg.gauge("hvd_program_cache_size",
+                          "compiled fused programs held").set(len(cache))
+            ring = getattr(engine, "_inflight", None)
+            if ring is not None:
+                reg.gauge("hvd_inflight_depth",
+                          "dispatched-but-unsettled batches").set(len(ring))
+                reg.gauge("hvd_inflight_high_water",
+                          "in-flight window high-water mark").set(
+                    ring.high_water)
+                reg.counter("hvd_inflight_dispatched_total",
+                            "batches through the in-flight ring").set_total(
+                    ring.dispatched)
+            stall = getattr(engine, "stall", None)
+            stalled = getattr(stall, "stalled", None)
+            if stalled is not None:
+                reg.gauge("hvd_stalled_collectives",
+                          "collectives past the stall-warn threshold").set(
+                    len(stalled))
+            san = getattr(engine, "sanitizer", None)
+            if san is not None:
+                reg.gauge("hvd_sanitizer_ledger_entries",
+                          "entries in the sanitizer ledger").set(
+                    len(san.ledger))
+            ctl = controller if controller is not None \
+                else getattr(engine, "controller", None)
+            if ctl is not None:
+                st = ctl.cache_stats
+                reg.counter("hvd_response_cache_hits_total",
+                            "bit-announce cache hits").set_total(st.hits)
+                reg.counter("hvd_response_cache_misses_total",
+                            "full-announce cache misses").set_total(st.misses)
+                reg.counter("hvd_response_cache_invalidations_total",
+                            "response-cache slots dropped").set_total(
+                    st.invalidations)
+                reg.counter("hvd_response_cache_evictions_total",
+                            "coordinated evictions seen").set_total(
+                    st.evictions)
+                reg.counter("hvd_controller_bytes_sent_total",
+                            "negotiation request bytes").set_total(
+                    ctl.bytes_sent)
+                reg.counter("hvd_monitor_frame_bytes_total",
+                            "monitor side-channel bytes sent").set_total(
+                    getattr(ctl, "monitor_bytes_sent", 0))
+            reg.counter("hvd_monitor_frames_sent_total",
+                        "monitor snapshots shipped").set_total(
+                self.frames_sent)
+            reg.counter("hvd_monitor_frames_received_total",
+                        "peer snapshots received").set_total(
+                self.frames_received)
+            reg.counter("hvd_monitor_table_flushes_total",
+                        "aggregation-table flushes (join epochs)").set_total(
+                self.aggregator.flushes)
+
+        reg.register_collector(collect)
+
+    # ------------------------------------------------------------ snapshots
+    def local_snapshot(self) -> dict:
+        """This rank's side-channel payload (also the self-entry the
+        aggregator keeps fresh in single-controller mode)."""
+        eng = self._engine
+        snap: dict = {"rank": self.rank, "ts": round(time.time(), 3)}
+        if eng is not None:
+            cyc = getattr(eng, "cycle_count", 0)
+            snap["cycle"] = getattr(eng, "_cycle_index", 0)
+            snap["cycle_us_avg"] = (
+                round(getattr(eng, "cycle_us_total", 0.0) / cyc, 2)
+                if cyc else None)
+            last = getattr(eng, "last_cycle_ts", 0.0)
+            snap["last_cycle_age_s"] = (
+                round(time.time() - last, 3) if last else None)
+            stall = getattr(eng, "stall", None)
+            stalled = getattr(stall, "stalled", None)
+            snap["stalled"] = sorted(stalled) if stalled else []
+            san = getattr(eng, "sanitizer", None)
+            if san is not None:
+                snap["ledger"] = [e.render() for e in san.tail(8)]
+        snap["metrics"] = self.registry.snapshot()
+        return snap
+
+    def _update_self(self, force: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_self_update < self.interval_s:
+                return
+            self._last_self_update = now
+        self.aggregator.update(self.rank, self.local_snapshot())
+
+    # ------------------------------------------- controller frame callbacks
+    def encode_frame(self) -> Optional[bytes]:
+        """``monitor_source`` for the controller: a serialized snapshot
+        every ``interval_s``, else None (the round carries no monitor
+        bytes).  Runs on the cycle thread inside the negotiation round —
+        must be cheap and must NEVER raise (the controller guards it too).
+        """
+        ctl = self._controller
+        if ctl is not None and not ctl.peer_monitor_proto \
+                and getattr(ctl, "rounds", 0) > _PROTO_GRACE_ROUNDS:
+            # Version-gated fallback: the server never echoed the monitor
+            # section — it predates protocol v3.  Stop paying frame bytes;
+            # local metrics (and the HTTP exporter's own-rank view) keep
+            # working without cross-rank aggregation.
+            if not self._proto_warned:
+                self._proto_warned = True
+                log.warning(
+                    "monitor: coordinator does not speak the monitor "
+                    "side-channel (protocol < v3); cross-rank aggregation "
+                    "disabled, local metrics only")
+            return None
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_frame < self.interval_s:
+                return None
+            self._last_frame = now
+        snap = self.local_snapshot()
+        blob = json.dumps(snap, separators=(",", ":")).encode()
+        if len(blob) > 48 * 1024:
+            # Stay far inside the server's per-blob cap (64KB): a
+            # pathological metric/ledger explosion degrades to the core
+            # health fields rather than being dropped wholesale.
+            snap.pop("metrics", None)
+            snap["ledger"] = (snap.get("ledger") or [])[-2:]
+            blob = json.dumps(snap, separators=(",", ":")).encode()
+            if len(blob) > 64 * 1024:   # still absurd: skip this interval
+                return None
+        self.frames_sent += 1
+        return blob
+
+    def on_frames(self, blobs: List[tuple]) -> None:
+        """``monitor_sink``: peers' (and our own, echoed) fresh snapshots
+        re-broadcast by the server this round."""
+        for rank, blob in blobs:
+            try:
+                self.aggregator.update(rank, json.loads(blob.decode()))
+                self.frames_received += 1
+            except (ValueError, UnicodeDecodeError):
+                log.warning("monitor: undecodable snapshot from rank %s",
+                            rank)
+        self._emit_timeline()
+
+    def on_join_epoch(self, last_rank: int = -1) -> None:
+        """Join epoch ended: the table's snapshots describe an uneven
+        world — flush, like the response-cache slot table."""
+        self.aggregator.flush()
+
+    # ------------------------------------------------------------ engine hook
+    def on_cycle(self, cycle_us: float) -> None:
+        """Per-cycle engine hook (coordinator thread): histogram the cycle
+        time; keep the self-entry fresh at the reporting interval so
+        ``/health`` works in single-controller mode too."""
+        try:
+            self.cycle_hist.observe(cycle_us)
+            if self._controller is None:
+                self._update_self()
+                self._emit_timeline()
+        except Exception:  # noqa: BLE001 - telemetry must never cost a cycle
+            pass
+
+    def _emit_timeline(self) -> None:
+        tl = self._timeline
+        if tl is None or not getattr(tl, "enabled", False):
+            return
+        now = time.monotonic()
+        if now - self._tl_last < self.interval_s:
+            return
+        self._tl_last = now
+        skew = self.aggregator.skew()
+        ctl = self._controller
+        tl.counter("monitor", {
+            "ranks_reporting": len(self.aggregator.ranks()),
+            "cycle_us_spread": skew.get("cycle_us_spread") or 0,
+            "monitor_bytes":
+                getattr(ctl, "monitor_bytes_sent", 0) if ctl else 0})
+
+    # -------------------------------------------------------------- exports
+    def health(self) -> dict:
+        self._update_self(force=True)
+        return self.aggregator.health(self.interval_s)
+
+    def render_prometheus(self) -> str:
+        self._update_self(force=True)
+        out = [self.registry.to_prometheus(f'rank="{self.rank}"')]
+        # Aggregated per-rank series from the side-channel table.
+        table = self.aggregator.table()
+        if table:
+            out.append("# TYPE hvd_rank_alive gauge")
+            for r in sorted(table):
+                alive = self.aggregator.is_alive(table[r]["age_s"],
+                                                 self.interval_s)
+                out.append(f'hvd_rank_alive{{rank="{r}"}} {1 if alive else 0}')
+            out.append("# TYPE hvd_rank_cycle_us_avg gauge")
+            for r in sorted(table):
+                v = table[r]["snap"].get("cycle_us_avg")
+                if v is not None:
+                    out.append(f'hvd_rank_cycle_us_avg{{rank="{r}"}} {v:g}')
+            out.append("# TYPE hvd_rank_stalled_collectives gauge")
+            for r in sorted(table):
+                n = len(table[r]["snap"].get("stalled") or [])
+                out.append(
+                    f'hvd_rank_stalled_collectives{{rank="{r}"}} {n}')
+        return "\n".join(out) + "\n"
+
+    def dump(self) -> dict:
+        """Raw JSON snapshot (``/snapshot``; the CLI pretty-prints it)."""
+        self._update_self(force=True)
+        return {"rank": self.rank, "world": self.world,
+                "health": self.aggregator.health(self.interval_s),
+                "table": {str(r): rec["snap"]
+                          for r, rec in self.aggregator.table().items()}}
+
+    def peer_ledger_report(self) -> str:
+        """Laggard attribution block for HVD302 stall reports: every peer
+        rank's last submissions, from the aggregation table."""
+        tails = self.aggregator.peer_ledger_tails(exclude_rank=self.rank)
+        if not tails:
+            return ""
+        lines = []
+        for r in sorted(tails):
+            lines.append(f"rank {r} last submissions:")
+            lines.extend(f"  {t}" for t in tails[r])
+        return "peer ledgers (via monitor side-channel):\n" + \
+            "\n".join(lines)
+
+    # ------------------------------------------------------------ lifecycle
+    def serve_http(self, port: int, addr: str = ""):
+        from .http import MonitorHTTPServer
+        self._http = MonitorHTTPServer(self, port=port, addr=addr).start()
+        return self._http
+
+    @property
+    def http_port(self) -> Optional[int]:
+        return self._http.port if self._http is not None else None
+
+    def close(self) -> None:
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
+        ctl = self._controller
+        if ctl is not None:
+            # The agent owns the controller hooks it installed.
+            ctl.monitor_source = None
+            ctl.monitor_sink = None
+            ctl.on_join_epoch = None
+        if self._stall is not None:
+            # A replacement agent may have re-installed its own source
+            # (e.g. the bench A/B attaches a temporary agent to a live
+            # engine): only uninstall OUR callback, never someone else's.
+            if getattr(self._stall, "peer_ledger_source", None) \
+                    is self._peer_cb:
+                self._stall.peer_ledger_source = None
+            self._stall = None
+        eng = self._engine
+        if eng is not None and getattr(eng, "monitor", None) is self:
+            eng.monitor = None

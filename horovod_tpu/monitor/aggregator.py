@@ -1,0 +1,154 @@
+"""Rank-0 aggregation table for cross-rank telemetry (no jax imports).
+
+Every rank periodically ships a snapshot blob (metrics + sanitizer ledger
+tail + stall state) through the coordinator's low-priority monitor frames
+(``csrc/coordinator.cc`` protocol v3, ``common/controller.py``); the server
+re-broadcasts fresh blobs to every rank, so each process — most usefully
+rank 0, which serves ``/metrics`` and ``/health`` — holds the same
+fleet-wide table.
+
+What the table answers that no per-rank view can:
+
+- **skew / straggler attribution**: slowest rank id and the cycle-time
+  spread across the fleet (the Horovod paper's "one slow rank gates the
+  world" diagnosis, computed instead of guessed);
+- **laggard ledger tails**: a stalling rank's HVD302 report can quote the
+  *laggard's* last submissions (the ROADMAP ledger-exchange item) — see
+  ``analysis/runtime_sanitizer.py``;
+- **liveness**: a rank whose snapshots stopped arriving is dead or wedged
+  even while the lock-step protocol technically still waits on it.
+
+A join epoch flushes the table (``controller.on_join_epoch``): snapshots
+captured while the world was uneven must not survive into the resumed
+world (mirrors the response-cache slot flush at the same boundary).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class RankAggregator:
+    """Per-rank snapshot table + fleet-level derived views."""
+
+    def __init__(self, world: int):
+        self.world = max(1, int(world))
+        self._lock = threading.Lock()
+        # rank -> {"snap": dict, "received_at": monotonic}
+        self._table: Dict[int, dict] = {}
+        self.flushes = 0
+        self.updates = 0
+
+    # ------------------------------------------------------------- writing
+    def update(self, rank: int, snap: dict) -> None:
+        with self._lock:
+            self._table[int(rank)] = {"snap": snap,
+                                      "received_at": time.monotonic()}
+            self.updates += 1
+
+    def flush(self) -> None:
+        """Drop every snapshot (join-epoch boundary / elastic re-init)."""
+        with self._lock:
+            self._table.clear()
+            self.flushes += 1
+
+    @staticmethod
+    def is_alive(age_s: float, interval_s: float) -> bool:
+        """THE liveness rule, shared by /health and the /metrics
+        ``hvd_rank_alive`` series: a rank is alive while its last snapshot
+        is younger than three reporting intervals."""
+        return age_s <= max(1.0, 3.0 * interval_s)
+
+    # ------------------------------------------------------------- reading
+    def ranks(self) -> List[int]:
+        with self._lock:
+            return sorted(self._table)
+
+    def snapshot_of(self, rank: int) -> Optional[dict]:
+        with self._lock:
+            rec = self._table.get(int(rank))
+            return rec["snap"] if rec else None
+
+    def table(self) -> Dict[int, dict]:
+        """``rank -> {"snap": ..., "age_s": ...}`` copy for exporters."""
+        now = time.monotonic()
+        with self._lock:
+            return {r: {"snap": rec["snap"],
+                        "age_s": round(now - rec["received_at"], 3)}
+                    for r, rec in self._table.items()}
+
+    def skew(self) -> dict:
+        """Straggler attribution from per-rank cycle timings.
+
+        Each snapshot carries ``cycle_us_avg`` (mean coordinator-cycle
+        wall microseconds on that rank).  Returns the slowest rank id and
+        the max-min spread; nulls until at least two ranks reported."""
+        with self._lock:
+            per_rank = {r: rec["snap"].get("cycle_us_avg")
+                        for r, rec in self._table.items()
+                        if rec["snap"].get("cycle_us_avg") is not None}
+        if len(per_rank) < 2:
+            return {"slowest_rank": None, "cycle_us_spread": None,
+                    "per_rank_cycle_us": per_rank or None}
+        slowest = max(per_rank, key=lambda r: per_rank[r])
+        spread = round(max(per_rank.values()) - min(per_rank.values()), 2)
+        return {"slowest_rank": slowest, "cycle_us_spread": spread,
+                "per_rank_cycle_us": per_rank}
+
+    def peer_ledger_tails(self,
+                          exclude_rank: Optional[int] = None
+                          ) -> Dict[int, List[str]]:
+        """rank -> rendered ledger-tail lines, for HVD302 enrichment."""
+        out: Dict[int, List[str]] = {}
+        with self._lock:
+            for r, rec in self._table.items():
+                if exclude_rank is not None and r == exclude_rank:
+                    continue
+                tail = rec["snap"].get("ledger") or []
+                if tail:
+                    out[r] = list(tail)
+        return out
+
+    def health(self, interval_s: float = 5.0) -> dict:
+        """The ``/health`` JSON body: per-rank liveness, last-cycle age,
+        stall state, plus fleet status and straggler attribution.
+
+        A rank is *alive* while its last snapshot is younger than three
+        reporting intervals.  Status: ``stalled`` when any rank reports a
+        stalled collective, ``degraded`` when a rank is missing or its
+        snapshots aged out, else ``ok``."""
+        now = time.monotonic()
+        ranks: Dict[str, dict] = {}
+        any_stalled = False
+        missing = 0
+        with self._lock:
+            table = dict(self._table)
+        for r in range(self.world):
+            rec = table.get(r)
+            if rec is None:
+                ranks[str(r)] = {"alive": False, "last_seen_s": None,
+                                 "cycle": None, "last_cycle_age_s": None,
+                                 "stalled": []}
+                missing += 1
+                continue
+            snap = rec["snap"]
+            age = now - rec["received_at"]
+            alive = self.is_alive(age, interval_s)
+            stalled = list(snap.get("stalled") or [])
+            any_stalled = any_stalled or bool(stalled)
+            missing += 0 if alive else 1
+            ranks[str(r)] = {
+                "alive": alive,
+                "last_seen_s": round(age, 3),
+                "cycle": snap.get("cycle"),
+                "last_cycle_age_s": snap.get("last_cycle_age_s"),
+                "stalled": stalled,
+            }
+        status = ("stalled" if any_stalled
+                  else "degraded" if missing else "ok")
+        out = {"status": status, "world": self.world,
+               "monitor_interval_s": interval_s, "ranks": ranks}
+        out.update(self.skew())
+        return out

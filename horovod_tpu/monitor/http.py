@@ -1,0 +1,96 @@
+"""Opt-in HTTP export surface for the telemetry subsystem (no jax imports).
+
+Runs on rank 0 when ``HOROVOD_MONITOR_PORT`` is set (``docs/monitoring.md``):
+
+- ``GET /metrics`` — Prometheus text format: this rank's registry plus
+  per-rank aggregated series (``hvd_rank_*{rank="r"}``) derived from the
+  controller side-channel's aggregation table.
+- ``GET /health``  — JSON: fleet status (``ok``/``stalled``/``degraded``),
+  per-rank liveness, last-cycle age and stall state, slowest-rank /
+  cycle-time-spread attribution.
+- ``GET /snapshot`` — raw JSON dump of the aggregation table (the format
+  ``python -m horovod_tpu.monitor <file>`` pretty-prints).
+
+Stdlib ``ThreadingHTTPServer`` on a daemon thread: scrapes never touch the
+coordinator cycle thread — they read lock-guarded tables only.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+
+class MonitorHTTPServer:
+    """Serve ``/metrics`` + ``/health`` + ``/snapshot`` for a MonitorAgent."""
+
+    def __init__(self, agent, port: int = 0, addr: str = ""):
+        self._agent = agent
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence stdlib request logging
+                pass
+
+            def _send(self, code: int, ctype: str, body: str):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 - stdlib API
+                try:
+                    path = self.path.split("?", 1)[0]
+                    if path == "/metrics":
+                        self._send(200, "text/plain; version=0.0.4",
+                                   outer._agent.render_prometheus())
+                    elif path == "/health":
+                        health = outer._agent.health()
+                        code = 200 if health.get("status") == "ok" else 503
+                        self._send(code, "application/json",
+                                   json.dumps(health, indent=2))
+                    elif path == "/snapshot":
+                        self._send(200, "application/json",
+                                   json.dumps(outer._agent.dump(), indent=2))
+                    else:
+                        self._send(404, "text/plain",
+                                   "try /metrics, /health or /snapshot\n")
+                except BrokenPipeError:  # pragma: no cover - client gone
+                    pass
+                except Exception as exc:  # noqa: BLE001 - keep serving
+                    try:
+                        self._send(500, "text/plain", f"{exc}\n")
+                    except Exception:  # pragma: no cover
+                        pass
+
+        self._httpd = ThreadingHTTPServer((addr, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MonitorHTTPServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvd-tpu-monitor-http",
+            daemon=True)
+        self._thread.start()
+        log.info("monitor: HTTP exporter listening on :%d "
+                 "(/metrics, /health, /snapshot)", self.port)
+        return self
+
+    def stop(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:  # noqa: BLE001 - already down
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

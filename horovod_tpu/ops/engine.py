@@ -170,6 +170,16 @@ class CollectiveEngine:
         self.negotiation_us_total = 0.0
         self.negotiation_cycles = 0
         self.last_negotiation_us = 0.0
+        # Whole-cycle wall-time accounting (drain + negotiate + fuse +
+        # dispatch): the per-rank numbers the monitor subsystem aggregates
+        # into slowest-rank / cycle-time-spread straggler attribution
+        # (horovod_tpu.monitor).  `monitor` is a MonitorAgent installed by
+        # init() when HOROVOD_MONITOR=1 — None costs one attribute check
+        # per cycle.
+        self.cycle_us_total = 0.0
+        self.cycle_count = 0
+        self.last_cycle_ts = 0.0
+        self.monitor = None
         # XLA:CPU executes collectives via blocking rendezvous on a shared
         # Eigen pool; back-to-back ASYNC launches can starve a participant
         # thread and abort the process ("Expected N threads to join the
@@ -344,6 +354,7 @@ class CollectiveEngine:
             self._run_cycle_locked()
 
     def _run_cycle_locked(self):
+        t_cycle0 = time.perf_counter()
         self._cycle_index += 1
         tl = self._state.timeline
         if tl is not None:
@@ -378,6 +389,12 @@ class CollectiveEngine:
             nbytes = sum(e.tensor.nbytes for b in responses for e in b
                          if e.tensor is not None)
             self.autotuner.on_cycle(nbytes)
+        dt_us = (time.perf_counter() - t_cycle0) * 1e6
+        self.cycle_us_total += dt_us
+        self.cycle_count += 1
+        self.last_cycle_ts = time.time()
+        if self.monitor is not None:
+            self.monitor.on_cycle(dt_us)
 
     # --------------------------------------------------------- negotiation
     def _compute_response_list(self, entries) -> List[List[TensorTableEntry]]:
@@ -433,6 +450,9 @@ class CollectiveEngine:
                 if tl is not None:
                     tl.end_activity(e.name, "QUEUE")
                 self.queue.mark_done(e)
+                # A failed entry is finished: clear the stall inspector's
+                # live-stall state (and warn latch) like any completion.
+                self.stall.progressed(e.name)
                 e.done.set()
             errored_handles = {e.handle for e, _ in errored}
             done_handles = {e.handle for e in ready} | errored_handles

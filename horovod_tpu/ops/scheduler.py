@@ -160,6 +160,11 @@ class StallInspector:
         self.shutdown_after_s = shutdown_after_s
         self.disabled = disabled
         self._warned: set = set()
+        # Names currently past the warn threshold — the live stall state
+        # the monitor subsystem exports (/health, per-rank snapshots).
+        # Unlike _warned (a log-once latch), this set empties the moment
+        # the stalled collective completes.
+        self.stalled: set = set()
 
     def check(self, waiting: Sequence,
               missing_ranks: Optional[Dict[str, List[int]]] = None):
@@ -168,6 +173,8 @@ class StallInspector:
         now = time.monotonic()
         for e in waiting:
             age = now - e.enqueue_time
+            if age > self.warn_after_s:
+                self.stalled.add(e.name)
             if age > self.warn_after_s and e.name not in self._warned:
                 self._warned.add(e.name)
                 extra = ""
@@ -187,6 +194,7 @@ class StallInspector:
         gradient names every step) warns afresh instead of being silently
         swallowed by the first step's latch."""
         self._warned.discard(name)
+        self.stalled.discard(name)
 
 
 class InflightRing:

@@ -100,6 +100,15 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "(1 = settle inline, no overlap)")
     p.add_argument("--timeline-filename", default=None)
     p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--monitor", action="store_true",
+                   help="Enable the cross-rank telemetry & health "
+                        "subsystem (docs/monitoring.md)")
+    p.add_argument("--monitor-port", type=int, default=None,
+                   help="Serve /metrics (Prometheus) + /health (JSON) "
+                        "over HTTP on rank 0 at this port (implies "
+                        "--monitor)")
+    p.add_argument("--monitor-interval", type=float, default=None,
+                   help="Telemetry snapshot period in seconds (default 5)")
     p.add_argument("--stall-check-time", type=float, default=None)
     p.add_argument("--stall-shutdown-time", type=float, default=None)
     p.add_argument("--autotune", action="store_true")
@@ -265,10 +274,15 @@ def tuning_env(args) -> Dict[str, str]:
             ("pipeline_chunk_mb", "HOROVOD_PIPELINE_CHUNK", 1024 * 1024),
             ("max_inflight", "HOROVOD_MAX_INFLIGHT", 1),
             ("stall_check_time", "HOROVOD_STALL_CHECK_TIME", 1),
-            ("stall_shutdown_time", "HOROVOD_STALL_SHUTDOWN_TIME", 1)):
+            ("stall_shutdown_time", "HOROVOD_STALL_SHUTDOWN_TIME", 1),
+            ("monitor_port", "HOROVOD_MONITOR_PORT", 1),
+            ("monitor_interval", "HOROVOD_MONITOR_INTERVAL", 1)):
         val = getattr(args, flag, None)
         if val is not None:
             env[var] = str(int(val * scale) if scale != 1 else val)
+    if getattr(args, "monitor", False) \
+            or getattr(args, "monitor_port", None):
+        env["HOROVOD_MONITOR"] = "1"
     if getattr(args, "timeline_mark_cycles", False):
         env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
     if getattr(args, "autotune", False):

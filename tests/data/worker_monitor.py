@@ -1,0 +1,162 @@
+"""Monitor-subsystem acceptance worker (the tentpole's two-process proof).
+
+Run via torovodrun with HOROVOD_MONITOR=1, a small HOROVOD_MONITOR_INTERVAL,
+HOROVOD_MONITOR_PORT (rank 0 binds it), HVD_TPU_SANITIZER=1 and a short
+HVD_TPU_SANITIZER_TIMEOUT.  Proves, across REAL processes:
+
+1. the coordinator monitor side-channel aggregates both ranks' snapshots
+   on every rank (protocol v3 store-and-forward);
+2. metrics frames never delay negotiation: the steady-state frame guard
+   (zero per-tensor metadata after warm-up) holds with monitoring ON;
+3. a forced stall on rank 1 produces an HVD302 report on rank 0 that
+   contains *rank 1's* ledger tail (the ROADMAP ledger-exchange item);
+4. rank 0's ``/health`` endpoint reflects the stall (503 + status
+   "stalled" naming the stuck tensor) and recovers to "ok" afterwards.
+
+Prints ``MONITOR_OK`` on success.
+"""
+
+import json
+import logging
+import os
+import time
+import urllib.error
+import urllib.request
+
+# One rank per process, one CPU device each; gloo for cross-process XLA
+# collectives (same preamble as worker_collectives.py).
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+from horovod_tpu.utils.logging import get_logger
+
+SHAPES = [(31,), (17,), (64,)]
+
+
+def train_step(value):
+    xs = [np.full(s, value * (i + 1), np.float32)
+          for i, s in enumerate(SHAPES)]
+    outs = hvd.grouped_allreduce(xs, name="grad", op=hvd.Sum)
+    world = hvd.size()
+    got = np.asarray(hvd.to_local(outs[0])).reshape(SHAPES[0])
+    np.testing.assert_allclose(
+        got, np.full(SHAPES[0], world * value, np.float32), rtol=1e-5)
+
+
+def submit_stall():
+    """Both ranks submit stall.t through THIS line: the sanitizer's
+    call-site tag must match across ranks (only the timing diverges)."""
+    return hvd.allreduce_async(np.ones(4, np.float32), name="stall.t",
+                               op=hvd.Sum)
+
+
+def fetch_health(port):
+    """GET /health; a stalled fleet answers 503 with the same JSON body."""
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=5) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return json.loads(exc.read())
+
+
+def main():
+    hvd.init()
+    rank = hvd.rank()
+    st = basics._get_state()
+    eng, ctl, mon = st.engine, st.controller, st.monitor
+    assert ctl is not None, "worker needs the torovodrun controller"
+    assert mon is not None, "HOROVOD_MONITOR=1 must install the agent"
+    assert eng.sanitizer is not None, "HVD_TPU_SANITIZER=1 expected"
+    port = int(os.environ["HOROVOD_MONITOR_PORT"])
+
+    # Capture HVD302 reports (the logger does not propagate to root).
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda rec: records.append(rec.getMessage())
+    get_logger().addHandler(handler)
+
+    # ---- 1. warm up + let snapshots ride the side-channel.  FIXED step
+    # count on both ranks (a data-dependent break could diverge and
+    # deadlock the blocking collectives).
+    for k in range(15):
+        train_step(1.0 + k)
+        time.sleep(0.08)
+    assert mon.aggregator.ranks() == [0, 1], (
+        f"rank {rank}: aggregation table is {mon.aggregator.table()}")
+    assert ctl.peer_monitor_proto, "server never advertised protocol v3"
+    assert ctl.monitor_bytes_sent > 0
+
+    # ---- 2. steady-state frame guard WITH monitoring enabled.
+    stats = ctl.cache_stats
+    full_before = stats.full_announces
+    for k in range(5):
+        train_step(50.0 + k)
+    assert stats.full_announces == full_before, (
+        f"monitoring pushed {stats.full_announces - full_before} cycles "
+        f"off the bitvector fast path")
+    assert stats.bit_announces >= 5 * len(SHAPES)
+    assert eng.negotiation_cycles > 0
+
+    # ---- 3. forced stall on rank 1: rank 0 announces, rank 1 sits out
+    # past the sanitizer timeout, then joins in.
+    if rank == 0:
+        handle = submit_stall()
+        deadline = time.time() + 25
+        report = None
+        while time.time() < deadline and report is None:
+            for m in records:
+                if "HVD302" in m and "stall.t" in m:
+                    report = m
+                    break
+            time.sleep(0.1)
+        assert report is not None, (
+            f"no HVD302 report for stall.t; records tail: {records[-5:]}")
+        # The tentpole claim: the report carries the LAGGARD's ledger
+        # tail, pulled from the cross-rank aggregation table.
+        assert "rank 1 last submissions" in report, report
+        assert "worker_monitor.py" in report, report
+        assert "grad" in report.split("rank 1 last submissions", 1)[1], report
+        # /health reflects the stall while it lasts.
+        health = fetch_health(port)
+        assert health["status"] == "stalled", health
+        assert "stall.t" in health["ranks"]["0"]["stalled"], health
+    else:
+        time.sleep(6.0)         # > HVD_TPU_SANITIZER_TIMEOUT + margin
+        handle = submit_stall()
+    out = hvd.synchronize(handle)
+    np.testing.assert_allclose(np.asarray(hvd.to_local(out)).reshape(4),
+                               np.full(4, hvd.size(), np.float32), rtol=1e-5)
+
+    # ---- 4. recovery: /health returns to "ok" once the stall cleared.
+    # Both ranks keep running MATCHED train steps (keeping engines — and
+    # rank 1's liveness frames — flowing) while rank 0 polls; a barrier
+    # here would itself trip the 2s sanitizer stall timeout on the rank
+    # that reaches it first.  Fixed iteration count on both ranks.
+    recovered = None
+    for k in range(20):
+        train_step(100.0 + k)
+        if rank == 0 and recovered is None:
+            health = fetch_health(port)
+            if (health["status"] == "ok"
+                    and health["ranks"]["0"]["stalled"] == []):
+                recovered = health
+        time.sleep(0.3)
+    if rank == 0:
+        assert recovered is not None, fetch_health(port)
+        # Straggler attribution present once both ranks reported.
+        assert recovered["slowest_rank"] in (0, 1), recovered
+    print("MONITOR_OK", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -10,6 +10,13 @@ naming both ranks and both call sites.
 
 Prints ``SANITIZER_OK`` when the divergence is caught with full
 attribution, ``SANITIZER_MISSED`` when the run completes undetected.
+
+``HVD_TPU_SANITIZER=hash`` mode exercises the SAME-SITE blind spot
+instead: both ranks submit through one call site, in the same order, with
+the same seq — only the *content* diverges.  Tag mode cannot tell the
+submissions apart; the content digest folded into the tag can.  Prints
+``SANITIZER_HASH_OK`` when the divergence is caught and a replicated
+control collective still negotiates cleanly afterwards.
 """
 
 import os
@@ -30,9 +37,38 @@ import horovod_tpu as hvd
 from horovod_tpu.common.controller import NegotiationError
 
 
+def hash_main(rank):
+    """Same call site, same order, same seq — divergent CONTENT only."""
+    # Deliberately divergent data through ONE call site: undetectable by
+    # seq/site tags (they match exactly), caught only by the content
+    # digest.  Hash mode compares LOCAL contributions, so it is meant for
+    # replicated-expectation debugging — which is exactly this shape.
+    x = np.full((4,), 1.0 + rank, np.float32)
+    try:
+        hvd.allreduce(x, name="hash.t", op=hvd.Sum)
+        print("SANITIZER_HASH_MISSED", flush=True)
+    except NegotiationError as e:
+        msg = str(e)
+        assert "ranks [0]" in msg and "ranks [1]" in msg, msg
+        assert "h=" in msg, msg
+        assert "site=worker_sanitizer.py" in msg, msg
+        # Control: replicated content hashes identically on both ranks and
+        # negotiates cleanly — the runtime survived the failed collective.
+        y = np.ones(4, np.float32)
+        out = hvd.allreduce(y, name="hash.ok", op=hvd.Sum)
+        got = np.asarray(hvd.to_local(out)).reshape(4)
+        np.testing.assert_allclose(
+            got, np.full(4, float(hvd.size()), np.float32), rtol=1e-6)
+        print("SANITIZER_HASH_OK", flush=True)
+    hvd.shutdown()
+
+
 def main():
     hvd.init()
     rank = hvd.rank()
+    if os.environ.get("HVD_TPU_SANITIZER", "").strip().lower() == "hash":
+        hash_main(rank)
+        return
     a = np.ones(4, np.float32)
     b = np.full((4,), 2.0, np.float32)
 

@@ -445,6 +445,21 @@ def test_sanitizer_catches_divergent_collective_order():
         f"stderr:\n{res.stderr[-3000:]}")
 
 
+def test_sanitizer_hash_catches_divergent_content_same_site():
+    """HVD_TPU_SANITIZER=hash acceptance (the same-site blind spot): two
+    ranks submit divergent DATA through one call site with identical
+    seq/site tags; only the content digest folded into the tag can tell
+    them apart.  The worker asserts rank attribution + the hash field in
+    the error, then proves a replicated control collective still
+    negotiates (runtime survives)."""
+    res = _run_torovodrun(2, WORKER_SANITIZER, timeout=300,
+                          extra_env={"HVD_TPU_SANITIZER": "hash"})
+    ok = res.stdout.count("SANITIZER_HASH_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
 def test_sanitizer_off_misses_divergent_order():
     """Control run: without the sanitizer the same divergence sails through
     negotiation (signatures match) and corrupts silently — the documented
@@ -498,6 +513,30 @@ def test_torovodrun_pipeline():
     chunk-count keying (assertions live in the worker)."""
     res = _run_torovodrun(2, WORKER_PIPELINE, timeout=300)
     ok = res.stdout.count("PIPELINE_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
+WORKER_MONITOR = os.path.join(REPO, "tests", "data", "worker_monitor.py")
+
+
+def test_torovodrun_monitor_acceptance():
+    """Monitor-subsystem acceptance (the tentpole's two-process proof):
+    cross-rank snapshot aggregation through the coordinator side-channel,
+    the steady-state frame guard holding with monitoring ON, a forced
+    stall on rank 1 producing an HVD302 report on rank 0 that quotes rank
+    1's ledger tail, and /health reflecting the stall then recovering.
+    Assertions live in the worker."""
+    port = _free_port()
+    res = _run_torovodrun(2, WORKER_MONITOR, timeout=300, extra_env={
+        "HOROVOD_MONITOR": "1",
+        "HOROVOD_MONITOR_INTERVAL": "0.2",
+        "HOROVOD_MONITOR_PORT": str(port),
+        "HVD_TPU_SANITIZER": "1",
+        "HVD_TPU_SANITIZER_TIMEOUT": "2",
+    })
+    ok = res.stdout.count("MONITOR_OK")
     assert res.returncode == 0 and ok == 2, (
         f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
         f"stderr:\n{res.stderr[-3000:]}")

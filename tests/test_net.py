@@ -1,0 +1,90 @@
+"""``common/net.py`` helpers (tier-1, no jax) — previously untested and
+now carrying the monitor HTTP port alongside the controller/rendezvous
+endpoints, so the selection/determinism contracts get explicit guards.
+"""
+
+import socket
+
+import pytest
+
+from horovod_tpu.common import net
+
+
+# -------------------------------------------------------------- free_ports
+def test_free_ports_distinct_and_bindable():
+    ports = net.free_ports(5)
+    assert len(ports) == 5
+    assert len(set(ports)) == 5, "one call must never return duplicates"
+    for p in ports:
+        assert 0 < p < 65536
+        # The probe sockets are closed on return: each port is bindable
+        # again right away (SO_REUSEADDR was set during probing).
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind(("127.0.0.1", p))
+        finally:
+            s.close()
+
+
+def test_free_ports_zero():
+    assert net.free_ports(0) == []
+
+
+# ------------------------------------------------------------ remote_ports
+def test_remote_ports_deterministic_by_seed():
+    a = net.remote_ports(4, seed=1234)
+    b = net.remote_ports(4, seed=1234)
+    assert a == b, "every participant must compute the same ports"
+
+
+def test_remote_ports_new_seed_moves_on():
+    # A retry with a fresh seed must be able to escape a collision; the
+    # generator is pseudo-random, so assert over several seeds rather
+    # than any single pair.
+    base = net.remote_ports(2, seed=0)
+    assert any(net.remote_ports(2, seed=s) != base for s in range(1, 8))
+
+
+def test_remote_ports_contiguous_high_range():
+    for seed in (0, 7, 99999):
+        ports = net.remote_ports(3, seed=seed)
+        assert ports == [ports[0], ports[0] + 1, ports[0] + 2]
+        assert 20000 <= ports[0] and ports[-1] < 60000
+
+
+# ----------------------------------------------------------- routable_addr
+def test_routable_addr_returns_nonempty_string():
+    addr = net.routable_addr()
+    assert isinstance(addr, str) and addr
+    # Either a dotted address or a resolvable-looking name — never the
+    # empty string a bare getsockname() failure could produce.
+    assert addr.strip() == addr
+
+
+# ----------------------------------------------------------- is_local_host
+@pytest.mark.parametrize("name", ["localhost", "127.0.0.1", "::1"])
+def test_is_local_host_loopback_spellings(name):
+    assert net.is_local_host(name) is True
+
+
+def test_is_local_host_own_hostname_and_fqdn():
+    assert net.is_local_host(socket.gethostname()) is True
+    fqdn = socket.getfqdn()
+    if fqdn:  # containers can report an empty/garbage fqdn
+        assert net.is_local_host(fqdn) is True
+
+
+def test_is_local_host_unresolvable_is_remote_and_not_cached():
+    bogus = "no-such-host.invalid"     # .invalid TLD never resolves
+    assert net.is_local_host(bogus) is False
+    # Failed resolutions must NOT be cached: a transient DNS failure has
+    # to be retried on the next call (docstring contract).
+    assert bogus not in net._is_local_cache
+
+
+def test_is_local_host_success_is_cached():
+    net.is_local_host("localhost")     # fast-path spelling, not cached
+    hostname = socket.gethostname()
+    net.is_local_host(hostname)
+    assert net._is_local_cache.get(hostname) is True
