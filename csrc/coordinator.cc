@@ -80,6 +80,33 @@
 //              how clients version-gate their own monitor frames.  Pre-v3
 //              clients stop parsing after the eviction section and ignore
 //              the trailing bytes)
+//             [protocol v4, FIRST ROUND ONLY] uint32 magic "FLT1",
+//                           uint32 0
+//             (the server's fault-tolerance capability advertisement.
+//              Appended only to round 1's response so the warm path pays
+//              ZERO extra bytes — by round 2 every client has latched it.
+//              Symmetrically, a v4 client appends an empty FLT1 section to
+//              its FIRST request only; the server latches the rank as
+//              v4-capable and may send it the typed ABORT frame below.
+//              Trailing sections in both directions are (magic, len,
+//              payload) tuples walked generically, so MON1 and FLT1
+//              compose in any order and unknown magics are skipped — the
+//              same old-peers-ignore-trailing-bytes contract as MON1)
+//
+//   ABORT  := uint32 0xFFFFFFFF, uint32 magic "ABT4",
+//             uint32 n_dead, n_dead * uint32 rank, { u16 len, reason }
+//             (protocol v4 liveness verdict, sent IN PLACE of a normal
+//              response when the server declares ranks dead — a client
+//              socket died (recv 0 / ECONNRESET / write failure) or a
+//              rank missed the per-round deadline.  0xFFFFFFFF is an
+//              impossible n_ready, so v4 clients detect the frame
+//              unambiguously and raise a typed PeerFailureError carrying
+//              the dead-rank list; v3 clients never receive it — the
+//              server version-gates on the request-side FLT1 ad and
+//              simply severs pre-v4 clients (they fail with the legacy
+//              rc=-1 path, exactly the pre-v4 behavior).  The server
+//              stops after an abort: the surviving world re-forms through
+//              the elastic driver, never through a half-dead server)
 //             (evictions are broadcast in the same lock-step round on every
 //              rank, so client slot tables can never diverge; a join epoch
 //              flushes ALL slots — full renegotiation while the world is
@@ -102,21 +129,28 @@
 // then resets join state (the world resumes normal operation).
 //
 // Exported C ABI (ctypes-consumed by horovod_tpu/common/native.py):
-//   hvdtpu_server_start(port, world, stall_warn_s, cache_capacity) -> handle
+//   hvdtpu_server_start(port, world, stall_warn_s, cache_capacity,
+//                       round_deadline_ms) -> handle
 //   hvdtpu_server_stop(handle)
 //   hvdtpu_client_connect(host, port, rank, timeout_ms) -> handle
 //   hvdtpu_client_round(handle, req, req_len, resp_buf, resp_cap) -> resp_len
+//   hvdtpu_client_send(handle, req, req_len) -> 0 / -1
+//   hvdtpu_client_recv(handle, resp_buf, resp_cap, timeout_ms)
+//       -> resp_len / -1 (error) / -2 (overflow) / -3 (timeout)
+//   hvdtpu_client_pending(handle) -> 1 if a frame is already readable
 //   hvdtpu_client_close(handle)
 
 #include <arpa/inet.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
 #include <algorithm>
 #include <atomic>
+#include <cerrno>
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
@@ -138,6 +172,14 @@ using Clock = std::chrono::steady_clock;
 // Monitor side-channel section marker ("MON1" little-endian).  Doubles as
 // the protocol-v3 capability advertisement in responses.
 constexpr uint32_t kMonMagic = 0x314e4f4d;
+// Fault-tolerance capability section marker ("FLT1" little-endian) —
+// protocol v4.  Rides a trailing (magic, len) section exactly like MON1:
+// request side on round 1 only (client ad), response side on round 1 only
+// (server ad), so the warm path carries zero extra bytes.
+constexpr uint32_t kFltMagic = 0x31544c46;
+// Typed abort frame marker ("ABT4") behind the 0xFFFFFFFF escape.
+constexpr uint32_t kAbortMagic = 0x34544241;
+constexpr uint32_t kAbortEscape = 0xffffffffu;
 // Per-blob and per-response caps for the monitor section: the aggregate
 // re-broadcast must stay well inside the client's fixed 4MB receive
 // buffer (_RESP_CAP in common/controller.py) no matter how many ranks
@@ -175,6 +217,49 @@ bool read_frame(int fd, std::vector<uint8_t>* out) {
   if (!read_exact(fd, &len, 4)) return false;
   out->resize(len);
   return len == 0 || read_exact(fd, out->data(), len);
+}
+
+// Deadline-bounded read: like read_exact, but every recv is gated on a
+// poll() against an ABSOLUTE deadline, so a peer that wedges mid-frame-
+// write (SIGSTOPped / paged out after the length prefix) cannot block
+// the caller past its deadline — a blocking read here would defeat both
+// the server's per-round deadline and the client's round timeout.
+// Returns 1 on success, 0 on deadline expiry, -1 on a dead socket (or
+// `stop`, polled each quantum so teardown never waits the deadline out).
+int read_exact_deadline(int fd, void* buf, size_t n,
+                        Clock::time_point deadline,
+                        const std::atomic<bool>* stop = nullptr) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    auto rem = std::chrono::duration_cast<std::chrono::milliseconds>(
+                   deadline - Clock::now())
+                   .count();
+    if (rem <= 0) return 0;
+    if (stop != nullptr && stop->load()) return -1;
+    pollfd pfd{fd, POLLIN, 0};
+    int pn = ::poll(&pfd, 1, static_cast<int>(std::min<int64_t>(rem, 100)));
+    if (pn < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    if (pn == 0) continue;
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return -1;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return 1;
+}
+
+int read_frame_deadline(int fd, std::vector<uint8_t>* out,
+                        Clock::time_point deadline,
+                        const std::atomic<bool>* stop = nullptr) {
+  uint32_t len = 0;
+  int rc = read_exact_deadline(fd, &len, 4, deadline, stop);
+  if (rc <= 0) return rc;
+  out->resize(len);
+  if (len == 0) return 1;
+  return read_exact_deadline(fd, out->data(), len, deadline, stop);
 }
 
 bool write_frame(int fd, const std::vector<uint8_t>& payload) {
@@ -309,10 +394,37 @@ struct Server {
   double stall_warn_s = 60.0;
   std::set<int> joined;
   int last_joined = -1;
+  // Liveness (protocol v4): per-rank fault-tolerance capability (latched
+  // from the request-side FLT1 ad) and the per-round deadline.  The
+  // deadline is armed when a round's FIRST frame arrives — an idle fleet
+  // (no rank negotiating) can never be declared dead, only a fleet where
+  // some ranks reached the round and others failed to.  0 disables the
+  // deadline; socket-death detection is always on.
+  std::unique_ptr<std::atomic<char>[]> v4;
+  int round_deadline_ms = 0;
 
   void run();
   void run_inner();
+  void broadcast_abort(const std::set<int>& dead, const std::string& why);
 };
+
+void Server::broadcast_abort(const std::set<int>& dead,
+                             const std::string& why) {
+  // Typed liveness verdict to surviving v4 clients; pre-v4 clients are
+  // simply severed (run()'s epilogue shuts every socket down), which is
+  // exactly the legacy rc=-1 failure they already understand.
+  std::vector<uint8_t> resp;
+  put_u32(&resp, kAbortEscape);
+  put_u32(&resp, kAbortMagic);
+  put_u32(&resp, static_cast<uint32_t>(dead.size()));
+  for (int r : dead) put_u32(&resp, static_cast<uint32_t>(r));
+  put_str(&resp, why);
+  for (int r = 0; r < world; ++r) {
+    if (dead.count(r) || !v4[r].load()) continue;
+    int fd = fds[r].load();
+    if (fd >= 0) write_frame(fd, resp);
+  }
+}
 
 void Server::run() {
   run_inner();
@@ -356,7 +468,18 @@ void Server::run_inner() {
   for (int r = 0; r < world; ++r)
     if (fds[r].load() < 0) return;  // stopped before the world assembled
 
-  std::vector<uint8_t> frame;
+  // Gather-phase containers, hoisted out of the round loop and cleared
+  // per round so each rank's frame buffer keeps its capacity across
+  // rounds — the steady-state warm path (13-byte frames) allocates
+  // nothing here, matching the pre-v4 reusable frame buffer.
+  std::vector<std::vector<uint8_t>> frames(world);
+  std::vector<char> have_frame(world, 0);
+  std::set<int> dead_conn, dead_late;
+  std::vector<pollfd> pfds;
+  std::vector<int> pranks;
+  pfds.reserve(world);
+  pranks.reserve(world);
+
   while (!stop.load()) {
     ++round_no;
     // One lock-step round: a frame from every rank, then a reply to all.
@@ -510,9 +633,225 @@ void Server::run_inner() {
       evict_budget = 0;    // candidates exhausted: stop for this round
       return false;
     };
+    // ---- gather phase (protocol v4 liveness): one frame from every rank,
+    // collected via poll so a dead socket (recv 0 / ECONNRESET) or a
+    // missed round deadline turns into a typed ABORT to the survivors
+    // instead of a deadline-free recv wedging the whole control plane.
+    // Frames are still PROCESSED in rank order below, so announce_seq
+    // ordering (and with it the deterministic ready order) is unchanged
+    // from the sequential-read protocol.
+    for (auto& f : frames) f.clear();
+    std::fill(have_frame.begin(), have_frame.end(), 0);
+    dead_conn.clear();
+    dead_late.clear();
+    bool deadline_armed = false;
+    Clock::time_point deadline_tp{};
+    // Grace drain for the failure-at-startup class: when a rank dies in
+    // round 1, survivors that have not yet SENT their round-1 frame have
+    // not advertised FLT1 either — aborting immediately would sever them
+    // with the untyped legacy rc=-1.  So after a death the gather keeps
+    // collecting frames from live ranks whose capability is still
+    // unknown, for a bounded window; once every live rank is either
+    // latched v4 or has its frame in hand (the common case within
+    // milliseconds — peers are in lock-step and about to send anyway),
+    // the abort goes out.  Rounds where every survivor is already
+    // latched (any round past the first) break immediately as before.
+    constexpr int kAbortGraceMs = 2000;
+    bool grace_armed = false;
+    Clock::time_point grace_tp{};
+    int pending_frames = world;
+    // Bounded salvage of already-buffered frames from the given pending
+    // live ranks: one zero-timeout poll, then a short drain read per
+    // readable fd (a complete buffered frame reads instantly; a partial
+    // one still counts as missing).  Shared by the deadline-expiry
+    // verdict and the post-gather abort salvage so the two cannot drift.
+    auto drain_buffered = [&](std::vector<pollfd>& dfds,
+                              std::vector<int>& dranks) {
+      if (dfds.empty() ||
+          ::poll(dfds.data(), static_cast<nfds_t>(dfds.size()), 0) <= 0)
+        return;
+      auto drain_tp = Clock::now() + std::chrono::milliseconds(50);
+      for (size_t i = 0; i < dfds.size(); ++i) {
+        if (!(dfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+        int r = dranks[i];
+        int rc2 = read_frame_deadline(fds[r].load(), &frames[r],
+                                      drain_tp, &stop);
+        if (rc2 > 0) {
+          have_frame[r] = 1;
+          --pending_frames;
+        } else if (rc2 < 0 && !stop.load()) {
+          dead_conn.insert(r);
+        }
+      }
+    };
+    while (pending_frames > 0 && !stop.load()) {
+      pfds.clear();
+      pranks.clear();
+      for (int r = 0; r < world; ++r)
+        if (!have_frame[r] && !dead_conn.count(r)) {
+          pfds.push_back(pollfd{fds[r].load(), POLLIN, 0});
+          pranks.push_back(r);
+        }
+      // Short poll quantum keeps the loop responsive to server_stop (the
+      // pre-v4 design relied on stop shutting the socket under a blocked
+      // recv; poll-wakeups serve the same purpose with a bound).
+      int timeout = 100;
+      if (deadline_armed && round_deadline_ms > 0) {
+        auto rem = std::chrono::duration_cast<std::chrono::milliseconds>(
+                       deadline_tp - Clock::now())
+                       .count();
+        if (rem <= 0) {
+          // Final zero-timeout drain before the verdict: a frame already
+          // buffered in the kernel at expiry (it landed while the gather
+          // was busy inside another rank's read) proves its sender
+          // reached the round — declaring it dead would abort the fleet
+          // with a verdict naming a healthy rank.
+          drain_buffered(pfds, pranks);
+          for (int r : pranks)
+            if (!have_frame[r] && !dead_conn.count(r)) dead_late.insert(r);
+          if (dead_late.empty() && dead_conn.empty()) continue;
+          break;
+        }
+        timeout = static_cast<int>(std::min<int64_t>(timeout, rem));
+      }
+      int n = ::poll(pfds.data(), static_cast<nfds_t>(pfds.size()), timeout);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        stop.store(true);
+        break;
+      }
+      for (size_t i = 0; i < pfds.size(); ++i) {
+        if (!(pfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+        int r = pranks[i];
+        // The frame READ is deadline-bounded too: a rank that wedges
+        // mid-frame-write (length prefix sent, payload never arrives)
+        // must not block the gather past the round deadline — a plain
+        // read_frame here would hang the whole control plane on a rank
+        // that poll() reported readable.  A partial frame proves the
+        // rank reached the round, so arming the deadline off its first
+        // bytes keeps the idle-fleet guarantee.
+        int rc;
+        if (round_deadline_ms > 0) {
+          Clock::time_point frd =
+              deadline_armed ? deadline_tp
+                             : Clock::now() + std::chrono::milliseconds(
+                                                  round_deadline_ms);
+          // The round deadline can expire while this loop is inside
+          // ANOTHER rank's read; starting this rank's read with a dead
+          // (or nearly dead) deadline would abandon a complete frame
+          // already buffered in the kernel and falsely declare a healthy
+          // rank late — and the top-of-loop expiry drain can never reach
+          // this per-fd path.  Grant the same bounded drain allowance
+          // instead: a buffered frame reads instantly, a genuine
+          // mid-frame wedge still turns into dead_late 50ms later.
+          auto min_frd = Clock::now() + std::chrono::milliseconds(50);
+          if (frd < min_frd) frd = min_frd;
+          rc = read_frame_deadline(fds[r].load(), &frames[r], frd, &stop);
+        } else {
+          rc = read_frame(fds[r].load(), &frames[r]) ? 1 : -1;
+        }
+        if (rc < 0) {
+          if (stop.load()) break;  // teardown racing the read, not a death
+          dead_conn.insert(r);
+        } else if (rc == 0) {
+          // Mid-frame wedge: the rank started its frame but never
+          // finished it inside the deadline.
+          dead_late.insert(r);
+          break;
+        } else {
+          have_frame[r] = 1;
+          --pending_frames;
+          if (!deadline_armed) {
+            // Armed at the round's FIRST frame: an idle fleet can never
+            // be declared dead — only ranks that failed to reach a round
+            // their peers already reached.
+            deadline_armed = true;
+            deadline_tp = Clock::now() +
+                          std::chrono::milliseconds(round_deadline_ms);
+          }
+        }
+      }
+      if (!dead_late.empty()) break;  // deadline verdict: abort the round
+      if (!dead_conn.empty()) {
+        bool awaiting_ad = false;
+        for (int r = 0; r < world; ++r)
+          if (!have_frame[r] && !dead_conn.count(r) && !v4[r].load()) {
+            awaiting_ad = true;
+            break;
+          }
+        if (!awaiting_ad) break;
+        auto now = Clock::now();
+        if (!grace_armed) {
+          grace_armed = true;
+          grace_tp = now + std::chrono::milliseconds(kAbortGraceMs);
+        } else if (now >= grace_tp) {
+          break;
+        }
+      }
+    }
+    if (!stop.load() && (!dead_conn.empty() || !dead_late.empty())) {
+      // Salvage still-buffered frames from live ranks before the verdict:
+      // a dead_late break above exits the gather immediately, skipping
+      // ranks whose complete frames already sit in the kernel buffer
+      // (they landed while the gather was blocked inside the dying
+      // rank's read).  Most importantly this recovers round 1's trailing
+      // FLT1 capability ads — without the frame, v4[] never latches and
+      // the survivor gets the untyped legacy sever (unattributed rc=-1)
+      // instead of the typed ABORT.
+      pfds.clear();
+      pranks.clear();
+      for (int r = 0; r < world; ++r)
+        if (!have_frame[r] && !dead_conn.count(r) && !dead_late.count(r)) {
+          pfds.push_back(pollfd{fds[r].load(), POLLIN, 0});
+          pranks.push_back(r);
+        }
+      drain_buffered(pfds, pranks);
+      auto list = [](const std::set<int>& s) {
+        std::string out;
+        for (int r : s) {
+          if (!out.empty()) out += ",";
+          out += std::to_string(r);
+        }
+        return out;
+      };
+      std::string why;
+      if (!dead_conn.empty())
+        why += "rank(s) [" + list(dead_conn) +
+               "] lost connection mid-negotiation (process crash, "
+               "ECONNRESET, or network failure)";
+      if (!dead_late.empty()) {
+        if (!why.empty()) why += "; ";
+        why += "rank(s) [" + list(dead_late) + "] missed the " +
+               std::to_string(round_deadline_ms) +
+               "ms round deadline (hung or wedged)";
+      }
+      why += " in negotiation round " + std::to_string(round_no);
+      std::set<int> all_dead = dead_conn;
+      all_dead.insert(dead_late.begin(), dead_late.end());
+      // A death in round 1 finds the FLT1 capability ads still sitting in
+      // the gathered-but-unPROCESSED frames (processing only starts once
+      // every rank's frame is in), so v4[] would gate the abort away from
+      // every survivor and the fleet would fail with the untyped legacy
+      // rc=-1 — losing dead-rank attribution exactly for the failure-at-
+      // startup class.  Latch the ads now: the client contract
+      // (controller.py) appends FLT1 as the FINAL trailing section of the
+      // round-1 request, so the ad is exactly the frame's last 8 bytes.
+      for (int r = 0; r < world; ++r) {
+        if (!have_frame[r] || v4[r].load()) continue;
+        const std::vector<uint8_t>& f = frames[r];
+        if (f.size() < 8) continue;
+        uint32_t magic = 0, blen = 0;
+        std::memcpy(&magic, f.data() + f.size() - 8, 4);
+        std::memcpy(&blen, f.data() + f.size() - 4, 4);
+        if (magic == kFltMagic && blen == 0) v4[r].store(1);
+      }
+      broadcast_abort(all_dead, why);
+      stop.store(true);
+      break;
+    }
+    if (stop.load()) break;
     for (int r = 0; r < world; ++r) {
-      if (!read_frame(fds[r].load(), &frame)) { stop.store(true); break; }
-      Reader rd{frame.data(), frame.data() + frame.size()};
+      Reader rd{frames[r].data(), frames[r].data() + frames[r].size()};
       // Sanitizer tag side-channel for this rank's bitvector announces
       // (slot -> tag); parsed after the bitvector but needed while
       // resolving it, so the sections are walked full -> bits -> tags and
@@ -608,24 +947,28 @@ void Server::run_inner() {
           bit_tags[slot] = rd.str();
         }
       }
-      // Optional monitor section (protocol v3): an opaque telemetry blob
-      // for store-and-forward.  A malformed/truncated section is dropped
-      // without failing the round — telemetry must never cost negotiation.
-      // Oversized blobs (> kMonBlobCap) are dropped for the same reason:
-      // the re-broadcast must never push a response past the client's
-      // fixed receive buffer (telemetry is lossy by design; the rank
-      // simply reports again next interval).
-      if (rd.ok && rd.p + 8 <= rd.end) {
+      // Optional trailing sections, walked generically as (magic, len,
+      // payload) tuples so protocol extensions compose in any order and
+      // unknown magics are skipped.  MON1 (protocol v3): an opaque
+      // telemetry blob for store-and-forward — a malformed/truncated
+      // section is dropped without failing the round (telemetry must
+      // never cost negotiation), and oversized blobs (> kMonBlobCap) are
+      // dropped so the re-broadcast never pushes a response past the
+      // client's fixed receive buffer.  FLT1 (protocol v4): the client's
+      // fault-tolerance capability ad, sent on its first round only —
+      // latches the rank as eligible for the typed ABORT frame.
+      while (rd.ok && rd.p + 8 <= rd.end) {
         uint32_t magic = rd.u32();
+        uint32_t blen = rd.u32();
+        if (!rd.ok || rd.p + blen > rd.end) break;
         if (magic == kMonMagic) {
-          uint32_t blen = rd.u32();
-          if (rd.ok && rd.p + blen <= rd.end) {
-            if (blen <= kMonBlobCap)
-              mon_blobs.emplace_back(
-                  r, std::string(reinterpret_cast<const char*>(rd.p), blen));
-            rd.p += blen;
-          }
+          if (blen <= kMonBlobCap)
+            mon_blobs.emplace_back(
+                r, std::string(reinterpret_cast<const char*>(rd.p), blen));
+        } else if (magic == kFltMagic) {
+          v4[r].store(1);
         }
+        rd.p += blen;
       }
       for (uint32_t id : bit_slots) {
         // A non-live slot with an intact record was evicted THIS round
@@ -889,14 +1232,37 @@ void Server::run_inner() {
       put_u32(&resp, static_cast<uint32_t>(b->second.size()));
       resp.insert(resp.end(), b->second.begin(), b->second.end());
     }
+    // Fault-tolerance capability ad (protocol v4): round 1's response only,
+    // so the warm path carries zero extra bytes — see the header comment.
+    if (round_no == 1) {
+      put_u32(&resp, kFltMagic);
+      put_u32(&resp, 0);
+    }
     // Attempt EVERY rank before honoring a failure: one dead/closing peer
     // must not cut the survivors off from a round's computed verdicts
     // (they may contain the ready broadcast that lets them finish cleanly).
-    bool write_failed = false;
+    // A failed write marks the rank dead and the survivors get a typed
+    // ABORT (queued behind the response they just received; consumed at
+    // their next recv) instead of a blind socket sever.
+    std::set<int> write_dead;
     for (int r = 0; r < world; ++r) {
-      if (!write_frame(fds[r].load(), resp)) write_failed = true;
+      if (!write_frame(fds[r].load(), resp)) write_dead.insert(r);
     }
-    if (write_failed) stop.store(true);
+    if (!write_dead.empty()) {
+      if (!stop.load()) {
+        std::string who;
+        for (int r : write_dead) {
+          if (!who.empty()) who += ",";
+          who += std::to_string(r);
+        }
+        broadcast_abort(write_dead,
+                        "rank(s) [" + who +
+                            "] lost connection while the round " +
+                            std::to_string(round_no) +
+                            " response was being broadcast");
+      }
+      stop.store(true);
+    }
     // Freed slot ids become reusable only now that every client has (or
     // will, before its next request) processed the eviction broadcast —
     // a same-round reassignment could otherwise collide with in-flight
@@ -915,7 +1281,7 @@ struct Client {
 extern "C" {
 
 void* hvdtpu_server_start(int port, int world, double stall_warn_s,
-                          int cache_capacity) {
+                          int cache_capacity, int round_deadline_ms) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return nullptr;
   int one = 1;
@@ -935,8 +1301,13 @@ void* hvdtpu_server_start(int port, int world, double stall_warn_s,
   s->stall_warn_s = stall_warn_s;
   s->cache_capacity = cache_capacity < 0 ? 0
       : static_cast<size_t>(cache_capacity);
+  s->round_deadline_ms = round_deadline_ms < 0 ? 0 : round_deadline_ms;
   s->fds = std::make_unique<std::atomic<int>[]>(world);
-  for (int i = 0; i < world; ++i) s->fds[i].store(-1);
+  s->v4 = std::make_unique<std::atomic<char>[]>(world);
+  for (int i = 0; i < world; ++i) {
+    s->fds[i].store(-1);
+    s->v4[i].store(0);
+  }
   s->loop = std::thread([s] { s->run(); });
   return s;
 }
@@ -1014,20 +1385,60 @@ void* hvdtpu_client_connect(const char* host, int port, int rank,
   return nullptr;
 }
 
-// One lock-step round: send req frame, block for response frame.
-// Returns response length, 0 on empty response, -1 on error, -2 if the
-// response exceeds resp_cap.
-int hvdtpu_client_round(void* handle, const uint8_t* req, int req_len,
-                        uint8_t* resp_buf, int resp_cap) {
+// Send half of a lock-step round: write the request frame.  0 on success,
+// -1 on a dead/closed socket.
+int hvdtpu_client_send(void* handle, const uint8_t* req, int req_len) {
   auto* c = static_cast<Client*>(handle);
   if (!c || c->fd < 0) return -1;
   std::vector<uint8_t> payload(req, req + req_len);
-  if (!write_frame(c->fd, payload)) return -1;
+  return write_frame(c->fd, payload) ? 0 : -1;
+}
+
+// Receive half: block for the response frame, bounded by timeout_ms
+// (<= 0 = wait forever, the pre-v4 behavior).  Returns the response
+// length, -1 on a dead socket, -2 on overflow, -3 on deadline expiry.
+// The deadline bounds the ENTIRE frame, not just its first byte: a
+// coordinator wedged mid-frame-write (SIGSTOPped / paged out after the
+// length prefix) must still surface as RoundTimeoutError — this timeout
+// is the documented backstop for exactly that wedged-coordinator case,
+// where the server-side round deadline cannot help.
+int hvdtpu_client_recv(void* handle, uint8_t* resp_buf, int resp_cap,
+                       int timeout_ms) {
+  auto* c = static_cast<Client*>(handle);
+  if (!c || c->fd < 0) return -1;
   std::vector<uint8_t> resp;
-  if (!read_frame(c->fd, &resp)) return -1;
+  if (timeout_ms > 0) {
+    auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+    int rc = read_frame_deadline(c->fd, &resp, deadline);
+    if (rc == 0) return -3;
+    if (rc < 0) return -1;
+  } else if (!read_frame(c->fd, &resp)) {
+    return -1;
+  }
   if (static_cast<int>(resp.size()) > resp_cap) return -2;
   if (!resp.empty()) std::memcpy(resp_buf, resp.data(), resp.size());
   return static_cast<int>(resp.size());
+}
+
+// 1 when a frame is already readable (used to drain a queued ABORT before
+// sending the next request — a send into a reset socket would make the
+// kernel discard the buffered abort frame), else 0.
+int hvdtpu_client_pending(void* handle) {
+  auto* c = static_cast<Client*>(handle);
+  if (!c || c->fd < 0) return 0;
+  pollfd pfd{c->fd, POLLIN, 0};
+  return ::poll(&pfd, 1, 0) > 0 ? 1 : 0;
+}
+
+// One lock-step round: send req frame, block for response frame.
+// Returns response length, 0 on empty response, -1 on error, -2 if the
+// response exceeds resp_cap.  (Legacy composite of send + recv, kept for
+// unit tests and out-of-tree callers.)
+int hvdtpu_client_round(void* handle, const uint8_t* req, int req_len,
+                        uint8_t* resp_buf, int resp_cap) {
+  int rc = hvdtpu_client_send(handle, req, req_len);
+  if (rc < 0) return rc;
+  return hvdtpu_client_recv(handle, resp_buf, resp_cap, 0);
 }
 
 // Unblock a thread stuck in hvdtpu_client_round (recv returns 0 after the

@@ -180,6 +180,22 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
         "Check the laggard ranks' logs for the branch they took instead; "
         "the ledger tail in this report shows the last calls they made.",
     ),
+    Rule(
+        "HVD303", Severity.ERROR,
+        "control-plane peer failure (dead or unresponsive rank)",
+        "The coordinator declared one or more ranks dead — their socket "
+        "died (process crash, ECONNRESET) or they missed the per-round "
+        "deadline (HOROVOD_ROUND_TIMEOUT_S) — and broadcast a typed ABORT "
+        "to the survivors, which surface it as PeerFailureError (or "
+        "RoundTimeoutError when this rank's own round deadline expired "
+        "without a verdict).  Without this machinery every surviving rank "
+        "would block in a deadline-free recv until a human killed the "
+        "job.",
+        "Check the named ranks' logs for the crash; under the elastic "
+        "driver the survivors re-rendezvous automatically — otherwise "
+        "restart the job without the dead host.  docs/fault_tolerance.md "
+        "covers the knobs.",
+    ),
 ]}
 
 

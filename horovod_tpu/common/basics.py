@@ -104,7 +104,17 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None,
             # PROCESS values there), and falls back to TPU-metadata
             # auto-detection without one (SPMD-only: the eager engine's
             # negotiation controller needs a launcher; enqueue guards it).
-            if multi_process:
+            if multi_process and cfg.elastic:
+                # Elastic worlds neutralize the XLA coordination service's
+                # own failure detector (it can only abort survivors; our
+                # control plane detects dead peers in ms and the driver
+                # owns recovery) so a post-fault teardown can park the
+                # poisoned world instead of dying in its shutdown barrier.
+                from ..elastic.worker import init_distributed_resilient
+                init_distributed_resilient(
+                    f"{cfg.controller_addr}:{cfg.controller_port}",
+                    num_processes=cfg.size_env, process_id=cfg.rank_env)
+            elif multi_process:
                 jax.distributed.initialize(
                     coordinator_address=(
                         f"{cfg.controller_addr}:{cfg.controller_port}"),
@@ -141,7 +151,10 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None,
                 rank=cfg.rank_env, world=cfg.size_env,
                 stall_warn_s=cfg.stall_check_time_s
                 if not cfg.stall_check_disable else 1e18,
-                cache_capacity=cfg.response_cache_capacity)
+                cache_capacity=cfg.response_cache_capacity,
+                round_timeout_s=cfg.round_timeout_s,
+                connect_retries=cfg.connect_retries,
+                connect_backoff_ms=cfg.connect_backoff_ms)
             st.engine.controller = st.controller
 
         if cfg.monitor:
@@ -178,6 +191,11 @@ def shutdown() -> None:
     with st._lock:
         if not st.initialized:
             return
+        # A control-plane fault (dead peer — HVD303) means the jax world's
+        # cooperative teardown can never complete: take the abrupt path
+        # below.  Captured before the engine is torn down.
+        abrupt = (st.engine is not None
+                  and getattr(st.engine, "fault", None) is not None)
         if st.controller is not None:
             # Unblock any lock-step round FIRST so the engine thread can't
             # be left inside the native client when we free it.
@@ -199,8 +217,14 @@ def shutdown() -> None:
         # SURVEY.md §7 hard-part #3).
         if (st.config is not None and st.config.elastic
                 and st.config.controller_addr != ""):
-            from ..elastic.worker import teardown_distributed
-            teardown_distributed()
+            from ..elastic.worker import (exit_guard_note_clean_shutdown,
+                                          teardown_distributed)
+            teardown_distributed(abrupt=abrupt)
+            if not abrupt:
+                # A non-abrupt explicit shutdown means the run completed:
+                # any exit code latched by a caught-and-recovered
+                # sys.exit() is stale.
+                exit_guard_note_clean_shutdown()
         st.initialized = False
         st.topology = None
 

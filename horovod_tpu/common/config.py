@@ -124,6 +124,19 @@ class Config:
     monitor_port: int = 0
     monitor_interval_s: float = 5.0
 
+    # Control-plane fault tolerance (protocol v4, docs/fault_tolerance.md).
+    # round_timeout_s: per-negotiation-round wall-clock deadline — the
+    # server declares ranks that miss it dead and broadcasts a typed ABORT
+    # to survivors; the client bounds its own response wait at 2x.  Must
+    # exceed the worst legitimate inter-rank skew (XLA compiles!); 0
+    # disables the deadlines (dead-socket detection is always on).
+    # connect_retries / connect_backoff_ms: bounded controller-connect
+    # retries with exponential backoff + jitter, so workers may start
+    # before the coordinator.
+    round_timeout_s: float = 0.0
+    connect_retries: int = 3
+    connect_backoff_ms: float = 500.0
+
     timeline_filename: str = ""
     timeline_mark_cycles: bool = False
 
@@ -186,6 +199,9 @@ class Config:
             monitor=_env_bool("MONITOR", False),
             monitor_port=_env_int("MONITOR_PORT", 0),
             monitor_interval_s=_env_float("MONITOR_INTERVAL", 5.0),
+            round_timeout_s=_env_float("ROUND_TIMEOUT_S", 0.0),
+            connect_retries=_env_int("CONNECT_RETRIES", 3),
+            connect_backoff_ms=_env_float("CONNECT_BACKOFF_MS", 500.0),
             timeline_filename=_env("TIMELINE", "") or "",
             timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
             stall_check_time_s=_env_float("STALL_CHECK_TIME", 60.0),

@@ -19,6 +19,12 @@ import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import native
+from .exceptions import (
+    HorovodInternalError, JoinTimeoutError, PeerFailureError,
+    RoundTimeoutError,
+)
+from .net import retry_with_backoff
+from ..testing import faults as _faults
 from ..utils.logging import get_logger
 
 log = get_logger()
@@ -28,6 +34,13 @@ _RESP_CAP = 4 * 1024 * 1024
 # Monitor side-channel section marker ("MON1" little-endian) — protocol v3.
 # Matches kMonMagic in csrc/coordinator.cc.
 _MON_MAGIC = 0x314E4F4D
+# Fault-tolerance capability section marker ("FLT1") — protocol v4; rides
+# the first request/response only (warm rounds carry zero extra bytes).
+_FLT_MAGIC = 0x31544C46
+# Typed abort frame: escape word + magic ("ABT4").  Matches kAbortEscape /
+# kAbortMagic in csrc/coordinator.cc.
+_ABORT_ESCAPE = 0xFFFFFFFF
+_ABORT_MAGIC = 0x34544241
 
 
 @dataclasses.dataclass
@@ -67,26 +80,82 @@ class TCPController:
 
     def __init__(self, addr: str, port: int, rank: int, world: int,
                  stall_warn_s: float = 60.0, connect_timeout_ms: int = 60000,
-                 cache_capacity: int = 2048):
+                 cache_capacity: int = 2048, round_timeout_s: float = 0.0,
+                 connect_retries: int = 3,
+                 connect_backoff_ms: float = 500.0):
         self._lib = native.load()
         self.rank = rank
         self.world = world
         self._server = None
+        # Control-plane fault tolerance (protocol v4, HOROVOD_ROUND_
+        # TIMEOUT_S): the server declares a rank dead when its socket dies
+        # or it misses the per-round deadline, and broadcasts a typed
+        # ABORT; this client additionally bounds its own response wait at
+        # 2x the deadline (the server's verdict — armed at the round's
+        # first frame, i.e. no later than our own send — must win the race
+        # so failures carry dead-rank attribution; the client timeout is
+        # the backstop for a wedged coordinator).  0 disables both
+        # deadlines; dead-socket detection is always on.
+        self.round_timeout_s = max(0.0, float(round_timeout_s))
+        # Monitor-installed attribution hook: called with the dead-rank
+        # list (or None for unattributed timeouts) to enrich HVD303 errors
+        # with snapshot ages / ledger tails.  Telemetry only — guarded.
+        self.fault_enricher = None
+        # Latches once the server advertises protocol v4 (FLT1 section in
+        # round 1's response) — the fault-frame analogue of
+        # peer_monitor_proto below.
+        self.peer_fault_proto = False
+        # Set by interrupt() before it severs the lock-step socket: an
+        # expected local teardown whose round failure must NOT be treated
+        # as a peer death (engine checks it before aborting).
+        self.interrupted = False
+        # Deterministic fault injection (HVD_TPU_FAULT, horovod_tpu.testing
+        # .faults): cached as a bound callable ONLY when armed, so the
+        # unarmed hot path costs one attribute check per site.
+        self._fault_fire = _faults.fire if _faults.armed() else None
         if rank == 0:
             self._server = self._lib.hvdtpu_server_start(
                 port, world, ctypes.c_double(stall_warn_s),
-                int(cache_capacity))
+                int(cache_capacity),
+                int(self.round_timeout_s * 1000))
             if not self._server:
                 raise RuntimeError(f"Failed to start controller server on "
                                    f"port {port}")
-        self._client = self._lib.hvdtpu_client_connect(
-            addr.encode(), port, rank, connect_timeout_ms)
-        if not self._client:
+        if self._fault_fire is not None:
+            self._fault_fire("connect", rank)
+        # Bounded connect retries with exponential backoff + jitter
+        # (HOROVOD_CONNECT_RETRIES / HOROVOD_CONNECT_BACKOFF_MS): workers
+        # may start before the coordinator's server exists.  The overall
+        # connect_timeout_ms budget is split across attempts; each native
+        # attempt itself re-resolves DNS and re-tries the TCP connect.
+        retries = max(0, int(connect_retries))
+        per_ms = (connect_timeout_ms if retries == 0
+                  else max(1000, int(connect_timeout_ms / (retries + 1))))
+
+        def _connect():
+            handle = self._lib.hvdtpu_client_connect(
+                addr.encode(), port, rank, per_ms)
+            if not handle:
+                raise ConnectionError(
+                    f"controller at {addr}:{port} not reachable")
+            return handle
+
+        def _on_retry(attempt, exc, delay_s):
+            log.warning(
+                "rank %d: %s (attempt %d/%d); retrying in %.1fs",
+                rank, exc, attempt + 1, retries + 1, delay_s)
+
+        try:
+            self._client = retry_with_backoff(
+                _connect, retries=retries, base_ms=connect_backoff_ms,
+                exceptions=(ConnectionError,), on_retry=_on_retry)
+        except ConnectionError as exc:
+            self._client = None
             if self._server:
                 self._lib.hvdtpu_server_stop(self._server)
             raise RuntimeError(
                 f"rank {rank}: failed to connect to controller at "
-                f"{addr}:{port}")
+                f"{addr}:{port} after {retries + 1} attempt(s)") from exc
         self._announced: set = set()
         # Response cache (reference N8 response_cache.cc): slot table
         # replicated across ranks.  (name, digest, required, datadep,
@@ -138,6 +207,7 @@ class TCPController:
         self._joined = False
         self._join_event = threading.Event()
         self._join_last_rank = -1
+        self._join_error: Optional[BaseException] = None
         self.synthesizer = None
         # Peer group tags → local ids, in a high id range so a synthesized
         # group can never collide with this rank's own group ids (a joining
@@ -211,22 +281,63 @@ class TCPController:
             if blob:
                 req += struct.pack("<II", _MON_MAGIC, len(blob)) + blob
                 self.monitor_bytes_sent += 8 + len(blob)
+        # v4 capability hello: FIRST request only, so warm-path frames
+        # carry zero fault-tolerance bytes (the frame guard asserts this).
+        if self.rounds == 1:
+            req += struct.pack("<II", _FLT_MAGIC, 0)
         stats.full_announces += sum(1 for a in full
                                     if not a[0].startswith("\x1f"))
         stats.bit_announces += len(bits)
         self.bytes_sent += len(req)
+        if self._fault_fire is not None:
+            self._fault_fire("round_send", self.rank, sever=self._sever)
+        # Drain a queued ABORT before sending: the server may have posted
+        # the typed verdict behind the previous round's response, and a
+        # send into an already-reset socket would make the kernel discard
+        # the buffered frame (losing the attribution).
+        if self._lib.hvdtpu_client_pending(self._client):
+            # NB: poll() also reports readable on EOF/POLLHUP — a dead
+            # socket lands here too, and must be reported as the ordinary
+            # peer-death failure, not as a protocol bug.
+            rc, _ = self._recv_salvaging_abort(1000)
+            if rc == -2:
+                self._raise_overflow()
+            if rc < 0:
+                self._raise_unattributed_failure(f"rc={rc}")
+            raise HorovodInternalError(
+                "controller protocol desync: unsolicited frame before the "
+                "round request (rc={})".format(rc))
         buf = (ctypes.c_uint8 * len(req)).from_buffer(req) if req else \
             (ctypes.c_uint8 * 0)()
-        rc = self._lib.hvdtpu_client_round(
-            self._client, buf, len(req), self._resp_buf, _RESP_CAP)
+        rc = self._lib.hvdtpu_client_send(self._client, buf, len(req))
         if rc < 0:
-            # HorovodInternalError so elastic run wrappers catch-and-restore
-            # (SURVEY.md §3.4); it subclasses RuntimeError for static mode.
-            from ..elastic.state import HorovodInternalError
-            raise HorovodInternalError(
-                f"controller round failed (rc={rc}); a peer likely died "
-                f"mid-negotiation")
-        data = bytes(self._resp_buf[:rc])
+            # Send failed — the socket died between rounds.  A typed abort
+            # may still be buffered locally; salvage it for attribution.
+            self._recv_salvaging_abort(250)
+            self._raise_unattributed_failure(f"send rc={rc}")
+        if self._fault_fire is not None:
+            self._fault_fire("mid_round_exit", self.rank,
+                             sever=self._sever)
+            self._fault_fire("round_recv", self.rank, sever=self._sever)
+        # Client-side wall-clock deadline (2x the server's per-round
+        # deadline — see __init__): the backstop for a wedged coordinator.
+        timeout_ms = int(self.round_timeout_s * 2000)
+        rc, data = self._recv_salvaging_abort(timeout_ms)
+        if rc == -3:
+            msg = (f"HVD303 negotiation round timed out after "
+                   f"{self.round_timeout_s * 2:g}s (HOROVOD_ROUND_TIMEOUT_S"
+                   f"={self.round_timeout_s:g}); the coordinator or a peer "
+                   f"rank is wedged")
+            extra = self._enrich(None)
+            if extra:
+                msg += "\n" + extra
+            raise RoundTimeoutError(msg, timeout_s=self.round_timeout_s * 2)
+        if rc == -2:
+            self._raise_overflow()
+        if rc < 0:
+            # ControlPlaneError subclasses HorovodInternalError, so elastic
+            # run wrappers still catch-and-restore (SURVEY.md §3.4).
+            self._raise_unattributed_failure(f"rc={rc}")
         off = 0
 
         def read_list():
@@ -315,11 +426,17 @@ class TCPController:
                 if key is not None:
                     self._slots.pop(key, None)
                     self.cache_stats.invalidations += 1
-        # Monitor section (protocol v3): the server's re-broadcast of this
-        # round's fleet snapshots.  The magic is also its capability
-        # advertisement — seeing it latches peer_monitor_proto, which the
-        # agent's version gate reads.
-        if off + 8 <= len(data):
+        # Trailing sections, walked order-agnostically (mirroring the
+        # server's generic request-side walk, so MON1 and FLT1 compose in
+        # either order).  MON1 (protocol v3): the server's re-broadcast of
+        # this round's fleet snapshots.  FLT1 (protocol v4, round 1's
+        # response only): the server can send us typed ABORT frames
+        # instead of blind socket severs.  Each magic doubles as the
+        # capability advertisement its version gate latches on.  An
+        # unknown magic stops the walk: MON1 carries no section-length
+        # field, so a client this old cannot skip sections it does not
+        # understand (a future section must be appended after these).
+        while off + 8 <= len(data):
             (magic,) = struct.unpack_from("<I", data, off)
             if magic == _MON_MAGIC:
                 off += 4
@@ -337,7 +454,99 @@ class TCPController:
                         self.monitor_sink(blobs)
                     except Exception:  # noqa: BLE001 - telemetry only
                         log.exception("monitor sink failed")
+            elif magic == _FLT_MAGIC:
+                off += 8  # magic + reserved u32 (always 0)
+                self.peer_fault_proto = True
+            else:
+                break
         return ready, warns, errors
+
+    # ------------------------------------------------- fault handling (v4)
+    @staticmethod
+    def _parse_abort(data: bytes) -> Optional[tuple]:
+        """``(dead_ranks, reason)`` when ``data`` is a typed ABORT frame
+        (escape word + "ABT4" magic), else None.  The escape word
+        0xFFFFFFFF is an impossible n_ready, so the check is unambiguous
+        against every normal response."""
+        if len(data) < 12:
+            return None
+        esc, magic = struct.unpack_from("<II", data, 0)
+        if esc != _ABORT_ESCAPE or magic != _ABORT_MAGIC:
+            return None
+        (n_dead,) = struct.unpack_from("<I", data, 8)
+        off = 12
+        ranks = []
+        for _ in range(n_dead):
+            (r,) = struct.unpack_from("<I", data, off)
+            ranks.append(r)
+            off += 4
+        (ln,) = struct.unpack_from("<H", data, off)
+        off += 2
+        reason = data[off:off + ln].decode(errors="replace")
+        return ranks, reason
+
+    def _recv_salvaging_abort(self, timeout_ms: int):
+        """One ``client_recv`` that raises the typed ``PeerFailureError``
+        when the frame is a v4 ABORT; otherwise returns ``(rc, data)``
+        for the caller to classify (``rc < 0``: dead / overflowed /
+        timed-out socket — see ``hvdtpu_client_recv``).  All of
+        ``negotiate()``'s salvage points (pre-send drain, failed send,
+        main response) funnel through here so the abort handling cannot
+        drift between them."""
+        rc = self._lib.hvdtpu_client_recv(
+            self._client, self._resp_buf, _RESP_CAP, timeout_ms)
+        data = bytes(self._resp_buf[:rc]) if rc > 0 else b""
+        abort = self._parse_abort(data)
+        if abort is not None:
+            self._raise_peer_failure(*abort)
+        return rc, data
+
+    def _enrich(self, dead_ranks: Optional[List[int]]) -> str:
+        """Monitor-sourced attribution block (snapshot ages, ledger tails)
+        for HVD303 errors; empty without an agent.  Telemetry must never
+        mask the original failure — guarded."""
+        if self.fault_enricher is None:
+            return ""
+        try:
+            return self.fault_enricher(dead_ranks) or ""
+        except Exception:  # noqa: BLE001 - attribution is best-effort
+            log.exception("fault enricher failed")
+            return ""
+
+    def _raise_peer_failure(self, ranks: List[int], reason: str):
+        msg = (f"HVD303 control-plane peer failure: the coordinator "
+               f"declared rank(s) {sorted(ranks)} dead: {reason}")
+        extra = self._enrich(ranks)
+        if extra:
+            msg += "\n" + extra
+        raise PeerFailureError(msg, dead_ranks=ranks, reason=reason)
+
+    def _raise_overflow(self):
+        """A response larger than the fixed receive buffer (native rc=-2)
+        is a protocol/sizing bug, NOT a peer failure: deliberately a plain
+        RuntimeError — a ControlPlaneError (or any HorovodInternalError)
+        would send the elastic run wrapper into a restore loop that hits
+        the identical overflow every round, while telling the operator
+        peers are dying."""
+        raise RuntimeError(
+            f"negotiation response exceeded the fixed "
+            f"{_RESP_CAP // (1024 * 1024)}MB receive buffer (_RESP_CAP); "
+            f"this is a protocol/sizing bug, not a peer failure — reduce "
+            f"the per-round announce burst or raise _RESP_CAP")
+
+    def _raise_unattributed_failure(self, detail: str):
+        """Peer death inferred from a severed socket with no salvageable
+        abort verdict naming the culprit.  Still typed (ControlPlaneError,
+        so the engine runs its clean abort instead of leaving the
+        InflightRing waiting on a dead world) and still monitor-enriched —
+        with no dead-rank list, the stalest snapshot is the prime suspect."""
+        msg = (f"HVD303 controller round failed ({detail}); a peer likely "
+               f"died mid-negotiation (unattributed: no abort verdict was "
+               f"salvageable)")
+        extra = self._enrich(None)
+        if extra:
+            msg += "\n" + extra
+        raise PeerFailureError(msg, dead_ranks=[])
 
     def _adopt_slot(self, key: tuple, slot: int):
         old = self._slot_keys.pop(slot, None)
@@ -432,6 +641,8 @@ class TCPController:
         returns ``(ready, errored)``: the subset ready everywhere in the
         server's global order, and ``(entry, message)`` pairs for per-tensor
         negotiation failures (digest mismatch across ranks)."""
+        if self._fault_fire is not None:
+            self._fault_fire("pre_announce", self.rank, sever=self._sever)
         by_name: Dict[str, object] = {self._wire_name(e): e for e in entries}
         new = []
         for n, e in by_name.items():
@@ -556,15 +767,49 @@ class TCPController:
         self._join_pending = True
 
     def join_wait(self, timeout: Optional[float] = None) -> int:
-        """Block until every rank joined; returns the last rank to join."""
+        """Block until every rank joined; returns the last rank to join.
+
+        Contract: the return value is always the last joining rank (an
+        ``int >= 0``) — never a sentinel.  If the all-joined verdict does
+        not arrive within ``timeout`` seconds, raises
+        :class:`~.exceptions.JoinTimeoutError` (a ``TimeoutError``
+        subclass, so existing handlers keep working); the join stays
+        pending and a later ``join_wait`` may still succeed."""
         if not self._join_event.wait(timeout):
-            raise TimeoutError("join() did not complete: some ranks have "
-                               "not joined")
+            raise JoinTimeoutError(
+                f"join() did not complete within {timeout}s: some ranks "
+                f"have not joined (the negotiation keeps running; call "
+                f"join_wait again to keep waiting)")
+        if self._join_error is not None:
+            raise self._join_error
         return self._join_last_rank
+
+    def fail_join(self, exc: BaseException):
+        """Fail any pending (and every future) ``join_wait`` with ``exc``.
+
+        Part of the engine abort's no-waiter-may-hang invariant: once the
+        control plane is down, the all-joined verdict can never arrive —
+        a ``hvd.join()`` blocked with ``timeout=None`` would otherwise
+        wait forever.  Sticky: this controller generation is dead."""
+        self._join_error = exc
+        self._join_event.set()
 
     def interrupt(self):
         """Unblock any thread stuck in a lock-step round (socket shutdown,
-        no free) — call before stopping the engine thread."""
+        no free) — call before stopping the engine thread.  Sets
+        ``interrupted`` first: the severed socket makes the in-flight
+        round raise exactly like a peer death, and the engine's cycle
+        handler uses the flag to tell expected teardown apart from a
+        real HVD303 fault (no spurious abort/log/health flip on every
+        clean shutdown)."""
+        self.interrupted = True
+        self._sever()
+
+    def _sever(self):
+        """Abruptly shut down the client socket without marking the
+        teardown expected — the fault harness's ``econnreset`` action uses
+        this so an injected sever still surfaces as a real HVD303 fault
+        on the severed rank."""
         if self._client:
             self._lib.hvdtpu_client_interrupt(self._client)
 

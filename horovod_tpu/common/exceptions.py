@@ -1,0 +1,98 @@
+"""Typed control-plane error taxonomy (no jax imports).
+
+The reference signals world-level failures through ``HorovodInternalError``
+(``horovod/common/exceptions.py``): the elastic ``run`` wrapper catches it,
+restores the last committed state, and re-rendezvouses (SURVEY.md §3.4).
+This module is the jax-free home of that hierarchy so the TCP controller,
+the fault-injection harness (``horovod_tpu/testing``) and the monitor
+subsystem can all raise/inspect typed failures without dragging jax into
+the fast test tier.  ``elastic/state.py`` re-exports
+``HorovodInternalError`` for backwards compatibility.
+
+Taxonomy::
+
+    RuntimeError
+     └─ HorovodInternalError          world-level failure; elastic resets
+         └─ ControlPlaneError         coordinator control plane failed
+             ├─ PeerFailureError      HVD303: a peer died / was declared
+             │                        dead (carries the dead-rank list)
+             └─ RoundTimeoutError     HVD303: this rank's negotiation
+                                      round exceeded its wall-clock
+                                      deadline (peers unattributable)
+    TimeoutError
+     └─ JoinTimeoutError              hvd.join() did not complete in time
+
+``NegotiationError`` (an application-level per-tensor failure, deliberately
+NOT a HorovodInternalError) stays in ``common/controller.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+class HorovodInternalError(RuntimeError):
+    """A peer died mid-collective; training must roll back to last commit.
+
+    The elastic ``@hvd.elastic.run`` wrapper catches this, restores the
+    last committed state, re-initializes the runtime and re-rendezvouses
+    with the surviving host set; without the wrapper it propagates as a
+    plain RuntimeError (static jobs fail fast instead of hanging).
+    """
+
+
+class ControlPlaneError(HorovodInternalError):
+    """The coordinator control plane failed (dead peer, abort broadcast,
+    or a missed deadline).  Base class for the HVD303 family — catch this
+    to handle any control-plane fault uniformly."""
+
+
+class PeerFailureError(ControlPlaneError):
+    """HVD303: the coordinator declared one or more peer ranks dead.
+
+    Raised on surviving ranks when the server broadcasts a typed ABORT
+    (a peer's socket died or it missed the per-round deadline), or when
+    this rank's own connection to the coordinator was severed.  Carries
+    the dead-rank attribution when known.
+
+    Attributes:
+        dead_ranks: sorted list of ranks the server declared dead
+            (empty when the failure could not be attributed — e.g. the
+            coordinator itself vanished before naming anyone).
+        reason: the server's verdict string (connection loss vs missed
+            deadline, and in which round).
+    """
+
+    def __init__(self, message: str,
+                 dead_ranks: Optional[Sequence[int]] = None,
+                 reason: str = ""):
+        super().__init__(message)
+        self.dead_ranks = sorted(dead_ranks or [])
+        self.reason = reason
+
+
+class RoundTimeoutError(ControlPlaneError):
+    """HVD303: a negotiation round exceeded ``HOROVOD_ROUND_TIMEOUT_S``.
+
+    Raised by the client when the coordinator's response did not arrive
+    inside the wall-clock deadline — the coordinator (or the laggard rank
+    gating the lock-step round) is wedged but its socket is still open, so
+    no dead-rank attribution is available from the wire; the monitor
+    aggregator may still enrich the message with per-rank snapshot ages.
+
+    Attributes:
+        timeout_s: the deadline that expired.
+    """
+
+    def __init__(self, message: str, timeout_s: float = 0.0):
+        super().__init__(message)
+        self.timeout_s = timeout_s
+
+
+class JoinTimeoutError(TimeoutError):
+    """``hvd.join()`` did not complete within the caller's timeout.
+
+    Contract: ``join_wait(timeout=)`` either returns the last rank to
+    join (an ``int >= 0``) or raises this — it never returns a sentinel.
+    Subclasses ``TimeoutError`` so pre-existing ``except TimeoutError``
+    call sites keep working."""

@@ -85,7 +85,8 @@ def load() -> ctypes.CDLL:
         lib = ctypes.CDLL(path)
         lib.hvdtpu_server_start.restype = ctypes.c_void_p
         lib.hvdtpu_server_start.argtypes = [ctypes.c_int, ctypes.c_int,
-                                            ctypes.c_double, ctypes.c_int]
+                                            ctypes.c_double, ctypes.c_int,
+                                            ctypes.c_int]
         lib.hvdtpu_server_stop.argtypes = [ctypes.c_void_p]
         lib.hvdtpu_client_connect.restype = ctypes.c_void_p
         lib.hvdtpu_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
@@ -94,6 +95,15 @@ def load() -> ctypes.CDLL:
         lib.hvdtpu_client_round.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+        lib.hvdtpu_client_send.restype = ctypes.c_int
+        lib.hvdtpu_client_send.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+        lib.hvdtpu_client_recv.restype = ctypes.c_int
+        lib.hvdtpu_client_recv.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+            ctypes.c_int]
+        lib.hvdtpu_client_pending.restype = ctypes.c_int
+        lib.hvdtpu_client_pending.argtypes = [ctypes.c_void_p]
         lib.hvdtpu_client_interrupt.argtypes = [ctypes.c_void_p]
         lib.hvdtpu_client_close.argtypes = [ctypes.c_void_p]
         _lib = lib

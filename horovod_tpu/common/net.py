@@ -17,7 +17,42 @@ from __future__ import annotations
 import functools
 import random
 import socket
-from typing import List
+import time
+from typing import Callable, List, Optional, Tuple, Type
+
+
+def retry_with_backoff(fn: Callable, retries: int = 3,
+                       base_ms: float = 200.0, max_ms: float = 5000.0,
+                       jitter: float = 0.25,
+                       exceptions: Tuple[Type[BaseException], ...] = (OSError,),
+                       on_retry: Optional[Callable] = None):
+    """Call ``fn()``; on a listed exception sleep ``base_ms * 2**attempt``
+    (capped at ``max_ms``, ± ``jitter`` fraction of randomization so a
+    fleet of workers retrying the same dead endpoint doesn't stampede in
+    lock-step) and try again, up to ``retries`` retries.  The final
+    failure re-raises the last exception.
+
+    ``on_retry(attempt, exc, delay_s)`` — optional observer, called before
+    each sleep (loggers; tests assert schedules through it).
+
+    Shared by the controller's connect path (workers may start before the
+    coordinator) and the elastic driver's worker-notification path (a
+    transiently unreachable worker must still learn about host changes).
+    """
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except exceptions as exc:
+            if attempt >= max(0, int(retries)):
+                raise
+            delay_s = min(max_ms, base_ms * (2 ** attempt)) / 1000.0
+            delay_s *= 1.0 + random.uniform(-jitter, jitter)
+            delay_s = max(0.0, delay_s)
+            if on_retry is not None:
+                on_retry(attempt, exc, delay_s)
+            time.sleep(delay_s)
+            attempt += 1
 
 
 def free_ports(n: int) -> List[int]:

@@ -171,6 +171,7 @@ class ElasticDriver:
                         proc.pid)
 
     def _notify_workers(self, version: int):
+        from ..common.net import retry_with_backoff
         ports = self.rendezvous.notification_ports()
         for identity, port in ports.items():
             if identity not in self._procs:
@@ -178,12 +179,32 @@ class ElasticDriver:
             host = identity.rsplit(":", 1)[0]
             addr = "127.0.0.1" if host in ("localhost", "127.0.0.1",
                                            socket.gethostname()) else host
-            try:
-                with socket.create_connection((addr, port), timeout=5) as s:
+
+            def _ping(addr=addr, port=port):
+                # Per-attempt timeout sized so ALL attempts + backoff stay
+                # inside the old single-attempt 5s budget: the notify loop
+                # is serial, and it runs during exactly the host-failure
+                # events that make workers unreachable — one dead worker
+                # must not stall the re-rendezvous rollout for the rest.
+                with socket.create_connection((addr, port), timeout=1.5) as s:
                     s.sendall(f"HOSTS_UPDATED {version}\n".encode())
+
+            # Bounded retries with backoff + jitter: a worker mid-GC /
+            # briefly partitioned must still learn about the host change
+            # (a single 5s attempt used to warn-and-drop, leaving the
+            # worker training against a dead generation until its next
+            # commit raced the rendezvous).  Still best-effort after the
+            # final attempt — the versioned rendezvous long-poll is the
+            # correctness backstop; the ping is the latency optimization.
+            try:
+                retry_with_backoff(
+                    _ping, retries=2, base_ms=200.0, max_ms=2000.0,
+                    on_retry=lambda a, exc, d: log.info(
+                        "elastic driver: notify %s attempt %d failed (%s);"
+                        " retrying in %.1fs", identity, a + 1, exc, d))
             except OSError as exc:
-                log.warning("elastic driver: notify %s failed: %s",
-                            identity, exc)
+                log.warning("elastic driver: notify %s failed after "
+                            "retries: %s", identity, exc)
 
     def _new_generation(self, hosts: List[DiscoveredHost]) -> bool:
         assignments = self.compute_assignments(hosts)

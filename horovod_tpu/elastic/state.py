@@ -13,9 +13,15 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 
-
-class HorovodInternalError(RuntimeError):
-    """A peer died mid-collective; training must roll back to last commit."""
+# Historical home of HorovodInternalError; the hierarchy now lives in the
+# jax-free common/exceptions.py (the controller and fault harness raise
+# typed subclasses without importing jax).  Re-exported here so existing
+# ``from horovod_tpu.elastic.state import HorovodInternalError`` imports —
+# including the torch/elastic binding — keep working.
+from ..common.exceptions import (  # noqa: F401  (re-export)
+    ControlPlaneError, HorovodInternalError, PeerFailureError,
+    RoundTimeoutError,
+)
 
 
 class HostsUpdatedInterrupt(Exception):

@@ -11,8 +11,10 @@ a strictly newer generation and re-forms the JAX world.
 
 from __future__ import annotations
 
+import atexit
 import os
 import socket
+import sys
 import threading
 from typing import Optional
 
@@ -21,6 +23,31 @@ from .state import HostsUpdatedInterrupt
 from ..utils.logging import get_logger
 
 log = get_logger()
+
+# Heartbeat failure detection is effectively DISABLED in elastic jax worlds
+# (one "missed heartbeat" per 10s; ~4 months of tolerance): the coordinator
+# control plane (protocol v4 — csrc/coordinator.cc) detects a dead rank in
+# milliseconds and the elastic driver owns recovery, while the XLA
+# coordination service's own detector can only abort() the process (its
+# missed-heartbeat / polled-error handlers terminate, and its shutdown
+# barrier can never complete once a peer died uncleanly).  See
+# docs/fault_tolerance.md "why the jax world is parked, not shut down".
+_HEARTBEAT_FOREVER = 1_000_000
+
+# Poisoned generations' native (client, service, preemption-manager)
+# triples.  Their threads cannot be stopped — stopping requires the
+# cooperative shutdown barrier the dead peer will never join — so they are
+# parked here, idling harmlessly, for the remainder of the process.
+_parked_worlds: list = []
+_exit_guard = {"installed": False, "code": 0, "in_finale": False}
+
+# True only when init_distributed_resilient managed to neutralize the
+# coordination service's heartbeat detection.  Parking a world whose
+# detectors are still ENABLED is worse than useless — the parked client's
+# missed-heartbeat handler would abort() the surviving process ~100s
+# after the crash — so teardown_distributed only parks when this is set
+# and otherwise degrades to the graceful shutdown path.
+_heartbeats_neutralized = False
 
 # The generation this process is currently participating in; bootstrap
 # requests strictly newer on re-init so a stale assignment can't be rejoined.
@@ -159,18 +186,177 @@ def elastic_bootstrap():
     return Config.from_env()
 
 
-def teardown_distributed():
+def init_distributed_resilient(coordinator_address: str,
+                               num_processes: int, process_id: int):
+    """Form the jax world for an ELASTIC job with the coordination
+    service's own failure detection neutralized.
+
+    The stock client/service abort the whole process when a peer stops
+    heartbeating (their missed-heartbeat and error-polling handlers call
+    terminate, and Python-level callbacks are not usable on this jaxlib)
+    — which would kill the SURVIVORS of a worker loss ~100s after the
+    crash, exactly the processes elastic recovery exists to save.  Our
+    control plane detects the death in milliseconds (protocol v4 typed
+    ABORT → PeerFailureError) and the elastic driver re-forms the world,
+    so the jax-level detector is set to effectively-never and the
+    poisoned world is parked at teardown (``teardown_distributed``
+    with ``abrupt=True``)."""
+    global _heartbeats_neutralized
+    from jax._src import distributed as _jdist
+    try:
+        _jdist.global_state.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            service_max_missing_heartbeats=_HEARTBEAT_FOREVER,
+            client_max_missing_heartbeats=_HEARTBEAT_FOREVER)
+        _heartbeats_neutralized = True
+    except TypeError:
+        # Signature drift on a newer jax: fall back to the stock init —
+        # heartbeat detection stays ENABLED, so abrupt teardowns must
+        # degrade to the graceful path (teardown_distributed checks the
+        # flag; parking a detecting world would let its missed-heartbeat
+        # handler abort() this process later).
+        _heartbeats_neutralized = False
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+
+
+def _install_exit_guard():
+    """After an abrupt teardown the process must end via ``os._exit``:
+    interpreter finalization would destroy a parked world's service,
+    which cancels the parked client's outstanding poll RPC and that
+    client's (unstoppable) thread aborts the whole process from C++ —
+    turning a clean exit into rc=134 AFTER all Python work succeeded.
+    The guard runs the full runtime shutdown itself, runs the remaining
+    (earlier-registered) atexit hooks, then skips interpreter
+    finalization.  The true exit code is preserved: an uncaught
+    exception exits 1 (the wrapped excepthook records it; 130 for
+    KeyboardInterrupt, per convention), and ``sys.exit(n)`` exits ``n``
+    — uncaught SystemExit never reaches ``sys.excepthook``, so the code
+    is recorded by wrapping ``sys.exit`` itself (which also covers
+    argparse errors and ``sys.exit(main())``; a bare ``raise
+    SystemExit(n)`` is the one path not covered)."""
+    if _exit_guard["installed"]:
+        return
+    _exit_guard["installed"] = True
+    orig_hook = sys.excepthook
+    orig_exit = sys.exit
+
+    def record_failure(tp, val, tb):
+        orig_hook(tp, val, tb)
+        _exit_guard["code"] = 130 if tp is KeyboardInterrupt else 1
+
+    def recording_exit(code=None):
+        if code is None:
+            _exit_guard["code"] = 0
+        elif isinstance(code, int):
+            _exit_guard["code"] = code
+        else:
+            # CPython prints a non-int code to stderr and exits 1.
+            _exit_guard["code"] = 1
+        orig_exit(code)
+
+    sys.excepthook = record_failure
+    sys.exit = recording_exit
+
+    def finale():
+        # From here on the latched code IS the exit status: the clean-
+        # shutdown clear below must not touch it (finale's own
+        # basics.shutdown() call would otherwise zero a real sys.exit(n)).
+        _exit_guard["in_finale"] = True
+        try:
+            from ..common import basics
+            basics.shutdown()
+        except Exception:  # noqa: BLE001 - exiting anyway
+            pass
+        try:
+            # Run the hooks registered BEFORE the guard (coverage
+            # writers, tempfile cleanup, ...): os._exit would silently
+            # skip them.  finale is unregistered first so the re-entrant
+            # drain cannot recurse.  (A hook registered AFTER the fault
+            # runs twice — interpreter drain then this one — rare, and
+            # preferable to skipping every startup-registered writer.)
+            atexit.unregister(finale)
+            atexit._run_exitfuncs()
+        except Exception:  # noqa: BLE001 - exiting anyway
+            pass
+        try:
+            sys.stdout.flush()
+            sys.stderr.flush()
+        except Exception:  # noqa: BLE001 - exiting anyway
+            pass
+        os._exit(_exit_guard["code"])
+
+    # Registered at fault time, so this runs FIRST among atexit hooks;
+    # it drains the earlier ones itself before os._exit.
+    atexit.register(finale)
+
+
+def exit_guard_note_clean_shutdown():
+    """Clear a stale exit-code latch on an explicit, successful shutdown.
+
+    ``sys.exit(n)`` latches ``n`` at call time (uncaught SystemExit never
+    reaches ``sys.excepthook``), but a *caught* SystemExit — argparse's
+    ``parser.exit`` inside ``try/except SystemExit``, a recovered CLI
+    helper — leaves the latch stale, and finale would end an otherwise
+    healthy run with ``os._exit(n)``.  An explicit ``basics.shutdown()``
+    is the "run completed" signal, so it resets the latch; a LATER
+    uncaught ``sys.exit(n)`` or exception re-latches the real code.
+    No-op from finale itself, where the latch is the exit status.  (A
+    caught-and-recovered ``sys.exit`` in a run that never calls
+    ``shutdown()`` explicitly remains uncovered.)"""
+    if not _exit_guard["in_finale"]:
+        _exit_guard["code"] = 0
+
+
+def teardown_distributed(abrupt: bool = False):
     """Tear the JAX world fully down so init() can re-form it with a new
     size — ``jax.distributed.shutdown()`` plus an XLA backend clear
     (SURVEY.md §7 hard-part #3: elastic re-meshing implies re-init +
-    recompile; live arrays must already be host-saved via state.commit)."""
+    recompile; live arrays must already be host-saved via state.commit).
+
+    ``abrupt=True`` (a control-plane fault declared a peer dead): the
+    cooperative shutdown barrier can never complete — the dead rank will
+    not join it — and on this jax the failed barrier path ABORTS the
+    surviving process.  Instead the poisoned world's native objects are
+    parked (their threads idle harmlessly: heartbeat detection was
+    disabled by ``init_distributed_resilient``) and the exit guard is
+    installed; ``init()`` then forms the next generation on fresh ports.
+    """
     import jax
     from jax._src import distributed as _jdist
-    if _jdist.global_state.client is not None:
+    gs = _jdist.global_state
+    if abrupt and gs.client is not None and not _heartbeats_neutralized:
+        # The world was formed by the stock-init fallback: its heartbeat
+        # detectors are live, so a parked client would abort() us later.
+        # Best effort graceful teardown instead (the try/except below
+        # tolerates the barrier failing against the dead peer).
+        log.warning("elastic: abrupt teardown requested but heartbeat "
+                    "detection could not be neutralized at init; "
+                    "degrading to the graceful shutdown path")
+        abrupt = False
+    if abrupt and gs.client is not None:
+        import jax.extend.backend as jeb
+        jeb.clear_backends()   # drops the backends' refs into the old world
+        _parked_worlds.append((gs.client, gs.service,
+                               gs.preemption_sync_manager))
+        gs.client = None
+        gs.service = None
+        gs.preemption_sync_manager = None
+        gs.coordinator_address = None
+        _install_exit_guard()
+        log.warning("elastic: parked the failed generation's jax world "
+                    "(%d parked total); re-init will start a fresh one",
+                    len(_parked_worlds))
+        return
+    if gs.client is not None:
         try:
             jax.distributed.shutdown()
         except Exception as exc:  # noqa: BLE001 - peers may already be gone
             log.warning("elastic: jax.distributed.shutdown failed: %s", exc)
-            _jdist.global_state.client = None
+            gs.client = None
     import jax.extend.backend as jeb
     jeb.clear_backends()

@@ -77,10 +77,19 @@ class MonitorAgent:
                 # ledger tails from the aggregation table.
                 stall.peer_ledger_source = self._peer_cb
                 self._stall = stall
+        # Control-plane fault state (HVD303): set by the engine's
+        # _abort_engine hook; flips /health to "peer_dead" with the
+        # dead-rank list so operators see WHO died, not just that the
+        # fleet degraded.
+        self._peer_failure: Optional[dict] = None
         if controller is not None:
             controller.monitor_source = self.encode_frame
             controller.monitor_sink = self.on_frames
             controller.on_join_epoch = self.on_join_epoch
+            # HVD303 attribution: PeerFailureError / RoundTimeoutError
+            # messages are enriched with the dead ranks' last snapshot
+            # ages and ledger tails from the aggregation table.
+            controller.fault_enricher = self.peer_failure_context
 
     # ----------------------------------------------------------- collectors
     def _register_collectors(self, engine, controller) -> None:
@@ -300,10 +309,52 @@ class MonitorAgent:
             "monitor_bytes":
                 getattr(ctl, "monitor_bytes_sent", 0) if ctl else 0})
 
+    # ------------------------------------------------------- fault hooks
+    def on_peer_failure(self, dead_ranks, reason: str = "") -> None:
+        """Engine hook (``_abort_engine``): latch the control-plane fault
+        so ``/health`` reports ``peer_dead`` with attribution."""
+        self._peer_failure = {
+            "dead_ranks": sorted(int(r) for r in (dead_ranks or [])),
+            "reason": str(reason)[:2000],
+            "ts": round(time.time(), 3),
+        }
+
+    def peer_failure_context(self, dead_ranks=None) -> str:
+        """Attribution block for HVD303 errors: the dead ranks' last
+        snapshot ages and ledger tails from the aggregation table (or, for
+        unattributed round timeouts, every rank's snapshot age — the
+        stalest rank is the prime suspect)."""
+        table = self.aggregator.table()
+        if not table:
+            return ""
+        ranks = (sorted(int(r) for r in dead_ranks)
+                 if dead_ranks else sorted(table))
+        lines = []
+        for r in ranks:
+            rec = table.get(r)
+            if rec is None:
+                lines.append(f"rank {r}: no snapshot ever received")
+                continue
+            lines.append(f"rank {r}: last snapshot {rec['age_s']:g}s ago")
+            for t in (rec["snap"].get("ledger") or [])[-4:]:
+                lines.append(f"  {t}")
+        if not lines:
+            return ""
+        return ("monitor attribution (snapshot ages via side-channel):\n"
+                + "\n".join(lines))
+
     # -------------------------------------------------------------- exports
     def health(self) -> dict:
         self._update_self(force=True)
-        return self.aggregator.health(self.interval_s)
+        out = self.aggregator.health(self.interval_s)
+        pf = self._peer_failure
+        if pf is not None:
+            # A declared control-plane fault outranks every derived
+            # status: the fleet is not "degraded", it lost a member.
+            out["status"] = "peer_dead"
+            out["peer_dead"] = pf["dead_ranks"]
+            out["peer_dead_reason"] = pf["reason"]
+        return out
 
     def render_prometheus(self) -> str:
         self._update_self(force=True)
@@ -369,6 +420,11 @@ class MonitorAgent:
             ctl.monitor_source = None
             ctl.monitor_sink = None
             ctl.on_join_epoch = None
+            # Like the stall source below: only uninstall OUR enricher —
+            # a replacement agent may have installed its own.
+            if getattr(ctl, "fault_enricher", None) is not None and \
+                    getattr(ctl.fault_enricher, "__self__", None) is self:
+                ctl.fault_enricher = None
         if self._stall is not None:
             # A replacement agent may have re-installed its own source
             # (e.g. the bench A/B attaches a temporary agent to a live

@@ -792,6 +792,12 @@ def join(timeout: Optional[float] = None) -> int:
     the reference's join use case) until every rank has joined; returns the
     last rank to join.  In single-controller mode every rank joins
     simultaneously, so this drains the queue and returns size()-1.
+
+    Contract: always returns the last joining rank (an ``int >= 0``) —
+    never a sentinel.  If ``timeout`` expires before every rank joined,
+    raises :class:`~horovod_tpu.common.exceptions.JoinTimeoutError` (a
+    ``TimeoutError`` subclass); the join stays pending and may be waited
+    on again.
     """
     eng = _engine()
     ctrl = eng.controller

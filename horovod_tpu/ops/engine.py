@@ -45,6 +45,7 @@ from . import collectives as C
 from .scheduler import (  # noqa: F401  (re-export: public engine surface)
     FusedProgramCache, InflightRing, StallInspector, TensorQueue,
 )
+from ..common.exceptions import ControlPlaneError
 from ..utils.logging import get_logger
 
 log = get_logger()
@@ -163,6 +164,13 @@ class CollectiveEngine:
         self._thread: Optional[threading.Thread] = None
         self._cycle_index = 0
         self.controller = None       # multi-process TCP controller (optional)
+        # Control-plane fault latch (HVD303): set by _abort_engine when a
+        # ControlPlaneError (dead peer / round timeout) surfaces from
+        # negotiation.  Once set, the engine is cleanly down — every
+        # pending/in-flight waiter was settled with the error, and new
+        # enqueues raise it immediately instead of queueing into a dead
+        # world.  Elastic re-init builds a fresh engine, clearing it.
+        self._fault: Optional[BaseException] = None
         # Control-plane observability: cumulative negotiation wall time and
         # round count (multi-process mode only — single-controller cycles
         # have no negotiation).  bench.py derives negotiation_us_per_cycle;
@@ -226,6 +234,88 @@ class CollectiveEngine:
             self._inflight.stop()
             self._inflight = None
 
+    def _abort_engine(self, exc: BaseException, busy: bool = False):
+        """Clean engine shutdown on a control-plane fault (HVD303).
+
+        Invariant restored here: NO waiter may hang.  Every entry still
+        queued is settled with the error, the in-flight ring fails its
+        window without blocking on device results that may never come
+        (a collective whose participant died can block forever), new
+        enqueues raise immediately, and the monitor's ``/health`` flips
+        to ``peer_dead`` with the dead-rank list.  Runs on the cycle
+        thread; idempotent.
+
+        ``busy`` is the caller's hint that the failing cycle itself was
+        carrying entries; together with the queue/ring state it picks the
+        log severity — losing a peer with NO work outstanding is the
+        shape of an ordinary staggered clean shutdown (the first rank to
+        leave severs its socket and the server declares it dead; no wire
+        protocol distinguishes that from a crash), so it must not put an
+        ERROR in every clean run's logs."""
+        if self._fault is not None:
+            return
+        self._fault = exc
+        # Everything still waiting to negotiate fails now — the control
+        # plane will never answer it.
+        pending = self.queue.drain()
+        idle = (not busy and not pending
+                and (self._inflight is None or len(self._inflight) == 0))
+        if idle:
+            log.warning(
+                "control plane lost peer(s) with no work outstanding — a "
+                "staggered clean shutdown looks exactly like this (a peer "
+                "crash between bursts does too); shutting the engine down: "
+                "%s", exc)
+        else:
+            log.error("control plane failed; shutting the engine down "
+                      "cleanly: %s", exc)
+        self._settle_queued(pending, exc)
+        if self._inflight is not None:
+            self._inflight.abort(exc)
+        ctl = self.controller
+        if ctl is not None:
+            # Join waiters are part of the invariant too: the all-joined
+            # verdict can never arrive from a dead control plane, and
+            # hvd.join()'s default is timeout=None.
+            try:
+                ctl.fail_join(exc)
+            except Exception:  # noqa: BLE001 - keep the abort going
+                log.exception("failing join waiters failed")
+        mon = self.monitor
+        if mon is not None:
+            try:
+                mon.on_peer_failure(getattr(exc, "dead_ranks", []) or [],
+                                    str(exc))
+            except Exception:  # noqa: BLE001 - telemetry only
+                log.exception("monitor peer-failure hook failed")
+        # Stop cycling: further lock-step rounds against a stopped server
+        # would only churn errors.  basics.shutdown() still runs the full
+        # teardown (thread join, controller close) afterwards.
+        self._shutdown.set()
+
+    def _settle_queued(self, entries, exc: BaseException):
+        """Settle queued-but-never-negotiated entries with a fault — THE
+        one implementation of the no-waiter-may-hang invariant for the
+        pre-negotiation stage (both _abort_engine's drain and the
+        enqueue-vs-abort race path funnel through here, so the settle
+        sequence cannot drift between them)."""
+        tl = self._state.timeline
+        for e in entries:
+            e.error = exc
+            if tl is not None:
+                tl.end_activity(e.name, "QUEUE")
+            self.queue.mark_done(e)
+            e.done.set()
+
+    @property
+    def fault(self) -> Optional[BaseException]:
+        """The control-plane fault (HVD303) that shut this engine down, or
+        ``None`` while healthy.  Public contract: ``basics.shutdown`` keys
+        its abrupt-teardown path off it, and fault-tolerance acceptance
+        workers poll it to converge on the typed verdict.  Elastic re-init
+        builds a fresh engine, which clears it."""
+        return self._fault
+
     # ------------------------------------------------------------- submit API
     def enqueue(self, name: str, ctype: CollectiveType, tensor,
                 reduce_op=C.ReduceOp.AVERAGE, root_rank: int = 0,
@@ -244,6 +334,11 @@ class CollectiveEngine:
         """Enqueue several entries atomically w.r.t. the drain — a cycle
         sees all of them or none, so grouped members always negotiate (and
         batch) together (reference: group_table N13)."""
+        if self._fault is not None:
+            # The control plane is down (dead peer / round timeout): fail
+            # fast with the original HVD303 error instead of queueing work
+            # no negotiation round will ever answer.
+            raise self._fault
         if self.controller is None and self._world_processes > 1:
             # A multi-process world without the launcher's negotiation
             # controller (pod auto-detect mode): eager collectives cannot
@@ -283,6 +378,16 @@ class CollectiveEngine:
         if tl is not None:
             for e in entries:
                 tl.start_activity(e.name, "QUEUE")
+        fault = self._fault
+        if fault is not None:
+            # Lost the race with _abort_engine (the fault landed between
+            # the guard above and the push).  Drain-as-claim: the queue pop
+            # is atomic, so only entries still queued are ours to settle —
+            # anything already drained (the abort's sweep, or a cycle that
+            # then fails them) is settled exactly once by its drainer,
+            # never twice (a double settle garbles the timeline's QUEUE
+            # begin/end pairing).
+            self._settle_queued(self.queue.drain(), fault)
         self._wake.set()
         return [e.handle for e in entries]
 
@@ -368,6 +473,31 @@ class CollectiveEngine:
         try:
             responses, not_ready = self._compute_response_list(entries)
         except BaseException as exc:  # noqa: BLE001 - propagate to waiters
+            if isinstance(exc, ControlPlaneError):
+                ctl = self.controller
+                if ctl is not None and getattr(ctl, "interrupted", False):
+                    # Expected teardown: basics.shutdown() severed the
+                    # lock-step socket to unblock this thread, which makes
+                    # the in-flight round fail exactly like a peer death.
+                    # Not a fault — settle and exit quietly (stop() joins
+                    # us next) instead of logging HVD303 and flipping
+                    # /health to peer_dead on every clean multi-process
+                    # shutdown.
+                    pass
+                else:
+                    # A dead peer / missed round deadline: the control
+                    # plane cannot recover in place — shut the engine down
+                    # cleanly, settling EVERY outstanding waiter with the
+                    # error (the elastic wrapper then restores +
+                    # re-rendezvouses; static jobs fail fast with HVD303
+                    # attribution instead of hanging).  MUST run before
+                    # this cycle's waiters are released below: a waiter
+                    # that wakes first reads engine.fault in
+                    # basics.shutdown() to pick the abrupt teardown — a
+                    # still-None fault would route a poisoned jax world
+                    # through the graceful shutdown barrier it can never
+                    # complete.
+                    self._abort_engine(exc, busy=bool(entries))
             for e in entries:
                 e.error = exc
                 self.queue.mark_done(e)

@@ -224,6 +224,7 @@ class InflightRing:
         self._cv = threading.Condition()
         self._items: deque = deque()
         self._stop = False
+        self._abort_error: Optional[BaseException] = None
         self.high_water = 0
         self.dispatched = 0
         self._thread = threading.Thread(
@@ -238,10 +239,23 @@ class InflightRing:
         with self._cv:
             while len(self._items) >= max(1, self.depth) and not self._stop:
                 self._cv.wait(0.1)
-            self._items.append((batch, results))
-            self.dispatched += 1
-            self.high_water = max(self.high_water, len(self._items))
-            self._cv.notify_all()
+            error = self._abort_error
+            if error is None:
+                # [batch, results, settled]: the flag is the settle claim —
+                # exactly one of watcher/abort flips it (under the lock)
+                # and runs the settler for this batch.
+                self._items.append([batch, results, False])
+                self.dispatched += 1
+                self.high_water = max(self.high_water, len(self._items))
+                self._cv.notify_all()
+                return
+        # Aborted while (or before) waiting for a window slot: the watcher
+        # may be wedged in a device wait that never returns — settle with
+        # the fault here rather than queueing into a dead window.
+        try:
+            self._settler(batch, results, error)
+        except BaseException:  # noqa: BLE001 - submit must not raise here
+            log.exception("in-flight abort settle failed")
 
     def flush(self, timeout: Optional[float] = None) -> bool:
         """Block until every submitted batch has settled."""
@@ -256,6 +270,36 @@ class InflightRing:
             self._cv.notify_all()
         self._thread.join(timeout=10)
 
+    def abort(self, error: BaseException):
+        """Fail every queued batch with ``error`` WITHOUT waiting on device
+        results, then stop accepting work.
+
+        The control-plane fault path (a dead peer mid-negotiation): device
+        results for already-dispatched batches may never materialize — a
+        cross-process collective whose participant died can block forever —
+        and the watcher itself may be wedged inside ``waiter`` on the head
+        batch for exactly as long.  So the window is drained and settled
+        HERE, on the aborting thread, including the batch the watcher is
+        blocked on.  Each batch is settled by exactly one thread: the
+        per-item claim flag is flipped under the lock, so a batch the
+        watcher already settled SUCCESSFULLY is skipped — a completed
+        collective must not retroactively report the fault.  A ``submit``
+        racing the abort settles its batch with the fault instead of
+        queueing it."""
+        with self._cv:
+            self._abort_error = error
+            self._stop = True
+            doomed = [it for it in self._items if not it[2]]
+            for it in doomed:
+                it[2] = True
+            self._items.clear()
+            self._cv.notify_all()
+        for batch, results, _ in doomed:
+            try:
+                self._settler(batch, results, error)
+            except BaseException:  # noqa: BLE001 - settle the rest anyway
+                log.exception("in-flight abort settle failed")
+
     def _watch(self):
         while True:
             with self._cv:
@@ -263,14 +307,28 @@ class InflightRing:
                     self._cv.wait(0.2)
                 if not self._items:
                     return          # stopped and drained
-                batch, results = self._items[0]
+                head = self._items[0]
+                batch, results = head[0], head[1]
+                abort_error = self._abort_error
             error = None
+            if abort_error is not None:
+                # Control-plane abort: settle with the fault, never block
+                # on device results that may not be coming.
+                error = abort_error
+            else:
+                try:
+                    self._waiter(results)
+                except BaseException as exc:  # noqa: BLE001 - fail waiters
+                    error = exc
+            # Claim the settle atomically: if abort() got here first (it
+            # can run while this thread is wedged in the device wait) the
+            # batch is already settled with the fault — do not re-settle.
+            with self._cv:
+                claimed = not head[2]
+                head[2] = True
             try:
-                self._waiter(results)
-            except BaseException as exc:  # noqa: BLE001 - fail the waiters
-                error = exc
-            try:
-                self._settler(batch, results, error)
+                if claimed:
+                    self._settler(batch, results, error)
             except BaseException:  # noqa: BLE001 - watcher must survive
                 # A raising settler would otherwise kill this thread and
                 # deadlock every later submit against a never-draining
@@ -283,5 +341,6 @@ class InflightRing:
                 # unsettled work (a popped-then-settling batch would let
                 # depth+1 launches pile up).
                 with self._cv:
-                    self._items.popleft()
+                    if self._items:
+                        self._items.popleft()
                     self._cv.notify_all()

@@ -111,6 +111,18 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                    help="Telemetry snapshot period in seconds (default 5)")
     p.add_argument("--stall-check-time", type=float, default=None)
     p.add_argument("--stall-shutdown-time", type=float, default=None)
+    p.add_argument("--round-timeout", type=float, default=None,
+                   help="Per-negotiation-round wall-clock deadline in "
+                        "seconds (docs/fault_tolerance.md): ranks that "
+                        "miss it are declared dead and survivors get a "
+                        "typed HVD303 abort; 0/unset disables the "
+                        "deadline (dead-socket detection is always on)")
+    p.add_argument("--connect-retries", type=int, default=None,
+                   help="Bounded controller-connect retries (workers may "
+                        "start before the coordinator)")
+    p.add_argument("--connect-backoff-ms", type=float, default=None,
+                   help="Base backoff between connect retries "
+                        "(exponential, jittered)")
     p.add_argument("--autotune", action="store_true")
     p.add_argument("--autotune-log-file", default=None)
     p.add_argument("--hierarchical-allreduce", action="store_true")
@@ -276,7 +288,10 @@ def tuning_env(args) -> Dict[str, str]:
             ("stall_check_time", "HOROVOD_STALL_CHECK_TIME", 1),
             ("stall_shutdown_time", "HOROVOD_STALL_SHUTDOWN_TIME", 1),
             ("monitor_port", "HOROVOD_MONITOR_PORT", 1),
-            ("monitor_interval", "HOROVOD_MONITOR_INTERVAL", 1)):
+            ("monitor_interval", "HOROVOD_MONITOR_INTERVAL", 1),
+            ("round_timeout", "HOROVOD_ROUND_TIMEOUT_S", 1),
+            ("connect_retries", "HOROVOD_CONNECT_RETRIES", 1),
+            ("connect_backoff_ms", "HOROVOD_CONNECT_BACKOFF_MS", 1)):
         val = getattr(args, flag, None)
         if val is not None:
             env[var] = str(int(val * scale) if scale != 1 else val)

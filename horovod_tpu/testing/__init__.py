@@ -1,0 +1,10 @@
+"""Deterministic test machinery for the control plane (no jax imports).
+
+This package must stay importable with jax hard-blocked — the tier-1
+purity guard in ``tests/test_monitor.py`` enforces it — because the fault
+points fire inside the controller's negotiation hot path and the
+acceptance workers arm them in processes that may not have a device
+backend at all.
+"""
+
+from .faults import FaultSpec, arm, armed, disarm, fire, spec  # noqa: F401

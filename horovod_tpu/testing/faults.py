@@ -1,0 +1,200 @@
+"""Deterministic fault-injection harness for the coordinator control plane.
+
+The reference proves its failure paths with integration tests that really
+kill workers (``test/integration/test_elastic_torch.py`` SIGKILLs a rank
+mid-epoch); reproducing that deterministically needs the kill to land at a
+*named protocol point*, not "roughly when the signal arrives".  This
+module provides those points: the controller (and anything else on the
+control plane) calls :func:`fire` at well-known places, and a single
+environment variable arms exactly one of them::
+
+    HVD_TPU_FAULT=<point>:<rank>:<action>[:<nth>]
+
+    point   connect        before the TCP connect to the coordinator
+            pre_announce   entering negotiate(), before building announces
+            round_send     before the request frame is written
+            mid_round_exit after the request is sent, before the response
+                           is read (a crash here is the classic
+                           "died mid-negotiation" shape: the server has
+                           this rank's frame, the rank is gone)
+            round_recv     before blocking for the response frame
+    rank    the rank the fault targets (other ranks never fire)
+    action  crash          os._exit(13) — an unclean process death
+            hang           sleep forever (bounded by _HANG_S; trips round
+                           deadlines / stall machinery)
+            delay_ms=N     sleep N milliseconds, then continue
+            econnreset     abruptly sever the controller connection (the
+                           caller passes the sever callback), then
+                           continue — the peer observes a dead socket
+    nth     fire on the nth arrival at that point (default 1); earlier
+            arrivals pass through untouched, later ones too (one-shot)
+
+Zero-cost when unarmed: :func:`armed` is a module-flag check, and the
+controller caches ``fire`` only when it returns True — an unarmed run
+never executes a single instruction of this module on the hot path (the
+steady-state frame guard in ``tests/test_response_cache.py`` additionally
+proves the wire carries zero extra bytes either way).
+
+No jax imports (tier-1 purity guard).  Thread-safe: the nth-counters are
+lock-guarded because fault points fire from the engine cycle thread while
+tests may arm/disarm from the main thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+ENV_VAR = "HVD_TPU_FAULT"
+
+POINTS = ("connect", "pre_announce", "round_send", "mid_round_exit",
+          "round_recv")
+ACTIONS = ("crash", "hang", "delay_ms", "econnreset")
+
+# Bounded "forever": long enough to trip any reasonable deadline, short
+# enough that a leaked daemon thread cannot outlive a CI job by much.
+_HANG_S = 3600.0
+
+_EXIT_CODE = 13  # distinct from rc=1 so tests can tell crash from bug
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One parsed ``HVD_TPU_FAULT`` directive."""
+    point: str
+    rank: int
+    action: str
+    arg: float = 0.0     # delay_ms payload
+    nth: int = 1
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        parts = text.strip().split(":")
+        if len(parts) not in (3, 4):
+            raise ValueError(
+                f"{ENV_VAR} must be <point>:<rank>:<action>[:<nth>], "
+                f"got {text!r}")
+        point, rank_s, action_s = parts[0], parts[1], parts[2]
+        nth = int(parts[3]) if len(parts) == 4 else 1
+        if point not in POINTS:
+            raise ValueError(
+                f"{ENV_VAR}: unknown fault point {point!r} "
+                f"(valid: {', '.join(POINTS)})")
+        arg = 0.0
+        if action_s.startswith("delay_ms="):
+            action = "delay_ms"
+            arg = float(action_s.split("=", 1)[1])
+        else:
+            action = action_s
+        if action not in ACTIONS:
+            raise ValueError(
+                f"{ENV_VAR}: unknown action {action_s!r} "
+                f"(valid: crash, hang, delay_ms=N, econnreset)")
+        if nth < 1:
+            raise ValueError(f"{ENV_VAR}: nth must be >= 1, got {nth}")
+        return cls(point=point, rank=int(rank_s), action=action, arg=arg,
+                   nth=nth)
+
+
+_lock = threading.Lock()
+_spec: Optional[FaultSpec] = None
+_counts: Dict[str, int] = {}
+_fired = False
+
+
+def _load_env() -> None:
+    global _spec
+    text = os.environ.get(ENV_VAR)
+    if text:
+        _spec = FaultSpec.parse(text)
+
+
+_load_env()
+
+
+def armed() -> bool:
+    """True when a fault directive is armed (env at import, or :func:`arm`).
+
+    Callers on hot paths should cache ``fire`` only when this is True —
+    the unarmed fast path then never enters this module at all."""
+    return _spec is not None
+
+
+def spec() -> Optional[FaultSpec]:
+    return _spec
+
+
+def arm(text_or_spec) -> FaultSpec:
+    """Arm a fault programmatically (tests); resets the nth-counters."""
+    global _spec, _fired
+    s = (text_or_spec if isinstance(text_or_spec, FaultSpec)
+         else FaultSpec.parse(text_or_spec))
+    with _lock:
+        _spec = s
+        _counts.clear()
+        _fired = False
+    return s
+
+
+def disarm() -> None:
+    global _spec, _fired
+    with _lock:
+        _spec = None
+        _counts.clear()
+        _fired = False
+
+
+def fired() -> bool:
+    """True once the armed fault has executed (tests assert determinism)."""
+    return _fired
+
+
+def fire(point: str, rank: int,
+         sever: Optional[Callable[[], None]] = None) -> None:
+    """Arrive at a named fault point; executes the armed action when this
+    is the spec'd (point, rank) and the spec'd nth arrival.
+
+    ``sever`` is the caller-supplied connection killer for ``econnreset``
+    (the socket lives behind the native library, so only the caller can
+    reach it); a point with no sever degrades to a logged no-op rather
+    than a surprise crash."""
+    global _fired
+    s = _spec
+    if s is None or s.point != point or s.rank != rank:
+        return
+    with _lock:
+        n = _counts.get(point, 0) + 1
+        _counts[point] = n
+        if n != s.nth or _fired:
+            return
+        _fired = True
+    log.warning("fault injection: %s at %s (rank %d, arrival %d)",
+                s.action, point, rank, n)
+    if s.action == "crash":
+        # Unclean death, bypassing atexit/finally — the honest simulation
+        # of a SIGKILL'd / OOM'd worker.  Flush what the test harness may
+        # be tailing first.
+        import sys
+        try:
+            sys.stdout.flush()
+            sys.stderr.flush()
+        except Exception:  # noqa: BLE001 - exiting anyway
+            pass
+        os._exit(_EXIT_CODE)
+    elif s.action == "hang":
+        time.sleep(_HANG_S)
+    elif s.action == "delay_ms":
+        time.sleep(s.arg / 1000.0)
+    elif s.action == "econnreset":
+        if sever is None:
+            log.warning("fault injection: econnreset at %s has no sever "
+                        "callback; ignoring", point)
+        else:
+            sever()

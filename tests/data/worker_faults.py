@@ -1,0 +1,213 @@
+"""Control-plane fault-tolerance acceptance worker (ISSUE 5's two-process
+proof).  Launched with ``HVD_TPU_FAULT=mid_round_exit:1:crash:<nth>`` so
+rank 1 dies UNCLEANLY (os._exit) at a deterministic protocol point — after
+its request frame is sent, before the response is read: the classic
+"died mid-negotiation" shape the pre-v4 control plane answered with an
+eternal recv.
+
+Two modes (``FAULT_MODE``):
+
+``static``   plain torovodrun -np 2.  Rank 0 must raise a typed HVD303
+             ``PeerFailureError`` naming rank 1 within
+             ``HOROVOD_ROUND_TIMEOUT_S`` — including for a waiter that was
+             already pending when the peer died (no wedged waiters, no
+             wedged InflightRing) — and new work must fail fast instead of
+             queueing.  Rank 0 records the proof in ``FAULT_RESULT``
+             (a file, not stdout: the launcher reaps survivors after the
+             crash and may truncate pipes).
+
+``elastic``  under the elastic driver (two single-slot "hosts" so the
+             crashed host can be blacklisted without killing the world).
+             The survivor catches the typed error, restores committed
+             state, re-initializes, re-rendezvouses into the shrunk
+             generation and finishes every epoch; the result file records
+             the caught exception types, reset count and final world size.
+"""
+
+import json
+import os
+import time
+
+# One rank per process, one CPU device each; gloo for cross-process XLA
+# collectives (same preamble as worker_collectives.py).
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+from horovod_tpu.common.exceptions import (
+    HorovodInternalError, PeerFailureError,
+)
+
+RESULT = os.environ.get("FAULT_RESULT", "")
+
+
+def _write_result(payload: dict):
+    tmp = RESULT + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, RESULT)   # atomic: the test never reads a torn file
+
+
+def main_static():
+    hvd.init()
+    rank = hvd.rank()
+    eng = basics._get_state().engine
+    round_timeout = float(os.environ.get("HOROVOD_ROUND_TIMEOUT_S", "30"))
+    pending = None
+    if rank == 0:
+        # A waiter that can never complete normally (rank 1 never submits
+        # this name): the engine's clean shutdown must settle it with the
+        # fault — THE "no wedged waiters" assertion.
+        pending = hvd.allreduce_async(np.ones(4, np.float32),
+                                      name="never.ready", op=hvd.Sum)
+    t_step = time.monotonic()
+    try:
+        for k in range(100000):
+            t_step = time.monotonic()
+            out = hvd.allreduce(np.ones(2, np.float32), name="grad",
+                                op=hvd.Sum)
+            np.testing.assert_allclose(
+                np.asarray(hvd.to_local(out)).reshape(2),
+                np.full(2, float(hvd.size()), np.float32))
+        raise AssertionError("fault never fired")
+    except (PeerFailureError, ValueError) as exc:
+        # The crash can surface on the blocking step through either plane,
+        # whichever loses the race: the typed control-plane abort
+        # (PeerFailureError), or — when the dead rank's FINAL frame made a
+        # collective ready that it never executed — the data-plane
+        # collective failing underneath XLA (ValueError from the gloo
+        # transport here; the analogous ICI failure on TPU).  Either way
+        # the CONTROL plane must converge on the typed verdict within the
+        # round deadline, delivered through every outstanding waiter:
+        first_error = type(exc).__name__
+        assert rank == 0, "only the survivor should get this far"
+        try:
+            eng.synchronize(pending, timeout=round_timeout)
+            raise AssertionError("never.ready completed?!")
+        except PeerFailureError as verdict:
+            typed = verdict
+        elapsed = time.monotonic() - t_step
+        assert typed.dead_ranks == [1], typed.dead_ranks
+        assert "HVD303" in str(typed), str(typed)
+        assert elapsed < round_timeout, (
+            f"typed verdict took {elapsed:.1f}s against a {round_timeout}s "
+            f"round deadline")
+        # New work fails fast instead of queueing into a dead world.
+        t0 = time.monotonic()
+        try:
+            hvd.allreduce(np.ones(2, np.float32), name="after.death",
+                          op=hvd.Sum)
+            raise AssertionError("post-fault enqueue did not fail")
+        except (PeerFailureError, RuntimeError):
+            pass
+        assert time.monotonic() - t0 < 5
+        _write_result({"ok": True, "mode": "static",
+                       "dead_ranks": typed.dead_ranks,
+                       "hvd303": "HVD303" in str(typed),
+                       "first_error": first_error,
+                       "elapsed_s": round(elapsed, 3)})
+        print("FAULT_STATIC_OK", flush=True)
+    # rank 1 never reaches here (os._exit inside the fault point).
+
+
+def _control_plane_verdict(exc, grace_s: float = 10.0):
+    """Resolve an exception from a blocking collective against the
+    engine's control-plane verdict.
+
+    A dying peer races two planes: the typed HVD303 abort (control), and
+    the in-flight device collective failing underneath XLA (data — a gloo
+    ValueError here, the analogous ICI failure on TPU).  When the data
+    plane loses a peer, the engine's fault latch converges within the
+    round deadline — so wait for it, and treat the exception as a
+    world-failure only when the control plane confirms; anything else is
+    a genuine application bug and re-raises."""
+    if isinstance(exc, HorovodInternalError):
+        return exc
+    eng = basics._get_state().engine
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline:
+        fault = getattr(eng, "fault", None)
+        if fault is not None:
+            return fault
+        time.sleep(0.05)
+    return None
+
+
+def main_elastic():
+    from horovod_tpu.elastic import worker as elastic_worker
+    from horovod_tpu.elastic.state import HostsUpdatedInterrupt, ObjectState
+
+    epochs = int(os.environ.get("FAULT_EPOCHS", "8"))
+    steps = int(os.environ.get("FAULT_STEPS_PER_EPOCH", "150"))
+    hvd.init()
+    caught = []
+    resets = {"n": 0}
+    state = ObjectState(epoch=0)
+    elastic_worker.attach_notification_manager(state)
+
+    # Manual retry loop (the @hvd.elastic.run control flow, unrolled so the
+    # test can record WHICH exception type triggered each reset — the
+    # wrapper swallows it).
+    while True:
+        try:
+            state.sync()
+            while state.epoch < epochs:
+                # A burst of BLOCKING allreduces per epoch: every one
+                # forces at least one lock-step negotiation round, so the
+                # nth-armed fault (a ROUND count) fires at a work-
+                # determined point mid-run.  Pacing off the idle cycle
+                # tick instead would be wall-clock flaky: on a loaded
+                # machine all epochs can complete before the idle rounds
+                # ever reach nth, and the fault would never fire.
+                for i in range(steps):
+                    contrib = np.full((2,), float(hvd.rank() + 1),
+                                      np.float32)
+                    out = hvd.to_local(hvd.allreduce(
+                        contrib, name=f"epoch.{state.epoch}.s{i}",
+                        op=hvd.Sum))
+                    expected = sum(r + 1.0 for r in range(hvd.size()))
+                    np.testing.assert_allclose(
+                        out, np.full((2,), expected))
+                state.epoch += 1
+                state.commit()      # host-update check may raise here
+                time.sleep(0.1)
+            break
+        except HostsUpdatedInterrupt:
+            caught.append(["HostsUpdatedInterrupt", []])
+        except Exception as exc:  # noqa: BLE001 - resolved below
+            verdict = _control_plane_verdict(exc)
+            if verdict is None:
+                raise               # a real bug, not a dead peer
+            caught.append([type(verdict).__name__,
+                           list(getattr(verdict, "dead_ranks", []))])
+            state.restore()
+        resets["n"] += 1
+        # Reset: tear the world down, re-init (which re-rendezvouses into
+        # the next generation over the surviving host set).
+        basics.shutdown()
+        basics.init()
+
+    if hvd.rank() == 0:
+        _write_result({"ok": True, "mode": "elastic",
+                       "epochs": state.epoch, "final_size": hvd.size(),
+                       "resets": resets["n"], "caught": caught})
+        print("FAULT_ELASTIC_OK", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    mode = os.environ.get("FAULT_MODE", "static")
+    assert RESULT, "FAULT_RESULT must point at a writable path"
+    if mode == "elastic":
+        main_elastic()
+    else:
+        main_static()

@@ -139,6 +139,122 @@ def test_rendezvous_publish_fetch_versioning():
         s.stop()
 
 
+def test_rendezvous_rollback_to_surviving_host_set():
+    """The PeerFailureError recovery path's rendezvous half: a worker that
+    reset after a dead peer long-polls for a STRICTLY newer generation and
+    lands in the shrunk world — never re-joins the stale one, and a dead
+    identity gets nothing from the new table."""
+    s = RendezvousServer()
+    try:
+        s.publish({"a:0": {"rank": 0, "size": 2},
+                   "b:0": {"rank": 1, "size": 2}})
+        a = fetch_assignment("127.0.0.1", s.port, "a:0", timeout_s=5)
+        assert a["size"] == 2 and a["version"] == 1
+        # b:0 died; the driver republished over the survivors only.
+        v2 = s.publish({"a:0": {"rank": 0, "size": 1}})
+        a = fetch_assignment("127.0.0.1", s.port, "a:0",
+                             min_version=a["version"] + 1, timeout_s=5)
+        assert a["size"] == 1 and a["rank"] == 0 and a["version"] == v2
+        # The dead identity is gone from the new generation.
+        with pytest.raises(TimeoutError):
+            fetch_assignment("127.0.0.1", s.port, "b:0", min_version=v2,
+                             timeout_s=1.0)
+    finally:
+        s.stop()
+
+
+# -------------------------------------------- state restore/rollback paths
+def _identity_bcast(obj, root_rank=0):
+    return obj
+
+
+def test_object_state_restore_after_peer_failure_byte_identical():
+    """State.restore() after a simulated PeerFailureError must roll every
+    registered attribute back to the last commit, byte-identically — the
+    half of elastic recovery that runs before re-rendezvous."""
+    import pickle
+
+    from horovod_tpu.common.exceptions import PeerFailureError
+    from horovod_tpu.elastic.state import ObjectState
+
+    state = ObjectState(bcast_object=_identity_bcast,
+                        epoch=3, batch=7,
+                        table={"w": [1.0, 2.0], "meta": {"k": (1, 2)}})
+    state.commit()
+    committed = pickle.dumps((state.epoch, state.batch, state.table))
+    # Mutate mid-epoch (including a nested structure), then fail.
+    state.epoch = 4
+    state.batch = 0
+    state.table["w"].append(3.0)
+    state.table["meta"]["k"] = (9,)
+    try:
+        raise PeerFailureError("HVD303 peer died", dead_ranks=[1])
+    except PeerFailureError:
+        state.restore()
+    assert pickle.dumps((state.epoch, state.batch, state.table)) == committed
+    # Restore hands back COPIES: mutating post-restore state must not
+    # corrupt the saved snapshot a second restore depends on.
+    state.table["w"].append(99.0)
+    state.restore()
+    assert pickle.dumps((state.epoch, state.batch, state.table)) == committed
+
+
+def test_jax_state_restore_after_peer_failure_byte_identical():
+    """JaxState: pytree leaves committed to host memory restore to device
+    byte-identically after a control-plane fault."""
+    import numpy as np
+
+    from horovod_tpu.common.exceptions import PeerFailureError
+    from horovod_tpu.elastic.state import JaxState
+
+    params = {"w": np.arange(8, dtype=np.float32).reshape(2, 4),
+              "b": np.float32(0.5)}
+    state = JaxState(bcast_object=_identity_bcast, params=params, step=11)
+    state.commit()
+    committed = {k: np.asarray(v).tobytes()
+                 for k, v in state.params.items()}
+    state.params = {"w": state.params["w"] * 2.0,
+                    "b": state.params["b"] + 1.0}
+    state.step = 12
+    try:
+        raise PeerFailureError("HVD303 peer died", dead_ranks=[0])
+    except PeerFailureError:
+        state.restore()
+    assert state.step == 11
+    for k, blob in committed.items():
+        assert np.asarray(state.params[k]).tobytes() == blob, k
+
+
+def test_run_wrapper_resets_on_peer_failure(monkeypatch):
+    """@hvd.elastic.run over a step that hits a PeerFailureError once:
+    restore-to-commit, runtime reset, retry — and completion on the second
+    attempt (the re-rendezvous itself is covered by the integration
+    tier)."""
+    from horovod_tpu.common import basics
+    from horovod_tpu.common.exceptions import PeerFailureError
+    from horovod_tpu.elastic.state import ObjectState, run
+
+    resets = []
+    monkeypatch.setattr(basics, "shutdown", lambda: resets.append("down"))
+    monkeypatch.setattr(basics, "init", lambda: resets.append("up"))
+
+    attempts = []
+
+    @run
+    def train(state):
+        attempts.append(state.epoch)
+        if len(attempts) == 1:
+            state.epoch = 99          # uncommitted progress, must roll back
+            raise PeerFailureError("HVD303 peer died", dead_ranks=[1])
+        return state.epoch
+
+    state = ObjectState(bcast_object=_identity_bcast, epoch=5)
+    state.commit()
+    assert train(state) == 5
+    assert attempts == [5, 5], "restore did not roll back to the commit"
+    assert resets == ["down", "up"], "runtime was not reset between tries"
+
+
 # ------------------------------------------------- driver process lifecycle
 @pytest.mark.slow
 def test_driver_success_on_worker_exit_zero():
@@ -398,3 +514,38 @@ def test_elastic_rendezvous_addr_routable_for_remote_hosts(monkeypatch):
         drv2.rendezvous.stop()
     finally:
         drv.rendezvous.stop()
+
+
+# ------------------------------------------------- post-fault exit guard
+def _run_guarded(tail: str) -> subprocess.CompletedProcess:
+    src = (
+        "import atexit, sys\n"
+        "atexit.register(lambda: print('EARLY_HOOK_RAN', flush=True))\n"
+        "from horovod_tpu.elastic import worker\n"
+        "worker._install_exit_guard()\n"
+        + tail)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + env.get("PYTHONPATH", "").split(os.pathsep))
+    return subprocess.run([sys.executable, "-c", src], env=env,
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_exit_guard_preserves_exit_codes_and_early_atexit_hooks():
+    """The post-fault exit guard ends the process via os._exit (parked
+    jax worlds must not reach interpreter finalization), but it must not
+    LAUNDER failures into successes: the elastic driver judges workers
+    by exit code.  Uncaught SystemExit never reaches sys.excepthook, so
+    sys.exit(3) needs the guard's sys.exit wrap to survive; and atexit
+    hooks registered before the fault (coverage writers...) still run."""
+    res = _run_guarded("sys.exit(3)")
+    assert res.returncode == 3, (res.returncode, res.stdout, res.stderr)
+    assert "EARLY_HOOK_RAN" in res.stdout, (res.stdout, res.stderr)
+
+    res = _run_guarded("raise RuntimeError('worker failed')")
+    assert res.returncode == 1, (res.returncode, res.stdout, res.stderr)
+    assert "EARLY_HOOK_RAN" in res.stdout, (res.stdout, res.stderr)
+
+    res = _run_guarded("print('work done', flush=True)")
+    assert res.returncode == 0, (res.returncode, res.stdout, res.stderr)
+    assert "EARLY_HOOK_RAN" in res.stdout, (res.stdout, res.stderr)

@@ -1,0 +1,909 @@
+"""Control-plane fault tolerance (tier-1, no subprocess spawns).
+
+Covers: the typed exception taxonomy, the retry/backoff helper, the
+fault-injection harness (``horovod_tpu/testing/faults.py``), the protocol
+v4 liveness machinery through REAL native server + client threads
+(dead-peer abort, round deadline, client recv timeout, connect retries),
+the engine's clean-shutdown invariants (``InflightRing.abort``), and the
+monitor agent's HVD303 enrichment + ``/health`` ``peer_dead`` reporting.
+The cross-process acceptance lives in ``tests/test_multiprocess.py``
+(``worker_faults.py``).
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.common.controller import TCPController
+from horovod_tpu.common.exceptions import (
+    ControlPlaneError, HorovodInternalError, JoinTimeoutError,
+    PeerFailureError, RoundTimeoutError,
+)
+from horovod_tpu.common.net import retry_with_backoff
+from horovod_tpu.testing import faults
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """Every test starts and ends unarmed — an armed leak would make the
+    controller cache the fire hook in unrelated tests."""
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+# ------------------------------------------------------------- exceptions
+def test_exception_taxonomy():
+    """PeerFailureError / RoundTimeoutError are ControlPlaneErrors are
+    HorovodInternalErrors — the elastic wrapper's catch covers all of
+    them; JoinTimeoutError is a TimeoutError (pre-existing handlers keep
+    working)."""
+    assert issubclass(PeerFailureError, ControlPlaneError)
+    assert issubclass(RoundTimeoutError, ControlPlaneError)
+    assert issubclass(ControlPlaneError, HorovodInternalError)
+    assert issubclass(HorovodInternalError, RuntimeError)
+    assert issubclass(JoinTimeoutError, TimeoutError)
+    exc = PeerFailureError("boom", dead_ranks=[3, 1], reason="died")
+    assert exc.dead_ranks == [1, 3] and exc.reason == "died"
+    # The legacy import path still resolves (re-export contract).
+    from horovod_tpu.elastic.state import (
+        HorovodInternalError as legacy, PeerFailureError as legacy_pf)
+    assert legacy is HorovodInternalError and legacy_pf is PeerFailureError
+
+
+# ---------------------------------------------------------- retry/backoff
+def test_retry_with_backoff_succeeds_after_failures():
+    calls = []
+    delays = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    out = retry_with_backoff(fn, retries=4, base_ms=1.0, max_ms=4.0,
+                             jitter=0.0,
+                             on_retry=lambda a, e, d: delays.append(d))
+    assert out == "ok" and len(calls) == 3
+    # Exponential schedule: 1ms then 2ms (jitter disabled).
+    assert delays == [0.001, 0.002]
+
+
+def test_retry_with_backoff_exhausts_and_reraises():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_with_backoff(fn, retries=2, base_ms=1.0, jitter=0.0)
+    assert len(calls) == 3      # 1 initial + 2 retries
+
+
+def test_retry_with_backoff_caps_delay():
+    delays = []
+
+    def fn():
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_with_backoff(fn, retries=5, base_ms=1.0, max_ms=2.0,
+                           jitter=0.0,
+                           on_retry=lambda a, e, d: delays.append(d))
+    assert max(delays) <= 0.002 + 1e-9
+
+
+# ------------------------------------------------------ fault-spec parsing
+def test_fault_spec_parse_forms():
+    s = faults.FaultSpec.parse("mid_round_exit:1:crash")
+    assert (s.point, s.rank, s.action, s.nth) == ("mid_round_exit", 1,
+                                                  "crash", 1)
+    s = faults.FaultSpec.parse("round_send:0:delay_ms=250:7")
+    assert s.action == "delay_ms" and s.arg == 250.0 and s.nth == 7
+    s = faults.FaultSpec.parse("connect:2:hang")
+    assert s.point == "connect" and s.action == "hang"
+
+
+@pytest.mark.parametrize("bad", [
+    "nope",                       # too few fields
+    "badpoint:1:crash",           # unknown point
+    "round_send:1:explode",       # unknown action
+    "round_send:1:crash:0",       # nth < 1
+    "round_send:1:crash:2:extra", # too many fields
+])
+def test_fault_spec_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        faults.FaultSpec.parse(bad)
+
+
+def test_fire_is_noop_when_unarmed_and_rank_gated():
+    assert not faults.armed()
+    faults.fire("round_send", 0)          # no spec: must be a no-op
+    faults.arm("round_send:1:delay_ms=1")
+    faults.fire("round_send", 0)          # wrong rank
+    faults.fire("pre_announce", 1)        # wrong point
+    assert not faults.fired()
+    faults.fire("round_send", 1)
+    assert faults.fired()
+
+
+def test_fire_nth_semantics_one_shot():
+    fired_at = []
+    faults.arm("round_recv:0:delay_ms=1:3")
+    for i in range(5):
+        faults.fire("round_recv", 0)
+        if faults.fired() and not fired_at:
+            fired_at.append(i)
+    assert fired_at == [2]                # 3rd arrival, zero-indexed 2
+
+
+def test_fire_econnreset_calls_sever():
+    severed = []
+    faults.arm("round_send:0:econnreset")
+    faults.fire("round_send", 0, sever=lambda: severed.append(1))
+    assert severed == [1]
+    # One-shot: a later arrival does not sever again.
+    faults.fire("round_send", 0, sever=lambda: severed.append(2))
+    assert severed == [1]
+
+
+# ------------------------------------- v4 liveness through the real server
+def test_dead_peer_socket_gets_typed_abort():
+    """Rank 1's connection dies mid-run (econnreset fault); rank 0 raises
+    HVD303 PeerFailureError naming rank 1 — instead of the pre-v4 forever-
+    blocked recv."""
+    faults.arm("round_send:1:econnreset:3")
+    port = _free_port()
+    res = {}
+
+    def worker(rank):
+        ctl = TCPController("127.0.0.1", port, rank=rank, world=2,
+                            stall_warn_s=60.0)
+        try:
+            try:
+                for _ in range(10):
+                    ctl.negotiate([])
+                res[rank] = "no error"
+            except PeerFailureError as exc:
+                res[rank] = ("peer_failure", exc.dead_ranks,
+                             "HVD303" in str(exc))
+            except HorovodInternalError:
+                res[rank] = ("internal",)   # the severed rank's own view
+        finally:
+            if rank == 0:
+                deadline = time.time() + 20
+                while len(res) < 2 and time.time() < deadline:
+                    time.sleep(0.01)
+            ctl.shutdown()
+
+    t1 = threading.Thread(target=worker, args=(1,), daemon=True)
+    t1.start()
+    worker(0)
+    t1.join(20)
+    assert res[0] == ("peer_failure", [1], True), res
+    assert res[1][0] in ("internal", "peer_failure"), res
+
+
+def test_dead_peer_in_round_one_still_gets_typed_abort():
+    """Failure-at-startup attribution: rank 1 dies before sending its very
+    FIRST frame — the server hasn't processed anyone's FLT1 capability ad
+    yet (ads ride the round-1 frames, processed only after a full gather),
+    so it must latch the ads from the already-gathered frames before
+    broadcasting, or every survivor would get the untyped legacy rc=-1
+    instead of HVD303 with the dead-rank list.  Rank 0's first frame is
+    deliberately DELAYED past rank 1's death: the server's bounded grace
+    drain must hold the abort until the survivor's ad is in hand (an
+    immediate broadcast would find no FLT1 to deliver it to)."""
+    faults.arm("round_send:1:econnreset:1")
+    port = _free_port()
+    res = {}
+
+    def worker(rank):
+        ctl = TCPController("127.0.0.1", port, rank=rank, world=2,
+                            stall_warn_s=60.0)
+        try:
+            try:
+                if rank == 0:
+                    time.sleep(0.5)   # rank 1 is long dead by now
+                for _ in range(10):
+                    ctl.negotiate([])
+                res[rank] = "no error"
+            except PeerFailureError as exc:
+                res[rank] = ("peer_failure", exc.dead_ranks,
+                             "HVD303" in str(exc))
+            except HorovodInternalError:
+                res[rank] = ("internal",)   # the severed rank's own view
+        finally:
+            if rank == 0:
+                deadline = time.time() + 20
+                while len(res) < 2 and time.time() < deadline:
+                    time.sleep(0.01)
+            ctl.shutdown()
+
+    t1 = threading.Thread(target=worker, args=(1,), daemon=True)
+    t1.start()
+    worker(0)
+    t1.join(20)
+    assert res[0] == ("peer_failure", [1], True), res
+    assert res[1][0] in ("internal", "peer_failure"), res
+
+
+def test_round_deadline_declares_silent_rank_dead():
+    """Rank 1 stops negotiating (socket open, process 'hung'): the server's
+    per-round deadline — armed at rank 0's frame — declares it dead and
+    rank 0 gets the abort within ~the deadline, not never."""
+    port = _free_port()
+    res = {}
+    release = threading.Event()
+
+    def worker(rank):
+        ctl = TCPController("127.0.0.1", port, rank=rank, world=2,
+                            stall_warn_s=60.0, round_timeout_s=1.0)
+        try:
+            if rank == 1:
+                ctl.negotiate([])
+                ctl.negotiate([])
+                release.wait(20)          # silent: no further rounds
+                res[1] = "done"
+            else:
+                t0 = time.monotonic()
+                try:
+                    for _ in range(10):
+                        ctl.negotiate([])
+                    res[0] = "no error"
+                except PeerFailureError as exc:
+                    res[0] = ("deadline", exc.dead_ranks,
+                              "deadline" in str(exc),
+                              time.monotonic() - t0)
+        finally:
+            if rank == 0:
+                deadline = time.time() + 25
+                while 0 not in res and time.time() < deadline:
+                    time.sleep(0.01)
+            release.set()
+            ctl.shutdown()
+
+    t1 = threading.Thread(target=worker, args=(1,), daemon=True)
+    t1.start()
+    worker(0)
+    t1.join(25)
+    kind, dead, named, dt = res[0]
+    assert kind == "deadline" and dead == [1] and named, res
+    assert dt < 6.0, f"abort took {dt}s against a 1s deadline"
+
+
+def test_client_round_timeout_against_wedged_server():
+    """The coordinator accepts frames but never answers: the client's own
+    wall-clock deadline (2x HOROVOD_ROUND_TIMEOUT_S) fires as a typed
+    RoundTimeoutError instead of blocking forever."""
+    port = _free_port()
+    lsock = socket.socket()
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", port))
+    lsock.listen(2)
+
+    def mute_server():
+        try:
+            conn, _ = lsock.accept()
+            conn.recv(4)                      # rank handshake
+            while True:
+                hdr = conn.recv(4)
+                if not hdr:
+                    return
+                (n,) = struct.unpack("<I", hdr)
+                got = b""
+                while len(got) < n:
+                    chunk = conn.recv(n - len(got))
+                    if not chunk:
+                        return
+                    got += chunk
+                # swallow the frame; never respond
+        except OSError:
+            pass
+
+    t = threading.Thread(target=mute_server, daemon=True)
+    t.start()
+    ctl = TCPController("127.0.0.1", port, rank=1, world=2,
+                        stall_warn_s=60.0, round_timeout_s=0.5)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(RoundTimeoutError) as ei:
+            ctl.negotiate([])
+        dt = time.monotonic() - t0
+        assert 0.8 < dt < 6.0, dt
+        assert "HVD303" in str(ei.value)
+        assert ei.value.timeout_s == pytest.approx(1.0)
+    finally:
+        ctl.shutdown()
+        lsock.close()
+
+
+def test_client_round_timeout_against_mid_frame_wedged_server():
+    """The coordinator wedges MID-frame (length prefix written, payload
+    never arrives): the client deadline must bound the whole frame read,
+    not just its first byte — otherwise poll() sees POLLIN and the recv
+    blocks forever, the exact pre-v4 wedge the timeout documents away."""
+    port = _free_port()
+    lsock = socket.socket()
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", port))
+    lsock.listen(2)
+    hold = []
+
+    def prefix_only_server():
+        try:
+            conn, _ = lsock.accept()
+            hold.append(conn)                 # keep the socket open
+            conn.recv(4)                      # rank handshake
+            hdr = conn.recv(4)
+            if not hdr:
+                return
+            (n,) = struct.unpack("<I", hdr)
+            got = b""
+            while len(got) < n:
+                chunk = conn.recv(n - len(got))
+                if not chunk:
+                    return
+                got += chunk
+            conn.sendall(struct.pack("<I", 100))  # prefix, then silence
+        except OSError:
+            pass
+
+    t = threading.Thread(target=prefix_only_server, daemon=True)
+    t.start()
+    ctl = TCPController("127.0.0.1", port, rank=1, world=2,
+                        stall_warn_s=60.0, round_timeout_s=0.5)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(RoundTimeoutError) as ei:
+            ctl.negotiate([])
+        dt = time.monotonic() - t0
+        assert 0.8 < dt < 6.0, dt
+        assert "HVD303" in str(ei.value)
+    finally:
+        ctl.shutdown()
+        lsock.close()
+
+
+def test_coordinator_death_mid_round_raises_typed_unattributed():
+    """The COORDINATOR itself dies mid-round (socket closed, no abort
+    verdict ever sent): the client must still raise a typed
+    PeerFailureError — empty dead_ranks, since nothing attributed the
+    death — so the engine runs its clean abort instead of wedging the
+    InflightRing behind a plain HorovodInternalError."""
+    port = _free_port()
+    lsock = socket.socket()
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", port))
+    lsock.listen(2)
+
+    def vanishing_server():
+        try:
+            conn, _ = lsock.accept()
+            conn.recv(4)                      # rank handshake
+            hdr = conn.recv(4)                # round-1 frame prefix
+            if hdr:
+                (n,) = struct.unpack("<I", hdr)
+                got = b""
+                while len(got) < n:
+                    chunk = conn.recv(n - len(got))
+                    if not chunk:
+                        break
+                    got += chunk
+            conn.close()                      # die without answering
+        except OSError:
+            pass
+
+    t = threading.Thread(target=vanishing_server, daemon=True)
+    t.start()
+    ctl = TCPController("127.0.0.1", port, rank=1, world=2,
+                        stall_warn_s=60.0, round_timeout_s=2.0)
+    try:
+        with pytest.raises(PeerFailureError) as ei:
+            ctl.negotiate([])
+        assert ei.value.dead_ranks == []
+        assert "HVD303" in str(ei.value)
+    finally:
+        ctl.shutdown()
+        lsock.close()
+
+
+def test_round_deadline_covers_mid_frame_wedge():
+    """Rank 1 wedges mid-frame-write (length prefix sent, payload never
+    comes): poll() reports it readable, so the gather's frame read itself
+    must be deadline-bounded — rank 0 still gets the typed ABORT naming
+    rank 1 instead of the whole control plane blocking in read_frame."""
+    port = _free_port()
+    res = {}
+
+    def wedged_rank1():
+        # Raw client: handshake, then only the length prefix of its
+        # round-1 frame.  The socket stays open ('hung', not crashed).
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                s = socket.create_connection(("127.0.0.1", port), timeout=2)
+                break
+            except OSError:
+                time.sleep(0.05)
+        else:
+            return
+        try:
+            s.sendall(struct.pack("<I", 1))       # rank id
+            s.sendall(struct.pack("<I", 64))      # frame prefix, no payload
+            while 0 not in res and time.time() < deadline:
+                time.sleep(0.01)
+        finally:
+            s.close()
+
+    t1 = threading.Thread(target=wedged_rank1, daemon=True)
+    t1.start()
+    ctl = TCPController("127.0.0.1", port, rank=0, world=2,
+                        stall_warn_s=60.0, round_timeout_s=1.0)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(PeerFailureError) as ei:
+            for _ in range(10):
+                ctl.negotiate([])
+        dt = time.monotonic() - t0
+        res[0] = "aborted"
+        assert ei.value.dead_ranks == [1]
+        assert "deadline" in str(ei.value)
+        assert dt < 6.0, f"abort took {dt}s against a 1s deadline"
+    finally:
+        res.setdefault(0, "failed")
+        ctl.shutdown()
+        t1.join(25)
+
+
+def test_connect_retries_cover_late_server_start():
+    """Workers may start before the coordinator: the bounded-retry connect
+    keeps attempting (with backoff) until the server appears."""
+    port = _free_port()
+    res = {}
+
+    def late_rank0():
+        time.sleep(1.0)
+        ctl = TCPController("127.0.0.1", port, rank=0, world=2,
+                            stall_warn_s=60.0)
+        try:
+            ctl.negotiate([])
+            res[0] = "ok"
+            deadline = time.time() + 20
+            while 1 not in res and time.time() < deadline:
+                time.sleep(0.01)
+        finally:
+            ctl.shutdown()
+
+    t0 = threading.Thread(target=late_rank0, daemon=True)
+    t0.start()
+    # Short per-attempt budget forces actual retries before rank 0's
+    # server exists.
+    ctl = TCPController("127.0.0.1", port, rank=1, world=2,
+                        stall_warn_s=60.0, connect_timeout_ms=8000,
+                        connect_retries=6, connect_backoff_ms=50.0)
+    try:
+        ctl.negotiate([])
+        res[1] = "ok"
+    finally:
+        ctl.shutdown()
+    t0.join(25)
+    assert res == {0: "ok", 1: "ok"}
+
+
+def test_connect_exhaustion_raises_runtime_error():
+    port = _free_port()   # nothing listening, ever
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="failed to connect"):
+        TCPController("127.0.0.1", port, rank=1, world=2,
+                      stall_warn_s=60.0, connect_timeout_ms=2000,
+                      connect_retries=1, connect_backoff_ms=10.0)
+    assert time.monotonic() - t0 < 30
+
+
+# ------------------------------------------------------ join_wait contract
+def test_join_wait_raises_typed_timeout():
+    """join_wait either returns the last joining rank or raises
+    JoinTimeoutError — never a sentinel (satellite contract)."""
+    port = _free_port()
+    res = {}
+    release = threading.Event()
+
+    def worker(rank):
+        ctl = TCPController("127.0.0.1", port, rank=rank, world=2,
+                            stall_warn_s=60.0)
+        try:
+            if rank == 0:
+                ctl.request_join()
+                ctl.negotiate([])             # join announced; peer has not
+                with pytest.raises(JoinTimeoutError):
+                    ctl.join_wait(timeout=0.2)
+                res[0] = "typed"
+                release.set()
+            else:
+                ctl.negotiate([])             # participates but never joins
+                release.wait(20)
+                res[1] = "done"
+        finally:
+            if rank == 0:
+                deadline = time.time() + 20
+                while 1 not in res and time.time() < deadline:
+                    time.sleep(0.01)
+            ctl.shutdown()
+
+    t1 = threading.Thread(target=worker, args=(1,), daemon=True)
+    t1.start()
+    worker(0)
+    t1.join(20)
+    assert res == {0: "typed", 1: "done"}
+
+
+def test_fail_join_releases_blocked_join_waiter():
+    """Part of the no-waiter-may-hang invariant: ``hvd.join()``'s default
+    is ``timeout=None``, and the all-joined verdict can never arrive from
+    a dead control plane — ``fail_join`` must release the blocked waiter
+    with the typed fault, and stay sticky for every later ``join_wait``
+    (this controller generation is dead)."""
+    port = _free_port()
+    res = {}
+    release = threading.Event()
+    fault = PeerFailureError("HVD303 join test", dead_ranks=[1])
+
+    def worker(rank):
+        ctl = TCPController("127.0.0.1", port, rank=rank, world=2,
+                            stall_warn_s=60.0)
+        try:
+            if rank == 0:
+                ctl.request_join()
+                ctl.negotiate([])        # join announced; peer never joins
+                got = {}
+
+                def waiter():
+                    try:
+                        ctl.join_wait(None)   # hvd.join() default: forever
+                    except PeerFailureError as exc:
+                        got["exc"] = exc
+
+                t = threading.Thread(target=waiter, daemon=True)
+                t.start()
+                time.sleep(0.2)          # waiter is parked on _join_event
+                ctl.fail_join(fault)
+                t.join(10)
+                assert not t.is_alive(), "join waiter still blocked"
+                assert got.get("exc") is fault
+                with pytest.raises(PeerFailureError):   # sticky
+                    ctl.join_wait(timeout=1)
+                res[0] = "typed"
+                release.set()
+            else:
+                ctl.negotiate([])        # participates but never joins
+                release.wait(20)
+                res[1] = "done"
+        finally:
+            if rank == 0:
+                deadline = time.time() + 20
+                while 1 not in res and time.time() < deadline:
+                    time.sleep(0.01)
+            ctl.shutdown()
+
+    t1 = threading.Thread(target=worker, args=(1,), daemon=True)
+    t1.start()
+    worker(0)
+    t1.join(20)
+    assert res == {0: "typed", 1: "done"}
+
+
+# -------------------------------------------- engine-side abort invariants
+def test_inflight_ring_abort_settles_without_device_wait():
+    """InflightRing.abort fails every queued batch with the fault WITHOUT
+    calling the waiter — including the batch the watcher is currently
+    blocked on.  On a real TPU a collective whose participant died can
+    block ``jax.block_until_ready`` forever, so the abort must settle the
+    whole window from the aborting thread; waiting for the wedged waiter
+    to return (it may never) would hang every waiter on the head batch."""
+    from horovod_tpu.ops.scheduler import InflightRing
+    settled = []
+    waited = []
+    gate = threading.Event()
+
+    def waiter(results):
+        waited.append(results)
+        gate.wait(10)     # simulates a device wait that never completes
+
+    ring = InflightRing(waiter, lambda b, r, e: settled.append((b, e)),
+                        depth=4)
+    try:
+        ring.submit(["b0"], "r0")
+        time.sleep(0.1)                       # watcher picks up b0
+        ring.submit(["b1"], "r1")
+        ring.submit(["b2"], "r2")
+        fault = PeerFailureError("dead", dead_ranks=[1])
+        ring.abort(fault)
+        # NOTE: the gate stays CLOSED — the watcher is still wedged in
+        # b0's device wait, yet every batch (b0 included) must settle.
+        deadline = time.time() + 5
+        while len(settled) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(settled) == 3, settled
+        assert waited == ["r0"]               # b1/b2's waiter never ran
+        errs = {b[0]: e for (b, e) in settled}
+        assert errs["b0"] is fault
+        assert errs["b1"] is fault and errs["b2"] is fault
+        # A submit racing (or following) the abort settles immediately
+        # with the fault instead of queueing into the dead window.
+        ring.submit(["b3"], "r3")
+        assert settled[-1] == (["b3"], fault)
+    finally:
+        gate.set()
+        ring.stop()
+
+
+def test_inflight_ring_abort_skips_already_settled_batch():
+    """A batch the watcher already settled SUCCESSFULLY must not be
+    re-settled with the fault by a racing ``abort()``: the per-batch
+    settle claim makes exactly one thread run the settler, so a completed
+    collective cannot retroactively report PeerFailureError (a spurious
+    failure — and under elastic, an unnecessary rollback).  The window is
+    [claimed, settler running, not yet popped]: the batch is still in
+    ``_items`` when the abort snapshots the window."""
+    from horovod_tpu.ops.scheduler import InflightRing
+    settled = []
+    in_settler = threading.Event()
+    release = threading.Event()
+
+    def settler(batch, results, error):
+        settled.append((batch[0], error))
+        if batch[0] == "b0" and error is None:
+            in_settler.set()
+            release.wait(10)   # hold b0 mid-settle, still in _items
+
+    ring = InflightRing(lambda r: None, settler, depth=4)
+    try:
+        ring.submit(["b0"], "r0")
+        assert in_settler.wait(5)    # watcher claimed b0, settling success
+        ring.submit(["b1"], "r1")    # unclaimed: the abort must fail THIS
+        fault = PeerFailureError("dead", dead_ranks=[1])
+        ring.abort(fault)            # races b0's in-flight success settle
+        release.set()
+        deadline = time.time() + 5
+        while len(settled) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert dict(settled) == {"b0": None, "b1": fault}, settled
+        assert [b for b, _ in settled].count("b0") == 1   # exactly once
+    finally:
+        release.set()
+        ring.stop()
+
+
+def test_engine_abort_fails_join_waiters():
+    """``_abort_engine`` extends the no-waiter-may-hang invariant to join
+    waiters: it must hand the fault to ``controller.fail_join`` (the
+    single-controller engine has no TCP controller — ``None`` — so a stub
+    stands in for the multi-process wiring)."""
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+
+    hvd.init()
+    eng = basics._get_state().engine
+    fault = PeerFailureError("HVD303 join wiring", dead_ranks=[1])
+    failed = []
+
+    class _StubCtl:
+        def fail_join(self, exc):
+            failed.append(exc)
+
+    assert eng.controller is None     # single-controller mode
+    eng.stop()
+    eng.controller = _StubCtl()
+    try:
+        eng._abort_engine(fault)
+        assert failed == [fault]
+    finally:
+        # Un-down the shared engine for the rest of the suite.
+        eng.controller = None
+        eng._fault = None
+        eng._shutdown.clear()
+        eng.start()
+
+
+def test_engine_abort_settles_queue_and_rejects_new_work():
+    """A ControlPlaneError from negotiation cleanly downs the engine:
+    queued waiters settle with the error, later enqueues raise it
+    immediately (no hang, no wedge)."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+    from horovod_tpu.ops.engine import CollectiveType
+
+    hvd.init()
+    eng = basics._get_state().engine
+    fault = PeerFailureError("HVD303 test fault", dead_ranks=[1])
+    assert eng.fault is None
+    # Park the cycle thread so the queued entry cannot complete before the
+    # abort lands (single-controller cycles settle within microseconds).
+    eng.stop()
+    try:
+        h = eng.enqueue("fault.test.pending", CollectiveType.ALLREDUCE,
+                        hvd.stack_per_rank(
+                            [np.ones(2, np.float32)] * hvd.size()))
+        eng._abort_engine(fault)
+        with pytest.raises(PeerFailureError):
+            eng.synchronize(h, timeout=5)
+        with pytest.raises(PeerFailureError):
+            eng.enqueue("fault.test.after", CollectiveType.ALLREDUCE,
+                        hvd.stack_per_rank(
+                            [np.ones(2, np.float32)] * hvd.size()))
+    finally:
+        # Un-down the shared engine for the rest of the suite.
+        eng._fault = None
+        eng._shutdown.clear()
+        eng.start()
+
+
+def test_cycle_fault_sets_engine_fault_before_releasing_waiters():
+    """Ordering invariant: when a cycle fails with a ControlPlaneError,
+    ``engine.fault`` must be set BEFORE any of that cycle's waiters are
+    released — a waiter that wakes first reads ``engine.fault`` in
+    ``basics.shutdown()`` to pick the abrupt teardown, and a still-None
+    fault would route a poisoned jax world through the graceful shutdown
+    barrier it can never complete."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+    from horovod_tpu.ops.engine import CollectiveType
+
+    hvd.init()
+    eng = basics._get_state().engine
+    fault = PeerFailureError("HVD303 ordering", dead_ranks=[1])
+    eng.stop()
+    h = eng.enqueue("fault.order.entry", CollectiveType.ALLREDUCE,
+                    hvd.stack_per_rank(
+                        [np.ones(2, np.float32)] * hvd.size()))
+    with eng._handles_lock:
+        e = eng._handles[h]
+    seen = []
+    orig_set = e.done.set
+
+    def probing_set():
+        seen.append(eng.fault)     # what a waking waiter would observe
+        orig_set()
+
+    e.done.set = probing_set
+
+    def failing_compute(entries):
+        raise fault
+
+    orig_compute = eng._compute_response_list
+    eng._compute_response_list = failing_compute
+    try:
+        eng.run_loop_once()
+        assert seen and all(f is fault for f in seen), seen
+        with pytest.raises(PeerFailureError):
+            eng.synchronize(h, timeout=5)
+    finally:
+        eng._compute_response_list = orig_compute
+        eng._fault = None
+        eng._shutdown.clear()
+        eng.start()
+
+
+def test_enqueue_fault_race_settles_exactly_once():
+    """The enqueue-vs-abort race path must settle via drain-as-claim: when
+    the fault (and the abort's own queue sweep) lands between the guard
+    and the push, the post-push re-check may only settle entries it drains
+    back out itself — an entry the abort already swept must NOT be settled
+    a second time (a double settle garbles the timeline's QUEUE pairing)."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+    from horovod_tpu.ops.engine import CollectiveType
+
+    hvd.init()
+    eng = basics._get_state().engine
+    fault = PeerFailureError("HVD303 race", dead_ranks=[1])
+    eng.stop()
+    settles = []
+    orig_settle = eng._settle_queued
+
+    def counting_settle(entries, exc):
+        settles.append([e.name for e in entries])
+        orig_settle(entries, exc)
+
+    orig_push = eng.queue.push_many
+
+    def racing_push(entries):
+        orig_push(entries)
+        # The abort lands NOW — fault set + abort's queue sweep both run
+        # between this thread's push and its post-push re-check.
+        eng._fault = fault
+        counting_settle(eng.queue.drain(), fault)
+
+    eng._settle_queued = counting_settle
+    eng.queue.push_many = racing_push
+    try:
+        h = eng.enqueue("fault.race.once", CollectiveType.ALLREDUCE,
+                        hvd.stack_per_rank(
+                            [np.ones(2, np.float32)] * hvd.size()))
+        with pytest.raises(PeerFailureError):
+            eng.synchronize(h, timeout=5)
+        flat = [n for batch in settles for n in batch]
+        assert flat.count("fault.race.once") == 1, settles
+    finally:
+        eng.queue.push_many = orig_push
+        eng._settle_queued = orig_settle
+        eng._fault = None
+        eng._shutdown.clear()
+        eng.start()
+
+
+# ----------------------------------------------- monitor HVD303 enrichment
+def test_monitor_health_reports_peer_dead():
+    from horovod_tpu.monitor import MonitorAgent
+    agent = MonitorAgent(rank=0, world=2, interval_s=0.2)
+    agent.aggregator.update(1, {"rank": 1, "ledger": ["allreduce 'g' @x:1"]})
+    h = agent.health()
+    assert h["status"] in ("ok", "degraded")
+    agent.on_peer_failure([1], "rank(s) [1] lost connection")
+    h = agent.health()
+    assert h["status"] == "peer_dead"
+    assert h["peer_dead"] == [1]
+    assert "lost connection" in h["peer_dead_reason"]
+    agent.close()
+
+
+def test_monitor_peer_failure_context_quotes_dead_rank():
+    from horovod_tpu.monitor import MonitorAgent
+    agent = MonitorAgent(rank=0, world=3, interval_s=0.2)
+    agent.aggregator.update(1, {"rank": 1,
+                                "ledger": ["allreduce 'grad.7' @t.py:12"]})
+    ctx = agent.peer_failure_context([1, 2])
+    assert "rank 1: last snapshot" in ctx
+    assert "grad.7" in ctx
+    assert "rank 2: no snapshot ever received" in ctx
+    # Unattributed (round timeout): every known rank's age is listed.
+    ctx_all = agent.peer_failure_context(None)
+    assert "rank 1" in ctx_all
+    agent.close()
+
+
+def test_controller_enricher_is_guarded():
+    """A raising enricher must never mask the HVD303 failure itself."""
+    ctl = TCPController.__new__(TCPController)
+    ctl.fault_enricher = None
+    assert ctl._enrich([1]) == ""
+
+    def boom(ranks):
+        raise RuntimeError("telemetry bug")
+
+    ctl.fault_enricher = boom
+    assert ctl._enrich([1]) == ""
+    with pytest.raises(PeerFailureError) as ei:
+        ctl._raise_peer_failure([2, 0], "it died")
+    assert ei.value.dead_ranks == [0, 2]
+    assert "it died" in str(ei.value)
+
+
+# --------------------------------------------------------- abort frame fmt
+def test_parse_abort_roundtrip_and_rejects_normal_frames():
+    reason = "rank(s) [1] lost connection mid-negotiation"
+    frame = struct.pack("<III", 0xFFFFFFFF, 0x34544241, 2)
+    frame += struct.pack("<II", 1, 3)
+    frame += struct.pack("<H", len(reason)) + reason.encode()
+    got = TCPController._parse_abort(frame)
+    assert got == ([1, 3], reason)
+    # A normal response (n_ready=0...) must never parse as an abort.
+    assert TCPController._parse_abort(struct.pack("<III", 0, 0, 0)) is None
+    assert TCPController._parse_abort(b"") is None

@@ -461,6 +461,7 @@ root = sys.argv[1]
 # horovod_tpu/__init__.py (which imports jax) never runs.
 for name, sub in (('horovod_tpu', ''), ('horovod_tpu.ops', 'ops'),
                   ('horovod_tpu.utils', 'utils'),
+                  ('horovod_tpu.common', 'common'),
                   ('horovod_tpu.analysis', 'analysis')):
     m = types.ModuleType(name)
     m.__path__ = [os.path.join(root, sub)] if sub else [root]
@@ -470,14 +471,21 @@ importlib.import_module('horovod_tpu.monitor')
 importlib.import_module('horovod_tpu.monitor.__main__')
 importlib.import_module('horovod_tpu.monitor.http')
 importlib.import_module('horovod_tpu.analysis.findings')
+# Control-plane fault tolerance: the harness and the typed error taxonomy
+# carry the jax-free fault tests and the acceptance workers' arming path.
+importlib.import_module('horovod_tpu.testing')
+importlib.import_module('horovod_tpu.testing.faults')
+importlib.import_module('horovod_tpu.common.exceptions')
+importlib.import_module('horovod_tpu.common.net')
 print('PURITY_OK')
 """
 
 
 def test_monitor_and_scheduler_import_without_jax():
-    """Fast-tier purity: the monitor package and ops/scheduler.py must be
-    importable with jax imports hard-blocked — they carry the jax-free
-    unit-test tier and the standalone CLI."""
+    """Fast-tier purity: the monitor package, ops/scheduler.py, the
+    fault-injection harness (horovod_tpu/testing) and the control-plane
+    exception taxonomy must be importable with jax imports hard-blocked —
+    they carry the jax-free unit-test tier and the standalone CLI."""
     res = subprocess.run(
         [sys.executable, "-c", _PURITY_SRC,
          os.path.join(REPO, "horovod_tpu")],

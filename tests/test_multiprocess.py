@@ -542,6 +542,82 @@ def test_torovodrun_monitor_acceptance():
         f"stderr:\n{res.stderr[-3000:]}")
 
 
+WORKER_FAULTS = os.path.join(REPO, "tests", "data", "worker_faults.py")
+
+
+def test_torovodrun_dead_rank_aborts_with_attribution(tmp_path):
+    """ISSUE 5 acceptance (static half): with HVD_TPU_FAULT=
+    mid_round_exit:1:crash, rank 1 dies uncleanly mid-negotiation and rank
+    0 raises a typed HVD303 PeerFailureError naming rank 1 within
+    HOROVOD_ROUND_TIMEOUT_S — no hang, no wedged waiters (a pre-existing
+    pending handle settles with the fault, new work fails fast).  The
+    proof is the result file rank 0 writes before the launcher reaps it;
+    the launcher's nonzero exit (rank 1's crash) is expected."""
+    import json
+    result = tmp_path / "fault_result.json"
+    res = _run_torovodrun(2, WORKER_FAULTS, timeout=300, extra_env={
+        "FAULT_MODE": "static",
+        "FAULT_RESULT": str(result),
+        "HVD_TPU_FAULT": "mid_round_exit:1:crash:300",
+        "HOROVOD_ROUND_TIMEOUT_S": "30",
+    })
+    assert res.returncode != 0, (
+        "rank 1's unclean crash must fail the launch\n"
+        f"stdout:\n{res.stdout[-2000:]}")
+    assert result.exists(), (
+        f"rank 0 never recorded the typed abort\nstdout:\n"
+        f"{res.stdout[-3000:]}\nstderr:\n{res.stderr[-3000:]}")
+    data = json.loads(result.read_text())
+    assert data["ok"] and data["mode"] == "static", data
+    assert data["dead_ranks"] == [1] and data["hvd303"], data
+    assert data["elapsed_s"] < 30, data
+
+
+def test_torovodrun_elastic_rerendezvous_after_crash(tmp_path):
+    """ISSUE 5 acceptance (elastic half): the same mid-negotiation crash
+    under the elastic driver.  Two single-slot local 'hosts' (localhost +
+    127.0.0.1) so blacklisting the crashed host leaves a surviving world:
+    the survivor catches the typed PeerFailureError, restores committed
+    state, re-rendezvouses into the shrunk generation and completes every
+    epoch; the driver exits 0."""
+    import json
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("localhost:1\n127.0.0.1:1\n")
+    result = tmp_path / "fault_result.json"
+    env = dict(os.environ)
+    other_paths = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + other_paths)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("HOROVOD_TIMELINE", None)
+    env.update({
+        "FAULT_MODE": "elastic",
+        "FAULT_RESULT": str(result),
+        "FAULT_EPOCHS": "6",
+        "HVD_TPU_FAULT": "mid_round_exit:1:crash:600",
+        "HOROVOD_ROUND_TIMEOUT_S": "30",
+    })
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+           "--host-discovery-script", f"cat {hostfile}",
+           "--min-np", "1", "--max-np", "2",
+           sys.executable, WORKER_FAULTS]
+    res = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=300)
+    assert res.returncode == 0, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+    assert result.exists(), res.stdout[-3000:]
+    data = json.loads(result.read_text())
+    assert data["ok"] and data["mode"] == "elastic", data
+    assert data["epochs"] == 6, data
+    assert data["final_size"] == 1, data
+    assert data["resets"] >= 1, data
+    # The reset was triggered by the TYPED control-plane error, not a
+    # blind socket failure.
+    assert any(kind == "PeerFailureError" and ranks == [1]
+               for kind, ranks in data["caught"]), data
+
+
 def test_torovodrun_sanitizer_catches_divergence_on_cached_path():
     """PR 2 acceptance: HVD_TPU_SANITIZER=1 still catches divergent
     submission order when both ranks are on the cached/bitvector path (the

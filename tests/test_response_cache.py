@@ -172,6 +172,46 @@ def test_steady_state_frames_hold_with_priority_drain():
     assert res[0] == res[1]
 
 
+def test_v4_liveness_adds_zero_warm_path_bytes():
+    """Protocol-v4 frame guard: the fault-tolerance machinery (FLT1
+    capability ad, server liveness tracking, abort frames) must add ZERO
+    bytes to warm-path negotiation frames.  The capability hello rides
+    round 1 only; a steady-state single-tensor cycle is exactly
+    4B n_full + 4B bv_len + 1B bitvec + 4B n_tag = 13 bytes — byte-for-
+    byte the pre-v4 wire format.  Holds with a fault ARMED-but-not-fired
+    too (fault points must not leak onto the wire)."""
+    from horovod_tpu.testing import faults
+
+    faults.disarm()
+
+    def run_pair():
+        def fn(ctl, rank):
+            assert not ctl.peer_fault_proto
+            _steps(ctl, lambda: [E("t")], 2)        # warm-up: learn slot
+            # Round 1's response carried the server's v4 ad.
+            assert ctl.peer_fault_proto
+            bytes_before = ctl.bytes_sent
+            rounds_before = ctl.rounds
+            _steps(ctl, lambda: [E("t")], 4)
+            per_round = ((ctl.bytes_sent - bytes_before)
+                         / (ctl.rounds - rounds_before))
+            assert per_round == 13, (
+                f"warm-path frame grew to {per_round}B — the v4 liveness "
+                f"fields must cost zero warm bytes")
+            return True
+
+        _pair(fn)
+
+    run_pair()
+    # Armed on an unrelated (point, rank) pair: still zero wire impact.
+    faults.arm("mid_round_exit:7:crash")
+    try:
+        run_pair()
+        assert not faults.fired()
+    finally:
+        faults.disarm()
+
+
 # ------------------------------------------------------------ invalidation
 def test_shape_change_falls_back_to_full_negotiation():
     """A new digest (shape change) misses the cache on every rank, rides a
