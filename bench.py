@@ -213,12 +213,20 @@ def _control_plane_stats():
         }
     else:
         monitor = {"enabled": False}
+    # Lifecycle-phase breakdown (horovod_tpu.trace): which host-side phase
+    # (queue/negotiation/copy_in/reduce/drain) a gradient's latency sits in,
+    # when tracing is armed (HOROVOD_TRACE, or the bench_trace A/B below —
+    # which also writes this section).  Null when disarmed: absence of
+    # data, not zero latency.
+    tracer = getattr(eng, "tracer", None)
+    trace = tracer.phase_summary() if tracer is not None else None
     return {"negotiation_us_per_cycle": per_cycle,
             "response_cache_hit_rate":
                 round(rate, 4) if rate is not None else None,
             "chunks_per_cycle": chunks,
             "inflight_depth": ring.high_water if ring is not None else 0,
-            "monitor": monitor}
+            "monitor": monitor,
+            "trace": trace}
 
 
 def bench_response_cache(iters=30, n_tensors=8, errors=None):
@@ -338,6 +346,39 @@ def bench_pipeline(iters=20, errors=None):
     return out
 
 
+def _ab_inputs(n_tensors, elems=1 << 14):
+    """Eager A/B workload, shaped per launch mode: stacked [world, elems]
+    in single-controller mode, the local contribution per process
+    otherwise.  Shared by the monitor/trace A/B sections (bench_pipeline
+    keeps its own variant with per-workload element counts)."""
+    import jax
+    import numpy as np
+    import horovod_tpu as hvd
+    multi_proc = jax.process_count() > 1
+    m = hvd.mesh()
+    n_local = len([d for d in m.devices.flat
+                   if d.process_index == jax.process_index()])
+    shape = ((n_local, elems) if n_local > 1 else (elems,)) \
+        if multi_proc else (hvd.size(), elems)
+    return [np.full(shape, 1.0 + j * 1e-6, np.float32)
+            for j in range(n_tensors)]
+
+
+def _ab_noise_verdict(on_ms, off_ms, errors, key, label):
+    """ONE noise band for every telemetry-plane ON-vs-OFF A/B:
+    ``within_noise`` while ON stays inside the jitter band repeated
+    identical phases show (15% or 0.2 ms, whichever is larger).  Only a
+    GROSS miss (1.5x + 1 ms) lands in ``errors[]`` — the bench never
+    hard-fails, and the single-core CPU smoke tier is too jittery to
+    treat the tight band as an error there; the A/B history tracks
+    within_noise either way."""
+    within = (on_ms <= off_ms * 1.15) or (on_ms - off_ms <= 0.2)
+    if errors is not None and on_ms > off_ms * 1.5 + 1.0:
+        errors[key] = (f"{label} ON step {on_ms}ms vs OFF {off_ms}ms "
+                       f"(gross regression, far beyond noise)")
+    return bool(within)
+
+
 def bench_monitor(iters=30, n_tensors=8, errors=None):
     """Telemetry plane ON vs OFF A/B: the same eager steady-state workload
     with no MonitorAgent attached, then with one attached at an aggressive
@@ -360,18 +401,7 @@ def bench_monitor(iters=30, n_tensors=8, errors=None):
         # The whole bench was launched with HOROVOD_MONITOR=1: no
         # un-monitored baseline exists, and the user's agent must survive.
         return out
-    # Input shape follows the launch mode, like bench_pipeline: stacked
-    # [world, elems] in single-controller mode, the local contribution
-    # per process otherwise.
-    multi_proc = jax.process_count() > 1
-    m = hvd.mesh()
-    n_local = len([d for d in m.devices.flat
-                   if d.process_index == jax.process_index()])
-    elems = 1 << 14
-    shape = ((n_local, elems) if n_local > 1 else (elems,)) \
-        if multi_proc else (hvd.size(), elems)
-    xs = [np.full(shape, 1.0 + j * 1e-6, np.float32)
-          for j in range(n_tensors)]
+    xs = _ab_inputs(n_tensors)
 
     def phase(n_iter):
         t0 = time.perf_counter()
@@ -398,22 +428,82 @@ def bench_monitor(iters=30, n_tensors=8, errors=None):
             "metrics_frame_bytes":
                 getattr(ctl, "monitor_bytes_sent", 0) if ctl else 0,
         })
-        # "Within noise": ON stays inside the jitter band repeated
-        # identical phases show (15% or 0.2 ms, whichever is larger).
-        # Only a GROSS miss (1.5x + 1 ms) lands in errors[] — the bench
-        # never hard-fails, and the single-core CPU smoke tier is too
-        # jittery to treat the tight band as an error there; the A/B
-        # history tracks within_noise either way.
-        within = (on_ms <= off_ms * 1.15) or (on_ms - off_ms <= 0.2)
-        out["within_noise"] = bool(within)
-        if errors is not None and on_ms > off_ms * 1.5 + 1.0:
-            errors["monitor_overhead"] = (
-                f"monitoring ON step {on_ms}ms vs OFF {off_ms}ms "
-                f"(gross regression, far beyond noise)")
+        out["within_noise"] = _ab_noise_verdict(
+            on_ms, off_ms, errors, "monitor_overhead", "monitoring")
     finally:
         agent.close()
     _record_timing("monitor_ab", warmup=3, iters=iters,
                    wall_s=(off_ms + on_ms) * iters / 1e3)
+    return out
+
+
+def bench_trace(iters=30, n_tensors=8, errors=None):
+    """Tracing plane ON vs OFF A/B at fusion scale: the same eager
+    steady-state workload with the engine's tracer detached (the disarmed
+    default — every stamp site is one attribute check), then with a
+    recorder attached (no file I/O: the pure span-stamping cost).
+
+    Two claims are recorded on every JSON line:
+
+    - **overhead bound** (``within_noise``): the disarmed path must stay
+      free and the ARMED path must stay within jitter of it — the guard
+      future PRs cannot silently regress (a gross miss lands in
+      ``errors["trace_overhead"]``);
+    - **phase breakdown** (``phases_us``/``cycle_us``/``phase_sum_us``):
+      mean per-phase microseconds over the armed window, whose sum must be
+      consistent with the measured mean lifecycle — the attribution the
+      small-message latency war steers by (docs/timeline.md).
+    """
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics as _basics
+    from horovod_tpu.trace import TraceRecorder
+
+    eng = _basics._get_state().engine
+    preexisting = eng.tracer
+    out = {"already_armed": preexisting is not None}
+    xs = _ab_inputs(n_tensors)
+
+    def phase(n_iter):
+        t0 = time.perf_counter()
+        for _ in range(n_iter):
+            outs = hvd.grouped_allreduce(xs, name="trace_bench",
+                                         op=hvd.Sum)
+        del outs
+        return round((time.perf_counter() - t0) / n_iter * 1e3, 3)
+
+    try:
+        if preexisting is None:
+            eng.tracer = None
+            phase(3)                            # warm: slots + programs
+            off_ms = phase(iters)
+        else:
+            # Launched with HOROVOD_TRACE armed: no disarmed baseline
+            # exists; record the armed breakdown only.
+            off_ms = None
+        eng.tracer = TraceRecorder(capacity=4096) \
+            if preexisting is None else preexisting
+        phase(3)
+        on_ms = phase(iters)
+        out.update({"off_step_ms": off_ms, "on_step_ms": on_ms})
+        summary = eng.tracer.phase_summary()
+        out.update(summary)
+        if off_ms is not None:
+            out["overhead_pct"] = (round(100.0 * (on_ms / off_ms - 1.0), 2)
+                                   if off_ms else None)
+            out["within_noise"] = _ab_noise_verdict(
+                on_ms, off_ms, errors, "trace_overhead", "tracing")
+        # Consistency: the five phase means must re-add to the measured
+        # mean lifecycle (they partition it by construction; a drifted
+        # stamp would break this).
+        if summary.get("cycle_us"):
+            drift = abs(summary["phase_sum_us"] - summary["cycle_us"])
+            out["phase_sum_consistent"] = bool(
+                drift <= max(1.0, 0.01 * summary["cycle_us"]))
+    finally:
+        if preexisting is None:
+            eng.tracer = None
+    _record_timing("trace_ab", warmup=3, iters=iters,
+                   wall_s=((off_ms or 0) + on_ms) * iters / 1e3)
     return out
 
 
@@ -1325,6 +1415,10 @@ def _run(out, errors):
             out["monitor_ab"] = bench_monitor(errors=errors)
         except Exception as exc:  # noqa: BLE001 - contained
             errors["monitor_ab"] = repr(exc)
+        try:
+            out["trace_ab"] = bench_trace(errors=errors)
+        except Exception as exc:  # noqa: BLE001 - contained
+            errors["trace_ab"] = repr(exc)
         return
 
     if model == "llama":
@@ -1423,6 +1517,11 @@ def _run(out, errors):
         out["monitor_ab"] = bench_monitor(errors=errors)
     except Exception as exc:  # noqa: BLE001 - contained
         errors["monitor_ab"] = repr(exc)
+
+    try:
+        out["trace_ab"] = bench_trace(errors=errors)
+    except Exception as exc:  # noqa: BLE001 - contained
+        errors["trace_ab"] = repr(exc)
 
     if os.environ.get("HVD_BENCH_SKIP_AUTOTUNE", "") != "1":
         try:

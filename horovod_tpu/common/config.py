@@ -140,6 +140,17 @@ class Config:
     timeline_filename: str = ""
     timeline_mark_cycles: bool = False
 
+    # Distributed collective tracing (horovod_tpu.trace, docs/timeline.md).
+    # HOROVOD_TRACE=<path> arms per-tensor lifecycle spans AND writes this
+    # rank's trace file there (the launcher suffixes the base per rank;
+    # merge with `python -m horovod_tpu.trace`); HOROVOD_TRACE=1 arms the
+    # in-memory recorder only (digests still ride the monitor side-channel,
+    # bench reads the phase breakdown).  Unset = strictly zero cost.
+    # HOROVOD_TRACE_RING bounds the preallocated span ring.
+    trace: bool = False
+    trace_filename: str = ""
+    trace_ring: int = 4096
+
     stall_check_time_s: float = 60.0
     stall_shutdown_time_s: float = 0.0
     stall_check_disable: bool = False
@@ -204,6 +215,7 @@ class Config:
             connect_backoff_ms=_env_float("CONNECT_BACKOFF_MS", 500.0),
             timeline_filename=_env("TIMELINE", "") or "",
             timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
+            trace_ring=_env_int("TRACE_RING", 4096),
             stall_check_time_s=_env_float("STALL_CHECK_TIME", 60.0),
             stall_shutdown_time_s=_env_float("STALL_SHUTDOWN_TIME", 0.0),
             stall_check_disable=_env_bool("STALL_CHECK_DISABLE", False),
@@ -234,4 +246,12 @@ class Config:
         )
         if _env_int("CACHE_CAPACITY", 1024) == 0:
             cfg.cache_enabled = False
+        # HOROVOD_TRACE: a bool-ish value arms the in-memory recorder only;
+        # anything else is the per-rank trace file path (and arms it).
+        raw_trace = (_env("TRACE", "") or "").strip()
+        if raw_trace:
+            cfg.trace = raw_trace.lower() not in ("0", "false", "no", "off")
+            if cfg.trace and raw_trace.lower() not in ("1", "true", "yes",
+                                                       "on"):
+                cfg.trace_filename = raw_trace
         return cfg

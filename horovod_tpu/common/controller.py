@@ -728,6 +728,21 @@ class TCPController:
             errored.append((e, msg))
         return out, errored
 
+    def slot_of(self, e) -> int:
+        """The response-cache slot assigned to an entry's announce key, or
+        -1 while unlearned.  The compact cross-rank correlation id the
+        trace spans carry beside the cycle id (``horovod_tpu.trace``):
+        slots are server-assigned, so the same tensor has the same slot on
+        every rank.  Read-only — never touches the LRU order."""
+        ps_id = getattr(e, "process_set_id", 0)
+        required = 0
+        if ps_id:
+            from .basics import _get_state
+            required = _get_state().process_set_table.get(ps_id).size()
+        key = (self._wire_name(e), self._digest(e), required,
+               self._datadep(e), getattr(e, "group_id", -1) != -1)
+        return self._slots.get(key, -1)
+
     def forget(self, e):
         """Drop all negotiation bookkeeping for an entry failed locally
         (e.g. group-abort) so a retry under the same name renegotiates from

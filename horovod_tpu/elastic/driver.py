@@ -370,8 +370,14 @@ def run_elastic(args) -> int:
     # the controller client, so re-ranked survivors start clean.
     from ..runner.run import tuning_env
     extra_env = tuning_env(args)
+    # Trace/timeline filenames travel as the BASE: ranks are assigned at
+    # rendezvous, so elastic workers apply the shared per-rank suffix
+    # (utils.timeline.per_rank_filename) in elastic_bootstrap — the same
+    # <base>.<rank> names every other launch path produces.
     if getattr(args, "timeline_filename", None):
         extra_env["HOROVOD_TIMELINE"] = args.timeline_filename
+    if getattr(args, "trace_filename", None):
+        extra_env["HOROVOD_TRACE"] = args.trace_filename
     driver = ElasticDriver(
         discovery, args.command, min_np=min_np, max_np=max_np,
         env=extra_env, start_timeout_s=args.start_timeout,

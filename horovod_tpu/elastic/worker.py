@@ -183,7 +183,20 @@ def elastic_bootstrap():
         "HOROVOD_CONTROLLER_PORT2": str(a["controller_port2"]),
     }
     os.environ.update(env)
-    return Config.from_env()
+    cfg = Config.from_env()
+    # Per-rank output suffixing, unified with the static launch paths
+    # (utils.timeline.per_rank_filename): the env carries the BASE name
+    # (the driver can't know ranks before assignment, and re-suffixing an
+    # already-suffixed env value across generations would compound), so
+    # the assigned rank is applied to the parsed config only.
+    from ..utils.timeline import per_rank_filename
+    if cfg.timeline_filename:
+        cfg.timeline_filename = per_rank_filename(cfg.timeline_filename,
+                                                  a["rank"])
+    if cfg.trace_filename:
+        cfg.trace_filename = per_rank_filename(cfg.trace_filename,
+                                               a["rank"])
+    return cfg
 
 
 def init_distributed_resilient(coordinator_address: str,

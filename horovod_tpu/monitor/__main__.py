@@ -87,6 +87,32 @@ def render(dump: dict) -> str:
         lines.append("rank  " + "  ".join(h[len("hvd_"):] for h in heads))
         for r, vals in rows:
             lines.append(f"{r:>4}  " + "  ".join(_fmt(v) for v in vals))
+    # Lifecycle phase means from the trace digests (HOROVOD_TRACE armed):
+    # which host-side phase eats the cycle, per rank (docs/timeline.md).
+    phase_rows = []
+    phase_names = None
+    for r in sorted(table, key=lambda k: int(k)):
+        tr = table[r].get("trace") or {}
+        phases = tr.get("phases")
+        if not phases:
+            continue
+        if phase_names is None:
+            phase_names = list(phases)
+        means = []
+        for p in phase_names:
+            total, count = (phases.get(p) or [0, 0])[:2]
+            means.append(round(total / count, 1) if count else None)
+        phase_rows.append((r, tr.get("spans"), means, tr.get("cycle_us")))
+    if phase_rows:
+        lines.append("")
+        lines.append("lifecycle phases, mean us (trace digests):")
+        lines.append("rank  spans  "
+                     + "  ".join(f"{p:>11}" for p in phase_names)
+                     + f"  {'cycle':>9}")
+        for r, spans, means, cyc in phase_rows:
+            lines.append(f"{r:>4}  {_fmt(spans):>5}  "
+                         + "  ".join(f"{_fmt(v):>11}" for v in means)
+                         + f"  {_fmt(cyc):>9}")
     return "\n".join(lines)
 
 

@@ -34,6 +34,7 @@ from typing import Dict, List, Optional
 
 from .aggregator import RankAggregator
 from .registry import MetricRegistry
+from ..trace.core import PHASES as _TRACE_PHASES
 from ..utils.logging import get_logger
 
 log = get_logger()
@@ -159,6 +160,27 @@ class MonitorAgent:
                 reg.gauge("hvd_sanitizer_ledger_entries",
                           "entries in the sanitizer ledger").set(
                     len(san.ledger))
+            tracer = getattr(engine, "tracer", None)
+            if tracer is not None:
+                # Per-phase lifecycle histograms (horovod_tpu.trace):
+                # mirrored from the recorder's own buckets — visible at
+                # /metrics as hvd_trace_<phase>_us and in the CLI view.
+                try:
+                    hists = tracer.phase_histograms()
+                except Exception:  # noqa: BLE001 - telemetry only
+                    hists = {}
+                for phase, (counts, sum_us, count) in hists.items():
+                    reg.histogram(
+                        f"hvd_trace_{phase}_us",
+                        f"tensor-lifecycle {phase} phase (us)",
+                        buckets=tracer.buckets).set_cumulative(
+                        counts, sum_us, count)
+                reg.counter("hvd_trace_spans_total",
+                            "lifecycle spans committed").set_total(
+                    tracer.spans_committed)
+                reg.counter("hvd_trace_spans_dropped_total",
+                            "span claims dropped (ring full)").set_total(
+                    tracer.dropped)
             ctl = controller if controller is not None \
                 else getattr(engine, "controller", None)
             if ctl is not None:
@@ -212,6 +234,16 @@ class MonitorAgent:
             san = getattr(eng, "sanitizer", None)
             if san is not None:
                 snap["ledger"] = [e.render() for e in san.tail(8)]
+            tracer = getattr(eng, "tracer", None)
+            if tracer is not None:
+                # Compact per-cycle phase digest (horovod_tpu.trace):
+                # rides the MON1 side-channel inside this JSON blob —
+                # size-capped by the recorder (DIGEST_* caps) and version-
+                # safe (pre-trace peers ignore unknown snapshot keys).
+                try:
+                    snap["trace"] = tracer.digest()
+                except Exception:  # noqa: BLE001 - telemetry only
+                    pass
         snap["metrics"] = self.registry.snapshot()
         return snap
 
@@ -389,16 +421,48 @@ class MonitorAgent:
 
     def peer_ledger_report(self) -> str:
         """Laggard attribution block for HVD302 stall reports: every peer
-        rank's last submissions, from the aggregation table."""
+        rank's last submissions from the aggregation table, plus — when
+        the peers run with tracing armed — the phase each laggard is
+        currently stuck in and its last completed cycle's phase breakdown
+        (the trace digest that rode the same side-channel)."""
         tails = self.aggregator.peer_ledger_tails(exclude_rank=self.rank)
-        if not tails:
+        table = self.aggregator.table()
+
+        def _has_trace(rec):
+            tr = rec["snap"].get("trace") or {}
+            return tr.get("open") or tr.get("cycles")
+
+        if not tails and not any(_has_trace(rec) for r, rec in table.items()
+                                 if r != self.rank):
             return ""
         lines = []
-        for r in sorted(tails):
-            lines.append(f"rank {r} last submissions:")
-            lines.extend(f"  {t}" for t in tails[r])
+        ranks = set(tails) | {r for r in table if r != self.rank}
+        for r in sorted(ranks):
+            if r in tails:
+                lines.append(f"rank {r} last submissions:")
+                lines.extend(f"  {t}" for t in tails[r])
+            lines.extend(f"  {t}" for t in self._peer_phase_lines(table, r))
         return "peer ledgers (via monitor side-channel):\n" + \
             "\n".join(lines)
+
+    @staticmethod
+    def _peer_phase_lines(table: dict, rank: int) -> List[str]:
+        """Trace-digest attribution for one peer: current phase per open
+        span, and the last completed cycle's per-phase microseconds."""
+        rec = table.get(rank)
+        tr = (rec["snap"].get("trace") or {}) if rec else {}
+        lines: List[str] = []
+        for name, phase in sorted((tr.get("open") or {}).items()):
+            lines.append(f"rank {rank} currently in phase {phase}: {name}")
+        cycles = tr.get("cycles") or []
+        if cycles:
+            row = cycles[-1]
+            # [cycle, n_tensors, queue, negotiation, copy_in, reduce, drain]
+            body = "  ".join(f"{p}={v}us"
+                             for p, v in zip(_TRACE_PHASES, row[2:]))
+            lines.append(f"rank {rank} last cycle {row[0]} "
+                         f"({row[1]} tensors): {body}")
+        return lines
 
     # ------------------------------------------------------------ lifecycle
     def serve_http(self, port: int, addr: str = ""):

@@ -157,6 +157,25 @@ class Histogram:
             return {"count": self._count, "sum": round(self._sum, 3),
                     "buckets": out}
 
+    def set_cumulative(self, counts: Sequence[int], sum_: float,
+                       count: int) -> None:
+        """Adopt an externally maintained histogram (collectors mirroring
+        a publisher's own per-bucket counts — e.g. the trace recorder's
+        per-phase buckets — without per-event registry calls on the hot
+        path).  ``counts`` are per-bucket non-cumulative counts aligned
+        with ``self.buckets`` plus the +Inf overflow.  Never moves
+        backwards, matching ``Counter.set_total`` semantics."""
+        counts = list(counts)
+        if len(counts) != len(self._counts):
+            raise ValueError(
+                f"histogram {self.name!r}: expected {len(self._counts)} "
+                f"bucket counts, got {len(counts)}")
+        with self._lock:
+            if count >= self._count:
+                self._counts = counts
+                self._sum = float(sum_)
+                self._count = int(count)
+
 
 class MetricRegistry:
     """Thread-safe name → metric table with snapshot-time collectors."""

@@ -86,6 +86,10 @@ class TensorTableEntry:
     # scheduling); must be identical across ranks for a given name.
     priority: int = 0
     enqueue_time: float = 0.0
+    # Lifecycle trace span (horovod_tpu.trace): claimed at first drain when
+    # tracing is armed, stamped at each phase boundary, committed at settle.
+    # None whenever tracing is disarmed — every stamp site guards on it.
+    span: Any = None
     # filled on completion:
     result: Any = None
     error: Optional[BaseException] = None
@@ -103,6 +107,21 @@ def _fusion_key(e: TensorTableEntry) -> Tuple:
     """
     return (e.ctype, e.reduce_op, e.root_rank, e.process_set_id,
             e.prescale_factor, e.postscale_factor, e.compression)
+
+
+# Sentinel for a tensor whose trace-span claim was dropped (ring full):
+# marks the entry permanently untraceable for this collective, so later
+# drains cannot re-claim it with a fresh drain time (which would fold the
+# negotiation cycles already spent into the queue phase) and the recorder's
+# dropped counter counts each entry once.  Every stamp/commit site treats
+# it as "no span".
+_SPAN_DROPPED = object()
+
+
+def _live_span(e):
+    """The entry's traceable span, or None (untraced / claim dropped)."""
+    sp = e.span
+    return None if (sp is None or sp is _SPAN_DROPPED) else sp
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -188,6 +207,15 @@ class CollectiveEngine:
         self.cycle_count = 0
         self.last_cycle_ts = 0.0
         self.monitor = None
+        # Distributed collective tracing (HOROVOD_TRACE, horovod_tpu.trace):
+        # per-tensor lifecycle spans (queue/negotiation/copy_in/reduce/
+        # drain) stamped through the cycle below, ring-buffered, optionally
+        # written to a per-rank trace file, and digested into the monitor
+        # side-channel.  None when disarmed — every stamp site is then one
+        # attribute check (the bench trace A/B pins this at zero cost).
+        from ..trace import maybe_install as _trace_install
+        self.tracer = _trace_install(
+            cfg, rank=cfg.rank_env if cfg.rank_env >= 0 else 0)
         # XLA:CPU executes collectives via blocking rendezvous on a shared
         # Eigen pool; back-to-back ASYNC launches can starve a participant
         # thread and abort the process ("Expected N threads to join the
@@ -233,6 +261,10 @@ class CollectiveEngine:
             # synchronize() must never outlive the watcher unsignalled.
             self._inflight.stop()
             self._inflight = None
+        if self.tracer is not None:
+            # After the ring: settling commits spans, and the trace file
+            # must hold them all before the final flush.
+            self.tracer.close()
 
     def _abort_engine(self, exc: BaseException, busy: bool = False):
         """Clean engine shutdown on a control-plane fault (HVD303).
@@ -300,10 +332,17 @@ class CollectiveEngine:
         enqueue-vs-abort race path funnel through here, so the settle
         sequence cannot drift between them)."""
         tl = self._state.timeline
+        tr = self.tracer
         for e in entries:
             e.error = exc
             if tl is not None:
                 tl.end_activity(e.name, "QUEUE")
+            sp = _live_span(e) if tr is not None else None
+            if sp is not None:
+                # Requeued entries may already carry a claimed span: commit
+                # it as aborted so the ring slot is reclaimable.
+                sp.error = True
+                tr.commit(sp)
             self.queue.mark_done(e)
             e.done.set()
 
@@ -467,6 +506,19 @@ class CollectiveEngine:
         entries = self.queue.drain()
         if not entries and self.controller is None:
             return
+        tr = self.tracer
+        t_trace0 = t_drain = 0.0
+        if tr is not None:
+            t_drain = time.monotonic()
+            t_trace0 = t_drain - (time.perf_counter() - t_cycle0)
+            for e in entries:
+                if e.span is None:
+                    # queue phase closes at this first drain; requeued
+                    # entries keep their span (still in negotiation).  A
+                    # dropped claim latches the sentinel: claim at most
+                    # once per entry.
+                    e.span = tr.begin(e.name, e.enqueue_time, t_drain) \
+                        or _SPAN_DROPPED
         # Multi-process mode: every rank must complete a (possibly empty)
         # lock-step negotiation round each cycle, or peers with pending
         # tensors would block on this rank's missing frame.
@@ -500,11 +552,45 @@ class CollectiveEngine:
                     self._abort_engine(exc, busy=bool(entries))
             for e in entries:
                 e.error = exc
+                sp = _live_span(e) if tr is not None else None
+                if sp is not None:
+                    sp.error = True
+                    tr.commit(sp)
                 self.queue.mark_done(e)
                 e.done.set()
             return
         if not_ready:
             self.queue.requeue(not_ready)
+        t_ready = 0.0
+        if tr is not None and responses:
+            # Globally-ready verdict: negotiation phase closes.  The cycle
+            # id is the cross-rank correlation key — the controller's
+            # lock-step round counter is identical on every rank for the
+            # same round; single-controller mode uses the local index.
+            t_ready = time.monotonic()
+            ctl = self.controller
+            cyc_id = ctl.rounds if ctl is not None else self._cycle_index
+            for batch in responses:
+                for e in batch:
+                    sp = _live_span(e)
+                    if sp is None:
+                        # ONLY synthesized join entries claim here (they
+                        # never drained, so ready-time is their drain).
+                        # An ordinary entry whose drain-time claim was
+                        # dropped (ring full) stays untraced: re-claiming
+                        # it now would fold its negotiation time into the
+                        # queue phase and skew the attribution exactly
+                        # under the load that saturates the ring.
+                        if e.span is not None or \
+                                not getattr(e, "trace_synthesized", False):
+                            continue
+                        sp = tr.begin(e.name, e.enqueue_time, t_ready)
+                        e.span = sp or _SPAN_DROPPED
+                    if sp is not None:
+                        sp.t_ready = t_ready
+                        sp.cycle = cyc_id
+                        if ctl is not None and sp.slot < 0:
+                            sp.slot = ctl.slot_of(e)
         cycle_chunks = 0
         for batch in responses:
             cycle_chunks += self._perform_operation(batch)
@@ -515,6 +601,12 @@ class CollectiveEngine:
                     "chunks": cycle_chunks,
                     "inflight": len(self._inflight)
                     if self._inflight is not None else 0})
+        if tr is not None and responses:
+            ctl = self.controller
+            tr.cycle(ctl.rounds if ctl is not None else self._cycle_index,
+                     t_trace0, t_drain, t_ready, time.monotonic(),
+                     sum(len(b) for b in responses),
+                     self.last_negotiation_us if ctl is not None else 0.0)
         if self.autotuner is not None and self.autotuner.tuning:
             nbytes = sum(e.tensor.nbytes for b in responses for e in b
                          if e.tensor is not None)
@@ -575,10 +667,15 @@ class CollectiveEngine:
                         # reusing the name renegotiates from scratch.
                         self.controller.forget(e)
             tl = self._state.timeline
+            tr0 = self.tracer
             for e, msg in errored:
                 e.error = NegotiationError(msg)
                 if tl is not None:
                     tl.end_activity(e.name, "QUEUE")
+                sp = _live_span(e) if tr0 is not None else None
+                if sp is not None:
+                    sp.error = True
+                    tr0.commit(sp)
                 self.queue.mark_done(e)
                 # A failed entry is finished: clear the stall inspector's
                 # live-stall state (and warn latch) like any completion.
@@ -649,6 +746,16 @@ class CollectiveEngine:
         except BaseException as exc:  # noqa: BLE001 - propagate to waiters
             self._settle_batch(batch, None, exc)
             return 0
+        tr = self.tracer
+        if tr is not None:
+            # copy_in phase closes: the fused program (fetch/build + the
+            # async XLA launch — the fusion copy-in lives inside it) has
+            # been dispatched; reduce runs from here to settle.
+            t_launch = time.monotonic()
+            for e in batch:
+                sp = _live_span(e)
+                if sp is not None:
+                    sp.t_launch = t_launch
         self.pipeline_chunks_total += chunks
         self.pipeline_dispatches += 1
         ring = self._inflight_ring()
@@ -668,6 +775,8 @@ class CollectiveEngine:
         watcher): assign results/error, close timeline lanes, release
         waiters.  Must never raise — a lost settle hangs synchronize()."""
         tl = self._state.timeline
+        tr = self.tracer
+        t_result = time.monotonic() if tr is not None else 0.0
         if error is None:
             for e, r in zip(batch, results):
                 e.result = r
@@ -680,6 +789,12 @@ class CollectiveEngine:
                     if inflight:
                         tl.end_activity(e.name, "INFLIGHT")
                     tl.end_activity(e.name, f"XLA_{e.ctype.name}")
+                sp = _live_span(e) if tr is not None else None
+                if sp is not None:
+                    sp.t_result = t_result
+                    sp.t_done = time.monotonic()
+                    sp.error = error is not None
+                    tr.commit(sp)
                 self.queue.mark_done(e)
                 self.stall.progressed(e.name)
             except Exception:  # noqa: BLE001 - keep settling the rest
@@ -760,6 +875,10 @@ class CollectiveEngine:
             e = TensorTableEntry(handle=handle, name=name,
                                  ctype=CollectiveType.BARRIER, tensor=None,
                                  enqueue_time=now)
+            # Tracer marker: synthesized entries never drain, so their
+            # span is claimed at the ready verdict instead (and ONLY for
+            # entries carrying this flag).
+            e.trace_synthesized = True
             if self.sanitizer is not None:
                 # The peer advanced its per-set seq by submitting; advance
                 # ours too or every post-join collective mismatches on seq.
@@ -794,6 +913,7 @@ class CollectiveEngine:
             root_rank=root, prescale_factor=pre, postscale_factor=post,
             group_id=group_id, donate=True, compression=comp,
             enqueue_time=now)
+        e.trace_synthesized = True
         if self.sanitizer is not None:
             self.sanitizer.observe_synthesized(e)
         return e

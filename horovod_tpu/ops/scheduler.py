@@ -180,9 +180,14 @@ class StallInspector:
                 extra = ""
                 if missing_ranks and e.name in missing_ranks:
                     extra = f"; ranks not yet submitted: {missing_ranks[e.name]}"
+                # With tracing armed the entry carries a lifecycle span:
+                # name the phase it is stuck in, not just that it waits.
+                # Duck-typed: a dropped-claim sentinel has no phase_name.
+                pn = getattr(getattr(e, "span", None), "phase_name", None)
+                phase = f" (stuck in phase {pn()})" if pn else ""
                 log.warning(
                     "Stall detected: tensor %r has waited %.1fs for "
-                    "negotiation/execution%s", e.name, age, extra)
+                    "negotiation/execution%s%s", e.name, age, phase, extra)
             if (self.shutdown_after_s > 0 and age > self.shutdown_after_s):
                 raise RuntimeError(
                     f"Collective on tensor {e.name!r} stalled for {age:.1f}s "

@@ -25,6 +25,8 @@ import subprocess
 import sys
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..utils.timeline import per_rank_filename
+
 
 @dataclasses.dataclass
 class HostSpec:
@@ -100,6 +102,13 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "(1 = settle inline, no overlap)")
     p.add_argument("--timeline-filename", default=None)
     p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--trace-filename", default=None,
+                   help="Arm distributed collective tracing and write one "
+                        "trace file per rank at <base>.<rank>; merge with "
+                        "`python -m horovod_tpu.trace` (docs/timeline.md)")
+    p.add_argument("--trace-ring", type=int, default=None,
+                   help="Preallocated trace span-ring capacity "
+                        "(default 4096)")
     p.add_argument("--monitor", action="store_true",
                    help="Enable the cross-rank telemetry & health "
                         "subsystem (docs/monitoring.md)")
@@ -289,6 +298,7 @@ def tuning_env(args) -> Dict[str, str]:
             ("stall_shutdown_time", "HOROVOD_STALL_SHUTDOWN_TIME", 1),
             ("monitor_port", "HOROVOD_MONITOR_PORT", 1),
             ("monitor_interval", "HOROVOD_MONITOR_INTERVAL", 1),
+            ("trace_ring", "HOROVOD_TRACE_RING", 1),
             ("round_timeout", "HOROVOD_ROUND_TIMEOUT_S", 1),
             ("connect_retries", "HOROVOD_CONNECT_RETRIES", 1),
             ("connect_backoff_ms", "HOROVOD_CONNECT_BACKOFF_MS", 1)):
@@ -377,7 +387,11 @@ def worker_envs(args, hosts: List[HostSpec],
             }
             env |= tuning_env(args)
             if args.timeline_filename:
-                env["HOROVOD_TIMELINE"] = f"{args.timeline_filename}.{rank}"
+                env["HOROVOD_TIMELINE"] = per_rank_filename(
+                    args.timeline_filename, rank)
+            if getattr(args, "trace_filename", None):
+                env["HOROVOD_TRACE"] = per_rank_filename(
+                    args.trace_filename, rank)
             envs.append(env)
             rank += 1
     return envs

@@ -110,8 +110,16 @@ def tpu_vm_worker_env(args, endpoints: Sequence[TPUEndpoint],
         "HOROVOD_ONE_PROC_PER_HOST": "1",
     }
     env |= tuning_env(args)   # same knob forwarding as every other backend
+    # Per-rank output files share ONE suffix scheme across every backend
+    # (utils.timeline.per_rank_filename); worker_id is this process's
+    # global rank in one-proc-per-host mode.
+    from ..utils.timeline import per_rank_filename
     if getattr(args, "timeline_filename", None):
-        env["HOROVOD_TIMELINE"] = f"{args.timeline_filename}.{worker_id}"
+        env["HOROVOD_TIMELINE"] = per_rank_filename(
+            args.timeline_filename, worker_id)
+    if getattr(args, "trace_filename", None):
+        env["HOROVOD_TRACE"] = per_rank_filename(
+            args.trace_filename, worker_id)
     return env
 
 

@@ -14,7 +14,21 @@ import json
 import os
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Union
+
+
+def per_rank_filename(base: str, rank: Union[int, str]) -> str:
+    """THE per-rank suffix scheme for trace/timeline output files.
+
+    Every launch path must produce the same names for the same world —
+    ``<base>.<global rank>`` — or the merge tool's glob (``<base>.*``) and
+    the docs' examples break on one backend: ``runner/run.py`` suffixes
+    with the worker's global rank, ``runner/tpu_vm.py`` with the pod
+    worker id (the process's global rank in one-proc-per-host mode), and
+    elastic workers suffix at rendezvous time with their assigned rank
+    (the driver cannot know ranks before assignment).
+    """
+    return f"{base}.{rank}"
 
 
 class Timeline:

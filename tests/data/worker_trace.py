@@ -1,0 +1,115 @@
+"""Tracing-subsystem acceptance worker (the tentpole's two-process proof).
+
+Run via torovodrun with ``--trace-filename`` (the launcher suffixes the
+base per rank), HOROVOD_MONITOR=1 and a small HOROVOD_MONITOR_INTERVAL.
+Proves, across REAL processes:
+
+1. tracing is armed from the launcher knob and every committed span
+   carries the lock-step cycle id (the cross-rank correlation key);
+2. the steady-state frame guard holds with tracing + monitoring ON —
+   warm cycles still exchange zero per-tensor metadata, and the MON1
+   digest blob stays inside the size cap;
+3. each rank's trace digest reaches the PEER through the side-channel
+   (aggregation table carries per-cycle phase rows);
+4. the per-rank trace files are written and flushed on shutdown — the
+   launcher-side test then merges them with ``python -m
+   horovod_tpu.trace`` and asserts per-rank lanes + matched cycle flows.
+
+Prints ``TRACE_OK`` on success.
+"""
+
+import json
+import os
+import time
+
+# One rank per process, one CPU device each; gloo for cross-process XLA
+# collectives (same preamble as worker_collectives.py).
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+
+SHAPES = [(31,), (17,), (64,)]
+
+
+def train_step(value):
+    xs = [np.full(s, value * (i + 1), np.float32)
+          for i, s in enumerate(SHAPES)]
+    outs = hvd.grouped_allreduce(xs, name="grad", op=hvd.Sum)
+    world = hvd.size()
+    got = np.asarray(hvd.to_local(outs[0])).reshape(SHAPES[0])
+    np.testing.assert_allclose(
+        got, np.full(SHAPES[0], world * value, np.float32), rtol=1e-5)
+
+
+def main():
+    hvd.init()
+    rank = hvd.rank()
+    st = basics._get_state()
+    eng, ctl, mon = st.engine, st.controller, st.monitor
+    assert ctl is not None, "worker needs the torovodrun controller"
+    assert mon is not None, "HOROVOD_MONITOR=1 must install the agent"
+    tracer = eng.tracer
+    assert tracer is not None, "--trace-filename must arm the tracer"
+    trace_file = st.config.trace_filename
+    assert trace_file.endswith(f".{rank}"), (
+        f"per-rank suffix scheme broken: {trace_file!r}")
+
+    # ---- 1. steady state: fixed step count on both ranks.
+    for k in range(15):
+        train_step(1.0 + k)
+        time.sleep(0.05)
+    assert tracer.spans_committed >= 15 * len(SHAPES), (
+        tracer.spans_committed)
+    summary = tracer.phase_summary()
+    assert summary["phases_us"] is not None
+    # Phase sums partition the measured lifecycle (the bench consistency).
+    drift = abs(summary["phase_sum_us"] - summary["cycle_us"])
+    assert drift <= max(1.0, 0.01 * summary["cycle_us"]), summary
+
+    # ---- 2. frame guard with tracing + monitoring ON, digest size cap.
+    stats = ctl.cache_stats
+    full_before = stats.full_announces
+    for k in range(5):
+        train_step(50.0 + k)
+    assert stats.full_announces == full_before, (
+        f"tracing pushed {stats.full_announces - full_before} cycles "
+        f"off the bitvector fast path")
+    assert stats.bit_announces >= 5 * len(SHAPES)
+    digest_blob = json.dumps(tracer.digest(),
+                             separators=(",", ":")).encode()
+    assert len(digest_blob) <= 8192, len(digest_blob)
+
+    # ---- 3. the PEER's digest arrived through the MON1 side-channel.
+    peer = 1 - rank
+    deadline = time.time() + 20
+    peer_trace = None
+    while time.time() < deadline and not peer_trace:
+        snap = mon.aggregator.snapshot_of(peer)
+        tr = (snap or {}).get("trace") or {}
+        if tr.get("cycles"):
+            peer_trace = tr
+            break
+        train_step(100.0 + time.time() % 1)
+        time.sleep(0.1)
+    assert peer_trace is not None, (
+        f"rank {rank}: no trace digest from rank {peer}: "
+        f"{mon.aggregator.table()}")
+    # Digest rows carry the shared cycle ids and the five phases.
+    row = peer_trace["cycles"][-1]
+    assert len(row) == 2 + 5 and row[0] > 0, row
+
+    print("TRACE_OK", flush=True)
+    hvd.shutdown()     # stops the engine -> closes/flushes the trace file
+    assert os.path.exists(trace_file), trace_file
+
+
+if __name__ == "__main__":
+    main()

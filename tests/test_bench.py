@@ -43,6 +43,15 @@ def test_bench_minimal_mode():
     assert out["value"] and out["value"] > 0
     assert out["errors"] == {}
     assert out["world"] == 8
+    # Trace A/B on every line: the armed window's phase breakdown must
+    # partition the measured lifecycle (queue+negotiation+copy_in+reduce+
+    # drain re-adds to cycle_us), and the overhead bound is recorded.
+    ab = out["trace_ab"]
+    assert set(ab["phases_us"]) == {"queue", "negotiation", "copy_in",
+                                    "reduce", "drain"}
+    assert ab["spans"] > 0 and ab["cycle_us"] > 0
+    assert ab["phase_sum_consistent"] is True, ab
+    assert "within_noise" in ab and "overhead_pct" in ab
 
 
 def test_bench_default_resnet():

@@ -60,6 +60,16 @@ def test_self_lint_covers_monitor_package():
     assert not findings, "\n".join(f.render() for f in findings)
 
 
+def test_self_lint_covers_trace_package():
+    """Same explicit coverage for the tracing subsystem: core/writer/
+    merge/analyze/CLI must parse and lint clean."""
+    tr_dir = os.path.join(REPO, "horovod_tpu", "trace")
+    files = [f for f in os.listdir(tr_dir) if f.endswith(".py")]
+    assert len(files) >= 5, files       # core/writer/merge/analyze/CLI
+    findings = lint_paths([tr_dir])
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
 def test_allowlist_entries_still_fire():
     """Stale allowlist entries (fixed code, moved lines) must be pruned."""
     findings = lint_paths([os.path.join(REPO, "horovod_tpu"),

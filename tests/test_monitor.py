@@ -471,6 +471,12 @@ importlib.import_module('horovod_tpu.monitor')
 importlib.import_module('horovod_tpu.monitor.__main__')
 importlib.import_module('horovod_tpu.monitor.http')
 importlib.import_module('horovod_tpu.analysis.findings')
+# Distributed tracing: the span core, the merge/analyze halves and the CLI
+# must run standalone (operators merge traces on machines without jax).
+importlib.import_module('horovod_tpu.trace')
+importlib.import_module('horovod_tpu.trace.merge')
+importlib.import_module('horovod_tpu.trace.analyze')
+importlib.import_module('horovod_tpu.trace.__main__')
 # Control-plane fault tolerance: the harness and the typed error taxonomy
 # carry the jax-free fault tests and the acceptance workers' arming path.
 importlib.import_module('horovod_tpu.testing')
@@ -482,10 +488,11 @@ print('PURITY_OK')
 
 
 def test_monitor_and_scheduler_import_without_jax():
-    """Fast-tier purity: the monitor package, ops/scheduler.py, the
-    fault-injection harness (horovod_tpu/testing) and the control-plane
-    exception taxonomy must be importable with jax imports hard-blocked —
-    they carry the jax-free unit-test tier and the standalone CLI."""
+    """Fast-tier purity: the monitor package, ops/scheduler.py, the trace
+    package, the fault-injection harness (horovod_tpu/testing) and the
+    control-plane exception taxonomy must be importable with jax imports
+    hard-blocked — they carry the jax-free unit-test tier and the
+    standalone CLIs."""
     res = subprocess.run(
         [sys.executable, "-c", _PURITY_SRC,
          os.path.join(REPO, "horovod_tpu")],

@@ -542,6 +542,60 @@ def test_torovodrun_monitor_acceptance():
         f"stderr:\n{res.stderr[-3000:]}")
 
 
+WORKER_TRACE = os.path.join(REPO, "tests", "data", "worker_trace.py")
+
+
+def test_torovodrun_trace_acceptance(tmp_path):
+    """ISSUE 6 acceptance: two ranks run with --trace-filename +
+    HOROVOD_MONITOR=1; in-worker assertions cover the armed tracer, the
+    phase-sum/lifecycle consistency, the steady-state frame guard with
+    tracing ON (digest inside the size cap) and the peer's digest arriving
+    over the MON1 side-channel.  Launcher-side, `python -m
+    horovod_tpu.trace` merges the two per-rank files into one chrome trace
+    with a lane per rank and cycle-correlated flow arrows."""
+    base = str(tmp_path / "tr")
+    res = _run_torovodrun(2, WORKER_TRACE, timeout=300,
+                          extra_args=("--trace-filename", base),
+                          extra_env={
+                              "HOROVOD_MONITOR": "1",
+                              "HOROVOD_MONITOR_INTERVAL": "0.2",
+                          })
+    ok = res.stdout.count("TRACE_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+    assert os.path.exists(base + ".0") and os.path.exists(base + ".1")
+    merged_path = str(tmp_path / "merged.json")
+    import json
+    merge = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.trace", base,
+         "-o", merged_path, "--report"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert merge.returncode == 0, (merge.stdout, merge.stderr)
+    assert "critical-path attribution" in merge.stdout
+    with open(merged_path) as fh:
+        merged = json.load(fh)
+    ev = merged["traceEvents"]
+    # One lane per rank...
+    names = {e["args"]["name"] for e in ev
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert names == {"rank 0", "rank 1"}, names
+    assert {e["pid"] for e in ev if e.get("ph") == "X"} == {0, 1}
+    # ...with cycle-correlated flows: each flow id starts on one rank and
+    # finishes on the other (the same lock-step round on both lanes).
+    starts = {e["id"]: e["pid"] for e in ev if e.get("ph") == "s"}
+    ends = {e["id"]: e["pid"] for e in ev if e.get("ph") == "f"}
+    common = set(starts) & set(ends)
+    assert common, (starts, ends)
+    assert all(starts[c] != ends[c] for c in common)
+    # Both ranks' tensor lanes carry the five phases.
+    phases = {e["name"] for e in ev if e.get("ph") == "X"
+              and e.get("tid", 0) != 0}
+    assert {"QUEUE", "NEGOTIATION", "COPY_IN", "REDUCE",
+            "DRAIN"} <= phases, phases
+
+
 WORKER_FAULTS = os.path.join(REPO, "tests", "data", "worker_faults.py")
 
 

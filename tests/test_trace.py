@@ -1,0 +1,568 @@
+"""Distributed collective tracing (tier-1, no jax in the core).
+
+Covers the jax-free trace package (span ring, phase accounting, per-rank
+writer, cross-rank merge with cycle flows, critical-path analyzer, CLI),
+the disarmed-is-None contract, the MON1 digest riding the monitor
+side-channel through the real native server with the steady-state frame
+guard intact, HVD302 phase enrichment, per-rank filename unification, and
+the purity guard extension lives in tests/test_monitor.py.
+"""
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.controller import TCPController
+from horovod_tpu.monitor import MetricRegistry, MonitorAgent
+from horovod_tpu.trace import (
+    DIGEST_MAX_CYCLES, DIGEST_MAX_OPEN, PHASES, TraceRecorder, TraceWriter,
+    maybe_install,
+)
+from horovod_tpu.trace.analyze import critical_path, phase_summary
+from horovod_tpu.trace.merge import (
+    RankTrace, expand_inputs, load_trace_file, merge_snapshot, merge_traces,
+)
+from horovod_tpu.utils.timeline import per_rank_filename
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _stamp(span, t0, q=0.001, n=0.002, c=0.003, r=0.004, d=0.005):
+    """Complete a claimed span with phase durations (seconds)."""
+    span.t_ready = t0 + q + n
+    span.t_launch = t0 + q + n + c
+    span.t_result = t0 + q + n + c + r
+    span.t_done = t0 + q + n + c + r + d
+    return span
+
+
+def _make_span(rec, name, cycle, t0, **durs):
+    span = rec.begin(name, t0, t0 + durs.get("q", 0.001))
+    assert span is not None
+    span.cycle = cycle
+    _stamp(span, t0, **durs)
+    rec.commit(span)
+    return span
+
+
+# ------------------------------------------------------------------- core
+def test_span_stamping_and_phase_partition():
+    rec = TraceRecorder(capacity=64)
+    t0 = 100.0
+    span = rec.begin("grad.0", t0, t0 + 0.001)
+    assert span.phase_name() == "negotiation"     # drained, not ready yet
+    span.cycle = 7
+    _stamp(span, t0, q=0.001, n=0.002, c=0.003, r=0.004, d=0.005)
+    rec.commit(span)
+    phases = span.phases_us()
+    assert phases == {"queue": pytest.approx(1000, rel=1e-6),
+                      "negotiation": pytest.approx(2000, rel=1e-6),
+                      "copy_in": pytest.approx(3000, rel=1e-6),
+                      "reduce": pytest.approx(4000, rel=1e-6),
+                      "drain": pytest.approx(5000, rel=1e-6)}
+    # The five phases partition the lifecycle: sums re-add exactly.
+    assert sum(phases.values()) == pytest.approx(span.lifecycle_us(),
+                                                 rel=1e-9)
+    summary = rec.phase_summary()
+    assert summary["spans"] == 1
+    assert summary["phase_sum_us"] == pytest.approx(summary["cycle_us"],
+                                                    abs=0.05)
+
+
+def test_commit_is_idempotent_and_partial_spans_tolerated():
+    rec = TraceRecorder(capacity=64)
+    span = rec.begin("t", 1.0, 1.001)
+    span.error = True
+    rec.commit(span)
+    rec.commit(span)                       # double settle must not double
+    assert rec.spans_committed == 1
+    # Only queue elapsed; later phases report 0, nothing negative.
+    phases = span.phases_us()
+    assert phases["queue"] > 0
+    assert all(phases[p] == 0.0 for p in PHASES[1:])
+
+
+def test_ring_reuses_slots_and_bounds_memory():
+    rec = TraceRecorder(capacity=16)       # floor capacity
+    seen = set()
+    for i in range(100):
+        span = rec.begin(f"g.{i}", float(i), float(i) + 0.1)
+        seen.add(id(span))
+        span.cycle = i
+        _stamp(span, float(i))
+        rec.commit(span)
+    # Zero allocation on the hot path: span objects are recycled in place.
+    assert len(seen) <= 16
+    assert rec.spans_committed == 100
+    assert rec.dropped == 0
+
+
+def test_ring_full_of_open_spans_drops_claims_not_blocks():
+    rec = TraceRecorder(capacity=16)
+    held = [rec.begin(f"h.{i}", 0.0, 0.1) for i in range(16)]
+    assert all(s is not None for s in held)
+    assert rec.begin("overflow", 0.0, 0.1) is None
+    assert rec.dropped == 1
+    rec.commit(_stamp(held[0], 0.0))
+    assert rec.begin("retry", 0.0, 0.1) is not None
+
+
+def test_disarmed_recorder_is_none():
+    from horovod_tpu.common.config import Config
+    assert maybe_install(Config()) is None
+    cfg = Config()
+    cfg.trace = True
+    rec = maybe_install(cfg, rank=3)
+    assert isinstance(rec, TraceRecorder) and rec.rank == 3
+
+
+def test_trace_env_parsing(monkeypatch):
+    from horovod_tpu.common.config import Config
+    monkeypatch.delenv("HOROVOD_TRACE", raising=False)
+    monkeypatch.delenv("HVD_TPU_TRACE", raising=False)
+    assert Config.from_env().trace is False
+    monkeypatch.setenv("HOROVOD_TRACE", "1")
+    cfg = Config.from_env()
+    assert cfg.trace is True and cfg.trace_filename == ""
+    monkeypatch.setenv("HOROVOD_TRACE", "/tmp/tr.json")
+    cfg = Config.from_env()
+    assert cfg.trace is True and cfg.trace_filename == "/tmp/tr.json"
+    monkeypatch.setenv("HOROVOD_TRACE", "0")
+    assert Config.from_env().trace is False
+    monkeypatch.setenv("HOROVOD_TRACE_RING", "128")
+    monkeypatch.setenv("HOROVOD_TRACE", "1")
+    assert Config.from_env().trace_ring == 128
+
+
+def test_digest_is_size_capped():
+    rec = TraceRecorder(capacity=64)
+    for cyc in range(200):                 # far over DIGEST_MAX_CYCLES
+        rec.cycle(cyc, 0.0, 0.001, 0.002, 0.003, 8, 42.0)
+        _make_span(rec, f"g.{cyc % 8}", cyc, float(cyc))
+    for i in range(40):                    # open spans over DIGEST_MAX_OPEN
+        rec.begin(f"open.{i}", 0.0, 0.1)
+    d = rec.digest()
+    assert len(d["cycles"]) == DIGEST_MAX_CYCLES
+    assert len(d["open"]) <= DIGEST_MAX_OPEN
+    assert set(d["phases"]) == set(PHASES)
+    blob = json.dumps(d, separators=(",", ":")).encode()
+    assert len(blob) <= 8192, len(blob)    # far inside the 48KB blob guard
+
+
+def test_phase_histograms_feed_registry():
+    rec = TraceRecorder(capacity=64)
+    _make_span(rec, "g", 1, 10.0)
+    hists = rec.phase_histograms()
+    assert set(hists) == set(PHASES)
+    counts, sum_us, count = hists["reduce"]
+    assert count == 1 and sum_us == pytest.approx(4000, rel=1e-6)
+    reg = MetricRegistry()
+    h = reg.histogram("hvd_trace_reduce_us", buckets=rec.buckets)
+    h.set_cumulative(counts, sum_us, count)
+    snap = h.snapshot_value()
+    assert snap["count"] == 1 and snap["sum"] == pytest.approx(4000, abs=0.1)
+    # set_cumulative never regresses (mirrored totals, like set_total).
+    h.set_cumulative([0] * len(counts), 0, 0)
+    assert h.snapshot_value()["count"] == 1
+    with pytest.raises(ValueError):
+        h.set_cumulative([1], 1, 1)
+
+
+# ------------------------------------------------------------ writer/merge
+def _write_rank_file(tmp_path, rank, cycles, anchor_wall=1000.0,
+                     phase_scale=1.0):
+    """A per-rank trace file with `cycles` cycles of 2 tensors each."""
+    path = str(tmp_path / per_rank_filename("tr", rank))
+    writer = TraceWriter(path, rank=rank)
+    rec = TraceRecorder(capacity=64, writer=writer, rank=rank)
+    rec.anchor_wall, rec.anchor_mono = anchor_wall, 0.0
+    writer.header(rank=rank, anchor_wall=anchor_wall, anchor_mono=0.0)
+    for cyc in range(1, cycles + 1):
+        t0 = cyc * 1.0
+        rec.cycle(cyc, t0, t0 + 0.001, t0 + 0.002, t0 + 0.003, 2, 50.0)
+        for j in range(2):
+            _make_span(rec, f"g.{j}", cyc, t0,
+                       n=0.002 * phase_scale, r=0.004 * phase_scale)
+    rec.close()
+    return path
+
+
+def test_writer_roundtrip_and_merge_has_lanes_and_flows(tmp_path):
+    p0 = _write_rank_file(tmp_path, 0, cycles=3)
+    p1 = _write_rank_file(tmp_path, 1, cycles=3, phase_scale=3.0)
+    rt0, rt1 = load_trace_file(p0), load_trace_file(p1)
+    assert rt0.rank == 0 and rt1.rank == 1
+    assert len(rt0.spans) == 6 and len(rt0.cycles) == 3
+    merged = merge_traces([rt0, rt1])
+    ev = merged["traceEvents"]
+    pids = {e["pid"] for e in ev if e.get("ph") == "X"}
+    assert pids == {0, 1}, "one lane per rank"
+    names = {e["args"]["name"] for e in ev if e["ph"] == "M"
+             and e["name"] == "process_name"}
+    assert names == {"rank 0", "rank 1"}
+    # Phase slices present for every phase.
+    slice_names = {e["name"] for e in ev if e.get("ph") == "X"}
+    for p in PHASES:
+        assert p.upper() in slice_names
+    # Cycle-correlated flows: every common cycle id has a flow start on
+    # one rank and a flow finish on the other.
+    starts = {e["id"]: e["pid"] for e in ev if e.get("ph") == "s"}
+    ends = {e["id"]: e["pid"] for e in ev if e.get("ph") == "f"}
+    assert set(starts) == set(ends) == {1, 2, 3}
+    assert all(starts[c] != ends[c] for c in starts)
+
+
+def test_expand_inputs_globs_rank_suffixes(tmp_path):
+    p0 = _write_rank_file(tmp_path, 0, cycles=1)
+    p1 = _write_rank_file(tmp_path, 1, cycles=1)
+    assert expand_inputs([str(tmp_path / "tr")]) == [p0, p1]
+    assert expand_inputs([p1]) == [p1]
+    with pytest.raises(FileNotFoundError):
+        expand_inputs([str(tmp_path / "nope")])
+
+
+def test_cli_merges_and_reports(tmp_path, capsys):
+    from horovod_tpu.trace.__main__ import main
+    _write_rank_file(tmp_path, 0, cycles=3)
+    _write_rank_file(tmp_path, 1, cycles=3, phase_scale=2.0)
+    out = str(tmp_path / "merged.json")
+    rc = main([str(tmp_path / "tr"), "-o", out, "--report"])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "critical-path attribution" in text
+    assert "wrote" in text
+    with open(out) as fh:
+        merged = json.load(fh)
+    assert {e["pid"] for e in merged["traceEvents"]} >= {0, 1}
+
+
+def test_cli_rejects_bad_usage(tmp_path, capsys):
+    from horovod_tpu.trace.__main__ import main
+    with pytest.raises(SystemExit):
+        main([])
+    rc = main([str(tmp_path / "missing")])
+    assert rc == 1
+
+
+# ---------------------------------------------------------------- analyzer
+def test_analyzer_agrees_with_recorder_on_partial_spans():
+    """One attribution rule: a span missing a mid-stamp (batch failed
+    before the launch stamp) carries the elapsed time into the phase that
+    contains it, identically in the live recorder and the offline
+    analyzer — the --report can never disagree with the MON1 digest."""
+    from horovod_tpu.trace.analyze import _span_phases_us
+    line = {"e": 1.0, "d": 1.001, "r": 1.003, "l": 0.0, "x": 1.009,
+            "f": 1.010}
+    offline = _span_phases_us(line)
+    span = TraceRecorder(capacity=16).begin("t", 1.0, 1.001)
+    span.t_ready, span.t_launch = 1.003, 0.0
+    span.t_result, span.t_done = 1.009, 1.010
+    live = span.phases_us()
+    assert offline == live
+    assert offline["copy_in"] == 0.0
+    assert offline["reduce"] == pytest.approx(6000, rel=1e-6)
+    # Nothing vanishes: the full lifecycle is attributed.
+    assert sum(offline.values()) == pytest.approx(10000, rel=1e-6)
+
+def test_critical_path_names_slowest_rank_and_attributes_phases(tmp_path):
+    p0 = _write_rank_file(tmp_path, 0, cycles=4)
+    p1 = _write_rank_file(tmp_path, 1, cycles=4, phase_scale=5.0)
+    ranks = [load_trace_file(p0), load_trace_file(p1)]
+    cp = critical_path(ranks)
+    assert len(cp["cycles"]) == 4
+    # Rank 1's phases are 5x: it gates every lock-step cycle.
+    assert all(row["slowest_rank"] == 1 for row in cp["cycles"])
+    assert cp["slowest_counts"] == {1: 4}
+    att = cp["attributed_us"]
+    # reduce (scaled 0.020s/span) dominates over drain (0.005s/span).
+    assert att["reduce"] > att["drain"] > 0
+    summary = phase_summary(ranks)
+    assert summary["fleet"]["queue"]["spans"] == 16
+
+
+def test_merge_snapshot_builds_digest_lanes():
+    dump = {"table": {
+        "0": {"trace": {"cycles": [[5, 2, 10, 20, 30, 40, 5]]}},
+        "1": {"trace": {"cycles": [[5, 2, 12, 25, 33, 44, 6]]}},
+    }}
+    merged = merge_snapshot(dump)
+    ev = merged["traceEvents"]
+    assert {e["pid"] for e in ev if e.get("ph") == "X"} == {0, 1}
+    assert {e["id"] for e in ev if e.get("ph") == "s"} == {5}
+    assert {e["id"] for e in ev if e.get("ph") == "f"} == {5}
+
+
+# ------------------------------------------- side-channel + frame guard
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class E:
+    def __init__(self, name, shape=(4,)):
+        self.name = name
+        self.tensor = np.zeros((2,) + tuple(shape), np.float32)
+
+
+class FakeEngine:
+    """Duck-typed engine surface the MonitorAgent collectors read."""
+
+    def __init__(self, tracer=None):
+        self.cycle_count = 10
+        self.cycle_us_total = 1000.0
+        self.last_cycle_ts = time.time()
+        self._cycle_index = 10
+        self.negotiation_us_total = 0.0
+        self.negotiation_cycles = 0
+        self.pipeline_chunks_total = 0
+        self.pipeline_dispatches = 0
+        self.monitor = None
+        self.tracer = tracer
+
+
+def _pair(fn, cache_capacity=2048):
+    port = _free_port()
+    results, errors = {}, {}
+    peer_done = threading.Event()
+
+    def worker(rank):
+        ctl = TCPController("127.0.0.1", port, rank=rank, world=2,
+                            stall_warn_s=60.0,
+                            cache_capacity=cache_capacity)
+        try:
+            results[rank] = fn(ctl, rank)
+        except Exception as exc:  # noqa: BLE001 - surfaced by the assert
+            errors[rank] = exc
+        finally:
+            if rank == 1:
+                peer_done.set()
+                ctl.shutdown()
+            else:
+                peer_done.wait(timeout=20)
+                ctl.shutdown()
+
+    t1 = threading.Thread(target=worker, args=(1,), daemon=True)
+    t1.start()
+    worker(0)
+    t1.join(timeout=20)
+    assert not errors, errors
+    assert set(results) == {0, 1}, results
+    return results
+
+
+def _steps(ctl, make_entries, n_steps, max_rounds=20):
+    for _ in range(n_steps):
+        entries = list(make_entries())
+        got = []
+        for _round in range(max_rounds):
+            if not entries:
+                break
+            ready, errs = ctl.negotiate(entries)
+            assert not errs, errs
+            got += [e.name for e in ready]
+            entries = [e for e in entries if e.name not in set(got)]
+        assert not entries, f"never ready: {[e.name for e in entries]}"
+
+
+def test_frame_guard_holds_with_tracing_digests_riding_mon1():
+    """CI satellite: with tracing armed AND a MonitorAgent attached, the
+    trace digest rides the MON1 side-channel (peers decode it from the
+    aggregation table), the digest blob stays inside the size cap, and
+    steady-state warm-path frames stay byte-stable — zero per-tensor
+    metadata, the same fixed handful of negotiation-critical bytes."""
+    names = [f"grad.{i}" for i in range(8)]
+
+    def fn(ctl, rank):
+        tracer = TraceRecorder(capacity=256, rank=rank)
+        for cyc in range(1, 6):
+            tracer.cycle(cyc, cyc * 1.0, cyc + 0.001, cyc + 0.002,
+                         cyc + 0.003, 8, 40.0)
+            _make_span(tracer, f"grad.{cyc % 8}", cyc, cyc * 1.0)
+        agent = MonitorAgent(engine=FakeEngine(tracer=tracer),
+                             controller=ctl, rank=rank, world=2,
+                             interval_s=0.05)
+        blob = agent.encode_frame()
+        assert blob is not None and len(blob) <= 48 * 1024
+        assert json.loads(blob.decode())["trace"]["cycles"], \
+            "digest must ride the snapshot"
+        mk = lambda: [E(n) for n in names]            # noqa: E731
+        _steps(ctl, mk, 2)                            # warm-up: learn slots
+        time.sleep(0.06)                              # arm the interval
+        st = ctl.cache_stats
+        full_before = st.full_announces
+        bytes_before = ctl.bytes_sent
+        mon_before = ctl.monitor_bytes_sent
+        _steps(ctl, mk, 5)
+        assert st.full_announces == full_before, (
+            "tracing pushed steady-state cycles off the bitvector path")
+        mon_bytes = ctl.monitor_bytes_sent - mon_before
+        per_cycle = (ctl.bytes_sent - bytes_before - mon_bytes) / 5
+        assert per_cycle <= 16, per_cycle
+        deadline = time.monotonic() + 10
+        while (len(agent.aggregator.ranks()) < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.06)
+            _steps(ctl, mk, 1)
+        peer = 1 - rank
+        snap = agent.aggregator.snapshot_of(peer)
+        assert snap is not None, agent.aggregator.table()
+        assert snap.get("trace", {}).get("cycles"), (
+            f"rank {rank}: peer digest missing: {snap.get('trace')}")
+        return True
+
+    _pair(fn)
+
+
+def test_hvd302_report_quotes_laggard_phase_and_cycle_breakdown():
+    """Satellite: the peer attribution block names the phase the laggard
+    is stuck in and its last completed cycle's phase breakdown, alongside
+    the ledger tail."""
+    agent = MonitorAgent(engine=FakeEngine(), rank=0, world=2)
+    agent.aggregator.update(1, {
+        "ledger": ["#12 grad.7 [allreduce|float32|(8,)] at train.py:50"],
+        "trace": {"v": 1, "open": {"grad.9": "negotiation"},
+                  "cycles": [[41, 8, 100, 50, 200, 300, 10],
+                             [42, 8, 110, 60, 210, 310, 12]]},
+    })
+    report = agent.peer_ledger_report()
+    assert "rank 1 last submissions" in report
+    assert "rank 1 currently in phase negotiation: grad.9" in report
+    assert "rank 1 last cycle 42 (8 tensors)" in report
+    assert "copy_in=210us" in report and "reduce=310us" in report
+    # Phase-only peers (tracing without sanitizer ledger) still report.
+    agent2 = MonitorAgent(engine=FakeEngine(), rank=0, world=2)
+    agent2.aggregator.update(1, {
+        "trace": {"open": {"g": "reduce"}, "cycles": []}})
+    assert "currently in phase reduce" in agent2.peer_ledger_report()
+    # The canonical skew stall: the laggard hasn't ENQUEUED yet, so its
+    # digest has no open spans — the last-cycle breakdown must still
+    # make it into the report.
+    agent3 = MonitorAgent(engine=FakeEngine(), rank=0, world=2)
+    agent3.aggregator.update(1, {
+        "trace": {"cycles": [[9, 3, 10, 20, 30, 40, 5]]}})
+    assert "rank 1 last cycle 9 (3 tensors)" in agent3.peer_ledger_report()
+
+
+def test_dropped_claim_latches_entry_untraceable():
+    """A tensor whose drain-time span claim was dropped (ring full) must
+    never be re-claimed on a later drain — that would fold its elapsed
+    negotiation time into the queue phase and re-count `dropped`."""
+    from horovod_tpu.ops.engine import _SPAN_DROPPED, _live_span
+
+    class Entry:
+        span = None
+
+    rec = TraceRecorder(capacity=16)
+    held = [rec.begin(f"h.{i}", 0.0, 0.1) for i in range(16)]  # exhaust
+    e = Entry()
+    # The engine's drain-loop idiom: claim-or-latch, exactly once.
+    if e.span is None:
+        e.span = rec.begin("x", 0.0, 0.1) or _SPAN_DROPPED
+    assert e.span is _SPAN_DROPPED and rec.dropped == 1
+    # Requeued + re-drained: the sentinel blocks the re-claim even after
+    # slots free up, and every stamp site sees "no span".
+    rec.commit(_stamp(held[0], 0.0))
+    if e.span is None:          # must NOT fire
+        e.span = rec.begin("x", 0.5, 0.6) or _SPAN_DROPPED
+    assert e.span is _SPAN_DROPPED
+    assert _live_span(e) is None
+    assert rec.dropped == 1
+
+
+def test_stall_inspector_names_current_phase():
+    """Engine-side half of the HVD302 phase satellite: the stall warning
+    names the phase the stuck entry is in when tracing is armed."""
+    from horovod_tpu.ops.scheduler import StallInspector
+    from horovod_tpu.utils.logging import get_logger
+
+    rec = TraceRecorder(capacity=64)
+    insp = StallInspector(warn_after_s=0.0, shutdown_after_s=0.0)
+
+    class Entry:
+        name = "stuck.t"
+        enqueue_time = time.monotonic() - 5.0
+        span = rec.begin("stuck.t", time.monotonic() - 5.0,
+                         time.monotonic() - 4.9)
+
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda r: records.append(r.getMessage())
+    logger = get_logger()
+    logger.addHandler(handler)
+    try:
+        insp.check([Entry()])
+    finally:
+        logger.removeHandler(handler)
+    assert records and "stuck in phase negotiation" in records[0], records
+
+
+# ----------------------------------------------- per-rank filename scheme
+def test_per_rank_filename_unifies_all_launch_paths(monkeypatch, tmp_path):
+    """Satellite: run.py, tpu_vm.py and the elastic bootstrap all produce
+    the same ``<base>.<rank>`` names through one helper."""
+    assert per_rank_filename("/tmp/tl", 3) == "/tmp/tl.3"
+
+    # torovodrun static path: rank suffix on timeline AND trace.
+    from horovod_tpu.runner.run import parse_args, placement, worker_envs
+    args = parse_args(["-np", "2", "--timeline-filename", "/tmp/tl",
+                       "--trace-filename", "/tmp/tr", "python", "t.py"])
+    envs = worker_envs(args, placement(args), ("127.0.0.1", 5555, 5556))
+    assert [e["HOROVOD_TIMELINE"] for e in envs] == ["/tmp/tl.0",
+                                                     "/tmp/tl.1"]
+    assert [e["HOROVOD_TRACE"] for e in envs] == ["/tmp/tr.0", "/tmp/tr.1"]
+
+    # TPU-VM pod path: worker_id IS the process rank; same scheme.
+    from horovod_tpu.runner import tpu_vm
+
+    class EP:
+        internal_ip = "10.0.0.1"
+        external_ip = "1.2.3.4"
+    env = tpu_vm.tpu_vm_worker_env(args, [EP(), EP()], worker_id=1,
+                                   ports=(5555, 5556))
+    assert env["HOROVOD_TIMELINE"] == "/tmp/tl.1"
+    assert env["HOROVOD_TRACE"] == "/tmp/tr.1"
+
+    # Elastic path: the env carries the BASE; the bootstrap suffixes with
+    # the rendezvous-assigned rank (the driver can't know ranks earlier).
+    from horovod_tpu.elastic import worker as ew
+    monkeypatch.setattr(ew, "_current_version", None)
+    monkeypatch.setattr(
+        ew.rdv, "fetch_assignment",
+        lambda *a, **k: {"version": 0, "rank": 1, "size": 2,
+                         "local_rank": 0, "local_size": 1, "cross_rank": 1,
+                         "cross_size": 2, "controller_addr": "127.0.0.1",
+                         "controller_port": 1234, "controller_port2": 1235})
+    monkeypatch.setenv("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
+    monkeypatch.setenv("HOROVOD_RENDEZVOUS_PORT", "9999")
+    monkeypatch.setenv("HOROVOD_TIMELINE", "/tmp/tl")
+    monkeypatch.setenv("HOROVOD_TRACE", "/tmp/tr")
+    # elastic_bootstrap projects its assignment into os.environ directly
+    # (by design — workers re-read it); scrub those keys afterwards or a
+    # later hvd.init() in this process would take the multi-process path.
+    assign_keys = [f"HOROVOD_{k}" for k in (
+        "RANK", "SIZE", "LOCAL_RANK", "LOCAL_SIZE", "CROSS_RANK",
+        "CROSS_SIZE", "CONTROLLER_ADDR", "CONTROLLER_PORT",
+        "CONTROLLER_PORT2")]
+    saved = {k: os.environ.get(k) for k in assign_keys}
+    try:
+        cfg = ew.elastic_bootstrap()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert cfg.timeline_filename == "/tmp/tl.1"
+    assert cfg.trace_filename == "/tmp/tr.1"
+    # The env keeps the BASE so the next generation re-suffixes cleanly.
+    assert os.environ["HOROVOD_TIMELINE"] == "/tmp/tl"
+    assert os.environ["HOROVOD_TRACE"] == "/tmp/tr"
