@@ -225,6 +225,14 @@ def _control_plane_stats():
                 round(rate, 4) if rate is not None else None,
             "chunks_per_cycle": chunks,
             "inflight_depth": ring.high_water if ring is not None else 0,
+            # Small-message latency war (ISSUE 8): live lane/partition
+            # counters, so the trajectory shows whether the fast lane and
+            # ByteScheduler partitioning actually engaged this run.
+            "fast_lane": {
+                "threshold_bytes": getattr(eng, "fast_lane_threshold", 0),
+                "dispatches": getattr(eng, "fast_lane_dispatches", 0),
+                "pin_hits": getattr(eng, "fast_lane_hits", 0)},
+            "partition_splits": getattr(eng, "partition_splits", 0),
             "monitor": monitor,
             "trace": trace}
 
@@ -507,9 +515,114 @@ def bench_trace(iters=30, n_tensors=8, errors=None):
     return out
 
 
+def bench_fast_lane(iters=40, errors=None):
+    """Latency fast lane ON vs OFF A/B (ISSUE 8) — the latency-critical
+    workload: ONE sub-threshold ungrouped blocking allreduce per step.
+
+    Records on every JSON line:
+
+    - **bitwise_identical**: the same input through both lanes produces
+      byte-identical results (the fast lane skips the fusion buffer, it
+      must never change the math);
+    - **off/on step latency** + ``latency_ratio`` (off/on; >1 = the fast
+      lane won) and a ``within_noise`` guard (the lane must never be a
+      gross regression);
+    - **phases_us** for both lanes from a temporarily armed tracer: on
+      the fast lane ``copy_in``+``drain`` must collapse toward zero (the
+      pinned program is fetched O(1) pre-launch, so the device wait is
+      attributed to ``reduce`` — ``copy_in_drain_us`` carries the
+      evidence), plus the engagement counters
+      (``fast_lane_dispatches``/``pin_hits``)."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics as _basics
+    from horovod_tpu.trace import TraceRecorder
+
+    eng = _basics._get_state().engine
+    thr_on = 1 << 20
+    out = {"threshold_bytes": thr_on}
+    pool = _ab_inputs(8, elems=1 << 12)       # 16KB/rank: sub-threshold
+    saved_thr = eng.fast_lane_threshold
+    preexisting_tracer = eng.tracer
+
+    def phase(n_iter, tag):
+        t0 = time.perf_counter()
+        for i in range(n_iter):
+            r = hvd.allreduce(pool[i % len(pool)],
+                              name=f"fastlane_bench_{tag}", op=hvd.Sum)
+        del r
+        return round((time.perf_counter() - t0) / n_iter * 1e3, 3)
+
+    def traced_phases(n_iter, tag):
+        if preexisting_tracer is not None:
+            eng.tracer = preexisting_tracer
+            return None               # can't isolate a per-lane breakdown
+        eng.tracer = TraceRecorder(capacity=4096)
+        phase(n_iter, tag)
+        summary = eng.tracer.phase_summary()
+        eng.tracer = None
+        return summary
+
+    try:
+        # OFF lane: legacy fused single-entry dispatch.
+        eng.fast_lane_threshold = 0
+        r_off = np.asarray(hvd.to_local(hvd.allreduce(
+            pool[0], name="fastlane_ab_ref", op=hvd.Sum)))
+        phase(3, "off")                       # warm: program + slots
+        off_ms = phase(iters, "off")
+        ph_off = traced_phases(max(10, iters // 4), "off_traced")
+
+        # ON lane: single-tensor batches through pinned programs.
+        eng.fast_lane_threshold = thr_on
+        r_on = np.asarray(hvd.to_local(hvd.allreduce(
+            pool[0], name="fastlane_ab_ref", op=hvd.Sum)))
+        d0, h0 = eng.fast_lane_dispatches, eng.fast_lane_hits
+        phase(3, "on")
+        on_ms = phase(iters, "on")
+        ph_on = traced_phases(max(10, iters // 4), "on_traced")
+
+        out.update({
+            "bitwise_identical": bool(np.array_equal(r_off, r_on)),
+            "off_step_ms": off_ms, "on_step_ms": on_ms,
+            "latency_ratio": round(off_ms / on_ms, 3) if on_ms else None,
+            "fast_lane_dispatches": eng.fast_lane_dispatches - d0,
+            "pin_hits": eng.fast_lane_hits - h0,
+        })
+        out["within_noise"] = _ab_noise_verdict(
+            on_ms, off_ms, errors, "fast_lane_overhead", "fast lane")
+        if errors is not None and not out["bitwise_identical"]:
+            errors["fast_lane_bitwise"] = (
+                "fast-lane result differs from the fused path — the lane "
+                "fork must be bitwise-invisible")
+        for tag, ph in (("off", ph_off), ("on", ph_on)):
+            if ph and ph.get("phases_us"):
+                p = ph["phases_us"]
+                out[f"phases_us_{tag}"] = p
+                out[f"copy_in_drain_us_{tag}"] = round(
+                    p["copy_in"] + p["drain"], 2)
+    finally:
+        eng.fast_lane_threshold = saved_thr
+        eng.tracer = preexisting_tracer
+    _record_timing("fast_lane_ab", warmup=3, iters=iters,
+                   wall_s=(off_ms + on_ms) * iters / 1e3)
+    return out
+
+
 def bench_busbw(sizes_mb, iters=10, errors=None, engine_only=False):
     """Allreduce bus-bandwidth sweep over both data planes.  A failing size
-    records an error and the sweep continues — partial results beat none."""
+    records an error and the sweep continues — partial results beat none.
+
+    Iteration counts scale INVERSELY with payload size: each point targets
+    ≥``HVD_BENCH_BUSBW_TARGET_WALL_S`` (default 0.2 s) of measured wall —
+    10 iters × ~7 ms at 4 KB is noise-dominated, while 256 MB already
+    fills the budget at the floor.  Distinct input buffers come from a
+    memory-bounded pool cycled round-robin (repeats recur only after the
+    pool, keeping the axon dispatch-cache hazard at bay without holding
+    hundreds of 256 MB arrays).
+
+    ``crossover_mb`` reports the smallest payload where the engine path's
+    bus-bw ≥ raw ``psum``'s — THE small-message-latency-war scoreboard
+    (engine ≥ psum everywhere ⇒ crossover at the sweep's left edge)."""
     import jax
     import numpy as np
     from jax import lax
@@ -520,11 +633,21 @@ def bench_busbw(sizes_mb, iters=10, errors=None, engine_only=False):
     n = hvd.size()
     m = hvd.mesh()
     factor = 2.0 * (n - 1) / n if n > 1 else 1.0  # n=1: report algo bw
+    target_wall = float(os.environ.get("HVD_BENCH_BUSBW_TARGET_WALL_S",
+                                       "0.2"))
     out = {"engine": {}, "psum": {}, "world": n,
            "formula": "2(n-1)/n*bytes/t" if n > 1 else "bytes/t (n=1)",
            # p50-ish end-to-end dispatch latency (wall/iters), the
            # small-tensor metric the GB/s figure hides (VERDICT r3 weak #3).
-           "engine_latency_ms": {}, "psum_latency_ms": {}}
+           "engine_latency_ms": {}, "psum_latency_ms": {},
+           "iters": {}, "target_wall_s": target_wall,
+           "crossover_mb": None}
+
+    def n_iters(est_dt):
+        """≥ the floor, ≤ 1000, sized to fill the wall target."""
+        if est_dt <= 0:
+            return iters
+        return int(max(iters, min(1000, -(-target_wall // est_dt))))
 
     multi_proc = jax.process_count() > 1
     n_local = len([d for d in m.devices.flat
@@ -545,22 +668,31 @@ def bench_busbw(sizes_mb, iters=10, errors=None, engine_only=False):
                 return a if multi_proc else jax.device_put(
                     a, NamedSharding(m, P("hvd")))
             x = make(-1)
-            xs = [make(i) for i in range(iters)]
 
             # Eager engine path: enqueue -> negotiate -> fused program.
-            for _ in range(3):
-                r = hvd.allreduce(x, name="busbw_warm", op=hvd.Sum)
+            # Warm iter 1 compiles; iters 2-3 are the timing probe that
+            # sizes the measured run.
+            r = hvd.allreduce(x, name="busbw_warm", op=hvd.Sum)
             jax.block_until_ready(r)
             t0 = time.perf_counter()
-            for xi in xs:
-                r = hvd.allreduce(xi, name="busbw", op=hvd.Sum)
+            for i in range(2):
+                r = hvd.allreduce(make(-2 - i), name="busbw_warm",
+                                  op=hvd.Sum)
+            jax.block_until_ready(r)
+            it = n_iters((time.perf_counter() - t0) / 2)
+            pool = min(it, max(4, (256 << 20) // max(elems * 4, 1)))
+            xs = [make(i) for i in range(pool)]
+            t0 = time.perf_counter()
+            for i in range(it):
+                r = hvd.allreduce(xs[i % pool], name="busbw", op=hvd.Sum)
             jax.block_until_ready(r)
             wall = time.perf_counter() - t0
-            dt = wall / iters
+            dt = wall / it
             out["engine"][label] = round(
                 factor * elems * 4 / dt / 1e9, 3)
             out["engine_latency_ms"][label] = round(dt * 1e3, 3)
-            _record_timing(f"busbw_engine_{label}", warmup=3, iters=iters,
+            out["iters"][label] = it
+            _record_timing(f"busbw_engine_{label}", warmup=3, iters=it,
                            wall_s=wall, bytes=elems * 4)
         except Exception as exc:  # noqa: BLE001 - record, keep sweeping
             if errors is not None:
@@ -582,19 +714,32 @@ def bench_busbw(sizes_mb, iters=10, errors=None, engine_only=False):
             y = f(x)
             jax.block_until_ready(y)
             t0 = time.perf_counter()
-            for xi in xs:          # distinct buffers (see engine path)
+            for xi in xs[:2]:
                 y = f(xi)
             jax.block_until_ready(y)
+            it = n_iters((time.perf_counter() - t0) / 2) if len(xs) >= 2 \
+                else iters
+            t0 = time.perf_counter()
+            for i in range(it):    # distinct buffers (see engine path)
+                y = f(xs[i % len(xs)])
+            jax.block_until_ready(y)
             wall = time.perf_counter() - t0
-            dt = wall / iters
+            dt = wall / it
             out["psum"][label] = round(
                 factor * elems * 4 / dt / 1e9, 3)
             out["psum_latency_ms"][label] = round(dt * 1e3, 3)
-            _record_timing(f"busbw_psum_{label}", warmup=1, iters=iters,
+            _record_timing(f"busbw_psum_{label}", warmup=3, iters=it,
                            wall_s=wall, bytes=elems * 4)
         except Exception as exc:  # noqa: BLE001
             if errors is not None:
                 errors[f"busbw_psum_{label}"] = repr(exc)
+
+    for mb in sorted(sizes_mb):
+        label = f"{mb:g}MB"
+        e, p = out["engine"].get(label), out["psum"].get(label)
+        if e is not None and p is not None and e >= p:
+            out["crossover_mb"] = mb
+            break
     return out
 
 
@@ -1300,6 +1445,9 @@ def main():
         "vs_baseline_def": "framework img/s ÷ raw-XLA img/s on this chip "
                            "(1.0 = zero framework overhead); MFU/100 when "
                            "raw section unavailable; null = no data",
+        # Smallest busbw-sweep payload where engine ≥ psum (the latency-
+        # war scoreboard); null until the busbw section runs/succeeds.
+        "crossover_mb": None,
         "errors": errors,
     }
     budget = float(os.environ.get("HVD_BENCH_TIMEOUT_S", "900"))
@@ -1402,6 +1550,7 @@ def _run(out, errors):
             "vs_baseline_def": "minimal mode: 1.0 = engine path executed "
                                "on device; null = no data",
             "allreduce_busbw_GBps": busbw,
+            "crossover_mb": busbw.get("crossover_mb"),
         })
         try:
             out["response_cache"] = bench_response_cache(errors=errors)
@@ -1411,6 +1560,10 @@ def _run(out, errors):
             out["pipeline"] = bench_pipeline(errors=errors)
         except Exception as exc:  # noqa: BLE001 - contained
             errors["pipeline"] = repr(exc)
+        try:
+            out["fast_lane_ab"] = bench_fast_lane(errors=errors)
+        except Exception as exc:  # noqa: BLE001 - contained
+            errors["fast_lane_ab"] = repr(exc)
         try:
             out["monitor_ab"] = bench_monitor(errors=errors)
         except Exception as exc:  # noqa: BLE001 - contained
@@ -1502,6 +1655,8 @@ def _run(out, errors):
         except Exception as exc:  # noqa: BLE001 - whole-section failure
             errors["busbw"] = repr(exc)
     out["allreduce_busbw_GBps"] = busbw
+    if busbw is not None:
+        out["crossover_mb"] = busbw.get("crossover_mb")
 
     try:
         out["response_cache"] = bench_response_cache(errors=errors)
@@ -1512,6 +1667,11 @@ def _run(out, errors):
         out["pipeline"] = bench_pipeline(errors=errors)
     except Exception as exc:  # noqa: BLE001 - contained
         errors["pipeline"] = repr(exc)
+
+    try:
+        out["fast_lane_ab"] = bench_fast_lane(errors=errors)
+    except Exception as exc:  # noqa: BLE001 - contained
+        errors["fast_lane_ab"] = repr(exc)
 
     try:
         out["monitor_ab"] = bench_monitor(errors=errors)

@@ -69,6 +69,11 @@ class Config:
       chunk size for pipelined cast/reduce/cast; 0 = single chunk)
     - ``max_inflight``             <- HOROVOD_MAX_INFLIGHT (bounded window
       of dispatched-but-unsettled fused batches, multi-process mode)
+    - ``fast_lane_threshold_bytes``<- HOROVOD_FAST_LANE_THRESHOLD (latency
+      fast lane: sub-threshold allreduces skip the fusion buffer; 0 = off)
+    - ``partition_threshold_bytes``<- HOROVOD_PARTITION_THRESHOLD
+      (ByteScheduler-style split of huge tensors into preemptible
+      sub-tensors; 0 = off)
     - ``timeline_filename``        <- HOROVOD_TIMELINE
     - ``timeline_mark_cycles``     <- HOROVOD_TIMELINE_MARK_CYCLES
     - ``stall_check_time_s``       <- HOROVOD_STALL_CHECK_TIME
@@ -113,6 +118,21 @@ class Config:
     # coordinates when a controller exists.
     pipeline_chunk_bytes: int = 0
     max_inflight: int = 2
+
+    # Small-message latency war (ISSUE 8, docs/performance.md "Latency
+    # fast lane").  fast_lane_threshold_bytes: ungrouped allreduces below
+    # this many bytes skip the fusion-buffer batching entirely — direct
+    # single-tensor dispatch through a persistent pre-compiled program
+    # (still negotiated, still response-cache-slotted, bitwise-identical
+    # results); 0 = off.  partition_threshold_bytes: tensors above this
+    # many bytes split into priority-inheriting sub-tensors so a small
+    # high-priority gradient preempts a huge transfer between parts
+    # instead of queueing behind the whole of it (ByteScheduler, Peng et
+    # al. SOSP 2019); reassembled transparently at synchronize; 0 = off.
+    # Both must be identical on every rank (the launcher forwards them;
+    # autotune broadcasts fast-lane moves).
+    fast_lane_threshold_bytes: int = 0
+    partition_threshold_bytes: int = 0
 
     # Cross-rank telemetry & health subsystem (horovod_tpu.monitor,
     # docs/monitoring.md).  HOROVOD_MONITOR=1 enables the per-rank metric
@@ -207,6 +227,8 @@ class Config:
             response_cache_capacity=_env_int("RESPONSE_CACHE_CAPACITY", 2048),
             pipeline_chunk_bytes=_env_int("PIPELINE_CHUNK", 0),
             max_inflight=_env_int("MAX_INFLIGHT", 2),
+            fast_lane_threshold_bytes=_env_int("FAST_LANE_THRESHOLD", 0),
+            partition_threshold_bytes=_env_int("PARTITION_THRESHOLD", 0),
             monitor=_env_bool("MONITOR", False),
             monitor_port=_env_int("MONITOR_PORT", 0),
             monitor_interval_s=_env_float("MONITOR_INTERVAL", 5.0),

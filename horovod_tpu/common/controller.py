@@ -171,6 +171,13 @@ class TCPController:
         self.cache_stats = ResponseCacheStats()
         self._slots: Dict[tuple, int] = {}
         self._slot_keys: Dict[int, tuple] = {}
+        # Persistent-program invalidation (engine hook, ISSUE 8): called
+        # with each slot id this client drops — eviction broadcast,
+        # forget(), capacity trim, or slot-id reuse via a fresh adoption —
+        # so the engine's slot-pinned compiled programs can never outlive
+        # (or cross-serve) the slot they were pinned to.  Guarded: the
+        # data-plane cache must never fail a negotiation round.
+        self.slot_drop_hook = None
         # Full key tuples announced in full and awaiting a server slot.
         # The server echoes the full key in the assignment broadcast, so
         # adoption matches exactly the announced tuple — same (name,
@@ -217,19 +224,23 @@ class TCPController:
 
     # ------------------------------------------------------------- protocol
     def _round(self, announces: Sequence) -> tuple:
-        """announces: (name, required_ranks, digest, group, datadep, tag)
-        tuples; required 0 = world.  Tuples whose slot is known ride the
-        fixed-size bitvector (the steady-state fast path); the sanitizer
-        tag — when present — travels in the sparse side-channel so order
-        divergence is still caught on the cached path."""
+        """announces: (name, required_ranks, digest, group, datadep, tag
+        [, entry]) tuples; required 0 = world.  Tuples whose slot is known
+        ride the fixed-size bitvector (the steady-state fast path); the
+        sanitizer tag — when present — travels in the sparse side-channel
+        so order divergence is still caught on the cached path.  The
+        optional trailing entry (never on the wire) gets its learned slot
+        stamped as ``cache_slot`` — the engine's persistent-program pin
+        key, obtained here where the slot lookup already happened so the
+        hot dispatch path never rebuilds the announce key."""
         full, bits, tags = [], [], []
         stats = self.cache_stats
         for a in announces:
-            n, required, digest, group, datadep, tag = a
+            n, required, digest, group, datadep, tag = a[:6]
             key = (n, digest, required, datadep, group != "-1")
             slot = self._slots.get(key) if self.cache_enabled else None
             if slot is None:
-                full.append(a)
+                full.append(a[:6])
                 if not n.startswith("\x1f"):
                     stats.misses += 1
                     # EVERY cacheable full announce registers for adoption
@@ -250,6 +261,8 @@ class TCPController:
                 if tag:
                     tags.append((slot, tag))
                 stats.hits += 1
+                if len(a) > 6 and a[6] is not None:
+                    a[6].cache_slot = slot
         req = bytearray(struct.pack("<I", len(full)))
         for n, required, digest, group, datadep, tag in full:
             req += struct.pack("<H", required)
@@ -426,6 +439,7 @@ class TCPController:
                 if key is not None:
                     self._slots.pop(key, None)
                     self.cache_stats.invalidations += 1
+                self._notify_slot_drop(slot)
         # Trailing sections, walked order-agnostically (mirroring the
         # server's generic request-side walk, so MON1 and FLT1 compose in
         # either order).  MON1 (protocol v3): the server's re-broadcast of
@@ -548,10 +562,21 @@ class TCPController:
             msg += "\n" + extra
         raise PeerFailureError(msg, dead_ranks=[])
 
+    def _notify_slot_drop(self, slot: int):
+        h = self.slot_drop_hook
+        if h is not None:
+            try:
+                h(slot)
+            except Exception:  # noqa: BLE001 - data-plane cache only
+                log.exception("slot-drop hook failed")
+
     def _adopt_slot(self, key: tuple, slot: int):
         old = self._slot_keys.pop(slot, None)
         if old is not None:
             self._slots.pop(old, None)
+            # Slot-id reuse: a program pinned to the OLD tuple must not
+            # serve the new one (its digest differs by construction).
+            self._notify_slot_drop(slot)
         self._trim_slots(len(self._slots) + 1)
         self._slots[key] = slot
         self._slot_keys[slot] = key
@@ -573,6 +598,7 @@ class TCPController:
             lru_slot = self._slots.pop(lru_key)
             self._slot_keys.pop(lru_slot, None)
             self.cache_stats.invalidations += 1
+            self._notify_slot_drop(lru_slot)
             excess -= 1
 
     # ---------------------------------------------------------- engine API
@@ -657,7 +683,7 @@ class TCPController:
                 required = _get_state().process_set_table.get(ps_id).size()
             new.append((n, required, self._digest(e),
                         str(getattr(e, "group_id", -1)), self._datadep(e),
-                        getattr(e, "sanitizer_tag", None) or ""))
+                        getattr(e, "sanitizer_tag", None) or "", e))
         self._announced.update(n for n, *_ in new)
         self._trim_slots()
         if self._join_pending:
@@ -757,6 +783,7 @@ class TCPController:
             slot = self._slots.pop(key)
             self._slot_keys.pop(slot, None)
             self.cache_stats.invalidations += 1
+            self._notify_slot_drop(slot)
         self._awaiting_assign = {k for k in self._awaiting_assign
                                  if k[0] != n}
 

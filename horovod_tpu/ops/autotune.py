@@ -43,6 +43,13 @@ _CAP_LO = 4.0
 # space, rounded to an integer on apply).
 _CHUNK_BOUNDS = (16.0, 30.0)
 _INFLIGHT_BOUNDS = (0.0, 3.0)
+# Latency fast-lane threshold (multi-process only, same gate): 256B..16MB.
+# The left end of the busbw curve is where the fusion buffer costs more
+# than it buys (BENCH_SELF_r03/r05) — the search finds the crossover
+# instead of a hand-set constant.  Note cycle_time is ALREADY the second
+# base coordinate, so the latency pair (fast_lane_threshold, cycle_time)
+# is fully searched, never hand-set.
+_FAST_LANE_BOUNDS = (8.0, 24.0)
 
 
 def _clamp(v: float, lo: float, hi: float) -> float:
@@ -212,6 +219,18 @@ class ParameterManager:
             bounds.append(_CHUNK_BOUNDS)
             starts.append(math.log2(max(float(engine.max_inflight), 1.0)))
             bounds.append(_INFLIGHT_BOUNDS)
+        # Sixth coordinate — the latency fast-lane threshold — gated like
+        # the pipeline pair: the fast lane's win (skipping the fusion
+        # buffer + per-cycle key construction) only exists where a
+        # negotiation round and the slot-pinned program path exist.
+        # Moves broadcast through the same agreement payload, so the
+        # threshold can never diverge across ranks (divergence would fork
+        # the batch plan).
+        self._tune_fast_lane = ctl is not None
+        if self._tune_fast_lane:
+            fl0 = max(float(engine.fast_lane_threshold) or 4096.0, 256.0)
+            starts.append(math.log2(fl0))
+            bounds.append(_FAST_LANE_BOUNDS)
         self.search = LogCoordinateDescent(
             start=tuple(starts), bounds=tuple(bounds), max_evals=max_evals)
         self._sample_no = 0
@@ -276,6 +295,11 @@ class ParameterManager:
             # reads its depth live).
             self._engine.pipeline_chunk_bytes = int(params[idx])
             self._engine.max_inflight = max(1, int(round(params[idx + 1])))
+            idx += 2
+        if self._tune_fast_lane and len(params) > idx:
+            # Applies from the next ready verdict; stale fast-lane pins
+            # self-invalidate on their validity compare.
+            self._engine.fast_lane_threshold = int(params[idx])
 
     def _poll_move(self):
         payload = self._poller(self._move_handle)
@@ -302,6 +326,9 @@ class ParameterManager:
                 extra += (f" pipeline_chunk_bytes={int(params[idx])}"
                           f" max_inflight="
                           f"{max(1, int(round(params[idx + 1])))}")
+                idx += 2
+            if self._tune_fast_lane and len(params) > idx:
+                extra += f" fast_lane_threshold={int(params[idx])}"
             self._log_line(f"# final: fusion_threshold={int(params[0])} "
                            f"cycle_time_s={params[1]:.6f}{extra} "
                            f"evals={self.search.evals}\n")
@@ -339,6 +366,8 @@ class ParameterManager:
                 cols += ",response_cache_capacity"
             if self._tune_pipeline:
                 cols += ",pipeline_chunk_bytes,max_inflight"
+            if self._tune_fast_lane:
+                cols += ",fast_lane_threshold"
             self._log_line(f"sample,fusion_threshold_bytes,cycle_time_s"
                            f"{cols},score_bytes_per_s\n")
             self._log_header_written = True
@@ -351,6 +380,9 @@ class ParameterManager:
         if self._tune_pipeline and len(params) > idx + 1:
             extra += (f",{int(params[idx])}"
                       f",{max(1, int(round(params[idx + 1])))}")
+            idx += 2
+        if self._tune_fast_lane and len(params) > idx:
+            extra += f",{int(params[idx])}"
         self._log_line(f"{self._sample_no},{int(params[0])},"
                        f"{params[1]:.6f}{extra},{score:.1f}\n")
 

@@ -41,9 +41,12 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..compat import shard_map
 
+import heapq
+
 from . import collectives as C
 from .scheduler import (  # noqa: F401  (re-export: public engine surface)
-    FusedProgramCache, InflightRing, StallInspector, TensorQueue,
+    FusedProgramCache, InflightRing, PingPongBuffers, StallInspector,
+    TensorQueue, partition_name, partition_plan,
 )
 from ..common.exceptions import ControlPlaneError
 from ..utils.logging import get_logger
@@ -86,6 +89,25 @@ class TensorTableEntry:
     # scheduling); must be identical across ranks for a given name.
     priority: int = 0
     enqueue_time: float = 0.0
+    # Latency fast lane (ISSUE 8): marked at the ready verdict for
+    # sub-threshold ungrouped allreduces — the entry dispatches as its own
+    # single-tensor batch through a persistent pre-compiled program,
+    # skipping the fusion-buffer concat/split and the per-cycle program-
+    # cache key construction entirely (bitwise-identical results).
+    fast_lane: bool = False
+    # Response-cache slot (stamped by the controller when this entry's
+    # announce rides the warm-path bitvector; -1 until learned).  The
+    # engine's persistent-program pin key: slot ids are server-assigned
+    # and digest-scoped, so a compiled program pinned to a slot is valid
+    # for exactly as long as the slot is (coordinated invalidation via
+    # the controller's slot_drop_hook).
+    cache_slot: int = -1
+    # ByteScheduler-style partitioning: sub-tensors of a split parent
+    # carry (parent_name, index, count) plus the parent entry; the parent
+    # itself never enters the queue (synchronize reassembles from the
+    # parts, invisibly to callers).
+    partition: Optional[Tuple] = None
+    parent: Any = None
     # Lifecycle trace span (horovod_tpu.trace): claimed at first drain when
     # tracing is armed, stamped at each phase boundary, committed at settle.
     # None whenever tracing is disarmed — every stamp site guards on it.
@@ -104,9 +126,16 @@ def _fusion_key(e: TensorTableEntry) -> Tuple:
     combiner merges those into one wire transfer — this keeps grouped ops
     with mixed fp32/bf16 members atomic in a single batch (reference: group
     table N13 semantics).
+
+    The partition COUNT (never the raw threshold bytes, mirroring the
+    chunk-plan keying) distinguishes a partitioned sub-tensor's program
+    from a same-shaped ordinary tensor's, so a slot-pinned part program
+    can never cross-serve an unpartitioned entry; parts of equal-shaped
+    parents still share one compiled program.
     """
     return (e.ctype, e.reduce_op, e.root_rank, e.process_set_id,
-            e.prescale_factor, e.postscale_factor, e.compression)
+            e.prescale_factor, e.postscale_factor, e.compression,
+            e.partition[2] if e.partition is not None else 0)
 
 
 # Sentinel for a tensor whose trace-span claim was dropped (ring full):
@@ -171,6 +200,30 @@ class CollectiveEngine:
         self.pipeline_chunks_total = 0
         self.pipeline_dispatches = 0
         self.last_cycle_chunks = 0
+        # Small-message latency war (ISSUE 8, docs/performance.md
+        # "Latency fast lane").  fast_lane_threshold: ungrouped allreduces
+        # below it skip the fusion buffer — single-tensor batches through
+        # persistent pre-compiled programs (_fast_programs: slot id — or
+        # name in single-controller mode — -> pinned program record,
+        # invalidated via the controller's slot_drop_hook).
+        # partition_threshold: tensors above it split at enqueue into
+        # priority-inheriting sub-tensors (ByteScheduler) so a small
+        # high-priority gradient preempts a huge transfer between parts;
+        # synchronize() reassembles transparently.  The dispatch backlog
+        # (_backlog, ring mode only) is what makes preemption real: ready
+        # batches queue by (lane, priority) and feed the in-flight window
+        # only as it has room, so a later cycle's hotter batch overtakes
+        # a huge tensor's remaining parts instead of queueing behind them.
+        self.fast_lane_threshold = cfg.fast_lane_threshold_bytes
+        self.partition_threshold = cfg.partition_threshold_bytes
+        self._fast_programs: Dict[Any, tuple] = {}
+        self._pingpong: Optional[PingPongBuffers] = None
+        self._staging_tokens: Dict[int, list] = {}
+        self._backlog: List[tuple] = []       # heap: (lane, -prio, seq, batch)
+        self._backlog_seq = itertools.count()
+        self.fast_lane_dispatches = 0         # fast-lane batches dispatched
+        self.fast_lane_hits = 0               # ... served by a pinned program
+        self.partition_splits = 0             # parents split at enqueue
         self.hierarchical_allreduce = cfg.hierarchical_allreduce
         self.hierarchical_allgather = cfg.hierarchical_allgather
         self._hier_local_size = cfg.hierarchical_local_size
@@ -256,6 +309,14 @@ class CollectiveEngine:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        if self._backlog and self._fault is None:
+            # Undispatched ready batches (the preemptive backlog only
+            # defers dispatch while the window is full): dispatch them now,
+            # before the ring drains — their waiters must not outlive the
+            # engine unsignalled.  The fault path already settled them.
+            while self._backlog:
+                batch = heapq.heappop(self._backlog)[3]
+                self._perform_operation(batch)
         if self._inflight is not None:
             # Settles every dispatched batch first: a waiter blocked in
             # synchronize() must never outlive the watcher unsignalled.
@@ -290,7 +351,7 @@ class CollectiveEngine:
         # Everything still waiting to negotiate fails now — the control
         # plane will never answer it.
         pending = self.queue.drain()
-        idle = (not busy and not pending
+        idle = (not busy and not pending and not self._backlog
                 and (self._inflight is None or len(self._inflight) == 0))
         if idle:
             log.warning(
@@ -302,6 +363,18 @@ class CollectiveEngine:
             log.error("control plane failed; shutting the engine down "
                       "cleanly: %s", exc)
         self._settle_queued(pending, exc)
+        # Ready-but-undispatched batches parked in the preemptive backlog
+        # are waiters too: settle them with the fault (their negotiation
+        # lane is the one still open on the timeline).
+        while self._backlog:
+            batch = heapq.heappop(self._backlog)[3]
+            self._settle_batch(batch, None, exc)
+        if self._pingpong is not None:
+            # Both staging buffers settle exactly once: outstanding tokens
+            # are released (idempotently — a racing watcher settle is a
+            # no-op) and no dispatcher may block on a slot the wedged
+            # watcher will never free.
+            self._pingpong.abort()
         if self._inflight is not None:
             self._inflight.abort(exc)
         ctl = self.controller
@@ -393,16 +466,23 @@ class CollectiveEngine:
         for kw in items:
             handle = next(self._handle_counter)
             entries.append(TensorTableEntry(handle=handle, **kw))
+        # ByteScheduler partitioning: tensors above the threshold split
+        # into priority-inheriting sub-tensors HERE, before the sanitizer
+        # and the queue — the parts are what negotiate (under
+        # deterministic sub-names every rank derives identically); the
+        # parent stays handle-registered and is reassembled transparently
+        # in synchronize().
+        queued = self._maybe_partition(entries)
         if self.sanitizer is not None:
             # BEFORE the push: the cycle thread may drain a pushed entry
             # within microseconds, and an untagged digest racing a tagged
             # peer announce would be a false mismatch.
-            self.sanitizer.observe(entries)
+            self.sanitizer.observe(queued)
         with self._handles_lock:
             for e in entries:
                 self._handles[e.handle] = e
         try:
-            self.queue.push_many(entries)
+            self.queue.push_many(queued)
         except ValueError:
             with self._handles_lock:
                 for e in entries:
@@ -411,11 +491,11 @@ class CollectiveEngine:
                 # Duplicate-name rejection is rank-local: peers never see
                 # these entries, so the advanced seq counters must be
                 # rolled back or every later tag skews cross-rank.
-                self.sanitizer.rollback(entries)
+                self.sanitizer.rollback(queued)
             raise
         tl = self._state.timeline
         if tl is not None:
-            for e in entries:
+            for e in queued:
                 tl.start_activity(e.name, "QUEUE")
         fault = self._fault
         if fault is not None:
@@ -430,15 +510,139 @@ class CollectiveEngine:
         self._wake.set()
         return [e.handle for e in entries]
 
+    def _maybe_partition(
+            self, entries: List[TensorTableEntry]) -> List[TensorTableEntry]:
+        """Split oversized reduction entries into sub-tensors (ByteScheduler
+        partitioning): returns the queue-facing entry list — parents
+        replaced by their parts.  Eligibility and the plan are pure
+        functions of the negotiated (shape, dtype) plus the fleet-wide
+        threshold, so every rank derives identical sub-names/shapes.
+        ADASUM is excluded (its dot products span the whole vector —
+        splitting changes the math); grouped members stay whole (groups
+        are atomic)."""
+        thr = self.partition_threshold
+        if thr <= 0:
+            return list(entries)
+        out: List[TensorTableEntry] = []
+        for e in entries:
+            if (e.ctype != CollectiveType.ALLREDUCE or e.group_id >= 0
+                    or e.tensor is None
+                    or e.reduce_op == C.ReduceOp.ADASUM
+                    or e.tensor.nbytes <= thr):
+                out.append(e)
+                continue
+            shape = tuple(e.tensor.shape)
+            per_rank = shape[1:]
+            n = int(np.prod(per_rank)) if per_rank else 1
+            # The threshold counts GLOBAL stacked bytes (the same
+            # convention as the fusion threshold and the eligibility gate
+            # above); the plan runs over the per-rank flat buffer, so
+            # scale it down by world — parts come out ~threshold-sized
+            # globally, and the gate and the plan can never disagree
+            # about whether a split happens.
+            per_rank_thr = max(1, thr // max(1, shape[0]))
+            plan = partition_plan(n, e.tensor.dtype.itemsize, per_rank_thr)
+            if len(plan) <= 1:
+                out.append(e)
+                continue
+            arrays = self._split_parts(e, plan)
+            k = len(plan)
+            subs = []
+            for i, arr in enumerate(arrays):
+                sub = TensorTableEntry(
+                    handle=next(self._handle_counter),
+                    name=partition_name(e.name, i, k),
+                    ctype=e.ctype, tensor=arr, reduce_op=e.reduce_op,
+                    root_rank=e.root_rank,
+                    process_set_id=e.process_set_id,
+                    prescale_factor=e.prescale_factor,
+                    postscale_factor=e.postscale_factor,
+                    group_id=-1, donate=True, compression=e.compression,
+                    priority=e.priority)          # priority inheritance
+                sub.partition = (e.name, i, k)
+                sub.parent = e
+                subs.append(sub)
+            e.parts = subs
+            e.partition_shape = per_rank
+            e.tensor = None           # staged into the parts; free it
+            out.extend(subs)
+            self.partition_splits += 1
+        return out
+
+    def _split_parts(self, e: TensorTableEntry, plan) -> List[Any]:
+        """One jitted splitter launch: flatten the per-rank payload and
+        slice the plan's parts out, keeping the stacked [world, n_i]
+        convention and the world-axis sharding (each part is an ordinary
+        engine tensor from here on).  Cached like any other program."""
+        shape = tuple(e.tensor.shape)
+        mesh, axis, _world = self._mesh_axis(e.process_set_id)
+        key = ("partition_split", shape, str(e.tensor.dtype), plan,
+               e.process_set_id)
+
+        def build():
+            sharding = NamedSharding(mesh, P(axis))
+
+            def split(x):
+                flat = x.reshape(shape[0], -1)
+                return tuple(flat[:, off:off + ln] for off, ln in plan)
+
+            return jax.jit(split, out_shardings=sharding)
+
+        fn = self.cache.get_or_build(key, build)
+        return list(fn(e.tensor))
+
+    def _assemble_parts(self, e: TensorTableEntry):
+        """Reassemble a partitioned tensor's result from its settled parts
+        (concat + reshape back to the per-rank logical shape) — runs on
+        the synchronizing caller's thread, invisible to it."""
+        parts = e.parts
+        per_rank = tuple(e.partition_shape)
+        key = ("partition_join",
+               tuple(tuple(s.result.shape) for s in parts),
+               str(parts[0].result.dtype), per_rank)
+
+        def build():
+            def join(*xs):
+                flat = (jnp.concatenate([x.reshape(-1) for x in xs])
+                        if len(xs) > 1 else xs[0].reshape(-1))
+                return flat.reshape(per_rank)
+
+            return jax.jit(join)
+
+        fn = self.cache.get_or_build(key, build)
+        return fn(*[s.result for s in parts])
+
     def synchronize(self, handle: int, timeout: Optional[float] = None):
         """Block until the handle's collective completed; return result.
 
         Reference parity: ``horovod/torch/mpi_ops.py synchronize()``.
+        Partitioned entries wait on every part and reassemble — callers
+        cannot tell a split tensor from a whole one.
         """
         with self._handles_lock:
             e = self._handles.get(handle)
         if e is None:
             raise ValueError(f"Unknown handle {handle}")
+        parts = getattr(e, "parts", None)
+        if parts is not None:
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            for s in parts:
+                left = (None if deadline is None
+                        else max(0.0, deadline - time.monotonic()))
+                if not s.done.wait(left):
+                    raise TimeoutError(
+                        f"Collective {e.name!r} did not complete within "
+                        f"{timeout}s ({sum(1 for p in parts if p.done.is_set())}"
+                        f"/{len(parts)} parts settled)")
+            with self._handles_lock:
+                self._handles.pop(handle, None)
+            err = next((s.error for s in parts if s.error is not None), None)
+            if err is not None:
+                raise err
+            if e.result is None:
+                e.result = self._assemble_parts(e)
+            return e.result
         if not e.done.wait(timeout):
             raise TimeoutError(f"Collective {e.name!r} did not complete "
                                f"within {timeout}s")
@@ -451,7 +655,12 @@ class CollectiveEngine:
     def poll(self, handle: int) -> bool:
         with self._handles_lock:
             e = self._handles.get(handle)
-        return e is None or e.done.is_set()
+        if e is None:
+            return True
+        parts = getattr(e, "parts", None)
+        if parts is not None:
+            return all(s.done.is_set() for s in parts)
+        return e.done.is_set()
 
     # ------------------------------------------------------------- main loop
     def _background_loop(self):
@@ -592,8 +801,40 @@ class CollectiveEngine:
                         if ctl is not None and sp.slot < 0:
                             sp.slot = ctl.slot_of(e)
         cycle_chunks = 0
-        for batch in responses:
-            cycle_chunks += self._perform_operation(batch)
+        ring = self._inflight_ring()
+        if ring is None:
+            for batch in responses:
+                cycle_chunks += self._perform_operation(batch)
+        else:
+            # Preemptive dispatch backlog (ByteScheduler): ready batches
+            # queue by (lane, priority, arrival) and each cycle dispatches
+            # every fast-lane batch plus up to `max_inflight` fused
+            # batches — leftovers wait HERE, where a later cycle's
+            # higher-priority batch (or any fast-lane batch) overtakes
+            # them.  This is what partitioning buys: a huge tensor's
+            # remaining parts yield mid-transfer to a small hot gradient.
+            # The budget is deliberately a pure function of knob + heap
+            # state (never of local ring occupancy): every rank pushes
+            # identical batches with identical (lane, priority, arrival)
+            # keys, so every rank pops — and therefore LAUNCHES — in the
+            # identical order, which cross-process XLA collectives
+            # require.  An over-eager pop just blocks briefly in the
+            # ring's bounded submit, exactly like the pre-backlog path.
+            for batch in responses:
+                lane = 0 if batch[0].fast_lane else 1
+                prio = max(e.priority for e in batch)
+                heapq.heappush(self._backlog,
+                               (lane, -prio, next(self._backlog_seq), batch))
+            budget = max(1, int(self.max_inflight))
+            while self._backlog and (self._backlog[0][0] == 0 or budget > 0):
+                if self._backlog[0][0] != 0:
+                    budget -= 1
+                batch = heapq.heappop(self._backlog)[3]
+                cycle_chunks += self._perform_operation(batch)
+            if self._backlog:
+                # Leftovers must not wait out a long cycle timer: run the
+                # next cycle (and its negotiation round) immediately.
+                self._wake.set()
         if responses:
             self.last_cycle_chunks = cycle_chunks
             if tl is not None and tl.enabled:
@@ -633,6 +874,7 @@ class CollectiveEngine:
         not_ready: List[TensorTableEntry] = []
         if self.controller is not None:
             self.controller.synthesizer = self._synthesize_join_entry
+            self.controller.slot_drop_hook = self._on_slot_drop
             t0 = time.perf_counter()
             ready, errored = self.controller.negotiate(entries)
             dt_us = (time.perf_counter() - t0) * 1e6
@@ -696,6 +938,30 @@ class CollectiveEngine:
         # never of local handle/group counters, which differ across ranks
         # (every rank must build byte-identical fused programs).  Grouped
         # members are pulled together at the first member's position.
+        #
+        # Latency fast lane: sub-threshold ungrouped allreduces skip the
+        # fusion buffer entirely — each becomes its own single-tensor
+        # batch, dispatched FIRST (they are the latency-critical blocking
+        # ops; the threshold is identical on every rank, and nbytes
+        # derives from the negotiated shape/dtype, so the fork is
+        # deterministic fleet-wide).  Partitioned sub-tensors likewise
+        # stay single-entry batches: the part — not the re-fused whole —
+        # is the preemption unit.
+        fast: List[TensorTableEntry] = []
+        thr = self.fast_lane_threshold
+        if thr > 0:
+            rest: List[TensorTableEntry] = []
+            for e in entries:
+                if (e.group_id < 0 and e.partition is None
+                        and e.ctype == CollectiveType.ALLREDUCE
+                        and e.tensor is not None and e.tensor.nbytes < thr):
+                    e.fast_lane = True
+                    fast.append(e)
+                else:
+                    rest.append(e)
+            entries = rest
+        batches: List[List[TensorTableEntry]] = [[e] for e in fast]
+
         clusters: List[List[TensorTableEntry]] = []
         seen_groups: set = set()
         for e in entries:
@@ -708,9 +974,11 @@ class CollectiveEngine:
             else:
                 clusters.append([e])
 
-        batches: List[List[TensorTableEntry]] = []
         by_key: Dict[Tuple, List[List[TensorTableEntry]]] = {}
         for members in clusters:
+            if members[0].partition is not None:
+                batches.append(members)       # one batch per part, never
+                continue                      # re-fused past the split
             by_key.setdefault(_fusion_key(members[0]), []).append(members)
         for key, key_clusters in by_key.items():
             cur: List[TensorTableEntry] = []
@@ -741,6 +1009,18 @@ class CollectiveEngine:
             if tl is not None:
                 tl.end_activity(e.name, f"NEGOTIATE_{e.ctype.name}")
                 tl.start_activity(e.name, f"XLA_{e.ctype.name}")
+        pp = self._pingpong
+        if pp is not None and not batch[0].fast_lane:
+            # Double-buffered fusion staging: claim one of the two ping-
+            # pong slots per dtype group before launching, released by the
+            # InflightRing watcher at settle — cycle N+1's copy_in may
+            # overlap cycle N's reduce, N+2's may not.  Fast-lane batches
+            # skip it: they stage no fusion buffer.
+            keys = sorted({str(e.tensor.dtype) for e in batch
+                           if e.tensor is not None})
+            if keys:
+                self._staging_tokens[id(batch)] = [pp.acquire(k)
+                                                   for k in keys]
         try:
             results, chunks = self._execute_batch(batch)
         except BaseException as exc:  # noqa: BLE001 - propagate to waiters
@@ -750,14 +1030,19 @@ class CollectiveEngine:
         if tr is not None:
             # copy_in phase closes: the fused program (fetch/build + the
             # async XLA launch — the fusion copy-in lives inside it) has
-            # been dispatched; reduce runs from here to settle.
+            # been dispatched; reduce runs from here to settle.  Fast-lane
+            # entries served by a pinned program were already stamped
+            # pre-invoke (their copy_in is the O(1) pin fetch — the
+            # device wait belongs to the reduce phase); never restamp.
             t_launch = time.monotonic()
             for e in batch:
                 sp = _live_span(e)
-                if sp is not None:
+                if sp is not None and not sp.t_launch:
                     sp.t_launch = t_launch
         self.pipeline_chunks_total += chunks
         self.pipeline_dispatches += 1
+        if batch[0].fast_lane:
+            self.fast_lane_dispatches += 1
         ring = self._inflight_ring()
         if ring is None:
             self._settle_batch(batch, results)
@@ -777,6 +1062,14 @@ class CollectiveEngine:
         tl = self._state.timeline
         tr = self.tracer
         t_result = time.monotonic() if tr is not None else 0.0
+        tokens = self._staging_tokens.pop(id(batch), None)
+        if tokens is not None and self._pingpong is not None:
+            # Hand the ping-pong staging slots back FIRST: the cycle
+            # thread may be blocked in acquire() waiting on exactly this
+            # settle.  Idempotent per token — an abort that already
+            # settled them is a no-op.
+            for tok in tokens:
+                self._pingpong.release(tok)
         if error is None:
             for e, r in zip(batch, results):
                 e.result = r
@@ -823,6 +1116,9 @@ class CollectiveEngine:
                 lambda b, r, err: self._settle_batch(b, r, err,
                                                      inflight=True),
                 depth=self.max_inflight)
+            # Double-buffered fusion staging rides the same lifecycle: the
+            # ring's watcher is what hands the ping-pong slots back.
+            self._pingpong = PingPongBuffers(slots=2)
         else:
             self._inflight.depth = max(1, int(self.max_inflight))
         return self._inflight
@@ -973,6 +1269,57 @@ class CollectiveEngine:
         return tuple(min(max(1, -(-b // chunk)), max(1, e))
                      for e, b in groups.values())
 
+    def _on_slot_drop(self, slot: int):
+        """Controller invalidation hook: a response-cache slot this client
+        dropped (eviction / forget / trim / id reuse) takes its pinned
+        persistent program with it."""
+        self._fast_programs.pop(slot, None)
+
+    def _fast_pin_key(self, e: TensorTableEntry):
+        """Persistent-program pin key: the server-assigned response-cache
+        slot (digest-scoped, coordinated invalidation) when known, the
+        tensor name in single-controller mode (no slots exist; the
+        validity compare below keeps name reuse sound)."""
+        return e.cache_slot if e.cache_slot >= 0 else e.name
+
+    def _execute_fast_lane(self, e: TensorTableEntry):
+        """Dispatch a fast-lane entry through its pinned pre-compiled
+        program — zero fusion-key construction, zero chunk planning, zero
+        program-cache tuple hashing on the warm path; one dict probe and
+        a handful of scalar compares.  Returns ``(results, chunks)`` or
+        None (no valid pin yet — the caller takes the regular path and
+        pins the program it builds)."""
+        rec = self._fast_programs.get(self._fast_pin_key(e))
+        if rec is None:
+            return None
+        (fkey, shape, dtype, donate, chunk_knob, hier, fn, chunks) = rec
+        if (shape != e.tensor.shape or dtype != e.tensor.dtype
+                or donate != e.donate
+                or chunk_knob != self.pipeline_chunk_bytes
+                or hier != self.hierarchical_allreduce
+                or fkey != _fusion_key(e)):
+            # Stale pin (name reuse under new params, knob retune, ...):
+            # drop it; the regular path rebuilds and re-pins.
+            self._fast_programs.pop(self._fast_pin_key(e), None)
+            return None
+        self.fast_lane_hits += 1
+        tr = self.tracer
+        if tr is not None:
+            sp = _live_span(e)
+            if sp is not None and not sp.t_launch:
+                # copy_in closes HERE, before the invoke: the fast lane
+                # stages no fusion buffer and fetches no key — the device
+                # wait that follows belongs to the reduce phase (this is
+                # what makes copy_in ≈ 0 on the fast lane in the bench's
+                # phase breakdown).
+                sp.t_launch = time.monotonic()
+        outs = fn(e.tensor)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        if self._serialize_launches:
+            jax.block_until_ready(outs)
+        return list(outs), chunks
+
     def _execute_batch(self, batch: List[TensorTableEntry]):
         """Build-or-fetch the fused program and launch it; returns
         ``(results, chunk_count)`` — results may still be async (the
@@ -980,6 +1327,10 @@ class CollectiveEngine:
         e0 = batch[0]
         if e0.ctype == CollectiveType.BARRIER:
             return [None for _ in batch], 0
+        if e0.fast_lane and len(batch) == 1:
+            fast = self._execute_fast_lane(e0)
+            if fast is not None:
+                return fast
         mesh, axis, world = self._mesh_axis(e0.process_set_id)
         shapes = tuple(tuple(e.tensor.shape) for e in batch)
         dtypes = tuple(str(e.tensor.dtype) for e in batch)
@@ -991,6 +1342,24 @@ class CollectiveEngine:
         fn, hit = self.cache.get_or_build2(
             key, lambda: self._build_program(e0, shapes, dtypes, mesh, axis,
                                              world, donate, plan))
+        if e0.fast_lane and len(batch) == 1:
+            # Pin the program for the next submission of this tensor: the
+            # record stores exactly the inputs the program was built from,
+            # so the warm-path validity check is a few scalar compares.
+            pin = self._fast_programs
+            pin[self._fast_pin_key(e0)] = (
+                key[0], e0.tensor.shape, e0.tensor.dtype, e0.donate,
+                self.pipeline_chunk_bytes, self.hierarchical_allreduce,
+                fn, sum(plan) if plan else 1)
+            if e0.cache_slot >= 0:
+                # Cold start pinned under the NAME (the slot was still
+                # unlearned at that dispatch); now that the slot-keyed pin
+                # exists, drop the orphan — it would never be probed again
+                # but would hold a compiled-program reference and crowd
+                # live pins out of the capacity bound.
+                pin.pop(e0.name, None)
+            while len(pin) > max(16, self.cache.capacity):
+                pin.pop(next(iter(pin)))
         if hit:
             outs = fn(*[e.tensor for e in batch])
         else:

@@ -2,7 +2,8 @@
 
 The pieces of the collective engine that are pure host-side scheduling —
 the pending-tensor queue, the compiled-program cache, the stall inspector,
-and the in-flight dispatch window — live here so the scheduler logic is
+the in-flight dispatch window, the tensor partition plan and the
+double-buffer staging slots — live here so the scheduler logic is
 unit-testable without touching a jax backend (the fast test tier drives
 these classes directly; ``ops/engine.py`` composes them with the XLA data
 plane).
@@ -12,7 +13,9 @@ Reference mapping (SURVEY.md §2a): ``TensorQueue`` ← tensor_queue.cc N6,
 cache), ``StallInspector`` ← stall inspector N11, ``InflightRing`` ← the
 in-flight response window ByteScheduler-style schedulers bound (Peng et
 al., SOSP 2019) — here a bounded ring between the dispatching cycle thread
-and a completion watcher.
+and a completion watcher.  ``partition_plan`` and ``PingPongBuffers`` are
+the latency-war half (ISSUE 8): ByteScheduler-style tensor partitioning
+and the double-buffered fusion staging handoff.
 """
 
 from __future__ import annotations
@@ -25,6 +28,49 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from ..utils.logging import get_logger
 
 log = get_logger()
+
+
+def partition_plan(n_elems: int, itemsize: int,
+                   threshold_bytes: int) -> Tuple[Tuple[int, int], ...]:
+    """Even ``(offset, length)`` split of a flattened per-rank buffer into
+    ~threshold-sized sub-tensors (ByteScheduler partitioning, Peng et al.
+    SOSP 2019: the *partition*, not the fused batch, is the preemption
+    unit — a huge gradient split into parts lets a small high-priority
+    tensor jump the dispatch queue between parts instead of waiting out
+    the whole transfer).
+
+    A pure function of (element count, itemsize, threshold): every rank
+    computes the identical plan from the negotiated shape/dtype, so the
+    sub-tensor names and shapes — which ARE announced — agree across
+    ranks.  Returns ``()`` when no split applies (threshold off, or the
+    buffer already fits), never a 1-part plan."""
+    total = n_elems * itemsize
+    if threshold_bytes <= 0 or n_elems <= 1 or total <= threshold_bytes:
+        return ()
+    parts = -(-total // threshold_bytes)          # ceil
+    parts = min(parts, n_elems)
+    if parts <= 1:
+        return ()
+    per = -(-n_elems // parts)                    # ceil; last part shorter
+    plan = []
+    off = 0
+    while off < n_elems:
+        ln = min(per, n_elems - off)
+        plan.append((off, ln))
+        off += ln
+    return tuple(plan)
+
+
+def partition_name(parent: str, index: int, count: int) -> str:
+    """Wire name of one sub-tensor.  Deterministic across ranks (the parts
+    are negotiated under these names); ``parent_of`` inverts it."""
+    return f"{parent}::part{index}/{count}"
+
+
+def parent_of(name: str) -> str:
+    """The parent tensor name behind a partition sub-name (identity for
+    ordinary names)."""
+    return name.rsplit("::part", 1)[0] if "::part" in name else name
 
 
 class TensorQueue:
@@ -171,35 +217,77 @@ class StallInspector:
         if self.disabled:
             return
         now = time.monotonic()
+        # Partitioned sub-tensors (``e.partition = (parent, i, k)``) are
+        # one logical collective to the user: collect them per parent and
+        # report the PARENT once with partition progress, instead of k
+        # near-duplicate HVD302 warnings for ``grad::part0/8``,
+        # ``grad::part1/8``, ...
+        part_groups: Dict[str, list] = {}
         for e in waiting:
-            age = now - e.enqueue_time
-            if age > self.warn_after_s:
-                self.stalled.add(e.name)
-            if age > self.warn_after_s and e.name not in self._warned:
-                self._warned.add(e.name)
-                extra = ""
-                if missing_ranks and e.name in missing_ranks:
-                    extra = f"; ranks not yet submitted: {missing_ranks[e.name]}"
-                # With tracing armed the entry carries a lifecycle span:
-                # name the phase it is stuck in, not just that it waits.
-                # Duck-typed: a dropped-claim sentinel has no phase_name.
-                pn = getattr(getattr(e, "span", None), "phase_name", None)
-                phase = f" (stuck in phase {pn()})" if pn else ""
-                log.warning(
-                    "Stall detected: tensor %r has waited %.1fs for "
-                    "negotiation/execution%s%s", e.name, age, phase, extra)
-            if (self.shutdown_after_s > 0 and age > self.shutdown_after_s):
-                raise RuntimeError(
-                    f"Collective on tensor {e.name!r} stalled for {age:.1f}s "
-                    f"(> HOROVOD_STALL_SHUTDOWN_TIME); aborting")
+            part = getattr(e, "partition", None)
+            if part is not None:
+                part_groups.setdefault(part[0], []).append(e)
+                continue
+            self._check_one(e, e.name, now, missing_ranks)
+        for parent_name, group in part_groups.items():
+            e = max(group, key=lambda g: now - g.enqueue_time)
+            k = getattr(e, "partition")[2]
+            settled = self._parts_settled(e, k)
+            self._check_one(e, parent_name, now, missing_ranks,
+                            partition=f" ({settled}/{k} parts settled)")
+
+    @staticmethod
+    def _parts_settled(e, k: int) -> int:
+        """How many of a partitioned tensor's sub-entries already settled
+        (duck-typed off the parent's part list; falls back to 0)."""
+        parts = getattr(getattr(e, "parent", None), "parts", None)
+        if not parts:
+            return 0
+        try:
+            return sum(1 for s in parts if s.done.is_set())
+        except Exception:  # noqa: BLE001 - progress is best-effort
+            return 0
+
+    def _check_one(self, e, report_name: str, now: float, missing_ranks,
+                   partition: str = ""):
+        age = now - e.enqueue_time
+        if age > self.warn_after_s:
+            self.stalled.add(report_name)
+        if age > self.warn_after_s and report_name not in self._warned:
+            self._warned.add(report_name)
+            extra = ""
+            if missing_ranks:
+                missing = missing_ranks.get(e.name) \
+                    or missing_ranks.get(report_name)
+                if missing:
+                    extra = f"; ranks not yet submitted: {missing}"
+            # With tracing armed the entry carries a lifecycle span:
+            # name the phase it is stuck in, not just that it waits.
+            # Duck-typed: a dropped-claim sentinel has no phase_name.
+            pn = getattr(getattr(e, "span", None), "phase_name", None)
+            phase = f" (stuck in phase {pn()})" if pn else ""
+            log.warning(
+                "Stall detected: tensor %r has waited %.1fs for "
+                "negotiation/execution%s%s%s", report_name, age, partition,
+                phase, extra)
+        if (self.shutdown_after_s > 0 and age > self.shutdown_after_s):
+            raise RuntimeError(
+                f"Collective on tensor {report_name!r} stalled for "
+                f"{age:.1f}s (> HOROVOD_STALL_SHUTDOWN_TIME); aborting")
 
     def progressed(self, name: str):
         """A once-stalled tensor completed: clear its warned latch so a
         *later* collective reusing the name (steady-state training reuses
         gradient names every step) warns afresh instead of being silently
-        swallowed by the first step's latch."""
+        swallowed by the first step's latch.  Partition sub-names clear
+        the parent's latch too (the parent is what was warned about) —
+        the next check re-warns with updated part progress."""
         self._warned.discard(name)
         self.stalled.discard(name)
+        parent = parent_of(name)
+        if parent != name:
+            self._warned.discard(parent)
+            self.stalled.discard(parent)
 
 
 class InflightRing:
@@ -349,3 +437,100 @@ class InflightRing:
                     if self._items:
                         self._items.popleft()
                     self._cv.notify_all()
+
+
+class StagingToken:
+    """One acquired staging slot.  ``release`` is idempotent — exactly one
+    of {normal settle, abort} actually frees the slot, the other is a
+    no-op (mirrors the InflightRing's per-item settle claim)."""
+
+    __slots__ = ("key", "slot", "_released")
+
+    def __init__(self, key, slot: int):
+        self.key = key
+        self.slot = slot
+        self._released = False
+
+
+class PingPongBuffers:
+    """Double-buffered fusion staging: two ownership slots per key (one
+    key per fused-buffer dtype group).
+
+    The cycle thread ``acquire``\\ s a slot before launching a fused batch
+    and the InflightRing watcher ``release``\\ s it when the batch settles
+    — so cycle N+1's copy_in (the host-side program fetch + async launch
+    that stages the next fused buffer into HBM) may start while cycle N's
+    reduce is still on the device, but cycle N+2's may not: at most two
+    fused staging buffers per dtype group ever exist, regardless of how
+    deep ``HOROVOD_MAX_INFLIGHT`` opens the ring.  That is the classic
+    ping-pong buffer pair (reference N7's fusion-buffer reuse, pipelined),
+    and it is what bounds fused-temporary HBM while the window is deep.
+
+    ``abort`` settles every outstanding token exactly once (idempotent per
+    token) and permanently opens the gate — once the control plane is
+    down, no dispatcher may block on a slot the wedged watcher will never
+    release.  jax-free: the fast test tier drives it directly."""
+
+    def __init__(self, slots: int = 2):
+        self.slots = max(1, int(slots))
+        self._cv = threading.Condition()
+        self._outstanding: Dict[object, List[StagingToken]] = {}
+        self.aborted = False
+        self.acquires = 0
+        self.waits = 0            # acquires that had to block (telemetry)
+
+    def in_flight(self, key) -> int:
+        with self._cv:
+            return len(self._outstanding.get(key, ()))
+
+    def acquire(self, key) -> StagingToken:
+        """Block until one of ``key``'s slots is free (or the pair is
+        aborted); returns the slot's token."""
+        with self._cv:
+            waited = False
+            while (not self.aborted
+                   and len(self._outstanding.get(key, ())) >= self.slots):
+                waited = True
+                self._cv.wait(0.1)
+            if waited:
+                self.waits += 1
+            self.acquires += 1
+            used = {t.slot for t in self._outstanding.get(key, ())}
+            slot = next(i for i in range(self.slots + 1) if i not in used)
+            tok = StagingToken(key, slot)
+            if not self.aborted:
+                self._outstanding.setdefault(key, []).append(tok)
+            else:
+                # Aborted: hand out a pre-released token — the dispatch is
+                # about to fail its entries anyway, and tracking it would
+                # leak (nobody settles after abort).
+                tok._released = True
+            return tok
+
+    def release(self, token: Optional[StagingToken]):
+        if token is None:
+            return
+        with self._cv:
+            if token._released:
+                return                     # abort (or a double settle) won
+            token._released = True
+            lst = self._outstanding.get(token.key)
+            if lst is not None:
+                try:
+                    lst.remove(token)
+                except ValueError:
+                    pass
+                if not lst:
+                    self._outstanding.pop(token.key, None)
+            self._cv.notify_all()
+
+    def abort(self):
+        """Release every outstanding token exactly once and open the gate
+        for good.  Idempotent; safe against concurrent release."""
+        with self._cv:
+            self.aborted = True
+            for lst in self._outstanding.values():
+                for tok in lst:
+                    tok._released = True
+            self._outstanding.clear()
+            self._cv.notify_all()

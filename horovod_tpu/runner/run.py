@@ -100,6 +100,14 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     p.add_argument("--max-inflight", type=int, default=None,
                    help="Bound on dispatched-but-unsettled fused batches "
                         "(1 = settle inline, no overlap)")
+    p.add_argument("--fast-lane-threshold-kb", type=float, default=None,
+                   help="Latency fast lane: ungrouped allreduces below "
+                        "this many KB skip the fusion buffer (persistent "
+                        "pre-compiled single-tensor programs); 0 = off")
+    p.add_argument("--partition-threshold-mb", type=float, default=None,
+                   help="Split tensors above this many MB into priority-"
+                        "inheriting sub-tensors (ByteScheduler-style "
+                        "preemption); 0 = off")
     p.add_argument("--timeline-filename", default=None)
     p.add_argument("--timeline-mark-cycles", action="store_true")
     p.add_argument("--trace-filename", default=None,
@@ -294,6 +302,9 @@ def tuning_env(args) -> Dict[str, str]:
             ("cache_capacity", "HOROVOD_CACHE_CAPACITY", 1),
             ("pipeline_chunk_mb", "HOROVOD_PIPELINE_CHUNK", 1024 * 1024),
             ("max_inflight", "HOROVOD_MAX_INFLIGHT", 1),
+            ("fast_lane_threshold_kb", "HOROVOD_FAST_LANE_THRESHOLD", 1024),
+            ("partition_threshold_mb", "HOROVOD_PARTITION_THRESHOLD",
+             1024 * 1024),
             ("stall_check_time", "HOROVOD_STALL_CHECK_TIME", 1),
             ("stall_shutdown_time", "HOROVOD_STALL_SHUTDOWN_TIME", 1),
             ("monitor_port", "HOROVOD_MONITOR_PORT", 1),

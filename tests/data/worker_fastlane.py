@@ -1,0 +1,140 @@
+"""Latency fast lane + partitioning worker: the ISSUE 8 acceptance runs
+across REAL processes.
+
+Proves, end to end through negotiate → (lane fork) → execute:
+
+- results are BITWISE-identical with the fast lane + partitioning on vs
+  off (with and without bf16 wire compression) — the lane fork and the
+  tensor split never change the math;
+- the fast lane actually engaged AND the slot-keyed persistent-program
+  pin served warm dispatches (the controller stamps the response-cache
+  slot during the bit announce; dispatch is one dict probe);
+- a huge tensor split into priority-inheriting sub-tensors and the
+  parent reassembled transparently;
+- the steady-state control-plane contract holds with BOTH knobs on:
+  zero per-tensor metadata after warm-up, the per-cycle request stays
+  the fixed bitvector handful of bytes, and the negotiation ROUND COUNT
+  per step is unchanged vs the knobs-off baseline (the fast lane is
+  wire-invisible).
+
+Launched by test_multiprocess.py::test_torovodrun_fast_lane with
+``torovodrun -np 2``.
+"""
+
+import os
+
+# One rank per process, one CPU device each; gloo for cross-process XLA
+# collectives (same preamble as worker_collectives.py).
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+
+
+def step(value, rank, compression=None, tag=""):
+    """One small blocking allreduce + one huge one; returns host arrays."""
+    small = (np.linspace(-1.0, 1.0, 256).astype(np.float32)
+             * value * (rank + 1))
+    huge = (np.linspace(-2.0, 2.0, 5000).astype(np.float32)
+            * value * (rank + 2))
+    a = hvd.allreduce(small, name=f"small{tag}", op=hvd.Sum,
+                      compression=compression, priority=5)
+    b = hvd.allreduce(huge, name=f"huge{tag}", op=hvd.Sum,
+                      compression=compression)
+    return [np.asarray(hvd.to_local(a)), np.asarray(hvd.to_local(b))]
+
+
+def main():
+    hvd.init()
+    rank = hvd.rank()
+    eng = basics._get_state().engine
+    ctl = eng.controller
+    assert ctl is not None, "worker needs the torovodrun controller"
+    st = ctl.cache_stats
+
+    # ---- knobs OFF baseline --------------------------------------------
+    eng.fast_lane_threshold = 0
+    eng.partition_threshold = 0
+    base32 = step(1.0, rank, tag=".off32")
+    base16 = step(1.0, rank, compression="bf16", tag=".off16")
+    for k in range(3):
+        step(2.0 + k, rank, tag=".off32")       # warm the steady state
+    bits0, fulls0 = st.bit_announces, st.full_announces
+    for k in range(3):
+        step(5.0 + k, rank, tag=".off32")
+    bits_per_step_off = (st.bit_announces - bits0) / 3
+    assert st.full_announces == fulls0
+
+    # ---- fast lane ON (alone): bitwise + frame count unchanged ---------
+    # "Frame count" is announce content, which is deterministic — raw
+    # round counts are wall-clock pacing (the cycle thread ticks every
+    # HOROVOD_CYCLE_TIME regardless of work) and may not be compared.
+    eng.fast_lane_threshold = 64 * 1024     # small (1KB) rides the lane
+    on32 = step(1.0, rank, tag=".on32")
+    on16 = step(1.0, rank, compression="bf16", tag=".on16")
+    for b, o in zip(base32 + base16, on32 + on16):
+        np.testing.assert_array_equal(b, o)   # BITWISE, not allclose
+    assert eng.fast_lane_dispatches > 0, "fast lane never engaged"
+    step(2.0, rank, tag=".on32")                # warm the lane's programs
+    bits1, fulls1 = st.bit_announces, st.full_announces
+    for k in range(3):
+        step(5.0 + k, rank, tag=".on32")
+    bits_per_step_on = (st.bit_announces - bits1) / 3
+    assert st.full_announces == fulls1, (
+        "fast-lane steady state fell back to full negotiation")
+    assert bits_per_step_on == bits_per_step_off, (
+        f"fast lane changed the steady-state announce count per step: "
+        f"{bits_per_step_on} vs {bits_per_step_off}")
+
+    # ---- + partitioning: bitwise with both knobs on --------------------
+    eng.partition_threshold = 8 * 1024      # huge (20KB) splits into 3
+    mix32 = step(1.0, rank, tag=".mix32")
+    mix16 = step(1.0, rank, compression="bf16", tag=".mix16")
+    for b, o in zip(base32 + base16, mix32 + mix16):
+        np.testing.assert_array_equal(b, o)
+    assert eng.partition_splits > 0, "partitioning never engaged"
+
+    # ---- steady state: frames frozen, pin serving ----------------------
+    step(3.0, rank, tag=".steady")           # warm-up: learn slots
+    step(4.0, rank, tag=".steady")
+    full_before = st.full_announces
+    bytes_before = ctl.bytes_sent
+    rounds2 = ctl.rounds
+    hits_before = eng.fast_lane_hits
+    for k in range(5):
+        step(5.0 + k, rank, tag=".steady")
+    assert st.full_announces == full_before, (
+        f"fast-lane/partitioned steady state sent per-tensor metadata: "
+        f"{st.full_announces - full_before} full announces")
+    per_round = (ctl.bytes_sent - bytes_before) / max(1, ctl.rounds - rounds2)
+    assert per_round <= 32, (
+        f"warm-path request grew to {per_round}B/round with the lane on")
+    assert eng.fast_lane_hits > hits_before, (
+        "slot-keyed persistent-program pin never served a warm dispatch")
+
+    # ---- partitioned steady state relearns nothing either --------------
+    # (sub-names hold response-cache slots like any tensor; toggling the
+    # fast-lane knob mid-run is invisible to the control plane)
+    full_before = st.full_announces
+    eng.fast_lane_threshold = 32 * 1024
+    step(11.0, rank, tag=".steady")
+    assert st.full_announces == full_before, (
+        "fast-lane knob change invalidated response-cache slots")
+
+    hvd.barrier()
+    print(f"FASTLANE_OK rank={rank} "
+          f"lane_dispatches={eng.fast_lane_dispatches} "
+          f"pin_hits={eng.fast_lane_hits} "
+          f"splits={eng.partition_splits}", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,7 @@ class FakeEngine:
     def __init__(self, thr=64 * 1024 * 1024, cyc=0.001):
         self.fusion_threshold = thr
         self.cycle_time_s = cyc
+        self.fast_lane_threshold = 0
 
 
 class FakeClock:
@@ -155,9 +156,9 @@ def test_parameter_manager_ignores_idle_cycles():
 
 def test_parameter_manager_pipeline_coordinates(tmp_path):
     """With a controller present the search gains the response-cache,
-    chunk-bytes and in-flight coordinates (5-point search, 6-float
-    agreement payload); every agreed move lands on the engine knobs and
-    stays inside the coordinate bounds."""
+    chunk-bytes, in-flight and fast-lane coordinates (6-point search,
+    7-float agreement payload); every agreed move lands on the engine
+    knobs and stays inside the coordinate bounds."""
 
     class FakeCtl:
         cache_enabled = True
@@ -173,19 +174,21 @@ def test_parameter_manager_pipeline_coordinates(tmp_path):
     pm = ParameterManager(eng, warmup_samples=0, steps_per_sample=1,
                           log_path=str(log), clock=clock,
                           broadcaster=bc, poller=poll, max_evals=10)
-    assert pm._tune_cache and pm._tune_pipeline
-    assert len(pm.search.point) == 5
+    assert pm._tune_cache and pm._tune_pipeline and pm._tune_fast_lane
+    assert len(pm.search.point) == 6
     for _ in range(40):
         if not pm.tuning:
             break
         _drive_sample(pm, clock, 1 << 20, 0.01)
-    assert sent and all(len(p) == 6 for p in sent), \
-        [len(p) for p in sent]               # [thr,cyc,cap,chunk,infl,done]
+    assert sent and all(len(p) == 7 for p in sent), \
+        [len(p) for p in sent]          # [thr,cyc,cap,chunk,infl,fl,done]
     assert 1 <= eng.max_inflight <= 8
     assert (1 << 16) <= eng.pipeline_chunk_bytes <= (1 << 30)
     assert 1 <= eng.controller.cache_capacity <= 256
+    assert (1 << 8) <= eng.fast_lane_threshold <= (1 << 24)
     header = log.read_text().splitlines()[0]
     assert "pipeline_chunk_bytes" in header and "max_inflight" in header
+    assert "fast_lane_threshold" in header
 
 
 def test_parameter_manager_single_controller_skips_pipeline_coords():
@@ -198,6 +201,7 @@ def test_parameter_manager_single_controller_skips_pipeline_coords():
                           clock=clock, broadcaster=bc, poller=poll,
                           max_evals=4)
     assert not pm._tune_cache and not pm._tune_pipeline
+    assert not pm._tune_fast_lane
     assert len(pm.search.point) == 2
     _drive_sample(pm, clock, 1 << 20, 0.01)
     assert sent and all(len(p) == 3 for p in sent)

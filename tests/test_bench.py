@@ -38,7 +38,8 @@ def _run_bench(extra_env):
 
 
 def test_bench_minimal_mode():
-    out = _run_bench({"HVD_BENCH_MINIMAL": "1", "HVD_BENCH_SIZES_MB": "1"})
+    out = _run_bench({"HVD_BENCH_MINIMAL": "1",
+                      "HVD_BENCH_SIZES_MB": "0.125,1"})
     assert out["metric"] == "allreduce_engine_busbw_GBps"
     assert out["value"] and out["value"] > 0
     assert out["errors"] == {}
@@ -52,6 +53,21 @@ def test_bench_minimal_mode():
     assert ab["spans"] > 0 and ab["cycle_us"] > 0
     assert ab["phase_sum_consistent"] is True, ab
     assert "within_noise" in ab and "overhead_pct" in ab
+    # Latency fast lane A/B on every line: both lanes bitwise-identical,
+    # the lane + pinned-program path actually engaged, and the per-lane
+    # phase breakdown carries the copy_in+drain evidence.
+    fl = out["fast_lane_ab"]
+    assert fl["bitwise_identical"] is True, fl
+    assert fl["fast_lane_dispatches"] > 0 and fl["pin_hits"] > 0, fl
+    assert "copy_in_drain_us_on" in fl and "within_noise" in fl, fl
+    # crossover_mb rides every JSON line (null in engine-only sweeps),
+    # and the busbw sweep scales iterations toward the wall target: the
+    # small 128KB point is fast enough on the CPU mesh that a ≥200ms wall
+    # needs strictly MORE than the 10-iteration floor (a probe-timing
+    # regression that always returns the floor fails here).
+    iters = out["allreduce_busbw_GBps"]["iters"]
+    assert iters["1MB"] >= 10
+    assert iters["0.125MB"] > 10, iters
 
 
 def test_bench_default_resnet():

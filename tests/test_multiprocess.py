@@ -518,6 +518,24 @@ def test_torovodrun_pipeline():
         f"stderr:\n{res.stderr[-3000:]}")
 
 
+WORKER_FASTLANE = os.path.join(REPO, "tests", "data", "worker_fastlane.py")
+
+
+def test_torovodrun_fast_lane():
+    """ISSUE 8 acceptance: the latency fast lane (single-tensor dispatch
+    through slot-pinned persistent programs) + ByteScheduler partitioning
+    produce bitwise-identical results vs the fused whole-tensor path
+    (with and without bf16 wire compression), the steady-state response-
+    cache frame guarantee holds with both knobs on, the negotiation round
+    count per step is unchanged, and the pinned-program path actually
+    served warm dispatches (assertions live in the worker)."""
+    res = _run_torovodrun(2, WORKER_FASTLANE, timeout=300)
+    ok = res.stdout.count("FASTLANE_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
 WORKER_MONITOR = os.path.join(REPO, "tests", "data", "worker_monitor.py")
 
 

@@ -172,6 +172,80 @@ def test_steady_state_frames_hold_with_priority_drain():
     assert res[0] == res[1]
 
 
+def test_fast_lane_and_partition_add_zero_warm_path_bytes():
+    """ISSUE 8 frame guard: the latency fast lane and ByteScheduler
+    partitioning must cost ZERO extra control-plane bytes on the warm
+    path.
+
+    The fast lane is engine-local — an entry's announce (digest, wire
+    frames) is byte-identical whether or not it will ride the lane — and
+    partitioned sub-tensors are ordinary announces: after warm-up their
+    sub-names ride the same fixed-size bitvector as any tensor, with zero
+    per-tensor metadata.  A refactor that leaks either knob onto the wire
+    (digest, extra sections, full-announce churn) fails here."""
+    from horovod_tpu.ops.scheduler import partition_name, partition_plan
+
+    # The engine's split of one 64-elem fp32 tensor at a 64B threshold:
+    # deterministic sub-names/shapes, exactly what every rank announces.
+    plan = partition_plan(64, 4, 64)
+    assert len(plan) == 4
+    k = len(plan)
+
+    def mk():
+        subs = [E(partition_name("huge.grad", i, k), shape=(ln,))
+                for i, (_off, ln) in enumerate(plan)]
+        for i, s in enumerate(subs):
+            s.partition = ("huge.grad", i, k)
+        small = E("hot.grad", shape=(8,))
+        small.fast_lane = True            # engine-side mark: wire-invisible
+        return subs + [small]
+
+    def fn(ctl, rank):
+        _steps(ctl, mk, 2)                # warm-up: learn the slots
+        st = ctl.cache_stats
+        full_before = st.full_announces
+        bytes_before = ctl.bytes_sent
+        orders = _steps(ctl, mk, 5)
+        assert st.full_announces == full_before, (
+            "fast-lane/partitioned steady state sent per-tensor metadata")
+        assert st.bit_announces >= 5 * (k + 1)
+        # Per-cycle request: 4B n_full + 4B bv_len + bitvec + 4B n_tag —
+        # the same fixed handful of bytes as any warm cycle.
+        per_cycle = (ctl.bytes_sent - bytes_before) / 5
+        assert per_cycle <= 16, per_cycle
+        return orders
+
+    res = _pair(fn)
+    assert res[0] == res[1]
+
+
+def test_bit_announce_stamps_cache_slot_on_entry():
+    """The persistent-program pin key: warm-path announces stamp the
+    server-assigned slot onto the entry (where the slot lookup already
+    happened — the engine never rebuilds the announce key on dispatch)."""
+
+    def fn(ctl, rank):
+        first = [E("t")]
+        _steps(ctl, lambda: first, 1)
+        assert getattr(first[0], "cache_slot", -1) == -1  # full announce
+        warm = [E("t")]
+        _steps(ctl, lambda: warm, 1)
+        assert getattr(warm[0], "cache_slot", -1) >= 0    # bit announce
+        return warm[0].cache_slot
+
+    res = _pair(fn)
+    assert res[0] == res[1]              # server-assigned: same everywhere
+
+
+def test_digest_blind_to_fast_lane_mark():
+    """The negotiation digest must not see the fast-lane mark: the lane is
+    a local dispatch decision, and a digest change would churn every slot
+    when the threshold (or an autotune move) flips it."""
+    a, b = E("t"), E("t")
+    b.fast_lane = True
+    assert TCPController._digest(a) == TCPController._digest(b)
+
+
 def test_v4_liveness_adds_zero_warm_path_bytes():
     """Protocol-v4 frame guard: the fault-tolerance machinery (FLT1
     capability ad, server liveness tracking, abort frames) must add ZERO

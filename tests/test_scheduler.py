@@ -1,8 +1,9 @@
 """Scheduler primitives, no jax backend: the priority TensorQueue, the
-StallInspector thresholds, and the InflightRing window — the host-side
-scheduling logic of the pipelined data plane, covered on the fast tier
-(``horovod_tpu/ops/scheduler.py`` deliberately imports no jax so these run
-in milliseconds)."""
+StallInspector thresholds, the InflightRing window, the ByteScheduler
+partition plan and the ping-pong staging buffers — the host-side
+scheduling logic of the pipelined data plane and the latency fast lane,
+covered on the fast tier (``horovod_tpu/ops/scheduler.py`` deliberately
+imports no jax so these run in milliseconds)."""
 
 import threading
 import time
@@ -10,7 +11,8 @@ import time
 import pytest
 
 from horovod_tpu.ops.scheduler import (
-    FusedProgramCache, InflightRing, StallInspector, TensorQueue,
+    FusedProgramCache, InflightRing, PingPongBuffers, StallInspector,
+    TensorQueue, parent_of, partition_name, partition_plan,
 )
 
 
@@ -258,3 +260,179 @@ def test_program_cache_distinguishes_chunk_plans():
     cache.get_or_build(base + ((4,),), builder("four-chunk"))
     assert built == ["two-chunk", "four-chunk"]
     assert len(cache) == 2 and cache.hits == 1
+
+
+# ------------------------------------------------------------ partition plan
+def test_partition_plan_covers_exactly_once():
+    """Split/reassembly identity at the plan level: the (offset, length)
+    pieces tile [0, n) exactly — concatenating the slices reassembles the
+    original buffer bit for bit."""
+    for n, itemsize, thr in ((1000, 4, 1024), (4096, 4, 4096),
+                             (77, 8, 100), (1 << 20, 2, 1 << 16)):
+        plan = partition_plan(n, itemsize, thr)
+        if not plan:
+            assert n * itemsize <= thr
+            continue
+        # Contiguous, complete, no overlap.
+        off = 0
+        for o, ln in plan:
+            assert o == off and ln > 0
+            off += ln
+        assert off == n
+        # Identity: slicing a concrete buffer by the plan and re-joining
+        # yields the original byte-for-byte.
+        buf = bytes(range(256)) * (n * itemsize // 256 + 1)
+        buf = buf[:n * itemsize]
+        parts = [buf[o * itemsize:(o + ln) * itemsize] for o, ln in plan]
+        assert b"".join(parts) == buf
+        # Every part (except possibly the last) is ~threshold-sized.
+        for o, ln in plan[:-1]:
+            assert ln * itemsize <= thr + itemsize * len(plan)
+
+
+def test_partition_plan_edges():
+    assert partition_plan(100, 4, 0) == ()           # knob off
+    assert partition_plan(100, 4, 400) == ()         # already fits
+    assert partition_plan(1, 4, 1) == ()             # can't split a scalar
+    plan = partition_plan(10, 4, 12)                 # 40B over 12B -> 4 parts
+    assert len(plan) == 4 and sum(ln for _o, ln in plan) == 10
+
+
+def test_partition_plan_deterministic():
+    """Same (shape, dtype, threshold) -> byte-identical plan: the parts'
+    names and shapes ride negotiation, so ranks must always agree."""
+    assert partition_plan(12345, 4, 999) == partition_plan(12345, 4, 999)
+
+
+def test_partition_names_invert():
+    assert partition_name("grad.0", 2, 8) == "grad.0::part2/8"
+    assert parent_of("grad.0::part2/8") == "grad.0"
+    assert parent_of("plain.name") == "plain.name"
+
+
+def test_partition_priority_inheritance_orders_drain():
+    """Sub-tensors carry the parent's priority, so a high-priority small
+    tensor arriving later still drains ahead of a huge low-priority
+    tensor's remaining parts — the ByteScheduler preemption invariant at
+    the queue level."""
+    q = TensorQueue()
+    parts = []
+    for i in range(4):
+        e = E(partition_name("huge", i, 4), priority=0)   # inherited: 0
+        e.partition = ("huge", i, 4)
+        parts.append(e)
+    q.push_many(parts)
+    q.push(E("hot.grad", priority=5))
+    assert [e.name for e in q.drain()][0] == "hot.grad"
+
+
+def test_stall_reports_partitioned_parent_once(warnings_log):
+    """k stalled sub-entries produce ONE HVD302 warning naming the parent
+    with (settled/total) partition progress — not k near-duplicates."""
+
+    class Done:
+        def __init__(self, done):
+            self._d = done
+
+        def is_set(self):
+            return self._d
+
+    class Part:
+        def __init__(self, parent, i, k, age):
+            self.name = partition_name(parent.name, i, k)
+            self.partition = (parent.name, i, k)
+            self.parent = parent
+            self.enqueue_time = time.monotonic() - age
+            self.done = Done(False)
+
+    class Parent:
+        name = "model.embedding"
+        parts = ()
+
+    parent = Parent()
+    k = 5
+    waiting = [Part(parent, i, k, 5.0) for i in range(3)]  # 2 already done
+    settled = [Part(parent, i, k, 5.0) for i in range(3, 5)]
+    for s in settled:
+        s.done = Done(True)
+    parent.parts = waiting + settled
+
+    insp = StallInspector(warn_after_s=1.0, shutdown_after_s=0.0)
+    insp.check(waiting)
+    msgs = [m for m in warnings_log if "Stall detected" in m]
+    assert len(msgs) == 1, msgs
+    assert "model.embedding" in msgs[0]
+    assert "2/5 parts settled" in msgs[0]
+    assert "::part" not in msgs[0]
+    assert insp.stalled == {"model.embedding"}
+    # A part completing clears the parent latch so the NEXT check re-warns
+    # with fresh progress.
+    insp.progressed(waiting[0].name)
+    assert "model.embedding" not in insp.stalled
+    insp.check(waiting[1:])
+    assert len([m for m in warnings_log if "Stall detected" in m]) == 2
+
+
+# ------------------------------------------------------------ PingPongBuffers
+def test_pingpong_two_slots_then_blocks():
+    pp = PingPongBuffers()
+    t0 = pp.acquire("float32")
+    t1 = pp.acquire("float32")
+    assert {t0.slot, t1.slot} == {0, 1}
+    assert pp.in_flight("float32") == 2
+    # A different dtype group has its own pair.
+    assert pp.acquire("bfloat16").slot == 0
+
+    blocked = threading.Event()
+    got = []
+
+    def third():
+        got.append(pp.acquire("float32"))
+        blocked.set()
+
+    threading.Thread(target=third, daemon=True).start()
+    assert not blocked.wait(0.3), "third acquire did not block on the pair"
+    pp.release(t0)                        # the watcher settles cycle N
+    assert blocked.wait(5.0)
+    assert got[0].slot == t0.slot         # ping-pong: the freed slot
+    assert pp.waits == 1
+
+
+def test_pingpong_release_idempotent():
+    pp = PingPongBuffers()
+    t = pp.acquire("k")
+    pp.release(t)
+    pp.release(t)                         # double settle: no-op
+    assert pp.in_flight("k") == 0
+    a = pp.acquire("k")
+    b = pp.acquire("k")
+    assert {a.slot, b.slot} == {0, 1}     # slot accounting intact
+
+
+def test_pingpong_abort_settles_both_buffers_exactly_once():
+    """The fault path: abort releases BOTH outstanding staging buffers
+    exactly once — a racing watcher settle afterwards is a no-op, and a
+    blocked acquirer wakes instead of hanging on a slot the wedged
+    watcher will never free."""
+    pp = PingPongBuffers()
+    t0 = pp.acquire("k")
+    t1 = pp.acquire("k")
+    woke = threading.Event()
+
+    def blocked_acquire():
+        pp.acquire("k")
+        woke.set()
+
+    threading.Thread(target=blocked_acquire, daemon=True).start()
+    assert not woke.wait(0.3)
+    pp.abort()
+    assert woke.wait(5.0), "abort left an acquirer hanging"
+    assert pp.in_flight("k") == 0
+    # Exactly once: the watcher's late settle of the aborted tokens is a
+    # no-op (nothing to double-free, no negative accounting).
+    pp.release(t0)
+    pp.release(t1)
+    assert pp.in_flight("k") == 0
+    assert pp.aborted
+    # Post-abort acquires never block (the engine is going down).
+    assert pp.acquire("k") is not None
