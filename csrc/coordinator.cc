@@ -93,6 +93,49 @@
 //              compose in any order and unknown magics are skipped — the
 //              same old-peers-ignore-trailing-bytes contract as MON1)
 //
+//   [protocol v5, FIRST ROUND ONLY] uint32 magic "AGG5", uint32 0
+//             (the hierarchical-control-plane capability advertisement,
+//              both directions, round 1 only — exactly the FLT1 pattern,
+//              so the warm path carries zero extra bytes.  On the request
+//              side it rides BEFORE the FLT1 section: the server's
+//              pre-processing FLT1 salvage reads the frame's final 8
+//              bytes, so FLT1 must stay last.)
+//
+//   AGENT  := a per-host aggregator (horovod_tpu/common/host_agent.py) may
+//             connect IN PLACE of its host's ranks: handshake word
+//             0xFFFFFF05 ("v5 agent hello", outside the rank space), then
+//             one frame { u32 host_index, u32 n_ranks, n_ranks * u32 rank }
+//             claiming the ranks it serves.  Each round the agent sends ONE
+//             uplink frame for the whole host:
+//
+//   uplink := u32 magic "HUP5"
+//             u32 n_dead, n_dead * u32 rank      (local ranks whose socket
+//                                                 died — propagated up so
+//                                                 the root can abort with
+//                                                 rank attribution)
+//             u32 agg_nranks                     (0 = no aggregate section)
+//             [if agg_nranks>0] u32 bv_len, bytes bitvec
+//             u32 n_sub, n_sub * { u32 rank, u32 flen, bytes rank-frame }
+//             u32 n_mon, n_mon * { u32 rank, u32 blen, bytes blob }
+//
+//             (the aggregate bitvector is the warm-path win: when every
+//              local rank's round frame is a pure warm frame — no full
+//              announces, no tags, no trailing sections — with an
+//              IDENTICAL pending bitvector (the synchronized steady state:
+//              all ranks submit the same tensors in the same cycle), the
+//              agent collapses them into ONE fixed-size section that
+//              counts for all agg_nranks ranks at once.  Any asymmetric or
+//              non-warm frame is forwarded per-rank in the sub section,
+//              byte-identical to what the rank sent (minus extracted MON1
+//              blobs, which travel deduplicated in the mon section), so
+//              full negotiation, sanitizer tags, FLT1 ads and join frames
+//              keep their exact flat-mode semantics.  The root answers
+//              with its ordinary response frame, written ONCE per host;
+//              the agent fans it down verbatim — responses were already
+//              rank-agnostic.  Root-side gather work therefore scales
+//              with hosts, not ranks: one readable fd, one frame parse
+//              and one response write per host per round.)
+//
 //   ABORT  := uint32 0xFFFFFFFF, uint32 magic "ABT4",
 //             uint32 n_dead, n_dead * uint32 rank, { u16 len, reason }
 //             (protocol v4 liveness verdict, sent IN PLACE of a normal
@@ -145,6 +188,9 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
+#ifdef __linux__
+#include <sys/epoll.h>
+#endif
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -180,6 +226,13 @@ constexpr uint32_t kFltMagic = 0x31544c46;
 // Typed abort frame marker ("ABT4") behind the 0xFFFFFFFF escape.
 constexpr uint32_t kAbortMagic = 0x34544241;
 constexpr uint32_t kAbortEscape = 0xffffffffu;
+// Hierarchical control plane (protocol v5): capability ad ("AGG5", round 1
+// only in both directions, exactly the FLT1 pattern), the per-host agent's
+// hello word (outside the rank space — ranks are < world < 2^31), and the
+// host uplink frame magic ("HUP5").
+constexpr uint32_t kAggMagic = 0x35474741;
+constexpr uint32_t kAgentHello = 0xffffff05u;
+constexpr uint32_t kHupMagic = 0x35505548;
 // Per-blob and per-response caps for the monitor section: the aggregate
 // re-broadcast must stay well inside the client's fixed 4MB receive
 // buffer (_RESP_CAP in common/controller.py) no matter how many ranks
@@ -309,6 +362,128 @@ struct Reader {
   }
 };
 
+// ------------------------------------------------------- connection state
+// One accepted control-plane connection: a single rank (flat mode) or a
+// per-host agent speaking for several ranks (protocol v5).  Reads are
+// non-blocking (MSG_DONTWAIT; the fd itself stays blocking so response
+// writes need no EAGAIN handling) into a per-connection reassembly buffer:
+// the gather loop never blocks inside one peer's half-written frame, so a
+// wedged peer can only cost its own round-deadline verdict, never the
+// whole control plane's liveness.
+struct Conn {
+  int fd = -1;
+  std::vector<int> ranks;           // ranks this connection speaks for
+  bool is_agent = false;
+  std::vector<uint8_t> inbuf;       // partial frame bytes (reassembly)
+  std::vector<std::vector<uint8_t>> frames;  // complete frames, FIFO
+  bool sock_dead = false;
+
+  // Drain everything currently readable without blocking; extract complete
+  // frames.  Returns false once the socket is dead (EOF / hard error).
+  int dead_errno = 0;   // diagnostic: errno at death (0 = orderly EOF)
+  bool drain() {
+    if (sock_dead) return false;
+    uint8_t tmp[65536];
+    for (;;) {
+      ssize_t r = ::recv(fd, tmp, sizeof(tmp), MSG_DONTWAIT);
+      if (r > 0) {
+        inbuf.insert(inbuf.end(), tmp, tmp + r);
+        if (static_cast<size_t>(r) < sizeof(tmp)) break;  // likely drained
+        continue;
+      }
+      if (r == 0) { sock_dead = true; dead_errno = 0; break; }
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      sock_dead = true;
+      dead_errno = errno;
+      break;
+    }
+    // Reassemble: length-prefixed frames, possibly several per drain.
+    while (inbuf.size() >= 4) {
+      uint32_t len = inbuf[0] | (inbuf[1] << 8) | (inbuf[2] << 16)
+          | (static_cast<uint32_t>(inbuf[3]) << 24);
+      if (inbuf.size() < 4 + static_cast<size_t>(len)) break;
+      frames.emplace_back(inbuf.begin() + 4, inbuf.begin() + 4 + len);
+      inbuf.erase(inbuf.begin(), inbuf.begin() + 4 + len);
+    }
+    return !sock_dead;
+  }
+};
+
+// Readiness multiplexer for the gather loop: epoll on Linux, a pollfd-set
+// fallback elsewhere (or under HVD_TPU_COORD_EPOLL=0, which keeps the
+// fallback testable on Linux).  One instance per server lifetime — fds are
+// registered once after the world assembles, not rebuilt per round like
+// the old poll-per-fd gather.
+class Poller {
+ public:
+  Poller() {
+#ifdef __linux__
+    const char* env = std::getenv("HVD_TPU_COORD_EPOLL");
+    if (env == nullptr || env[0] != '0') epfd_ = ::epoll_create1(0);
+#endif
+  }
+  ~Poller() {
+#ifdef __linux__
+    if (epfd_ >= 0) ::close(epfd_);
+#endif
+  }
+  bool using_epoll() const { return epfd_ >= 0; }
+  void add(int fd, int idx) {
+#ifdef __linux__
+    if (epfd_ >= 0) {
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.u32 = static_cast<uint32_t>(idx);
+      ::epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+      return;
+    }
+#endif
+    pfds_.push_back(pollfd{fd, POLLIN, 0});
+    idxs_.push_back(idx);
+  }
+  void remove(int fd) {
+#ifdef __linux__
+    if (epfd_ >= 0) {
+      ::epoll_ctl(epfd_, EPOLL_CTL_DEL, fd, nullptr);
+      return;
+    }
+#endif
+    for (size_t i = 0; i < pfds_.size(); ++i)
+      if (pfds_[i].fd == fd) {
+        pfds_.erase(pfds_.begin() + i);
+        idxs_.erase(idxs_.begin() + i);
+        break;
+      }
+  }
+  // Fills `ready` with registered indices that have data (or EOF/error)
+  // pending.  Returns poll()/epoll_wait() rc (<0 only on a real error).
+  int wait(int timeout_ms, std::vector<int>* ready) {
+    ready->clear();
+#ifdef __linux__
+    if (epfd_ >= 0) {
+      epoll_event evs[64];
+      int n = ::epoll_wait(epfd_, evs, 64, timeout_ms);
+      for (int i = 0; i < n; ++i)
+        ready->push_back(static_cast<int>(evs[i].data.u32));
+      return n;
+    }
+#endif
+    int n = ::poll(pfds_.data(), static_cast<nfds_t>(pfds_.size()),
+                   timeout_ms);
+    if (n > 0)
+      for (size_t i = 0; i < pfds_.size(); ++i)
+        if (pfds_[i].revents & (POLLIN | POLLHUP | POLLERR))
+          ready->push_back(idxs_[i]);
+    return n;
+  }
+
+ private:
+  int epfd_ = -1;
+  std::vector<pollfd> pfds_;   // fallback set
+  std::vector<int> idxs_;
+};
+
 // ----------------------------------------------------------------- server
 struct PendingInfo {
   uint64_t order;            // announce sequence for deterministic ordering
@@ -402,6 +577,23 @@ struct Server {
   // deadline; socket-death detection is always on.
   std::unique_ptr<std::atomic<char>[]> v4;
   int round_deadline_ms = 0;
+  // Protocol v5: per-rank hierarchical capability (AGG5 ad / agent
+  // handshake) and the accepted connections (loop-thread-only once the
+  // world has assembled; server_stop severs through `fds`, which holds
+  // every rank's serving fd — duplicated across an agent's ranks).
+  // NB: nothing reads v5[] yet — the server sends no v5-only per-rank
+  // sections (responses are rank-agnostic by design).  The latch exists
+  // for protocol symmetry with v4[] so a future v5-gated section has its
+  // capability record already on the wire; today it is diagnostic only.
+  std::unique_ptr<std::atomic<char>[]> v5;
+  std::vector<Conn> conns;
+  // Root-side service accounting (hvdtpu_server_stats): per-round time
+  // from gather completion to the last response write — the serialized
+  // root work the hierarchical control plane exists to shrink (parse +
+  // verdict compute + one write per CONNECTION).  Client wall clocks
+  // can't isolate this on a shared test box; the bench reads it directly.
+  std::atomic<uint64_t> stat_rounds{0};
+  std::atomic<uint64_t> stat_service_ns{0};
 
   void run();
   void run_inner();
@@ -412,17 +604,21 @@ void Server::broadcast_abort(const std::set<int>& dead,
                              const std::string& why) {
   // Typed liveness verdict to surviving v4 clients; pre-v4 clients are
   // simply severed (run()'s epilogue shuts every socket down), which is
-  // exactly the legacy rc=-1 failure they already understand.
+  // exactly the legacy rc=-1 failure they already understand.  One write
+  // per CONNECTION: an agent gets the frame once and fans it to its
+  // surviving local ranks itself.
   std::vector<uint8_t> resp;
   put_u32(&resp, kAbortEscape);
   put_u32(&resp, kAbortMagic);
   put_u32(&resp, static_cast<uint32_t>(dead.size()));
   for (int r : dead) put_u32(&resp, static_cast<uint32_t>(r));
   put_str(&resp, why);
-  for (int r = 0; r < world; ++r) {
-    if (dead.count(r) || !v4[r].load()) continue;
-    int fd = fds[r].load();
-    if (fd >= 0) write_frame(fd, resp);
+  for (Conn& c : conns) {
+    if (c.sock_dead || c.fd < 0) continue;
+    bool any_live_v4 = false;
+    for (int r : c.ranks)
+      if (!dead.count(r) && v4[r].load()) any_live_v4 = true;
+    if (any_live_v4) write_frame(c.fd, resp);
   }
 }
 
@@ -438,11 +634,15 @@ void Server::run() {
 }
 
 void Server::run_inner() {
-  // Accept exactly `world` connections; first message from each client is a
-  // 4-byte rank id.  All accepted fds land in `fds` (even on early stop) so
+  // Accept until every rank is claimed: one connection per rank (flat
+  // mode), or one per-host agent connection claiming several ranks
+  // (protocol v5 — hello word kAgentHello outside the rank space, then a
+  // rank-list frame).  All accepted fds land in `fds` (one slot per
+  // claimed rank; an agent's fd is duplicated across its ranks) so
   // server_stop's cleanup owns closing them — run() never closes a
   // registered fd, which avoids shutdown() on a recycled fd number.
-  for (int i = 0; i < world && !stop.load(); ++i) {
+  int claimed = 0;
+  while (claimed < world && !stop.load()) {
     int fd = ::accept(listen_fd, nullptr, nullptr);
     if (fd < 0) return;
     int one = 1;
@@ -452,33 +652,82 @@ void Server::run_inner() {
       if (handshake_fd.exchange(-1) != -2) ::close(fd);
       return;
     }
-    uint32_t rank = 0;
-    bool ok = read_exact(fd, &rank, 4);
+    uint32_t hello = 0;
+    bool ok = read_exact(fd, &hello, 4);
+    bool is_agent = ok && hello == kAgentHello;
+    std::vector<uint8_t> rank_list;
+    if (is_agent) ok = read_frame(fd, &rank_list);
     // Ownership handoff: if server_stop already exchanged the slot to -2 it
     // owns shutdown() on this fd, so we must not close it (the number could
     // be recycled under its feet); we're stopping anyway.
     if (handshake_fd.exchange(-1) == -2) return;
-    if (!ok || rank >= static_cast<uint32_t>(world) || fds[rank].load() >= 0) {
+    Conn conn;
+    conn.fd = fd;
+    conn.is_agent = is_agent;
+    if (ok && is_agent) {
+      Reader rd{rank_list.data(), rank_list.data() + rank_list.size()};
+      rd.u32();  // host index: diagnostic only
+      uint32_t n = rd.u32();
+      std::set<int> uniq;
+      for (uint32_t i = 0; i < n && rd.ok; ++i) {
+        uint32_t r = rd.u32();
+        if (!rd.ok || r >= static_cast<uint32_t>(world)
+            || fds[r].load() >= 0 || !uniq.insert(int(r)).second) {
+          rd.ok = false;
+          break;
+        }
+        conn.ranks.push_back(static_cast<int>(r));
+      }
+      ok = rd.ok && !conn.ranks.empty();
+    } else if (ok) {
+      if (hello >= static_cast<uint32_t>(world) || fds[hello].load() >= 0)
+        ok = false;
+      else
+        conn.ranks.push_back(static_cast<int>(hello));
+    }
+    if (!ok) {
       ::close(fd);
-      --i;
       continue;
     }
-    fds[rank].store(fd);
+    for (int r : conn.ranks) {
+      fds[r].store(fd);
+      if (is_agent) {
+        // The agent handshake IS the v4+v5 capability proof: agents only
+        // exist in v5 builds, and they fan typed aborts down to their
+        // local ranks themselves.
+        v4[r].store(1);
+        v5[r].store(1);
+      }
+    }
+    claimed += static_cast<int>(conn.ranks.size());
+    conns.push_back(std::move(conn));
   }
   for (int r = 0; r < world; ++r)
     if (fds[r].load() < 0) return;  // stopped before the world assembled
+  // Deterministic processing order: connections sorted by first rank, so
+  // announce_seq ordering matches the flat per-rank gather's rank order.
+  std::sort(conns.begin(), conns.end(), [](const Conn& a, const Conn& b) {
+    return a.ranks.front() < b.ranks.front();
+  });
+  // Readiness multiplexer, registered ONCE: the old gather rebuilt a
+  // pollfd set and issued a bounded blocking read per readable fd every
+  // round — O(ranks) setup + the risk of blocking inside one peer's
+  // half-written frame.  Frames now reassemble per connection off
+  // non-blocking reads, and root-side gather work is one event + one
+  // frame + one response write per CONNECTION (= per host under the
+  // hierarchical control plane).
+  Poller poller;
+  for (size_t i = 0; i < conns.size(); ++i)
+    poller.add(conns[i].fd, static_cast<int>(i));
 
   // Gather-phase containers, hoisted out of the round loop and cleared
-  // per round so each rank's frame buffer keeps its capacity across
+  // per round so each connection's frame buffer keeps its capacity across
   // rounds — the steady-state warm path (13-byte frames) allocates
   // nothing here, matching the pre-v4 reusable frame buffer.
-  std::vector<std::vector<uint8_t>> frames(world);
-  std::vector<char> have_frame(world, 0);
+  std::vector<std::vector<uint8_t>> round_frames(conns.size());
+  std::vector<char> have_frame(conns.size(), 0);
   std::set<int> dead_conn, dead_late;
-  std::vector<pollfd> pfds;
-  std::vector<int> pranks;
-  pfds.reserve(world);
-  pranks.reserve(world);
+  std::vector<int> ready_idx;
 
   while (!stop.load()) {
     ++round_no;
@@ -633,19 +882,70 @@ void Server::run_inner() {
       evict_budget = 0;    // candidates exhausted: stop for this round
       return false;
     };
-    // ---- gather phase (protocol v4 liveness): one frame from every rank,
-    // collected via poll so a dead socket (recv 0 / ECONNRESET) or a
-    // missed round deadline turns into a typed ABORT to the survivors
-    // instead of a deadline-free recv wedging the whole control plane.
-    // Frames are still PROCESSED in rank order below, so announce_seq
-    // ordering (and with it the deterministic ready order) is unchanged
-    // from the sequential-read protocol.
-    for (auto& f : frames) f.clear();
-    std::fill(have_frame.begin(), have_frame.end(), 0);
+    // ---- gather phase (protocol v4 liveness): ONE frame per connection,
+    // collected through the readiness multiplexer with per-connection
+    // reassembly, so a dead socket (recv 0 / ECONNRESET), an agent's
+    // dead-local-rank report, or a missed round deadline turns into a
+    // typed ABORT to the survivors — and a peer wedged mid-frame-write
+    // can never block the gather (its bytes just sit in the reassembly
+    // buffer until the deadline names it).  Frames are still PROCESSED in
+    // rank order below, so announce_seq ordering (and with it the
+    // deterministic ready order) is unchanged from the serial protocol.
+    for (size_t i = 0; i < conns.size(); ++i) {
+      round_frames[i].clear();
+      have_frame[i] = 0;
+    }
     dead_conn.clear();
     dead_late.clear();
     bool deadline_armed = false;
     Clock::time_point deadline_tp{};
+    // Take this round's frame for connection i (from the reassembly
+    // queue), arm the deadline at the round's FIRST complete frame (an
+    // idle fleet can never be declared dead — only ranks that failed to
+    // reach a round their peers already reached), and peek an agent
+    // uplink's dead-rank section: a local rank death the agent observed
+    // is a root-level liveness verdict with exact rank attribution.
+    auto take_frame = [&](size_t i) {
+      round_frames[i] = std::move(conns[i].frames.front());
+      conns[i].frames.erase(conns[i].frames.begin());
+      have_frame[i] = 1;
+      if (!deadline_armed && round_deadline_ms > 0) {
+        deadline_armed = true;
+        deadline_tp = Clock::now() +
+                      std::chrono::milliseconds(round_deadline_ms);
+      }
+      if (conns[i].is_agent) {
+        const std::vector<uint8_t>& f = round_frames[i];
+        const std::vector<int>& claimed = conns[i].ranks;
+        Reader rd{f.data(), f.data() + f.size()};
+        if (rd.u32() == kHupMagic && rd.ok) {
+          uint32_t nd = rd.u32();
+          for (uint32_t k = 0; k < nd && rd.ok; ++k) {
+            uint32_t r = rd.u32();
+            // Membership check: an agent may only declare ITS OWN ranks
+            // dead — a corrupted uplink must not abort a healthy rank on
+            // another host.
+            if (rd.ok && std::find(claimed.begin(), claimed.end(),
+                                   static_cast<int>(r)) != claimed.end())
+              dead_conn.insert(static_cast<int>(r));
+          }
+        }
+      }
+    };
+    // Leftover frames (they reassembled while the previous round was
+    // still writing responses) satisfy this round immediately; a
+    // connection that died after delivering its last frame is found dead
+    // here, not silently skipped.
+    int pending_frames = 0;
+    for (size_t i = 0; i < conns.size(); ++i) {
+      if (!conns[i].frames.empty()) {
+        take_frame(i);
+      } else if (conns[i].sock_dead) {
+        for (int r : conns[i].ranks) dead_conn.insert(r);
+      } else {
+        ++pending_frames;
+      }
+    }
     // Grace drain for the failure-at-startup class: when a rank dies in
     // round 1, survivors that have not yet SENT their round-1 frame have
     // not advertised FLT1 either — aborting immediately would sever them
@@ -659,126 +959,78 @@ void Server::run_inner() {
     constexpr int kAbortGraceMs = 2000;
     bool grace_armed = false;
     Clock::time_point grace_tp{};
-    int pending_frames = world;
-    // Bounded salvage of already-buffered frames from the given pending
-    // live ranks: one zero-timeout poll, then a short drain read per
-    // readable fd (a complete buffered frame reads instantly; a partial
-    // one still counts as missing).  Shared by the deadline-expiry
-    // verdict and the post-gather abort salvage so the two cannot drift.
-    auto drain_buffered = [&](std::vector<pollfd>& dfds,
-                              std::vector<int>& dranks) {
-      if (dfds.empty() ||
-          ::poll(dfds.data(), static_cast<nfds_t>(dfds.size()), 0) <= 0)
-        return;
-      auto drain_tp = Clock::now() + std::chrono::milliseconds(50);
-      for (size_t i = 0; i < dfds.size(); ++i) {
-        if (!(dfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
-        int r = dranks[i];
-        int rc2 = read_frame_deadline(fds[r].load(), &frames[r],
-                                      drain_tp, &stop);
-        if (rc2 > 0) {
-          have_frame[r] = 1;
-          --pending_frames;
-        } else if (rc2 < 0 && !stop.load()) {
-          dead_conn.insert(r);
-        }
-      }
-    };
-    while (pending_frames > 0 && !stop.load()) {
-      pfds.clear();
-      pranks.clear();
-      for (int r = 0; r < world; ++r)
-        if (!have_frame[r] && !dead_conn.count(r)) {
-          pfds.push_back(pollfd{fds[r].load(), POLLIN, 0});
-          pranks.push_back(r);
-        }
-      // Short poll quantum keeps the loop responsive to server_stop (the
+    while (pending_frames > 0 && !stop.load() && dead_late.empty()) {
+      // Short wait quantum keeps the loop responsive to server_stop (the
       // pre-v4 design relied on stop shutting the socket under a blocked
-      // recv; poll-wakeups serve the same purpose with a bound).
+      // recv; poller wakeups serve the same purpose with a bound).
       int timeout = 100;
-      if (deadline_armed && round_deadline_ms > 0) {
+      if (deadline_armed) {
         auto rem = std::chrono::duration_cast<std::chrono::milliseconds>(
                        deadline_tp - Clock::now())
                        .count();
         if (rem <= 0) {
-          // Final zero-timeout drain before the verdict: a frame already
-          // buffered in the kernel at expiry (it landed while the gather
-          // was busy inside another rank's read) proves its sender
-          // reached the round — declaring it dead would abort the fleet
-          // with a verdict naming a healthy rank.
-          drain_buffered(pfds, pranks);
-          for (int r : pranks)
-            if (!have_frame[r] && !dead_conn.count(r)) dead_late.insert(r);
-          if (dead_late.empty() && dead_conn.empty()) continue;
+          // Final non-blocking drain before the verdict: a frame already
+          // buffered in the kernel at expiry proves its sender reached
+          // the round — declaring it dead would abort the fleet with a
+          // verdict naming a healthy rank.
+          for (size_t i = 0; i < conns.size(); ++i) {
+            if (have_frame[i] || conns[i].sock_dead) continue;
+            conns[i].drain();
+            if (!conns[i].frames.empty()) {
+              take_frame(i);
+              --pending_frames;
+            }
+          }
+          for (size_t i = 0; i < conns.size(); ++i) {
+            if (have_frame[i]) continue;
+            if (conns[i].sock_dead) {
+              poller.remove(conns[i].fd);
+              for (int r : conns[i].ranks) dead_conn.insert(r);
+            } else {
+              // Mid-frame wedge or silence: the connection reached (or
+              // never reached) the round but missed its deadline.
+              for (int r : conns[i].ranks) dead_late.insert(r);
+            }
+          }
           break;
         }
         timeout = static_cast<int>(std::min<int64_t>(timeout, rem));
       }
-      int n = ::poll(pfds.data(), static_cast<nfds_t>(pfds.size()), timeout);
+      int n = poller.wait(timeout, &ready_idx);
       if (n < 0) {
         if (errno == EINTR) continue;
         stop.store(true);
         break;
       }
-      for (size_t i = 0; i < pfds.size(); ++i) {
-        if (!(pfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
-        int r = pranks[i];
-        // The frame READ is deadline-bounded too: a rank that wedges
-        // mid-frame-write (length prefix sent, payload never arrives)
-        // must not block the gather past the round deadline — a plain
-        // read_frame here would hang the whole control plane on a rank
-        // that poll() reported readable.  A partial frame proves the
-        // rank reached the round, so arming the deadline off its first
-        // bytes keeps the idle-fleet guarantee.
-        int rc;
-        if (round_deadline_ms > 0) {
-          Clock::time_point frd =
-              deadline_armed ? deadline_tp
-                             : Clock::now() + std::chrono::milliseconds(
-                                                  round_deadline_ms);
-          // The round deadline can expire while this loop is inside
-          // ANOTHER rank's read; starting this rank's read with a dead
-          // (or nearly dead) deadline would abandon a complete frame
-          // already buffered in the kernel and falsely declare a healthy
-          // rank late — and the top-of-loop expiry drain can never reach
-          // this per-fd path.  Grant the same bounded drain allowance
-          // instead: a buffered frame reads instantly, a genuine
-          // mid-frame wedge still turns into dead_late 50ms later.
-          auto min_frd = Clock::now() + std::chrono::milliseconds(50);
-          if (frd < min_frd) frd = min_frd;
-          rc = read_frame_deadline(fds[r].load(), &frames[r], frd, &stop);
-        } else {
-          rc = read_frame(fds[r].load(), &frames[r]) ? 1 : -1;
-        }
-        if (rc < 0) {
-          if (stop.load()) break;  // teardown racing the read, not a death
-          dead_conn.insert(r);
-        } else if (rc == 0) {
-          // Mid-frame wedge: the rank started its frame but never
-          // finished it inside the deadline.
-          dead_late.insert(r);
-          break;
-        } else {
-          have_frame[r] = 1;
+      for (int idx : ready_idx) {
+        Conn& c = conns[static_cast<size_t>(idx)];
+        if (c.sock_dead) continue;
+        c.drain();
+        if (!have_frame[idx] && !c.frames.empty()) {
+          take_frame(static_cast<size_t>(idx));
           --pending_frames;
-          if (!deadline_armed) {
-            // Armed at the round's FIRST frame: an idle fleet can never
-            // be declared dead — only ranks that failed to reach a round
-            // their peers already reached.
-            deadline_armed = true;
-            deadline_tp = Clock::now() +
-                          std::chrono::milliseconds(round_deadline_ms);
-          }
+        }
+        if (c.sock_dead) {
+          // Removed from the poller either way (a dead level-triggered fd
+          // would spin the loop); if the round's frame never arrived,
+          // these ranks are this round's verdict.
+          poller.remove(c.fd);
+          if (!have_frame[idx])
+            for (int r : c.ranks) dead_conn.insert(r);
         }
       }
       if (!dead_late.empty()) break;  // deadline verdict: abort the round
       if (!dead_conn.empty()) {
         bool awaiting_ad = false;
-        for (int r = 0; r < world; ++r)
-          if (!have_frame[r] && !dead_conn.count(r) && !v4[r].load()) {
-            awaiting_ad = true;
-            break;
-          }
+        for (size_t i = 0; i < conns.size(); ++i) {
+          if (have_frame[i] || conns[i].sock_dead) continue;
+          for (int r : conns[i].ranks)
+            if (!dead_conn.count(r) && !v4[r].load()) {
+              awaiting_ad = true;
+              break;
+            }
+          if (awaiting_ad) break;
+        }
         if (!awaiting_ad) break;
         auto now = Clock::now();
         if (!grace_armed) {
@@ -790,22 +1042,21 @@ void Server::run_inner() {
       }
     }
     if (!stop.load() && (!dead_conn.empty() || !dead_late.empty())) {
-      // Salvage still-buffered frames from live ranks before the verdict:
-      // a dead_late break above exits the gather immediately, skipping
-      // ranks whose complete frames already sit in the kernel buffer
-      // (they landed while the gather was blocked inside the dying
-      // rank's read).  Most importantly this recovers round 1's trailing
-      // FLT1 capability ads — without the frame, v4[] never latches and
-      // the survivor gets the untyped legacy sever (unattributed rc=-1)
-      // instead of the typed ABORT.
-      pfds.clear();
-      pranks.clear();
-      for (int r = 0; r < world; ++r)
-        if (!have_frame[r] && !dead_conn.count(r) && !dead_late.count(r)) {
-          pfds.push_back(pollfd{fds[r].load(), POLLIN, 0});
-          pranks.push_back(r);
-        }
-      drain_buffered(pfds, pranks);
+      // Salvage still-buffered frames from live connections before the
+      // verdict: frames may have landed since the last poller wakeup.
+      // Most importantly this recovers round 1's trailing FLT1 capability
+      // ads — without the frame, v4[] never latches and the survivor gets
+      // the untyped legacy sever (unattributed rc=-1) instead of the
+      // typed ABORT.
+      for (size_t i = 0; i < conns.size(); ++i) {
+        if (have_frame[i] || conns[i].sock_dead) continue;
+        bool all_dead = true;
+        for (int r : conns[i].ranks)
+          if (!dead_conn.count(r) && !dead_late.count(r)) all_dead = false;
+        if (all_dead) continue;
+        conns[i].drain();
+        if (!conns[i].frames.empty()) take_frame(i);
+      }
       auto list = [](const std::set<int>& s) {
         std::string out;
         for (int r : s) {
@@ -814,6 +1065,16 @@ void Server::run_inner() {
         }
         return out;
       };
+      if (std::getenv("HVD_TPU_COORD_DEBUG") != nullptr) {
+        for (size_t i = 0; i < conns.size(); ++i)
+          fprintf(stderr,
+                  "[coord] round=%llu conn=%zu ranks0=%d agent=%d "
+                  "have=%d dead=%d errno=%d inbuf=%zu frames=%zu\n",
+                  (unsigned long long)round_no, i, conns[i].ranks.front(),
+                  (int)conns[i].is_agent, (int)have_frame[i],
+                  (int)conns[i].sock_dead, conns[i].dead_errno,
+                  conns[i].inbuf.size(), conns[i].frames.size());
+      }
       std::string why;
       if (!dead_conn.empty())
         why += "rank(s) [" + list(dead_conn) +
@@ -835,10 +1096,14 @@ void Server::run_inner() {
       // rc=-1 — losing dead-rank attribution exactly for the failure-at-
       // startup class.  Latch the ads now: the client contract
       // (controller.py) appends FLT1 as the FINAL trailing section of the
-      // round-1 request, so the ad is exactly the frame's last 8 bytes.
-      for (int r = 0; r < world; ++r) {
-        if (!have_frame[r] || v4[r].load()) continue;
-        const std::vector<uint8_t>& f = frames[r];
+      // round-1 request (AGG5 rides before it), so the ad is exactly the
+      // frame's last 8 bytes.  Agent connections were latched at
+      // handshake and need no salvage.
+      for (size_t i = 0; i < conns.size(); ++i) {
+        if (!have_frame[i] || conns[i].is_agent) continue;
+        int r = conns[i].ranks.front();
+        if (v4[r].load()) continue;
+        const std::vector<uint8_t>& f = round_frames[i];
         if (f.size() < 8) continue;
         uint32_t magic = 0, blen = 0;
         std::memcpy(&magic, f.data() + f.size() - 8, 4);
@@ -850,8 +1115,11 @@ void Server::run_inner() {
       break;
     }
     if (stop.load()) break;
-    for (int r = 0; r < world; ++r) {
-      Reader rd{frames[r].data(), frames[r].data() + frames[r].size()};
+    auto svc_t0 = Clock::now();   // gather complete: root service begins
+    // One rank's frame (a flat connection's round frame, or one agent
+    // subframe — byte-identical to what the rank itself sent).
+    auto process_rank_frame = [&](int r, const uint8_t* fdata, size_t flen) {
+      Reader rd{fdata, fdata + flen};
       // Sanitizer tag side-channel for this rank's bitvector announces
       // (slot -> tag); parsed after the bitvector but needed while
       // resolving it, so the sections are walked full -> bits -> tags and
@@ -967,6 +1235,8 @@ void Server::run_inner() {
                 r, std::string(reinterpret_cast<const char*>(rd.p), blen));
         } else if (magic == kFltMagic) {
           v4[r].store(1);
+        } else if (magic == kAggMagic) {
+          v5[r].store(1);
         }
         rd.p += blen;
       }
@@ -1014,6 +1284,113 @@ void Server::run_inner() {
             it->second.slot = hint;
           if (eff != it->second.digest) it->second.errored = true;
         }
+      }
+    };
+    // Aggregate warm-path announce (protocol v5): one fixed-size bitvector
+    // that counts for EVERY rank its agent speaks for.  The agent only
+    // emits it when all its local ranks sent identical pure-warm frames,
+    // so per-rank semantics (readiness counting, stall attribution, digest
+    // consistency) reduce to inserting each covered rank; sanitizer-tagged
+    // frames are forwarded per-rank by construction, so the aggregate
+    // digest is always the slot record's untagged one.
+    auto process_agg_bits = [&](const std::vector<int>& ranks,
+                                const uint8_t* bv, uint32_t nbytes) {
+      for (uint32_t b = 0; b < nbytes; ++b) {
+        uint8_t byte = bv[b];
+        if (!byte) continue;
+        for (int bit = 0; bit < 8; ++bit) {
+          if (!(byte & (1u << bit))) continue;
+          uint32_t id = b * 8 + bit;
+          // Same evicted-this-round contract as the per-rank bit path: a
+          // non-live slot with an intact record still resolves, on the
+          // string path.
+          if (id >= cache_recs.size() || cache_recs[id].name.empty())
+            continue;
+          CacheRec& rec = cache_recs[id];
+          int64_t hint = rec.live ? static_cast<int64_t>(id) : -1;
+          if (rec.live) rec.last_used = round_no;
+          const std::string& eff = rec.digest;
+          auto it = pending.find(rec.name);
+          bool fresh = it == pending.end();
+          if (fresh) {
+            PendingInfo info;
+            info.order = announce_seq++;
+            info.required = rec.required ? rec.required : world;
+            info.first_seen = Clock::now();
+            info.digest = eff;
+            info.group = rec.group;
+            info.data_dep =
+                rec.datadep.empty() ? -1 : std::atoi(rec.datadep.c_str());
+            info.slot = hint;
+            it = pending.emplace(rec.name, std::move(info)).first;
+          }
+          for (int r : ranks) {
+            it->second.ready_ranks.insert(r);
+            it->second.by_digest[eff].insert(r);
+            (rec.group == "-1" ? it->second.ungrouped_ranks
+                               : it->second.grouped_ranks)
+                .insert(r);
+          }
+          if (!fresh) {
+            if (hint < 0)
+              it->second.slot = -1;
+            else if (it->second.slot == INT64_MIN)
+              it->second.slot = hint;
+            if (eff != it->second.digest) it->second.errored = true;
+          }
+        }
+      }
+    };
+    // Dispatch this round's frames in connection (= ascending first-rank)
+    // order: flat frames parse exactly as before; an agent uplink unpacks
+    // into its aggregate section, verbatim per-rank subframes, and
+    // deduplicated MON1 blobs.
+    for (size_t ci = 0; ci < conns.size(); ++ci) {
+      const Conn& c = conns[ci];
+      const std::vector<uint8_t>& f = round_frames[ci];
+      if (!c.is_agent) {
+        process_rank_frame(c.ranks.front(), f.data(), f.size());
+        continue;
+      }
+      Reader rd{f.data(), f.data() + f.size()};
+      if (rd.u32() != kHupMagic || !rd.ok) continue;  // malformed: dropped
+      uint32_t nd = rd.u32();
+      for (uint32_t k = 0; k < nd && rd.ok; ++k) rd.u32();  // peeked in gather
+      uint32_t agg_n = rd.u32();
+      if (rd.ok && agg_n > 0) {
+        uint32_t nbytes = rd.u32();
+        if (rd.ok && rd.p + nbytes <= rd.end) {
+          process_agg_bits(c.ranks, rd.p, nbytes);
+          rd.p += nbytes;
+        } else {
+          rd.ok = false;
+        }
+      }
+      // Membership check on every per-rank section: an agent speaks ONLY
+      // for its claimed ranks — a corrupted uplink must not announce (or
+      // attribute telemetry) on behalf of another host's ranks.
+      auto owns = [&c](uint32_t r) {
+        return std::find(c.ranks.begin(), c.ranks.end(),
+                         static_cast<int>(r)) != c.ranks.end();
+      };
+      uint32_t n_sub = rd.ok ? rd.u32() : 0;
+      for (uint32_t k = 0; k < n_sub && rd.ok; ++k) {
+        uint32_t r = rd.u32();
+        uint32_t flen = rd.u32();
+        if (!rd.ok || rd.p + flen > rd.end) break;
+        if (owns(r)) process_rank_frame(static_cast<int>(r), rd.p, flen);
+        rd.p += flen;
+      }
+      uint32_t n_mon = rd.ok ? rd.u32() : 0;
+      for (uint32_t k = 0; k < n_mon && rd.ok; ++k) {
+        uint32_t r = rd.u32();
+        uint32_t blen = rd.u32();
+        if (!rd.ok || rd.p + blen > rd.end) break;
+        if (blen <= kMonBlobCap && owns(r))
+          mon_blobs.emplace_back(
+              static_cast<int>(r),
+              std::string(reinterpret_cast<const char*>(rd.p), blen));
+        rd.p += blen;
       }
     }
     if (stop.load()) break;
@@ -1237,16 +1614,28 @@ void Server::run_inner() {
     if (round_no == 1) {
       put_u32(&resp, kFltMagic);
       put_u32(&resp, 0);
+      // Hierarchical-control-plane capability ad (protocol v5): also
+      // round-1 only.  Appended AFTER FLT1 so pre-v5 clients — whose
+      // trailing walk stops at the first unknown magic — still latch
+      // their fault capability before ignoring the rest.
+      put_u32(&resp, kAggMagic);
+      put_u32(&resp, 0);
     }
-    // Attempt EVERY rank before honoring a failure: one dead/closing peer
-    // must not cut the survivors off from a round's computed verdicts
+    // Attempt EVERY connection before honoring a failure: one dead/closing
+    // peer must not cut the survivors off from a round's computed verdicts
     // (they may contain the ready broadcast that lets them finish cleanly).
-    // A failed write marks the rank dead and the survivors get a typed
-    // ABORT (queued behind the response they just received; consumed at
-    // their next recv) instead of a blind socket sever.
+    // A failed write marks the connection's ranks dead and the survivors
+    // get a typed ABORT (queued behind the response they just received;
+    // consumed at their next recv) instead of a blind socket sever.  One
+    // write per connection: an agent fans the (already rank-agnostic)
+    // response down to its local ranks itself.
     std::set<int> write_dead;
-    for (int r = 0; r < world; ++r) {
-      if (!write_frame(fds[r].load(), resp)) write_dead.insert(r);
+    for (Conn& c : conns) {
+      if (!write_frame(c.fd, resp)) {
+        c.sock_dead = true;
+        poller.remove(c.fd);
+        for (int r : c.ranks) write_dead.insert(r);
+      }
     }
     if (!write_dead.empty()) {
       if (!stop.load()) {
@@ -1268,6 +1657,11 @@ void Server::run_inner() {
     // a same-round reassignment could otherwise collide with in-flight
     // bit announces for the old tuple.
     for (uint32_t s : evictions) cache_free.push_back(s);
+    stat_service_ns.fetch_add(static_cast<uint64_t>(
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            Clock::now() - svc_t0)
+            .count()));
+    stat_rounds.fetch_add(1);
   }
   // fds are closed by hvdtpu_server_stop after the thread joins.
 }
@@ -1304,12 +1698,28 @@ void* hvdtpu_server_start(int port, int world, double stall_warn_s,
   s->round_deadline_ms = round_deadline_ms < 0 ? 0 : round_deadline_ms;
   s->fds = std::make_unique<std::atomic<int>[]>(world);
   s->v4 = std::make_unique<std::atomic<char>[]>(world);
+  s->v5 = std::make_unique<std::atomic<char>[]>(world);
   for (int i = 0; i < world; ++i) {
     s->fds[i].store(-1);
     s->v4[i].store(0);
+    s->v5[i].store(0);
   }
   s->loop = std::thread([s] { s->run(); });
   return s;
+}
+
+// Root-side service accounting: out[0] = rounds served, out[1] = mean
+// root service microseconds per round (gather-complete -> last response
+// write).  Safe while the server runs (atomics) — the negotiation-scaling
+// bench reads it before stopping the server.
+int hvdtpu_server_stats(void* handle, double* out) {
+  auto* s = static_cast<Server*>(handle);
+  if (!s || !out) return -1;
+  uint64_t rounds = s->stat_rounds.load();
+  uint64_t ns = s->stat_service_ns.load();
+  out[0] = static_cast<double>(rounds);
+  out[1] = rounds ? static_cast<double>(ns) / 1e3 / rounds : 0.0;
+  return 0;
 }
 
 void hvdtpu_server_stop(void* handle) {
@@ -1338,9 +1748,12 @@ void hvdtpu_server_stop(void* handle) {
   // run() deliberately did not close it — close it now, after the join.
   if (hs >= 0) ::close(hs);
   ::close(s->listen_fd);
+  // An agent connection's fd appears once per claimed rank: close each
+  // DISTINCT fd exactly once (a double close could hit a recycled number).
+  std::set<int> closed;
   for (int i = 0; i < s->world; ++i) {
     int fd = s->fds[i].load();
-    if (fd >= 0) ::close(fd);
+    if (fd >= 0 && closed.insert(fd).second) ::close(fd);
   }
   delete s;
 }

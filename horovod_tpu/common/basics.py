@@ -51,6 +51,8 @@ class GlobalState:
         self.engine = None          # ops.engine.CollectiveEngine
         self.timeline = None        # utils.timeline.Timeline
         self.controller = None      # multi-process TCP controller client
+        self.host_agent = None      # common.host_agent.HostAgent (v5, owned
+                                    # by the local_rank-0 process per host)
         self.monitor = None         # monitor.MonitorAgent (HOROVOD_MONITOR)
         self._lock = threading.Lock()
 
@@ -146,15 +148,60 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None,
             from .controller import TCPController
             ctrl_port = (cfg.controller_port2 if cfg.controller_port2
                          else cfg.controller_port + 1)
+            connect_addr, connect_port = cfg.controller_addr, ctrl_port
+            server_port = None
+            hier = cfg.hierarchical_controller and not cfg.elastic
+            if hier and (cfg.local_rank_env < 0 or cfg.local_size_env <= 0
+                         or cfg.cross_rank_env < 0):
+                # Manual launches may set only RANK/SIZE/CONTROLLER_ADDR
+                # (enough for flat mode).  Deriving a host topology from
+                # the -1 defaults would give every process local_rank 0 on
+                # cross_rank 0 — each trying to bind its own agent on ONE
+                # derived port (EADDRINUSE out of init()).  Fall back to
+                # the flat plane loudly instead.
+                from ..utils.logging import get_logger
+                get_logger().warning(
+                    "HOROVOD_HIERARCHICAL_CONTROLLER=1 but HOROVOD_"
+                    "LOCAL_RANK/LOCAL_SIZE/CROSS_RANK are not set (launch "
+                    "through torovodrun to get them); using the flat "
+                    "control plane")
+                hier = False
+            if hier:
+                # Two-level control plane (protocol v5): ranks talk to a
+                # per-host agent that presents the whole host to the root
+                # as ONE connection (common/host_agent.py).  The
+                # local_rank-0 process owns its host's agent; rank 0 still
+                # hosts the root server at the launcher-advertised port
+                # while its own client goes through host 0's agent like
+                # everyone else's.  Elastic worlds stay flat: agent
+                # lifecycles don't span re-rendezvous generations yet.
+                from .host_agent import HostAgent
+                local_rank = cfg.local_rank_env
+                local_size = cfg.local_size_env
+                cross_rank = cfg.cross_rank_env
+                agent_port = (cfg.agent_port
+                              or ctrl_port + 1 + cross_rank)
+                if local_rank == 0:
+                    first = cfg.rank_env - local_rank
+                    ranks = list(range(first,
+                                       min(cfg.size_env,
+                                           first + local_size)))
+                    st.host_agent = HostAgent(
+                        agent_port, cfg.controller_addr, ctrl_port,
+                        ranks, host_index=cross_rank).start()
+                connect_addr, connect_port = "127.0.0.1", agent_port
+                if cfg.rank_env == 0:
+                    server_port = ctrl_port
             st.controller = TCPController(
-                cfg.controller_addr, ctrl_port,
+                connect_addr, connect_port,
                 rank=cfg.rank_env, world=cfg.size_env,
                 stall_warn_s=cfg.stall_check_time_s
                 if not cfg.stall_check_disable else 1e18,
                 cache_capacity=cfg.response_cache_capacity,
                 round_timeout_s=cfg.round_timeout_s,
                 connect_retries=cfg.connect_retries,
-                connect_backoff_ms=cfg.connect_backoff_ms)
+                connect_backoff_ms=cfg.connect_backoff_ms,
+                server_port=server_port)
             st.engine.controller = st.controller
 
         if cfg.monitor:
@@ -209,6 +256,12 @@ def shutdown() -> None:
         if st.controller is not None:
             st.controller.shutdown()
             st.controller = None
+        if st.host_agent is not None:
+            # After the controller: the agent must outlive this process's
+            # own client socket so its teardown EOF is observed (and
+            # reported upstream) rather than racing a dead agent thread.
+            st.host_agent.stop()
+            st.host_agent = None
         if st.timeline is not None:
             st.timeline.close()
             st.timeline = None

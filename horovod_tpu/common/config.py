@@ -181,6 +181,21 @@ class Config:
     # derive from the topology's per-process device counts (multi-host).
     hierarchical_local_size: int = 0
 
+    # Two-level control plane (protocol v5, docs/performance.md "Control
+    # plane at scale").  HOROVOD_HIERARCHICAL_CONTROLLER=1: every rank's
+    # negotiation client connects to a per-host agent
+    # (common/host_agent.py, owned by the local_rank-0 process) instead of
+    # the rank-0 root server; the agent collapses its host's warm-path
+    # bitvector frames into ONE fixed-size uplink per round, so root-side
+    # gather work scales with hosts, not ranks.  Per-rank wire bytes are
+    # unchanged (frame-guarded).  Flat single-server mode remains the
+    # default; elastic worlds always run flat (agent lifecycles don't span
+    # re-rendezvous generations yet).  HOROVOD_AGENT_PORT: the agent's
+    # listen port on each host (the launcher assigns one per host); 0 =
+    # derive deterministically from the controller port + cross_rank.
+    hierarchical_controller: bool = False
+    agent_port: int = 0
+
     autotune: bool = False
     autotune_log: str = ""
     autotune_warmup_samples: int = 3
@@ -244,6 +259,9 @@ class Config:
             hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool("HIERARCHICAL_ALLGATHER", False),
             hierarchical_local_size=_env_int("HIERARCHICAL_LOCAL_SIZE", 0),
+            hierarchical_controller=_env_bool("HIERARCHICAL_CONTROLLER",
+                                              False),
+            agent_port=_env_int("AGENT_PORT", 0),
             autotune=_env_bool("AUTOTUNE", False),
             autotune_log=_env("AUTOTUNE_LOG", "") or "",
             autotune_warmup_samples=_env_int("AUTOTUNE_WARMUP_SAMPLES", 3),

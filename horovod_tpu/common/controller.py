@@ -37,6 +37,11 @@ _MON_MAGIC = 0x314E4F4D
 # Fault-tolerance capability section marker ("FLT1") — protocol v4; rides
 # the first request/response only (warm rounds carry zero extra bytes).
 _FLT_MAGIC = 0x31544C46
+# Hierarchical control plane capability marker ("AGG5") — protocol v5;
+# round 1 only in both directions, exactly the FLT1 pattern.  On the
+# request side it rides BEFORE FLT1: the server's pre-processing FLT1
+# salvage reads the round-1 frame's final 8 bytes, so FLT1 stays last.
+_AGG_MAGIC = 0x35474741
 # Typed abort frame: escape word + magic ("ABT4").  Matches kAbortEscape /
 # kAbortMagic in csrc/coordinator.cc.
 _ABORT_ESCAPE = 0xFFFFFFFF
@@ -82,7 +87,13 @@ class TCPController:
                  stall_warn_s: float = 60.0, connect_timeout_ms: int = 60000,
                  cache_capacity: int = 2048, round_timeout_s: float = 0.0,
                  connect_retries: int = 3,
-                 connect_backoff_ms: float = 500.0):
+                 connect_backoff_ms: float = 500.0,
+                 server_port: Optional[int] = None):
+        # server_port: where rank 0 binds the root coordinator when that
+        # differs from where this client connects — the hierarchical
+        # control plane (protocol v5) points every client at its local
+        # HostAgent while the root server keeps the launcher-advertised
+        # port.  None (default, flat mode) = same port for both.
         self._lib = native.load()
         self.rank = rank
         self.world = world
@@ -105,6 +116,13 @@ class TCPController:
         # round 1's response) — the fault-frame analogue of
         # peer_monitor_proto below.
         self.peer_fault_proto = False
+        # Latches once the server advertises protocol v5 (AGG5 section in
+        # round 1's response): the coordinator understands per-host agent
+        # connections, so a HostAgent between this client and the root is
+        # known-compatible.  Purely observational on the rank client — its
+        # own wire bytes are IDENTICAL either way (the frame guard pins
+        # this), which is what lets the agent forward them verbatim.
+        self.peer_hier_proto = False
         # Set by interrupt() before it severs the lock-step socket: an
         # expected local teardown whose round failure must NOT be treated
         # as a peer death (engine checks it before aborting).
@@ -114,13 +132,14 @@ class TCPController:
         # unarmed hot path costs one attribute check per site.
         self._fault_fire = _faults.fire if _faults.armed() else None
         if rank == 0:
+            srv_port = port if server_port is None else int(server_port)
             self._server = self._lib.hvdtpu_server_start(
-                port, world, ctypes.c_double(stall_warn_s),
+                srv_port, world, ctypes.c_double(stall_warn_s),
                 int(cache_capacity),
                 int(self.round_timeout_s * 1000))
             if not self._server:
                 raise RuntimeError(f"Failed to start controller server on "
-                                   f"port {port}")
+                                   f"port {srv_port}")
         if self._fault_fire is not None:
             self._fault_fire("connect", rank)
         # Bounded connect retries with exponential backoff + jitter
@@ -294,9 +313,12 @@ class TCPController:
             if blob:
                 req += struct.pack("<II", _MON_MAGIC, len(blob)) + blob
                 self.monitor_bytes_sent += 8 + len(blob)
-        # v4 capability hello: FIRST request only, so warm-path frames
-        # carry zero fault-tolerance bytes (the frame guard asserts this).
+        # v5 + v4 capability hellos: FIRST request only, so warm-path
+        # frames carry zero extra bytes (the frame guard asserts this).
+        # AGG5 rides before FLT1 — the server's abort-path capability
+        # salvage reads the frame's FINAL 8 bytes as the FLT1 ad.
         if self.rounds == 1:
+            req += struct.pack("<II", _AGG_MAGIC, 0)
             req += struct.pack("<II", _FLT_MAGIC, 0)
         stats.full_announces += sum(1 for a in full
                                     if not a[0].startswith("\x1f"))
@@ -471,6 +493,9 @@ class TCPController:
             elif magic == _FLT_MAGIC:
                 off += 8  # magic + reserved u32 (always 0)
                 self.peer_fault_proto = True
+            elif magic == _AGG_MAGIC:
+                off += 8  # magic + reserved u32 (always 0)
+                self.peer_hier_proto = True
             else:
                 break
         return ready, warns, errors

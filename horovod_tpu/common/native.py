@@ -88,6 +88,9 @@ def load() -> ctypes.CDLL:
                                             ctypes.c_double, ctypes.c_int,
                                             ctypes.c_int]
         lib.hvdtpu_server_stop.argtypes = [ctypes.c_void_p]
+        lib.hvdtpu_server_stats.restype = ctypes.c_int
+        lib.hvdtpu_server_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_double)]
         lib.hvdtpu_client_connect.restype = ctypes.c_void_p
         lib.hvdtpu_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                               ctypes.c_int, ctypes.c_int]

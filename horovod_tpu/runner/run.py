@@ -143,6 +143,13 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
     p.add_argument("--autotune", action="store_true")
     p.add_argument("--autotune-log-file", default=None)
     p.add_argument("--hierarchical-allreduce", action="store_true")
+    p.add_argument("--hierarchical-controller", action="store_true",
+                   help="Two-level control plane (docs/performance.md "
+                        "'Control plane at scale'): a per-host agent "
+                        "aggregates its ranks' warm-path negotiation "
+                        "frames into one fixed-size uplink per round, so "
+                        "the rank-0 coordinator's gather scales with "
+                        "hosts, not ranks")
     p.add_argument("--tpu-topology-aware", action="store_true", default=True)
     # Elastic (reference: _run_elastic)
     p.add_argument("--min-np", type=int, default=None)
@@ -327,6 +334,8 @@ def tuning_env(args) -> Dict[str, str]:
             env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
     if getattr(args, "hierarchical_allreduce", False):
         env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    if getattr(args, "hierarchical_controller", False):
+        env["HOROVOD_HIERARCHICAL_CONTROLLER"] = "1"
     return env
 
 
@@ -373,9 +382,18 @@ def wait_and_reap(procs: List[subprocess.Popen],
 
 
 def worker_envs(args, hosts: List[HostSpec],
-                coordinator: Tuple[str, int, int]) -> List[Dict[str, str]]:
+                coordinator: Tuple[str, int, int],
+                agent_ports: Optional[List[Optional[int]]] = None
+                ) -> List[Dict[str, str]]:
     """Compute the per-rank env injection (reference §3.3: HOROVOD_RANK,
-    HOROVOD_SIZE, HOROVOD_LOCAL_RANK, HOROVOD_CROSS_RANK, rendezvous addr)."""
+    HOROVOD_SIZE, HOROVOD_LOCAL_RANK, HOROVOD_CROSS_RANK, rendezvous addr).
+
+    ``agent_ports`` (hierarchical control plane): one launcher-allocated
+    listen port per host for that host's aggregation agent, injected as
+    HOROVOD_AGENT_PORT so every process on a host agrees where its agent
+    lives.  A None entry means no injection for that host (remote hosts:
+    a port bind-probed on the launcher proves nothing there — the
+    config-side deterministic fallback derives one instead)."""
     np_total = args.np
     envs = []
     rank = 0
@@ -396,6 +414,9 @@ def worker_envs(args, hosts: List[HostSpec],
                 "HOROVOD_CONTROLLER_PORT2": str(coordinator[2]),
                 "HOROVOD_HOSTNAME": h.hostname,
             }
+            if agent_ports is not None \
+                    and agent_ports[cross_rank] is not None:
+                env["HOROVOD_AGENT_PORT"] = str(agent_ports[cross_rank])
             env |= tuning_env(args)
             if args.timeline_filename:
                 env["HOROVOD_TIMELINE"] = per_rank_filename(
@@ -433,14 +454,28 @@ def launch_workers(args, hosts: List[HostSpec],
     ``addrs`` (from the bootstrap probe phase) overrides the coordinator
     address with host 0's resolved control-plane address — this is what
     makes ``--network-interface`` actually select the control plane."""
-    ports = _free_ports(2)
+    from ..common.net import is_local_host
+    # Hierarchical control plane: one extra port per host for its
+    # aggregation agent.  Bind-probed HERE only for local/loopback hosts
+    # (the CPU test meshes) — a port free on the launcher proves nothing
+    # on a remote host, so remote hosts get NO injection and derive their
+    # own via the HOROVOD_AGENT_PORT=0 fallback in common/config.py.
+    hier = getattr(args, "hierarchical_controller", False)
+    agent_ports = None
+    if hier:
+        local_hosts = [is_local_host(h.hostname) for h in hosts]
+        probed = iter(_free_ports(2 + sum(local_hosts)))
+        ports = [next(probed), next(probed)]
+        agent_ports = [next(probed) if loc else None for loc in local_hosts]
+    else:
+        ports = _free_ports(2)
     if addrs:
         coord_host = addrs[hosts[0].hostname]
     else:
         coord_host = (hosts[0].hostname if hosts[0].hostname != "localhost"
                       else "127.0.0.1")
     coord = (coord_host, ports[0], ports[1])
-    envs = worker_envs(args, hosts, coord)
+    envs = worker_envs(args, hosts, coord, agent_ports=agent_ports)
     procs: List[subprocess.Popen] = []
     for rank, env in enumerate(envs):
         host = env["HOROVOD_HOSTNAME"]

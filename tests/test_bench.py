@@ -68,6 +68,20 @@ def test_bench_minimal_mode():
     iters = out["allreduce_busbw_GBps"]["iters"]
     assert iters["1MB"] >= 10
     assert iters["0.125MB"] > 10, iters
+    # Control-plane scale-out section (ISSUE 9) on every line: simulated
+    # worlds through the real native server, flat vs hierarchical, with
+    # the root-service scoreboard mirrored to the top-level flat_vs_hier.
+    ns = out["negotiation_scaling"]
+    assert set(ns["sizes"]) == {"8", "32", "128"}, ns
+    for rec in ns["sizes"].values():
+        assert rec["flat_root_us"] > 0 and rec["hier_root_us"] > 0, rec
+        assert rec["flat_round_us"] > 0 and rec["hier_round_us"] > 0, rec
+    assert out["flat_vs_hier"] == ns["flat_vs_hier"], (
+        out["flat_vs_hier"], ns["flat_vs_hier"])
+    # The tentpole's claim, measurable even on this shared box: at the
+    # largest world the flat root does multiples of the hierarchical
+    # root's serialized per-round work (128 connections vs 8).
+    assert ns["sizes"]["128"]["flat_vs_hier"] > 1.5, ns
 
 
 def test_bench_default_resnet():

@@ -514,6 +514,62 @@ def test_connect_exhaustion_raises_runtime_error():
     assert time.monotonic() - t0 < 30
 
 
+def test_hierarchical_silent_host_hits_round_deadline():
+    """Protocol v5 fault path: a whole host goes silent (its ranks stop
+    negotiating, sockets open) behind its agent — the root's per-round
+    deadline, armed by the healthy host's uplink, declares the silent
+    host's ranks dead and the survivors get the typed ABORT through their
+    own agent.  Attribution is host-granular by design: the agent is the
+    ranks' only path, so the verdict names all of them."""
+    from test_host_agent import HostAgent, _free_port as _hier_port
+
+    port = _hier_port()
+    agents = [HostAgent(0, "127.0.0.1", port, [0], host_index=0,
+                        connect_timeout_ms=20000).start(),
+              HostAgent(0, "127.0.0.1", port, [1], host_index=1,
+                        connect_timeout_ms=20000).start()]
+    res = {}
+    release = threading.Event()
+
+    def worker(rank):
+        ctl = TCPController("127.0.0.1", agents[rank].port, rank=rank,
+                            world=2, stall_warn_s=60.0, round_timeout_s=1.0,
+                            server_port=port if rank == 0 else None)
+        try:
+            if rank == 1:
+                ctl.negotiate([])
+                ctl.negotiate([])
+                release.wait(20)          # silent: no further rounds
+                res[1] = "done"
+            else:
+                t0 = time.monotonic()
+                try:
+                    for _ in range(10):
+                        ctl.negotiate([])
+                    res[0] = "no error"
+                except PeerFailureError as exc:
+                    res[0] = ("deadline", exc.dead_ranks,
+                              "deadline" in str(exc),
+                              time.monotonic() - t0)
+        finally:
+            if rank == 0:
+                deadline = time.time() + 25
+                while 0 not in res and time.time() < deadline:
+                    time.sleep(0.01)
+            release.set()
+            ctl.shutdown()
+
+    t1 = threading.Thread(target=worker, args=(1,), daemon=True)
+    t1.start()
+    worker(0)
+    t1.join(25)
+    for a in agents:
+        a.stop()
+    kind, dead, named, dt = res[0]
+    assert kind == "deadline" and dead == [1] and named, res
+    assert dt < 8.0, f"abort took {dt}s against a 1s deadline"
+
+
 # ------------------------------------------------------ join_wait contract
 def test_join_wait_raises_typed_timeout():
     """join_wait either returns the last joining rank or raises

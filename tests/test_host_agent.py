@@ -1,0 +1,358 @@
+"""Hierarchical control plane (protocol v5, tier-1, no jax / no spawns).
+
+Real native root server + per-host ``HostAgent`` aggregators + N client
+threads: negotiation verdicts must be identical to flat mode, the warm
+steady state must collapse to ONE fixed-size uplink per host per round,
+MON1 telemetry must dedup through the agent with a byte-identical
+``RankAggregator`` table, and agent/rank deaths must surface as typed
+attributed ``PeerFailureError``s.  The per-rank wire bytes are pinned in
+``tests/test_response_cache.py`` (frame guards); the cross-process
+acceptance lives in ``tests/test_multiprocess.py``.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.controller import TCPController
+from horovod_tpu.common.exceptions import (
+    HorovodInternalError, PeerFailureError,
+)
+from horovod_tpu.common.host_agent import HostAgent, split_rank_frame
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class E:
+    """Minimal negotiable entry (the controller only getattr-probes it)."""
+
+    def __init__(self, name, shape=(4,), tag=None):
+        self.name = name
+        self.tensor = np.zeros((2,) + tuple(shape), np.float32)
+        if tag is not None:
+            self.sanitizer_tag = tag
+
+
+def _steps(ctl, make_entries, n_steps, max_rounds=30):
+    """Drive submit->negotiate-until-ready cycles (lock-step friendly:
+    every rank keeps calling rounds until its own verdicts land)."""
+    orders = []
+    for _ in range(n_steps):
+        entries = list(make_entries())
+        got = []
+        for _round in range(max_rounds):
+            if not entries:
+                break
+            ready, errs = ctl.negotiate(entries)
+            assert not errs, errs
+            got += [e.name for e in ready]
+            entries = [e for e in entries if e.name not in set(got)]
+        assert not entries, f"never became ready: {[e.name for e in entries]}"
+        orders.append(tuple(got))
+    return orders
+
+
+def run_hier(hosts, fn, cache_capacity=2048, round_timeout_s=0.0,
+             setup=None, expect_errors=False):
+    """Run ``fn(ctl, rank)`` on every rank of a simulated multi-host world.
+
+    ``hosts`` is a list of rank lists (one per simulated host); each host
+    gets a real ``HostAgent``, rank 0 additionally hosts the native root
+    server (on a port distinct from any agent's).  Returns
+    ``(results, errors, agents)`` — with ``expect_errors`` False, any
+    worker exception fails the test."""
+    world = sum(len(h) for h in hosts)
+    root_port = _free_port()
+    agents = [HostAgent(0, "127.0.0.1", root_port, ranks, host_index=i,
+                        connect_timeout_ms=20000).start()
+              for i, ranks in enumerate(hosts)]
+    agent_of = {r: a for a, ranks in zip(agents, hosts) for r in ranks}
+    results, errors = {}, {}
+    all_done = threading.Event()
+
+    def worker(rank):
+        ctl = TCPController(
+            "127.0.0.1", agent_of[rank].port, rank=rank, world=world,
+            stall_warn_s=60.0, cache_capacity=cache_capacity,
+            round_timeout_s=round_timeout_s,
+            server_port=root_port if rank == 0 else None)
+        if setup is not None:
+            setup(ctl, rank)
+        try:
+            results[rank] = fn(ctl, rank)
+        except Exception as exc:  # noqa: BLE001 - surfaced by the assert
+            errors[rank] = exc
+        finally:
+            if len(results) + len(errors) == world:
+                all_done.set()
+            # Everyone holds its socket open until the whole world is done
+            # (lock-step: an early sever looks like a death to the agent).
+            all_done.wait(timeout=30)
+            ctl.shutdown()
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for h in hosts for r in h if r != 0]
+    for t in threads:
+        t.start()
+    worker(0)
+    for t in threads:
+        t.join(timeout=30)
+    for a in agents:
+        a.stop()
+    if not expect_errors:
+        assert not errors, errors
+        assert len(results) == world, sorted(results)
+    return results, errors, agents
+
+
+# ------------------------------------------------------------- equivalence
+def test_hierarchical_negotiation_matches_flat_semantics():
+    """4 ranks over 2 simulated hosts: every tensor becomes ready on every
+    rank, in the same global order — through warm-up AND steady state, so
+    both the string and the aggregated-bitvector verdict paths are
+    exercised."""
+    names = [f"grad.{i}" for i in range(6)]
+
+    def fn(ctl, rank):
+        return _steps(ctl, lambda: [E(n) for n in names], 5)
+
+    results, _errs, agents = run_hier([[0, 1], [2, 3]], fn)
+    assert results[0] == results[1] == results[2] == results[3]
+    # The warm steady state actually took the aggregate path on each host.
+    for a in agents:
+        assert a.stats.agg_rounds > 0, vars(a.stats)
+        assert a.error is None, a.error
+
+
+def test_one_uplink_per_host_per_round_and_fixed_size():
+    """THE scale-out guard (satellite): after warm-up, each steady-state
+    round costs the root exactly ONE uplink frame per host (not one per
+    rank), with zero per-rank subframes and a fixed-size aggregate
+    payload — the hierarchical analogue of the response cache's 13-byte
+    warm frame."""
+    names = [f"g.{i}" for i in range(8)]
+
+    def fn(ctl, rank):
+        mk = lambda: [E(n) for n in names]            # noqa: E731
+        _steps(ctl, mk, 2)                            # warm-up: learn slots
+        orders = _steps(ctl, mk, 5)                   # steady state
+        return orders
+
+    results, _errs, agents = run_hier([[0, 1], [2, 3]], fn)
+    for a in agents:
+        # One uplink per round — NEVER more (one per rank would be the
+        # flat regression this test exists to catch).
+        assert a.stats.uplink_frames == a.stats.rounds, vars(a.stats)
+        # The 5 steady steps all collapsed to the aggregate path, and the
+        # aggregate payload is a fixed handful of bytes: HUP5 magic +
+        # dead/agg/sub/mon section headers + a one-byte bitvector.
+        assert a.stats.agg_rounds >= 5, vars(a.stats)
+        assert 0 < a.stats.last_agg_uplink_len <= 40, vars(a.stats)
+        assert a.error is None, a.error
+    assert results[0] == results[1] == results[2] == results[3]
+
+
+# ---------------------------------------------------------------- monitor
+def test_monitor_fanin_dedup_byte_identical():
+    """Satellite: the agent extracts MON1 blobs into ONE deduplicated
+    uplink section; the root's re-broadcast (and with it every rank's
+    ``RankAggregator`` table) is byte-identical to flat mode."""
+    import json
+    from horovod_tpu.monitor.aggregator import RankAggregator
+
+    def run(mode_hosts):
+        blobs_by_rank = {r: json.dumps({"rank": r, "cycle": 7 + r},
+                                       separators=(",", ":")).encode()
+                         for h in mode_hosts for r in h}
+        aggs = {}
+        sent = {}
+
+        def setup(ctl, rank):
+            aggs[rank] = RankAggregator(4)
+            sent[rank] = [False]
+
+            def source():
+                if sent[rank][0]:
+                    return None
+                sent[rank][0] = True
+                return blobs_by_rank[rank]
+
+            def sink(blobs):
+                for br, blob in blobs:
+                    aggs[rank].update(br, json.loads(bytes(blob).decode()))
+
+            ctl.monitor_source = source
+            ctl.monitor_sink = sink
+
+        def fn(ctl, rank):
+            # Rounds with the blob attached, then enough rounds for the
+            # re-broadcast to land everywhere.
+            for _ in range(4):
+                ctl.negotiate([])
+            return {r: aggs[rank].snapshot_of(r) for r in range(4)}
+
+        results, _e, agents = run_hier(mode_hosts, fn, setup=setup)
+        return results, agents
+
+    hier_results, agents = run([[0, 1], [2, 3]])
+    # Every rank's aggregation table holds every rank's snapshot, decoded
+    # from byte-identical blobs (the dict round-trips exactly).
+    for rank in range(4):
+        table = hier_results[rank]
+        for r in range(4):
+            assert table[r] == {"rank": r, "cycle": 7 + r}, (rank, table)
+    # The blobs travelled deduplicated through the agents, not as
+    # store-and-forward subframes.
+    assert sum(a.stats.mon_blobs_forwarded for a in agents) == 4, [
+        vars(a.stats) for a in agents]
+
+
+# ------------------------------------------------------------ fault paths
+def test_agent_death_aborts_with_host_rank_attribution():
+    """Satellite: killing a host's agent yields a typed attributed
+    PeerFailureError on the OTHER host's ranks naming ALL of the dead
+    host's ranks, within the round deadline — no wedged waiters."""
+    killed = threading.Event()
+
+    def fn(ctl, rank):
+        _steps(ctl, lambda: [E("t")], 1)          # world is up
+        if rank in (2, 3):
+            killed.wait(15)                        # host 1 dies under them
+            try:
+                for _ in range(50):
+                    ctl.negotiate([E("t2")])
+                return "no error"
+            except (PeerFailureError, HorovodInternalError) as exc:
+                return ("died", type(exc).__name__)
+        if rank == 1:
+            killed.wait(15)
+        if rank == 0:
+            time.sleep(0.3)
+            _AGENT_TO_KILL[0].kill()
+            killed.set()
+        t0 = time.monotonic()
+        try:
+            for _ in range(50):
+                ctl.negotiate([E("t2")])
+                time.sleep(0.05)
+            return "no error"
+        except PeerFailureError as exc:
+            return ("peer_failure", sorted(exc.dead_ranks),
+                    "HVD303" in str(exc), time.monotonic() - t0)
+        except HorovodInternalError:
+            return ("internal",)
+
+    global _AGENT_TO_KILL
+    _AGENT_TO_KILL = []
+
+    world_hosts = [[0, 1], [2, 3]]
+    root_port = _free_port()
+    agents = [HostAgent(0, "127.0.0.1", root_port, ranks, host_index=i,
+                        connect_timeout_ms=20000).start()
+              for i, ranks in enumerate(world_hosts)]
+    _AGENT_TO_KILL.append(agents[1])
+    agent_of = {r: a for a, ranks in zip(agents, world_hosts) for r in ranks}
+    results = {}
+
+    def worker(rank):
+        ctl = TCPController(
+            "127.0.0.1", agent_of[rank].port, rank=rank, world=4,
+            stall_warn_s=60.0, round_timeout_s=2.0,
+            server_port=root_port if rank == 0 else None)
+        try:
+            results[rank] = fn(ctl, rank)
+        except Exception as exc:  # noqa: BLE001
+            results[rank] = ("raised", repr(exc))
+        finally:
+            deadline = time.time() + 25
+            while len(results) < 4 and time.time() < deadline:
+                time.sleep(0.01)
+            ctl.shutdown()
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in (1, 2, 3)]
+    for t in threads:
+        t.start()
+    worker(0)
+    for t in threads:
+        t.join(25)
+    for a in agents:
+        a.stop()
+    kind, dead, hvd303, dt = results[0]
+    assert kind == "peer_failure", results
+    assert dead == [2, 3], results          # the WHOLE host, attributed
+    assert hvd303 and dt < 10.0, results
+    assert results[1][0] in ("peer_failure", "internal", "died"), results
+
+
+def test_local_rank_death_propagates_attributed_through_agent():
+    """A single rank's socket to its agent dies: the agent reports it
+    upstream (FLT-style dead-rank ad in the uplink) and the root aborts
+    the fleet naming exactly that rank."""
+    severed = threading.Event()
+
+    def fn(ctl, rank):
+        _steps(ctl, lambda: [E("t")], 1)
+        if rank == 3:
+            ctl._sever()                      # uncontrolled death of rank 3
+            severed.set()
+            try:
+                ctl.negotiate([E("t2")])
+            except (PeerFailureError, HorovodInternalError):
+                pass
+            return "severed"
+        severed.wait(15)
+        try:
+            for _ in range(50):
+                ctl.negotiate([E("t2")])
+                time.sleep(0.05)
+            return "no error"
+        except PeerFailureError as exc:
+            return ("peer_failure", sorted(exc.dead_ranks),
+                    "HVD303" in str(exc))
+        except HorovodInternalError:
+            return ("internal",)
+
+    results, _errs, _agents = run_hier([[0, 1], [2, 3]], fn,
+                                       round_timeout_s=2.0,
+                                       expect_errors=True)
+    assert results[3] == "severed", results
+    assert results[0] == ("peer_failure", [3], True), results
+    assert results[1] == ("peer_failure", [3], True), results
+
+
+# ----------------------------------------------------------- frame parser
+def test_split_rank_frame_roundtrip():
+    """The agent's frame splitter must walk exactly the client wire
+    layout: announces, bitvector, tags, then generic trailing sections."""
+    import struct as _s
+    core = _s.pack("<I", 0) + _s.pack("<I", 1) + b"\x05" + _s.pack("<I", 0)
+    mon = _s.pack("<II", 0x314E4F4D, 3) + b"abc"
+    flt = _s.pack("<II", 0x31544C46, 0)
+    parsed = split_rank_frame(core + mon + flt)
+    assert parsed is not None
+    n_ann, n_tag, core_end, trailing = parsed
+    assert (n_ann, n_tag) == (0, 0)
+    assert core_end == len(core)
+    assert trailing == [(0x314E4F4D, b"abc"), (0x31544C46, b"")]
+    # Truncated trailing payload: malformed, forwarded verbatim.
+    assert split_rank_frame(core + mon[:-2]) is None
+
+
+def test_agent_is_jax_free_import():
+    """The agent must stay importable on the jax-free tier (also enforced
+    by the purity subprocess in test_monitor.py)."""
+    import sys
+    assert "horovod_tpu.common.host_agent" in sys.modules
+    import horovod_tpu.common.host_agent as ha
+    src = open(ha.__file__).read()
+    assert "import jax" not in src

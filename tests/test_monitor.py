@@ -483,6 +483,9 @@ importlib.import_module('horovod_tpu.testing')
 importlib.import_module('horovod_tpu.testing.faults')
 importlib.import_module('horovod_tpu.common.exceptions')
 importlib.import_module('horovod_tpu.common.net')
+# Hierarchical control plane: the per-host aggregation agent runs in
+# launcher-adjacent processes and the jax-free negotiation test tier.
+importlib.import_module('horovod_tpu.common.host_agent')
 print('PURITY_OK')
 """
 

@@ -690,6 +690,85 @@ def test_torovodrun_elastic_rerendezvous_after_crash(tmp_path):
                for kind, ranks in data["caught"]), data
 
 
+def test_torovodrun_hierarchical_controller_collectives():
+    """ISSUE 9 acceptance (happy path): the two-level control plane across
+    two simulated hosts — each worker talks to its host's aggregation
+    agent, the root sees one connection per host — produces the same
+    collective results as flat mode (the worker's own assertions)."""
+    res = _run_torovodrun(2, WORKER,
+                          extra_args=("-H", "localhost:1,127.0.0.1:1",
+                                      "--hierarchical-controller"))
+    ok = res.stdout.count("WORKER_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
+def test_torovodrun_hierarchical_single_host_agent():
+    """Both ranks behind ONE agent (the -np 2 localhost default): the
+    agent aggregates its whole world and the root negotiates with a single
+    connection."""
+    res = _run_torovodrun(2, WORKER, extra_args=("--hierarchical-controller",))
+    ok = res.stdout.count("WORKER_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
+def test_torovodrun_hierarchical_agent_crash_attributed(tmp_path):
+    """ISSUE 9 acceptance (fault half, the 2-proc/2-'host' worker): rank
+    1 — alone on its simulated host — crashes mid-negotiation, killing its
+    host agent with it.  The root attributes the severed AGENT connection
+    to the host's ranks, and rank 0 records a typed HVD303
+    PeerFailureError naming rank 1 within the round deadline — no wedged
+    waiters (same contract as the flat-mode test above, now through two
+    agents)."""
+    import json
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("localhost slots=1\n127.0.0.1 slots=1\n")
+    result = tmp_path / "fault_result.json"
+    res = _run_torovodrun(2, WORKER_FAULTS, timeout=300,
+                          extra_args=("--hostfile", str(hostfile),
+                                      "--hierarchical-controller"),
+                          extra_env={
+                              "FAULT_MODE": "static",
+                              "FAULT_RESULT": str(result),
+                              "HVD_TPU_FAULT": "mid_round_exit:1:crash:300",
+                              "HOROVOD_ROUND_TIMEOUT_S": "30",
+                          })
+    assert res.returncode != 0, (
+        "rank 1's unclean crash must fail the launch\n"
+        f"stdout:\n{res.stdout[-2000:]}")
+    assert result.exists(), (
+        f"rank 0 never recorded the typed abort\nstdout:\n"
+        f"{res.stdout[-3000:]}\nstderr:\n{res.stderr[-3000:]}")
+    data = json.loads(result.read_text())
+    assert data["ok"] and data["mode"] == "static", data
+    assert data["dead_ranks"] == [1] and data["hvd303"], data
+    assert data["elapsed_s"] < 30, data
+
+
+def test_torovodrun_hierarchical_monitor_acceptance():
+    """Monitor fan-in through the agents: cross-rank aggregation, the
+    HVD302 peer-ledger report and /health must all survive the MON1 blobs
+    being deduplicated into per-host uplinks (worker assertions unchanged
+    from the flat monitor acceptance)."""
+    port = _free_port()
+    res = _run_torovodrun(2, WORKER_MONITOR, timeout=300,
+                          extra_args=("--hierarchical-controller",),
+                          extra_env={
+                              "HOROVOD_MONITOR": "1",
+                              "HOROVOD_MONITOR_INTERVAL": "0.2",
+                              "HOROVOD_MONITOR_PORT": str(port),
+                              "HVD_TPU_SANITIZER": "1",
+                              "HVD_TPU_SANITIZER_TIMEOUT": "2",
+                          })
+    ok = res.stdout.count("MONITOR_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
 def test_torovodrun_sanitizer_catches_divergence_on_cached_path():
     """PR 2 acceptance: HVD_TPU_SANITIZER=1 still catches divergent
     submission order when both ranks are on the cached/bitvector path (the

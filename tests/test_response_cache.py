@@ -286,6 +286,39 @@ def test_v4_liveness_adds_zero_warm_path_bytes():
         faults.disarm()
 
 
+def test_hierarchy_keeps_per_rank_warm_path_bytes_identical():
+    """Protocol-v5 frame guard: with the hierarchical control plane ON
+    (ranks talk to a per-host agent, not the root), each rank's warm-path
+    request is byte-for-byte the flat 13-byte frame — 4B n_full + 4B
+    bv_len + 1B bitvec + 4B n_tag — and the v5 capability ad rides round 1
+    ONLY, exactly like FLT1/MON1.  The aggregation is the AGENT's job; a
+    refactor that leaks it into the per-rank wire format fails here."""
+    from test_host_agent import run_hier, _steps as _hier_steps
+
+    def fn(ctl, rank):
+        assert not ctl.peer_hier_proto
+        _hier_steps(ctl, lambda: [E("t")], 2)        # warm-up: learn slot
+        # Round 1's response carried the server's v5 ad (through the
+        # agent, verbatim).
+        assert ctl.peer_hier_proto and ctl.peer_fault_proto
+        bytes_before = ctl.bytes_sent
+        rounds_before = ctl.rounds
+        _hier_steps(ctl, lambda: [E("t")], 4)
+        per_round = ((ctl.bytes_sent - bytes_before)
+                     / (ctl.rounds - rounds_before))
+        assert per_round == 13, (
+            f"warm-path frame grew to {per_round}B under the hierarchical "
+            f"control plane — aggregation must cost zero per-rank bytes")
+        return True
+
+    results, _errs, agents = run_hier([[0, 1], [2, 3]], fn)
+    assert len(results) == 4
+    # ...and those identical 13-byte frames actually collapsed into ONE
+    # aggregate uplink per host in the steady state.
+    assert all(a.stats.agg_rounds >= 4 for a in agents), [
+        vars(a.stats) for a in agents]
+
+
 # ------------------------------------------------------------ invalidation
 def test_shape_change_falls_back_to_full_negotiation():
     """A new digest (shape change) misses the cache on every rank, rides a

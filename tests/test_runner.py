@@ -76,6 +76,25 @@ def test_worker_envs():
     assert all(e["HOROVOD_CONTROLLER_ADDR"] == "1.2.3.4" for e in envs)
     assert envs[0]["HOROVOD_FUSION_THRESHOLD"] == str(16 * 1024 * 1024)
     assert envs[1]["HOROVOD_TIMELINE"] == "/tmp/tl.1"
+    # Flat mode injects no agent endpoint.
+    assert all("HOROVOD_AGENT_PORT" not in e for e in envs)
+
+
+def test_worker_envs_hierarchical_controller():
+    """ISSUE 9 launch path: --hierarchical-controller forwards the knob
+    through tuning_env (shared by every backend, so it can't drift) and
+    injects ONE agent port per host — every process on a host must agree
+    where its aggregation agent listens."""
+    from horovod_tpu.runner.run import tuning_env
+    args = parse_args(["-np", "4", "-H", "a:2,b:2",
+                       "--hierarchical-controller", "python", "t.py"])
+    assert tuning_env(args)["HOROVOD_HIERARCHICAL_CONTROLLER"] == "1"
+    hosts = placement(args)
+    envs = worker_envs(args, hosts, ("1.2.3.4", 5555, 5556),
+                       agent_ports=[7001, 7002])
+    assert [e["HOROVOD_AGENT_PORT"] for e in envs] == \
+        ["7001", "7001", "7002", "7002"]
+    assert all(e["HOROVOD_HIERARCHICAL_CONTROLLER"] == "1" for e in envs)
 
 
 def test_platform_worker_env_cpu_hygiene():
