@@ -428,6 +428,115 @@ def bench_negotiation_scaling(errors=None):
     return out
 
 
+def bench_autoscale(errors=None):
+    """Closed-loop autoscaling micro-costs (ISSUE 10): (1) policy decision
+    latency — ``ScalePolicy.observe`` over scripted summaries, the
+    per-poll cost the elastic driver pays every autoscale interval; (2)
+    the clean-LEAVE drain round-trip — a REAL native server + two
+    controller clients, wall time from ``leave()`` on one rank to the
+    survivor OBSERVING the leave notice (the control-plane half of the
+    drain pipeline; the worker's batch-boundary drain dominates in
+    production).  Rank-0 only, self-contained (own server on a free
+    port), jax-free."""
+    if os.environ.get("HOROVOD_RANK", "0") not in ("", "0"):
+        return None
+    import socket as _socket
+    import threading as _threading
+
+    import numpy as np
+
+    from horovod_tpu.common.controller import TCPController
+    from horovod_tpu.elastic.autoscale import ScalePolicy
+
+    t_section = time.perf_counter()
+    out = {}
+    # (1) decision latency: a mixed diet of hold/scale/evict-shaped
+    # summaries through one policy instance.
+    pol = ScalePolicy(min_np=1, max_np=64, persistence=2, cooldown_s=0.0,
+                      idle_s=1e9)
+    n_obs = 300
+    t0 = time.perf_counter()
+    for i in range(n_obs):
+        pol.observe({
+            "slowest_rank": i % 8,
+            "per_rank_cycle_us": {r: 100.0 + 40.0 * ((i + r) % 5)
+                                  for r in range(8)},
+            "cycle_us_spread": float(i % 13),
+            "queue_depth": i % 32,
+            "queue_depth_trend": (i % 9) - 4.0,
+            "progress_total": i,
+        }, size=8, now=float(i))
+    out["decision_us"] = round(
+        (time.perf_counter() - t0) / n_obs * 1e6, 2)
+    out["decisions"] = pol.decisions
+
+    # (2) drain round-trip over the real wire.
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    result = {}
+    bar = _threading.Barrier(2)
+    leave_evt = _threading.Event()
+
+    class _E:
+        def __init__(self, name):
+            self.name = name
+            self.tensor = np.zeros((2, 4), np.float32)
+            self.group_id = -1
+
+    def run(rank):
+        ctl = TCPController("127.0.0.1", port, rank=rank, world=2,
+                            stall_warn_s=60.0, cache_capacity=64)
+        try:
+            for step in (0, 1):            # warm: settle all work
+                pending = [_E(f"warm{step}")]
+                for _ in range(30):
+                    ready, _errs = ctl.negotiate(pending)
+                    got = {e.name for e in ready}
+                    pending = [e for e in pending if e.name not in got]
+                    if not pending:
+                        break
+            bar.wait(timeout=30)
+            if rank == 1:
+                result["t_leave"] = time.perf_counter()
+                result["leave_sent"] = ctl.leave()
+                leave_evt.set()
+            else:
+                leave_evt.wait(30)
+                for _ in range(5000):
+                    ctl.negotiate([])
+                    if ctl.left_ranks:
+                        break
+                result["t_seen"] = time.perf_counter()
+                result["left_observed"] = ctl.left_ranks == [1]
+        except Exception as exc:  # noqa: BLE001 - recorded, never hangs
+            result.setdefault("error", repr(exc))
+            try:
+                bar.abort()
+            except Exception:  # noqa: BLE001
+                pass
+            leave_evt.set()
+        finally:
+            ctl.shutdown()
+
+    t = _threading.Thread(target=run, args=(1,), daemon=True)
+    t.start()
+    run(0)
+    t.join(timeout=30)
+    if "error" in result:
+        if errors is not None:
+            errors["autoscale_drain"] = result["error"]
+    else:
+        out["leave_sent"] = bool(result.get("leave_sent"))
+        out["left_observed"] = bool(result.get("left_observed"))
+        out["drain_roundtrip_us"] = round(
+            (result["t_seen"] - result["t_leave"]) * 1e6, 1)
+    _record_timing("autoscale", warmup=2, iters=n_obs,
+                   wall_s=time.perf_counter() - t_section)
+    return out
+
+
 def bench_response_cache(iters=30, n_tensors=8, errors=None):
     """Eager steady-state with the negotiation response cache ON vs OFF
     (client-side A/B: the slot tables stay coordinated either way): bus-bw
@@ -1775,6 +1884,10 @@ def _run(out, errors):
                     out["flat_vs_hier"] = sec.get("flat_vs_hier")
             except Exception as exc:  # noqa: BLE001 - contained
                 errors["negotiation_scaling"] = repr(exc)
+        try:
+            out["autoscale"] = bench_autoscale(errors=errors)
+        except Exception as exc:  # noqa: BLE001 - contained
+            errors["autoscale"] = repr(exc)
         return
 
     if model == "llama":
@@ -1894,6 +2007,11 @@ def _run(out, errors):
                 out["flat_vs_hier"] = sec.get("flat_vs_hier")
         except Exception as exc:  # noqa: BLE001 - contained
             errors["negotiation_scaling"] = repr(exc)
+
+    try:
+        out["autoscale"] = bench_autoscale(errors=errors)
+    except Exception as exc:  # noqa: BLE001 - contained
+        errors["autoscale"] = repr(exc)
 
     if os.environ.get("HVD_BENCH_SKIP_AUTOTUNE", "") != "1":
         try:

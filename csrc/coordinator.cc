@@ -101,6 +101,48 @@
 //              pre-processing FLT1 salvage reads the frame's final 8
 //              bytes, so FLT1 must stay last.)
 //
+//   LEAVE  := uint32 0xFFFFFFFE, uint32 magic "LVE6"
+//             (protocol v6 clean departure: a rank announces its own
+//              orderly exit IN PLACE of a round frame, immediately before
+//              severing its socket.  0xFFFFFFFE is an impossible
+//              n_announce, so the frame is unambiguous against every
+//              normal request.  The server drops the rank from the gather
+//              with NO dead-peer verdict: the rank stops counting toward
+//              world-level readiness (pending entries keep their raw
+//              required=0 marker and re-materialize against the shrunk
+//              effective world at verdict time), its connection leaves the
+//              poller, and survivors are told through a trailing LVE6
+//              response section.  The ONE abort case: the leaver still has
+//              outstanding negotiated work (a pending tensor it announced,
+//              or — while joined — an implicit world-level credit) whose
+//              readiness would include a rank that will never execute it;
+//              then the server broadcasts the typed ABORT naming the
+//              leaver, exactly like a crash, because the departure was NOT
+//              clean.  Version gating: the client advertises v6 with a
+//              round-1 LVE6 request section (between AGG5 and the final
+//              FLT1) and the server advertises with a round-1 LVE6
+//              response section (after AGG5); the server honors a LEAVE
+//              only when EVERY survivor has latched v6 — a pre-v6 survivor
+//              cannot parse the leave notice and would execute
+//              shrunk-world verdicts its fixed-size data plane cannot
+//              resolve — otherwise the LEAVE is ignored and the leaver's
+//              subsequent socket sever produces the legacy v4 verdict.
+//              Races: a LEAVE landing mid-gather counts as the rank's
+//              round frame (the deadline is satisfied, the gather
+//              completes with the survivors); one landing during a
+//              response write sits in the reassembly buffer and is taken
+//              as the NEXT round's frame — the sock_dead the sever leaves
+//              behind is ignored for a left connection, never a verdict.)
+//
+//   S->C   += [protocol v6] uint32 magic "LVE6", uint32 len,
+//             uint32 n_left, n_left * uint32 rank
+//             (ranks that left THIS round, appended after the MON1
+//              section only on rounds where someone actually left — the
+//              warm path carries zero extra bytes — plus an empty
+//              (n_left = 0) section on round 1 as the capability ad.
+//              Pre-v6 clients stop their trailing walk at the unknown
+//              magic and lose nothing.)
+//
 //   AGENT  := a per-host aggregator (horovod_tpu/common/host_agent.py) may
 //             connect IN PLACE of its host's ranks: handshake word
 //             0xFFFFFF05 ("v5 agent hello", outside the rank space), then
@@ -233,6 +275,20 @@ constexpr uint32_t kAbortEscape = 0xffffffffu;
 constexpr uint32_t kAggMagic = 0x35474741;
 constexpr uint32_t kAgentHello = 0xffffff05u;
 constexpr uint32_t kHupMagic = 0x35505548;
+// Clean-LEAVE (protocol v6): the request-side escape word (an impossible
+// n_announce, mirroring the response side's 0xFFFFFFFF abort escape) and
+// the "LVE6" magic that doubles as the capability ad in both directions.
+constexpr uint32_t kLeaveEscape = 0xfffffffeu;
+constexpr uint32_t kLeaveMagic = 0x3645564c;
+
+// A standalone clean-LEAVE frame: { kLeaveEscape, kLeaveMagic }.
+bool is_leave_frame(const uint8_t* p, size_t n) {
+  if (n < 8) return false;
+  uint32_t esc = 0, magic = 0;
+  std::memcpy(&esc, p, 4);
+  std::memcpy(&magic, p + 4, 4);
+  return esc == kLeaveEscape && magic == kLeaveMagic;
+}
 // Per-blob and per-response caps for the monitor section: the aggregate
 // re-broadcast must stay well inside the client's fixed 4MB receive
 // buffer (_RESP_CAP in common/controller.py) no matter how many ranks
@@ -377,6 +433,13 @@ struct Conn {
   std::vector<uint8_t> inbuf;       // partial frame bytes (reassembly)
   std::vector<std::vector<uint8_t>> frames;  // complete frames, FIFO
   bool sock_dead = false;
+  // Every rank this connection spoke for departed via clean LEAVE
+  // (protocol v6): removed from the poller, skipped by the gather, the
+  // deadline verdicts and the response write — its inevitable trailing
+  // EOF must never become a dead-peer verdict.  (An agent connection
+  // only flips this once its LAST local rank left; individual leaves
+  // just shrink `ranks`.)
+  bool left = false;
 
   // Drain everything currently readable without blocking; extract complete
   // frames.  Returns false once the socket is dead (EOF / hard error).
@@ -488,7 +551,13 @@ class Poller {
 struct PendingInfo {
   uint64_t order;            // announce sequence for deterministic ordering
   std::set<int> ready_ranks;
-  int required = 0;          // ranks needed (0 = full world)
+  // Ranks needed.  Kept RAW (0 = the full world, the announce-side
+  // marker) and materialized against the EFFECTIVE world — world minus
+  // clean leavers — at verdict time, so a rank departing via LEAVE
+  // (protocol v6) shrinks the threshold of already-pending world-level
+  // tensors instead of wedging them on a contribution that will never
+  // come.  Sub-process-set thresholds (required > 0) are unaffected.
+  int required = 0;
   Clock::time_point first_seen;
   bool warned = false;
   // Shape/dtype consistency: digest of the first announce, plus who
@@ -586,6 +655,13 @@ struct Server {
   // for protocol symmetry with v4[] so a future v5-gated section has its
   // capability record already on the wire; today it is diagnostic only.
   std::unique_ptr<std::atomic<char>[]> v5;
+  // Protocol v6 (clean LEAVE): per-rank capability latch (round-1 LVE6
+  // request ad; an agent's ranks latch from their forwarded round-1
+  // subframes) and the set of ranks that departed cleanly.  eff_world()
+  // is the readiness world every verdict materializes against.
+  std::unique_ptr<std::atomic<char>[]> v6;
+  std::set<int> left;
+  int eff_world() const { return world - static_cast<int>(left.size()); }
   std::vector<Conn> conns;
   // Root-side service accounting (hvdtpu_server_stats): per-round time
   // from gather completion to the last response write — the serialized
@@ -614,7 +690,7 @@ void Server::broadcast_abort(const std::set<int>& dead,
   for (int r : dead) put_u32(&resp, static_cast<uint32_t>(r));
   put_str(&resp, why);
   for (Conn& c : conns) {
-    if (c.sock_dead || c.fd < 0) continue;
+    if (c.sock_dead || c.left || c.fd < 0) continue;
     bool any_live_v4 = false;
     for (int r : c.ranks)
       if (!dead.count(r) && v4[r].load()) any_live_v4 = true;
@@ -754,6 +830,9 @@ void Server::run_inner() {
     // client's aggregation table tracks the fleet.  The server never
     // parses the payload.
     std::vector<std::pair<int, std::string>> mon_blobs;
+    // Ranks whose clean LEAVE (protocol v6) was processed this round —
+    // broadcast to survivors in the trailing LVE6 response section.
+    std::vector<int> left_this_round;
     bool join_started = false;
     // slot: >= 0 answers may ride the ready bitvector; -1 forces strings.
     auto handle_announce = [&](int r, uint16_t required,
@@ -765,7 +844,7 @@ void Server::run_inner() {
       if (it == pending.end()) {
         PendingInfo info;
         info.order = announce_seq++;
-        info.required = required ? required : world;
+        info.required = required;   // raw: 0 = full (effective) world
         info.first_seen = Clock::now();
         info.digest = digest;
         info.group = group == "-1" ? group : std::to_string(r) + ":" + group;
@@ -938,6 +1017,7 @@ void Server::run_inner() {
     // here, not silently skipped.
     int pending_frames = 0;
     for (size_t i = 0; i < conns.size(); ++i) {
+      if (conns[i].left) continue;   // departed cleanly: not in this round
       if (!conns[i].frames.empty()) {
         take_frame(i);
       } else if (conns[i].sock_dead) {
@@ -974,7 +1054,8 @@ void Server::run_inner() {
           // the round — declaring it dead would abort the fleet with a
           // verdict naming a healthy rank.
           for (size_t i = 0; i < conns.size(); ++i) {
-            if (have_frame[i] || conns[i].sock_dead) continue;
+            if (have_frame[i] || conns[i].sock_dead || conns[i].left)
+              continue;
             conns[i].drain();
             if (!conns[i].frames.empty()) {
               take_frame(i);
@@ -982,7 +1063,7 @@ void Server::run_inner() {
             }
           }
           for (size_t i = 0; i < conns.size(); ++i) {
-            if (have_frame[i]) continue;
+            if (have_frame[i] || conns[i].left) continue;
             if (conns[i].sock_dead) {
               poller.remove(conns[i].fd);
               for (int r : conns[i].ranks) dead_conn.insert(r);
@@ -1023,7 +1104,8 @@ void Server::run_inner() {
       if (!dead_conn.empty()) {
         bool awaiting_ad = false;
         for (size_t i = 0; i < conns.size(); ++i) {
-          if (have_frame[i] || conns[i].sock_dead) continue;
+          if (have_frame[i] || conns[i].sock_dead || conns[i].left)
+            continue;
           for (int r : conns[i].ranks)
             if (!dead_conn.count(r) && !v4[r].load()) {
               awaiting_ad = true;
@@ -1049,7 +1131,7 @@ void Server::run_inner() {
       // the untyped legacy sever (unattributed rc=-1) instead of the
       // typed ABORT.
       for (size_t i = 0; i < conns.size(); ++i) {
-        if (have_frame[i] || conns[i].sock_dead) continue;
+        if (have_frame[i] || conns[i].sock_dead || conns[i].left) continue;
         bool all_dead = true;
         for (int r : conns[i].ranks)
           if (!dead_conn.count(r) && !dead_late.count(r)) all_dead = false;
@@ -1068,10 +1150,12 @@ void Server::run_inner() {
       if (std::getenv("HVD_TPU_COORD_DEBUG") != nullptr) {
         for (size_t i = 0; i < conns.size(); ++i)
           fprintf(stderr,
-                  "[coord] round=%llu conn=%zu ranks0=%d agent=%d "
+                  "[coord] round=%llu conn=%zu ranks0=%d agent=%d left=%d "
                   "have=%d dead=%d errno=%d inbuf=%zu frames=%zu\n",
-                  (unsigned long long)round_no, i, conns[i].ranks.front(),
-                  (int)conns[i].is_agent, (int)have_frame[i],
+                  (unsigned long long)round_no, i,
+                  conns[i].ranks.empty() ? -1 : conns[i].ranks.front(),
+                  (int)conns[i].is_agent, (int)conns[i].left,
+                  (int)have_frame[i],
                   (int)conns[i].sock_dead, conns[i].dead_errno,
                   conns[i].inbuf.size(), conns[i].frames.size());
       }
@@ -1237,6 +1321,8 @@ void Server::run_inner() {
           v4[r].store(1);
         } else if (magic == kAggMagic) {
           v5[r].store(1);
+        } else if (magic == kLeaveMagic) {
+          v6[r].store(1);
         }
         rd.p += blen;
       }
@@ -1263,7 +1349,7 @@ void Server::run_inner() {
         if (fresh) {
           PendingInfo info;
           info.order = announce_seq++;
-          info.required = rec.required ? rec.required : world;
+          info.required = rec.required;   // raw: 0 = full world
           info.first_seen = Clock::now();
           info.digest = eff;
           info.group = rec.group;
@@ -1315,7 +1401,7 @@ void Server::run_inner() {
           if (fresh) {
             PendingInfo info;
             info.order = announce_seq++;
-            info.required = rec.required ? rec.required : world;
+            info.required = rec.required;   // raw: 0 = full world
             info.first_seen = Clock::now();
             info.digest = eff;
             info.group = rec.group;
@@ -1341,14 +1427,73 @@ void Server::run_inner() {
         }
       }
     };
+    // Clean LEAVE (protocol v6): drop the rank from the gather with no
+    // dead-peer verdict.  Honored only when every survivor latched v6 —
+    // a pre-v6 survivor cannot parse the leave notice and would execute
+    // shrunk-world verdicts its fixed-size data plane cannot resolve —
+    // otherwise the LEAVE is ignored and the leaver's subsequent socket
+    // sever produces the legacy v4 verdict.  The ONE abort case: the
+    // leaver still has outstanding negotiated work (a pending tensor it
+    // announced, or an implicit world-level credit while joined) whose
+    // readiness would include a rank that will never execute it.
+    auto handle_leave = [&](int r, Conn& c) {
+      if (left.count(r)) return;
+      for (int rr = 0; rr < world; ++rr) {
+        if (rr == r || left.count(rr) || v6[rr].load()) continue;
+        return;   // pre-v6 survivor: degrade to the legacy sever path
+      }
+      std::string stuck;
+      for (auto& [n, info] : pending) {
+        bool involved = info.ready_ranks.count(r) > 0;
+        if (!involved && joined.count(r) && info.required == 0 &&
+            n.find('\x1f') == std::string::npos)
+          involved = true;   // joined rank: implicit world-level credit
+        if (involved) {
+          stuck = n;
+          break;
+        }
+      }
+      if (!stuck.empty()) {
+        broadcast_abort(std::set<int>{r},
+                        "rank " + std::to_string(r) +
+                            " sent a clean LEAVE with outstanding "
+                            "negotiated work (tensor '" + stuck +
+                            "') in round " + std::to_string(round_no));
+        stop.store(true);
+        return;
+      }
+      left.insert(r);
+      left_this_round.push_back(r);
+      joined.erase(r);
+      if (c.is_agent) {
+        // The host's uplink SHRINKS instead of dying: the agent keeps
+        // speaking for its remaining ranks (its own uplink already
+        // dropped the leaver); only the last local rank's departure
+        // retires the whole connection.
+        c.ranks.erase(std::remove(c.ranks.begin(), c.ranks.end(), r),
+                      c.ranks.end());
+        if (c.ranks.empty()) {
+          c.left = true;
+          poller.remove(c.fd);
+        }
+      } else {
+        c.left = true;
+        poller.remove(c.fd);
+      }
+    };
     // Dispatch this round's frames in connection (= ascending first-rank)
     // order: flat frames parse exactly as before; an agent uplink unpacks
     // into its aggregate section, verbatim per-rank subframes, and
     // deduplicated MON1 blobs.
     for (size_t ci = 0; ci < conns.size(); ++ci) {
-      const Conn& c = conns[ci];
+      Conn& c = conns[ci];
+      if (c.left || stop.load()) continue;
       const std::vector<uint8_t>& f = round_frames[ci];
       if (!c.is_agent) {
+        if (is_leave_frame(f.data(), f.size())) {
+          handle_leave(c.ranks.front(), c);
+          continue;
+        }
         process_rank_frame(c.ranks.front(), f.data(), f.size());
         continue;
       }
@@ -1378,8 +1523,17 @@ void Server::run_inner() {
         uint32_t r = rd.u32();
         uint32_t flen = rd.u32();
         if (!rd.ok || rd.p + flen > rd.end) break;
-        if (owns(r)) process_rank_frame(static_cast<int>(r), rd.p, flen);
+        if (owns(r)) {
+          // A local rank's clean LEAVE travels as a verbatim subframe
+          // (the agent cannot aggregate it): same semantics as flat mode,
+          // but the HOST connection persists for the remaining ranks.
+          if (is_leave_frame(rd.p, flen))
+            handle_leave(static_cast<int>(r), c);
+          else
+            process_rank_frame(static_cast<int>(r), rd.p, flen);
+        }
         rd.p += flen;
+        if (stop.load()) break;
       }
       uint32_t n_mon = rd.ok ? rd.u32() : 0;
       for (uint32_t k = 0; k < n_mon && rd.ok; ++k) {
@@ -1394,6 +1548,7 @@ void Server::run_inner() {
       }
     }
     if (stop.load()) break;
+    if (eff_world() <= 0) break;   // every rank departed cleanly: done
     if (join_started) {
       // A join epoch begins: flush every slot (broadcast as evictions) so
       // the whole epoch renegotiates in full — joined ranks need digest
@@ -1431,12 +1586,22 @@ void Server::run_inner() {
       // only toward DEFAULT-process-set world tensors (wire names of other
       // sets carry a "\x1f" prefix the joined client cannot synthesize
       // for; join is a world-level operation in the reference too).
-      bool world_level = info.required == world &&
+      bool world_level = info.required == 0 &&
                          it->first.find('\x1f') == std::string::npos;
+      // The readiness threshold, materialized HERE (not at announce time):
+      // raw required 0 means "the full world", which a clean LEAVE
+      // (protocol v6) may have shrunk since the announce — the effective
+      // world is what the survivors can actually deliver.
+      int req = info.required ? info.required : eff_world();
       int have = static_cast<int>(info.ready_ranks.size());
       if (world_level) {
         for (int jr : joined)
           if (!info.ready_ranks.count(jr)) ++have;
+        // A leaver that announced before departing would have aborted the
+        // fleet (outstanding work); a leaver that had NOT announced simply
+        // stops being counted — but it may have been counted implicitly
+        // while joined, so clamp against the shrunk threshold.
+        if (have > req) have = req;
       }
       // A collective that needs real data from a joined rank cannot be
       // satisfied with synthesized identity values: answer with a
@@ -1457,7 +1622,7 @@ void Server::run_inner() {
                            who + "] which joined; collectives that need a "
                            "joined rank's data cannot run until all ranks "
                            "join");
-        if (have >= info.required) {
+        if (have >= req) {
           it = pending.erase(it);
           continue;
         }
@@ -1481,7 +1646,7 @@ void Server::run_inner() {
                            "' negotiation failed: ranks [" + g +
                            "] submitted it as a GROUPED collective but "
                            "ranks [" + u + "] submitted it ungrouped");
-        if (have >= info.required) {
+        if (have >= req) {
           it = pending.erase(it);
           continue;
         }
@@ -1505,14 +1670,14 @@ void Server::run_inner() {
           msg += "ranks [" + rs + "] announced " + d;
         }
         errs.emplace_back(it->first, msg);
-        if (have >= info.required) {
+        if (have >= req) {
           it = pending.erase(it);
           continue;
         }
         ++it;
         continue;
       }
-      if (have >= info.required) {
+      if (have >= req) {
         // Slot-bit verdict only when every rank can resolve it: the slot
         // exists, every announcer was (or is being, via this round's
         // assigns broadcast) taught it, and no rank is joined (joined
@@ -1532,7 +1697,9 @@ void Server::run_inner() {
         for (int r = 0; r < world; ++r) {
           // Joined ranks are exempt only where they get implicit-ready
           // credit (world-level tensors); for subgroup tensors a joined
-          // member really is the missing party — name it.
+          // member really is the missing party — name it.  Clean leavers
+          // are never "missing": they stopped counting entirely.
+          if (left.count(r)) continue;
           if (!info.ready_ranks.count(r) &&
               !(world_level && joined.count(r))) {
             if (!missing.empty()) missing += ",";
@@ -1546,7 +1713,7 @@ void Server::run_inner() {
       ++it;
     }
     std::sort(ready.begin(), ready.end());
-    if (world > 0 && static_cast<int>(joined.size()) == world) {
+    if (eff_world() > 0 && static_cast<int>(joined.size()) == eff_world()) {
       // Every rank joined: announce the epoch end (digest = last joiner)
       // and reset so the world can resume normal collectives.
       ready.emplace_back(UINT64_MAX, "\x1f__all_joined__",
@@ -1609,6 +1776,12 @@ void Server::run_inner() {
       put_u32(&resp, static_cast<uint32_t>(b->second.size()));
       resp.insert(resp.end(), b->second.begin(), b->second.end());
     }
+    // Clean-LEAVE notice (protocol v6): ranks that departed THIS round.
+    // Appended only on rounds where someone actually left (warm rounds
+    // carry zero extra bytes — frame-guarded) and, empty, on round 1 as
+    // the capability ad; it rides AFTER the v4/v5 ads below so older
+    // clients latch everything they understand before their trailing
+    // walk stops at the unknown magic.
     // Fault-tolerance capability ad (protocol v4): round 1's response only,
     // so the warm path carries zero extra bytes — see the header comment.
     if (round_no == 1) {
@@ -1621,6 +1794,12 @@ void Server::run_inner() {
       put_u32(&resp, kAggMagic);
       put_u32(&resp, 0);
     }
+    if (round_no == 1 || !left_this_round.empty()) {
+      put_u32(&resp, kLeaveMagic);
+      put_u32(&resp, 4 + 4 * static_cast<uint32_t>(left_this_round.size()));
+      put_u32(&resp, static_cast<uint32_t>(left_this_round.size()));
+      for (int r : left_this_round) put_u32(&resp, static_cast<uint32_t>(r));
+    }
     // Attempt EVERY connection before honoring a failure: one dead/closing
     // peer must not cut the survivors off from a round's computed verdicts
     // (they may contain the ready broadcast that lets them finish cleanly).
@@ -1631,6 +1810,7 @@ void Server::run_inner() {
     // response down to its local ranks itself.
     std::set<int> write_dead;
     for (Conn& c : conns) {
+      if (c.left) continue;   // departed cleanly: no response owed
       if (!write_frame(c.fd, resp)) {
         c.sock_dead = true;
         poller.remove(c.fd);
@@ -1699,10 +1879,12 @@ void* hvdtpu_server_start(int port, int world, double stall_warn_s,
   s->fds = std::make_unique<std::atomic<int>[]>(world);
   s->v4 = std::make_unique<std::atomic<char>[]>(world);
   s->v5 = std::make_unique<std::atomic<char>[]>(world);
+  s->v6 = std::make_unique<std::atomic<char>[]>(world);
   for (int i = 0; i < world; ++i) {
     s->fds[i].store(-1);
     s->v4[i].store(0);
     s->v5[i].store(0);
+    s->v6[i].store(0);
   }
   s->loop = std::thread([s] { s->run(); });
   return s;

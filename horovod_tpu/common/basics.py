@@ -243,6 +243,29 @@ def shutdown() -> None:
         # below.  Captured before the engine is torn down.
         abrupt = (st.engine is not None
                   and getattr(st.engine, "fault", None) is not None)
+        # Peers that departed via clean LEAVE (protocol v6): not a fault,
+        # but the cooperative jax teardown barrier can no longer complete
+        # either — the survivors must park, exactly like the fault path,
+        # just without the HVD303 noise.
+        peers_left = bool(getattr(st.controller, "left_ranks", None)) \
+            if st.controller is not None else False
+        leave_sent = False
+        if st.controller is not None and st.engine is not None \
+                and not abrupt:
+            # Clean departure (protocol v6): quiesce the cycle thread at a
+            # round boundary — the in-flight lock-step round completes in
+            # a healthy world — then announce the LEAVE on the quiet
+            # socket BEFORE the sever, so the coordinator drops this rank
+            # from the gather instead of survivors eating a dead-peer
+            # verdict.  A wedged thread (a peer already died) falls back
+            # to the legacy interrupt-and-sever below; a pre-v6 server
+            # makes leave() a no-op.
+            if st.engine.quiesce(timeout=5.0) and \
+                    getattr(st.engine, "fault", None) is None:
+                leave_sent = st.controller.leave()
+            else:
+                abrupt = abrupt or (
+                    getattr(st.engine, "fault", None) is not None)
         if st.controller is not None:
             # Unblock any lock-step round FIRST so the engine thread can't
             # be left inside the native client when we free it.
@@ -272,11 +295,18 @@ def shutdown() -> None:
                 and st.config.controller_addr != ""):
             from ..elastic.worker import (exit_guard_note_clean_shutdown,
                                           teardown_distributed)
-            teardown_distributed(abrupt=abrupt)
+            # A clean LEAVE — ours (leave_sent: the peers are NOT shutting
+            # down, so the cooperative barrier would hang waiting for
+            # them) or a peer's (peers_left: the departed rank will never
+            # join it) — parks the world like the fault path; only a
+            # full-world synchronized shutdown can take the graceful
+            # barrier.
+            teardown_distributed(abrupt=abrupt or leave_sent or peers_left)
             if not abrupt:
                 # A non-abrupt explicit shutdown means the run completed:
                 # any exit code latched by a caught-and-recovered
-                # sys.exit() is stale.
+                # sys.exit() is stale.  Clean leaves count — the departure
+                # was orderly.
                 exit_guard_note_clean_shutdown()
         st.initialized = False
         st.topology = None

@@ -196,6 +196,25 @@ class Config:
     hierarchical_controller: bool = False
     agent_port: int = 0
 
+    # Closed-loop elastic autoscaling (docs/elastic.md "Closed-loop
+    # autoscaling") — consumed by the elastic DRIVER (torovodrun
+    # --host-discovery-script), not by workers.  HOROVOD_AUTOSCALE=1
+    # turns the policy loop on (requires --monitor-port so the driver can
+    # poll rank 0's /health for the aggregation summary); the remaining
+    # knobs parameterize elastic/autoscale.ScalePolicy: observation
+    # period, scale-out queue thresholds (absolute + EWMA trend),
+    # straggler-evict factor vs the peer median, hysteresis persistence
+    # (consecutive observations), post-decision cooldown, and the idle
+    # window before scale-in.
+    autoscale: bool = False
+    autoscale_interval_s: float = 5.0
+    autoscale_queue_high: float = 16.0
+    autoscale_queue_trend: float = 4.0
+    autoscale_straggler_factor: float = 3.0
+    autoscale_persistence: int = 3
+    autoscale_cooldown_s: float = 30.0
+    autoscale_idle_s: float = 60.0
+
     autotune: bool = False
     autotune_log: str = ""
     autotune_warmup_samples: int = 3
@@ -262,6 +281,15 @@ class Config:
             hierarchical_controller=_env_bool("HIERARCHICAL_CONTROLLER",
                                               False),
             agent_port=_env_int("AGENT_PORT", 0),
+            autoscale=_env_bool("AUTOSCALE", False),
+            autoscale_interval_s=_env_float("AUTOSCALE_INTERVAL", 5.0),
+            autoscale_queue_high=_env_float("AUTOSCALE_QUEUE_HIGH", 16.0),
+            autoscale_queue_trend=_env_float("AUTOSCALE_QUEUE_TREND", 4.0),
+            autoscale_straggler_factor=_env_float(
+                "AUTOSCALE_STRAGGLER_FACTOR", 3.0),
+            autoscale_persistence=_env_int("AUTOSCALE_PERSISTENCE", 3),
+            autoscale_cooldown_s=_env_float("AUTOSCALE_COOLDOWN", 30.0),
+            autoscale_idle_s=_env_float("AUTOSCALE_IDLE_S", 60.0),
             autotune=_env_bool("AUTOTUNE", False),
             autotune_log=_env("AUTOTUNE_LOG", "") or "",
             autotune_warmup_samples=_env_int("AUTOTUNE_WARMUP_SAMPLES", 3),

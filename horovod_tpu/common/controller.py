@@ -46,6 +46,12 @@ _AGG_MAGIC = 0x35474741
 # kAbortMagic in csrc/coordinator.cc.
 _ABORT_ESCAPE = 0xFFFFFFFF
 _ABORT_MAGIC = 0x34544241
+# Clean-LEAVE (protocol v6): request-side escape word (an impossible
+# n_announce) + "LVE6" magic, which doubles as the round-1 capability ad in
+# both directions and as the response-side leave-notice section marker.
+# Matches kLeaveEscape / kLeaveMagic in csrc/coordinator.cc.
+_LEAVE_ESCAPE = 0xFFFFFFFE
+_LVE_MAGIC = 0x3645564C
 
 
 @dataclasses.dataclass
@@ -123,6 +129,23 @@ class TCPController:
         # own wire bytes are IDENTICAL either way (the frame guard pins
         # this), which is what lets the agent forward them verbatim.
         self.peer_hier_proto = False
+        # Latches once the server advertises protocol v6 (LVE6 section):
+        # this client may announce its own clean departure with a typed
+        # LEAVE frame instead of a blind socket sever — see leave().
+        self.peer_leave_proto = False
+        # Ranks the server reported as cleanly departed (LVE6 notice
+        # sections), cumulative for this controller generation.  A
+        # non-empty list means the world SHRANK without a fault: the
+        # engine fails world-level work with PeerLeftInterrupt (the
+        # data-plane world is still the old fixed size) and the elastic
+        # wrapper re-rendezvouses.  peer_leave_hook (installed by the
+        # monitor agent) is called with each notice's rank list — guarded,
+        # telemetry must never fail a round.
+        self.left_ranks: List[int] = []
+        self.peer_leave_hook = None
+        # True once leave() actually put the LEAVE frame on the wire —
+        # basics.shutdown() keys the elastic abrupt-teardown path off it.
+        self.leave_sent = False
         # Set by interrupt() before it severs the lock-step socket: an
         # expected local teardown whose round failure must NOT be treated
         # as a peer death (engine checks it before aborting).
@@ -313,12 +336,14 @@ class TCPController:
             if blob:
                 req += struct.pack("<II", _MON_MAGIC, len(blob)) + blob
                 self.monitor_bytes_sent += 8 + len(blob)
-        # v5 + v4 capability hellos: FIRST request only, so warm-path
+        # v5 + v6 + v4 capability hellos: FIRST request only, so warm-path
         # frames carry zero extra bytes (the frame guard asserts this).
-        # AGG5 rides before FLT1 — the server's abort-path capability
-        # salvage reads the frame's FINAL 8 bytes as the FLT1 ad.
+        # AGG5 and LVE6 ride before FLT1 — the server's abort-path
+        # capability salvage reads the frame's FINAL 8 bytes as the FLT1
+        # ad, so FLT1 must stay last.
         if self.rounds == 1:
             req += struct.pack("<II", _AGG_MAGIC, 0)
+            req += struct.pack("<II", _LVE_MAGIC, 0)
             req += struct.pack("<II", _FLT_MAGIC, 0)
         stats.full_announces += sum(1 for a in full
                                     if not a[0].startswith("\x1f"))
@@ -496,6 +521,34 @@ class TCPController:
             elif magic == _AGG_MAGIC:
                 off += 8  # magic + reserved u32 (always 0)
                 self.peer_hier_proto = True
+            elif magic == _LVE_MAGIC:
+                # Clean-LEAVE section (protocol v6): the payload-bearing
+                # form — (magic, len, n_left, ranks) — unlike the bare
+                # v4/v5 ads, so an empty round-1 section IS the server's
+                # capability ad and a non-empty one is a leave notice.
+                (ln,) = struct.unpack_from("<I", data, off + 4)
+                off += 8
+                end = off + ln
+                self.peer_leave_proto = True
+                n_left = 0
+                if ln >= 4:
+                    (n_left,) = struct.unpack_from("<I", data, off)
+                    off += 4
+                ranks = []
+                for _ in range(n_left):
+                    (r,) = struct.unpack_from("<I", data, off)
+                    ranks.append(r)
+                    off += 4
+                off = end
+                if ranks:
+                    self.left_ranks = sorted(set(self.left_ranks) |
+                                             set(ranks))
+                    h = self.peer_leave_hook
+                    if h is not None:
+                        try:
+                            h(ranks)
+                        except Exception:  # noqa: BLE001 - telemetry only
+                            log.exception("peer-leave hook failed")
             else:
                 break
         return ready, warns, errors
@@ -860,6 +913,33 @@ class TCPController:
         wait forever.  Sticky: this controller generation is dead."""
         self._join_error = exc
         self._join_event.set()
+
+    def leave(self) -> bool:
+        """Announce this rank's clean departure (protocol v6): one typed
+        LEAVE frame on the lock-step socket, sent IN PLACE of the next
+        round frame, immediately before the sever.
+
+        The server drops the rank from the gather with no dead-peer
+        verdict — survivors get a leave notice instead of an HVD303 abort
+        — and aborts (typed, naming us) only if we still have outstanding
+        negotiated work, which is why the frame is refused locally while
+        ``_announced`` is non-empty: a LEAVE that would abort the fleet is
+        worse than the legacy sever's staggered-shutdown heuristic.
+
+        Caller contract: the engine's cycle thread must be quiesced (no
+        lock-step round in flight — ``engine.quiesce()``); version-gated
+        on the server's round-1 LVE6 ad, so against a pre-v6 coordinator
+        this is a no-op and the sever keeps its legacy semantics.
+        Returns True when the frame actually went on the wire."""
+        if (self._client is None or not self.peer_leave_proto
+                or self.interrupted or self.leave_sent
+                or self._announced or self._joined or self._join_pending):
+            return False
+        req = struct.pack("<II", _LEAVE_ESCAPE, _LVE_MAGIC)
+        buf = (ctypes.c_uint8 * len(req)).from_buffer_copy(req)
+        rc = self._lib.hvdtpu_client_send(self._client, buf, len(req))
+        self.leave_sent = rc == 0
+        return self.leave_sent
 
     def interrupt(self):
         """Unblock any thread stuck in a lock-step round (socket shutdown,

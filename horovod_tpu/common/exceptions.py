@@ -21,6 +21,12 @@ Taxonomy::
                                       deadline (peers unattributable)
     TimeoutError
      └─ JoinTimeoutError              hvd.join() did not complete in time
+    Exception
+     ├─ HostsUpdatedInterrupt        host set changed; re-rendezvous
+     │   └─ PeerLeftInterrupt        a peer sent a clean LEAVE (v6) —
+     │                               world shrank, NOT a fault
+     └─ DrainRequested               the driver asked this worker to
+                                     drain: finish batch, LEAVE, exit 0
 
 ``NegotiationError`` (an application-level per-tensor failure, deliberately
 NOT a HorovodInternalError) stays in ``common/controller.py``.
@@ -96,3 +102,59 @@ class JoinTimeoutError(TimeoutError):
     join (an ``int >= 0``) or raises this — it never returns a sentinel.
     Subclasses ``TimeoutError`` so pre-existing ``except TimeoutError``
     call sites keep working."""
+
+
+class HostsUpdatedInterrupt(Exception):
+    """The elastic driver notified a host-set change; re-rendezvous keeping
+    current (committed-or-not) parameters.
+
+    Historically defined in ``elastic/state.py``; moved here (jax-free) so
+    the controller, the engine and the autoscaling stack can raise it
+    without dragging jax into the fast test tier.  ``elastic/state.py``
+    re-exports it, so ``isinstance`` checks against either import path see
+    ONE class.  Deliberately NOT a :class:`HorovodInternalError`: the
+    elastic run wrapper keeps current parameters (no restore) on this
+    path."""
+
+    def __init__(self, skip_sync: bool = False):
+        self.skip_sync = skip_sync
+
+
+class PeerLeftInterrupt(HostsUpdatedInterrupt):
+    """A peer rank departed with a clean LEAVE (protocol v6) — the world
+    must re-form before any more default-process-set collectives run.
+
+    Raised on survivors when the coordinator's leave notice arrives: new
+    world-level submissions fail with it immediately, and world-level
+    verdicts computed over the shrunk control-plane world are failed with
+    it instead of executed (the data-plane world is still the old, fixed
+    size — executing would wedge the transport).  A
+    :class:`HostsUpdatedInterrupt` subclass: the elastic run wrapper
+    re-rendezvouses keeping current parameters, exactly like a
+    driver-pinged host change — NOT an HVD303 fault, the departure was
+    orderly.
+
+    Attributes:
+        left_ranks: sorted ranks that announced a clean LEAVE.
+    """
+
+    def __init__(self, left_ranks: Optional[Sequence[int]] = None):
+        super().__init__(skip_sync=False)
+        self.left_ranks = sorted(left_ranks or [])
+
+    def __str__(self):
+        return (f"peer rank(s) {self.left_ranks} left the world cleanly "
+                f"(protocol v6 LEAVE); re-rendezvous before submitting "
+                f"more world-level collectives")
+
+
+class DrainRequested(Exception):
+    """The elastic driver asked this worker to drain: finish the current
+    batch, send a clean LEAVE, and exit 0.
+
+    Delivered through the worker notification channel (the autoscaler's
+    scale-in / straggler-evict path) and raised from ``state.commit()`` —
+    the same check point as :class:`HostsUpdatedInterrupt`, so the worker
+    always drains at a batch boundary with its state committed.  The
+    ``@hvd.elastic.run`` wrapper catches it, shuts the runtime down (which
+    sends the LEAVE) and returns; the host is NOT blacklisted."""

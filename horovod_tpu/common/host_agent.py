@@ -26,6 +26,12 @@ presents the whole host to the root as ONE connection:
   rank attribution; the agent's own death severs its root connection, and
   the root declares the whole host's ranks dead (coarse but correct —
   the agent was those ranks' only path).
+- **clean LEAVE (protocol v6)**: a local rank announcing its own orderly
+  departure sends the typed LEAVE frame in place of a round frame; the
+  agent forwards it upstream verbatim (the root drops the rank with no
+  verdict) and then retires the rank — the host's uplink SHRINKS to the
+  survivors and the aggregate warm path re-engages over the smaller rank
+  set, instead of the departure killing the whole host's connection.
 
 Root-side gather work therefore scales with hosts, not ranks: one
 readable fd, one frame parse and one response write per host per round.
@@ -52,6 +58,15 @@ _AGENT_HELLO = 0xFFFFFF05
 _HUP_MAGIC = 0x35505548        # "HUP5"
 _MON_MAGIC = 0x314E4F4D        # "MON1"
 _ABORT_ESCAPE = 0xFFFFFFFF
+# Clean-LEAVE frame (protocol v6): escape word + "LVE6" magic.
+_LEAVE_ESCAPE = 0xFFFFFFFE
+_LVE_MAGIC = 0x3645564C
+
+
+def _is_leave_frame(data: bytes) -> bool:
+    return (len(data) >= 8
+            and struct.unpack_from("<II", data) == (_LEAVE_ESCAPE,
+                                                    _LVE_MAGIC))
 
 
 def _read_exact(sock: socket.socket, n: int,
@@ -146,6 +161,7 @@ class AgentStats:
         self.mon_blobs_forwarded = 0   # MON1 blobs deduped into uplinks
         self.responses_fanned = 0
         self.dead_reports = 0          # out-of-round dead-rank uplinks
+        self.leaves_forwarded = 0      # clean LEAVEs relayed upstream (v6)
 
 
 class HostAgent:
@@ -172,6 +188,13 @@ class HostAgent:
         # hand: reported upstream once the completed round's uplink (which
         # legitimately includes their last announce) has gone out.
         self._deferred_dead: List[int] = []
+        # Ranks whose round frame was a clean LEAVE (protocol v6): the
+        # frame is forwarded upstream as a verbatim subframe, and the rank
+        # is retired — removed from the local set and from ``ranks`` so
+        # the aggregate warm path re-engages over the SHRUNK host — once
+        # the round's response has been fanned to the survivors.  Their
+        # trailing EOF must never become a dead-rank report.
+        self._left_pending: set = set()
         self.error: Optional[str] = None
         # Bound before start() returns so callers (and port-0 users) know
         # where local ranks must connect.
@@ -317,18 +340,22 @@ class HostAgent:
                     # event is EOF (a rank dying right after its send).
                     # Consume it so a level-triggered selector can't spin,
                     # and report once the round's frame — already in
-                    # hand — has been folded into the uplink.
+                    # hand — has been folded into the uplink.  A rank
+                    # whose frame was a clean LEAVE is EXPECTED to sever
+                    # right after it: retire silently, never report.
                     try:
                         if s.recv(1) == b"":
                             sel.unregister(s)
                             self._local.pop(rank, None)
-                            self._deferred_dead.append(rank)
+                            if rank not in self._left_pending:
+                                self._deferred_dead.append(rank)
                     except socket.timeout:
                         pass
                     except OSError:
                         sel.unregister(s)
                         self._local.pop(rank, None)
-                        self._deferred_dead.append(rank)
+                        if rank not in self._left_pending:
+                            self._deferred_dead.append(rank)
                     continue
                 try:
                     chunk = s.recv(65536)
@@ -347,6 +374,12 @@ class HostAgent:
                     if len(buf) >= 4 + ln:
                         frames[rank] = buf[4:4 + ln]
                         bufs[rank] = buf[4 + ln:]
+                        if _is_leave_frame(frames[rank]):
+                            # Clean departure (protocol v6): the LEAVE is
+                            # this rank's round frame — forwarded upstream
+                            # verbatim so the root drops the rank — and
+                            # the rank retires after the round completes.
+                            self._left_pending.add(rank)
         return None
 
     def _on_local_death(self, rank: int) -> None:
@@ -385,6 +418,29 @@ class HostAgent:
                 s.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
+
+    def _retire_left(self, sel) -> None:
+        """Retire ranks whose clean LEAVE completed a round: drop their
+        socket and shrink ``ranks`` so the next warm round's aggregate
+        section counts only the survivors — the host's uplink SHRINKS
+        instead of the whole host dying.  Called after the leave round's
+        uplink went out (the root needs the verbatim LEAVE subframe) and
+        before the response fan-out (no response is owed to a leaver)."""
+        for rank in sorted(self._left_pending):
+            s = self._local.pop(rank, None)
+            if s is not None:
+                try:
+                    sel.unregister(s)
+                except (KeyError, ValueError):
+                    pass   # EOF handling already unregistered it
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            if rank in self.ranks:
+                self.ranks.remove(rank)
+            self.stats.leaves_forwarded += 1
+        self._left_pending.clear()
 
     def _build_uplink(self, frames: Dict[int, bytes]) -> bytes:
         """Fold one round's local frames into the host uplink."""
@@ -490,6 +546,8 @@ class HostAgent:
                 if resp is None:
                     self._sever_local()
                     return
+                if self._left_pending:
+                    self._retire_left(sel)
                 dead_writes = self._fan_down(resp)
                 if len(resp) >= 4 and struct.unpack_from(
                         "<I", resp)[0] == _ABORT_ESCAPE:

@@ -10,13 +10,33 @@ failure) and ``sync`` (rank-0 broadcast so joiners catch up), plus the
 TPU mapping (SURVEY.md §5 "failure detection"): a lost host invalidates the
 ICI mesh, so recovery re-runs ``init()`` (rebuilding mesh + engine, which
 also invalidates compiled-program caches) before ``state.sync()``.
-"""
 
-from .state import (  # noqa: F401
-    State, ObjectState, JaxState,
-    HorovodInternalError, HostsUpdatedInterrupt, run,
+Import shape: the jax-free halves (driver, discovery, registration,
+rendezvous, the ``autoscale`` policy engine, the control-flow exceptions)
+stay importable without jax so the fast test tier, the launcher process and
+the synthetic-load acceptance workers can use them; the state objects
+(``State``/``ObjectState``/``JaxState``/``run``) hold device arrays and
+load lazily on first attribute access (PEP 562)."""
+
+from ..common.exceptions import (  # noqa: F401  (jax-free re-exports)
+    DrainRequested, HorovodInternalError, HostsUpdatedInterrupt,
+    PeerLeftInterrupt,
 )
 from .discovery import (  # noqa: F401
     DiscoveredHost, FixedHostDiscovery, HostDiscovery, HostDiscoveryScript,
 )
 from .registration import WorkerStateRegistry  # noqa: F401
+
+# Lazily-loaded jax-backed state layer (elastic/state.py imports jax).
+_STATE_ATTRS = ("State", "ObjectState", "JaxState", "run")
+
+
+def __getattr__(name):
+    if name in _STATE_ATTRS:
+        from . import state as _state
+        return getattr(_state, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_STATE_ATTRS))

@@ -51,7 +51,12 @@ class ElasticDriver:
                  start_timeout_s: float = 600.0,
                  rendezvous_addr: Optional[str] = None,
                  output_filename: Optional[str] = None,
-                 verbose: int = 0):
+                 verbose: int = 0,
+                 discovery_grace_s: Optional[float] = None,
+                 autoscale_policy=None,
+                 autoscale_interval_s: float = 5.0,
+                 autoscale_source=None,
+                 scale_command: Optional[str] = None):
         self.discovery = discovery
         self.command = command
         self.min_np = min_np
@@ -61,6 +66,26 @@ class ElasticDriver:
         self.start_timeout_s = start_timeout_s
         self.output_filename = output_filename
         self.verbose = verbose
+        # Discovery-flap debounce: a host must stay MISSING from discovery
+        # for this long before the driver drops it from the world.  One
+        # bad poll (script hiccup, metadata blip) must not churn rank
+        # assignments — appearing hosts still join immediately.  Default:
+        # two polls' worth.
+        self.discovery_grace_s = (2.0 * discovery_interval_s
+                                  if discovery_grace_s is None
+                                  else max(0.0, float(discovery_grace_s)))
+        # Closed-loop autoscaling (docs/elastic.md): a ScalePolicy consumes
+        # summaries from `autoscale_source` (default: rank 0's monitor
+        # /health endpoint) and this driver executes the decisions —
+        # scale_out through the operator's `scale_command`, evict/scale_in
+        # through the drain pipeline (DRAIN ping → worker finishes its
+        # batch → clean LEAVE → exit 0 → cordoned host leaves the world).
+        self.autoscale_policy = autoscale_policy
+        self.autoscale_interval_s = max(0.5, float(autoscale_interval_s))
+        self._autoscale_source = autoscale_source
+        self.scale_command = scale_command
+        self.events: List[dict] = []    # executed decisions, for operators
+                                        # and the scenario acceptance test
 
         self.registry = WorkerStateRegistry()
         self.rendezvous = RendezvousServer()
@@ -76,6 +101,17 @@ class ElasticDriver:
         # Identities the driver itself terminated (host removed / shrunk):
         # their nonzero exit must not blacklist the host as a failure.
         self._released: set = set()
+        # Identities the autoscaler asked to drain: their exit 0 is a
+        # clean departure (record_left), never the job-success signal.
+        self._draining: set = set()
+        # Hosts the autoscaler retired (straggler evict / scale-in):
+        # excluded from assignment like the blacklist, but clean — an
+        # operator scale-out may un-cordon by naming them again through
+        # `scale_command` + discovery.
+        self._cordoned: set = set()
+        # Discovery-flap debounce state: hostname -> (last_seen_monotonic,
+        # last_known_slots).
+        self._last_seen: Dict[str, tuple] = {}
         self._out_files: Dict[str, tuple] = {}  # identity -> open log files
         self._success = threading.Event()
         self._first_failure_rc = 0
@@ -83,7 +119,40 @@ class ElasticDriver:
     # ----------------------------------------------------------- assignment
     def active_hosts(self, discovered: List[DiscoveredHost]) -> List[DiscoveredHost]:
         return [h for h in discovered
-                if not self.registry.is_blacklisted(h.hostname)]
+                if not self.registry.is_blacklisted(h.hostname)
+                and h.hostname not in self._cordoned]
+
+    def _effective_hosts(self, discovered: List[DiscoveredHost],
+                         now: float) -> List[DiscoveredHost]:
+        """Discovery-flap debounce: the discovered set, plus hosts that
+        vanished less than ``discovery_grace_s`` ago (kept at their last
+        known slot count, in their original order — rank assignments must
+        not churn when a host misses ONE poll and returns).  New hosts
+        join immediately; blacklist/cordon filtering happens in
+        ``active_hosts`` as usual."""
+        for h in discovered:
+            self._last_seen[h.hostname] = (now, h.slots)
+        present = {h.hostname for h in discovered}
+        out = list(discovered)
+        for name, (seen, slots) in list(self._last_seen.items()):
+            if name in present:
+                continue
+            if now - seen <= self.discovery_grace_s:
+                out.append(DiscoveredHost(name, slots))
+            else:
+                del self._last_seen[name]
+        # Deterministic order: the ORIGINAL first-seen order is what keeps
+        # assignments stable across flaps (a host re-listed after its
+        # one-poll absence must land back on its old ranks); hosts with no
+        # previous position — the whole first generation, and any batch of
+        # newcomers — keep their DISCOVERY order, preserving the
+        # documented hostfile-order rank/coordinator placement.
+        order = {h.hostname: i for i, h in enumerate(self._hosts)}
+        base = len(order)
+        disc_pos = {h.hostname: i for i, h in enumerate(discovered)}
+        out.sort(key=lambda h: order.get(
+            h.hostname, base + disc_pos.get(h.hostname, 0)))
+        return out
 
     def compute_assignments(self, hosts: List[DiscoveredHost]) -> Dict[str, dict]:
         """Identity → assignment for one generation.  Rank order follows
@@ -154,7 +223,10 @@ class ElasticDriver:
             stderr = open(os.path.join(d, "stderr"), "a")
             self._close_out_files(identity)
             self._out_files[identity] = (stdout, stderr)
-        if hostname in ("localhost", "127.0.0.1", socket.gethostname()):
+        if is_local_host(hostname):
+            # is_local_host (not a literal tuple): loopback aliases like
+            # 127.0.0.2 — how tests and single-box deployments model
+            # multi-host worlds — must spawn locally, not through ssh.
             proc = subprocess.Popen(self.command, env=env,
                                     stdout=stdout, stderr=stderr)
         else:
@@ -177,8 +249,7 @@ class ElasticDriver:
             if identity not in self._procs:
                 continue
             host = identity.rsplit(":", 1)[0]
-            addr = "127.0.0.1" if host in ("localhost", "127.0.0.1",
-                                           socket.gethostname()) else host
+            addr = "127.0.0.1" if is_local_host(host) else host
 
             def _ping(addr=addr, port=port):
                 # Per-attempt timeout sized so ALL attempts + backoff stay
@@ -252,8 +323,9 @@ class ElasticDriver:
                 # kill the driver (script timeout, malformed slots line, ...)
                 log.warning("elastic driver: discovery failed: %s", exc)
                 discovered = []
-            self._hosts = discovered  # raw; blacklist applied at use
-            if self._new_generation(self.active_hosts(discovered)):
+            # Effective = flap-debounced; blacklist/cordon applied at use.
+            self._hosts = self._effective_hosts(discovered, time.monotonic())
+            if self._new_generation(self.active_hosts(self._hosts)):
                 break
             if time.monotonic() > deadline:
                 log.warning("elastic driver: needed min_np=%s slots within "
@@ -263,31 +335,10 @@ class ElasticDriver:
             time.sleep(self.discovery_interval_s)
 
         last_poll = time.monotonic()
+        last_autoscale = time.monotonic()
         while True:
-            changed = False
             # 1. process exits
-            for identity, proc in list(self._procs.items()):
-                rc = proc.poll()
-                if rc is None:
-                    continue
-                del self._procs[identity]
-                self._close_out_files(identity)
-                if identity in self._released:
-                    self._released.discard(identity)
-                    continue
-                if rc == 0:
-                    self.registry.record_success(identity)
-                    if identity in self._assigned:
-                        self._success.set()
-                else:
-                    self.registry.record_failure(identity)
-                    if self.verbose:
-                        log.warning("elastic driver: %s failed rc=%s",
-                                    identity, rc)
-                    if not self._success.is_set():
-                        self._first_failure_rc = (self._first_failure_rc
-                                                  or rc)
-                        changed = True
+            changed = self._reap_exits()
 
             # 2. success: training completed on some rank; drain the rest
             if self._success.is_set():
@@ -300,17 +351,31 @@ class ElasticDriver:
                 self._shutdown_workers()
                 return 0
 
-            # 3. discovery poll
+            # 3. discovery poll (flap-debounced: a host must stay missing
+            # past discovery_grace_s before it drops out of the world, so
+            # one bad poll never churns rank assignments)
             if time.monotonic() - last_poll >= self.discovery_interval_s:
                 last_poll = time.monotonic()
                 try:
                     discovered = self.discovery.find_available_hosts_and_slots()
-                    if ([(h.hostname, h.slots) for h in discovered]
+                    effective = self._effective_hosts(discovered,
+                                                      time.monotonic())
+                    if ([(h.hostname, h.slots) for h in effective]
                             != [(h.hostname, h.slots) for h in self._hosts]):
-                        self._hosts = discovered
+                        self._hosts = effective
                         changed = True
                 except Exception as exc:  # noqa: BLE001 - transient poll
                     log.warning("elastic driver: discovery failed: %s", exc)
+
+            # 3b. closed-loop autoscaling: consume monitor summaries, let
+            # the policy decide, execute (docs/elastic.md).  Decisions
+            # mutate the world only through the same discovery/cordon/
+            # drain paths the rest of this loop already handles.
+            if (self.autoscale_policy is not None
+                    and time.monotonic() - last_autoscale
+                    >= self.autoscale_interval_s):
+                last_autoscale = time.monotonic()
+                self._autoscale_step()
 
             # 4. re-form the world if needed.  The blacklist is re-applied
             # HERE so a failure-triggered regeneration excludes the host
@@ -325,6 +390,213 @@ class ElasticDriver:
                     return self._first_failure_rc or 1
 
             time.sleep(0.05)
+
+    def _reap_exits(self) -> bool:
+        """Reap exited workers and classify each exit — the decision table
+        the clean-exit tests pin (docs/elastic.md "Drain semantics"):
+
+        - released (driver terminated it: host removed/shrunk) → LEFT;
+        - draining (autoscale drain → clean LEAVE → exit) → LEFT: never
+          the job-success signal, never a blacklisting failure — the host
+          stays eligible for a later scale-out; triggers regeneration;
+        - rc == 0 otherwise → SUCCESS (training completed somewhere);
+        - rc != 0 → FAILURE: blacklist the host, trigger regeneration.
+
+        Returns True when the world must re-form."""
+        changed = False
+        for identity, proc in list(self._procs.items()):
+            rc = proc.poll()
+            if rc is None:
+                continue
+            del self._procs[identity]
+            self._close_out_files(identity)
+            if identity in self._released:
+                self._released.discard(identity)
+                self.registry.record_left(identity)
+                continue
+            if identity in self._draining:
+                self._draining.discard(identity)
+                self.registry.record_left(identity)
+                if rc != 0:
+                    log.warning("elastic driver: drained worker %s exited "
+                                "rc=%s (expected 0)", identity, rc)
+                changed = True
+            elif rc == 0:
+                self.registry.record_success(identity)
+                if identity in self._assigned:
+                    self._success.set()
+            else:
+                self.registry.record_failure(identity)
+                if self.verbose:
+                    log.warning("elastic driver: %s failed rc=%s",
+                                identity, rc)
+                if not self._success.is_set():
+                    self._first_failure_rc = self._first_failure_rc or rc
+                    changed = True
+        return changed
+
+    # ------------------------------------------------------- autoscaling
+    def _default_autoscale_source(self):
+        """Poll rank 0's monitor ``/health`` (which carries the
+        ``RankAggregator.summary()`` fields — spread, trends, queue depth,
+        cycle counters) for the policy's observation record.  Needs
+        ``HOROVOD_MONITOR_PORT`` forwarded to the workers; returns None —
+        a hold — when the exporter is not up (e.g. mid-re-rendezvous)."""
+        import json
+        import urllib.request
+        port = int(self.extra_env.get("HOROVOD_MONITOR_PORT", "0") or 0)
+        if port <= 0 or not self._assigned:
+            return None
+        a = next((a for a in self._assigned.values() if a["rank"] == 0),
+                 None)
+        if a is None:
+            return None
+        host = a["controller_addr"]
+        with urllib.request.urlopen(f"http://{host}:{port}/health",
+                                    timeout=2.0) as r:
+            return json.loads(r.read().decode())
+
+    def drain_worker(self, identity: str) -> bool:
+        """Ask one worker to drain: finish its batch, send the clean
+        LEAVE, exit 0 (``DRAIN`` verb on the notification channel —
+        the worker-side handler raises ``DrainRequested`` from the next
+        ``state.commit()``).  The identity's exit is then classified as a
+        departure, never a failure.  Best-effort: False when the worker
+        has no registered notification port or the ping failed."""
+        if identity in self._draining:
+            return True
+        port = self.rendezvous.notification_ports().get(identity)
+        if port is None:
+            log.warning("elastic driver: cannot drain %s (no notification "
+                        "port registered)", identity)
+            return False
+        host = identity.rsplit(":", 1)[0]
+        addr = "127.0.0.1" if is_local_host(host) else host
+        try:
+            with socket.create_connection((addr, port), timeout=2.0) as s:
+                s.sendall(b"DRAIN\n")
+        except OSError as exc:
+            log.warning("elastic driver: drain ping to %s failed: %s",
+                        identity, exc)
+            return False
+        self._draining.add(identity)
+        return True
+
+    def cordon(self, hostname: str) -> None:
+        """Retire a host from assignment (clean — unlike the blacklist,
+        the record carries no failure; discovery dropping the host, or an
+        operator re-adding capacity elsewhere, is the durable state)."""
+        self._cordoned.add(hostname)
+
+    def _run_scale_command(self, action: str, decision,
+                           host: Optional[str] = None) -> None:
+        """Invoke the operator's capacity hook (``--scale-command``): a
+        shell command receiving the decision through HVD_AUTOSCALE_*
+        env — the cloud-agnostic seam where a deployment resizes its
+        instance group / TPU slice pool.  Discovery is still the source
+        of truth: the command changes what the discovery script reports,
+        the driver reacts as it would to any host change."""
+        if not self.scale_command:
+            return
+        env = dict(os.environ)
+        env["HVD_AUTOSCALE_ACTION"] = action
+        if decision.target_size is not None:
+            env["HVD_AUTOSCALE_TARGET"] = str(decision.target_size)
+        if host is not None:
+            env["HVD_AUTOSCALE_HOST"] = host
+        try:
+            out = subprocess.run(self.scale_command, shell=True, env=env,
+                                 capture_output=True, text=True, timeout=60)
+            if out.returncode != 0:
+                log.warning("elastic driver: scale command rc=%s: %s",
+                            out.returncode, (out.stderr or "").strip())
+        except Exception as exc:  # noqa: BLE001 - capacity hook is
+            # best-effort; the policy retries after its cooldown
+            log.warning("elastic driver: scale command failed: %s", exc)
+
+    def _autoscale_step(self) -> None:
+        """One observe→decide→execute turn of the autoscaler."""
+        try:
+            src = self._autoscale_source or self._default_autoscale_source
+            summary = src()
+        except Exception as exc:  # noqa: BLE001 - telemetry outage = hold
+            log.info("elastic driver: autoscale source unavailable: %s",
+                     exc)
+            return
+        if not summary:
+            return
+        decision = self.autoscale_policy.observe(summary,
+                                                 size=len(self._assigned))
+        if decision.is_hold:
+            return
+        event = {"action": decision.action, "reason": decision.reason,
+                 "target_size": decision.target_size,
+                 "evict_rank": decision.evict_rank, "ts": time.time()}
+        if decision.action == "evict":
+            identity = next(
+                (i for i, a in self._assigned.items()
+                 if a["rank"] == decision.evict_rank), None)
+            if identity is None or identity in self._draining:
+                return
+            host = self._assigned[identity]["hostname"]
+            if not self._host_removable(host):
+                log.warning(
+                    "elastic driver: autoscale EVICT of %s skipped — "
+                    "retiring host %s would drop below min_np=%s",
+                    identity, host, self.min_np)
+                return
+            event["identity"], event["host"] = identity, host
+            log.warning("elastic driver: autoscale EVICT %s (%s)",
+                        identity, decision.reason)
+            # Cordon first, then drain: when the worker's clean exit
+            # triggers the regeneration, the host is already excluded.
+            self.cordon(host)
+            if not self.drain_worker(identity):
+                # Unreachable worker: fall back to termination.  Marked
+                # DRAINING (not released) so the reap classifies it as a
+                # departure AND triggers the regeneration — a released
+                # exit is silently skipped, which would leave the
+                # survivors waiting on a generation that never forms.
+                proc = self._procs.get(identity)
+                if proc is not None and proc.poll() is None:
+                    self._draining.add(identity)
+                    proc.terminate()
+            self._run_scale_command("evict", decision, host=host)
+        elif decision.action == "scale_out":
+            log.warning("elastic driver: autoscale SCALE_OUT -> %s (%s)",
+                        decision.target_size, decision.reason)
+            self._run_scale_command("scale_out", decision)
+        elif decision.action == "scale_in":
+            # Retire the LAST host of the current generation that does
+            # not carry the coordinator (host 0 must survive a shrink).
+            order: List[str] = []
+            for a in sorted(self._assigned.values(),
+                            key=lambda a: a["rank"]):
+                if a["hostname"] not in order:
+                    order.append(a["hostname"])
+            victims = [h for h in order[1:] if self._host_removable(h)]
+            if not victims:
+                return
+            host = victims[-1]
+            event["host"] = host
+            log.warning("elastic driver: autoscale SCALE_IN: draining "
+                        "host %s (%s)", host, decision.reason)
+            self.cordon(host)
+            for identity, a in self._assigned.items():
+                if a["hostname"] == host:
+                    self.drain_worker(identity)
+            self._run_scale_command("scale_in", decision, host=host)
+        self.events.append(event)
+
+    def _host_removable(self, host: str) -> bool:
+        """min_np at HOST granularity: the policy approves scale-in/evict
+        from rank counts, but retiring a host removes ALL its slots —
+        on multi-slot hosts that can undershoot min_np and the driver
+        would abort the whole job at the next regeneration.  A host is
+        removable only if the surviving assignment still covers min_np."""
+        remaining = sum(1 for a in self._assigned.values()
+                        if a["hostname"] != host)
+        return remaining >= self.min_np
 
     def _close_out_files(self, identity: str):
         for fh in self._out_files.pop(identity, ()):
@@ -378,10 +650,36 @@ def run_elastic(args) -> int:
         extra_env["HOROVOD_TIMELINE"] = args.timeline_filename
     if getattr(args, "trace_filename", None):
         extra_env["HOROVOD_TRACE"] = args.trace_filename
+    # Closed-loop autoscaling (docs/elastic.md): the policy lives in the
+    # DRIVER process, parameterized from the same HOROVOD_AUTOSCALE_*
+    # env table Config documents (the launcher's env, not the workers').
+    from ..common.config import Config
+    cfg = Config.from_env()
+    autoscale_on = cfg.autoscale or getattr(args, "autoscale", False)
+    policy = None
+    if autoscale_on:
+        from .autoscale import ScalePolicy
+        policy = ScalePolicy(
+            min_np=min_np, max_np=max_np,
+            queue_high=cfg.autoscale_queue_high,
+            queue_trend_up=cfg.autoscale_queue_trend,
+            straggler_factor=cfg.autoscale_straggler_factor,
+            persistence=cfg.autoscale_persistence,
+            cooldown_s=cfg.autoscale_cooldown_s,
+            idle_s=cfg.autoscale_idle_s)
+        if not extra_env.get("HOROVOD_MONITOR_PORT"):
+            log.warning(
+                "autoscale enabled without --monitor-port: the driver has "
+                "no monitor endpoint to observe, so the policy will hold "
+                "forever; pass --monitor-port to close the loop")
     driver = ElasticDriver(
         discovery, args.command, min_np=min_np, max_np=max_np,
         env=extra_env, start_timeout_s=args.start_timeout,
-        output_filename=args.output_filename, verbose=args.verbose)
+        output_filename=args.output_filename, verbose=args.verbose,
+        autoscale_policy=policy,
+        autoscale_interval_s=(getattr(args, "autoscale_interval", None)
+                              or cfg.autoscale_interval_s),
+        scale_command=getattr(args, "scale_command", None))
     try:
         return driver.run()
     finally:

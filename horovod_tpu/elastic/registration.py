@@ -14,6 +14,10 @@ from typing import Dict, Set
 READY = "READY"
 SUCCESS = "SUCCESS"
 FAILURE = "FAILURE"
+# Clean departure (drain → LEAVE → exit 0, or a driver-released identity):
+# terminal like SUCCESS/FAILURE, but it is neither a job-completion signal
+# nor a blacklisting failure — the host stays schedulable.
+LEFT = "LEFT"
 
 
 class WorkerStateRegistry:
@@ -37,6 +41,16 @@ class WorkerStateRegistry:
             self._states[identity] = FAILURE
             self._failures[host] = self._failures.get(host, 0) + 1
             self._blacklist.add(host)
+
+    def record_left(self, identity: str):
+        """Clean-exit classification: a worker that exited 0 because the
+        driver drained it (autoscale scale-in / straggler evict → clean
+        LEAVE) or released it (host removed from a generation).  NOT a
+        success — it must not end the job — and NOT a failure: the host
+        is never blacklisted for an orderly departure, so it stays
+        eligible for a later scale-out."""
+        with self._lock:
+            self._states[identity] = LEFT
 
     def state_of(self, identity: str) -> str:
         with self._lock:

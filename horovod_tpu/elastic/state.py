@@ -19,17 +19,17 @@ import jax
 # ``from horovod_tpu.elastic.state import HorovodInternalError`` imports —
 # including the torch/elastic binding — keep working.
 from ..common.exceptions import (  # noqa: F401  (re-export)
-    ControlPlaneError, HorovodInternalError, PeerFailureError,
+    ControlPlaneError, DrainRequested, HorovodInternalError,
+    HostsUpdatedInterrupt, PeerFailureError, PeerLeftInterrupt,
     RoundTimeoutError,
 )
 
-
-class HostsUpdatedInterrupt(Exception):
-    """The elastic driver notified a host-set change; re-rendezvous keeping
-    current (committed-or-not) parameters."""
-
-    def __init__(self, skip_sync: bool = False):
-        self.skip_sync = skip_sync
+# HostsUpdatedInterrupt (and the new DrainRequested / PeerLeftInterrupt)
+# moved to the jax-free common/exceptions.py with the rest of the control-
+# flow taxonomy — the controller, the engine and the autoscaling stack
+# raise them without importing jax.  Re-exported above so every historical
+# ``from horovod_tpu.elastic.state import HostsUpdatedInterrupt`` import
+# keeps seeing the ONE class.
 
 
 class State:
@@ -183,6 +183,15 @@ def run(func: Callable) -> Callable:
             except HorovodInternalError:
                 state.restore()
                 skip_sync = False
+            except DrainRequested:
+                # The driver asked this worker to drain (autoscale
+                # scale-in / straggler evict): the batch that just
+                # committed is the last one — shut down, which sends the
+                # clean LEAVE (protocol v6) so survivors see an orderly
+                # departure, and return.  Exit 0 is the contract the
+                # driver's clean-exit classification keys on.
+                basics.shutdown()
+                return None
             except HostsUpdatedInterrupt as e:
                 skip_sync = e.skip_sync
             reset_required = True

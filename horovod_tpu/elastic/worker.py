@@ -19,7 +19,7 @@ import threading
 from typing import Optional
 
 from . import rendezvous as rdv
-from .state import HostsUpdatedInterrupt
+from ..common.exceptions import DrainRequested, HostsUpdatedInterrupt
 from ..utils.logging import get_logger
 
 log = get_logger()
@@ -62,10 +62,12 @@ def identity() -> str:
 
 
 class WorkerNotificationService:
-    """Tiny TCP listener; driver sends ``HOSTS_UPDATED <version>\\n``."""
+    """Tiny TCP listener; driver sends ``HOSTS_UPDATED <version>\\n`` or —
+    the autoscaler's drain path — ``DRAIN\\n``."""
 
-    def __init__(self, on_hosts_updated):
+    def __init__(self, on_hosts_updated, on_drain=None):
         self._on_hosts_updated = on_hosts_updated
+        self._on_drain = on_drain
         self._sock = socket.socket()
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("0.0.0.0", 0))
@@ -91,6 +93,8 @@ class WorkerNotificationService:
                 if data.startswith("HOSTS_UPDATED"):
                     version = int(data.split()[1]) if " " in data else 0
                     self._on_hosts_updated(version)
+                elif data.startswith("DRAIN") and self._on_drain is not None:
+                    self._on_drain()
             except (OSError, ValueError):
                 pass
             finally:
@@ -115,7 +119,9 @@ class WorkerNotificationManager:
     def __init__(self):
         self._lock = threading.Lock()
         self._pending_version: Optional[int] = None
-        self._service = WorkerNotificationService(self._notify)
+        self._drain_pending = False
+        self._service = WorkerNotificationService(self._notify,
+                                                  on_drain=self._notify_drain)
         addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR")
         port = os.environ.get("HOROVOD_RENDEZVOUS_PORT")
         if addr and port:
@@ -126,16 +132,30 @@ class WorkerNotificationManager:
         with self._lock:
             self._pending_version = version
 
+    def _notify_drain(self):
+        with self._lock:
+            self._drain_pending = True
+
     def raise_if_updated(self):
         with self._lock:
+            drain = self._drain_pending
             v = self._pending_version
-            if v is None:
+            if drain:
+                # Drain outranks a host update: this worker is LEAVING —
+                # re-rendezvousing into the next generation first would
+                # just delay the departure the driver is waiting on.
+                self._drain_pending = False
+                self._pending_version = None
+            elif v is None:
                 return
             # A late ping for the generation we already joined is not news.
-            if _current_version is not None and v <= _current_version:
+            elif _current_version is not None and v <= _current_version:
                 self._pending_version = None
                 return
-            self._pending_version = None
+            else:
+                self._pending_version = None
+        if drain:
+            raise DrainRequested()
         raise HostsUpdatedInterrupt()
 
 

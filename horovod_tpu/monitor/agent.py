@@ -91,6 +91,11 @@ class MonitorAgent:
             # messages are enriched with the dead ranks' last snapshot
             # ages and ledger tails from the aggregation table.
             controller.fault_enricher = self.peer_failure_context
+            # Clean-LEAVE notices (protocol v6): the departed rank stops
+            # counting toward liveness, so /health stays ok — an orderly
+            # departure is not a degradation.
+            if hasattr(controller, "peer_leave_hook"):
+                controller.peer_leave_hook = self.on_peer_leave
 
     # ----------------------------------------------------------- collectors
     def _register_collectors(self, engine, controller) -> None:
@@ -342,6 +347,13 @@ class MonitorAgent:
                 getattr(ctl, "monitor_bytes_sent", 0) if ctl else 0})
 
     # ------------------------------------------------------- fault hooks
+    def on_peer_leave(self, ranks) -> None:
+        """Controller hook (protocol v6 leave notice): clean departures —
+        marked in the aggregator so liveness accounting skips them;
+        deliberately NOT a fault latch (``/health`` stays ok)."""
+        for r in ranks or []:
+            self.aggregator.mark_left(int(r))
+
     def on_peer_failure(self, dead_ranks, reason: str = "") -> None:
         """Engine hook (``_abort_engine``): latch the control-plane fault
         so ``/health`` reports ``peer_dead`` with attribution."""
@@ -409,6 +421,14 @@ class MonitorAgent:
                 n = len(table[r]["snap"].get("stalled") or [])
                 out.append(
                     f'hvd_rank_stalled_collectives{{rank="{r}"}} {n}')
+        # Windowed trend gauges (autoscale policy inputs): emitted only
+        # once their EWMA window fills — absence IS the null.
+        summary = self.aggregator.summary()
+        for name in ("cycle_us_spread_trend", "queue_depth_trend"):
+            v = summary.get(name)
+            if v is not None:
+                out.append(f"# TYPE hvd_{name} gauge")
+                out.append(f"hvd_{name} {v:g}")
         return "\n".join(out) + "\n"
 
     def dump(self) -> dict:

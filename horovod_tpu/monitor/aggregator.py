@@ -30,6 +30,47 @@ import time
 from typing import Dict, List, Optional
 
 
+class EwmaTrend:
+    """Windowed EWMA trend of a scalar series: fast EWMA minus slow EWMA.
+
+    Positive = the series is rising, negative = falling, ~0 = flat; the
+    magnitude is in the series' own units, so thresholds stay intuitive
+    (a ``queue_depth_trend`` of 3 means the backlog is ~3 entries above
+    its recent baseline).  ``trend`` is ``None`` until ``min_samples``
+    observations arrived — the autoscale policy treats nulls as
+    "window not filled, hold" — and ``reset()`` re-empties the window
+    (join-epoch flush: samples from an uneven world must not steer
+    scaling decisions into the resumed one)."""
+
+    def __init__(self, fast: float = 0.5, slow: float = 0.1,
+                 min_samples: int = 5):
+        self.fast_alpha = float(fast)
+        self.slow_alpha = float(slow)
+        self.min_samples = max(1, int(min_samples))
+        self._fast: Optional[float] = None
+        self._slow: Optional[float] = None
+        self._n = 0
+
+    def update(self, value: float) -> None:
+        v = float(value)
+        self._fast = v if self._fast is None else (
+            self.fast_alpha * v + (1 - self.fast_alpha) * self._fast)
+        self._slow = v if self._slow is None else (
+            self.slow_alpha * v + (1 - self.slow_alpha) * self._slow)
+        self._n += 1
+
+    @property
+    def trend(self) -> Optional[float]:
+        if self._n < self.min_samples:
+            return None
+        return round(self._fast - self._slow, 4)
+
+    def reset(self) -> None:
+        self._fast = None
+        self._slow = None
+        self._n = 0
+
+
 class RankAggregator:
     """Per-rank snapshot table + fleet-level derived views."""
 
@@ -38,6 +79,17 @@ class RankAggregator:
         self._lock = threading.Lock()
         # rank -> {"snap": dict, "received_at": monotonic}
         self._table: Dict[int, dict] = {}
+        # Ranks that departed via clean LEAVE (protocol v6): excluded from
+        # liveness/degraded accounting — an orderly departure must not
+        # flip /health — and reported under "left_ranks".  NOT cleared by
+        # flush(): the departure outlives any join epoch; only a new
+        # controller generation (fresh aggregator) forgets it.
+        self._left: set = set()
+        # Windowed trend gauges (autoscale policy inputs — docs/elastic.md
+        # "Closed-loop autoscaling"): nulls until the window fills,
+        # flushed on join epoch like the rest of the table.
+        self._spread_trend = EwmaTrend()
+        self._queue_trend = EwmaTrend()
         self.flushes = 0
         self.updates = 0
 
@@ -47,11 +99,34 @@ class RankAggregator:
             self._table[int(rank)] = {"snap": snap,
                                       "received_at": time.monotonic()}
             self.updates += 1
+            # Feed the trend windows at snapshot cadence: spread needs two
+            # reporting ranks; queue depth sums every rank's pending count.
+            per_rank = [rec["snap"].get("cycle_us_avg")
+                        for r, rec in self._table.items()
+                        if r not in self._left
+                        and rec["snap"].get("cycle_us_avg") is not None]
+            if len(per_rank) >= 2:
+                self._spread_trend.update(max(per_rank) - min(per_rank))
+            q = self._queue_depth_locked()
+            if q is not None:
+                self._queue_trend.update(q)
+
+    def mark_left(self, rank: int) -> None:
+        """Record a clean departure (protocol v6 leave notice): the rank
+        stops counting toward liveness — ``/health`` stays ok — and its
+        stale snapshot is dropped."""
+        with self._lock:
+            self._left.add(int(rank))
+            self._table.pop(int(rank), None)
 
     def flush(self) -> None:
-        """Drop every snapshot (join-epoch boundary / elastic re-init)."""
+        """Drop every snapshot (join-epoch boundary / elastic re-init).
+        Trend windows flush with the table; clean-leave records persist
+        (the departed rank is still gone in the resumed world)."""
         with self._lock:
             self._table.clear()
+            self._spread_trend.reset()
+            self._queue_trend.reset()
             self.flushes += 1
 
     @staticmethod
@@ -79,6 +154,22 @@ class RankAggregator:
                         "age_s": round(now - rec["received_at"], 3)}
                     for r, rec in self._table.items()}
 
+    def left_ranks(self) -> List[int]:
+        with self._lock:
+            return sorted(self._left)
+
+    def _queue_depth_locked(self) -> Optional[int]:
+        """Fleet queue depth: sum of every reporting rank's
+        ``hvd_queue_pending`` gauge; None until someone reports it."""
+        vals = []
+        for r, rec in self._table.items():
+            if r in self._left:
+                continue
+            v = (rec["snap"].get("metrics") or {}).get("hvd_queue_pending")
+            if v is not None:
+                vals.append(int(v))
+        return sum(vals) if vals else None
+
     def skew(self) -> dict:
         """Straggler attribution from per-rank cycle timings.
 
@@ -88,7 +179,8 @@ class RankAggregator:
         with self._lock:
             per_rank = {r: rec["snap"].get("cycle_us_avg")
                         for r, rec in self._table.items()
-                        if rec["snap"].get("cycle_us_avg") is not None}
+                        if r not in self._left
+                        and rec["snap"].get("cycle_us_avg") is not None}
         if len(per_rank) < 2:
             return {"slowest_rank": None, "cycle_us_spread": None,
                     "per_rank_cycle_us": per_rank or None}
@@ -96,6 +188,39 @@ class RankAggregator:
         spread = round(max(per_rank.values()) - min(per_rank.values()), 2)
         return {"slowest_rank": slowest, "cycle_us_spread": spread,
                 "per_rank_cycle_us": per_rank}
+
+    def summary(self) -> dict:
+        """The autoscale policy's observation record (docs/elastic.md):
+        straggler attribution plus the windowed trend gauges and fleet
+        load figures, so policy inputs are observable standalone — the
+        same numbers ride ``/health`` and ``/metrics``.  Trend fields are
+        null until their EWMA window fills."""
+        out = self.skew()
+        with self._lock:
+            out["queue_depth"] = self._queue_depth_locked()
+            out["cycle_us_spread_trend"] = self._spread_trend.trend
+            out["queue_depth_trend"] = self._queue_trend.trend
+            out["ranks_reporting"] = len(
+                [r for r in self._table if r not in self._left])
+            out["left_ranks"] = sorted(self._left)
+            # Fleet WORK-progress counter (the autoscale idle detector's
+            # input): dispatched batches, NOT coordinator cycles — the
+            # engine's cycle index advances on idle ticks too, so an idle
+            # fleet would never read as idle through it.  Falls back to
+            # the cycle counter for snapshot sources without the dispatch
+            # metric.
+            prog = []
+            for r, rec in self._table.items():
+                if r in self._left:
+                    continue
+                m = rec["snap"].get("metrics") or {}
+                v = m.get("hvd_pipeline_dispatches_total")
+                if v is None:
+                    v = rec["snap"].get("cycle")
+                if v is not None:
+                    prog.append(v)
+            out["progress_total"] = sum(prog) if prog else None
+        return out
 
     def peer_ledger_tails(self,
                           exclude_rank: Optional[int] = None
@@ -125,7 +250,16 @@ class RankAggregator:
         missing = 0
         with self._lock:
             table = dict(self._table)
+            left = set(self._left)
         for r in range(self.world):
+            if r in left:
+                # Clean departure (protocol v6): the rank is GONE by
+                # design, not degraded — reported separately, never as
+                # missing.
+                ranks[str(r)] = {"alive": False, "left": True,
+                                 "last_seen_s": None, "cycle": None,
+                                 "last_cycle_age_s": None, "stalled": []}
+                continue
             rec = table.get(r)
             if rec is None:
                 ranks[str(r)] = {"alive": False, "last_seen_s": None,
@@ -150,5 +284,5 @@ class RankAggregator:
                   else "degraded" if missing else "ok")
         out = {"status": status, "world": self.world,
                "monitor_interval_s": interval_s, "ranks": ranks}
-        out.update(self.skew())
+        out.update(self.summary())
         return out

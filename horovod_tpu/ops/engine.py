@@ -243,6 +243,15 @@ class CollectiveEngine:
         # enqueues raise it immediately instead of queueing into a dead
         # world.  Elastic re-init builds a fresh engine, clearing it.
         self._fault: Optional[BaseException] = None
+        # Clean world-membership change (protocol v6, NOT a fault): set
+        # when the coordinator's leave notice names peers that departed
+        # via clean LEAVE.  World-level (default-process-set) work fails
+        # with it — the control plane's world shrank but the data-plane
+        # world is still the old fixed size, so executing a shrunk-world
+        # verdict would wedge the transport — while /health stays ok and
+        # no HVD303 is raised; the elastic wrapper re-rendezvouses keeping
+        # current parameters.  Elastic re-init clears it with the engine.
+        self._world_changed: Optional[BaseException] = None
         # Control-plane observability: cumulative negotiation wall time and
         # round count (multi-process mode only — single-controller cycles
         # have no negotiation).  bench.py derives negotiation_us_per_cycle;
@@ -302,6 +311,28 @@ class CollectiveEngine:
         self._thread = threading.Thread(
             target=self._background_loop, name="hvd-tpu-coordinator", daemon=True)
         self._thread.start()
+
+    def quiesce(self, timeout: float = 10.0) -> bool:
+        """Stop the cycle thread at a round boundary for a CLEAN departure.
+
+        Sets the shutdown flag and joins the thread WITHOUT severing the
+        controller socket first: in a healthy world the in-flight
+        lock-step round completes in milliseconds and the thread exits at
+        the loop check, leaving the socket quiet — the precondition for
+        ``controller.leave()`` (the LEAVE frame must not interleave with a
+        round in flight).  Returns True when the thread exited cleanly
+        with no fault latched; False (thread wedged — a peer is already
+        gone or the coordinator is stuck) tells the caller to fall back to
+        the legacy ``interrupt()`` sever."""
+        self._shutdown.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            if t.is_alive():
+                return False
+            self._thread = None
+        return self._fault is None
 
     def stop(self):
         self._shutdown.set()
@@ -428,6 +459,15 @@ class CollectiveEngine:
         builds a fresh engine, which clears it."""
         return self._fault
 
+    @property
+    def world_changed(self) -> Optional[BaseException]:
+        """The ``PeerLeftInterrupt`` latched when peers departed via clean
+        LEAVE (protocol v6), or ``None``.  NOT a fault: ``fault`` stays
+        ``None`` and ``/health`` stays ok — but world-level work fails
+        with this until the elastic re-init forms the next generation
+        (which builds a fresh engine, clearing it)."""
+        return self._world_changed
+
     # ------------------------------------------------------------- submit API
     def enqueue(self, name: str, ctype: CollectiveType, tensor,
                 reduce_op=C.ReduceOp.AVERAGE, root_rank: int = 0,
@@ -451,6 +491,12 @@ class CollectiveEngine:
             # fast with the original HVD303 error instead of queueing work
             # no negotiation round will ever answer.
             raise self._fault
+        if self._world_changed is not None and any(
+                int(kw.get("process_set_id", 0) or 0) == 0 for kw in items):
+            # Peers departed via clean LEAVE (protocol v6): world-level
+            # work cannot run until the world re-forms — fail fast with
+            # the re-rendezvous interrupt, NOT an HVD303 fault.
+            raise self._world_changed
         if self.controller is None and self._world_processes > 1:
             # A multi-process world without the launcher's negotiation
             # controller (pod auto-detect mode): eager collectives cannot
@@ -927,6 +973,35 @@ class CollectiveEngine:
             done_handles = {e.handle for e in ready} | errored_handles
             not_ready = [e for e in entries if e.handle not in done_handles]
             entries = [e for e in ready if e.handle not in errored_handles]
+            left = getattr(self.controller, "left_ranks", None)
+            if left:
+                # Clean world shrink (protocol v6 leave notice): world-level
+                # verdicts were computed over the SHRUNK control-plane
+                # world, but the data-plane world is still the old fixed
+                # size — executing them would wedge the transport.  Fail
+                # every default-process-set entry (ready AND still-pending)
+                # with PeerLeftInterrupt: not a fault, /health stays ok,
+                # and the elastic wrapper re-rendezvouses keeping current
+                # parameters.  Sub-process-set collectives that exclude
+                # the leavers keep flowing.
+                if self._world_changed is None:
+                    from ..common.exceptions import PeerLeftInterrupt
+                    self._world_changed = PeerLeftInterrupt(left)
+                exc_left = self._world_changed
+                keep_r: List[TensorTableEntry] = []
+                keep_nr: List[TensorTableEntry] = []
+                poisoned: List[TensorTableEntry] = []
+                for src, kept in ((entries, keep_r), (not_ready, keep_nr)):
+                    for e in src:
+                        if getattr(e, "process_set_id", 0) == 0:
+                            self.controller.forget(e)
+                            poisoned.append(e)
+                        else:
+                            kept.append(e)
+                self._settle_queued(poisoned, exc_left)
+                for e in poisoned:
+                    self.stall.progressed(e.name)
+                entries, not_ready = keep_r, keep_nr
         for e in entries:
             if self._state.timeline is not None:
                 self._state.timeline.end_activity(e.name, "QUEUE")

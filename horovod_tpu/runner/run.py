@@ -161,6 +161,21 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "script (elastic mode; URL override via "
                         "HOROVOD_TPU_METADATA_URL)")
     p.add_argument("--slots-per-host", type=int, default=None)
+    p.add_argument("--autoscale", action="store_true",
+                   help="Closed-loop autoscaling (elastic mode; docs/"
+                        "elastic.md): the driver polls rank 0's monitor "
+                        "/health and scales the world itself — out on "
+                        "rising load, straggler drain-and-evict on "
+                        "monitor attribution, in when idle.  Requires "
+                        "--monitor-port; knobs via HOROVOD_AUTOSCALE_*")
+    p.add_argument("--autoscale-interval", type=float, default=None,
+                   help="Seconds between autoscale policy observations "
+                        "(default 5)")
+    p.add_argument("--scale-command", default=None,
+                   help="Operator capacity hook run on scale decisions "
+                        "with HVD_AUTOSCALE_ACTION/TARGET/HOST in env; "
+                        "it changes what --host-discovery-script reports "
+                        "(e.g. resizes an instance group)")
     # Cluster-scheduler backends (reference P7 ships jsrun/mpirun backends;
     # the TPU equivalents live in runner/tpu_vm.py).
     p.add_argument("--tpu", default=None,

@@ -1,0 +1,193 @@
+"""Simulated-load autoscaling acceptance worker (ISSUE 10) — jax-free.
+
+A synthetic elastic "trainer" exercising the REAL wire stack end to end —
+versioned rendezvous, native lock-step negotiation (flat or through a real
+per-host ``HostAgent``), MON1 monitor side-channel + rank-0 HTTP exporter,
+DRAIN notifications and protocol-v6 clean LEAVEs — without the jax data
+plane, so the multiprocess scenario test can grow/shrink worlds in
+seconds.  The load is scripted through files in ``AUTOSCALE_DIR``:
+
+- ``load``       float: synthetic queue depth each rank reports (0 = idle;
+                 also freezes the fake cycle counter, so the policy's
+                 idle detector sees zero progress)
+- ``straggler``  int rank whose fake cycle time inflates 100x ("" = none)
+- ``done``       existence ends the run: every worker leaves cleanly and
+                 exits 0 (the driver classifies the first non-draining
+                 exit 0 as job success)
+
+Per generation each worker: fetches its assignment, (hierarchical mode)
+starts its host's agent, connects a real ``TCPController``, attaches a
+real ``MonitorAgent`` over a duck-typed fake engine (rank 0 serves
+``/health`` on ``HOROVOD_MONITOR_PORT`` — the driver's policy input), and
+loops lock-step rounds.  ``DrainRequested`` → clean LEAVE → exit 0;
+``HostsUpdatedInterrupt`` → clean LEAVE → re-rendezvous into the next
+generation.
+"""
+
+import os
+import sys
+import time
+
+from horovod_tpu.common.controller import TCPController
+from horovod_tpu.common.exceptions import (
+    DrainRequested, HorovodInternalError, HostsUpdatedInterrupt,
+)
+from horovod_tpu.elastic import rendezvous as rdv
+from horovod_tpu.elastic import worker as ew
+from horovod_tpu.monitor.agent import MonitorAgent
+
+DIR = os.environ["AUTOSCALE_DIR"]
+HIER = os.environ.get("HOROVOD_HIERARCHICAL_CONTROLLER", "") == "1"
+MONITOR_PORT = int(os.environ.get("HOROVOD_MONITOR_PORT", "0"))
+
+
+def _read(name, default=""):
+    try:
+        with open(os.path.join(DIR, name)) as fh:
+            return fh.read().strip()
+    except OSError:
+        return default
+
+
+class _FakeQueue:
+    def __init__(self):
+        self.depth = 0
+
+    def pending_count(self):
+        return self.depth
+
+
+class _FakeEngine:
+    """Duck-typed engine surface for MonitorAgent's collectors: the
+    scripted load/straggler values flow through the SAME snapshot fields
+    a real engine publishes (cycle_us_avg, cycle, hvd_queue_pending)."""
+
+    def __init__(self):
+        self.cycle_count = 0
+        self.cycle_us_total = 0.0
+        self._cycle_index = 0
+        self.last_cycle_ts = time.time()
+        self.negotiation_us_total = 0.0
+        self.negotiation_cycles = 0
+        # The autoscaler's idle detector reads this WORK counter (via
+        # hvd_pipeline_dispatches_total): it advances only when batches
+        # actually dispatch — exactly like the real engine's, whose cycle
+        # index ticks on idle rounds too.
+        self.pipeline_dispatches = 0
+        self.queue = _FakeQueue()
+        self.monitor = None
+
+    def tick(self, cycle_us, busy):
+        self.cycle_count += 1
+        self.cycle_us_total += cycle_us
+        self.last_cycle_ts = time.time()
+        if busy:
+            self._cycle_index += 1
+            self.pipeline_dispatches += 1
+
+
+class E:
+    def __init__(self, name):
+        import numpy as np
+        self.name = name
+        self.tensor = np.zeros((2, 4), np.float32)
+        self.group_id = -1
+
+
+def one_generation(mgr):
+    """Run one rendezvous generation; returns True to re-rendezvous,
+    False to exit 0."""
+    addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+    port = int(os.environ["HOROVOD_RENDEZVOUS_PORT"])
+    min_v = 0 if ew._current_version is None else ew._current_version + 1
+    a = rdv.fetch_assignment(addr, port, ew.identity(),
+                             min_version=min_v, timeout_s=120)
+    ew._current_version = int(a["version"])
+    rank, size = int(a["rank"]), int(a["size"])
+    ctl_port = int(a["controller_port2"]) or int(a["controller_port"]) + 1
+    coord = a["controller_addr"]
+
+    agent = None
+    connect_addr, connect_port, server_port = coord, ctl_port, None
+    if HIER:
+        from horovod_tpu.common.host_agent import HostAgent
+        cross = int(a["cross_rank"])
+        agent_port = ctl_port + 1 + cross
+        if int(a["local_rank"]) == 0:
+            agent = HostAgent(agent_port, coord, ctl_port, [rank],
+                              host_index=cross).start()
+        connect_addr, connect_port = "127.0.0.1", agent_port
+        if rank == 0:
+            server_port = ctl_port
+    elif rank == 0:
+        server_port = ctl_port
+
+    eng = _FakeEngine()
+    ctl = TCPController(connect_addr, connect_port, rank=rank, world=size,
+                        stall_warn_s=1e9, cache_capacity=256,
+                        round_timeout_s=30.0, server_port=server_port)
+    mon = MonitorAgent(engine=eng, controller=ctl, rank=rank, world=size,
+                       interval_s=0.15)
+    if rank == 0 and MONITOR_PORT:
+        mon.serve_http(MONITOR_PORT)
+    print(f"[worker {ew.identity()}] generation {a['version']} "
+          f"rank={rank}/{size}", flush=True)
+
+    step = 0
+    try:
+        while True:
+            load = float(_read("load", "0") or 0)
+            straggler = _read("straggler", "")
+            busy = load > 0
+            cycle_us = 100.0
+            if straggler and int(straggler) == rank:
+                cycle_us = 10000.0
+            eng.queue.depth = int(load)
+            # One lock-step negotiation round (a fresh entry while busy,
+            # an empty round while idle — the monitor frames ride either).
+            entries = [E(f"g{a['version']}.s{step}")] if busy else []
+            pending = list(entries)
+            for _ in range(50):
+                ready, errs = ctl.negotiate(pending)
+                got = {e.name for e in ready}
+                pending = [e for e in pending if e.name not in got]
+                if not pending:
+                    break
+            eng.tick(cycle_us, busy)
+            step += 1
+            if os.path.exists(os.path.join(DIR, "done")):
+                return False
+            mgr.raise_if_updated()
+            time.sleep(0.05)
+    except DrainRequested:
+        print(f"[worker {ew.identity()}] drain requested -> clean LEAVE",
+              flush=True)
+        return False
+    except HostsUpdatedInterrupt:
+        print(f"[worker {ew.identity()}] hosts updated -> re-rendezvous",
+              flush=True)
+        return True
+    except HorovodInternalError as exc:
+        # The old generation's coordinator went away mid-round (its rank-0
+        # left first): re-rendezvous, exactly like the real elastic path.
+        print(f"[worker {ew.identity()}] control plane ended ({exc}); "
+              f"re-rendezvous", flush=True)
+        return True
+    finally:
+        mon.close()
+        ctl.leave()          # best-effort clean departure (protocol v6)
+        ctl.shutdown()
+        if agent is not None:
+            agent.stop()
+
+
+def main():
+    mgr = ew.WorkerNotificationManager()
+    ew._manager = mgr
+    while one_generation(mgr):
+        pass
+    print(f"[worker {ew.identity()}] exiting 0", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

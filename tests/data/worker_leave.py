@@ -1,0 +1,159 @@
+"""Clean-LEAVE acceptance worker (ISSUE 10's two-process proof).
+
+Two modes (``LEAVE_MODE``), one script — the same departure point, with
+and without the typed LEAVE frame, so the disambiguation the protocol
+exists for is asserted from both sides:
+
+``clean``  rank 1 finishes K lock-step allreduce steps, then calls
+           ``hvd.shutdown()`` — which quiesces the engine at a round
+           boundary and sends the protocol-v6 LEAVE before the sever —
+           and exits 0.  Rank 0 keeps training and must observe a
+           ``PeerLeftInterrupt`` (a ``HostsUpdatedInterrupt`` — the
+           re-rendezvous signal, NOT an HVD303 fault): ``engine.fault``
+           stays None, ``controller.left_ranks == [1]``, new world-level
+           submissions fail fast with the same interrupt, and the
+           monitor's ``/health`` stays ``ok`` with rank 1 reported left.
+
+``sever``  rank 1 severs its socket at the SAME point WITHOUT a LEAVE:
+           rank 0 must get the typed attributed ``PeerFailureError``
+           naming rank 1 (HVD303) — the legacy crash verdict, proving
+           the LEAVE frame (not timing luck) is what made mode ``clean``
+           clean.
+
+Results ride files (``LEAVE_RESULT`` / + ``.r1``): both ranks exit via
+``os._exit`` — the departed world cannot complete the jax coordination
+service's cooperative shutdown barrier, exactly why clean departures
+park it (docs/fault_tolerance.md).
+"""
+
+import json
+import os
+import time
+
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+from horovod_tpu.common.exceptions import (
+    HorovodInternalError, HostsUpdatedInterrupt, PeerLeftInterrupt,
+)
+
+MODE = os.environ.get("LEAVE_MODE", "clean")
+RESULT = os.environ.get("LEAVE_RESULT", "")
+WARM_STEPS = int(os.environ.get("LEAVE_WARM_STEPS", "6"))
+
+
+def _write(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def main():
+    hvd.init()
+    rank = hvd.rank()
+    st = basics._get_state()
+    eng, ctl = st.engine, st.controller
+
+    # Warm lock-step steps on BOTH ranks: all work settles, so the
+    # departure point has zero outstanding negotiated work.
+    for k in range(WARM_STEPS):
+        out = hvd.allreduce(np.ones(2, np.float32), name=f"warm.{k}",
+                            op=hvd.Sum)
+        np.testing.assert_allclose(
+            np.asarray(hvd.to_local(out)).reshape(2),
+            np.full(2, float(hvd.size()), np.float32))
+
+    if rank == 1:
+        if MODE == "clean":
+            hvd.shutdown()       # quiesce -> LEAVE -> sever (protocol v6)
+            _write(RESULT + ".r1", {"ok": True,
+                                    "leave_sent": bool(ctl.leave_sent)})
+        else:
+            # The control: the SAME departure point, no LEAVE frame.
+            eng.quiesce(timeout=5.0)
+            ctl._sever()
+            _write(RESULT + ".r1", {"ok": True, "leave_sent": False})
+            time.sleep(3)        # let rank 0 read the verdict first —
+                                 # a nonzero exit makes the launcher reap
+        os._exit(0 if MODE == "clean" else 3)
+
+    # ------------------------------------------------------------- rank 0
+    verdict = None
+    try:
+        for k in range(100000):
+            hvd.allreduce(np.ones(2, np.float32), name=f"after.{k}",
+                          op=hvd.Sum)
+            time.sleep(0.01)
+        raise AssertionError("peer departure never observed")
+    except HostsUpdatedInterrupt as exc:
+        verdict = exc
+    except HorovodInternalError as exc:
+        verdict = exc
+
+    if MODE == "clean":
+        assert isinstance(verdict, PeerLeftInterrupt), repr(verdict)
+        assert not isinstance(verdict, HorovodInternalError), repr(verdict)
+        assert verdict.left_ranks == [1], verdict.left_ranks
+        assert eng.fault is None, repr(eng.fault)
+        assert ctl.left_ranks == [1], ctl.left_ranks
+        assert not ctl.interrupted
+        # New world-level work fails FAST with the same interrupt (never
+        # queues into a world that must re-form first).
+        t0 = time.monotonic()
+        try:
+            hvd.allreduce(np.ones(2, np.float32), name="post.leave",
+                          op=hvd.Sum)
+            raise AssertionError("post-leave enqueue did not fail")
+        except PeerLeftInterrupt:
+            pass
+        assert time.monotonic() - t0 < 5
+        # /health stays ok with the departed rank reported LEFT — an
+        # orderly departure is not a degradation.
+        health = st.monitor.health()
+        assert health["status"] == "ok", health
+        assert health["left_ranks"] == [1], health
+        assert health["ranks"]["1"].get("left") is True, health["ranks"]
+        _write(RESULT, {
+            "ok": True, "mode": MODE,
+            "verdict": type(verdict).__name__,
+            "left_ranks": verdict.left_ranks,
+            "fault": None,
+            "health_status": health["status"],
+            "health_left": health["left_ranks"],
+        })
+        print("LEAVE_CLEAN_OK", flush=True)
+    else:
+        from horovod_tpu.common.exceptions import PeerFailureError
+        # Without the LEAVE frame the same sever is a CRASH: typed,
+        # attributed HVD303.
+        assert isinstance(verdict, PeerFailureError) or \
+            eng.fault is not None, repr(verdict)
+        fault = verdict if isinstance(verdict, PeerFailureError) \
+            else eng.fault
+        assert isinstance(fault, PeerFailureError), repr(fault)
+        assert fault.dead_ranks == [1], fault.dead_ranks
+        assert "HVD303" in str(fault), str(fault)
+        _write(RESULT, {
+            "ok": True, "mode": MODE,
+            "verdict": type(fault).__name__,
+            "dead_ranks": fault.dead_ranks,
+            "hvd303": "HVD303" in str(fault),
+        })
+        print("LEAVE_SEVER_OK", flush=True)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    assert RESULT, "LEAVE_RESULT must point at a writable path"
+    main()

@@ -1,0 +1,446 @@
+"""Closed-loop autoscaling: policy engine, trend gauges, drain plumbing
+(tier-1, no jax, no process spawns).
+
+Covers the jax-free halves of the autoscaling subsystem (ISSUE 10):
+``elastic/autoscale.ScalePolicy`` decision semantics (scripted summaries +
+scripted clock — hysteresis, cooldown, attribution), the monitor
+aggregator's windowed EWMA trend gauges and clean-leave accounting, the
+registry's clean-exit-vs-blacklist classification, the driver's
+discovery-flap debounce (assignments must not churn on a one-poll host
+disappearance) and the DRAIN notification verb.  The end-to-end
+simulated-load scenario lives in ``tests/test_multiprocess.py``.
+"""
+
+import socket
+import time
+
+import pytest
+
+from horovod_tpu.common.exceptions import DrainRequested
+from horovod_tpu.elastic.autoscale import (
+    EVICT, HOLD, SCALE_IN, SCALE_OUT, ScaleDecision, ScalePolicy,
+)
+from horovod_tpu.elastic.discovery import DiscoveredHost, FixedHostDiscovery
+from horovod_tpu.elastic.driver import ElasticDriver
+from horovod_tpu.elastic.registration import LEFT, WorkerStateRegistry
+from horovod_tpu.monitor.aggregator import EwmaTrend, RankAggregator
+
+
+# ---------------------------------------------------------------- EwmaTrend
+def test_ewma_trend_null_until_window_fills_then_signed():
+    t = EwmaTrend(min_samples=3)
+    t.update(10.0)
+    t.update(10.0)
+    assert t.trend is None            # window not filled: policy holds
+    t.update(10.0)
+    assert t.trend == pytest.approx(0.0, abs=0.5)
+    for v in (14.0, 18.0, 24.0):
+        t.update(v)
+    assert t.trend > 0                # rising series: positive trend
+    for v in (6.0, 2.0, 1.0, 0.0, 0.0, 0.0):
+        t.update(v)
+    assert t.trend < 0                # falling series: negative trend
+    t.reset()
+    assert t.trend is None
+
+
+# -------------------------------------------------------------- aggregator
+def _snap(rank, cycle_us=100.0, queue=0, cycle=10, stalled=()):
+    return {"rank": rank, "cycle_us_avg": cycle_us, "cycle": cycle,
+            "last_cycle_age_s": 0.1, "stalled": list(stalled),
+            "metrics": {"hvd_queue_pending": queue}}
+
+
+def test_aggregator_summary_exposes_trend_gauges_and_load():
+    agg = RankAggregator(world=2)
+    s = agg.summary()
+    assert s["cycle_us_spread_trend"] is None       # nulls until filled
+    assert s["queue_depth_trend"] is None
+    assert s["queue_depth"] is None
+    for i in range(8):
+        agg.update(0, _snap(0, cycle_us=100, queue=2 + i, cycle=i))
+        agg.update(1, _snap(1, cycle_us=100 + 10 * i, queue=2 + i, cycle=i))
+    s = agg.summary()
+    assert s["queue_depth"] == 2 * (2 + 7)
+    assert s["cycle_us_spread_trend"] > 0           # spread widening
+    assert s["queue_depth_trend"] > 0               # backlog rising
+    assert s["slowest_rank"] == 1
+    assert s["ranks_reporting"] == 2
+    # Join-epoch flush resets the trend windows with the table.
+    agg.flush()
+    s = agg.summary()
+    assert s["cycle_us_spread_trend"] is None
+    assert s["queue_depth_trend"] is None
+
+
+def test_aggregator_mark_left_keeps_health_ok():
+    """A clean departure (protocol v6) is NOT a degradation: /health stays
+    ok, the rank reports as left, and skew/liveness skip it."""
+    agg = RankAggregator(world=2)
+    agg.update(0, _snap(0))
+    agg.update(1, _snap(1))
+    agg.mark_left(1)
+    h = agg.health(interval_s=5.0)
+    assert h["status"] == "ok", h
+    assert h["ranks"]["1"]["left"] is True
+    assert h["left_ranks"] == [1]
+    assert agg.summary()["left_ranks"] == [1]
+    # skew needs two LIVE ranks; the leaver no longer counts.
+    assert agg.skew()["slowest_rank"] is None
+    # ...and mark_left persists across a join-epoch flush (the departed
+    # rank is still gone in the resumed world).
+    agg.flush()
+    assert agg.left_ranks() == [1]
+
+
+# ------------------------------------------------------------- ScalePolicy
+def _summary(spread=None, slowest=None, per_rank=None, q=0, q_trend=None,
+             progress_total=None):
+    return {"cycle_us_spread": spread, "slowest_rank": slowest,
+            "per_rank_cycle_us": per_rank or {}, "queue_depth": q,
+            "queue_depth_trend": q_trend, "progress_total": progress_total}
+
+
+def test_policy_scale_out_needs_persistent_trend_then_cools_down():
+    p = ScalePolicy(min_np=2, max_np=8, queue_trend_up=4.0, persistence=3,
+                    cooldown_s=30.0)
+    t = 1000.0
+    # Two hot observations: below persistence — hold.
+    for i in range(2):
+        d = p.observe(_summary(q=50, q_trend=10.0, progress_total=i), 2,
+                      now=t + i)
+        assert d.is_hold, d
+    d = p.observe(_summary(q=50, q_trend=10.0, progress_total=3), 2, now=t + 2)
+    assert d.action == SCALE_OUT and d.target_size == 3, d
+    # Cooldown: even a screaming-hot summary holds.
+    d = p.observe(_summary(q=500, q_trend=99.0, progress_total=4), 3,
+                  now=t + 10)
+    assert d.is_hold and d.reason == "cooldown"
+    # After the cooldown the counter restarts from zero (hysteresis).
+    d = p.observe(_summary(q=50, q_trend=10.0, progress_total=5), 3,
+                  now=t + 40)
+    assert d.is_hold
+
+
+def test_policy_null_trends_never_scale():
+    """Unfilled windows (nulls) must hold — a fresh world is not a signal."""
+    p = ScalePolicy(min_np=1, max_np=8, persistence=1, cooldown_s=0.0)
+    for i in range(5):
+        d = p.observe(_summary(q=0, q_trend=None, progress_total=None), 2,
+                      now=100.0 + i)
+        assert d.is_hold, d
+
+
+def test_policy_evicts_persistent_straggler_with_attribution():
+    p = ScalePolicy(min_np=1, straggler_factor=3.0, persistence=3,
+                    cooldown_s=30.0)
+    per_rank = {0: 100.0, 1: 100.0, 2: 900.0}
+    t = 1000.0
+    for i in range(2):
+        d = p.observe(_summary(spread=800, slowest=2, per_rank=per_rank,
+                               progress_total=i), 3, now=t + i)
+        assert d.is_hold, d
+    d = p.observe(_summary(spread=800, slowest=2, per_rank=per_rank,
+                           progress_total=3), 3, now=t + 2)
+    assert d.action == EVICT and d.evict_rank == 2, d
+    # The reason IS the monitor attribution the drain log quotes.
+    assert "rank 2" in d.reason and "900" in d.reason \
+        and "monitor attribution" in d.reason, d.reason
+
+
+def test_policy_straggler_identity_must_be_stable():
+    """A different rank being slowest each observation is noise, not a
+    straggler — the persistence counter tracks ONE rank."""
+    p = ScalePolicy(min_np=1, straggler_factor=2.0, persistence=2,
+                    cooldown_s=0.0)
+    for i, slow in enumerate((0, 1, 2, 0, 1, 2)):
+        per_rank = {r: (500.0 if r == slow else 100.0) for r in range(3)}
+        d = p.observe(_summary(spread=400, slowest=slow, per_rank=per_rank,
+                               progress_total=i), 3, now=100.0 + i)
+        assert d.is_hold, (i, d)
+
+
+def test_policy_scale_in_when_idle_and_respects_min_np():
+    p = ScalePolicy(min_np=2, persistence=1, cooldown_s=0.0, idle_s=10.0)
+    t = 1000.0
+    # Busy (cycle counter advancing): no scale-in however long.
+    for i in range(5):
+        d = p.observe(_summary(q=0, progress_total=i), 3, now=t + 5 * i)
+        assert d.is_hold, d
+    # Idle (no queue, frozen cycle counter): scale in after idle_s.
+    d = p.observe(_summary(q=0, progress_total=4), 3, now=t + 30)
+    assert d.is_hold
+    d = p.observe(_summary(q=0, progress_total=4), 3, now=t + 45)
+    assert d.action == SCALE_IN and d.target_size == 2, d
+    # At min_np: idle forever, never shrink below.
+    p2 = ScalePolicy(min_np=2, cooldown_s=0.0, idle_s=1.0)
+    p2.observe(_summary(q=0, progress_total=1), 2, now=t)
+    d = p2.observe(_summary(q=0, progress_total=1), 2, now=t + 100)
+    assert d.is_hold
+
+
+# ------------------------------------------- clean-exit classification
+def test_registry_record_left_neither_blacklists_nor_succeeds():
+    reg = WorkerStateRegistry()
+    reg.record_left("hostA:0")
+    assert reg.state_of("hostA:0") == LEFT
+    assert not reg.is_blacklisted("hostA")
+    assert reg.success_count() == 0
+    # Control: a failure on the same host DOES blacklist.
+    reg.record_failure("hostA:1")
+    assert reg.is_blacklisted("hostA")
+
+
+class _FakeProc:
+    def __init__(self, rc):
+        self._rc = rc
+        self.pid = 0
+
+    def poll(self):
+        return self._rc
+
+    def terminate(self):
+        pass
+
+
+def _driver(**kw):
+    kw.setdefault("min_np", 1)
+    return ElasticDriver(FixedHostDiscovery([]), ["true"], **kw)
+
+
+def test_driver_reap_classifies_drained_exit_as_left_not_success():
+    d = _driver()
+    d._assigned = {"hostA:0": {"rank": 0}}
+    d._procs["hostA:0"] = _FakeProc(0)
+    d._draining.add("hostA:0")
+    changed = d._reap_exits()
+    assert changed is True                       # world must re-form
+    assert not d._success.is_set()               # NOT the job-success signal
+    assert d.registry.state_of("hostA:0") == LEFT
+    assert not d.registry.is_blacklisted("hostA")
+    # Control A: the same exit WITHOUT the drain mark is job success.
+    d2 = _driver()
+    d2._assigned = {"hostA:0": {"rank": 0}}
+    d2._procs["hostA:0"] = _FakeProc(0)
+    assert d2._reap_exits() is False
+    assert d2._success.is_set()
+    # Control B: a crash blacklists.
+    d3 = _driver()
+    d3._procs["hostA:0"] = _FakeProc(7)
+    assert d3._reap_exits() is True
+    assert d3.registry.is_blacklisted("hostA")
+    assert d3._first_failure_rc == 7
+
+
+# ----------------------------------------------------- discovery flapping
+def test_discovery_flap_does_not_churn_assignments():
+    """A host missing for ONE poll then returning must not change the
+    effective host list (so the driver's change detection never re-forms
+    the world) — and the rank assignment computed over the flapped list
+    is identical."""
+    d = _driver(min_np=2, discovery_interval_s=1.0)   # grace = 2s default
+    full = [DiscoveredHost("hostA", 1), DiscoveredHost("hostB", 1)]
+    eff0 = d._effective_hosts(full, now=100.0)
+    d._hosts = eff0
+    base = [(h.hostname, h.slots) for h in eff0]
+    ranks0 = {i: a["rank"]
+              for i, a in d.compute_assignments(eff0).items()}
+
+    # hostB vanishes for one poll — inside the grace window.
+    flap = d._effective_hosts([full[0]], now=101.0)
+    assert [(h.hostname, h.slots) for h in flap] == base, flap
+    ranks1 = {i: a["rank"]
+              for i, a in d.compute_assignments(flap).items()}
+    assert ranks1 == ranks0                      # zero assignment churn
+
+    # ...and returns: still identical.
+    back = d._effective_hosts(full, now=102.0)
+    assert [(h.hostname, h.slots) for h in back] == base
+
+    # Gone PAST the grace window: now it really drops.
+    gone = d._effective_hosts([full[0]], now=110.0)
+    assert [(h.hostname, h.slots) for h in gone] == [("hostA", 1)]
+
+    # A NEW host joins immediately — growth is never debounced.
+    grown = d._effective_hosts(full + [DiscoveredHost("hostC", 1)],
+                               now=111.0)
+    assert ("hostC", 1) in [(h.hostname, h.slots) for h in grown]
+
+
+def test_cordoned_host_excluded_like_blacklist_but_clean():
+    d = _driver(min_np=1)
+    hosts = [DiscoveredHost("hostA", 1), DiscoveredHost("hostB", 1)]
+    d.cordon("hostB")
+    active = d.active_hosts(hosts)
+    assert [h.hostname for h in active] == ["hostA"]
+    assert not d.registry.is_blacklisted("hostB")
+
+
+# ------------------------------------------------------------- DRAIN verb
+def test_drain_verb_raises_drain_requested_at_commit_point():
+    """Driver → worker drain plumbing: the DRAIN ping lands in the
+    notification manager and surfaces as DrainRequested from the next
+    raise_if_updated() (the state.commit() check point), outranking a
+    pending host update."""
+    from horovod_tpu.elastic.worker import WorkerNotificationManager
+
+    mgr = WorkerNotificationManager()          # no rendezvous env: local
+    try:
+        port = mgr._service.port
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=5) as s:
+            s.sendall(b"DRAIN\n")
+        deadline = time.monotonic() + 5
+        fired = False
+        while time.monotonic() < deadline:
+            try:
+                mgr.raise_if_updated()
+            except DrainRequested:
+                fired = True
+                break
+            time.sleep(0.02)
+        assert fired, "DRAIN ping never surfaced as DrainRequested"
+        # One-shot: the next check is clean.
+        mgr.raise_if_updated()
+    finally:
+        mgr._service.stop()
+
+
+def test_autoscale_step_executes_evict_through_drain_and_cordon():
+    """Driver-side decision execution: an EVICT decision cordons the
+    straggler's host and (with no live proc/notification port) falls back
+    to released-termination — never a blacklist — and the event log
+    records the attribution."""
+    decisions = iter([
+        ScaleDecision(EVICT, reason="persistent straggler; monitor "
+                      "attribution: rank 1 slowest", evict_rank=1),
+        ScaleDecision(HOLD),
+    ])
+
+    class _Policy:
+        min_np = 1
+
+        def observe(self, summary, size, now=None):
+            return next(decisions)
+
+    d = _driver(autoscale_policy=_Policy(),
+                autoscale_source=lambda: {"any": "summary"})
+    d._assigned = {
+        "hostA:0": {"rank": 0, "hostname": "hostA"},
+        "hostB:0": {"rank": 1, "hostname": "hostB"},
+    }
+    d._autoscale_step()
+    assert d._cordoned == {"hostB"}
+    assert len(d.events) == 1
+    ev = d.events[0]
+    assert ev["action"] == EVICT and ev["identity"] == "hostB:0"
+    assert "monitor attribution" in ev["reason"]
+    assert not d.registry.is_blacklisted("hostB")
+    # Second step: hold → no new event.
+    d._autoscale_step()
+    assert len(d.events) == 1
+
+
+# --------------------------------------------------- review-pass regressions
+def test_policy_unobserved_load_never_reads_as_idle():
+    """A summary with NO load telemetry at all (queue_depth and
+    progress_total both None — exporter up, aggregation table empty) is
+    UNKNOWN, not idle: the idle timer must not accrue toward draining a
+    fleet whose load was never observed."""
+    p = ScalePolicy(min_np=1, persistence=1, cooldown_s=0.0, idle_s=5.0)
+    for i in range(20):
+        d = p.observe(_summary(q=None, progress_total=None), 3,
+                      now=100.0 + 10.0 * i)
+        assert d.is_hold, (i, d)
+    # Control: the same cadence WITH observed idleness does scale in
+    # (first observation primes the progress baseline, second starts the
+    # idle timer, third crosses idle_s).
+    p2 = ScalePolicy(min_np=1, persistence=1, cooldown_s=0.0, idle_s=5.0)
+    p2.observe(_summary(q=0, progress_total=7), 3, now=100.0)
+    p2.observe(_summary(q=0, progress_total=7), 3, now=110.0)
+    d = p2.observe(_summary(q=0, progress_total=7), 3, now=120.0)
+    assert d.action == SCALE_IN, d
+
+
+def test_host_granular_min_np_guard_blocks_scale_in_and_evict():
+    """The policy approves scale decisions from RANK counts, but retiring
+    a host removes ALL its slots: with 2x2-slot hosts and min_np=3, both
+    scale_in and evict must be skipped or the next regeneration would
+    abort the whole job below min_np."""
+    for action_decision in (
+            ScaleDecision(SCALE_IN, reason="idle", target_size=3),
+            ScaleDecision(EVICT, reason="monitor attribution: rank 2",
+                          evict_rank=2)):
+        decisions = iter([action_decision])
+
+        class _Policy:
+            def observe(self, summary, size, now=None):
+                return next(decisions)
+
+        d = _driver(min_np=3, autoscale_policy=_Policy(),
+                    autoscale_source=lambda: {"any": "summary"})
+        d._assigned = {
+            "hostA:0": {"rank": 0, "hostname": "hostA"},
+            "hostA:1": {"rank": 1, "hostname": "hostA"},
+            "hostB:0": {"rank": 2, "hostname": "hostB"},
+            "hostB:1": {"rank": 3, "hostname": "hostB"},
+        }
+        d._autoscale_step()
+        assert d.events == [], (action_decision.action, d.events)
+        assert d._cordoned == set(), (action_decision.action, d._cordoned)
+
+
+def test_evict_fallback_terminates_as_draining_and_regenerates():
+    """An unreachable drain target (no notification port) falls back to
+    termination marked DRAINING — so the reap classifies it LEFT and
+    TRIGGERS the regeneration (a 'released' exit is silently skipped,
+    which would leave survivors waiting on a generation that never
+    forms)."""
+    decisions = iter([ScaleDecision(
+        EVICT, reason="monitor attribution: rank 1", evict_rank=1)])
+
+    class _Policy:
+        def observe(self, summary, size, now=None):
+            return next(decisions)
+
+    class _LiveProc(_FakeProc):
+        def __init__(self):
+            super().__init__(None)
+            self.terminated = False
+
+        def terminate(self):
+            self.terminated = True
+            self._rc = -15
+
+    d = _driver(min_np=1, autoscale_policy=_Policy(),
+                autoscale_source=lambda: {"any": "summary"})
+    d._assigned = {
+        "hostA:0": {"rank": 0, "hostname": "hostA"},
+        "hostB:0": {"rank": 1, "hostname": "hostB"},
+    }
+    proc = _LiveProc()
+    d._procs["hostB:0"] = proc
+    d._autoscale_step()
+    assert proc.terminated
+    assert "hostB:0" in d._draining and "hostB:0" not in d._released
+    # The reap must classify it as a departure AND demand regeneration.
+    assert d._reap_exits() is True
+    assert d.registry.state_of("hostB:0") == LEFT
+    assert not d.registry.is_blacklisted("hostB")
+
+
+def test_effective_hosts_preserves_discovery_order_for_new_hosts():
+    """The first generation (and any batch of newcomers) must keep the
+    DISCOVERY order — the documented hostfile-order rank/coordinator
+    placement — not an alphabetical resort."""
+    d = _driver(min_np=1, discovery_interval_s=1.0)
+    disc = [DiscoveredHost("node-b", 4), DiscoveredHost("node-a", 4)]
+    eff = d._effective_hosts(disc, now=100.0)
+    assert [h.hostname for h in eff] == ["node-b", "node-a"]
+    d._hosts = eff
+    # Newcomers land AFTER the established order, in discovery order.
+    disc2 = [DiscoveredHost("node-z", 1), DiscoveredHost("node-b", 4),
+             DiscoveredHost("node-a", 4), DiscoveredHost("node-c", 1)]
+    eff2 = d._effective_hosts(disc2, now=101.0)
+    assert [h.hostname for h in eff2] == ["node-b", "node-a", "node-z",
+                                          "node-c"]

@@ -82,6 +82,14 @@ def test_bench_minimal_mode():
     # largest world the flat root does multiples of the hierarchical
     # root's serialized per-round work (128 connections vs 8).
     assert ns["sizes"]["128"]["flat_vs_hier"] > 1.5, ns
+    # Autoscale section (ISSUE 10) on every line: policy decision latency
+    # plus the clean-LEAVE drain round-trip through a real native server —
+    # the survivor must actually OBSERVE the leave notice.
+    asc = out["autoscale"]
+    assert asc["decision_us"] > 0, asc
+    assert asc["leave_sent"] is True, asc
+    assert asc["left_observed"] is True, asc
+    assert asc["drain_roundtrip_us"] > 0, asc
 
 
 def test_bench_default_resnet():

@@ -70,6 +70,18 @@ def test_self_lint_covers_trace_package():
     assert not findings, "\n".join(f.render() for f in findings)
 
 
+def test_self_lint_covers_autoscale_stack():
+    """Explicit coverage for the autoscaling subsystem (ISSUE 10): the
+    policy engine and the driver/registration/worker layers it drives
+    must parse and lint clean."""
+    el_dir = os.path.join(REPO, "horovod_tpu", "elastic")
+    files = {f for f in os.listdir(el_dir) if f.endswith(".py")}
+    assert {"autoscale.py", "driver.py", "registration.py",
+            "worker.py"} <= files, files
+    findings = lint_paths([el_dir])
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
 def test_allowlist_entries_still_fire():
     """Stale allowlist entries (fixed code, moved lines) must be pruned."""
     findings = lint_paths([os.path.join(REPO, "horovod_tpu"),

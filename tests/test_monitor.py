@@ -486,6 +486,17 @@ importlib.import_module('horovod_tpu.common.net')
 # Hierarchical control plane: the per-host aggregation agent runs in
 # launcher-adjacent processes and the jax-free negotiation test tier.
 importlib.import_module('horovod_tpu.common.host_agent')
+# Closed-loop autoscaling: the REAL elastic package surface (state objects
+# load lazily via PEP 562), the policy engine, the elastic driver (which
+# hosts it) and the worker notification layer all run in the LAUNCHER
+# process and the synthetic-load acceptance workers — none may drag jax
+# in.  NB: horovod_tpu.elastic is imported for real, not shelled — the
+# lazy __init__ IS the thing under test.
+importlib.import_module('horovod_tpu.elastic')
+importlib.import_module('horovod_tpu.elastic.autoscale')
+importlib.import_module('horovod_tpu.elastic.driver')
+importlib.import_module('horovod_tpu.elastic.worker')
+importlib.import_module('horovod_tpu.elastic.rendezvous')
 print('PURITY_OK')
 """
 

@@ -779,3 +779,220 @@ def test_torovodrun_sanitizer_catches_divergence_on_cached_path():
     assert res.returncode == 0 and ok == 2, (
         f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
         f"stderr:\n{res.stderr[-3000:]}")
+
+
+WORKER_LEAVE = os.path.join(REPO, "tests", "data", "worker_leave.py")
+
+
+def _leave_env(result, mode):
+    return {
+        "LEAVE_MODE": mode,
+        "LEAVE_RESULT": str(result),
+        "HOROVOD_ROUND_TIMEOUT_S": "30",
+        "HOROVOD_MONITOR": "1",
+        "HOROVOD_MONITOR_INTERVAL": "0.2",
+    }
+
+
+def _assert_clean_leave(res, result):
+    import json
+    assert res.returncode == 0, (
+        f"clean LEAVE must not fail the launch (rc={res.returncode})\n"
+        f"stdout:\n{res.stdout[-3000:]}\nstderr:\n{res.stderr[-3000:]}")
+    assert result.exists(), (
+        f"rank 0 never recorded the leave\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+    data = json.loads(result.read_text())
+    assert data["ok"] and data["mode"] == "clean", data
+    assert data["verdict"] == "PeerLeftInterrupt", data
+    assert data["left_ranks"] == [1], data
+    assert data["fault"] is None, data
+    assert data["health_status"] == "ok", data
+    assert data["health_left"] == [1], data
+    with open(str(result) + ".r1") as fh:
+        r1 = json.load(fh)
+    assert r1["ok"] and r1["leave_sent"] is True, r1
+
+
+def test_torovodrun_clean_leave_vs_sever(tmp_path):
+    """ISSUE 10 acceptance (both halves, one worker script): a worker that
+    sends the protocol-v6 LEAVE mid-run exits 0 with the survivor
+    continuing — PeerLeftInterrupt (a HostsUpdatedInterrupt), engine.fault
+    None, /health ok with rank 1 reported left, launcher rc 0 — while the
+    SAME sever without a LEAVE frame still produces the typed attributed
+    HVD303 abort naming rank 1.  The frame, not timing luck, is what
+    disambiguates."""
+    import json
+    # Half 1: clean.
+    result = tmp_path / "leave_clean.json"
+    res = _run_torovodrun(2, WORKER_LEAVE, timeout=300,
+                          extra_env=_leave_env(result, "clean"))
+    _assert_clean_leave(res, result)
+
+    # Half 2: the control — same departure point, no LEAVE frame.
+    result2 = tmp_path / "leave_sever.json"
+    res2 = _run_torovodrun(2, WORKER_LEAVE, timeout=300,
+                           extra_env=_leave_env(result2, "sever"))
+    assert res2.returncode != 0, (
+        "the unclean sever must fail the launch\n"
+        f"stdout:\n{res2.stdout[-2000:]}")
+    assert result2.exists(), (
+        f"rank 0 never recorded the typed abort\nstdout:\n"
+        f"{res2.stdout[-3000:]}\nstderr:\n{res2.stderr[-3000:]}")
+    data = json.loads(result2.read_text())
+    assert data["ok"] and data["mode"] == "sever", data
+    assert data["verdict"] == "PeerFailureError", data
+    assert data["dead_ranks"] == [1] and data["hvd303"], data
+
+
+def test_torovodrun_clean_leave_hierarchical(tmp_path):
+    """The PR 8 follow-up, end to end: the same clean LEAVE through the
+    per-host agent (protocol v5 + v6 composed) — the host's uplink
+    shrinks, the survivor continues, /health stays ok."""
+    result = tmp_path / "leave_hier.json"
+    res = _run_torovodrun(2, WORKER_LEAVE, timeout=300,
+                          extra_args=("--hierarchical-controller",),
+                          extra_env=_leave_env(result, "clean"))
+    _assert_clean_leave(res, result)
+
+
+WORKER_AUTOSCALE = os.path.join(REPO, "tests", "data",
+                                "worker_autoscale.py")
+
+
+@pytest.mark.parametrize("hier", [False, True], ids=["flat", "hier"])
+def test_autoscale_simulated_load_scenario(tmp_path, hier):
+    """ISSUE 10 acceptance: the closed loop, end to end, over real
+    processes and the real wire stack (rendezvous + native lock-step
+    negotiation — flat and through real per-host agents — + MON1 monitor
+    aggregation + rank-0 /health + DRAIN pings + protocol-v6 LEAVEs):
+
+    traffic ramp → policy scales OUT (scale command adds a host, the
+    world grows) → injected straggler → policy EVICTS it with monitor
+    attribution (drain → clean LEAVE → exit 0, host cordoned, never
+    blacklisted) → world heals → idle → policy scales IN → the run ends
+    with every worker exiting 0 and the driver returning success."""
+    import json
+    import threading as _threading
+    import time as _time
+
+    from horovod_tpu.common.net import free_ports
+    from horovod_tpu.elastic.autoscale import ScalePolicy
+    from horovod_tpu.elastic.discovery import HostDiscoveryScript
+    from horovod_tpu.elastic.driver import ElasticDriver
+
+    sdir = tmp_path / "autoscale"
+    sdir.mkdir()
+    hosts = tmp_path / "hosts"
+    hosts.write_text("127.0.0.1:1\n127.0.0.2:1\n")
+    (sdir / "load").write_text("0")
+    (sdir / "straggler").write_text("")
+    scale_sh = tmp_path / "scale.sh"
+    scale_sh.write_text(f"""#!/bin/sh
+case "$HVD_AUTOSCALE_ACTION" in
+  scale_out)
+    grep -q '^127.0.0.3:' {hosts} || echo '127.0.0.3:1' >> {hosts} ;;
+  evict|scale_in)
+    grep -v "^$HVD_AUTOSCALE_HOST:" {hosts} > {hosts}.tmp
+    mv {hosts}.tmp {hosts} ;;
+esac
+""")
+    scale_sh.chmod(0o755)
+
+    (monitor_port,) = free_ports(1)
+    env = {k: v for k, v in os.environ.items()}
+    other_paths = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p and "axon" not in p]
+    extra_env = {
+        "PYTHONPATH": os.pathsep.join([REPO] + other_paths),
+        "AUTOSCALE_DIR": str(sdir),
+        "HOROVOD_MONITOR_PORT": str(monitor_port),
+    }
+    if hier:
+        extra_env["HOROVOD_HIERARCHICAL_CONTROLLER"] = "1"
+
+    policy = ScalePolicy(min_np=1, max_np=3, queue_high=10.0,
+                         queue_trend_up=1e9,   # absolute threshold drives
+                         straggler_factor=3.0, persistence=2,
+                         cooldown_s=2.0, idle_s=2.0)
+    d = ElasticDriver(
+        HostDiscoveryScript(f"cat {hosts}"),
+        [sys.executable, WORKER_AUTOSCALE],
+        min_np=1, max_np=3, env=extra_env,
+        discovery_interval_s=0.25, start_timeout_s=120,
+        autoscale_policy=policy, autoscale_interval_s=0.4,
+        scale_command=f"sh {scale_sh}", verbose=1)
+
+    rc = {}
+    t = _threading.Thread(target=lambda: rc.update(code=d.run()),
+                          daemon=True)
+    t.start()
+
+    def wait_for(cond, what, timeout=60):
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if cond():
+                return
+            if rc:
+                raise AssertionError(
+                    f"driver exited rc={rc} while waiting for {what}; "
+                    f"events={d.events}")
+            _time.sleep(0.1)
+        raise AssertionError(f"timed out waiting for {what}; "
+                             f"events={d.events} assigned="
+                             f"{sorted(d._assigned)} procs="
+                             f"{sorted(d._procs)}")
+
+    try:
+        # Phase 0: the initial 2-host world forms.
+        wait_for(lambda: len(d._procs) == 2, "initial world")
+
+        # Phase 1: traffic ramp → scale out → the world grows to 3.
+        (sdir / "load").write_text("40")
+        wait_for(lambda: any(e["action"] == "scale_out"
+                             for e in d.events), "scale_out decision")
+        wait_for(lambda: len(d._assigned) == 3 and len(d._procs) == 3,
+                 "world grown to 3")
+
+        # Phase 2: straggler injected on rank 1 → attributed evict →
+        # drain → clean exit → the world heals WITHOUT 127.0.0.2.
+        straggler_identity = next(
+            i for i, a in d._assigned.items() if a["rank"] == 1)
+        straggler_host = d._assigned[straggler_identity]["hostname"]
+        (sdir / "straggler").write_text("1")
+        wait_for(lambda: any(e["action"] == "evict" for e in d.events),
+                 "evict decision")
+        ev = next(e for e in d.events if e["action"] == "evict")
+        assert ev["evict_rank"] == 1, ev
+        assert ev["host"] == straggler_host, ev
+        assert "monitor attribution" in ev["reason"], ev["reason"]
+        (sdir / "straggler").write_text("")
+        wait_for(lambda: straggler_host in d._cordoned
+                 and len(d._assigned) == 2
+                 and straggler_host not in
+                 {a["hostname"] for a in d._assigned.values()},
+                 "world healed without the straggler")
+        assert not d.registry.is_blacklisted(straggler_host)
+        assert d.registry.state_of(straggler_identity) == "LEFT"
+
+        # Phase 3: idle → scale in → the world shrinks.
+        (sdir / "load").write_text("0")
+        wait_for(lambda: any(e["action"] == "scale_in"
+                             for e in d.events), "scale_in decision")
+        wait_for(lambda: len(d._assigned) == 1, "world shrunk to 1")
+
+        # Phase 4: done → every worker exits 0 → driver succeeds.
+        (sdir / "done").write_text("1")
+        t.join(timeout=60)
+        assert not t.is_alive(), "driver never finished"
+        assert rc.get("code") == 0, (rc, d.events)
+
+        actions = [e["action"] for e in d.events]
+        assert actions.index("scale_out") < actions.index("evict") \
+            < actions.index("scale_in"), actions
+        # Clean departures only: nothing was ever blacklisted.
+        assert d.registry.blacklist() == set(), d.registry.blacklist()
+    finally:
+        (sdir / "done").write_text("1")
+        _time.sleep(0.5)
+        d._shutdown_workers()

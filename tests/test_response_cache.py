@@ -446,3 +446,112 @@ def test_matching_tags_stay_ready_on_cached_path():
         return True
 
     _pair(fn)
+
+
+# ------------------------------------------------------- clean LEAVE (v6)
+def test_v6_leave_ad_round1_gated_and_warm_path_unchanged():
+    """Protocol-v6 frame guard: the clean-LEAVE machinery costs ZERO warm
+    bytes — the LVE6 capability ad rides round 1 only (request side
+    between AGG5 and the final FLT1; response side after AGG5), and the
+    steady-state frame stays the exact pre-v6 13 bytes."""
+
+    def fn(ctl, rank):
+        assert not ctl.peer_leave_proto
+        _steps(ctl, lambda: [E("t")], 2)            # warm-up: learn slot
+        # Round 1's response carried the server's v6 ad.
+        assert ctl.peer_leave_proto
+        assert ctl.left_ranks == []
+        bytes_before = ctl.bytes_sent
+        rounds_before = ctl.rounds
+        _steps(ctl, lambda: [E("t")], 4)
+        per_round = ((ctl.bytes_sent - bytes_before)
+                     / (ctl.rounds - rounds_before))
+        assert per_round == 13, (
+            f"warm-path frame grew to {per_round}B — the v6 clean-LEAVE "
+            f"fields must cost zero warm bytes")
+        return True
+
+    _pair(fn)
+
+
+def test_v6_clean_leave_drops_rank_without_abort():
+    """THE clean-LEAVE semantics, at the wire level: rank 1 finishes its
+    work, sends LEAVE, severs.  Rank 0 sees a leave NOTICE — not a
+    dead-peer abort — and its subsequent world-level announce resolves
+    over the shrunk effective world."""
+    import time as _time
+    left_evt = threading.Event()
+
+    def fn(ctl, rank):
+        _steps(ctl, lambda: [E("warm")], 2)
+        assert ctl.peer_leave_proto
+        if rank == 1:
+            # All work resolved: the LEAVE must be accepted locally...
+            assert ctl.leave() is True
+            assert ctl.leave_sent
+            left_evt.set()
+            return "left"
+        # rank 0: keep the lock-step rounds turning until the notice lands.
+        assert left_evt.wait(10)
+        for _ in range(500):
+            ctl.negotiate([])          # must NOT raise PeerFailureError
+            if ctl.left_ranks:
+                break
+            _time.sleep(0.005)
+        assert ctl.left_ranks == [1], ctl.left_ranks
+        # World-level work now resolves over the shrunk world (the ENGINE
+        # poisons these verdicts client-side; the controller itself keeps
+        # the protocol alive for the survivor).
+        ready, errs = ctl.negotiate([E("after.leave")])
+        assert not errs
+        assert [e.name for e in ready] == ["after.leave"]
+        return "survived"
+
+    res = _pair(fn)
+    assert res == {0: "survived", 1: "left"}
+
+
+def test_v6_leave_with_outstanding_work_gets_typed_abort():
+    """The ONE abort case: a rank that sends LEAVE while it still has
+    outstanding negotiated work (a pending tensor it announced) gets the
+    fleet a typed ABORT naming it — readiness would otherwise include a
+    rank that will never execute.  The client-side leave() refuses this
+    locally (announced-work guard), so the frame is forged raw."""
+    import ctypes as _ctypes
+    import struct as _struct
+    import time as _time
+
+    from horovod_tpu.common.controller import _LEAVE_ESCAPE, _LVE_MAGIC
+    from horovod_tpu.common.exceptions import PeerFailureError
+
+    sent_evt = threading.Event()
+
+    def fn(ctl, rank):
+        _steps(ctl, lambda: [E("warm")], 2)
+        if rank == 1:
+            # Announce work rank 0 never submits, then a raw LEAVE: the
+            # local guard would refuse leave() here — assert that too.
+            ctl.negotiate([E("solo.only.on.1")])
+            assert ctl.leave() is False, "leave() must refuse with work out"
+            req = _struct.pack("<II", _LEAVE_ESCAPE, _LVE_MAGIC)
+            buf = (_ctypes.c_uint8 * len(req)).from_buffer_copy(req)
+            assert ctl._lib.hvdtpu_client_send(ctl._client, buf,
+                                               len(req)) == 0
+            sent_evt.set()
+            _time.sleep(1.0)           # let rank 0 read the abort
+            return "left-dirty"
+        # rank 0 keeps the lock-step rounds turning THROUGHOUT — rank 1's
+        # solo announce needs a frame from this rank too — until the
+        # typed verdict lands.
+        try:
+            for _ in range(2000):
+                ctl.negotiate([])
+                _time.sleep(0.002)
+            raise AssertionError("no abort after dirty LEAVE")
+        except PeerFailureError as exc:
+            assert exc.dead_ranks == [1]
+            assert "LEAVE" in str(exc) and "outstanding" in str(exc)
+        return "aborted"
+
+    res = _pair(fn)
+    assert res == {0: "aborted", 1: "left-dirty"}
