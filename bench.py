@@ -220,7 +220,25 @@ def _control_plane_stats():
     # data, not zero latency.
     tracer = getattr(eng, "tracer", None)
     trace = tracer.phase_summary() if tracer is not None else None
+    # Zero-RTT warm path (protocol v7): speculation outcomes + the
+    # in-flight round window, so the trajectory shows whether the warm
+    # cycle actually dropped its round trip this run.  Nulls without a
+    # controller (single-controller mode has no negotiation round).
+    spec_hits = getattr(ctl, "spec_hits", 0) if ctl is not None else 0
+    spec_miss = getattr(ctl, "spec_mispredicts", 0) if ctl is not None else 0
+    zero_rtt = {
+        "spec_hits": spec_hits if ctl is not None else None,
+        "spec_mispredicts": spec_miss if ctl is not None else None,
+        "spec_rounds": getattr(ctl, "spec_rounds", None)
+            if ctl is not None else None,
+        "spec_hit_rate": (round(spec_hits / (spec_hits + spec_miss), 4)
+                          if spec_hits + spec_miss else None),
+        "spec_cycles": getattr(eng, "spec_cycles", 0) or None,
+        "inflight_rounds": getattr(ctl, "inflight_high_water", None)
+            if ctl is not None else None,
+    }
     return {"negotiation_us_per_cycle": per_cycle,
+            "zero_rtt": zero_rtt,
             "response_cache_hit_rate":
                 round(rate, 4) if rate is not None else None,
             "chunks_per_cycle": chunks,
@@ -266,7 +284,7 @@ def _negotiation_world(world, ranks_per_host, rounds, warm=5):
     lib = _load()
     port = free_port()
     server = lib.hvdtpu_server_start(port, world, ctypes.c_double(600.0),
-                                     2048, 0)
+                                     2048, 0, 0)
     if not server:
         raise RuntimeError(f"bench server failed to start on port {port}")
     agents = []
@@ -533,6 +551,138 @@ def bench_autoscale(errors=None):
         out["drain_roundtrip_us"] = round(
             (result["t_seen"] - result["t_leave"]) * 1e6, 1)
     _record_timing("autoscale", warmup=2, iters=n_obs,
+                   wall_s=time.perf_counter() - t_section)
+    return out
+
+
+def bench_zero_rtt(errors=None, world=4, warm=6, cycles=40, n_tensors=8):
+    """Zero-RTT warm control plane A/B (ISSUE 11): a simulated world of
+    REAL ``TCPController`` clients against the native root server, driven
+    through warm steady-state cycles with speculation ON
+    (``spec_ready_after=1``) vs OFF (0, today's lock-step).  Per knob:
+    warm-cycle negotiation microseconds, speculation hit rate, and the
+    negotiation round TRIPS per cycle (a speculative cycle sends its
+    frame but returns the predicted verdict without waiting — the claim
+    under test is trips < 1 in steady state).  ``orders_identical`` pins
+    the bitwise story: every rank's verdict order, on-vs-off, must be
+    identical — speculation may only remove the wait, never reorder
+    dispatch.  Rank-0 only, self-contained (own server on a free port),
+    jax-free."""
+    if os.environ.get("HOROVOD_RANK", "0") not in ("", "0"):
+        return None
+    import threading as _threading
+
+    import numpy as np
+
+    from horovod_tpu.common.controller import TCPController
+    from horovod_tpu.common.net import free_ports
+
+    names = [f"zrt.grad.{i}" for i in range(n_tensors)]
+
+    class _E:
+        def __init__(self, name):
+            self.name = name
+            self.tensor = np.zeros((2, 4), np.float32)
+            self.group_id = -1
+
+    def run_world(spec):
+        port = free_ports(1)[0]
+        results, errs = {}, {}
+        all_done = _threading.Event()
+
+        def worker(rank):
+            ctl = TCPController("127.0.0.1", port, rank=rank, world=world,
+                                stall_warn_s=600.0, cache_capacity=256,
+                                spec_ready_after=spec)
+            try:
+                orders = []
+
+                def step():
+                    entries = [_E(n) for n in names]
+                    got = []
+                    for _ in range(60):
+                        if not entries:
+                            break
+                        ready, _e2 = ctl.negotiate(entries)
+                        got += [e.name for e in ready]
+                        entries = [e for e in entries
+                                   if e.name not in set(got)]
+                    orders.append(tuple(got))
+
+                for _ in range(warm):
+                    step()
+                s0, h0, m0, r0 = (ctl.spec_rounds, ctl.spec_hits,
+                                  ctl.spec_mispredicts, ctl.rounds)
+                t0 = time.perf_counter()
+                for _ in range(cycles):
+                    step()
+                dt = time.perf_counter() - t0
+                results[rank] = {
+                    "us_per_cycle": dt / cycles * 1e6,
+                    "rounds": ctl.rounds - r0,
+                    "spec_rounds": ctl.spec_rounds - s0,
+                    "spec_hits": ctl.spec_hits - h0,
+                    "spec_mispredicts": ctl.spec_mispredicts - m0,
+                    "orders": orders,
+                }
+            except Exception as exc:  # noqa: BLE001 - recorded, never hangs
+                errs[rank] = repr(exc)
+            finally:
+                if len(results) + len(errs) == world:
+                    all_done.set()
+                all_done.wait(timeout=60)
+                ctl.shutdown()
+
+        threads = [_threading.Thread(target=worker, args=(r,), daemon=True)
+                   for r in range(1, world)]
+        for t in threads:
+            t.start()
+        worker(0)
+        for t in threads:
+            t.join(timeout=60)
+        if errs:
+            raise RuntimeError(f"zero_rtt world failed: {errs}")
+        return results
+
+    t_section = time.perf_counter()
+    res_on = run_world(1)
+    res_off = run_world(0)
+
+    def agg(res, key):
+        return round(sum(r[key] for r in res.values()) / len(res), 2)
+
+    hits = sum(r["spec_hits"] for r in res_on.values())
+    miss = sum(r["spec_mispredicts"] for r in res_on.values())
+    trips_on = (sum(r["rounds"] - r["spec_rounds"]
+                    for r in res_on.values())
+                / max(1, sum(r["rounds"] for r in res_on.values())))
+    on_orders = [r["orders"] for r in res_on.values()]
+    off_orders = [r["orders"] for r in res_off.values()]
+    out = {
+        "world": world, "cycles": cycles, "tensors": n_tensors,
+        "negotiation_us_per_cycle_on": agg(res_on, "us_per_cycle"),
+        "negotiation_us_per_cycle_off": agg(res_off, "us_per_cycle"),
+        "spec_rounds": sum(r["spec_rounds"] for r in res_on.values()),
+        "spec_hits": hits,
+        "spec_mispredicts": miss,
+        "spec_hit_rate": (round(hits / (hits + miss), 4)
+                          if hits + miss else None),
+        # Round trips the warm cycle still pays with speculation on
+        # (1.0 = every cycle lock-stepped; the acceptance bar is < 1).
+        "round_trips_per_cycle_on": round(trips_on, 4),
+        "round_trips_per_cycle_off": 1.0,
+        # Every rank's verdict order, on-vs-off: identical = speculation
+        # changed WHEN verdicts returned, never what or in what order.
+        "orders_identical": (
+            all(o == on_orders[0] for o in on_orders)
+            and all(o == off_orders[0] for o in off_orders)
+            and on_orders[0] == off_orders[0]),
+    }
+    off_us = out["negotiation_us_per_cycle_off"]
+    if off_us:
+        out["speedup"] = round(off_us / out["negotiation_us_per_cycle_on"],
+                               3)
+    _record_timing("zero_rtt_ab", warmup=warm, iters=cycles * 2,
                    wall_s=time.perf_counter() - t_section)
     return out
 
@@ -1888,6 +2038,10 @@ def _run(out, errors):
             out["autoscale"] = bench_autoscale(errors=errors)
         except Exception as exc:  # noqa: BLE001 - contained
             errors["autoscale"] = repr(exc)
+        try:
+            out["zero_rtt_ab"] = bench_zero_rtt(errors=errors)
+        except Exception as exc:  # noqa: BLE001 - contained
+            errors["zero_rtt_ab"] = repr(exc)
         return
 
     if model == "llama":
@@ -2012,6 +2166,11 @@ def _run(out, errors):
         out["autoscale"] = bench_autoscale(errors=errors)
     except Exception as exc:  # noqa: BLE001 - contained
         errors["autoscale"] = repr(exc)
+
+    try:
+        out["zero_rtt_ab"] = bench_zero_rtt(errors=errors)
+    except Exception as exc:  # noqa: BLE001 - contained
+        errors["zero_rtt_ab"] = repr(exc)
 
     if os.environ.get("HVD_BENCH_SKIP_AUTOTUNE", "") != "1":
         try:

@@ -143,6 +143,42 @@
 //              Pre-v6 clients stop their trailing walk at the unknown
 //              magic and lose nothing.)
 //
+//   [protocol v7, zero-RTT warm path] uint32 magic "ZRT7"
+//             Speculative readiness: when a cache slot has been
+//             ready-on-first-announce for spec_ready_after consecutive
+//             rounds (hvdtpu_server_start's last arg; 0 = off), the server
+//             piggybacks a PREDICTED next-round ready verdict on this
+//             round's response:
+//               S->C   += uint32 "ZRT7", uint32 len,
+//                         uint32 n_pred, n_pred * uint32 slot
+//             (appended only on rounds that actually predict — the warm
+//              path with speculation off carries zero extra bytes — plus
+//              an empty (n_pred = 0) section on round 1 as the capability
+//              ad, after the LVE6 ad so pre-v7 clients latch everything
+//              older before their trailing walk stops.)  A client whose
+//              ENTIRE next-round announce is exactly the predicted slot
+//              set may then dispatch the verdict without waiting for the
+//              response: it sends the round frame with a one-byte confirm
+//              section appended —
+//               C->S   += uint32 "ZRT7", uint32 1, uint8 1
+//              — and defers reading the response to the start of its next
+//              round (the zero-RTT skip; the v4 abort and LVE6 notices a
+//              deferred response may carry are honored there, one round
+//              late, bounded by the client's in-flight window).  The
+//              request-side ad is an empty ZRT7 section on round 1,
+//              between LVE6 and the final FLT1.  Predictions are only
+//              emitted while EVERY rank has latched v7 (no wire bytes
+//              change for old peers), no rank is joined, and no rank left
+//              this round.  A mispredict (a predicted slot not ready next
+//              round — a rank skipped a cycle, or any slot-invalidation
+//              event: digest change, eviction, join epoch, LEAVE) resets
+//              the slot's streak, so speculation disengages and the
+//              verdict resolves through normal full rounds until the
+//              streak rebuilds; the speculating client merely consumed a
+//              verdict early — its announce stays pending server-side and
+//              the late real verdict is absorbed by its next entry, so
+//              results stay bitwise identical.
+//
 //   AGENT  := a per-host aggregator (horovod_tpu/common/host_agent.py) may
 //             connect IN PLACE of its host's ranks: handshake word
 //             0xFFFFFF05 ("v5 agent hello", outside the rank space), then
@@ -215,7 +251,7 @@
 //
 // Exported C ABI (ctypes-consumed by horovod_tpu/common/native.py):
 //   hvdtpu_server_start(port, world, stall_warn_s, cache_capacity,
-//                       round_deadline_ms) -> handle
+//                       round_deadline_ms, spec_ready_after) -> handle
 //   hvdtpu_server_stop(handle)
 //   hvdtpu_client_connect(host, port, rank, timeout_ms) -> handle
 //   hvdtpu_client_round(handle, req, req_len, resp_buf, resp_cap) -> resp_len
@@ -280,6 +316,10 @@ constexpr uint32_t kHupMagic = 0x35505548;
 // the "LVE6" magic that doubles as the capability ad in both directions.
 constexpr uint32_t kLeaveEscape = 0xfffffffeu;
 constexpr uint32_t kLeaveMagic = 0x3645564c;
+// Zero-RTT warm path (protocol v7): "ZRT7" doubles as the round-1
+// capability ad (both directions), the response-side prediction section
+// marker, and the request-side one-byte speculation confirm.
+constexpr uint32_t kZrtMagic = 0x3754525a;
 
 // A standalone clean-LEAVE frame: { kLeaveEscape, kLeaveMagic }.
 bool is_leave_frame(const uint8_t* p, size_t n) {
@@ -580,6 +620,10 @@ struct PendingInfo {
   std::set<int> ungrouped_ranks;
   // Data dependency: -1 none, -2 needs every rank, >=0 needs that root.
   int data_dep = -1;
+  // Round this pending instance was created in: a slot verdict counts
+  // toward its speculation streak (protocol v7) only when announce and
+  // ready landed in the SAME round — the warm steady-state shape.
+  uint64_t round_created = 0;
 };
 
 struct Server {
@@ -619,6 +663,12 @@ struct Server {
     uint16_t required = 0;
     bool live = false;
     uint64_t last_used = 0;  // round counter, for LRU eviction
+    // Speculation streak (protocol v7): consecutive rounds this slot was
+    // ready-on-first-announce.  Prediction state hangs off the slot table
+    // so every existing invalidation path (eviction, join-epoch flush,
+    // relearn-after-digest-change) resets it for free: a reassigned or
+    // relearned record starts from a zeroed streak.
+    uint32_t streak = 0;
   };
   // Bounded like the reference's capacity-limited cache; at capacity the
   // least-recently-used non-pending slot is evicted and the eviction is
@@ -661,6 +711,19 @@ struct Server {
   // is the readiness world every verdict materializes against.
   std::unique_ptr<std::atomic<char>[]> v6;
   std::set<int> left;
+  // Protocol v7 (zero-RTT warm path): per-rank capability latch (round-1
+  // ZRT7 request ad), the streak threshold (0 = speculation off), and the
+  // slots predicted ready for the NEXT round (validated — and the
+  // mispredicted slots' streaks reset — when that round's verdict lands).
+  std::unique_ptr<std::atomic<char>[]> v7;
+  int spec_ready_after = 0;
+  std::set<uint32_t> pred_slots;
+  int pred_carry_rounds = 0;   // consecutive rounds a prediction carried
+  // Diagnostic speculation accounting (not exported through the stats
+  // ABI; the client-side counters are the observability surface).
+  uint64_t spec_predictions = 0;
+  uint64_t spec_confirms = 0;
+  uint64_t spec_mispredicts = 0;
   int eff_world() const { return world - static_cast<int>(left.size()); }
   std::vector<Conn> conns;
   // Root-side service accounting (hvdtpu_server_stats): per-round time
@@ -846,6 +909,7 @@ void Server::run_inner() {
         info.order = announce_seq++;
         info.required = required;   // raw: 0 = full (effective) world
         info.first_seen = Clock::now();
+        info.round_created = round_no;
         info.digest = digest;
         info.group = group == "-1" ? group : std::to_string(r) + ":" + group;
         info.data_dep = datadep.empty() ? -1 : std::atoi(datadep.c_str());
@@ -1323,6 +1387,13 @@ void Server::run_inner() {
           v5[r].store(1);
         } else if (magic == kLeaveMagic) {
           v6[r].store(1);
+        } else if (magic == kZrtMagic) {
+          // Empty payload: the round-1 capability ad.  One byte 0x01: the
+          // rank consumed last round's prediction and dispatched its
+          // verdict speculatively (accounting only — the announce itself
+          // already rides the ordinary bitvector section).
+          v7[r].store(1);
+          if (blen >= 1 && *rd.p == 1) ++spec_confirms;
         }
         rd.p += blen;
       }
@@ -1351,6 +1422,7 @@ void Server::run_inner() {
           info.order = announce_seq++;
           info.required = rec.required;   // raw: 0 = full world
           info.first_seen = Clock::now();
+          info.round_created = round_no;
           info.digest = eff;
           info.group = rec.group;
           info.data_dep =
@@ -1403,6 +1475,7 @@ void Server::run_inner() {
             info.order = announce_seq++;
             info.required = rec.required;   // raw: 0 = full world
             info.first_seen = Clock::now();
+            info.round_created = round_no;
             info.digest = eff;
             info.group = rec.group;
             info.data_dep =
@@ -1577,6 +1650,9 @@ void Server::run_inner() {
     std::vector<std::tuple<uint64_t, std::string, std::string, std::string>>
         ready;
     std::vector<uint32_t> ready_slots;
+    // Parallel to ready_slots: announce and ready landed in the SAME
+    // round — the speculation streak's increment condition (v7).
+    std::vector<char> ready_slot_first;
     std::vector<std::string> warns;
     std::vector<std::pair<std::string, std::string>> errs;
     auto now = Clock::now();
@@ -1682,9 +1758,10 @@ void Server::run_inner() {
         // exists, every announcer was (or is being, via this round's
         // assigns broadcast) taught it, and no rank is joined (joined
         // ranks need the digest string to synthesize a contribution).
-        if (joined.empty() && info.slot >= 0)
+        if (joined.empty() && info.slot >= 0) {
           ready_slots.push_back(static_cast<uint32_t>(info.slot));
-        else
+          ready_slot_first.push_back(info.round_created == round_no ? 1 : 0);
+        } else
           ready.emplace_back(info.order, it->first, info.digest, info.group);
         it = pending.erase(it);
         continue;
@@ -1720,6 +1797,92 @@ void Server::run_inner() {
                          std::to_string(last_joined), "-1");
       joined.clear();
       last_joined = -1;
+    }
+
+    // ---- speculative readiness (protocol v7).  Validate last round's
+    // prediction against THIS round's actual slot verdicts: a predicted
+    // slot that did not go ready is a mispredict — its streak resets, so
+    // speculation disengages for it until the streak rebuilds through
+    // normal rounds (the speculating client's early-consumed verdict is
+    // absorbed by the merge of its next announce into the still-pending
+    // entry; nothing to repair here).
+    {
+      std::set<uint32_t> ready_now(ready_slots.begin(), ready_slots.end());
+      std::set<uint32_t> carried;
+      if (!pred_slots.empty()) {
+        for (uint32_t s : pred_slots) {
+          if (ready_now.count(s)) continue;       // validated
+          // Not ready: distinguish a genuine mispredict (SOMEONE
+          // announced the slot — a speculating client may have consumed
+          // the verdict, and the partial announce proves a rank skipped)
+          // from an idle round (NOBODY announced it — the engine's
+          // timer-driven cycles legitimately interleave empty rounds
+          // between step bursts; no client can have speculated, because
+          // speculating requires announcing, so the prediction simply
+          // CARRIES to the next round with its streak intact).
+          bool announced = s < cache_recs.size() &&
+                           pending.count(cache_recs[s].name) > 0;
+          if (announced || s >= cache_recs.size() ||
+              !cache_recs[s].live) {
+            ++spec_mispredicts;
+            if (s < cache_recs.size()) cache_recs[s].streak = 0;
+          } else {
+            carried.insert(s);
+          }
+        }
+        pred_slots.clear();
+      }
+      // Bound the carry: a prediction for a tensor the workload stopped
+      // submitting must not ride every response forever.  Dropping it
+      // keeps the streak, so the next use re-predicts immediately.
+      if (!carried.empty()) {
+        if (++pred_carry_rounds > 256) carried.clear();
+      } else {
+        pred_carry_rounds = 0;
+      }
+      // Streak update: ready-on-first-announce extends it, a slow
+      // (multi-round) resolution resets it, and a slot left PENDING this
+      // round resets it too — "k consecutive rounds" means exactly that.
+      for (size_t i = 0; i < ready_slots.size(); ++i) {
+        uint32_t s = ready_slots[i];
+        if (s >= cache_recs.size()) continue;
+        CacheRec& rec = cache_recs[s];
+        rec.streak = ready_slot_first[i] ? rec.streak + 1 : 0;
+      }
+      for (auto& [n, info] : pending)
+        if (info.slot >= 0 &&
+            info.slot < static_cast<int64_t>(cache_recs.size()))
+          cache_recs[info.slot].streak = 0;
+      if (!left_this_round.empty()) {
+        // A clean LEAVE shrinks the effective world mid-stream: every
+        // streak restarts against the new readiness threshold.
+        for (auto& rec : cache_recs) rec.streak = 0;
+      }
+      // Emit the next-round prediction: every rank v7, nobody joined, no
+      // membership change this round, and only slots that went ready THIS
+      // round with a mature streak (so the clients re-announcing them next
+      // round is the overwhelmingly likely case).
+      bool all_v7 = spec_ready_after > 0 && joined.empty() &&
+                    left_this_round.empty() && !join_started;
+      if (all_v7)
+        for (int r = 0; r < world; ++r)
+          if (!left.count(r) && !v7[r].load()) {
+            all_v7 = false;
+            break;
+          }
+      if (all_v7) {
+        for (size_t i = 0; i < ready_slots.size(); ++i) {
+          uint32_t s = ready_slots[i];
+          if (s < cache_recs.size() && cache_recs[s].live &&
+              cache_recs[s].streak >=
+                  static_cast<uint32_t>(spec_ready_after))
+            pred_slots.insert(s);
+        }
+        // Idle-round carry: unconsumed predictions stand (re-emitted so
+        // clients, whose predictions are one-round-valid, stay primed).
+        pred_slots.insert(carried.begin(), carried.end());
+        spec_predictions += pred_slots.size();
+      }
     }
 
     std::vector<uint8_t> resp;
@@ -1800,6 +1963,18 @@ void Server::run_inner() {
       put_u32(&resp, static_cast<uint32_t>(left_this_round.size()));
       for (int r : left_this_round) put_u32(&resp, static_cast<uint32_t>(r));
     }
+    // Zero-RTT prediction section (protocol v7): appended only on rounds
+    // that actually predict — the warm path with speculation off carries
+    // zero extra bytes — plus an empty section on round 1 as the
+    // capability ad.  LAST among the trailing sections: pre-v7 clients
+    // stop their order-agnostic-until-unknown walk here having latched
+    // every older capability.
+    if (round_no == 1 || !pred_slots.empty()) {
+      put_u32(&resp, kZrtMagic);
+      put_u32(&resp, 4 + 4 * static_cast<uint32_t>(pred_slots.size()));
+      put_u32(&resp, static_cast<uint32_t>(pred_slots.size()));
+      for (uint32_t s : pred_slots) put_u32(&resp, s);
+    }
     // Attempt EVERY connection before honoring a failure: one dead/closing
     // peer must not cut the survivors off from a round's computed verdicts
     // (they may contain the ready broadcast that lets them finish cleanly).
@@ -1855,7 +2030,8 @@ struct Client {
 extern "C" {
 
 void* hvdtpu_server_start(int port, int world, double stall_warn_s,
-                          int cache_capacity, int round_deadline_ms) {
+                          int cache_capacity, int round_deadline_ms,
+                          int spec_ready_after) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return nullptr;
   int one = 1;
@@ -1876,15 +2052,18 @@ void* hvdtpu_server_start(int port, int world, double stall_warn_s,
   s->cache_capacity = cache_capacity < 0 ? 0
       : static_cast<size_t>(cache_capacity);
   s->round_deadline_ms = round_deadline_ms < 0 ? 0 : round_deadline_ms;
+  s->spec_ready_after = spec_ready_after < 0 ? 0 : spec_ready_after;
   s->fds = std::make_unique<std::atomic<int>[]>(world);
   s->v4 = std::make_unique<std::atomic<char>[]>(world);
   s->v5 = std::make_unique<std::atomic<char>[]>(world);
   s->v6 = std::make_unique<std::atomic<char>[]>(world);
+  s->v7 = std::make_unique<std::atomic<char>[]>(world);
   for (int i = 0; i < world; ++i) {
     s->fds[i].store(-1);
     s->v4[i].store(0);
     s->v5[i].store(0);
     s->v6[i].store(0);
+    s->v7[i].store(0);
   }
   s->loop = std::thread([s] { s->run(); });
   return s;

@@ -201,7 +201,9 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None,
                 round_timeout_s=cfg.round_timeout_s,
                 connect_retries=cfg.connect_retries,
                 connect_backoff_ms=cfg.connect_backoff_ms,
-                server_port=server_port)
+                server_port=server_port,
+                spec_ready_after=cfg.spec_ready_after,
+                round_pipeline=cfg.round_pipeline)
             st.engine.controller = st.controller
 
         if cfg.monitor:

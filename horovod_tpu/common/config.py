@@ -157,6 +157,21 @@ class Config:
     connect_retries: int = 3
     connect_backoff_ms: float = 500.0
 
+    # Zero-RTT warm control plane (protocol v7, docs/performance.md
+    # "Zero-RTT warm path").  spec_ready_after (HOROVOD_SPEC_READY_AFTER):
+    # after a response-cache slot has been ready-on-first-announce for
+    # this many consecutive rounds, the root piggybacks a predicted
+    # next-round verdict and clients may dispatch it without waiting for
+    # the response; 0 (default) = off, every round lock-step.
+    # round_pipeline (HOROVOD_ROUND_PIPELINE): client-side in-flight
+    # negotiation-round window — 1 (default) = lock-step, >1 sends round
+    # N+1's request before round N's response is read.  Both runtime-
+    # tunable (autotune coordinates in multi-process mode); results are
+    # bitwise-identical either way (a mispredict only delays a verdict by
+    # one normal round).
+    spec_ready_after: int = 0
+    round_pipeline: int = 1
+
     timeline_filename: str = ""
     timeline_mark_cycles: bool = False
 
@@ -269,6 +284,8 @@ class Config:
             round_timeout_s=_env_float("ROUND_TIMEOUT_S", 0.0),
             connect_retries=_env_int("CONNECT_RETRIES", 3),
             connect_backoff_ms=_env_float("CONNECT_BACKOFF_MS", 500.0),
+            spec_ready_after=_env_int("SPEC_READY_AFTER", 0),
+            round_pipeline=_env_int("ROUND_PIPELINE", 1),
             timeline_filename=_env("TIMELINE", "") or "",
             timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
             trace_ring=_env_int("TRACE_RING", 4096),

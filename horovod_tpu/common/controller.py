@@ -52,6 +52,11 @@ _ABORT_MAGIC = 0x34544241
 # Matches kLeaveEscape / kLeaveMagic in csrc/coordinator.cc.
 _LEAVE_ESCAPE = 0xFFFFFFFE
 _LVE_MAGIC = 0x3645564C
+# Zero-RTT warm path (protocol v7): "ZRT7" is the round-1 capability ad in
+# both directions, the response-side next-round prediction section, and
+# the request-side one-byte speculation confirm.  Matches kZrtMagic in
+# csrc/coordinator.cc.
+_ZRT_MAGIC = 0x3754525A
 
 
 @dataclasses.dataclass
@@ -94,7 +99,10 @@ class TCPController:
                  cache_capacity: int = 2048, round_timeout_s: float = 0.0,
                  connect_retries: int = 3,
                  connect_backoff_ms: float = 500.0,
-                 server_port: Optional[int] = None):
+                 server_port: Optional[int] = None,
+                 spec_ready_after: int = 0,
+                 round_pipeline: int = 1,
+                 zero_rtt: bool = True):
         # server_port: where rank 0 binds the root coordinator when that
         # differs from where this client connects — the hierarchical
         # control plane (protocol v5) points every client at its local
@@ -133,6 +141,58 @@ class TCPController:
         # this client may announce its own clean departure with a typed
         # LEAVE frame instead of a blind socket sever — see leave().
         self.peer_leave_proto = False
+        # Zero-RTT warm path (protocol v7, docs/performance.md "Zero-RTT
+        # warm path").  spec_ready_after mirrors the server knob (rank 0
+        # starts the server with it); on the CLIENT it gates consuming
+        # predictions — 0 keeps every round lock-step.  round_pipeline is
+        # the client-side in-flight round window: 1 = today's lock-step,
+        # >1 sends round N+1's request before round N's response is read
+        # (the response is drained — bounded by the window — at the start
+        # of a later _round call, where v4 aborts and LVE6 notices it may
+        # carry are honored).  zero_rtt=False emulates a pre-v7 client:
+        # no ZRT7 ad, predictions ignored (the downgrade-matrix tests and
+        # the bench A/B ride this).  Both knobs are runtime-tunable
+        # (autotune coordinates in multi-process mode).
+        self.spec_ready_after = max(0, int(spec_ready_after))
+        self.round_pipeline = max(1, int(round_pipeline))
+        self.zero_rtt = bool(zero_rtt)
+        # Latches once the server advertises protocol v7 (ZRT7 section).
+        self.peer_zero_rtt_proto = False
+        # Dispatch-safety gate, owned by the ENGINE: consuming a predicted
+        # verdict means dispatching a collective BEFORE peers have seen
+        # its real verdict, so the dispatch path must never block this
+        # thread on device completion — a peer that still needs our next
+        # round frame to learn the verdict would deadlock against our
+        # blocked cycle thread.  The engine clears this when its launches
+        # are synchronous (the CPU tier's serialized-launch mode, or an
+        # inline-settling window); harness/bench controllers, which
+        # dispatch nothing, keep the default True.
+        self.spec_dispatch_ok = True
+        # Slots the server predicted ready for the NEXT round (one-round
+        # validity: replaced — or cleared — by every processed response),
+        # and the client-side engagement streak: consecutive responses
+        # that carried a usable prediction.  Consumption requires the
+        # streak to reach spec_ready_after — the knob's CLIENT meaning
+        # (the server's streak threshold is fixed at start): larger
+        # values re-engage more conservatively after any instability,
+        # since a mispredict resets the streak to zero.  This is the
+        # axis the autotune coordinate actually walks.
+        self._predicted: set = set()
+        self._pred_streak = 0
+        # Requests sent whose responses are not yet read, oldest first:
+        # the consumed prediction (frozenset of slots) for speculative
+        # rounds, None for plain pipelined rounds.  Never longer than
+        # max(round_pipeline, 1) after a _round call returns.
+        self._outstanding: List[Optional[frozenset]] = []
+        # Speculation observability (bench zero_rtt_ab, /metrics, the
+        # timeline counter track): hits/mispredicts resolve when the
+        # deferred response validates; spec_rounds counts verdicts
+        # returned without waiting (round trips saved).
+        self.spec_hits = 0
+        self.spec_mispredicts = 0
+        self.spec_rounds = 0
+        self.inflight_high_water = 0
+        self.last_round_speculative = False
         # Ranks the server reported as cleanly departed (LVE6 notice
         # sections), cumulative for this controller generation.  A
         # non-empty list means the world SHRANK without a fault: the
@@ -159,7 +219,8 @@ class TCPController:
             self._server = self._lib.hvdtpu_server_start(
                 srv_port, world, ctypes.c_double(stall_warn_s),
                 int(cache_capacity),
-                int(self.round_timeout_s * 1000))
+                int(self.round_timeout_s * 1000),
+                self.spec_ready_after)
             if not self._server:
                 raise RuntimeError(f"Failed to start controller server on "
                                    f"port {srv_port}")
@@ -265,6 +326,12 @@ class TCPController:
         self._group_tag_counter = itertools.count(1 << 30)
 
     # ------------------------------------------------------------- protocol
+    @property
+    def inflight_rounds(self) -> int:
+        """Requests on the wire whose responses are not yet read (>0 only
+        under speculation or ``round_pipeline > 1``)."""
+        return len(self._outstanding)
+
     def _round(self, announces: Sequence) -> tuple:
         """announces: (name, required_ranks, digest, group, datadep, tag
         [, entry]) tuples; required 0 = world.  Tuples whose slot is known
@@ -274,7 +341,30 @@ class TCPController:
         optional trailing entry (never on the wire) gets its learned slot
         stamped as ``cache_slot`` — the engine's persistent-program pin
         key, obtained here where the slot lookup already happened so the
-        hot dispatch path never rebuilds the announce key."""
+        hot dispatch path never rebuilds the announce key.
+
+        Zero-RTT warm path (protocol v7): a round whose entire announce is
+        exactly the server's prediction returns the predicted verdict
+        WITHOUT waiting for the response — the response is drained at the
+        start of a later call, where it validates the prediction (and
+        delivers any abort/leave/monitor payload one round late, bounded
+        by the in-flight window).  ``round_pipeline > 1`` defers the read
+        the same way without needing a prediction: the verdict then lands
+        one call later, off the critical path."""
+        acc_ready: List[tuple] = []
+        acc_warns: List[str] = []
+        acc_errors: List[tuple] = []
+        acc = (acc_ready, acc_warns, acc_errors)
+        depth = max(1, int(self.round_pipeline))
+        # Deferred responses first: bound the in-flight window, then
+        # opportunistically consume anything already buffered (refreshes
+        # the prediction at ~zero wait — in the steady state the previous
+        # round's response arrived while this rank computed).
+        while len(self._outstanding) >= depth:
+            self._drain_one(acc)
+        while self._outstanding and \
+                self._lib.hvdtpu_client_pending(self._client):
+            self._drain_one(acc)
         full, bits, tags = [], [], []
         stats = self.cache_stats
         for a in announces:
@@ -336,15 +426,51 @@ class TCPController:
             if blob:
                 req += struct.pack("<II", _MON_MAGIC, len(blob)) + blob
                 self.monitor_bytes_sent += 8 + len(blob)
-        # v5 + v6 + v4 capability hellos: FIRST request only, so warm-path
-        # frames carry zero extra bytes (the frame guard asserts this).
-        # AGG5 and LVE6 ride before FLT1 — the server's abort-path
-        # capability salvage reads the frame's FINAL 8 bytes as the FLT1
-        # ad, so FLT1 must stay last.
+        # Speculation decision (protocol v7): the verdict may be returned
+        # without waiting only when this client's ENTIRE outstanding
+        # negotiation state is a SUBSET of the predicted warm set (each
+        # predicted slot is an independent "ready next round" claim, so a
+        # round announcing only part of the working set — the sequential
+        # per-tensor submit pattern — still qualifies) — and no full
+        # announces, no sanitizer tags, no older announced-but-unresolved
+        # names (whose verdict could interleave and reorder dispatch
+        # across ranks), no join in any form, no unread responses (the
+        # prediction would be stale).  Everything else falls back to the
+        # lock-step (or plain pipelined) round.
+        spec_slots = None
+        if (self.zero_rtt and self.spec_ready_after > 0 and self._predicted
+                and self.spec_dispatch_ok
+                and self._pred_streak >= self.spec_ready_after
+                and not full and not tags and bits
+                and not self._outstanding
+                and not self._joined and not self._join_pending
+                and set(bits) <= self._predicted
+                and len(bits) == len(set(bits))):
+            names = set()
+            for s in bits:
+                key = self._slot_keys.get(s)
+                if key is None:
+                    names = None
+                    break
+                names.add(key[0])
+            if names is not None and names == self._announced:
+                spec_slots = frozenset(bits)
+        # v5 + v6 + v7 + v4 capability hellos: FIRST request only, so
+        # warm-path frames carry zero extra bytes (the frame guard asserts
+        # this).  AGG5/LVE6/ZRT7 ride before FLT1 — the server's
+        # abort-path capability salvage reads the frame's FINAL 8 bytes as
+        # the FLT1 ad, so FLT1 must stay last.
         if self.rounds == 1:
             req += struct.pack("<II", _AGG_MAGIC, 0)
             req += struct.pack("<II", _LVE_MAGIC, 0)
+            if self.zero_rtt:
+                req += struct.pack("<II", _ZRT_MAGIC, 0)
             req += struct.pack("<II", _FLT_MAGIC, 0)
+        if spec_slots is not None:
+            # One-byte speculation confirm: this round's verdict was
+            # consumed from the prediction (the announce itself still
+            # rides the ordinary bitvector section above).
+            req += struct.pack("<IIB", _ZRT_MAGIC, 1, 1)
         stats.full_announces += sum(1 for a in full
                                     if not a[0].startswith("\x1f"))
         stats.bit_announces += len(bits)
@@ -354,8 +480,12 @@ class TCPController:
         # Drain a queued ABORT before sending: the server may have posted
         # the typed verdict behind the previous round's response, and a
         # send into an already-reset socket would make the kernel discard
-        # the buffered frame (losing the attribution).
-        if self._lib.hvdtpu_client_pending(self._client):
+        # the buffered frame (losing the attribution).  With responses
+        # legitimately in flight (speculation/pipelining) a readable frame
+        # is EXPECTED — the entry drain above already consumed what it
+        # could, so skip the desync check entirely.
+        if not self._outstanding and \
+                self._lib.hvdtpu_client_pending(self._client):
             # NB: poll() also reports readable on EOF/POLLHUP — a dead
             # socket lands here too, and must be reported as the ordinary
             # peer-death failure, not as a protocol bug.
@@ -379,9 +509,46 @@ class TCPController:
             self._fault_fire("mid_round_exit", self.rank,
                              sever=self._sever)
             self._fault_fire("round_recv", self.rank, sever=self._sever)
+        self._outstanding.append(spec_slots)
+        self.last_round_speculative = spec_slots is not None
+        if spec_slots is not None:
+            # Zero-RTT: return the predicted verdict NOW; the response is
+            # validated at the start of a later round.  Verdict order is
+            # slot-ascending — identical to the ready-bitvector
+            # reconstruction rule every rank applies, so speculating and
+            # lock-stepping ranks dispatch in the same order.
+            self.spec_rounds += 1
+            self._predicted = set()            # one-round validity: consumed
+            for s in sorted(spec_slots):
+                key = self._slot_keys.get(s)
+                if key is not None:
+                    acc_ready.append((key[0], key[1], "-1"))
+            if len(self._outstanding) > self.inflight_high_water:
+                self.inflight_high_water = len(self._outstanding)
+            return acc
+        # Lock-step (depth 1): read this round's response now.  Pipelined
+        # (depth > 1): leave up to depth-1 responses in flight — their
+        # verdicts land at a later call, off the critical path.
+        while len(self._outstanding) >= depth:
+            self._drain_one(acc)
+        # High-water of the DEFERRED window: what is still unread when the
+        # round returns (a lock-step round always returns at 0).
+        if len(self._outstanding) > self.inflight_high_water:
+            self.inflight_high_water = len(self._outstanding)
+        return acc
+
+    def _drain_one(self, acc, timeout_ms: Optional[int] = None):
+        """Read and process the OLDEST outstanding response, folding its
+        verdicts into ``acc`` = (ready, warns, errors).  All the
+        lock-step recv classification (typed abort salvage, round
+        timeout, overflow, unattributed death) lives here so deferred
+        reads fail exactly like synchronous ones — just up to one round
+        later, bounded by the in-flight window."""
+        spec_slots = self._outstanding[0]
         # Client-side wall-clock deadline (2x the server's per-round
         # deadline — see __init__): the backstop for a wedged coordinator.
-        timeout_ms = int(self.round_timeout_s * 2000)
+        if timeout_ms is None:
+            timeout_ms = int(self.round_timeout_s * 2000)
         rc, data = self._recv_salvaging_abort(timeout_ms)
         if rc == -3:
             msg = (f"HVD303 negotiation round timed out after "
@@ -398,6 +565,20 @@ class TCPController:
             # ControlPlaneError subclasses HorovodInternalError, so elastic
             # run wrappers still catch-and-restore (SURVEY.md §3.4).
             self._raise_unattributed_failure(f"rc={rc}")
+        self._outstanding.pop(0)
+        ready, warns, errors = self._parse_response(data, spec_slots)
+        acc[0].extend(ready)
+        acc[1].extend(warns)
+        acc[2].extend(errors)
+
+    def _parse_response(self, data: bytes,
+                        spec_slots: Optional[frozenset] = None) -> tuple:
+        """Decode one response frame, applying every side effect (slot
+        adoption, coordinated evictions, capability latches, monitor
+        sink, leave notices, next-round prediction).  ``spec_slots``
+        non-None marks the round as speculatively consumed: its slot
+        verdicts were already delivered at send time, so they are
+        filtered here and only VALIDATE the prediction."""
         off = 0
 
         def read_list():
@@ -459,6 +640,9 @@ class TCPController:
         # same rule, so the reconstructed order is identical on all ranks
         # (which is all the engine's deterministic batching needs).
         # Unknown slots are other process sets' tensors — not ours.
+        # Speculatively consumed slots (protocol v7) were delivered at
+        # send time: here they only validate the prediction.
+        actual_bits: set = set()
         if off < len(data):
             (nb,) = struct.unpack_from("<I", data, off)
             off += 4
@@ -467,9 +651,27 @@ class TCPController:
             for i in range(nb * 8):
                 if not (bv[i // 8] >> (i % 8)) & 1:
                     continue
+                actual_bits.add(i)
+                if spec_slots is not None and i in spec_slots:
+                    continue
                 key = self._slot_keys.get(i)
                 if key is not None:
                     ready.append((key[0], key[1], "-1"))
+        if spec_slots is not None:
+            if spec_slots <= actual_bits:
+                self.spec_hits += 1
+            else:
+                # Mispredict: a predicted slot did not go ready (a rank
+                # skipped a cycle, or a slot-invalidation event landed).
+                # The early-consumed verdict needs no repair — our announce
+                # stays pending server-side and the late real verdict is
+                # absorbed by this name's next entry — but speculation
+                # disengages (the server reset the slot's streak; we drop
+                # any stale prediction) until the streak rebuilds through
+                # normal full rounds.
+                self.spec_mispredicts += 1
+                self._predicted = set()
+                self._pred_streak = 0
         # Coordinated evictions: drop the named slots so this table can
         # never diverge from the server's (or any peer's).
         if off < len(data):
@@ -482,6 +684,7 @@ class TCPController:
                 # have dropped the slot already (invalidations covered
                 # that); the eviction still happened fleet-wide.
                 self.cache_stats.evictions += 1
+                self._predicted.discard(slot)
                 key = self._slot_keys.pop(slot, None)
                 if key is not None:
                     self._slots.pop(key, None)
@@ -497,6 +700,7 @@ class TCPController:
         # unknown magic stops the walk: MON1 carries no section-length
         # field, so a client this old cannot skip sections it does not
         # understand (a future section must be appended after these).
+        saw_prediction = False
         while off + 8 <= len(data):
             (magic,) = struct.unpack_from("<I", data, off)
             if magic == _MON_MAGIC:
@@ -549,8 +753,42 @@ class TCPController:
                             h(ranks)
                         except Exception:  # noqa: BLE001 - telemetry only
                             log.exception("peer-leave hook failed")
+            elif magic == _ZRT_MAGIC and self.zero_rtt:
+                # Zero-RTT prediction section (protocol v7): the slots the
+                # server predicts ready NEXT round (empty on round 1 — the
+                # capability ad).  Adopted verbatim: the speculation
+                # decision requires an exact match against our own next
+                # announce, so an unknown slot in here simply disables
+                # speculation for that round.  A pre-v7 client (zero_rtt
+                # False) stops its walk here, exactly like an unknown
+                # magic.
+                (ln,) = struct.unpack_from("<I", data, off + 4)
+                off += 8
+                end = off + ln
+                self.peer_zero_rtt_proto = True
+                n_pred = 0
+                if ln >= 4:
+                    (n_pred,) = struct.unpack_from("<I", data, off)
+                    off += 4
+                pred = set()
+                for _ in range(n_pred):
+                    (s,) = struct.unpack_from("<I", data, off)
+                    pred.add(s)
+                    off += 4
+                off = end
+                self._predicted = pred
+                saw_prediction = bool(pred)
             else:
                 break
+        if saw_prediction:
+            self._pred_streak += 1
+        else:
+            # Predictions are one-round-valid: a response without a ZRT7
+            # section (spec off, streak reset, old server, mixed-version
+            # fleet) expires any stale one — and the engagement streak
+            # restarts with the next prediction run.
+            self._predicted = set()
+            self._pred_streak = 0
         return ready, warns, errors
 
     # ------------------------------------------------- fault handling (v4)
@@ -653,7 +891,9 @@ class TCPController:
         if old is not None:
             self._slots.pop(old, None)
             # Slot-id reuse: a program pinned to the OLD tuple must not
-            # serve the new one (its digest differs by construction).
+            # serve the new one (its digest differs by construction) —
+            # nor may a prediction made for the old tuple (v7).
+            self._predicted.discard(slot)
             self._notify_slot_drop(slot)
         self._trim_slots(len(self._slots) + 1)
         self._slots[key] = slot
@@ -675,6 +915,7 @@ class TCPController:
                 continue
             lru_slot = self._slots.pop(lru_key)
             self._slot_keys.pop(lru_slot, None)
+            self._predicted.discard(lru_slot)
             self.cache_stats.invalidations += 1
             self._notify_slot_drop(lru_slot)
             excess -= 1
@@ -860,6 +1101,7 @@ class TCPController:
         for key in [k for k in self._slots if k[0] == n]:
             slot = self._slots.pop(key)
             self._slot_keys.pop(slot, None)
+            self._predicted.discard(slot)
             self.cache_stats.invalidations += 1
             self._notify_slot_drop(slot)
         self._awaiting_assign = {k for k in self._awaiting_assign
@@ -931,8 +1173,39 @@ class TCPController:
         on the server's round-1 LVE6 ad, so against a pre-v6 coordinator
         this is a no-op and the sever keeps its legacy semantics.
         Returns True when the frame actually went on the wire."""
-        if (self._client is None or not self.peer_leave_proto
-                or self.interrupted or self.leave_sent
+        if self._client is None or self.interrupted or self.leave_sent:
+            return False
+        # Responses still in flight (speculation / round_pipeline > 1) are
+        # drained first: the LEAVE frame must be the next thing the server
+        # reads from a QUIET socket, and a deferred response may carry the
+        # leave-relevant latches (peer_leave_proto on round 1) or a typed
+        # abort that makes leaving moot.  Bounded even with the round
+        # timeout disabled — a clean shutdown must not block forever on a
+        # response a dead coordinator will never finish — and a typed
+        # verdict surfacing here is LOGGED with its attribution before the
+        # fall-back to the legacy sever: consuming the frame consumed the
+        # fleet's only copy of the dead-rank list.
+        try:
+            acc = ([], [], [])
+            while self._outstanding:
+                self._drain_one(
+                    acc, timeout_ms=int(self.round_timeout_s * 2000) or 5000)
+            # Verdicts a deferred response delivered here are parked for
+            # the next negotiate (the engine may keep cycling if the
+            # leave is refused below) — never dropped.
+            for name, digest, group in acc[0]:
+                if name in self._announced:
+                    self._early_ready.append((name, digest, group))
+            for name, msg in acc[2]:
+                if name in self._announced:
+                    self._early_errors[name] = msg
+        except Exception as exc:  # noqa: BLE001 - dead world: legacy sever
+            log.warning(
+                "clean LEAVE abandoned: draining the in-flight round "
+                "window failed (%s); falling back to the legacy sever",
+                exc)
+            return False
+        if (not self.peer_leave_proto
                 or self._announced or self._joined or self._join_pending):
             return False
         req = struct.pack("<II", _LEAVE_ESCAPE, _LVE_MAGIC)

@@ -61,6 +61,10 @@ _ABORT_ESCAPE = 0xFFFFFFFF
 # Clean-LEAVE frame (protocol v6): escape word + "LVE6" magic.
 _LEAVE_ESCAPE = 0xFFFFFFFE
 _LVE_MAGIC = 0x3645564C
+# Zero-RTT warm path (protocol v7): a speculating rank's warm frame is
+# the 13-byte core plus a one-byte ZRT7 confirm section.  Identical
+# confirms across the host stay on the fixed-size aggregate uplink path.
+_ZRT_MAGIC = 0x3754525A
 
 
 def _is_leave_frame(data: bytes) -> bool:
@@ -195,6 +199,12 @@ class HostAgent:
         # the round's response has been fanned to the survivors.  Their
         # trailing EOF must never become a dead-rank report.
         self._left_pending: set = set()
+        # Per-rank reassembly buffers, persistent ACROSS rounds: a
+        # speculating or pipelined rank (protocol v7) legitimately sends
+        # round N+1's frame before round N's response has been fanned
+        # down, so bytes beyond the current round's frame must survive
+        # the gather instead of dying with a per-call buffer.
+        self._bufs: Dict[int, bytes] = {}
         self.error: Optional[str] = None
         # Bound before start() returns so callers (and port-0 users) know
         # where local ranks must connect.
@@ -305,6 +315,23 @@ class HostAgent:
             return True
 
     # ---------------------------------------------------------- round loop
+    def _take_frame(self, rank: int, frames: Dict[int, bytes]) -> None:
+        """Move one complete frame (if reassembled) from the rank's
+        persistent buffer into this round's frame set."""
+        buf = self._bufs.get(rank, b"")
+        if len(buf) < 4:
+            return
+        (ln,) = struct.unpack_from("<I", buf)
+        if len(buf) < 4 + ln:
+            return
+        frames[rank] = buf[4:4 + ln]
+        self._bufs[rank] = buf[4 + ln:]
+        if _is_leave_frame(frames[rank]):
+            # Clean departure (protocol v6): the LEAVE is this rank's
+            # round frame — forwarded upstream verbatim so the root drops
+            # the rank — and the rank retires after the round completes.
+            self._left_pending.add(rank)
+
     def _gather_local(self, sel) -> Optional[Dict[int, bytes]]:
         """One frame from every live local rank, multiplexed through the
         round loop's long-lived selector (registered ONCE per connection,
@@ -312,9 +339,13 @@ class HostAgent:
         when the round cannot complete (death/abort/teardown) after
         handling it: local deaths are reported upstream, an upstream frame
         arriving mid-gather (an ABORT — the only unsolicited downlink) is
-        fanned down."""
+        fanned down.  Reassembly buffers persist across rounds: a
+        speculating/pipelined rank's early next-round frame simply waits
+        its turn (it satisfies the NEXT gather immediately)."""
         frames: Dict[int, bytes] = {}
-        bufs: Dict[int, bytes] = {r: b"" for r in self._local}
+        # Leftover frames from ranks that ran ahead of the fan-out.
+        for rank in list(self._local):
+            self._take_frame(rank, frames)
         while not self._stop.is_set():
             if all(r in frames for r in self._local):
                 return frames
@@ -335,28 +366,6 @@ class HostAgent:
                 if rank not in self._local:
                     continue
                 s = key.fileobj
-                if rank in frames:
-                    # Delivered this round already: the only legitimate
-                    # event is EOF (a rank dying right after its send).
-                    # Consume it so a level-triggered selector can't spin,
-                    # and report once the round's frame — already in
-                    # hand — has been folded into the uplink.  A rank
-                    # whose frame was a clean LEAVE is EXPECTED to sever
-                    # right after it: retire silently, never report.
-                    try:
-                        if s.recv(1) == b"":
-                            sel.unregister(s)
-                            self._local.pop(rank, None)
-                            if rank not in self._left_pending:
-                                self._deferred_dead.append(rank)
-                    except socket.timeout:
-                        pass
-                    except OSError:
-                        sel.unregister(s)
-                        self._local.pop(rank, None)
-                        if rank not in self._left_pending:
-                            self._deferred_dead.append(rank)
-                    continue
                 try:
                     chunk = s.recv(65536)
                 except socket.timeout:
@@ -364,22 +373,25 @@ class HostAgent:
                 except OSError:
                     chunk = b""
                 if not chunk:
+                    if rank in frames or rank in self._left_pending:
+                        # EOF AFTER this round's frame (a rank dying right
+                        # after its send, or a leaver's expected sever):
+                        # the frame in hand still counts — retire the
+                        # socket now, report once the round's uplink has
+                        # gone out.  A clean leaver is never reported.
+                        sel.unregister(s)
+                        self._local.pop(rank, None)
+                        self._bufs.pop(rank, None)
+                        if rank not in self._left_pending:
+                            self._deferred_dead.append(rank)
+                        continue
                     sel.unregister(s)
+                    self._bufs.pop(rank, None)
                     self._on_local_death(rank)
                     return None
-                bufs[rank] = bufs.get(rank, b"") + chunk
-                buf = bufs[rank]
-                if len(buf) >= 4:
-                    (ln,) = struct.unpack_from("<I", buf)
-                    if len(buf) >= 4 + ln:
-                        frames[rank] = buf[4:4 + ln]
-                        bufs[rank] = buf[4 + ln:]
-                        if _is_leave_frame(frames[rank]):
-                            # Clean departure (protocol v6): the LEAVE is
-                            # this rank's round frame — forwarded upstream
-                            # verbatim so the root drops the rank — and
-                            # the rank retires after the round completes.
-                            self._left_pending.add(rank)
+                self._bufs[rank] = self._bufs.get(rank, b"") + chunk
+                if rank not in frames:
+                    self._take_frame(rank, frames)
         return None
 
     def _on_local_death(self, rank: int) -> None:
@@ -428,6 +440,7 @@ class HostAgent:
         before the response fan-out (no response is owed to a leaver)."""
         for rank in sorted(self._left_pending):
             s = self._local.pop(rank, None)
+            self._bufs.pop(rank, None)
             if s is not None:
                 try:
                     sel.unregister(s)
@@ -461,8 +474,17 @@ class HostAgent:
             for m, p in trailing:
                 if m == _MON_MAGIC:
                     mons.append((rank, p))
+            # A trailing ZRT7 speculation confirm (protocol v7) is part of
+            # the warm steady-state shape: when every local rank sends an
+            # identical one it rides the core-equality check below and
+            # collapses into the aggregate like the bitvector it confirms
+            # (the root's confirm accounting is advisory; the announce
+            # itself is the aggregate bitvector).  Any OTHER trailing
+            # section still forces the per-rank path.
+            warm_trailing = all(m == _ZRT_MAGIC and len(p) == 1
+                                for m, p in trailing if m != _MON_MAGIC)
             stripped = data[:core_end] + kept
-            if n_ann or n_tag or kept:
+            if n_ann or n_tag or (kept and not warm_trailing):
                 subs.append((rank, stripped))
                 aggregatable = False
             else:

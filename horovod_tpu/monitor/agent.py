@@ -203,6 +203,24 @@ class MonitorAgent:
                 reg.counter("hvd_controller_bytes_sent_total",
                             "negotiation request bytes").set_total(
                     ctl.bytes_sent)
+                # Zero-RTT warm path (protocol v7): speculation outcomes
+                # and the in-flight round window.
+                reg.counter("hvd_spec_hits_total",
+                            "speculative verdicts validated").set_total(
+                    getattr(ctl, "spec_hits", 0))
+                reg.counter("hvd_spec_mispredicts_total",
+                            "speculative verdicts mispredicted").set_total(
+                    getattr(ctl, "spec_mispredicts", 0))
+                reg.counter("hvd_spec_rounds_total",
+                            "rounds whose verdict skipped the "
+                            "response wait").set_total(
+                    getattr(ctl, "spec_rounds", 0))
+                reg.gauge("hvd_inflight_rounds",
+                          "negotiation responses currently unread").set(
+                    getattr(ctl, "inflight_rounds", 0))
+                reg.gauge("hvd_inflight_rounds_high_water",
+                          "in-flight negotiation round high-water").set(
+                    getattr(ctl, "inflight_high_water", 0))
                 reg.counter("hvd_monitor_frame_bytes_total",
                             "monitor side-channel bytes sent").set_total(
                     getattr(ctl, "monitor_bytes_sent", 0))

@@ -50,6 +50,13 @@ _INFLIGHT_BOUNDS = (0.0, 3.0)
 # base coordinate, so the latency pair (fast_lane_threshold, cycle_time)
 # is fully searched, never hand-set.
 _FAST_LANE_BOUNDS = (8.0, 24.0)
+# Zero-RTT pair (protocol v7, multi-process only).  spec_ready_after
+# 1..32 consecutive ready-on-first-announce rounds before the coordinator
+# predicts (small = aggressive speculation, large = conservative; 0 — the
+# explicit opt-out — gates the coordinate off entirely, like the cache
+# knob).  round_pipeline 1..4 in-flight negotiation rounds per client.
+_SPEC_BOUNDS = (0.0, 5.0)
+_RPIPE_BOUNDS = (0.0, 2.0)
 
 
 def _clamp(v: float, lo: float, hi: float) -> float:
@@ -231,6 +238,25 @@ class ParameterManager:
             fl0 = max(float(engine.fast_lane_threshold) or 4096.0, 256.0)
             starts.append(math.log2(fl0))
             bounds.append(_FAST_LANE_BOUNDS)
+        # Zero-RTT pair (protocol v7) — spec_ready_after gated like the
+        # cache coordinate (speculation off is an explicit opt-out, and
+        # the server's streak threshold was fixed at start from the same
+        # config: the client-side knob gates prediction CONSUMPTION, so
+        # walking it trades speculation eagerness against mispredict
+        # fallbacks); round_pipeline gated like the pipeline pair.  Moves
+        # ride the same agreement broadcast, so the in-flight windows can
+        # never diverge across ranks.
+        self._tune_spec = (ctl is not None
+                           and getattr(ctl, "spec_ready_after", 0) > 0)
+        if self._tune_spec:
+            sp0 = max(float(ctl.spec_ready_after), 1.0)
+            starts.append(math.log2(sp0))
+            bounds.append(_SPEC_BOUNDS)
+        self._tune_round_pipeline = ctl is not None
+        if self._tune_round_pipeline:
+            rp0 = max(float(getattr(ctl, "round_pipeline", 1)), 1.0)
+            starts.append(math.log2(rp0))
+            bounds.append(_RPIPE_BOUNDS)
         self.search = LogCoordinateDescent(
             start=tuple(starts), bounds=tuple(bounds), max_evals=max_evals)
         self._sample_no = 0
@@ -300,6 +326,19 @@ class ParameterManager:
             # Applies from the next ready verdict; stale fast-lane pins
             # self-invalidate on their validity compare.
             self._engine.fast_lane_threshold = int(params[idx])
+            idx += 1
+        if self._tune_spec and len(params) > idx:
+            # Client-side consumption gate: never moves to 0 (the bounds
+            # start at 1) — 0 is the config-level opt-out that disables
+            # the coordinate entirely.
+            self._engine.controller.spec_ready_after = max(
+                1, int(round(params[idx])))
+            idx += 1
+        if self._tune_round_pipeline and len(params) > idx:
+            # Applies from the next round: a shrunk window drains
+            # naturally at the next _round's entry drain.
+            self._engine.controller.round_pipeline = max(
+                1, int(round(params[idx])))
 
     def _poll_move(self):
         payload = self._poller(self._move_handle)
@@ -329,6 +368,14 @@ class ParameterManager:
                 idx += 2
             if self._tune_fast_lane and len(params) > idx:
                 extra += f" fast_lane_threshold={int(params[idx])}"
+                idx += 1
+            if self._tune_spec and len(params) > idx:
+                extra += (f" spec_ready_after="
+                          f"{max(1, int(round(params[idx])))}")
+                idx += 1
+            if self._tune_round_pipeline and len(params) > idx:
+                extra += (f" round_pipeline="
+                          f"{max(1, int(round(params[idx])))}")
             self._log_line(f"# final: fusion_threshold={int(params[0])} "
                            f"cycle_time_s={params[1]:.6f}{extra} "
                            f"evals={self.search.evals}\n")
@@ -368,6 +415,10 @@ class ParameterManager:
                 cols += ",pipeline_chunk_bytes,max_inflight"
             if self._tune_fast_lane:
                 cols += ",fast_lane_threshold"
+            if self._tune_spec:
+                cols += ",spec_ready_after"
+            if self._tune_round_pipeline:
+                cols += ",round_pipeline"
             self._log_line(f"sample,fusion_threshold_bytes,cycle_time_s"
                            f"{cols},score_bytes_per_s\n")
             self._log_header_written = True
@@ -383,6 +434,12 @@ class ParameterManager:
             idx += 2
         if self._tune_fast_lane and len(params) > idx:
             extra += f",{int(params[idx])}"
+            idx += 1
+        if self._tune_spec and len(params) > idx:
+            extra += f",{max(1, int(round(params[idx])))}"
+            idx += 1
+        if self._tune_round_pipeline and len(params) > idx:
+            extra += f",{max(1, int(round(params[idx])))}"
         self._log_line(f"{self._sample_no},{int(params[0])},"
                        f"{params[1]:.6f}{extra},{score:.1f}\n")
 

@@ -259,6 +259,16 @@ class CollectiveEngine:
         self.negotiation_us_total = 0.0
         self.negotiation_cycles = 0
         self.last_negotiation_us = 0.0
+        # Zero-RTT warm path (protocol v7): cycles whose verdict came from
+        # the coordinator's speculative prediction — negotiate() returned
+        # without waiting for the response, so the negotiation phase
+        # collapses toward zero.  The dispatch path below is deliberately
+        # identical for predicted and lock-step verdicts (same entries,
+        # same deterministic batching, same programs): a mispredict never
+        # reaches this layer — the controller absorbs it by merging the
+        # next announce into the still-pending server entry, so results
+        # stay bitwise identical and nothing needs un-dispatching here.
+        self.spec_cycles = 0
         # Whole-cycle wall-time accounting (drain + negotiate + fuse +
         # dispatch): the per-rank numbers the monitor subsystem aggregates
         # into slowest-rank / cycle-time-spread straggler attribution
@@ -921,19 +931,39 @@ class CollectiveEngine:
         if self.controller is not None:
             self.controller.synthesizer = self._synthesize_join_entry
             self.controller.slot_drop_hook = self._on_slot_drop
+            # Zero-RTT dispatch-safety gate (protocol v7): a speculative
+            # verdict is dispatched before peers have its real verdict,
+            # so this thread must stay free to keep serving them rounds —
+            # only the async in-flight window qualifies.  The serialized-
+            # launch CPU tier (and an inline-settling window) block the
+            # cycle thread inside the collective: a speculating rank
+            # would starve the peer of the very frame it needs to launch,
+            # deadlocking the fleet.  Pipelined rounds are unaffected
+            # (a deferred verdict is already in every rank's buffer).
+            self.controller.spec_dispatch_ok = (
+                not self._serialize_launches and self.max_inflight > 1)
             t0 = time.perf_counter()
             ready, errored = self.controller.negotiate(entries)
             dt_us = (time.perf_counter() - t0) * 1e6
             self.negotiation_us_total += dt_us
             self.negotiation_cycles += 1
             self.last_negotiation_us = dt_us
+            if getattr(self.controller, "last_round_speculative", False):
+                self.spec_cycles += 1
             tl0 = self._state.timeline
             if tl0 is not None and tl0.enabled:
                 st = self.controller.cache_stats
+                ctl0 = self.controller
                 tl0.counter("negotiation", {
                     "us": round(dt_us, 1), "cache_hits": st.hits,
                     "cache_misses": st.misses,
-                    "cache_invalidations": st.invalidations})
+                    "cache_invalidations": st.invalidations,
+                    # Zero-RTT speculation/pipelining (protocol v7).
+                    "spec_hits": getattr(ctl0, "spec_hits", 0),
+                    "spec_mispredicts": getattr(ctl0, "spec_mispredicts",
+                                                0),
+                    "inflight_rounds": getattr(ctl0, "inflight_rounds",
+                                               0)})
             # Per-tensor negotiation failures (shape/dtype divergence across
             # ranks): fail ONLY those waiters; the runtime stays up
             # (reference: per-tensor error Responses, SURVEY.md N2).
@@ -1002,6 +1032,23 @@ class CollectiveEngine:
                 for e in poisoned:
                     self.stall.progressed(e.name)
                 entries, not_ready = keep_r, keep_nr
+                # Zero-RTT race closure (protocol v7): a SPECULATIVE
+                # dispatch may have preceded this notice by one round — a
+                # world collective launched from a predicted verdict in
+                # the very round the leaver departed was never dispatched
+                # by the leaver and can never complete (lock-step's
+                # poison-before-dispatch guarantee does not cover it,
+                # because the verdict was consumed before the notice was
+                # readable).  With speculation armed, settle the
+                # in-flight window with the same re-rendezvous interrupt
+                # instead of letting its waiters wedge on a dead
+                # collective: the elastic wrapper restores and re-runs
+                # the step, exactly like any other world change.
+                ctl2 = self.controller
+                if (self._inflight is not None and len(self._inflight)
+                        and getattr(ctl2, "spec_ready_after", 0) > 0
+                        and getattr(ctl2, "spec_dispatch_ok", False)):
+                    self._inflight.abort(exc_left)
         for e in entries:
             if self._state.timeline is not None:
                 self._state.timeline.end_activity(e.name, "QUEUE")

@@ -108,6 +108,16 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                    help="Split tensors above this many MB into priority-"
                         "inheriting sub-tensors (ByteScheduler-style "
                         "preemption); 0 = off")
+    p.add_argument("--spec-ready-after", type=int, default=None,
+                   help="Zero-RTT warm path (protocol v7): after a "
+                        "response-cache slot has been ready-on-first-"
+                        "announce for this many consecutive rounds, the "
+                        "coordinator predicts the next-round verdict and "
+                        "clients dispatch it without waiting; 0 = off")
+    p.add_argument("--round-pipeline", type=int, default=None,
+                   help="In-flight negotiation-round window per client: "
+                        "1 = lock-step (default), >1 sends round N+1's "
+                        "request before round N's response is read")
     p.add_argument("--timeline-filename", default=None)
     p.add_argument("--timeline-mark-cycles", action="store_true")
     p.add_argument("--trace-filename", default=None,
@@ -327,6 +337,8 @@ def tuning_env(args) -> Dict[str, str]:
             ("fast_lane_threshold_kb", "HOROVOD_FAST_LANE_THRESHOLD", 1024),
             ("partition_threshold_mb", "HOROVOD_PARTITION_THRESHOLD",
              1024 * 1024),
+            ("spec_ready_after", "HOROVOD_SPEC_READY_AFTER", 1),
+            ("round_pipeline", "HOROVOD_ROUND_PIPELINE", 1),
             ("stall_check_time", "HOROVOD_STALL_CHECK_TIME", 1),
             ("stall_shutdown_time", "HOROVOD_STALL_SHUTDOWN_TIME", 1),
             ("monitor_port", "HOROVOD_MONITOR_PORT", 1),

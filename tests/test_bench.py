@@ -90,6 +90,20 @@ def test_bench_minimal_mode():
     assert asc["leave_sent"] is True, asc
     assert asc["left_observed"] is True, asc
     assert asc["drain_roundtrip_us"] > 0, asc
+    # Zero-RTT A/B (ISSUE 11) on every line: with speculation on, warm
+    # cycles stop paying the negotiation round trip (< 1 per cycle, hit
+    # rate ≥ 90% on this stable workload) while every rank's verdict
+    # order is identical on-vs-off — the bitwise-invariance evidence.
+    zrt = out["zero_rtt_ab"]
+    assert zrt["spec_hit_rate"] is not None and \
+        zrt["spec_hit_rate"] >= 0.9, zrt
+    assert zrt["round_trips_per_cycle_on"] < 1, zrt
+    assert zrt["round_trips_per_cycle_off"] == 1.0, zrt
+    assert zrt["orders_identical"] is True, zrt
+    assert zrt["negotiation_us_per_cycle_on"] > 0, zrt
+    assert zrt["negotiation_us_per_cycle_off"] > 0, zrt
+    # ...and the live-engine stats block carries the zero_rtt keys.
+    assert "zero_rtt" in out and "spec_hits" in out["zero_rtt"], out.keys()
 
 
 def test_bench_default_resnet():

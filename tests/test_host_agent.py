@@ -62,7 +62,7 @@ def _steps(ctl, make_entries, n_steps, max_rounds=30):
 
 
 def run_hier(hosts, fn, cache_capacity=2048, round_timeout_s=0.0,
-             setup=None, expect_errors=False):
+             setup=None, expect_errors=False, **ctl_kwargs):
     """Run ``fn(ctl, rank)`` on every rank of a simulated multi-host world.
 
     ``hosts`` is a list of rank lists (one per simulated host); each host
@@ -84,7 +84,7 @@ def run_hier(hosts, fn, cache_capacity=2048, round_timeout_s=0.0,
             "127.0.0.1", agent_of[rank].port, rank=rank, world=world,
             stall_warn_s=60.0, cache_capacity=cache_capacity,
             round_timeout_s=round_timeout_s,
-            server_port=root_port if rank == 0 else None)
+            server_port=root_port if rank == 0 else None, **ctl_kwargs)
         if setup is not None:
             setup(ctl, rank)
         try:

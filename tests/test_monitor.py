@@ -288,6 +288,33 @@ def test_http_exporter_metrics_health_snapshot():
         agent.close()
 
 
+def test_http_exporter_carries_zero_rtt_counters():
+    """ISSUE 11 observability: with a real controller attached, /metrics
+    exports the speculation outcome counters and the in-flight round
+    gauges alongside the response-cache family."""
+
+    def fn(ctl, rank):
+        _steps(ctl, lambda: [E("t")], 3)
+        if rank != 0:
+            return True
+        agent = MonitorAgent(engine=FakeEngine(), controller=ctl,
+                             rank=0, world=2, interval_s=0.1)
+        srv = agent.serve_http(0)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            text = urllib.request.urlopen(base + "/metrics").read().decode()
+            for name in ("hvd_spec_hits_total", "hvd_spec_mispredicts_total",
+                         "hvd_spec_rounds_total", "hvd_inflight_rounds",
+                         "hvd_inflight_rounds_high_water",
+                         "hvd_response_cache_hits_total"):
+                assert name in text, name
+        finally:
+            agent.close()
+        return True
+
+    _pair(fn)
+
+
 def test_http_health_returns_503_when_stalled():
     # The stall is on a PEER rank: the agent refreshes its own entry on
     # every /health render, so self-seeded state would be overwritten.

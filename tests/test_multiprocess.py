@@ -617,14 +617,17 @@ def test_torovodrun_trace_acceptance(tmp_path):
 WORKER_FAULTS = os.path.join(REPO, "tests", "data", "worker_faults.py")
 
 
-def test_torovodrun_dead_rank_aborts_with_attribution(tmp_path):
+@pytest.mark.parametrize("pipeline", [1, 2], ids=["lockstep", "pipelined"])
+def test_torovodrun_dead_rank_aborts_with_attribution(tmp_path, pipeline):
     """ISSUE 5 acceptance (static half): with HVD_TPU_FAULT=
     mid_round_exit:1:crash, rank 1 dies uncleanly mid-negotiation and rank
     0 raises a typed HVD303 PeerFailureError naming rank 1 within
     HOROVOD_ROUND_TIMEOUT_S — no hang, no wedged waiters (a pre-existing
     pending handle settles with the fault, new work fails fast).  The
     proof is the result file rank 0 writes before the launcher reaps it;
-    the launcher's nonzero exit (rank 1's crash) is expected."""
+    the launcher's nonzero exit (rank 1's crash) is expected.  Swept with
+    HOROVOD_ROUND_PIPELINE=2 (ISSUE 11): a deferred response must carry
+    the typed abort to the survivor exactly like a lock-step one."""
     import json
     result = tmp_path / "fault_result.json"
     res = _run_torovodrun(2, WORKER_FAULTS, timeout=300, extra_env={
@@ -632,6 +635,7 @@ def test_torovodrun_dead_rank_aborts_with_attribution(tmp_path):
         "FAULT_RESULT": str(result),
         "HVD_TPU_FAULT": "mid_round_exit:1:crash:300",
         "HOROVOD_ROUND_TIMEOUT_S": "30",
+        "HOROVOD_ROUND_PIPELINE": str(pipeline),
     })
     assert res.returncode != 0, (
         "rank 1's unclean crash must fail the launch\n"
@@ -715,14 +719,16 @@ def test_torovodrun_hierarchical_single_host_agent():
         f"stderr:\n{res.stderr[-3000:]}")
 
 
-def test_torovodrun_hierarchical_agent_crash_attributed(tmp_path):
+@pytest.mark.parametrize("pipeline", [1, 2], ids=["lockstep", "pipelined"])
+def test_torovodrun_hierarchical_agent_crash_attributed(tmp_path, pipeline):
     """ISSUE 9 acceptance (fault half, the 2-proc/2-'host' worker): rank
     1 — alone on its simulated host — crashes mid-negotiation, killing its
     host agent with it.  The root attributes the severed AGENT connection
     to the host's ranks, and rank 0 records a typed HVD303
     PeerFailureError naming rank 1 within the round deadline — no wedged
     waiters (same contract as the flat-mode test above, now through two
-    agents)."""
+    agents).  Swept with HOROVOD_ROUND_PIPELINE=2 (ISSUE 11): agent death
+    must surface through a deferred read too."""
     import json
     hostfile = tmp_path / "hosts.txt"
     hostfile.write_text("localhost slots=1\n127.0.0.1 slots=1\n")
@@ -735,6 +741,7 @@ def test_torovodrun_hierarchical_agent_crash_attributed(tmp_path):
                               "FAULT_RESULT": str(result),
                               "HVD_TPU_FAULT": "mid_round_exit:1:crash:300",
                               "HOROVOD_ROUND_TIMEOUT_S": "30",
+                              "HOROVOD_ROUND_PIPELINE": str(pipeline),
                           })
     assert res.returncode != 0, (
         "rank 1's unclean crash must fail the launch\n"
@@ -784,13 +791,15 @@ def test_torovodrun_sanitizer_catches_divergence_on_cached_path():
 WORKER_LEAVE = os.path.join(REPO, "tests", "data", "worker_leave.py")
 
 
-def _leave_env(result, mode):
+def _leave_env(result, mode, pipeline=1, spec=0):
     return {
         "LEAVE_MODE": mode,
         "LEAVE_RESULT": str(result),
         "HOROVOD_ROUND_TIMEOUT_S": "30",
         "HOROVOD_MONITOR": "1",
         "HOROVOD_MONITOR_INTERVAL": "0.2",
+        "HOROVOD_ROUND_PIPELINE": str(pipeline),
+        "HOROVOD_SPEC_READY_AFTER": str(spec),
     }
 
 
@@ -814,25 +823,34 @@ def _assert_clean_leave(res, result):
     assert r1["ok"] and r1["leave_sent"] is True, r1
 
 
-def test_torovodrun_clean_leave_vs_sever(tmp_path):
+@pytest.mark.parametrize("pipeline,spec", [(1, 0), (2, 0), (1, 1)],
+                         ids=["lockstep", "pipelined", "speculative"])
+def test_torovodrun_clean_leave_vs_sever(tmp_path, pipeline, spec):
     """ISSUE 10 acceptance (both halves, one worker script): a worker that
     sends the protocol-v6 LEAVE mid-run exits 0 with the survivor
     continuing — PeerLeftInterrupt (a HostsUpdatedInterrupt), engine.fault
     None, /health ok with rank 1 reported left, launcher rc 0 — while the
     SAME sever without a LEAVE frame still produces the typed attributed
     HVD303 abort naming rank 1.  The frame, not timing luck, is what
-    disambiguates."""
+    disambiguates.  Swept with ISSUE 11's knobs: HOROVOD_ROUND_PIPELINE=2
+    (the leaver drains its in-flight window before the LEAVE goes out, so
+    the v6 semantics hold with rounds in flight) and
+    HOROVOD_SPEC_READY_AFTER=1 (the v7 machinery armed across a clean
+    departure; the spec-dispatch-raced-a-LEAVE window is closed by the
+    engine settling its in-flight ring with the same interrupt)."""
     import json
     # Half 1: clean.
     result = tmp_path / "leave_clean.json"
     res = _run_torovodrun(2, WORKER_LEAVE, timeout=300,
-                          extra_env=_leave_env(result, "clean"))
+                          extra_env=_leave_env(result, "clean", pipeline,
+                                               spec))
     _assert_clean_leave(res, result)
 
     # Half 2: the control — same departure point, no LEAVE frame.
     result2 = tmp_path / "leave_sever.json"
     res2 = _run_torovodrun(2, WORKER_LEAVE, timeout=300,
-                           extra_env=_leave_env(result2, "sever"))
+                           extra_env=_leave_env(result2, "sever", pipeline,
+                                                spec))
     assert res2.returncode != 0, (
         "the unclean sever must fail the launch\n"
         f"stdout:\n{res2.stdout[-2000:]}")
@@ -854,6 +872,37 @@ def test_torovodrun_clean_leave_hierarchical(tmp_path):
                           extra_args=("--hierarchical-controller",),
                           extra_env=_leave_env(result, "clean"))
     _assert_clean_leave(res, result)
+
+
+@pytest.mark.parametrize("knobs", [
+    {"HOROVOD_SPEC_READY_AFTER": "1"},
+    {"HOROVOD_ROUND_PIPELINE": "2"},
+    {"HOROVOD_SPEC_READY_AFTER": "1", "HOROVOD_ROUND_PIPELINE": "2"},
+], ids=["spec", "pipeline", "both"])
+def test_torovodrun_zero_rtt_collectives(knobs):
+    """ISSUE 11 acceptance (results half): the full collective worker —
+    which asserts numeric correctness of every op against the expected
+    values — runs green with speculative readiness and/or pipelined
+    rounds on.  Zero-RTT changes WHEN verdicts return, never what
+    executes: the same assertions that pin lock-step results pin these."""
+    res = _run_torovodrun(2, WORKER, extra_env=knobs)
+    ok = res.stdout.count("WORKER_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
+def test_torovodrun_zero_rtt_hierarchical_collectives():
+    """ISSUE 11 through the per-host agents: speculation's confirm-bearing
+    warm frames must keep aggregating (host_agent treats an identical
+    ZRT7 confirm as part of the warm core) while results stay correct."""
+    res = _run_torovodrun(2, WORKER,
+                          extra_args=("--hierarchical-controller",),
+                          extra_env={"HOROVOD_SPEC_READY_AFTER": "1"})
+    ok = res.stdout.count("WORKER_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
 
 
 WORKER_AUTOSCALE = os.path.join(REPO, "tests", "data",

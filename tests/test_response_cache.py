@@ -37,7 +37,7 @@ class E:
             self.sanitizer_tag = tag
 
 
-def _pair(fn, cache_capacity=2048):
+def _pair(fn, cache_capacity=2048, **ctl_kwargs):
     """Run ``fn(ctl, rank)`` on two connected controller clients (rank 0
     hosts the server and keeps it alive until rank 1 finishes)."""
     port = _free_port()
@@ -47,7 +47,7 @@ def _pair(fn, cache_capacity=2048):
     def worker(rank):
         ctl = TCPController("127.0.0.1", port, rank=rank, world=2,
                             stall_warn_s=60.0,
-                            cache_capacity=cache_capacity)
+                            cache_capacity=cache_capacity, **ctl_kwargs)
         try:
             results[rank] = fn(ctl, rank)
         except Exception as exc:  # noqa: BLE001 - surfaced by the assert
@@ -509,6 +509,126 @@ def test_v6_clean_leave_drops_rank_without_abort():
 
     res = _pair(fn)
     assert res == {0: "survived", 1: "left"}
+
+
+# --------------------------------------------------- zero-RTT warm path (v7)
+def test_v7_zero_rtt_ad_round1_only_and_warm_path_pinned():
+    """Protocol-v7 frame guard: the zero-RTT machinery costs ZERO warm
+    bytes while speculation is off — the ZRT7 capability ad rides round 1
+    only (request side between LVE6 and the final FLT1; response side
+    after LVE6), composing with the AGG5/LVE6/FLT1 section walks (all
+    four capability latches land), and the steady-state frame stays the
+    exact pinned 13 bytes."""
+
+    def fn(ctl, rank):
+        assert not ctl.peer_zero_rtt_proto
+        _steps(ctl, lambda: [E("t")], 2)            # warm-up: learn slot
+        # Round 1's response carried every capability ad, ZRT7 included —
+        # the v4/v5/v6/v7 section walks compose.
+        assert ctl.peer_zero_rtt_proto
+        assert ctl.peer_fault_proto and ctl.peer_hier_proto
+        assert ctl.peer_leave_proto
+        bytes_before = ctl.bytes_sent
+        rounds_before = ctl.rounds
+        _steps(ctl, lambda: [E("t")], 4)
+        per_round = ((ctl.bytes_sent - bytes_before)
+                     / (ctl.rounds - rounds_before))
+        assert per_round == 13, (
+            f"warm-path frame grew to {per_round}B — the v7 zero-RTT "
+            f"fields must cost zero warm bytes with speculation off")
+        assert ctl.spec_rounds == 0 and ctl.inflight_high_water == 0
+        return True
+
+    _pair(fn)
+
+
+def test_v7_speculation_skips_round_trips_in_steady_state():
+    """THE zero-RTT claim at the wire level: with spec_ready_after=1,
+    steady-state cycles return the predicted verdict WITHOUT waiting for
+    the response — every measured cycle is speculative, every validation
+    a hit, verdict order identical across ranks, and the warm frame is
+    the 13-byte core plus only the 9-byte one-shot confirm section."""
+    names = [f"zrt.{i}" for i in range(6)]
+
+    def fn(ctl, rank):
+        mk = lambda: [E(n) for n in names]           # noqa: E731
+        _steps(ctl, mk, 3)                           # warm-up + streak
+        s0, b0, r0 = ctl.spec_rounds, ctl.bytes_sent, ctl.rounds
+        orders = _steps(ctl, mk, 6)
+        assert ctl.spec_rounds - s0 == 6, (ctl.spec_rounds, s0)
+        assert ctl.spec_mispredicts == 0
+        assert ctl.spec_hits >= 5                    # validated one behind
+        per_round = (ctl.bytes_sent - b0) / (ctl.rounds - r0)
+        assert per_round <= 22, per_round            # 13 core + 9 confirm
+        assert ctl.inflight_high_water == 1          # bounded window
+        return orders
+
+    res = _pair(fn, spec_ready_after=1)
+    assert res[0] == res[1]
+
+
+def test_v7_forced_mispredict_costs_one_round_then_recovers():
+    """Mispredict fallback semantics: rank 1 breaks the prediction by
+    skipping a cycle.  Rank 0's speculatively-consumed verdict needs no
+    repair; the NEXT cycle detects the mispredict and falls back to
+    exactly ONE normal lock-step round that delivers the merged verdict,
+    after which the streak rebuilds and speculation re-engages.  Results
+    (verdict names and order) are identical to what lock-step would have
+    delivered."""
+
+    def fn(ctl, rank):
+        mk = lambda: [E("t")]                        # noqa: E731
+        _steps(ctl, mk, 3)                           # speculation engaged
+        assert ctl.spec_rounds >= 1
+        if rank == 0:
+            ready, errs = ctl.negotiate([E("t")])
+            assert not errs
+            assert [e.name for e in ready] == ["t"]  # speculative verdict
+            assert ctl.last_round_speculative
+            m0, s0 = ctl.spec_mispredicts, ctl.spec_rounds
+            # Fallback: ONE normal round absorbs the mispredict — the
+            # merged pending entry delivers this cycle's verdict.
+            ready, errs = ctl.negotiate([E("t")])
+            assert not errs
+            assert ctl.spec_mispredicts == m0 + 1
+            assert ctl.spec_rounds == s0             # lock-step round
+            assert not ctl.last_round_speculative
+            assert [e.name for e in ready] == ["t"]
+            # Steady state: streak rebuilds, speculation resumes.
+            _steps(ctl, mk, 3)
+            assert ctl.spec_rounds > s0
+        else:
+            ctl.negotiate([])                        # breaks the prediction
+            ready, errs = ctl.negotiate([E("t")])
+            assert not errs
+            assert [e.name for e in ready] == ["t"]
+            _steps(ctl, mk, 3)
+        return True
+
+    _pair(fn, spec_ready_after=1)
+
+
+def test_v7_round_pipelining_adds_zero_warm_bytes():
+    """Pipelined rounds (HOROVOD_ROUND_PIPELINE=2): verdicts land one
+    call later — off the critical path — with NO wire-format change (the
+    window is purely client-side: the server's reassembly buffer already
+    accepts early frames), identical verdict order across ranks, and the
+    in-flight window actually engaged."""
+    names = [f"pl.{i}" for i in range(4)]
+
+    def fn(ctl, rank):
+        mk = lambda: [E(n) for n in names]           # noqa: E731
+        _steps(ctl, mk, 3)
+        b0, r0 = ctl.bytes_sent, ctl.rounds
+        orders = _steps(ctl, mk, 5)
+        per_round = (ctl.bytes_sent - b0) / (ctl.rounds - r0)
+        assert per_round <= 13, per_round            # zero extra bytes
+        assert ctl.inflight_high_water >= 1          # window engaged
+        assert ctl.inflight_high_water <= 2          # ...and bounded
+        return orders
+
+    res = _pair(fn, round_pipeline=2)
+    assert res[0] == res[1]
 
 
 def test_v6_leave_with_outstanding_work_gets_typed_abort():

@@ -1,0 +1,300 @@
+"""Protocol-v7 downgrade matrix (tier-1, no jax / no spawns).
+
+The zero-RTT warm path must be INVISIBLE to older peers: a v7 client
+against a v5/v6-era server (simulated faithfully at the wire level — the
+native server is always current, so the old server is a Python fake
+speaking the pre-v7 response format), pre-v7 clients against the v7
+server, and mixed-version fleets must all negotiate cleanly with
+speculation and pipelining silently disabled and no wire bytes changed
+for the old side.  The positive-path frame guards live in
+``tests/test_response_cache.py``; the cross-process fault sweep with
+pipelining on lives in ``tests/test_multiprocess.py``.
+"""
+
+import socket
+import struct
+import threading
+
+import numpy as np
+
+from horovod_tpu.common.controller import TCPController
+
+_MON_MAGIC = 0x314E4F4D
+_FLT_MAGIC = 0x31544C46
+_AGG_MAGIC = 0x35474741
+_LVE_MAGIC = 0x3645564C
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class E:
+    """Minimal negotiable entry (the controller only getattr-probes it)."""
+
+    def __init__(self, name, shape=(4,)):
+        self.name = name
+        self.tensor = np.zeros((2,) + tuple(shape), np.float32)
+        self.group_id = -1
+
+
+def _steps(ctl, make_entries, n_steps, max_rounds=20):
+    orders = []
+    for _ in range(n_steps):
+        entries = list(make_entries())
+        got = []
+        for _round in range(max_rounds):
+            if not entries:
+                break
+            ready, errs = ctl.negotiate(entries)
+            assert not errs, errs
+            got += [e.name for e in ready]
+            entries = [e for e in entries if e.name not in set(got)]
+        assert not entries, f"never became ready: {[e.name for e in entries]}"
+        orders.append(tuple(got))
+    return orders
+
+
+def _pair(fn, per_rank=None, **ctl_kwargs):
+    """Two controller clients against the REAL native server; shared
+    kwargs via ``ctl_kwargs``, or per-rank dicts via ``per_rank`` (a
+    {rank: kwargs} mapping — the mixed-version matrix case)."""
+    port = _free_port()
+    results, errors = {}, {}
+    peer_done = threading.Event()
+
+    def kwargs_for(rank):
+        if per_rank is not None:
+            return per_rank.get(rank, {})
+        return ctl_kwargs
+
+    def worker(rank):
+        ctl = TCPController("127.0.0.1", port, rank=rank, world=2,
+                            stall_warn_s=60.0, **kwargs_for(rank))
+        try:
+            results[rank] = fn(ctl, rank)
+        except Exception as exc:  # noqa: BLE001 - surfaced by the assert
+            errors[rank] = exc
+        finally:
+            if rank == 1:
+                peer_done.set()
+                ctl.shutdown()
+            else:
+                peer_done.wait(timeout=20)
+                ctl.shutdown()
+
+    t1 = threading.Thread(target=worker, args=(1,), daemon=True)
+    t1.start()
+    worker(0)
+    t1.join(timeout=20)
+    assert not errors, errors
+    assert set(results) == {0, 1}, results
+    return results
+
+
+# --------------------------------------------- pre-v7 clients, v7 server
+def test_pre_v7_clients_against_v7_server():
+    """Old clients (no ZRT7 ad, trailing walk stops at unknown magics)
+    against the current native server: negotiation is clean, the warm
+    path stays the exact pre-v7 13 bytes, and nothing speculative ever
+    engages — the server only predicts once EVERY rank latched v7."""
+
+    def fn(ctl, rank):
+        mk = lambda: [E("t")]                        # noqa: E731
+        _steps(ctl, mk, 2)
+        # The old client never latches (its walk treats ZRT7 as unknown)
+        # and the v4/v5/v6 latches it understands still land.
+        assert not ctl.peer_zero_rtt_proto
+        assert ctl.peer_fault_proto and ctl.peer_leave_proto
+        b0, r0 = ctl.bytes_sent, ctl.rounds
+        orders = _steps(ctl, mk, 4)
+        per_round = (ctl.bytes_sent - b0) / (ctl.rounds - r0)
+        assert per_round == 13, per_round
+        assert ctl.spec_rounds == 0 and not ctl._predicted
+        return orders
+
+    # spec armed server-side: it must still never predict to old clients.
+    res = _pair(fn, zero_rtt=False, spec_ready_after=1)
+    assert res[0] == res[1]
+
+
+def test_mixed_version_fleet_silently_disables_speculation():
+    """One v7 rank + one pre-v7 rank: the server withholds predictions
+    (the all-ranks-v7 gate), so the v7 rank never speculates, no response
+    byte changes for the old rank, and verdicts stay identical."""
+
+    def fn(ctl, rank):
+        mk = lambda: [E("t"), E("u")]                # noqa: E731
+        orders = _steps(ctl, mk, 5)
+        if rank == 0:
+            assert ctl.peer_zero_rtt_proto           # ad latched fine...
+            assert ctl.spec_rounds == 0              # ...but never predicted
+            assert not ctl._predicted
+        else:
+            assert not ctl.peer_zero_rtt_proto
+        b0, r0 = ctl.bytes_sent, ctl.rounds
+        _steps(ctl, mk, 3)
+        per_round = (ctl.bytes_sent - b0) / (ctl.rounds - r0)
+        assert per_round == 13, (rank, per_round)    # no confirm ever sent
+        return orders
+
+    res = _pair(fn, per_rank={0: dict(spec_ready_after=1),
+                              1: dict(zero_rtt=False, spec_ready_after=1)})
+    assert res[0] == res[1]
+
+
+def test_spec_ready_after_gates_engagement_conservatively():
+    """The knob is live on BOTH sides: the server waits k
+    ready-on-first-announce rounds before predicting, and the client
+    waits k consecutive prediction-bearing responses before consuming —
+    so a larger k engages speculation strictly later (the conservatism
+    axis the autotune coordinate walks), while both eventually engage on
+    a stable workload."""
+    counts = {}
+    for k in (1, 3):
+        def fn(ctl, rank):
+            _steps(ctl, lambda: [E("t")], 10)
+            return ctl.spec_rounds
+
+        res = _pair(fn, spec_ready_after=k)
+        assert res[0] == res[1], res
+        counts[k] = res[0]
+    assert counts[1] > counts[3] >= 1, counts
+
+
+# --------------------------------------------- v7 client, pre-v7 server
+class _FakeV6Server:
+    """A wire-faithful v5/v6-era coordinator for ONE client: full-string
+    negotiation (no slot assignments — pre-v7 servers had them, but
+    withholding them exercises the client's permanent full-announce
+    path), round-1 FLT1/AGG5/LVE6 ads, and NO ZRT7 anything.  Ignores
+    request trailing sections it does not understand — the documented
+    old-peers-ignore-trailing-bytes contract the v7 ad rides on."""
+
+    def __init__(self):
+        self._lsock = socket.socket()
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(1)
+        self.port = self._lsock.getsockname()[1]
+        self.rounds = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _read_exact(self, conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _run(self):
+        try:
+            conn, _ = self._lsock.accept()
+            self._read_exact(conn, 4)                # rank handshake
+            while True:
+                hdr = self._read_exact(conn, 4)
+                if hdr is None:
+                    return
+                (ln,) = struct.unpack("<I", hdr)
+                data = self._read_exact(conn, ln) if ln else b""
+                if data is None:
+                    return
+                self.rounds += 1
+                conn.sendall(self._respond(data))
+        except OSError:
+            pass
+        finally:
+            try:
+                self._lsock.close()
+            except OSError:
+                pass
+
+    def _respond(self, data):
+        # Parse the announce section only; world=1, so everything
+        # announced is immediately ready.  Trailing request sections
+        # (including a v7 ad or confirm) are simply never parsed.
+        off = 0
+        (n_ann,) = struct.unpack_from("<I", data, off)
+        off += 4
+        ready = []
+        for _ in range(n_ann):
+            off += 2                                  # required
+            fields = []
+            for _f in range(5):
+                (fl,) = struct.unpack_from("<H", data, off)
+                off += 2
+                fields.append(data[off:off + fl])
+                off += fl
+            name, digest, group = fields[0], fields[1], fields[2]
+            ready.append((name, digest, group))
+        resp = struct.pack("<I", len(ready))
+        for name, digest, group in ready:
+            for f in (name, digest, group):
+                resp += struct.pack("<H", len(f)) + f
+        resp += struct.pack("<I", 0)                  # warns
+        resp += struct.pack("<I", 0)                  # errors
+        resp += struct.pack("<I", 0)                  # assigns
+        resp += struct.pack("<I", 0)                  # ready bitvector
+        resp += struct.pack("<I", 0)                  # evictions
+        resp += struct.pack("<II", _MON_MAGIC, 0)     # v3 ad
+        if self.rounds == 1:
+            resp += struct.pack("<II", _FLT_MAGIC, 0)       # v4 ad
+            resp += struct.pack("<II", _AGG_MAGIC, 0)       # v5 ad
+            resp += struct.pack("<III", _LVE_MAGIC, 4, 0)   # v6 ad
+        return struct.pack("<I", len(resp)) + resp
+
+    def stop(self):
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+
+
+def test_v7_client_against_pre_v6_server_downgrades_cleanly():
+    """A v7 client (speculation armed, ads sent) against a v5/v6-era
+    server: the old server ignores the trailing ZRT7 ad, never predicts,
+    and the client silently stays lock-step — clean verdicts, zero
+    speculative rounds, no prediction state."""
+    srv = _FakeV6Server()
+    try:
+        ctl = TCPController("127.0.0.1", srv.port, rank=1, world=2,
+                            stall_warn_s=60.0, spec_ready_after=1)
+        try:
+            orders = _steps(ctl, lambda: [E("t"), E("u")], 4)
+            assert orders and all(set(o) == {"t", "u"} for o in orders)
+            # v4/v5/v6 latched from the old server's ads; v7 never.
+            assert ctl.peer_fault_proto and ctl.peer_hier_proto
+            assert ctl.peer_leave_proto
+            assert not ctl.peer_zero_rtt_proto
+            assert ctl.spec_rounds == 0 and not ctl._predicted
+            assert ctl.spec_hits == 0 and ctl.spec_mispredicts == 0
+        finally:
+            ctl.shutdown()
+    finally:
+        srv.stop()
+
+
+def test_v7_pipelined_client_against_pre_v6_server():
+    """Round pipelining is purely client-side (the server's reassembly
+    buffer already accepts early frames — true of the old server too, it
+    reads frames sequentially), so depth 2 against the pre-v7 server
+    still negotiates every verdict, one call late."""
+    srv = _FakeV6Server()
+    try:
+        ctl = TCPController("127.0.0.1", srv.port, rank=1, world=2,
+                            stall_warn_s=60.0, round_pipeline=2)
+        try:
+            orders = _steps(ctl, lambda: [E("t")], 5)
+            assert all(o == ("t",) for o in orders)
+            assert ctl.inflight_high_water >= 1
+        finally:
+            ctl.shutdown()
+    finally:
+        srv.stop()
