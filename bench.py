@@ -255,190 +255,157 @@ def _control_plane_stats():
             "trace": trace}
 
 
-def _negotiation_world(world, ranks_per_host, rounds, warm=5):
-    """One simulated negotiation world against a REAL native root server:
-    ``world`` lightweight rank threads speaking raw warm-path frames
-    (n_full=0 / empty bitvector / n_tag=0 — the steady-state floor), flat
-    (every rank a direct connection) or behind per-host ``HostAgent``
-    aggregators.  Returns ``(round_us, root_service_us)``: mean wall
-    microseconds per lock-step round (box-bound on a shared CPU host —
-    every simulated rank burns this machine's cycles) and the root's OWN
-    per-round service time (gather-complete -> last response write, read
-    from the native server) — the serialized coordinator work that gates
-    production world sizes, and the quantity the hierarchy shrinks.
-    Self-contained: own server, own ports, no jax, no live engine."""
-    import ctypes
-    import socket as _sock
-    import struct as _struct
-    import threading as _threading
-    from horovod_tpu.common.host_agent import HostAgent
-    from horovod_tpu.common.native import load as _load
-
-    def free_port():
-        s = _sock.socket()
-        s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]
-        s.close()
-        return p
-
-    lib = _load()
-    port = free_port()
-    server = lib.hvdtpu_server_start(port, world, ctypes.c_double(600.0),
-                                     2048, 0, 0)
-    if not server:
-        raise RuntimeError(f"bench server failed to start on port {port}")
-    agents = []
-    connect_port = {}
-    # ranks_per_host == world is the single-agent-for-the-whole-world
-    # topology — still hierarchical (one agent connection at the root),
-    # not a silent second flat run.
-    hier = 0 < ranks_per_host
-    if hier:
-        hosts = [list(range(i, min(world, i + ranks_per_host)))
-                 for i in range(0, world, ranks_per_host)]
-        agents = [HostAgent(0, "127.0.0.1", port, ranks, host_index=j,
-                            connect_timeout_ms=30000).start()
-                  for j, ranks in enumerate(hosts)]
-        for a, ranks in zip(agents, hosts):
-            for r in ranks:
-                connect_port[r] = a.port
-    payload = _struct.pack("<III", 0, 0, 0)      # the 12-byte warm frame
-    wire = _struct.pack("<I", len(payload)) + payload
-    start_bar = _threading.Barrier(world + 1, timeout=60)
-    done_bar = _threading.Barrier(world + 1, timeout=120)
-    stop_evt = _threading.Event()
-    failures = []
-
-    def rank_loop(rank):
+def _raise_nofile_limit():
+    """Best-effort RLIMIT_NOFILE bump toward the hard limit (a 2048-rank
+    simulated world needs thousands of in-process sockets); returns the
+    resulting soft limit."""
+    import resource
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
         try:
-            s = None
-            deadline = time.monotonic() + 30
-            while time.monotonic() < deadline:
-                try:
-                    s = _sock.create_connection(
-                        ("127.0.0.1", connect_port.get(rank, port)),
-                        timeout=5)
-                    break
-                except OSError:
-                    time.sleep(0.02)
-            if s is None:
-                raise OSError(f"rank {rank} never connected")
-            s.setsockopt(_sock.IPPROTO_TCP, _sock.TCP_NODELAY, 1)
-            s.sendall(_struct.pack("<I", rank))
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+            soft = hard
+        except (ValueError, OSError):
+            pass
+    return soft
 
-            def do_round():
-                s.sendall(wire)
-                hdr = b""
-                while len(hdr) < 4:
-                    c = s.recv(4 - len(hdr))
-                    if not c:
-                        raise OSError("server severed")
-                    hdr += c
-                (n,) = _struct.unpack("<I", hdr)
-                left = n
-                while left:
-                    c = s.recv(min(left, 65536))
-                    if not c:
-                        raise OSError("server severed")
-                    left -= len(c)
 
-            for _ in range(warm):
-                do_round()
-            start_bar.wait()
-            for _ in range(rounds):
-                do_round()
-            done_bar.wait()
-            stop_evt.wait(30)
-            s.close()
-        except Exception as exc:  # noqa: BLE001 - recorded, never hangs
-            failures.append((rank, repr(exc)))
-            try:
-                start_bar.abort()
-                done_bar.abort()
-            except Exception:  # noqa: BLE001
-                pass
-
-    threads = [_threading.Thread(target=rank_loop, args=(r,), daemon=True)
-               for r in range(world)]
-    root_service_us = 0.0
-    wall = 0.0
-    broken = False
-    try:
-        for t in threads:
-            t.start()
-        # A failing rank thread aborts both barriers; catch the break HERE
-        # so the informative per-rank failure reprs below (not an opaque
-        # BrokenBarrierError) are what lands in the bench's errors dict.
-        try:
-            start_bar.wait()
-            t0 = time.perf_counter()
-            done_bar.wait()
-            wall = time.perf_counter() - t0
-        except _threading.BrokenBarrierError:
-            broken = True
-        if not broken:
-            stats = (ctypes.c_double * 2)()
-            if lib.hvdtpu_server_stats(server, stats) == 0:
-                root_service_us = float(stats[1])
-    finally:
-        stop_evt.set()
-        for t in threads:
-            t.join(timeout=10)
-        for a in agents:
-            a.stop()
-        lib.hvdtpu_server_stop(server)
-    if failures or broken:
+def _negotiation_world(world, ranks_per_host, rounds, warm=5, hier=None,
+                       script=()):
+    """One simulated negotiation world against a REAL native root server,
+    now driven by the churn-scenario runner
+    (``horovod_tpu.testing.churn.ChurnRunner``): ``world`` lightweight
+    rank threads speaking raw warm-path frames (the steady-state floor),
+    flat (every rank a direct connection) or behind per-host ``HostAgent``
+    aggregators, with an optional CHURN SCRIPT (clean LEAVEs, join
+    epochs, agent death, preemption drains) replayed mid-run.  Returns
+    the runner's report: ``wall_us_per_round`` (box-bound on a shared CPU
+    host), ``root_us`` (the root's OWN gather-complete -> responses-
+    written service time), per-phase breakdowns across the churn, and
+    ``survived``.  Self-contained: own server, own ports, no jax, no
+    live engine."""
+    from horovod_tpu.testing.churn import ChurnRunner
+    if hier is None:
+        hier = ranks_per_host > 0
+    rep = ChurnRunner(world, ranks_per_host=ranks_per_host,
+                      hier=hier, rounds=rounds, warm=warm,
+                      script=script).run()
+    if not rep["survived"]:
         raise RuntimeError(
-            f"negotiation bench ranks failed: "
-            f"{failures[:4] if failures else 'barrier broken/timed out'}")
-    return wall / rounds * 1e6, root_service_us
+            f"negotiation bench world failed: {rep['abort_reason']} "
+            f"(failures: {rep['failures'][:4]})")
+    return rep
+
+
+def _default_churn_script(world, ranks_per_host, rounds, hier):
+    """The standard mid-run churn for the scaling sweep: a preemption
+    notice drains the LAST host (its ranks depart via clean LEAVEs), the
+    drained host's agent then dies (survivable — its ranks already left),
+    and a fleet-wide join epoch flushes the slot table.  All scheduled
+    inside the measured window so the post-churn phases measure the
+    SURVIVORS' root service.  Host indices follow ChurnRunner's grouping
+    (ceil(world / ranks_per_host) groups — NOT the nominal host-count
+    knob, which can exceed it for non-divisible worlds)."""
+    from horovod_tpu.testing.faults import parse_churn
+    n_groups = (world + ranks_per_host - 1) // ranks_per_host
+    if rounds < 9 or n_groups < 2:
+        return []
+    last = n_groups - 1
+    r1 = max(2, rounds // 3)
+    parts = [f"preempt_notice:{last}@{r1}"]
+    if hier:
+        parts.append(f"agent_crash:{last}@{min(rounds, r1 + 2)}")
+    parts.append(f"join:*@{max(r1 + 3, (2 * rounds) // 3)}")
+    return parse_churn(",".join(parts))
 
 
 def bench_negotiation_scaling(errors=None):
-    """Scale-out control plane A/B (ISSUE 9): drive simulated world sizes
-    through the REAL native root server — flat single-server vs the
-    hierarchical per-host-agent plane with a FIXED host count (the
-    scale-up shape: bigger worlds mean more ranks per host, one uplink per
-    host either way).  Two metrics per size: ``round_us`` (wall per
-    lock-step round — box-bound here, every simulated rank burns this same
-    machine's CPU) and ``root_us`` (the root's own gather-complete ->
-    responses-written service time, from the native server's counters).
-    The claim under test is the second one: root work scales with
-    CONNECTIONS, so hierarchical ``root_us`` stays ~flat 8->128 ranks
-    while the flat server's grows with the world.  Self-contained (own
-    servers on free ports): runs only in the rank-0 process and touches
-    nothing of the live engine."""
+    """Scale-out control plane A/B under churn (ISSUE 9 + ISSUE 12):
+    drive simulated world sizes — now up to 2048 ranks — through the REAL
+    native root server, flat single-server vs the hierarchical
+    per-host-agent plane with a FIXED host count, with scripted churn
+    (preemption-notice drain → clean LEAVEs, agent death, a join epoch)
+    injected MID-RUN in both planes.  Two metrics per size: ``round_us``
+    (wall per lock-step round — box-bound here) and ``root_us`` (the
+    root's own gather-complete -> responses-written service time).  The
+    claims under test: root work scales with CONNECTIONS (hier ``root_us``
+    stays ~flat as ranks grow), and it KEEPS that shape through churn —
+    ``hier_slope_post`` reads the slope on the post-churn phases, and
+    ``churn_survived`` certifies no run took an abort.  Self-contained
+    (own servers on free ports): runs only in the rank-0 process and
+    touches nothing of the live engine."""
     if os.environ.get("HOROVOD_RANK", "0") not in ("", "0"):
         return None
     sizes = [int(s) for s in os.environ.get(
         "HVD_BENCH_NEGOTIATION_SIZES", "8,32,128").split(",") if s]
-    sizes = sorted({max(2, min(s, 512)) for s in sizes})
+    sizes = sorted({max(2, min(s, 2048)) for s in sizes})
+    # A 2048-rank flat world needs ~2x2048 fds in this one process (and
+    # hierarchical ~4x): raise the soft limit, then clamp the sweep to
+    # what the box actually allows rather than dying with EMFILE.
+    soft = _raise_nofile_limit()
+    fd_cap = max(2, (soft - 256) // 4)
+    dropped = [s for s in sizes if s > fd_cap]
+    if dropped:
+        sizes = [s for s in sizes if s <= fd_cap] or [min(fd_cap, 128)]
+        if errors is not None:
+            errors["negotiation_scaling_fd_clamp"] = (
+                f"sizes {dropped} exceed the fd budget (soft limit {soft})"
+                f"; clamped to <= {fd_cap}")
     rounds = int(os.environ.get("HVD_BENCH_NEGOTIATION_ROUNDS", "30"))
     n_hosts = max(1, int(os.environ.get("HVD_BENCH_NEGOTIATION_HOSTS", "8")))
-    out = {"rounds": rounds, "hosts": n_hosts, "sizes": {}}
+    churn_on = os.environ.get("HVD_BENCH_NEGOTIATION_CHURN", "1") != "0"
+    out = {"rounds": rounds, "hosts": n_hosts, "churn": churn_on,
+           "sizes": {}}
     t_section = time.perf_counter()
+    survived_all = True
     for world in sizes:
         hosts = min(world, n_hosts)
         rph = (world + hosts - 1) // hosts
-        rec = {"hosts": hosts, "ranks_per_host": rph}
-        rec["flat_round_us"], rec["flat_root_us"] = [
-            round(v, 1) for v in _negotiation_world(world, 0, rounds)]
-        rec["hier_round_us"], rec["hier_root_us"] = [
-            round(v, 1) for v in _negotiation_world(world, rph, rounds)]
+        # Big worlds amortize: every simulated rank burns this same box's
+        # CPU, so scale the round count down as the world grows.
+        w_rounds = rounds if world <= 512 else max(12, rounds // 3)
+        rec = {"hosts": hosts, "ranks_per_host": rph, "rounds": w_rounds}
+        script = (_default_churn_script(world, rph, w_rounds, False)
+                  if churn_on else [])
+        flat_rep = _negotiation_world(world, rph, w_rounds, hier=False,
+                                      script=script)
+        script = (_default_churn_script(world, rph, w_rounds, True)
+                  if churn_on else [])
+        hier_rep = _negotiation_world(world, rph, w_rounds, hier=True,
+                                      script=script)
+        rec["flat_round_us"] = flat_rep["wall_us_per_round"]
+        rec["flat_root_us"] = flat_rep["root_us"]
+        rec["hier_round_us"] = hier_rep["wall_us_per_round"]
+        rec["hier_root_us"] = hier_rep["root_us"]
         rec["flat_vs_hier"] = (round(rec["flat_root_us"]
                                      / rec["hier_root_us"], 3)
                                if rec["hier_root_us"] else None)
+        if churn_on:
+            rec["churn_survived"] = (flat_rep["survived"]
+                                     and hier_rep["survived"])
+            survived_all = survived_all and rec["churn_survived"]
+            rec["left_ranks"] = hier_rep["left_ranks"]
+            rec["flat_root_us_post_churn"] = flat_rep["root_us_post"]
+            rec["hier_root_us_post_churn"] = hier_rep["root_us_post"]
         out["sizes"][str(world)] = rec
     big, small = out["sizes"][str(sizes[-1])], out["sizes"][str(sizes[0])]
     # Scoreboard: how much each plane's ROOT service degraded across the
     # sweep (1.0 = perfectly flat) and the headline flat/hier ratio at the
     # largest world.  The acceptance shape: flat_slope tracks the world
-    # growth while hier_slope stays near 1 (root sees a fixed host count).
+    # growth while hier_slope stays near 1 (root sees a fixed host count)
+    # — and hier_slope_post pins the SAME claim on the post-churn phases,
+    # i.e. the hierarchy's win does not evaporate where fleets churn.
     out["flat_slope"] = (round(big["flat_root_us"] / small["flat_root_us"],
                                3) if small["flat_root_us"] else None)
     out["hier_slope"] = (round(big["hier_root_us"] / small["hier_root_us"],
                                3) if small["hier_root_us"] else None)
     out["flat_vs_hier"] = big["flat_vs_hier"]
+    if churn_on:
+        out["churn_survived"] = survived_all
+        post_small = small.get("hier_root_us_post_churn")
+        post_big = big.get("hier_root_us_post_churn")
+        out["hier_slope_post"] = (round(post_big / post_small, 3)
+                                  if post_small and post_big else None)
     _record_timing("negotiation_scaling", warmup=5,
                    iters=rounds * len(sizes) * 2,
                    wall_s=time.perf_counter() - t_section,
@@ -1902,6 +1869,11 @@ def main():
         # hierarchical negotiation_us ratio at the largest simulated world
         # in the negotiation_scaling sweep; null until that section runs.
         "flat_vs_hier": None,
+        # Churned-sweep certification (ISSUE 12): True when every
+        # negotiation_scaling world rode out its scripted churn (LEAVEs +
+        # join epoch + agent death) without an abort; null until the
+        # section runs (or with churn disabled).
+        "churn_survived": None,
         "errors": errors,
     }
     budget = float(os.environ.get("HVD_BENCH_TIMEOUT_S", "900"))
@@ -2032,6 +2004,7 @@ def _run(out, errors):
                 out["negotiation_scaling"] = sec
                 if sec:
                     out["flat_vs_hier"] = sec.get("flat_vs_hier")
+                    out["churn_survived"] = sec.get("churn_survived")
             except Exception as exc:  # noqa: BLE001 - contained
                 errors["negotiation_scaling"] = repr(exc)
         try:
@@ -2159,6 +2132,7 @@ def _run(out, errors):
             out["negotiation_scaling"] = sec
             if sec:
                 out["flat_vs_hier"] = sec.get("flat_vs_hier")
+                out["churn_survived"] = sec.get("churn_survived")
         except Exception as exc:  # noqa: BLE001 - contained
             errors["negotiation_scaling"] = repr(exc)
 

@@ -146,7 +146,7 @@
 //   [protocol v7, zero-RTT warm path] uint32 magic "ZRT7"
 //             Speculative readiness: when a cache slot has been
 //             ready-on-first-announce for spec_ready_after consecutive
-//             rounds (hvdtpu_server_start's last arg; 0 = off), the server
+//             rounds (hvdtpu_server_start's 6th arg; 0 = off), the server
 //             piggybacks a PREDICTED next-round ready verdict on this
 //             round's response:
 //               S->C   += uint32 "ZRT7", uint32 len,
@@ -251,7 +251,12 @@
 //
 // Exported C ABI (ctypes-consumed by horovod_tpu/common/native.py):
 //   hvdtpu_server_start(port, world, stall_warn_s, cache_capacity,
-//                       round_deadline_ms, spec_ready_after) -> handle
+//                       round_deadline_ms, spec_ready_after,
+//                       spec_seed) -> handle
+//       (spec_seed: initial speculation streak for newly created cache
+//        slots — the elastic streak-carryover hint a re-rendezvous
+//        survivor passes so warm speculation re-engages in O(1) rounds;
+//        0 = relearn from zero, the non-elastic default)
 //   hvdtpu_server_stop(handle)
 //   hvdtpu_client_connect(host, port, rank, timeout_ms) -> handle
 //   hvdtpu_client_round(handle, req, req_len, resp_buf, resp_cap) -> resp_len
@@ -669,7 +674,21 @@ struct Server {
     // relearn-after-digest-change) resets it for free: a reassigned or
     // relearned record starts from a zeroed streak.
     uint32_t streak = 0;
+    // Per-slot instability backoff (ISSUE 12): mispredict count.  Each
+    // mispredict doubles the streak this slot must rebuild before it is
+    // predicted again (spec_ready_after << unstable, capped) — so a
+    // chronically unstable slot (one rank's irregular announce pattern)
+    // is WITHHELD from predictions instead of repeatedly entering them,
+    // mispredicting, and zeroing every speculating client's engagement
+    // streak for the stable slots too.  Stable slots keep speculating
+    // (frame-guarded).  The penalty decays one step per kValidRunDecay
+    // CONSECUTIVE validated predictions (valid_run) — deliberately much
+    // slower than the escalation, so a slot that alternates short stable
+    // stretches with mispredicts cannot oscillate back into predictions.
+    uint32_t unstable = 0;
+    uint32_t valid_run = 0;
   };
+  static constexpr uint32_t kValidRunDecay = 16;
   // Bounded like the reference's capacity-limited cache; at capacity the
   // least-recently-used non-pending slot is evicted and the eviction is
   // broadcast, so client tables track the server's exactly.  An evicted
@@ -717,6 +736,12 @@ struct Server {
   // mispredicted slots' streaks reset — when that round's verdict lands).
   std::unique_ptr<std::atomic<char>[]> v7;
   int spec_ready_after = 0;
+  // Elastic streak carryover (ISSUE 12): initial streak for NEWLY created
+  // slots.  A survivor of a re-rendezvous passes the previous generation's
+  // engagement hint through hvdtpu_server_start so the fresh slot table
+  // re-predicts after ONE ready-on-first-announce round instead of
+  // relearning spec_ready_after rounds from zero.  0 (default) = no seed.
+  int spec_seed = 0;
   std::set<uint32_t> pred_slots;
   int pred_carry_rounds = 0;   // consecutive rounds a prediction carried
   // Diagnostic speculation accounting (not exported through the stats
@@ -1328,6 +1353,12 @@ void Server::run_inner() {
                 ? group : std::to_string(r) + ":" + group;
             cache_recs[id] = CacheRec{name, digest, datadep, g, required,
                                       true, round_no};
+            // Streak carryover: a seeded fresh slot matures on its FIRST
+            // ready-on-first-announce round (seed + 1 >= spec_ready_after),
+            // re-engaging warm speculation in O(1) rounds after an elastic
+            // re-rendezvous instead of relearning from zero.
+            if (spec_seed > 0)
+              cache_recs[id].streak = static_cast<uint32_t>(spec_seed);
             cache_keys.emplace(key, id);
             ++cache_live;
             ck = cache_keys.find(key);
@@ -1811,7 +1842,17 @@ void Server::run_inner() {
       std::set<uint32_t> carried;
       if (!pred_slots.empty()) {
         for (uint32_t s : pred_slots) {
-          if (ready_now.count(s)) continue;       // validated
+          if (ready_now.count(s)) {
+            // Validated: after a long consecutive run of good
+            // predictions the slot earns one step of its instability
+            // penalty back (slow decay — see the field comment).
+            if (s < cache_recs.size() && cache_recs[s].unstable > 0 &&
+                ++cache_recs[s].valid_run >= kValidRunDecay) {
+              --cache_recs[s].unstable;
+              cache_recs[s].valid_run = 0;
+            }
+            continue;
+          }
           // Not ready: distinguish a genuine mispredict (SOMEONE
           // announced the slot — a speculating client may have consumed
           // the verdict, and the partial announce proves a rank skipped)
@@ -1825,7 +1866,17 @@ void Server::run_inner() {
           if (announced || s >= cache_recs.size() ||
               !cache_recs[s].live) {
             ++spec_mispredicts;
-            if (s < cache_recs.size()) cache_recs[s].streak = 0;
+            if (s < cache_recs.size()) {
+              // Per-slot backoff (ISSUE 12): beyond resetting the streak,
+              // escalate this slot's re-qualification threshold so a
+              // chronically unstable announce pattern withholds ONLY this
+              // slot from future predictions — a repeated mispredict
+              // would otherwise keep zeroing every speculating client's
+              // engagement streak fleet-wide.
+              cache_recs[s].streak = 0;
+              cache_recs[s].valid_run = 0;
+              if (cache_recs[s].unstable < 6) ++cache_recs[s].unstable;
+            }
           } else {
             carried.insert(s);
           }
@@ -1873,9 +1924,14 @@ void Server::run_inner() {
       if (all_v7) {
         for (size_t i = 0; i < ready_slots.size(); ++i) {
           uint32_t s = ready_slots[i];
-          if (s < cache_recs.size() && cache_recs[s].live &&
-              cache_recs[s].streak >=
-                  static_cast<uint32_t>(spec_ready_after))
+          if (s >= cache_recs.size() || !cache_recs[s].live) continue;
+          // Per-slot qualification: an unstable slot must rebuild a
+          // streak of spec_ready_after << unstable (capped) before it is
+          // predicted again — the withholding that keeps one flaky
+          // tensor from disengaging speculation for the stable ones.
+          uint64_t need = static_cast<uint64_t>(spec_ready_after)
+              << std::min<uint32_t>(cache_recs[s].unstable, 6u);
+          if (static_cast<uint64_t>(cache_recs[s].streak) >= need)
             pred_slots.insert(s);
         }
         // Idle-round carry: unconsumed predictions stand (re-emitted so
@@ -2031,7 +2087,7 @@ extern "C" {
 
 void* hvdtpu_server_start(int port, int world, double stall_warn_s,
                           int cache_capacity, int round_deadline_ms,
-                          int spec_ready_after) {
+                          int spec_ready_after, int spec_seed) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return nullptr;
   int one = 1;
@@ -2053,6 +2109,11 @@ void* hvdtpu_server_start(int port, int world, double stall_warn_s,
       : static_cast<size_t>(cache_capacity);
   s->round_deadline_ms = round_deadline_ms < 0 ? 0 : round_deadline_ms;
   s->spec_ready_after = spec_ready_after < 0 ? 0 : spec_ready_after;
+  // The seed is only meaningful below the qualification threshold (a
+  // fresh slot must still prove ONE ready-on-first-announce round), and
+  // only while speculation is armed at all.
+  s->spec_seed = (spec_seed < 0 || s->spec_ready_after == 0)
+      ? 0 : std::min(spec_seed, s->spec_ready_after);
   s->fds = std::make_unique<std::atomic<int>[]>(world);
   s->v4 = std::make_unique<std::atomic<char>[]>(world);
   s->v5 = std::make_unique<std::atomic<char>[]>(world);

@@ -59,6 +59,16 @@ class GlobalState:
 
 _state = GlobalState()
 
+# Elastic carryover across init/shutdown cycles within ONE worker process
+# (ISSUE 12): a re-rendezvous tears the runtime down and re-forms it, but
+# some state is keyed on the HOST/process, not the generation — the
+# per-host agent object (held on GlobalState across shutdowns so its
+# listen port survives) and the zero-RTT engagement hint captured from the
+# dying generation's controller (seeds the next generation's server slot
+# streaks and client consumption gate, so warm speculation re-engages in
+# O(1) rounds instead of relearning from zero).
+_elastic_carry = {"spec_seed": 0}
+
 
 def _get_state() -> GlobalState:
     return _state
@@ -150,7 +160,7 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None,
                          else cfg.controller_port + 1)
             connect_addr, connect_port = cfg.controller_addr, ctrl_port
             server_port = None
-            hier = cfg.hierarchical_controller and not cfg.elastic
+            hier = cfg.hierarchical_controller
             if hier and (cfg.local_rank_env < 0 or cfg.local_size_env <= 0
                          or cfg.cross_rank_env < 0):
                 # Manual launches may set only RANK/SIZE/CONTROLLER_ADDR
@@ -173,8 +183,12 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None,
                 # local_rank-0 process owns its host's agent; rank 0 still
                 # hosts the root server at the launcher-advertised port
                 # while its own client goes through host 0's agent like
-                # everyone else's.  Elastic worlds stay flat: agent
-                # lifecycles don't span re-rendezvous generations yet.
+                # everyone else's.  Elastic worlds compose (ISSUE 12): the
+                # agent object SURVIVES re-rendezvous generations — keyed
+                # on the host, listening on the stable per-host port the
+                # elastic driver allocated (HOROVOD_AGENT_PORT via the
+                # rendezvous assignment) — and each generation re-forms
+                # its uplink/local connections via new_generation.
                 from .host_agent import HostAgent
                 local_rank = cfg.local_rank_env
                 local_size = cfg.local_size_env
@@ -186,12 +200,36 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None,
                     ranks = list(range(first,
                                        min(cfg.size_env,
                                            first + local_size)))
-                    st.host_agent = HostAgent(
-                        agent_port, cfg.controller_addr, ctrl_port,
-                        ranks, host_index=cross_rank).start()
+                    reused = False
+                    if (st.host_agent is not None and cfg.elastic
+                            and st.host_agent.port == agent_port):
+                        try:
+                            st.host_agent.new_generation(
+                                cfg.controller_addr, ctrl_port, ranks,
+                                host_index=cross_rank)
+                            reused = True
+                        except RuntimeError:
+                            # A wedged previous-generation thread: fall
+                            # back to a fresh agent on the same port
+                            # (stop() closes the listener first).
+                            from ..utils.logging import get_logger
+                            get_logger().warning(
+                                "host agent could not serve a new "
+                                "generation; replacing it")
+                    if not reused:
+                        if st.host_agent is not None:
+                            st.host_agent.stop()
+                        st.host_agent = HostAgent(
+                            agent_port, cfg.controller_addr, ctrl_port,
+                            ranks, host_index=cross_rank).start()
                 connect_addr, connect_port = "127.0.0.1", agent_port
                 if cfg.rank_env == 0:
                     server_port = ctrl_port
+            # Zero-RTT streak carryover (ISSUE 12): a surviving elastic
+            # worker seeds the new generation from the hint captured at
+            # the previous shutdown — 0 on the first generation and in
+            # non-elastic worlds.
+            spec_carry = _elastic_carry["spec_seed"] if cfg.elastic else 0
             st.controller = TCPController(
                 connect_addr, connect_port,
                 rank=cfg.rank_env, world=cfg.size_env,
@@ -203,7 +241,9 @@ def init(process_sets: Optional[Sequence[ProcessSet]] = None,
                 connect_backoff_ms=cfg.connect_backoff_ms,
                 server_port=server_port,
                 spec_ready_after=cfg.spec_ready_after,
-                round_pipeline=cfg.round_pipeline)
+                round_pipeline=cfg.round_pipeline,
+                spec_seed=spec_carry,
+                spec_streak_hint=spec_carry)
             st.engine.controller = st.controller
 
         if cfg.monitor:
@@ -278,15 +318,39 @@ def shutdown() -> None:
         if st.monitor is not None:
             st.monitor.close()
             st.monitor = None
+        elastic_world = (st.config is not None and st.config.elastic
+                         and st.config.controller_addr != "")
         if st.controller is not None:
+            # Zero-RTT streak carryover (ISSUE 12): capture the dying
+            # generation's engagement hint before the controller goes
+            # away, so a survivor's re-init re-engages speculation in
+            # O(1) rounds.  A faulted generation carries nothing — and
+            # must also CLEAR any older hint, or a stale seed from two
+            # generations back would leak past the instability that just
+            # killed this one.
+            if elastic_world:
+                if abrupt:
+                    _elastic_carry["spec_seed"] = 0
+                else:
+                    try:
+                        _elastic_carry["spec_seed"] = \
+                            st.controller.spec_carry_hint()
+                    except Exception:  # noqa: BLE001 - telemetry only
+                        _elastic_carry["spec_seed"] = 0
             st.controller.shutdown()
             st.controller = None
         if st.host_agent is not None:
             # After the controller: the agent must outlive this process's
             # own client socket so its teardown EOF is observed (and
             # reported upstream) rather than racing a dead agent thread.
-            st.host_agent.stop()
-            st.host_agent = None
+            # Elastic worlds only END the generation (ISSUE 12): the agent
+            # object — and its stable listen port — survives for the next
+            # re-rendezvous generation's new_generation.
+            if elastic_world:
+                st.host_agent.end_generation()
+            else:
+                st.host_agent.stop()
+                st.host_agent = None
         if st.timeline is not None:
             st.timeline.close()
             st.timeline = None

@@ -204,12 +204,26 @@ class Config:
     # bitvector frames into ONE fixed-size uplink per round, so root-side
     # gather work scales with hosts, not ranks.  Per-rank wire bytes are
     # unchanged (frame-guarded).  Flat single-server mode remains the
-    # default; elastic worlds always run flat (agent lifecycles don't span
-    # re-rendezvous generations yet).  HOROVOD_AGENT_PORT: the agent's
-    # listen port on each host (the launcher assigns one per host); 0 =
-    # derive deterministically from the controller port + cross_rank.
+    # default.  Elastic worlds compose (ISSUE 12): the agent object
+    # survives re-rendezvous generations on a stable per-host port the
+    # elastic driver allocates and ships through the rendezvous
+    # assignment.  HOROVOD_AGENT_PORT: the agent's listen port on each
+    # host (the launcher — or the elastic rendezvous — assigns one per
+    # host); 0 = derive deterministically from controller port +
+    # cross_rank.
     hierarchical_controller: bool = False
     agent_port: int = 0
+
+    # Preemption-driven drains (ISSUE 12, docs/elastic.md).  When the
+    # discovery source posts a preemption notice for a host (e.g.
+    # TPUMetadataDiscovery's `preempted-workers` attribute), the elastic
+    # driver cordons the host and DRAINs its workers — requesting a state
+    # commit first (checkpoint pacing), then the clean-LEAVE departure —
+    # instead of waiting for the hardware to vanish and crash the fleet
+    # mid-collective.  HOROVOD_PREEMPT_GRACE_S bounds the drain: a worker
+    # that has not exited by the deadline is terminated (the legacy sever
+    # path), still classified as a departure, never a blacklist.
+    preempt_grace_s: float = 30.0
 
     # Closed-loop elastic autoscaling (docs/elastic.md "Closed-loop
     # autoscaling") — consumed by the elastic DRIVER (torovodrun
@@ -298,6 +312,7 @@ class Config:
             hierarchical_controller=_env_bool("HIERARCHICAL_CONTROLLER",
                                               False),
             agent_port=_env_int("AGENT_PORT", 0),
+            preempt_grace_s=_env_float("PREEMPT_GRACE_S", 30.0),
             autoscale=_env_bool("AUTOSCALE", False),
             autoscale_interval_s=_env_float("AUTOSCALE_INTERVAL", 5.0),
             autoscale_queue_high=_env_float("AUTOSCALE_QUEUE_HIGH", 16.0),

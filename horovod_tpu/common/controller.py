@@ -102,7 +102,9 @@ class TCPController:
                  server_port: Optional[int] = None,
                  spec_ready_after: int = 0,
                  round_pipeline: int = 1,
-                 zero_rtt: bool = True):
+                 zero_rtt: bool = True,
+                 spec_seed: int = 0,
+                 spec_streak_hint: int = 0):
         # server_port: where rank 0 binds the root coordinator when that
         # differs from where this client connects — the hierarchical
         # control plane (protocol v5) points every client at its local
@@ -178,7 +180,15 @@ class TCPController:
         # since a mispredict resets the streak to zero.  This is the
         # axis the autotune coordinate actually walks.
         self._predicted: set = set()
-        self._pred_streak = 0
+        # Elastic streak carryover (ISSUE 12): a re-rendezvous survivor
+        # seeds the consumption gate from the PREVIOUS generation's
+        # engagement (spec_carry_hint()), so warm speculation re-engages
+        # after the first prediction-bearing response instead of
+        # relearning spec_ready_after responses from zero.  spec_seed is
+        # the server-side twin (initial streak for fresh slots, rank 0
+        # only).  Both default to 0 — the non-elastic behavior unchanged.
+        self._pred_streak = max(0, min(int(spec_streak_hint),
+                                       self.spec_ready_after))
         # Requests sent whose responses are not yet read, oldest first:
         # the consumed prediction (frozenset of slots) for speculative
         # rounds, None for plain pipelined rounds.  Never longer than
@@ -220,7 +230,7 @@ class TCPController:
                 srv_port, world, ctypes.c_double(stall_warn_s),
                 int(cache_capacity),
                 int(self.round_timeout_s * 1000),
-                self.spec_ready_after)
+                self.spec_ready_after, max(0, int(spec_seed)))
             if not self._server:
                 raise RuntimeError(f"Failed to start controller server on "
                                    f"port {srv_port}")
@@ -1145,6 +1155,23 @@ class TCPController:
         if self._join_error is not None:
             raise self._join_error
         return self._join_last_rank
+
+    def spec_carry_hint(self) -> int:
+        """The streak seed a re-rendezvous SURVIVOR carries into the next
+        generation (ISSUE 12 elastic streak carryover): non-zero only when
+        speculation was armed, advertised by the server, and actually
+        engaged (at least one hit) in this generation.  The elastic
+        re-init passes it as both the new server's ``spec_seed`` (rank 0)
+        and the new client's ``spec_streak_hint``, so the warm path
+        re-engages in O(1) rounds instead of relearning from zero."""
+        if (self.spec_ready_after <= 0 or not self.peer_zero_rtt_proto
+                or self.spec_hits <= 0):
+            return 0
+        # A live engagement streak carries verbatim; a generation that
+        # engaged but was mid-rebuild carries the full threshold anyway —
+        # the workload proved stable enough to speculate at least once.
+        return max(1, min(self._pred_streak or self.spec_ready_after,
+                          self.spec_ready_after))
 
     def fail_join(self, exc: BaseException):
         """Fail any pending (and every future) ``join_wait`` with ``exc``.

@@ -36,6 +36,16 @@ presents the whole host to the root as ONE connection:
 Root-side gather work therefore scales with hosts, not ranks: one
 readable fd, one frame parse and one response write per host per round.
 
+**Generation survival (ISSUE 12):** the agent's identity is its HOST, not
+a rendezvous generation.  ``end_generation``/``new_generation`` tear down
+and re-form the per-generation connections (upstream root, local rank
+sockets, round thread) while the listening socket — on the stable
+per-host port the elastic driver allocated — stays bound, so the same
+agent object serves consecutive re-rendezvous generations whose rank sets
+grew, shrank or were renumbered.  This is what lets
+``HOROVOD_HIERARCHICAL_CONTROLLER=1`` compose with elastic worlds instead
+of being silently forced flat.
+
 No jax imports: the agent must run on the jax-free fast test tier and in
 launcher-adjacent processes.
 """
@@ -153,7 +163,11 @@ def split_rank_frame(data: bytes):
 
 class AgentStats:
     """Uplink accounting the frame-guard tests pin: exactly one uplink per
-    round, and how often the fixed-size aggregate path engaged."""
+    round, and how often the fixed-size aggregate path engaged.
+    Cumulative across re-rendezvous GENERATIONS (ISSUE 12): the agent is
+    keyed on its host, not on a generation, so the counters survive
+    ``new_generation`` — ``generations`` records how many worlds this one
+    agent object has served."""
 
     def __init__(self):
         self.rounds = 0
@@ -166,6 +180,7 @@ class AgentStats:
         self.responses_fanned = 0
         self.dead_reports = 0          # out-of-round dead-rank uplinks
         self.leaves_forwarded = 0      # clean LEAVEs relayed upstream (v6)
+        self.generations = 0           # worlds served by this agent object
 
 
 class HostAgent:
@@ -217,14 +232,21 @@ class HostAgent:
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "HostAgent":
+        self.stats.generations += 1
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"hvd-host-agent-{self.host_index}")
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def end_generation(self) -> None:
+        """Tear down this GENERATION's connections — upstream root, local
+        rank sockets, the round thread — while keeping the LISTENER bound
+        (ISSUE 12): the agent's identity is its host (and the stable port
+        the elastic driver allocated for that host), not a generation.
+        ``new_generation`` re-accepts the next world on the same port.
+        Idempotent; safe on a generation that already failed."""
         self._stop.set()
-        for s in [self._lsock, self._up, *self._local.values()]:
+        for s in [self._up, *self._local.values()]:
             if s is not None:
                 try:
                     s.shutdown(socket.SHUT_RDWR)
@@ -233,7 +255,15 @@ class HostAgent:
         t = self._thread
         if t is not None:
             t.join(timeout=10)
-        for s in [self._lsock, self._up, *self._local.values()]:
+            if t.is_alive():
+                # Left in place as poison: new_generation refuses to run
+                # beside a thread that would read the replaced stop event
+                # and race the fresh generation's state.
+                self.error = (self.error
+                              or "generation thread failed to stop")
+            else:
+                self._thread = None
+        for s in [self._up, *self._local.values()]:
             if s is not None:
                 try:
                     s.close()
@@ -241,6 +271,52 @@ class HostAgent:
                     pass
         self._local.clear()
         self._up = None
+        self._bufs.clear()
+        self._left_pending.clear()
+        self._reported_dead.clear()
+        self._deferred_dead = []
+
+    def new_generation(self, upstream_addr: str, upstream_port: int,
+                       ranks: List[int],
+                       host_index: Optional[int] = None) -> "HostAgent":
+        """Serve the NEXT re-rendezvous generation from the same agent
+        object: the previous generation (if any) is ended, the rank set —
+        which may have grown, shrunk, or been renumbered by the elastic
+        driver — replaces the old one, the uplink re-connects to the new
+        generation's root, and local ranks re-connect to the SAME listen
+        port.  This is what lets ``HOROVOD_HIERARCHICAL_CONTROLLER=1``
+        survive elastic churn: LEAVE/join re-negotiate the host's uplink
+        width instead of forcing the fleet flat."""
+        if not ranks:
+            raise ValueError("HostAgent.new_generation needs ranks")
+        self.end_generation()
+        if self._thread is not None and self._thread.is_alive():
+            # The old round thread would read the REPLACED stop event and
+            # run concurrently with the new generation's thread, racing
+            # on the cleared per-generation state — refuse loudly; the
+            # caller falls back to a fresh agent on a fresh port.
+            raise RuntimeError(
+                "host agent: the previous generation's thread failed to "
+                "stop; cannot serve a new generation")
+        self.ranks = sorted(int(r) for r in ranks)
+        if host_index is not None:
+            self.host_index = int(host_index)
+        self.upstream_addr = upstream_addr
+        self.upstream_port = int(upstream_port)
+        self.error = None
+        # A fresh stop event only after the old thread is JOINED — the old
+        # thread reads self._stop, so replacing it earlier could leave it
+        # running against a cleared event.
+        self._stop = threading.Event()
+        self._lsock.listen(len(self.ranks))
+        return self.start()
+
+    def stop(self) -> None:
+        self.end_generation()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
 
     close = stop
 

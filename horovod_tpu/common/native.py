@@ -86,7 +86,8 @@ def load() -> ctypes.CDLL:
         lib.hvdtpu_server_start.restype = ctypes.c_void_p
         lib.hvdtpu_server_start.argtypes = [ctypes.c_int, ctypes.c_int,
                                             ctypes.c_double, ctypes.c_int,
-                                            ctypes.c_int, ctypes.c_int]
+                                            ctypes.c_int, ctypes.c_int,
+                                            ctypes.c_int]
         lib.hvdtpu_server_stop.argtypes = [ctypes.c_void_p]
         lib.hvdtpu_server_stats.restype = ctypes.c_int
         lib.hvdtpu_server_stats.argtypes = [

@@ -23,6 +23,13 @@ Decision table (first match wins; see docs/elastic.md "Closed-loop
 autoscaling" for the knob table):
 
 =============  ======================================================
+``preempt``    the discovery source posted a preemption notice for an
+               assigned host (``observe(preempt_hosts=...)``): the
+               hardware is going away on the platform's schedule, so
+               the decision OUTRANKS every load signal AND the cooldown
+               window — waiting is not an option — and opens a fresh
+               cooldown so the shrink isn't immediately second-guessed
+               by a queue-depth scale-out
 ``evict``      the SAME rank has been the slowest for ``persistence``
                consecutive observations AND its mean cycle time is ≥
                ``straggler_factor`` × the median of the other ranks —
@@ -57,21 +64,24 @@ HOLD = "hold"
 SCALE_OUT = "scale_out"
 SCALE_IN = "scale_in"
 EVICT = "evict"
+PREEMPT = "preempt"
 
 
 @dataclasses.dataclass(frozen=True)
 class ScaleDecision:
     """One typed policy verdict.
 
-    ``action`` is one of ``hold``/``scale_out``/``scale_in``/``evict``;
-    ``target_size`` rides the scale actions, ``evict_rank`` the evict
-    one, and ``reason`` carries the human-readable attribution the
-    driver logs (and the straggler's monitor evidence)."""
+    ``action`` is one of ``hold``/``scale_out``/``scale_in``/``evict``/
+    ``preempt``; ``target_size`` rides the scale actions, ``evict_rank``
+    the evict one, ``hosts`` the preempt one, and ``reason`` carries the
+    human-readable attribution the driver logs (and the straggler's
+    monitor evidence)."""
 
     action: str
     reason: str = ""
     target_size: Optional[int] = None
     evict_rank: Optional[int] = None
+    hosts: tuple = ()
 
     @property
     def is_hold(self) -> bool:
@@ -155,14 +165,32 @@ class ScalePolicy:
 
     # ------------------------------------------------------------ observe
     def observe(self, summary: dict, size: int,
-                now: Optional[float] = None) -> ScaleDecision:
+                now: Optional[float] = None,
+                preempt_hosts=()) -> ScaleDecision:
         """One policy step.  ``summary`` is a
         :meth:`RankAggregator.summary` record (possibly fetched over
         HTTP), ``size`` the current world size, ``now`` the injected
-        clock (defaults to ``time.monotonic()``)."""
+        clock (defaults to ``time.monotonic()``), and ``preempt_hosts``
+        the discovery source's active preemption notices (ISSUE 12)."""
         if now is None:
             now = time.monotonic()
         size = max(0, int(size))
+
+        # 0. Preemption notices outrank EVERYTHING — including the
+        # cooldown window: the platform reclaims the hardware on its own
+        # schedule, so holding would just convert an orderly drain into a
+        # mid-collective crash.  The decision still OPENS a cooldown (via
+        # _acted) so the shrink isn't immediately second-guessed by a
+        # queue-depth scale-out.
+        if preempt_hosts:
+            hosts = tuple(sorted(str(h) for h in preempt_hosts))
+            return self._acted(now, ScaleDecision(
+                PREEMPT,
+                reason=(f"preemption notice for host(s) "
+                        f"{', '.join(hosts)} (discovery outranks "
+                        f"queue/straggler signals)"),
+                hosts=hosts))
+
         if (self._last_action_ts is not None
                 and now - self._last_action_ts < self.cooldown_s):
             return ScaleDecision(HOLD, reason="cooldown")

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import subprocess
-from typing import Dict, List
+from typing import Dict, List, Set
 
 from ..utils.logging import get_logger
 
@@ -28,6 +28,17 @@ class DiscoveredHost:
 class HostDiscovery:
     def find_available_hosts_and_slots(self) -> List[DiscoveredHost]:
         raise NotImplementedError
+
+    def preemption_notices(self) -> Set[str]:
+        """Hostnames with an ACTIVE preemption notice (ISSUE 12): the host
+        is still alive — it stays in the discovered set — but the platform
+        has announced it will be reclaimed soon.  The elastic driver
+        reacts by cordoning the host and DRAINING its workers (commit →
+        clean LEAVE → exit, with a ``preempt_grace_s`` deadline falling
+        back to termination) so the departure is orderly instead of a
+        mid-collective crash.  Default: none — script/fixed discovery
+        sources have no preemption signal."""
+        return set()
 
 
 class HostDiscoveryScript(HostDiscovery):
@@ -95,10 +106,13 @@ class TPUMetadataDiscovery(HostDiscovery):
       ``id:port:ip`` triples).  This is slice membership.
     - ``instance/attributes/preempted-workers`` — comma-separated worker
       addresses with an active preemption notice (404 or empty = none).
-      Preempted workers are dropped from the discovered set so the
-      elastic driver re-forms the world BEFORE the hardware disappears,
-      instead of waiting to crash mid-collective.  On a real deployment a
-      per-host agent publishes this from its local
+      A preempted worker STAYS in the discovered set (the hardware is
+      still up) and is surfaced through :meth:`preemption_notices`
+      instead: the elastic driver cordons the host and DRAINS its workers
+      (state commit → clean LEAVE → exit 0, grace-bounded) so the
+      departure takes the orderly path BEFORE the hardware disappears —
+      never a mid-collective crash with a dead-peer verdict.  On a real
+      deployment a per-host agent publishes this from its local
       ``instance/preempted`` + maintenance-event signals.
 
     ``slots_per_host`` defaults to 4 — the chips-per-host of current
@@ -116,6 +130,9 @@ class TPUMetadataDiscovery(HostDiscovery):
                          or self._DEFAULT_BASE).rstrip("/")
         self.slots_per_host = slots_per_host or 4
         self.timeout_s = timeout_s
+        # Latest preemption-notice set, refreshed by every membership
+        # poll (the driver calls find_available... then reads notices).
+        self._preempted: Set[str] = set()
 
     def _get(self, path: str, default: str = None) -> str:
         import urllib.error
@@ -147,9 +164,14 @@ class TPUMetadataDiscovery(HostDiscovery):
             if not addr or addr in seen:
                 continue
             seen.add(addr)
-            if addr in preempted:
+            if addr in preempted and addr not in self._preempted:
                 log.warning("tpu metadata discovery: %s has a preemption "
-                            "notice; dropping from the world", addr)
-                continue
+                            "notice; the driver will drain it", addr)
             hosts.append(DiscoveredHost(addr, self.slots_per_host))
+        # Notices only count for hosts still IN the membership: once the
+        # hardware actually vanished, the membership change is the signal.
+        self._preempted = preempted & seen
         return hosts
+
+    def preemption_notices(self) -> Set[str]:
+        return set(self._preempted)

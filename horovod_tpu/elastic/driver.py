@@ -56,7 +56,8 @@ class ElasticDriver:
                  autoscale_policy=None,
                  autoscale_interval_s: float = 5.0,
                  autoscale_source=None,
-                 scale_command: Optional[str] = None):
+                 scale_command: Optional[str] = None,
+                 preempt_grace_s: float = 30.0):
         self.discovery = discovery
         self.command = command
         self.min_np = min_np
@@ -86,6 +87,34 @@ class ElasticDriver:
         self.scale_command = scale_command
         self.events: List[dict] = []    # executed decisions, for operators
                                         # and the scenario acceptance test
+        # Preemption-driven drains (ISSUE 12): a discovery preemption
+        # notice gets the DRAIN → clean LEAVE → cordon path, grace-bounded
+        # — a worker still alive past preempt_grace_s is terminated (the
+        # legacy sever), still classified as a departure.
+        self.preempt_grace_s = max(0.0, float(preempt_grace_s))
+        # Hosts cordoned BECAUSE of a preemption notice: released when the
+        # notice clears (recreated preemptible hardware under the same
+        # address must be able to rejoin), unlike evict cordons, which
+        # persist.  Doubles as the handled-once marker: a cordoned host is
+        # never re-drained while its notice stands.
+        self._preempt_cordoned: set = set()
+        self._drain_deadlines: Dict[str, float] = {}
+        # Hierarchical control plane × elastic (ISSUE 12): when the worker
+        # env arms HOROVOD_HIERARCHICAL_CONTROLLER, the driver allocates
+        # ONE stable agent port per host — reused across generations, so
+        # the generation-surviving HostAgent keeps its listen socket —
+        # and ships it with every assignment.
+        raw_hier = (self.extra_env.get("HOROVOD_HIERARCHICAL_CONTROLLER")
+                    or os.environ.get("HVD_TPU_HIERARCHICAL_CONTROLLER")
+                    or os.environ.get("HOROVOD_HIERARCHICAL_CONTROLLER")
+                    or "")
+        # The launcher's own environment counts too: workers inherit it
+        # through _worker_env, so the driver must allocate stable agent
+        # ports whenever the workers will run hierarchical — not only
+        # when the CLI flag put the knob into extra_env.
+        self._hier = str(raw_hier).strip().lower() in (
+            "1", "true", "yes", "on")
+        self._agent_ports: Dict[str, int] = {}
 
         self.registry = WorkerStateRegistry()
         self.rendezvous = RendezvousServer()
@@ -178,10 +207,37 @@ class ElasticDriver:
         # 0 pick from a high range instead (seeded by generation so retries
         # move on); a collision there surfaces as a worker failure and the
         # next generation picks different ports.
+        # Hierarchical control plane: one STABLE agent port per host,
+        # allocated on the host's first generation and reused for every
+        # later one — the generation-surviving HostAgent holds the listen
+        # socket across re-rendezvous, so the port must never churn.
+        # New LOCAL agent ports are allocated in the SAME free_ports call
+        # as the controller ports: probing them separately would close
+        # the controller probes first, and the kernel may hand the agent
+        # the just-freed controller port — a same-process EADDRINUSE on
+        # the rank-0 host.  (Already-cached agent ports can't collide:
+        # their agents still hold the listeners, so free_ports skips
+        # them.)
+        new_local_agents = []
+        if self._hier:
+            for hn in hosts_in_use:
+                if hn not in self._agent_ports:
+                    if is_local_host(hn):
+                        new_local_agents.append(hn)
+                    else:
+                        (ap,) = remote_ports(
+                            1, 7919 + len(self._agent_ports))
+                        self._agent_ports[hn] = ap
         if is_local_host(coord_host):
-            p1, p2 = _free_ports(2)
+            ports = _free_ports(2 + len(new_local_agents))
+            p1, p2 = ports[0], ports[1]
+            for hn, ap in zip(new_local_agents, ports[2:]):
+                self._agent_ports[hn] = ap
         else:
             p1, p2 = remote_ports(2, self.rendezvous.version + 1)
+            for hn in new_local_agents:
+                (ap,) = _free_ports(1)
+                self._agent_ports[hn] = ap
         assignments = {}
         for rank, (hn, lr) in enumerate(slots):
             assignments[f"{hn}:{lr}"] = {
@@ -193,6 +249,9 @@ class ElasticDriver:
                 "controller_port": p1, "controller_port2": p2,
                 "hostname": hn,
             }
+            if self._hier:
+                assignments[f"{hn}:{lr}"]["agent_port"] = \
+                    self._agent_ports[hn]
         return assignments
 
     # ------------------------------------------------------------ lifecycle
@@ -325,6 +384,11 @@ class ElasticDriver:
                 discovered = []
             # Effective = flap-debounced; blacklist/cordon applied at use.
             self._hosts = self._effective_hosts(discovered, time.monotonic())
+            # Preemption notices gate the FIRST generation too: a host
+            # with an active notice is cordoned (nothing is assigned yet,
+            # so this is the cordon-only path) rather than knowingly
+            # handed workers that would need an immediate drain.
+            self._check_preemption()
             if self._new_generation(self.active_hosts(self._hosts)):
                 break
             if time.monotonic() > deadline:
@@ -366,8 +430,19 @@ class ElasticDriver:
                         changed = True
                 except Exception as exc:  # noqa: BLE001 - transient poll
                     log.warning("elastic driver: discovery failed: %s", exc)
+                # 3a. preemption notices (ISSUE 12): an imminently-
+                # preempted host gets the proactive DRAIN → clean LEAVE →
+                # cordon path — never a dead-peer verdict — handled on
+                # every poll, with or without the autoscale policy
+                # (hardware loss does not wait for an autoscale interval).
+                self._check_preemption()
 
-            # 3b. closed-loop autoscaling: consume monitor summaries, let
+            # 3b. drain-grace enforcement: a drained worker that outlived
+            # its deadline is terminated (the legacy sever fallback) —
+            # still marked DRAINING, so the reap classifies it LEFT.
+            self._enforce_drain_deadlines()
+
+            # 3c. closed-loop autoscaling: consume monitor summaries, let
             # the policy decide, execute (docs/elastic.md).  Decisions
             # mutate the world only through the same discovery/cordon/
             # drain paths the rest of this loop already handles.
@@ -488,6 +563,133 @@ class ElasticDriver:
         operator re-adding capacity elsewhere, is the durable state)."""
         self._cordoned.add(hostname)
 
+    # ------------------------------------------------- preemption drains
+    def _request_commit_all(self) -> None:
+        """Checkpoint pacing (ISSUE 12): ask every live worker to commit
+        its elastic state NOW — sent immediately before an imminent
+        scale/preemption decision executes, so the last commit predates
+        the world change by milliseconds instead of a timer period.
+        Best-effort, and fanned out in PARALLEL with a bounded wait: on
+        the preemption path every second counts against the grace
+        window, so one unreachable worker must not serialize the rest.
+        The workers' own commit cadence is the backstop."""
+        def _ping(addr, port):
+            try:
+                with socket.create_connection((addr, port),
+                                              timeout=1.0) as s:
+                    s.sendall(b"COMMIT\n")
+            except OSError:
+                pass
+
+        pings = []
+        for identity, port in self.rendezvous.notification_ports().items():
+            if identity not in self._procs:
+                continue
+            host = identity.rsplit(":", 1)[0]
+            addr = "127.0.0.1" if is_local_host(host) else host
+            t = threading.Thread(target=_ping, args=(addr, port),
+                                 daemon=True)
+            t.start()
+            pings.append(t)
+        deadline = time.monotonic() + 2.0
+        for t in pings:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+    def _check_preemption(self) -> None:
+        """Consume the discovery source's preemption notices.  A noticed
+        ASSIGNED host is drained proactively — commit request → cordon →
+        DRAIN pings with a ``preempt_grace_s`` deadline — so the
+        departure takes the clean-LEAVE path before the hardware
+        disappears.  A noticed host OUTSIDE the current assignment is
+        cordoned too (a scale-out must never place workers on doomed
+        hardware).  Preemption cordons are RELEASED when their notice
+        clears: recreated preemptible hardware under the same address —
+        the normal TPU preemption lifecycle — rejoins the world, and a
+        later notice re-triggers the drain."""
+        try:
+            notices = set(self.discovery.preemption_notices())
+        except Exception as exc:  # noqa: BLE001 - transient, like discovery
+            log.warning("elastic driver: preemption poll failed: %s", exc)
+            return
+        for host in sorted(self._preempt_cordoned - notices):
+            self._preempt_cordoned.discard(host)
+            self._cordoned.discard(host)
+            log.warning("elastic driver: preemption notice for %s "
+                        "cleared; host un-cordoned", host)
+        assigned_hosts = {a["hostname"] for a in self._assigned.values()}
+        for host in sorted(notices):
+            if host in self._cordoned:
+                continue           # already handled (or evict-cordoned)
+            self._preempt_cordoned.add(host)
+            if host in assigned_hosts:
+                self._preempt_drain(host)
+            else:
+                # Not in this world (yet): cordon only, so the doomed
+                # host can't be assigned while the notice stands.
+                self.cordon(host)
+                log.warning("elastic driver: preemption notice for "
+                            "unassigned host %s; cordoned", host)
+
+    def _preempt_drain(self, host: str) -> None:
+        """Execute one preemption drain.  The policy (when attached) is
+        the decision source of record — a notice outranks its
+        queue/straggler signals and opens its cooldown window — but the
+        drain itself never waits on autoscaling being enabled.  min_np is
+        deliberately NOT a guard here: the hardware is going away either
+        way, and an orderly departure that later under-runs min_np still
+        beats a mid-collective crash with a dead-peer verdict."""
+        reason = f"preemption notice for host {host} (discovery)"
+        if self.autoscale_policy is not None:
+            try:
+                decision = self.autoscale_policy.observe(
+                    {}, size=len(self._assigned), preempt_hosts=(host,))
+                if getattr(decision, "action", "") == "preempt":
+                    reason = decision.reason
+            except Exception:  # noqa: BLE001 - policy bookkeeping is
+                pass           # advisory; the drain happens regardless
+        log.warning("elastic driver: PREEMPT drain of host %s (%s)",
+                    host, reason)
+        self.events.append({"action": "preempt_drain", "host": host,
+                            "reason": reason, "ts": time.time()})
+        # Commit first (checkpoint pacing), then cordon so the clean exit
+        # regenerates a world that excludes the host, then drain.
+        self._request_commit_all()
+        self.cordon(host)
+        deadline = time.monotonic() + self.preempt_grace_s
+        for identity, a in list(self._assigned.items()):
+            if a["hostname"] != host:
+                continue
+            if self.drain_worker(identity):
+                self._drain_deadlines[identity] = deadline
+            else:
+                # Unreachable worker: the termination fallback, marked
+                # DRAINING so the reap still classifies it LEFT and
+                # triggers the regeneration.
+                proc = self._procs.get(identity)
+                if proc is not None and proc.poll() is None:
+                    self._draining.add(identity)
+                    proc.terminate()
+
+    def _enforce_drain_deadlines(self) -> None:
+        """The grace fallback: a drained worker still alive past its
+        deadline is terminated — the legacy sever path — but stays
+        classified as a departure (DRAINING → LEFT), never a blacklist."""
+        if not self._drain_deadlines:
+            return
+        now = time.monotonic()
+        for identity, deadline in list(self._drain_deadlines.items()):
+            proc = self._procs.get(identity)
+            if proc is None or proc.poll() is not None:
+                self._drain_deadlines.pop(identity, None)
+                continue
+            if now >= deadline:
+                self._drain_deadlines.pop(identity, None)
+                log.warning(
+                    "elastic driver: drain grace (%.0fs) expired for %s; "
+                    "falling back to termination", self.preempt_grace_s,
+                    identity)
+                proc.terminate()
+
     def _run_scale_command(self, action: str, decision,
                            host: Optional[str] = None) -> None:
         """Invoke the operator's capacity hook (``--scale-command``): a
@@ -529,6 +731,10 @@ class ElasticDriver:
                                                  size=len(self._assigned))
         if decision.is_hold:
             return
+        # Checkpoint pacing (ISSUE 12): a non-hold decision is about to
+        # change the world — ask every worker to commit NOW, not at its
+        # next timer tick, so the restore point predates the change.
+        self._request_commit_all()
         event = {"action": decision.action, "reason": decision.reason,
                  "target_size": decision.target_size,
                  "evict_rank": decision.evict_rank, "ts": time.time()}
@@ -679,7 +885,12 @@ def run_elastic(args) -> int:
         autoscale_policy=policy,
         autoscale_interval_s=(getattr(args, "autoscale_interval", None)
                               or cfg.autoscale_interval_s),
-        scale_command=getattr(args, "scale_command", None))
+        scale_command=getattr(args, "scale_command", None),
+        # `is not None`, not `or`: an explicit --preempt-grace-s 0
+        # (terminate immediately) is a valid setting, not an unset one.
+        preempt_grace_s=(getattr(args, "preempt_grace_s", None)
+                         if getattr(args, "preempt_grace_s", None)
+                         is not None else cfg.preempt_grace_s))
     try:
         return driver.run()
     finally:

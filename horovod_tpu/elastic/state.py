@@ -66,6 +66,23 @@ class State:
         if notifier is not None:
             notifier.raise_if_updated()
 
+    def should_commit(self) -> bool:
+        """Checkpoint pacing (ISSUE 12): True when the elastic driver has
+        requested an immediate state commit (a ``COMMIT`` notification —
+        sent just before it executes a scale or preemption decision, so
+        the last commit predates the world change by milliseconds, not a
+        timer period).  Consult it alongside any periodic cadence::
+
+            if state.should_commit() or batch % commit_every == 0:
+                state.commit()
+
+        Consumed on read; False when no notification manager is attached
+        (single-process / non-elastic runs)."""
+        notifier = getattr(self, "_notification_manager", None)
+        if notifier is None:
+            return False
+        return bool(notifier.consume_commit_request())
+
     def save(self):
         raise NotImplementedError
 

@@ -62,12 +62,16 @@ def identity() -> str:
 
 
 class WorkerNotificationService:
-    """Tiny TCP listener; driver sends ``HOSTS_UPDATED <version>\\n`` or —
-    the autoscaler's drain path — ``DRAIN\\n``."""
+    """Tiny TCP listener; driver sends ``HOSTS_UPDATED <version>\\n``,
+    the autoscaler's drain path ``DRAIN\\n``, or — checkpoint pacing
+    (ISSUE 12) — ``COMMIT\\n``, the driver's request that the worker
+    commit its elastic state NOW because a scale/preemption decision is
+    imminent (committing on the timer would race the world change)."""
 
-    def __init__(self, on_hosts_updated, on_drain=None):
+    def __init__(self, on_hosts_updated, on_drain=None, on_commit=None):
         self._on_hosts_updated = on_hosts_updated
         self._on_drain = on_drain
+        self._on_commit = on_commit
         self._sock = socket.socket()
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("0.0.0.0", 0))
@@ -95,6 +99,9 @@ class WorkerNotificationService:
                     self._on_hosts_updated(version)
                 elif data.startswith("DRAIN") and self._on_drain is not None:
                     self._on_drain()
+                elif data.startswith("COMMIT") and \
+                        self._on_commit is not None:
+                    self._on_commit()
             except (OSError, ValueError):
                 pass
             finally:
@@ -120,8 +127,10 @@ class WorkerNotificationManager:
         self._lock = threading.Lock()
         self._pending_version: Optional[int] = None
         self._drain_pending = False
-        self._service = WorkerNotificationService(self._notify,
-                                                  on_drain=self._notify_drain)
+        self._commit_pending = False
+        self._service = WorkerNotificationService(
+            self._notify, on_drain=self._notify_drain,
+            on_commit=self._notify_commit)
         addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR")
         port = os.environ.get("HOROVOD_RENDEZVOUS_PORT")
         if addr and port:
@@ -135,6 +144,22 @@ class WorkerNotificationManager:
     def _notify_drain(self):
         with self._lock:
             self._drain_pending = True
+
+    def _notify_commit(self):
+        with self._lock:
+            self._commit_pending = True
+
+    def consume_commit_request(self) -> bool:
+        """True exactly once per driver ``COMMIT`` ping (checkpoint
+        pacing, ISSUE 12): the driver is about to execute a scale or
+        preemption decision and wants the elastic state committed NOW,
+        not at the next timer tick.  Train loops with a periodic commit
+        cadence consult ``state.should_commit()`` (which reads this)
+        alongside their own schedule."""
+        with self._lock:
+            pending = self._commit_pending
+            self._commit_pending = False
+            return pending
 
     def raise_if_updated(self):
         with self._lock:
@@ -202,6 +227,12 @@ def elastic_bootstrap():
         "HOROVOD_CONTROLLER_PORT": str(a["controller_port"]),
         "HOROVOD_CONTROLLER_PORT2": str(a["controller_port2"]),
     }
+    # Hierarchical control plane × elastic (ISSUE 12): the driver
+    # allocates ONE stable agent port per host and ships it with every
+    # generation's assignment, so the generation-surviving HostAgent keeps
+    # its listen socket across re-rendezvous.
+    if a.get("agent_port"):
+        env["HOROVOD_AGENT_PORT"] = str(a["agent_port"])
     os.environ.update(env)
     cfg = Config.from_env()
     # Per-rank output suffixing, unified with the static launch paths

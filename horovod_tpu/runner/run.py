@@ -186,6 +186,11 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "with HVD_AUTOSCALE_ACTION/TARGET/HOST in env; "
                         "it changes what --host-discovery-script reports "
                         "(e.g. resizes an instance group)")
+    p.add_argument("--preempt-grace-s", type=float, default=None,
+                   help="Drain grace for preemption notices (elastic "
+                        "mode): a noticed host's workers get this long "
+                        "to commit + clean-LEAVE before the driver falls "
+                        "back to termination (default 30)")
     # Cluster-scheduler backends (reference P7 ships jsrun/mpirun backends;
     # the TPU equivalents live in runner/tpu_vm.py).
     p.add_argument("--tpu", default=None,

@@ -198,3 +198,99 @@ def fire(point: str, rank: int,
                         "callback; ignoring", point)
         else:
             sever()
+
+
+# --------------------------------------------------------------- churn verbs
+# Scheduled CHURN events (ISSUE 12): where the fault points above inject a
+# single failure at a protocol point, a churn SCRIPT replays membership
+# change — clean LEAVEs, join epochs, agent death, preemption notices —
+# against a running control plane.  The script grammar is round-gated like
+# the fault points' nth gate::
+#
+#     HVD_TPU_CHURN=<verb>:<target>@<round>[,<verb>:<target>@<round>...]
+#
+#     verb    leave           the target RANK sends a protocol-v6 clean
+#                             LEAVE in place of its round frame and departs
+#             join            the target RANK (or ``*`` = every live rank)
+#                             announces the join protocol ("\x1f__join__"),
+#                             flushing the response-cache slot table — the
+#                             heavyweight control-plane churn event
+#             agent_crash     the target HOST's per-host agent is killed
+#                             abruptly (survivable only once its ranks have
+#                             left; otherwise a host-granular typed abort)
+#             preempt_notice  the target HOST receives a preemption notice:
+#                             the runner drains it — every live rank of the
+#                             host leaves cleanly (the driver's DRAIN →
+#                             clean LEAVE path, compressed to the wire)
+#     target  a rank id (leave/join), ``*`` (join: all live ranks), or a
+#             host index (agent_crash/preempt_notice)
+#     round   the 1-based negotiation round the event fires BEFORE —
+#             events at round N are applied once the fleet has completed
+#             N-1 measured rounds, so a ``leave`` is the target's round-N
+#             frame (deterministic, like the fault points' nth gate)
+#
+# The scripts are replayed by :class:`horovod_tpu.testing.churn.ChurnRunner`
+# against the REAL native server, flat or hierarchical.
+
+CHURN_ENV_VAR = "HVD_TPU_CHURN"
+CHURN_VERBS = ("leave", "join", "agent_crash", "preempt_notice")
+_HOST_VERBS = ("agent_crash", "preempt_notice")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChurnEvent:
+    """One parsed churn-script event."""
+    verb: str
+    target: str     # rank id, "*" (join only), or host index
+    at_round: int   # fires before this 1-based measured round
+
+    @classmethod
+    def parse(cls, text: str) -> "ChurnEvent":
+        head, sep, round_s = text.strip().partition("@")
+        if not sep:
+            raise ValueError(
+                f"{CHURN_ENV_VAR}: event must be <verb>:<target>@<round>, "
+                f"got {text!r}")
+        parts = head.split(":")
+        if len(parts) != 2:
+            raise ValueError(
+                f"{CHURN_ENV_VAR}: event must be <verb>:<target>@<round>, "
+                f"got {text!r}")
+        verb, target = parts[0].strip(), parts[1].strip()
+        if verb not in CHURN_VERBS:
+            raise ValueError(
+                f"{CHURN_ENV_VAR}: unknown churn verb {verb!r} "
+                f"(valid: {', '.join(CHURN_VERBS)})")
+        if target == "*":
+            if verb != "join":
+                raise ValueError(
+                    f"{CHURN_ENV_VAR}: target '*' is only valid for join, "
+                    f"got {text!r}")
+        else:
+            try:
+                if int(target) < 0:
+                    raise ValueError
+            except ValueError:
+                raise ValueError(
+                    f"{CHURN_ENV_VAR}: target must be a non-negative "
+                    f"{'host index' if verb in _HOST_VERBS else 'rank'} "
+                    f"or '*', got {text!r}") from None
+        try:
+            at_round = int(round_s)
+        except ValueError:
+            raise ValueError(
+                f"{CHURN_ENV_VAR}: round must be an integer, got "
+                f"{text!r}") from None
+        if at_round < 1:
+            raise ValueError(
+                f"{CHURN_ENV_VAR}: round must be >= 1, got {text!r}")
+        return cls(verb=verb, target=target, at_round=at_round)
+
+
+def parse_churn(text: str):
+    """Parse a full churn script (comma-separated events) into a list of
+    :class:`ChurnEvent`, ordered by firing round (stable for ties — the
+    written order breaks them, so ``leave:1@5,join:*@5`` leaves first)."""
+    events = [ChurnEvent.parse(p) for p in (text or "").split(",")
+              if p.strip()]
+    return sorted(events, key=lambda e: e.at_round)

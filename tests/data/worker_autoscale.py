@@ -40,6 +40,12 @@ DIR = os.environ["AUTOSCALE_DIR"]
 HIER = os.environ.get("HOROVOD_HIERARCHICAL_CONTROLLER", "") == "1"
 MONITOR_PORT = int(os.environ.get("HOROVOD_MONITOR_PORT", "0"))
 
+# Generation-surviving host agent (ISSUE 12): keyed on the HOST (this
+# process), not a rendezvous generation — created once on the stable
+# per-host port the driver ships in the assignment, then re-formed per
+# generation via new_generation.  Mirrors basics.init/shutdown.
+_agent = None
+
 
 def _read(name, default=""):
     try:
@@ -107,15 +113,29 @@ def one_generation(mgr):
     ctl_port = int(a["controller_port2"]) or int(a["controller_port"]) + 1
     coord = a["controller_addr"]
 
-    agent = None
     connect_addr, connect_port, server_port = coord, ctl_port, None
     if HIER:
         from horovod_tpu.common.host_agent import HostAgent
+        global _agent
         cross = int(a["cross_rank"])
-        agent_port = ctl_port + 1 + cross
+        agent_port = int(a.get("agent_port") or ctl_port + 1 + cross)
         if int(a["local_rank"]) == 0:
-            agent = HostAgent(agent_port, coord, ctl_port, [rank],
-                              host_index=cross).start()
+            reused = False
+            if _agent is not None and _agent.port == agent_port:
+                try:
+                    _agent.new_generation(coord, ctl_port, [rank],
+                                          host_index=cross)
+                    reused = True
+                except RuntimeError:
+                    pass          # wedged old thread: replace the agent
+            if not reused:
+                if _agent is not None:
+                    _agent.stop()
+                _agent = HostAgent(agent_port, coord, ctl_port, [rank],
+                                   host_index=cross).start()
+            print(f"[worker {ew.identity()}] agent generation "
+                  f"{_agent.stats.generations} on port {_agent.port}",
+                  flush=True)
         connect_addr, connect_port = "127.0.0.1", agent_port
         if rank == 0:
             server_port = ctl_port
@@ -157,6 +177,12 @@ def one_generation(mgr):
             step += 1
             if os.path.exists(os.path.join(DIR, "done")):
                 return False
+            # Checkpoint pacing (ISSUE 12): the driver pings COMMIT just
+            # before executing a scale/preemption decision — the synthetic
+            # trainer's "commit" is a log line the scenario test asserts.
+            if mgr.consume_commit_request():
+                print(f"[worker {ew.identity()}] commit requested by the "
+                      f"driver (checkpoint pacing)", flush=True)
             mgr.raise_if_updated()
             time.sleep(0.05)
     except DrainRequested:
@@ -177,8 +203,10 @@ def one_generation(mgr):
         mon.close()
         ctl.leave()          # best-effort clean departure (protocol v6)
         ctl.shutdown()
-        if agent is not None:
-            agent.stop()
+        # The host agent is NOT stopped: it survives into the next
+        # rendezvous generation (new_generation re-forms its links).
+        if _agent is not None:
+            _agent.end_generation()
 
 
 def main():

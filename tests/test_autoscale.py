@@ -429,6 +429,268 @@ def test_evict_fallback_terminates_as_draining_and_regenerates():
     assert not d.registry.is_blacklisted("hostB")
 
 
+# ------------------------------------------- preemption drains (ISSUE 12)
+def test_policy_preempt_outranks_signals_and_cooldown():
+    """The preempt decision source: a discovery preemption notice
+    outranks the straggler/queue signals AND the cooldown window (the
+    platform reclaims hardware on its own schedule), while still OPENING
+    a cooldown so the shrink is not immediately second-guessed."""
+    from horovod_tpu.elastic.autoscale import PREEMPT
+
+    p = ScalePolicy(min_np=1, max_np=8, queue_high=1.0, persistence=1,
+                    straggler_factor=2.0, cooldown_s=30.0)
+    # A summary that would EVICT (persistent straggler) — the notice wins.
+    evicty = _summary(slowest=1, per_rank={0: 100.0, 1: 1000.0, 2: 100.0},
+                      q=50, progress_total=1)
+    d = p.observe(evicty, 3, now=100.0, preempt_hosts=("hostB",))
+    assert d.action == PREEMPT and d.hosts == ("hostB",), d
+    assert "preemption notice" in d.reason and "hostB" in d.reason, d
+
+    # The decision opened a cooldown: scale-out-worthy load holds.
+    d2 = p.observe(_summary(q=50, progress_total=2), 3, now=101.0)
+    assert d2.is_hold and d2.reason == "cooldown", d2
+
+    # ...but a SECOND notice inside that same cooldown still fires.
+    d3 = p.observe(_summary(q=50, progress_total=3), 3, now=102.0,
+                   preempt_hosts=("hostC",))
+    assert d3.action == PREEMPT and d3.hosts == ("hostC",), d3
+
+    # Control: no notices -> the normal decision table resumes after
+    # cooldown (the evicty summary evicts with attribution).
+    p2 = ScalePolicy(min_np=1, persistence=1, straggler_factor=2.0,
+                     cooldown_s=0.0)
+    d4 = p2.observe(evicty, 3, now=200.0)
+    assert d4.action == EVICT, d4
+
+
+class _NoticeDiscovery(FixedHostDiscovery):
+    def __init__(self, hosts, notices=()):
+        super().__init__(hosts)
+        self.notices = set(notices)
+
+    def preemption_notices(self):
+        return set(self.notices)
+
+
+class _LiveProc2:
+    def __init__(self):
+        self._rc = None
+        self.pid = 0
+        self.terminated = False
+
+    def poll(self):
+        return self._rc
+
+    def terminate(self):
+        self.terminated = True
+        self._rc = -15
+
+    def exit(self, rc=0):
+        self._rc = rc
+
+
+def test_driver_preempt_drain_commits_cordons_and_classifies_left():
+    """The tentpole's preemption path, driver side: a notice for an
+    assigned host → COMMIT ping (checkpoint pacing) + DRAIN ping to its
+    worker + cordon + grace deadline armed; the worker's clean exit is
+    classified LEFT (never blacklisted, never a success signal) and
+    triggers regeneration.  The notice is handled once while it stands,
+    and re-arms after it clears."""
+    from horovod_tpu.elastic.worker import WorkerNotificationManager
+
+    disc = _NoticeDiscovery([DiscoveredHost("127.0.0.1", 1),
+                             DiscoveredHost("hostB", 1)],
+                            notices=["hostB"])
+    d = ElasticDriver(disc, ["true"], min_np=1, preempt_grace_s=60.0)
+    mgr = WorkerNotificationManager()     # plays hostB's worker
+    try:
+        d._assigned = {
+            "127.0.0.1:0": {"rank": 0, "hostname": "127.0.0.1"},
+            "hostB:0": {"rank": 1, "hostname": "hostB"},
+        }
+        proc = _LiveProc2()
+        d._procs["hostB:0"] = proc
+        # hostB resolves non-locally in drain pings; register the port
+        # under the LOCAL identity trick: use 127.0.0.1-side identity so
+        # the ping lands on the test's manager.
+        d._assigned["hostB:0"]["hostname"] = "hostB"
+        d.rendezvous._notify_ports["hostB:0"] = mgr._service.port
+        # Make the drain ping route locally (the manager listens here).
+        import horovod_tpu.elastic.driver as drv
+        orig = drv.is_local_host
+        drv.is_local_host = lambda h: True
+        try:
+            d._check_preemption()
+        finally:
+            drv.is_local_host = orig
+
+        assert [e["action"] for e in d.events] == ["preempt_drain"]
+        assert d.events[0]["host"] == "hostB"
+        assert "preemption notice" in d.events[0]["reason"]
+        assert "hostB" in d._cordoned
+        assert "hostB:0" in d._draining
+        assert "hostB:0" in d._drain_deadlines
+        assert not d.registry.is_blacklisted("hostB")
+
+        # The worker received BOTH pings: the commit request (checkpoint
+        # pacing) and the drain.
+        deadline = time.monotonic() + 5
+        committed = drained = False
+        while time.monotonic() < deadline and not (committed and drained):
+            committed = committed or mgr.consume_commit_request()
+            if not drained:
+                try:
+                    mgr.raise_if_updated()
+                except DrainRequested:
+                    drained = True
+            time.sleep(0.02)
+        assert committed, "COMMIT ping never arrived"
+        assert drained, "DRAIN ping never arrived"
+
+        # Handled once while the notice stands.
+        d._check_preemption()
+        assert len(d.events) == 1
+
+        # Clean exit 0 → LEFT, regeneration, never blacklisted.
+        proc.exit(0)
+        assert d._reap_exits() is True
+        assert d.registry.state_of("hostB:0") == LEFT
+        assert not d.registry.is_blacklisted("hostB")
+        assert not d._success.is_set()
+
+        # Notice clears → the PREEMPTION cordon is released automatically
+        # (recreated preemptible hardware under the same address rejoins
+        # the world) → a later notice drains again.
+        disc.notices.clear()
+        d._check_preemption()
+        assert "hostB" not in d._cordoned, d._cordoned
+        disc.notices.add("hostB")
+        d._procs["hostB:0"] = _LiveProc2()
+        d._check_preemption()
+        assert len(d.events) == 2, d.events
+        assert "hostB" in d._cordoned
+
+        # A notice for a host OUTSIDE the assignment cordons it (a
+        # scale-out must never land on doomed hardware) without a drain
+        # event, and releases when the notice clears.
+        disc.notices.add("hostZ")
+        d._check_preemption()
+        assert "hostZ" in d._cordoned
+        assert all(e.get("host") != "hostZ" for e in d.events), d.events
+        disc.notices.discard("hostZ")
+        d._check_preemption()
+        assert "hostZ" not in d._cordoned
+
+        # ...while an EVICT cordon is never released by notice churn.
+        d.cordon("hostE")
+        disc.notices.add("hostE")
+        d._check_preemption()
+        disc.notices.discard("hostE")
+        d._check_preemption()
+        assert "hostE" in d._cordoned
+    finally:
+        mgr._service.stop()
+        d.rendezvous.stop()
+
+
+def test_driver_preempt_grace_expiry_falls_back_to_termination():
+    """The deadline fallback: a drained worker still alive past
+    preempt_grace_s is terminated (the legacy sever), but stays
+    classified as a departure — DRAINING → LEFT — and regenerates."""
+    disc = _NoticeDiscovery([DiscoveredHost("hostA", 1),
+                             DiscoveredHost("hostB", 1)],
+                            notices=["hostB"])
+    d = ElasticDriver(disc, ["true"], min_np=1, preempt_grace_s=0.0)
+    try:
+        d._assigned = {
+            "hostA:0": {"rank": 0, "hostname": "hostA"},
+            "hostB:0": {"rank": 1, "hostname": "hostB"},
+        }
+        proc = _LiveProc2()
+        d._procs["hostB:0"] = proc
+        # No notification port registered: drain_worker fails → the
+        # unreachable fallback terminates immediately, marked DRAINING.
+        d._check_preemption()
+        assert proc.terminated
+        assert "hostB:0" in d._draining and "hostB:0" not in d._released
+        assert d._reap_exits() is True
+        assert d.registry.state_of("hostB:0") == LEFT
+        assert not d.registry.is_blacklisted("hostB")
+
+        # The reachable-but-wedged case: drained with a 0s grace, the
+        # deadline enforcement terminates it.
+        d2 = ElasticDriver(
+            _NoticeDiscovery([DiscoveredHost("hostC", 1)],
+                             notices=[]),
+            ["true"], min_np=1, preempt_grace_s=0.0)
+        try:
+            proc2 = _LiveProc2()
+            d2._procs["hostC:0"] = proc2
+            d2._draining.add("hostC:0")
+            d2._drain_deadlines["hostC:0"] = time.monotonic() - 1.0
+            d2._enforce_drain_deadlines()
+            assert proc2.terminated
+            assert "hostC:0" not in d2._drain_deadlines
+        finally:
+            d2.rendezvous.stop()
+    finally:
+        d.rendezvous.stop()
+
+
+def test_compute_assignments_allocates_stable_agent_ports():
+    """Hierarchical × elastic (ISSUE 12): with the hierarchical knob in
+    the worker env, every assignment carries its host's agent port — ONE
+    per host, STABLE across generations (the generation-surviving agent
+    holds the listen socket), newcomers getting fresh ports."""
+    d = _driver(min_np=1, env={"HOROVOD_HIERARCHICAL_CONTROLLER": "1"})
+    try:
+        hosts = [DiscoveredHost("127.0.0.1", 2), DiscoveredHost("hostB", 1)]
+        gen1 = d.compute_assignments(hosts)
+        ports1 = {i: a["agent_port"] for i, a in gen1.items()}
+        assert ports1["127.0.0.1:0"] == ports1["127.0.0.1:1"]
+        assert ports1["127.0.0.1:0"] != ports1["hostB:0"]
+        # Generation 2 (a host joined): existing hosts keep their ports.
+        gen2 = d.compute_assignments(hosts + [DiscoveredHost("hostC", 1)])
+        assert gen2["127.0.0.1:0"]["agent_port"] == ports1["127.0.0.1:0"]
+        assert gen2["hostB:0"]["agent_port"] == ports1["hostB:0"]
+        assert gen2["hostC:0"]["agent_port"] not in (
+            ports1["127.0.0.1:0"], ports1["hostB:0"])
+    finally:
+        d.rendezvous.stop()
+
+    # Control: flat worlds carry no agent ports.
+    d2 = _driver(min_np=1)
+    try:
+        flat = d2.compute_assignments([DiscoveredHost("127.0.0.1", 1)])
+        assert "agent_port" not in flat["127.0.0.1:0"]
+    finally:
+        d2.rendezvous.stop()
+
+
+def test_commit_verb_reaches_manager_and_state():
+    """Checkpoint pacing plumbing: a COMMIT ping on the notification
+    channel surfaces exactly once through consume_commit_request (the
+    ``state.should_commit()`` backend), without disturbing the DRAIN or
+    host-update verbs."""
+    from horovod_tpu.elastic.worker import WorkerNotificationManager
+
+    mgr = WorkerNotificationManager()
+    try:
+        with socket.create_connection(("127.0.0.1", mgr._service.port),
+                                      timeout=5) as s:
+            s.sendall(b"COMMIT\n")
+        deadline = time.monotonic() + 5
+        got = False
+        while time.monotonic() < deadline and not got:
+            got = mgr.consume_commit_request()
+            time.sleep(0.02)
+        assert got, "COMMIT ping never surfaced"
+        assert mgr.consume_commit_request() is False   # one-shot
+        mgr.raise_if_updated()                         # no spurious verbs
+    finally:
+        mgr._service.stop()
+
+
 def test_effective_hosts_preserves_discovery_order_for_new_hosts():
     """The first generation (and any batch of newcomers) must keep the
     DISCOVERY order — the documented hostfile-order rank/coordinator

@@ -82,6 +82,21 @@ def test_bench_minimal_mode():
     # largest world the flat root does multiples of the hierarchical
     # root's serialized per-round work (128 connections vs 8).
     assert ns["sizes"]["128"]["flat_vs_hier"] > 1.5, ns
+    # ISSUE 12: the sweep now injects churn MID-RUN (a preemption-notice
+    # drain -> clean LEAVEs, the drained host's agent dying, a join
+    # epoch) in BOTH planes — every world must survive it (no abort, all
+    # departures clean), the verdict is mirrored onto the top-level line,
+    # and the hierarchical root's slope stays ~flat THROUGH the churn
+    # (post-churn phases measured separately).
+    assert ns["churn_survived"] is True, ns
+    assert out["churn_survived"] is True, out["churn_survived"]
+    for rec in ns["sizes"].values():
+        assert rec["churn_survived"] is True, rec
+        assert rec["hier_root_us_post_churn"] > 0, rec
+    assert ns["hier_slope_post"] is not None, ns
+    # Generous bound for a shared noisy box; the real evidence rides the
+    # recorded slope values (hier ~1x while flat tracks the world size).
+    assert ns["hier_slope"] < ns["flat_slope"], ns
     # Autoscale section (ISSUE 10) on every line: policy decision latency
     # plus the clean-LEAVE drain round-trip through a real native server —
     # the survivor must actually OBSERVE the leave notice.

@@ -1,0 +1,123 @@
+"""Churn verbs + scenario runner (ISSUE 12; tier-1, no jax, no spawns).
+
+The churn-script grammar in ``horovod_tpu.testing.faults`` (leave / join /
+agent_crash / preempt_notice, round-gated like the fault points' nth gate)
+and the ``horovod_tpu.testing.churn.ChurnRunner`` replaying scripts
+against the REAL native server — flat and hierarchical.  The scaled
+version of these scenarios (to 2048 simulated ranks) rides the
+``negotiation_scaling`` bench; the full-stack driver/worker churn lives in
+``tests/test_multiprocess.py``.
+"""
+
+import pytest
+
+from horovod_tpu.testing.churn import ChurnRunner
+from horovod_tpu.testing.faults import (
+    CHURN_VERBS, ChurnEvent, parse_churn,
+)
+
+
+# ---------------------------------------------------------------- grammar
+def test_churn_event_parse_valid_forms():
+    assert ChurnEvent.parse("leave:3@10") == ChurnEvent("leave", "3", 10)
+    assert ChurnEvent.parse(" join:*@2 ") == ChurnEvent("join", "*", 2)
+    assert ChurnEvent.parse("agent_crash:1@7") == ChurnEvent(
+        "agent_crash", "1", 7)
+    assert ChurnEvent.parse("preempt_notice:0@4") == ChurnEvent(
+        "preempt_notice", "0", 4)
+    assert set(CHURN_VERBS) == {"leave", "join", "agent_crash",
+                                "preempt_notice"}
+
+
+def test_churn_script_parse_orders_by_round_stably():
+    script = parse_churn("join:*@8,leave:1@3,preempt_notice:1@3")
+    assert [(e.verb, e.at_round) for e in script] == [
+        ("leave", 3), ("preempt_notice", 3), ("join", 8)]
+    assert parse_churn("") == [] and parse_churn(None) == []
+
+
+@pytest.mark.parametrize("bad", [
+    "leave:1",                 # no round
+    "leave@5",                 # no target
+    "vanish:1@5",              # unknown verb
+    "leave:*@5",               # '*' is join-only
+    "leave:x@5",               # non-integer target
+    "leave:1@0",               # rounds are 1-based
+    "leave:1@x",               # non-integer round
+    "leave:-1@5",              # negative target
+])
+def test_churn_event_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        ChurnEvent.parse(bad)
+
+
+def test_churn_runner_validates_script_against_world():
+    with pytest.raises(ValueError):   # agent verbs need agents
+        ChurnRunner(4, ranks_per_host=2, hier=False, rounds=10,
+                    script=parse_churn("agent_crash:0@5"))
+    with pytest.raises(ValueError):   # host index out of range
+        ChurnRunner(4, ranks_per_host=2, hier=True, rounds=10,
+                    script=parse_churn("preempt_notice:5@5"))
+    with pytest.raises(ValueError):   # rank out of range
+        ChurnRunner(4, ranks_per_host=2, rounds=10,
+                    script=parse_churn("leave:9@5"))
+    with pytest.raises(ValueError):   # event beyond the run
+        ChurnRunner(4, ranks_per_host=2, rounds=10,
+                    script=parse_churn("leave:1@99"))
+    with pytest.raises(ValueError):   # host verbs need a host grouping
+        ChurnRunner(4, rounds=10,
+                    script=parse_churn("preempt_notice:0@5"))
+
+
+# ----------------------------------------------------------------- runner
+def test_churn_runner_flat_leave_and_join_survive():
+    """Flat plane: a clean LEAVE mid-run plus a fleet-wide join epoch —
+    the run completes with the survivors, no abort, the leaver recorded,
+    and per-phase root-service readings across the churn."""
+    rep = ChurnRunner(6, ranks_per_host=3, hier=False, rounds=16, warm=3,
+                      script=parse_churn("leave:5@5,join:*@10")).run()
+    assert rep["survived"] is True, rep
+    assert rep["left_ranks"] == [5], rep
+    assert rep["root_us_pre"] and rep["root_us_post"], rep
+    verbs = [e["verb"] for e in rep["events_fired"]]
+    assert verbs == ["leave", "join"], rep["events_fired"]
+    # The join epoch fired over the SURVIVORS only.
+    join_ev = rep["events_fired"][1]
+    assert 5 not in join_ev["ranks"] and len(join_ev["ranks"]) == 5, join_ev
+    assert len(rep["phases"]) >= 2, rep["phases"]
+
+
+def test_churn_runner_hier_preempt_drain_then_agent_death_survives():
+    """Hierarchical plane: a preemption notice drains a whole host (its
+    ranks depart via clean LEAVEs — the DRAIN → LEAVE path compressed to
+    the wire), then the drained host's agent dies.  The fleet survives
+    both: zero dead-peer verdicts for the drained host, and a dead agent
+    with no live ranks is not a failure."""
+    rep = ChurnRunner(
+        8, ranks_per_host=4, hier=True, rounds=16, warm=3,
+        script=parse_churn("preempt_notice:1@5,agent_crash:1@8")).run()
+    assert rep["survived"] is True, rep
+    assert rep["left_ranks"] == [4, 5, 6, 7], rep
+    assert rep["drained_hosts"] == [1], rep
+    assert rep["abort_reason"] is None, rep
+    # Post-churn phases kept measuring on the surviving host.
+    assert rep["root_us_post"] and rep["root_us_post"] > 0, rep
+
+
+def test_churn_runner_agent_crash_with_live_ranks_fails_attributed():
+    """The control: killing an agent UNDER live ranks is a host-granular
+    failure — the run reports it instead of wedging (the surviving host's
+    ranks observe the typed abort; the dead host's observe the sever)."""
+    rep = ChurnRunner(
+        4, ranks_per_host=2, hier=True, rounds=12, warm=3,
+        script=parse_churn("agent_crash:1@5")).run()
+    assert rep["survived"] is False, rep
+    assert rep["abort_reason"], rep
+    kinds = " ".join(why for _r, why in rep["failures"])
+    assert "abort" in kinds or "severed" in kinds, rep["failures"]
+
+
+def test_churn_runner_is_jax_free():
+    import horovod_tpu.testing.churn as churn
+    src = open(churn.__file__).read()
+    assert "import jax" not in src

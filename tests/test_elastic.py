@@ -225,6 +225,28 @@ def test_jax_state_restore_after_peer_failure_byte_identical():
         assert np.asarray(state.params[k]).tobytes() == blob, k
 
 
+def test_state_should_commit_consumes_driver_commit_request():
+    """Checkpoint pacing (ISSUE 12): ``state.should_commit()`` reads the
+    notification manager's one-shot COMMIT flag — True exactly once per
+    driver ping, False with no manager attached (non-elastic runs)."""
+    from horovod_tpu.elastic.state import ObjectState
+
+    state = ObjectState(bcast_object=_identity_bcast, epoch=0)
+    assert state.should_commit() is False      # no manager attached
+
+    class _Mgr:
+        def __init__(self):
+            self.pending = True
+
+        def consume_commit_request(self):
+            p, self.pending = self.pending, False
+            return p
+
+    state._notification_manager = _Mgr()
+    assert state.should_commit() is True
+    assert state.should_commit() is False      # one-shot
+
+
 def test_run_wrapper_resets_on_peer_failure(monkeypatch):
     """@hvd.elastic.run over a step that hits a PeerFailureError once:
     restore-to-commit, runtime reset, retry — and completion on the second
@@ -337,6 +359,66 @@ def test_elastic_integration(tmp_path, mode):
     assert res["resets"] >= 1, (res, out[-4000:])
 
 
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["grow", "shrink"])
+def test_elastic_integration_hierarchical(tmp_path, mode):
+    """ISSUE 12 — elastic × hierarchical, real jax workers: the SAME
+    grow/shrink run with ``--hierarchical-controller`` armed.
+    ``run_elastic`` honors the knob (no silent flat fallback): the driver
+    allocates a stable per-host agent port, every generation's rendezvous
+    assignment carries it, and the surviving local_rank-0 process's
+    HostAgent serves BOTH generations via new_generation while the rank
+    set changes under it."""
+    hostfile = tmp_path / "hosts.txt"
+    start, end = (("localhost:1", "localhost:2") if mode == "grow"
+                  else ("localhost:2", "localhost:1"))
+    hostfile.write_text(start + "\n")
+    marker = tmp_path / "epoch_marker"
+    result = tmp_path / "result"
+
+    env = dict(os.environ)
+    other_paths = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + other_paths)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ELASTIC_TEST_MARKER"] = str(marker)
+    env["ELASTIC_TEST_RESULT"] = str(result)
+    env["ELASTIC_TEST_EPOCHS"] = "6"
+    env.pop("HOROVOD_TIMELINE", None)
+
+    logs = tmp_path / "logs"
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+           "--host-discovery-script", f"cat {hostfile}",
+           "--min-np", "1", "--max-np", "2",
+           "--hierarchical-controller",
+           "--output-filename", str(logs),
+           sys.executable, WORKER]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 120
+        while not marker.exists() and time.time() < deadline:
+            time.sleep(0.2)
+        assert marker.exists(), "worker never reached the marker epoch"
+        hostfile.write_text(end + "\n")
+        out, _ = proc.communicate(timeout=180)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    def _logs():
+        return "\n\n".join(f"--- {p} ---\n" + p.read_text()[-2500:]
+                           for p in sorted(logs.glob("*/std*"))
+                           if p.exists())
+
+    assert proc.returncode == 0, out[-3000:] + _logs()
+    assert result.exists(), out[-3000:] + _logs()
+    res = json.loads(result.read_text())
+    assert res["epochs"] == 6
+    final_size = 2 if mode == "grow" else 1
+    assert res["final_size"] == final_size, (res, out[-4000:])
+    assert res["resets"] >= 1, (res, out[-4000:])
+
+
 # ------------------------------------------------- TPU metadata discovery
 class _FakeMetadataServer:
     """Minimal GCE-metadata-shaped HTTP server whose attribute map the test
@@ -390,16 +472,23 @@ def test_tpu_metadata_discovery_membership_and_preemption():
             DiscoveredHost("10.0.0.1", 4), DiscoveredHost("10.0.0.2", 4),
             DiscoveredHost("10.0.0.3", 4)]   # record formats + 404 notices
 
-        # A preemption notice drops the named worker from the world.
+        # A preemption notice KEEPS the worker in the membership (the
+        # hardware is still up) and surfaces it through
+        # preemption_notices() instead — the driver's cue to DRAIN it
+        # proactively (ISSUE 12) rather than dropping it into a crash.
         srv.attributes["instance/attributes/preempted-workers"] = "10.0.0.2"
         assert d.find_available_hosts_and_slots() == [
-            DiscoveredHost("10.0.0.1", 4), DiscoveredHost("10.0.0.3", 4)]
+            DiscoveredHost("10.0.0.1", 4), DiscoveredHost("10.0.0.2", 4),
+            DiscoveredHost("10.0.0.3", 4)]
+        assert d.preemption_notices() == {"10.0.0.2"}
 
-        # Membership change (a worker vanishes from the slice).
+        # Membership change (a worker vanishes from the slice): a notice
+        # for a host no longer in the membership clears with it.
         srv.attributes["instance/attributes/worker-network-endpoints"] = (
             "uid0:8470:10.0.0.1")
         assert d.find_available_hosts_and_slots() == [
             DiscoveredHost("10.0.0.1", 4)]
+        assert d.preemption_notices() == set()
     finally:
         srv.stop()
 

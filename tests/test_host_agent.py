@@ -348,6 +348,116 @@ def test_split_rank_frame_roundtrip():
     assert split_rank_frame(core + mon[:-2]) is None
 
 
+# ------------------------------------------- generation survival (ISSUE 12)
+def test_agent_survives_rerendezvous_generations():
+    """ONE HostAgent object serves two consecutive re-rendezvous
+    generations: generation 1 (a 2-rank world against root A), then
+    ``end_generation`` + ``new_generation`` with a GROWN rank set (a
+    3-rank world against a fresh root B on a different port) — same agent
+    object, same listen port, cumulative stats, ``generations == 2``.
+    This is the elastic × hierarchical unification seam: the agent is
+    keyed on its host, not a generation."""
+    root_a, root_b = _free_port(), _free_port()
+    agent = HostAgent(0, "127.0.0.1", root_a, [0, 1],
+                      host_index=0, connect_timeout_ms=20000).start()
+    stable_port = agent.port
+
+    def run_generation(world, root_port, n_steps):
+        results, errors = {}, {}
+        all_done = threading.Event()
+
+        def worker(rank):
+            ctl = TCPController(
+                "127.0.0.1", stable_port, rank=rank, world=world,
+                stall_warn_s=60.0,
+                server_port=root_port if rank == 0 else None)
+            try:
+                results[rank] = _steps(ctl, lambda: [E("g")], n_steps)
+                # The orderly departure every elastic teardown takes —
+                # the agent retires the rank instead of reporting it dead.
+                ctl.leave()
+            except Exception as exc:  # noqa: BLE001
+                errors[rank] = exc
+            finally:
+                if len(results) + len(errors) == world:
+                    all_done.set()
+                all_done.wait(timeout=20)
+                ctl.shutdown()
+
+        threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+                   for r in range(1, world)]
+        for t in threads:
+            t.start()
+        worker(0)
+        for t in threads:
+            t.join(timeout=20)
+        assert not errors, errors
+        assert len(results) == world, sorted(results)
+        assert len({tuple(o) for o in results.values()}) == 1, results
+
+    run_generation(2, root_a, 3)
+    agent.end_generation()
+    rounds_gen1 = agent.stats.rounds
+    assert rounds_gen1 > 0, vars(agent.stats)
+
+    # Generation 2: the world GREW (2 -> 3 ranks on this host) and the
+    # root moved to a fresh port — the agent re-forms on the SAME listen
+    # socket.
+    agent.new_generation("127.0.0.1", root_b, [0, 1, 2], host_index=0)
+    assert agent.port == stable_port
+    run_generation(3, root_b, 3)
+    agent.stop()
+    assert agent.stats.generations == 2, vars(agent.stats)
+    assert agent.stats.rounds > rounds_gen1, vars(agent.stats)
+    # Both generations hit the warm aggregate path.
+    assert agent.stats.agg_rounds > 0, vars(agent.stats)
+    assert agent.error is None, agent.error
+
+
+def test_agent_new_generation_shrinks_rank_set():
+    """The shrink direction: a host whose slot count dropped re-forms
+    with FEWER ranks — the uplink width renegotiates down and the new
+    world still negotiates warm."""
+    root_a, root_b = _free_port(), _free_port()
+    agent = HostAgent(0, "127.0.0.1", root_a, [0, 1, 2],
+                      host_index=0, connect_timeout_ms=20000).start()
+
+    def run_generation(world, root_port):
+        results = {}
+        all_done = threading.Event()
+
+        def worker(rank):
+            ctl = TCPController(
+                "127.0.0.1", agent.port, rank=rank, world=world,
+                stall_warn_s=60.0,
+                server_port=root_port if rank == 0 else None)
+            try:
+                results[rank] = _steps(ctl, lambda: [E("s")], 2)
+                ctl.leave()
+            finally:
+                if len(results) == world:
+                    all_done.set()
+                all_done.wait(timeout=20)
+                ctl.shutdown()
+
+        threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+                   for r in range(1, world)]
+        for t in threads:
+            t.start()
+        worker(0)
+        for t in threads:
+            t.join(timeout=20)
+        assert len(results) == world, sorted(results)
+
+    run_generation(3, root_a)
+    agent.new_generation("127.0.0.1", root_b, [0])
+    assert agent.ranks == [0]
+    run_generation(1, root_b)
+    agent.stop()
+    assert agent.stats.generations == 2, vars(agent.stats)
+    assert agent.error is None, agent.error
+
+
 def test_agent_is_jax_free_import():
     """The agent must stay importable on the jax-free tier (also enforced
     by the purity subprocess in test_monitor.py)."""

@@ -82,6 +82,17 @@ def test_self_lint_covers_autoscale_stack():
     assert not findings, "\n".join(f.render() for f in findings)
 
 
+def test_self_lint_covers_fault_harness():
+    """Explicit coverage for the fault-injection harness AND the churn
+    runner (ISSUE 12): both drive the control plane from the jax-free
+    tier and the bench, and must parse and lint clean."""
+    t_dir = os.path.join(REPO, "horovod_tpu", "testing")
+    files = {f for f in os.listdir(t_dir) if f.endswith(".py")}
+    assert {"faults.py", "churn.py"} <= files, files
+    findings = lint_paths([t_dir])
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
 def test_allowlist_entries_still_fire():
     """Stale allowlist entries (fixed code, moved lines) must be pruned."""
     findings = lint_paths([os.path.join(REPO, "horovod_tpu"),

@@ -508,6 +508,9 @@ importlib.import_module('horovod_tpu.trace.__main__')
 # carry the jax-free fault tests and the acceptance workers' arming path.
 importlib.import_module('horovod_tpu.testing')
 importlib.import_module('horovod_tpu.testing.faults')
+# Churn-scenario runner (ISSUE 12): drives simulated worlds + HostAgents
+# against the native server from the jax-free test tier and the bench.
+importlib.import_module('horovod_tpu.testing.churn')
 importlib.import_module('horovod_tpu.common.exceptions')
 importlib.import_module('horovod_tpu.common.net')
 # Hierarchical control plane: the per-host aggregation agent runs in

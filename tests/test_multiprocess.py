@@ -964,13 +964,15 @@ esac
                          queue_trend_up=1e9,   # absolute threshold drives
                          straggler_factor=3.0, persistence=2,
                          cooldown_s=2.0, idle_s=2.0)
+    logs = tmp_path / "logs"
     d = ElasticDriver(
         HostDiscoveryScript(f"cat {hosts}"),
         [sys.executable, WORKER_AUTOSCALE],
         min_np=1, max_np=3, env=extra_env,
         discovery_interval_s=0.25, start_timeout_s=120,
         autoscale_policy=policy, autoscale_interval_s=0.4,
-        scale_command=f"sh {scale_sh}", verbose=1)
+        scale_command=f"sh {scale_sh}", verbose=1,
+        output_filename=str(logs))
 
     rc = {}
     t = _threading.Thread(target=lambda: rc.update(code=d.run()),
@@ -1041,6 +1043,140 @@ esac
             < actions.index("scale_in"), actions
         # Clean departures only: nothing was ever blacklisted.
         assert d.registry.blacklist() == set(), d.registry.blacklist()
+
+        # ISSUE 12 — checkpoint pacing: every non-hold decision is
+        # preceded by a COMMIT ping; at least one live worker logged the
+        # paced commit request.
+        all_logs = "".join(p.read_text()
+                           for p in logs.glob("*/stdout") if p.exists())
+        assert "commit requested by the driver" in all_logs, (
+            all_logs[-3000:])
+        if hier:
+            # ISSUE 12 acceptance — elastic × hierarchical: the SAME
+            # agent object (same process, same listen port) served >= 2
+            # re-rendezvous generations on the long-lived coordinator
+            # host, instead of the fleet being silently forced flat.
+            coord_log = (logs / "127.0.0.1.0" / "stdout").read_text()
+            assert "agent generation 1" in coord_log, coord_log[-3000:]
+            assert "agent generation 2" in coord_log, coord_log[-3000:]
+    finally:
+        (sdir / "done").write_text("1")
+        _time.sleep(0.5)
+        d._shutdown_workers()
+
+
+@pytest.mark.parametrize("hier", [False, True], ids=["flat", "hier"])
+def test_preemption_drain_scenario(tmp_path, hier):
+    """ISSUE 12 acceptance — preemption-driven drains, end to end over
+    real processes and the real wire stack, flat AND hierarchical: a
+    discovery preemption notice for one host makes the driver request a
+    state commit (checkpoint pacing), cordon the host and DRAIN its
+    worker — which finishes, sends the protocol-v6 clean LEAVE, and exits
+    0 — so the departure is classified LEFT (never blacklisted, never an
+    HVD303 dead-peer verdict) and the world heals without the host."""
+    import json
+    import threading as _threading
+    import time as _time
+
+    from horovod_tpu.elastic.discovery import HostDiscoveryScript
+    from horovod_tpu.elastic.driver import ElasticDriver
+
+    sdir = tmp_path / "autoscale"
+    sdir.mkdir()
+    hosts = tmp_path / "hosts"
+    hosts.write_text("127.0.0.1:1\n127.0.0.2:1\n")
+    (sdir / "load").write_text("1")       # busy: rounds keep turning
+    (sdir / "straggler").write_text("")
+    notices = tmp_path / "notices"
+
+    class _NoticeScript(HostDiscoveryScript):
+        def preemption_notices(self):
+            try:
+                return {ln.strip() for ln in notices.read_text().split()
+                        if ln.strip()}
+            except OSError:
+                return set()
+
+    env = {k: v for k, v in os.environ.items()}
+    other_paths = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p and "axon" not in p]
+    extra_env = {
+        "PYTHONPATH": os.pathsep.join([REPO] + other_paths),
+        "AUTOSCALE_DIR": str(sdir),
+    }
+    if hier:
+        extra_env["HOROVOD_HIERARCHICAL_CONTROLLER"] = "1"
+
+    logs = tmp_path / "logs"
+    d = ElasticDriver(
+        _NoticeScript(f"cat {hosts}"),
+        [sys.executable, WORKER_AUTOSCALE],
+        min_np=1, max_np=2, env=extra_env,
+        discovery_interval_s=0.25, start_timeout_s=120, verbose=1,
+        preempt_grace_s=30.0, output_filename=str(logs))
+
+    rc = {}
+    t = _threading.Thread(target=lambda: rc.update(code=d.run()),
+                          daemon=True)
+    t.start()
+
+    def wait_for(cond, what, timeout=60):
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if cond():
+                return
+            if rc:
+                raise AssertionError(
+                    f"driver exited rc={rc} while waiting for {what}; "
+                    f"events={d.events}")
+            _time.sleep(0.1)
+        raise AssertionError(f"timed out waiting for {what}; "
+                             f"events={d.events} assigned="
+                             f"{sorted(d._assigned)} procs="
+                             f"{sorted(d._procs)}")
+
+    try:
+        wait_for(lambda: len(d._procs) == 2, "initial world")
+        # Let a few rounds turn so the drain lands mid-run, then post the
+        # preemption notice for the second host.
+        _time.sleep(1.0)
+        notices.write_text("127.0.0.2\n")
+        wait_for(lambda: any(e["action"] == "preempt_drain"
+                             for e in d.events), "preempt_drain event")
+        ev = next(e for e in d.events if e["action"] == "preempt_drain")
+        assert ev["host"] == "127.0.0.2", ev
+        assert "preemption notice" in ev["reason"], ev
+        wait_for(lambda: "127.0.0.2" in d._cordoned
+                 and d.registry.state_of("127.0.0.2:0") == "LEFT"
+                 and len(d._assigned) == 1
+                 and "127.0.0.2" not in
+                 {a["hostname"] for a in d._assigned.values()},
+                 "world healed without the preempted host")
+        # Clean departure: LEFT, never blacklisted.
+        assert not d.registry.is_blacklisted("127.0.0.2")
+        assert d.registry.blacklist() == set(), d.registry.blacklist()
+
+        (sdir / "done").write_text("1")
+        t.join(timeout=60)
+        assert not t.is_alive(), "driver never finished"
+        assert rc.get("code") == 0, (rc, d.events)
+
+        # The preempted worker took the PACED, CLEAN path: the commit
+        # request arrived before the drain, the drain surfaced as
+        # DrainRequested -> clean LEAVE, and no dead-peer verdict
+        # (HVD303 / PeerFailureError) ever reached it.
+        drained_log = (logs / "127.0.0.2.0" / "stdout").read_text()
+        assert "commit requested by the driver" in drained_log, (
+            drained_log[-3000:])
+        assert "drain requested -> clean LEAVE" in drained_log, (
+            drained_log[-3000:])
+        assert "HVD303" not in drained_log, drained_log[-3000:]
+        assert "PeerFailureError" not in drained_log, drained_log[-3000:]
+        if hier:
+            # The survivor's generation-surviving agent crossed into the
+            # healed generation: the same object served both.
+            coord_log = (logs / "127.0.0.1.0" / "stdout").read_text()
+            assert "agent generation 2" in coord_log, coord_log[-3000:]
     finally:
         (sdir / "done").write_text("1")
         _time.sleep(0.5)
