@@ -1179,7 +1179,7 @@ def _resnet_pieces(batch, image_size, framework: bool):
         # over the hvd axis; SyncBN reduces batch statistics over it too.
         cfg = resnet.ResNetConfig(depth=50, num_classes=1000,
                                   compute_dtype=dtype, sync_bn_axis="hvd")
-        opt = hvd.DistributedOptimizer(sgd, op=hvd.Average, axis_name="hvd")
+        opt = hvd.DistributedOptimizer(sgd, op=hvd.Average, axis_name="hvd")  # hvd-lint: disable=HVD103  (single-controller benchmark: synthetic data, no persisted model — divergent init is benign)
         mesh = hvd.mesh()
         inner = resnet.make_train_step(cfg, opt, axis_name=None)
         step = jax.jit(shard_map(inner, mesh=mesh,

@@ -10,6 +10,13 @@ Three layers, one rule catalog (see ``findings.RULES`` and
 - :mod:`.runtime_sanitizer` — ``HVD_TPU_SANITIZER=1`` run-time ledger and
   cross-rank order/signature check through the negotiation controller.
 
+Plus the two-pass **whole-package mode** (``--whole-package``; see
+:mod:`.callgraph` / :mod:`.whole_package`): a package-wide symbol table +
+call graph, interprocedural HVD101 rank-guard propagation, cross-module
+HVD102/HVD103 facts, per-entry-point collective schedules (HVD108/HVD109),
+SARIF 2.1.0 output (:mod:`.sarif`), finding baselines (:mod:`.baseline`)
+and the repo's CI gate (:mod:`.gate`, ``tools/lint_gate.py``).
+
 Framework bindings expose this as ``DistributedOptimizer(..., check=...)``
 (see :mod:`.hooks`).
 """
@@ -22,8 +29,23 @@ from .collective_lint import (  # noqa: F401
 __all__ = [
     "Finding", "Rule", "RULES", "Severity", "summarize",
     "COLLECTIVE_NAMES", "lint_file", "lint_paths", "lint_source",
-    "analyze_paths",
+    "analyze_paths", "analyze_package", "build_package",
 ]
+
+
+def analyze_package(paths):
+    """Whole-package (interprocedural) analysis; see
+    :func:`.whole_package.analyze_package`.  Imported lazily so the plain
+    per-module lint path stays import-light."""
+    from .whole_package import analyze_package as _ap
+    return _ap(paths)
+
+
+def build_package(paths):
+    """Build the pass-1 symbol table + call graph; see
+    :func:`.callgraph.build_package`."""
+    from .callgraph import build_package as _bp
+    return _bp(paths)
 
 
 def analyze_paths(paths, include_warnings: bool = True):

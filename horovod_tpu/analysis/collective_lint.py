@@ -70,6 +70,11 @@ _TRAINING_WRAPPERS = {
 
 _DISABLE_RE = re.compile(r"hvd-lint\s*:\s*disable\s*=\s*([A-Za-z0-9_,\s]+)")
 
+# Tracing wrappers whose body runs at trace time: @jit / @shard_map / @pmap
+# decorations (directly or through functools.partial) put the function body
+# in a jit context for HVD106/HVD107.
+_JIT_WRAPPER_NAMES = {"jit", "shard_map", "pmap"}
+
 
 def _call_name(node: ast.AST) -> Optional[str]:
     """Last dotted segment of a call target: ``hvd.ops.allreduce`` → ``allreduce``."""
@@ -119,24 +124,67 @@ def _iter_over_set_or_dict(it: ast.AST) -> Optional[str]:
 
 def _jit_decorated(fn: ast.AST) -> bool:
     """True for ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)`` /
-    ``@functools.partial(jit, ...)`` decorations."""
+    ``@functools.partial(shard_map, mesh=...)``-style decorations — any
+    tracing wrapper in :data:`_JIT_WRAPPER_NAMES`, direct or via partial."""
     if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return False
     for dec in fn.decorator_list:
         name = _call_name(dec)
-        if name == "jit":
+        if name in _JIT_WRAPPER_NAMES:
             return True
         if name == "partial" and isinstance(dec, ast.Call) and dec.args:
-            if _call_name(dec.args[0]) == "jit":
+            if _call_name(dec.args[0]) in _JIT_WRAPPER_NAMES:
                 return True
     return False
 
 
-class _FunctionFacts(ast.NodeVisitor):
-    """Collect per-function taint (names holding rank identity)."""
+def unwrap_wrapped_callable(call: ast.AST) -> Optional[str]:
+    """Peel tracing/functools wrappers off a call expression and return the
+    underlying function NAME: ``jax.jit(step)`` → ``step``,
+    ``jit(shard_map(step, mesh=m))`` → ``step``,
+    ``functools.partial(helper, 3)`` → ``helper``.  Returns None when the
+    innermost wrapped object is not a plain name (lambda, attribute chain)
+    or the expression is not a recognized wrapper."""
+    seen = False
+    while isinstance(call, ast.Call) and \
+            _call_name(call) in (_JIT_WRAPPER_NAMES | {"partial", "wraps"}):
+        if _call_name(call) == "partial" and call.args and \
+                _call_name(call.args[0]) in _JIT_WRAPPER_NAMES:
+            # partial(jit, static_argnums=...) builds a DECORATOR, it does
+            # not wrap a user function.
+            return None
+        seen = True
+        call = call.args[0] if call.args else None
+    if seen and isinstance(call, ast.Name):
+        return call.id
+    return None
 
-    def __init__(self):
+
+def _jit_wrapped_fn_names(tree: ast.AST) -> Set[str]:
+    """Names of locally defined functions wrapped in a tracing context by
+    ASSIGNMENT rather than decoration: ``step = jax.jit(step_impl)`` (or
+    ``jit(shard_map(step_impl, ...))``) puts ``step_impl``'s body in a jit
+    context for HVD106/HVD107 even though ``step_impl`` itself carries no
+    decorator — previously such bodies hid from the jit-context rules."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and _call_name(node.value) in _JIT_WRAPPER_NAMES:
+            name = unwrap_wrapped_callable(node.value)
+            if name:
+                out.add(name)
+    return out
+
+
+class _FunctionFacts(ast.NodeVisitor):
+    """Collect per-function taint: names assigned (transitively) from any
+    of ``source_calls`` — rank-identity accessors by default; the
+    whole-package HVD108 pass reuses this with world-size accessors to
+    prove branch conditions rank-invariant."""
+
+    def __init__(self, source_calls: Optional[Set[str]] = None):
         self.tainted: Set[str] = set()
+        self._sources = _RANK_CALLS if source_calls is None else source_calls
 
     def visit_Assign(self, node: ast.Assign):
         self._track(node.targets, node.value)
@@ -150,7 +198,7 @@ class _FunctionFacts(ast.NodeVisitor):
     def _track(self, targets, value):
         def taints(v) -> bool:
             return (isinstance(v, ast.Call)
-                    and _call_name(v) in _RANK_CALLS) or \
+                    and _call_name(v) in self._sources) or \
                    (isinstance(v, ast.Name) and v.id in self.tainted)
 
         vals: List[ast.AST]
@@ -208,6 +256,7 @@ class _Linter(ast.NodeVisitor):
         facts = _FunctionFacts()
         facts.visit(node)
         self._module_tainted = facts.tainted
+        self._jit_wrapped_names = _jit_wrapped_fn_names(node)
         self.generic_visit(node)
 
     def _visit_function(self, node):
@@ -228,7 +277,8 @@ class _Linter(ast.NodeVisitor):
             if dotted and dotted[0] == "run" and (
                     len(dotted) == 1 or "elastic" in dotted):
                 self.uses_elastic_state = True
-        jit = _jit_decorated(node)
+        jit = _jit_decorated(node) or \
+            node.name in getattr(self, "_jit_wrapped_names", ())
         self._fn_stack.append({"tainted": facts.tainted, "node": node})
         self._early_exit_after.append(None)
         if jit:

@@ -125,6 +125,33 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
         "inside shard_map), or call the eager op outside jit.",
     ),
     Rule(
+        "HVD108", Severity.WARNING,
+        "branch-divergent collective schedule",
+        "Two paths through one function emit different collective sequences "
+        "(whole-package analysis, call chains included).  Unless the branch "
+        "condition is provably identical on every rank, ranks taking "
+        "different paths submit different schedules — negotiation wedges at "
+        "the readiness threshold or pairs the wrong tensors under one slot. "
+        "Horovod-style background negotiation assumes every rank submits "
+        "THE SAME schedule; this rule proves it per branch statically.",
+        "Make both branches emit the same collective sequence (hoist the "
+        "collectives out of the branch), or ensure the condition is "
+        "rank-invariant (derived from size()/hyperparameters, not data).",
+    ),
+    Rule(
+        "HVD109", Severity.ERROR,
+        "collective reachable from an elastic/churn transition callback",
+        "A collective is reachable (through the call graph) from an "
+        "elastic-transition handler (on_leave / new_generation / "
+        "on_hosts_updated / preemption hooks).  Those callbacks run while "
+        "the rank set is MID-TRANSITION: peers may already have left or not "
+        "yet joined, so the collective negotiates against a world that is "
+        "being torn down — the fleet wedges with no diagnostics.",
+        "Defer the collective until after re-rendezvous completes (elastic "
+        "state sync on restore), or restrict it to a process_set formed "
+        "from the post-transition world.",
+    ),
+    Rule(
         "HVD201", Severity.ERROR,
         "collective over unknown mesh axis",
         "A traced lax collective names an axis_name the surrounding mesh "

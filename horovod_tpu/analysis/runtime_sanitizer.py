@@ -46,21 +46,33 @@ Mechanism:
   docs/monitoring.md) — the stalling rank no longer has to ssh into the
   peer's logs to see what it last submitted.
 
+- **Static linkage** (``HVD_TPU_SANITIZER_STATIC_INDEX=file``): the
+  whole-package analyzer exports a call-site → call-graph-node map
+  (``python -m horovod_tpu.analysis --whole-package --emit-static-index``).
+  When set, every ledger line and HVD301/HVD302 report annotates the
+  divergent call site with its static node (``mod:fn``, schedule index)
+  and, when the static analysis flagged that site, the rule that would
+  have caught the divergence before launch — closing the loop between the
+  runtime ledger and the static collective schedule.
+
 Env vars:
   HVD_TPU_SANITIZER=1          enable (tag mode)
   HVD_TPU_SANITIZER=hash       enable + content-hash the local contribution
   HVD_TPU_SANITIZER_TIMEOUT=s  stall warn threshold (default 30)
   HVD_TPU_SANITIZER_LEDGER=n   ledger capacity (default 512)
+  HVD_TPU_SANITIZER_STATIC_INDEX=f  static call-graph index (JSON) to
+                               annotate ledger reports with
 """
 
 from __future__ import annotations
 
 import collections
 import dataclasses
+import json
 import os
 import threading
 import traceback
-from typing import Deque, List, Optional, Sequence
+from typing import Deque, Dict, List, Optional, Sequence
 
 from .findings import is_package_frame
 from ..utils.logging import get_logger
@@ -106,11 +118,52 @@ def _caller_site() -> str:
     return "<internal>"
 
 
+class StaticIndex:
+    """Call-site → static call-graph node map, produced by
+    ``python -m horovod_tpu.analysis --whole-package --emit-static-index``.
+    Sites are keyed ``basename:line`` — the same spelling
+    :func:`_caller_site` stamps into ledger entries, so lookup is a dict
+    hit on the hot path's *reporting* side only (never on submission)."""
+
+    def __init__(self, sites: Dict[str, Dict]):
+        self._sites = sites
+
+    @classmethod
+    def load(cls, path: str) -> Optional["StaticIndex"]:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                data = json.load(fh)
+            return cls(data.get("sites", {}))
+        except (OSError, ValueError) as e:
+            log.warning("sanitizer: cannot load static index %s: %s",
+                        path, e)
+            return None
+
+    def annotate(self, site: str) -> str:
+        rec = self._sites.get(site)
+        if rec is None:
+            return ""
+        s = f" [static: {rec.get('node', '?')} #{rec.get('index', '?')}"
+        rules = rec.get("rules")
+        if rules:
+            s += f"; {'/'.join(rules)} flagged this site statically"
+        return s + "]"
+
+
+def _env_static_index() -> Optional[StaticIndex]:
+    path = os.environ.get("HVD_TPU_SANITIZER_STATIC_INDEX", "").strip()
+    return StaticIndex.load(path) if path else None
+
+
 class CollectiveSanitizer:
     """Per-engine ledger recorder + digest tagger."""
 
-    def __init__(self, capacity: int = 512, content_hash: bool = False):
+    def __init__(self, capacity: int = 512, content_hash: bool = False,
+                 static_index: Optional[StaticIndex] = None):
         self.capacity = capacity
+        # Static call-graph linkage for reports (StaticIndex docstring).
+        self.static_index = static_index if static_index is not None \
+            else _env_static_index()
         # HVD_TPU_SANITIZER=hash: fold a content digest of each entry's
         # LOCAL contribution into the tag.  Costs one device→host copy per
         # submission — the documented price of closing the same-site
@@ -241,8 +294,13 @@ class CollectiveSanitizer:
         entries = self.tail(n)
         if not entries:
             return "(collective ledger empty)"
+        idx = self.static_index
+
+        def line(e: LedgerEntry) -> str:
+            return e.render() + (idx.annotate(e.site) if idx else "")
+
         return "last submissions on this rank:\n  " + \
-            "\n  ".join(e.render() for e in entries)
+            "\n  ".join(line(e) for e in entries)
 
 
 class SanitizerStallInspector:
@@ -310,6 +368,9 @@ class SanitizerStallInspector:
             for name in sorted(newly):
                 site = tags.get(name, "")
                 site = site.split("site=", 1)[1] if "site=" in site else "?"
+                site = site.split(";", 1)[0]
+                if self._sanitizer.static_index is not None:
+                    site += self._sanitizer.static_index.annotate(site)
                 log.warning(
                     "HVD302 sanitizer: collective %r (submitted at %s) is "
                     "stalled%s; %s%s", name, site,

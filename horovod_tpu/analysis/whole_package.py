@@ -1,0 +1,551 @@
+"""Pass 2 of the whole-package analyzer: interprocedural fact propagation.
+
+Runs on the symbol table + call graph from :mod:`.callgraph` and closes the
+gaps per-module lint cannot see:
+
+- **HVD101, interprocedural** — a collective inside a helper that is only
+  *called* from a rank-guarded branch (possibly across modules, through
+  aliases/partials/methods) is flagged at the collective site with the
+  guarded call chain spelled out.  Context-bounded: a helper called from
+  both guarded and unguarded sites reports only the guarded path — the
+  guard context travels along each chain instead of being merged into the
+  callee.
+- **HVD102/HVD103, cross-module** — process-set registration and
+  initial-broadcast facts are unioned over each entry point's call-graph
+  closure.  A training script whose ``broadcast_parameters`` lives in a
+  helper module stops false-positiving; one whose ``init()`` and
+  ``DistributedOptimizer`` are split across modules starts firing.
+- **HVD108** — per entry point, a *collective schedule* (the sequence of
+  collectives reachable along each branch) is computed; two paths through
+  one function that emit different sequences are flagged unless the branch
+  condition is provably rank-invariant.
+- **HVD109** — collectives reachable from elastic/churn transition
+  callbacks (``on_leave``/``new_generation``/... or functions handed to
+  ``register_reset_callbacks``), where the rank set is mid-transition.
+
+``build_static_index`` exports a call-site → static-node map that the
+runtime sanitizer (``HVD_TPU_SANITIZER_STATIC_INDEX``) folds into its
+ledger reports, so a runtime divergence names the static finding that
+would have caught it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import (
+    CallSite, CollectiveSite, FunctionNode, ModuleInfo, Package,
+    build_package, is_uniform_test, reachable,
+)
+from .collective_lint import (
+    _FunctionFacts, _SYNC_CALLS, _TRAINING_WRAPPERS, lint_file,
+)
+from .findings import Finding
+
+_MAX_CHAIN = 16          # call-graph propagation depth bound
+_MAX_SCHEDULE_DEPTH = 10  # schedule splice depth bound
+
+
+def _suppressed(mod: ModuleInfo, line: int, rule: str) -> bool:
+    ids = mod.suppressed.get(line, set())
+    return "ALL" in ids or rule in ids
+
+
+def _chain_str(entry: FunctionNode, chain: Sequence[CallSite],
+               target: FunctionNode) -> str:
+    hops = [f"{entry.module.base}:{chain[0].line}" if chain else
+            entry.module.base]
+    for cs in chain[1:]:
+        hops.append(f"{cs.callee_expr or '?'}()")
+    hops.append(f"{target.name}() [{target.module.base}:{target.lineno}]")
+    return " -> ".join(hops)
+
+
+# ---------------------------------------------------------------------------
+# HVD101: rank-guard propagation along the call graph
+# ---------------------------------------------------------------------------
+
+def _interprocedural_hvd101(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    best: Dict[Tuple[str, int], Tuple[int, Finding]] = {}
+    for fn in pkg.iter_functions():
+        for cs in fn.calls:
+            if cs.guard is None or cs.resolved is None:
+                continue
+            # BFS from the guarded callee; the guard context belongs to
+            # THIS chain only (bounded context-sensitivity): other call
+            # sites of the same helper stay unguarded.
+            targets = [(cs.resolved, (cs,))]
+            targets += [(t, (cs,) + chain)
+                        for t, chain in reachable(cs.resolved,
+                                                  max_depth=_MAX_CHAIN)]
+            for target, chain in targets:
+                for col in target.collectives:
+                    if col.guard is not None:
+                        continue        # already flagged intra-procedurally
+                    if _suppressed(target.module, col.line, "HVD101") or \
+                            _suppressed(fn.module, cs.line, "HVD101"):
+                        continue
+                    key = (target.module.path, col.line)
+                    f = Finding(
+                        rule="HVD101", path=target.module.path,
+                        line=col.line, col=col.col,
+                        message=(
+                            f"collective {col.name!r} is only reached "
+                            f"through a rank-guarded call chain "
+                            f"({cs.guard.describe(fn.module.base)}): "
+                            f"{_chain_str(fn, chain, target)} — only a "
+                            f"subset of ranks submits it, the rest of the "
+                            f"world blocks in negotiation"))
+                    prev = best.get(key)
+                    if prev is None or len(chain) < prev[0]:
+                        best[key] = (len(chain), f)
+    findings.extend(f for _, f in best.values())
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# HVD102/HVD103: entry-closure fact flow
+# ---------------------------------------------------------------------------
+
+def _entry_roots(mod: ModuleInfo) -> List[FunctionNode]:
+    """Closure roots of a module: its top level plus every function defined
+    in it that no analyzed code calls (externally invokable — ``main()``
+    behind an ``if __name__`` block, CLI handlers, callbacks)."""
+    roots = [mod.toplevel] if mod.toplevel is not None else []
+    roots += [fn for fn in mod.all_functions
+              if fn is not mod.toplevel and fn.in_edges == 0]
+    return roots
+
+
+def _closure(mod: ModuleInfo) -> List[FunctionNode]:
+    out: List[FunctionNode] = []
+    seen: Set[str] = set()
+    for root in _entry_roots(mod):
+        if root.qname not in seen:
+            seen.add(root.qname)
+            out.append(root)
+        for t, _chain in reachable(root, max_depth=_MAX_CHAIN):
+            if t.qname not in seen:
+                seen.add(t.qname)
+                out.append(t)
+    return out
+
+
+def _closure_facts_hvd102_103(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in pkg.all_modules:
+        closure = _closure(mod)
+        names: Set[str] = set()
+        elastic = False
+        for fn in closure:
+            names |= fn.called_names
+            elastic = elastic or fn.uses_elastic_state
+
+        # HVD103 over the closure: init + gradient reduction anywhere in
+        # reach, no state sync anywhere in reach.
+        if "init" in names and (names & _TRAINING_WRAPPERS) \
+                and not (names & _SYNC_CALLS) and not elastic:
+            line = mod.first_training_line or mod.init_line or 1
+            if not _suppressed(mod, line, "HVD103"):
+                findings.append(Finding(
+                    rule="HVD103", path=mod.path, line=line, col=1,
+                    message=(
+                        "entry point calls init() and reduces gradients "
+                        "(directly or through its call-graph closure) but "
+                        "never broadcasts initial state from rank 0; ranks "
+                        "train divergent models")))
+
+        # HVD102 cross-module: the closure registers subgroup process sets
+        # somewhere, and THIS module's own code submits bare collectives.
+        # (Same-module registration is per-module lint's job — skip it to
+        # avoid duplicate findings.)
+        own_names: Set[str] = set()
+        for fn in mod.all_functions:
+            own_names |= fn.called_names
+        if "add_process_set" in names and "add_process_set" not in own_names:
+            for fn in mod.all_functions:
+                for col in fn.collectives:
+                    if col.has_process_set or \
+                            _suppressed(mod, col.line, "HVD102"):
+                        continue
+                    findings.append(Finding(
+                        rule="HVD102", path=mod.path, line=col.line,
+                        col=col.col,
+                        message=(
+                            f"collective {col.name!r} omits process_set= "
+                            f"while this entry point's call-graph closure "
+                            f"registers subgroup process sets (in another "
+                            f"module); it targets the GLOBAL set — a "
+                            f"deadlock if only subgroup members reach "
+                            f"this call")))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# HVD108: collective schedules per branch
+# ---------------------------------------------------------------------------
+
+def _terminates(stmts) -> bool:
+    """A statement list that definitely leaves the enclosing suite."""
+    import ast
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def _schedule_stmts(stmts, fn: FunctionNode, pkg: Package, memo, stack,
+                    divergences, depth: int, collect: bool):
+    """Schedule of a statement list.  ``collect`` gates divergence
+    recording so each function's If nodes are reported once (when the
+    function itself is analyzed), not re-reported at every splice site."""
+    import ast
+    seq: List = []
+    calls_by_line: Dict[Tuple[int, int], FunctionNode] = {}
+    for cs in fn.calls:
+        if cs.resolved is not None:
+            calls_by_line[(cs.line, cs.col)] = cs.resolved
+    cols_by_line: Dict[Tuple[int, int], CollectiveSite] = {
+        (c.line, c.col): c for c in fn.collectives}
+
+    def expr_events(node) -> List:
+        # Post-order: a call's arguments are evaluated (and their
+        # collectives submitted) BEFORE the call itself completes, so
+        # hvd.allgather(helper(x)) must record helper's ops first.
+        ev: List = []
+
+        def rec(n):
+            for child in ast.iter_child_nodes(n):
+                rec(child)
+            if not isinstance(n, ast.Call):
+                return
+            key = (n.lineno, n.col_offset + 1)
+            col = cols_by_line.get(key)
+            if col is not None:
+                ev.append(("op", col.name))
+                return
+            target = calls_by_line.get(key)
+            if target is not None:
+                spliced = _schedule_of(target, pkg, memo, stack, depth + 1)
+                if spliced is not None:
+                    ev.append(spliced)
+
+        rec(node)
+        return [e for e in ev if e not in (("seq",), None)]
+
+    def sub_sched(sub_stmts):
+        return _schedule_stmts(sub_stmts, fn, pkg, memo, stack,
+                               divergences, depth, collect)
+
+    tainted = _fn_tainted(fn)
+    i, n = 0, len(stmts)
+    while i < n:
+        stmt = stmts[i]
+        i += 1
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue                       # defs don't run at the def site
+        if isinstance(stmt, ast.If):
+            seq.extend(expr_events(stmt.test))
+            body_t, or_t = _terminates(stmt.body), _terminates(stmt.orelse)
+            if (body_t or or_t) and i < n:
+                # Guard-clause folding: a terminating arm's real
+                # alternative is the FALL-THROUGH code, not the lexical
+                # orelse — `if fast: return coll(x)` vs the rest of the
+                # function compare as two complete paths.
+                rest = stmts[i:]
+                a = _prune(sub_sched(
+                    list(stmt.body) + ([] if body_t else rest)))
+                b = _prune(sub_sched(
+                    list(stmt.orelse) + ([] if or_t else rest)))
+                i = n                      # rest is folded into the arms
+            else:
+                a = _prune(sub_sched(stmt.body))
+                b = _prune(sub_sched(stmt.orelse))
+            if a == b:
+                if a is not None:
+                    seq.append(a)
+            else:
+                if collect and not is_uniform_test(stmt.test, tainted,
+                                                   _fn_uniform_names(fn)):
+                    divergences.append((fn, stmt.lineno, a, b))
+                seq.append(("branch", a or ("seq",), b or ("seq",)))
+        elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            if isinstance(stmt, ast.While):
+                seq.extend(expr_events(stmt.test))
+            else:
+                seq.extend(expr_events(stmt.iter))
+            body = _schedule_stmts(stmt.body, fn, pkg, memo, stack,
+                                   divergences, depth, collect)
+            if len(body) > 1:
+                seq.append(("loop", body))
+            seq.extend(_schedule_stmts(stmt.orelse, fn, pkg, memo, stack,
+                                       divergences, depth, collect)[1:])
+        elif isinstance(stmt, ast.Try):
+            seq.extend(_schedule_stmts(stmt.body, fn, pkg, memo, stack,
+                                       divergences, depth, collect)[1:])
+            # handlers model exceptional divergence — deliberately ignored
+            seq.extend(_schedule_stmts(stmt.finalbody, fn, pkg, memo, stack,
+                                       divergences, depth, collect)[1:])
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                seq.extend(expr_events(item.context_expr))
+            seq.extend(_schedule_stmts(stmt.body, fn, pkg, memo, stack,
+                                       divergences, depth, collect)[1:])
+        else:
+            seq.extend(expr_events(stmt))
+    return tuple(["seq"] + seq)
+
+
+def _prune(sched):
+    """Normalize a schedule: drop every structural node (seq/branch/loop)
+    that contains no collective op anywhere beneath it, and flatten nested
+    sequences — so two branches that differ only in collective-free
+    structure compare EQUAL (both prune to None).  Cycle markers prune
+    away too: an unexpanded recursive call contributes no known ops."""
+    if not isinstance(sched, tuple) or not sched:
+        return None
+    tag = sched[0]
+    if tag == "op":
+        return sched
+    if tag == "seq":
+        flat: List = []
+        for item in sched[1:]:
+            p = _prune(item)
+            if p is None:
+                continue
+            if isinstance(p, tuple) and p and p[0] == "seq":
+                flat.extend(p[1:])
+            else:
+                flat.append(p)
+        return tuple(["seq"] + flat) if flat else None
+    if tag == "branch":
+        a, b = _prune(sched[1]), _prune(sched[2])
+        if a is None and b is None:
+            return None
+        if a == b:
+            return a
+        return ("branch", a or ("seq",), b or ("seq",))
+    if tag == "loop":
+        body = _prune(sched[1])
+        return None if body is None else ("loop", body)
+    return None        # "cycle" and anything unknown
+
+
+def _fn_tainted(fn: FunctionNode) -> Set[str]:
+    cached = getattr(fn, "_tainted", None)
+    if cached is None:
+        facts = _FunctionFacts()
+        if fn.node is not None:
+            facts.visit(fn.node)
+        cached = fn._tainted = facts.tainted
+    return cached
+
+
+def _fn_uniform_names(fn: FunctionNode) -> Set[str]:
+    """Names assigned from world-size-style accessors — rank-invariant by
+    construction, so branches on them don't diverge the schedule."""
+    from .callgraph import _UNIFORM_CALLS
+    cached = getattr(fn, "_uniform_names", None)
+    if cached is None:
+        facts = _FunctionFacts(source_calls=_UNIFORM_CALLS)
+        if fn.node is not None:
+            facts.visit(fn.node)
+        cached = fn._uniform_names = facts.tainted
+    return cached
+
+
+# Reserved memo key counting cycle/depth truncations ("::" can't appear in
+# a function qname, so it never collides with one).
+_TRUNCATED = "::truncated::"
+
+
+def _schedule_of(fn: FunctionNode, pkg: Package, memo, stack,
+                 depth: int = 0):
+    """Context-insensitive schedule summary of a function.
+
+    Memoized ONLY when the computation was not truncated by a cycle or the
+    depth bound: a truncated schedule depends on what was on the recursion
+    stack at the time, and caching it would silently hide collectives in
+    every later (non-cyclic) context — suppressing real HVD108 findings.
+    """
+    if fn.qname in memo:
+        return memo[fn.qname]
+    if fn.qname in stack or depth > _MAX_SCHEDULE_DEPTH:
+        memo[_TRUNCATED] = memo.get(_TRUNCATED, 0) + 1
+        return ("cycle", fn.qname)
+    if fn.node is None:
+        return ("seq",)
+    import ast
+    body = fn.node.body if isinstance(
+        fn.node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)) else []
+    stack = stack | {fn.qname}
+    before = memo.get(_TRUNCATED, 0)
+    sched = _prune(_schedule_stmts(body, fn, pkg, memo, stack, [], depth,
+                                   collect=False))
+    if memo.get(_TRUNCATED, 0) == before:
+        memo[fn.qname] = sched         # context-free: safe to reuse
+    return sched
+
+
+def _render_schedule(sched, limit: int = 6) -> str:
+    if sched is None:
+        return "(no collectives)"
+    ops: List[str] = []
+
+    def walk(node):
+        if not isinstance(node, tuple) or not node:
+            return
+        if node[0] == "op":
+            ops.append(node[1])
+        elif node[0] == "seq":
+            for item in node[1:]:
+                walk(item)
+        elif node[0] == "branch":
+            ops.append("{" + _render_schedule(node[1], limit) + " | "
+                       + _render_schedule(node[2], limit) + "}")
+        elif node[0] == "loop":
+            ops.append("loop[" + _render_schedule(node[1], limit) + "]")
+        elif node[0] == "cycle":
+            ops.append("…")
+
+    walk(sched)
+    if not ops:
+        return "(no collectives)"
+    if len(ops) > limit:
+        ops = ops[:limit] + ["…"]
+    return ", ".join(ops)
+
+
+def _schedule_hvd108(pkg: Package) -> List[Finding]:
+    import ast
+    findings: List[Finding] = []
+    memo: Dict = {}
+    seen: Set[Tuple[str, int]] = set()
+    for fn in pkg.iter_functions():
+        if fn.node is None:
+            continue
+        divergences: List = []
+        body = fn.node.body if isinstance(
+            fn.node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)) \
+            else []
+        _schedule_stmts(body, fn, pkg, memo, {fn.qname}, divergences, 0,
+                        collect=True)
+        for owner, line, a, b in divergences:
+            key = (owner.module.path, line)
+            if key in seen or _suppressed(owner.module, line, "HVD108"):
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                rule="HVD108", path=owner.module.path, line=line, col=1,
+                message=(
+                    f"the if/else branches at line {line} of "
+                    f"{owner.name}() emit different collective schedules: "
+                    f"[{_render_schedule(a)}] vs [{_render_schedule(b)}] — "
+                    f"ranks taking different branches negotiate different "
+                    f"sequences")))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# HVD109: collectives reachable from transition callbacks
+# ---------------------------------------------------------------------------
+
+def _callback_hvd109(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    for fn in pkg.iter_functions():
+        if not fn.is_callback:
+            continue
+        targets: List[Tuple[FunctionNode, Tuple[CallSite, ...]]] = \
+            [(fn, ())] + list(reachable(fn, max_depth=_MAX_CHAIN))
+        for target, chain in targets:
+            for col in target.collectives:
+                key = (target.module.path, col.line)
+                if key in seen or \
+                        _suppressed(target.module, col.line, "HVD109"):
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    rule="HVD109", path=target.module.path, line=col.line,
+                    col=col.col,
+                    message=(
+                        f"collective {col.name!r} is reachable from "
+                        f"elastic-transition callback {fn.name!r} "
+                        f"({fn.module.base}:{fn.lineno}"
+                        + (f", via {_chain_str(fn, chain, target)}"
+                           if chain else "")
+                        + ") — the rank set is mid-transition there; "
+                          "peers may already have left")))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def analyze_package(paths: Sequence[str],
+                    package: Optional[Package] = None) -> List[Finding]:
+    """Whole-package analysis: per-module lint + interprocedural passes.
+
+    Returns findings sorted by (path, line, rule).  Per-module HVD103
+    findings refuted by cross-module facts (the broadcast lives in a
+    helper module) are dropped — whole-package mode is strictly more
+    precise in both directions.
+    """
+    pkg = package or build_package(paths)
+    findings: List[Finding] = []
+    from .collective_lint import iter_python_files, lint_source
+    by_path = {m.path: m for m in pkg.all_modules}
+    for f in iter_python_files(paths):
+        ap = os.path.abspath(f)
+        mod = by_path.get(ap)
+        # Pass 1 already read+parsed every parseable module — lint its
+        # retained source instead of re-reading; files pass 1 skipped
+        # (syntax errors) still go through lint_file for their HVD100.
+        per_module = lint_source(mod.source, ap) if mod is not None \
+            else lint_file(f)
+        for finding in per_module:
+            if finding.rule == "HVD103":
+                continue    # recomputed over closures below, both verdicts
+            finding.path = os.path.abspath(finding.path)
+            findings.append(finding)
+    findings += _interprocedural_hvd101(pkg)
+    findings += _closure_facts_hvd102_103(pkg)
+    findings += _schedule_hvd108(pkg)
+    findings += _callback_hvd109(pkg)
+    uniq: Dict[Tuple[str, str, int, int], Finding] = {}
+    for f in findings:
+        uniq.setdefault((f.rule, os.path.abspath(f.path), f.line, f.col), f)
+    return sorted(uniq.values(), key=lambda f: (f.path, f.line, f.col,
+                                                f.rule))
+
+
+def build_static_index(paths: Sequence[str],
+                       package: Optional[Package] = None,
+                       findings: Optional[List[Finding]] = None) -> Dict:
+    """Map ``basename:line`` call sites → static call-graph nodes + the
+    rules flagged there.  The runtime sanitizer keys its ledger sites the
+    same way (``HVD_TPU_SANITIZER_STATIC_INDEX``), so a runtime divergence
+    report can name the static finding that would have caught it."""
+    pkg = package or build_package(paths)
+    if findings is None:
+        findings = analyze_package(paths, package=pkg)
+    rules_by_site: Dict[str, List[str]] = {}
+    for f in findings:
+        site = f"{os.path.basename(f.path)}:{f.line}"
+        rules = rules_by_site.setdefault(site, [])
+        if f.rule not in rules:
+            rules.append(f.rule)
+    sites: Dict[str, Dict] = {}
+    for fn in pkg.iter_functions():
+        for i, col in enumerate(fn.collectives):
+            site = f"{fn.module.base}:{col.line}"
+            sites[site] = {
+                "node": fn.qname,
+                "op": col.name,
+                "index": i,
+                "guarded": col.guard is not None,
+                "rules": rules_by_site.get(site, []),
+            }
+    return {"version": 1, "sites": sites}

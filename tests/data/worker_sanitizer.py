@@ -66,7 +66,7 @@ def hash_main(rank):
 def main():
     hvd.init()
     rank = hvd.rank()
-    if os.environ.get("HVD_TPU_SANITIZER", "").strip().lower() == "hash":
+    if os.environ.get("HVD_TPU_SANITIZER", "").strip().lower() == "hash":  # hvd-lint: disable=HVD108  (env-selected test mode)
         hash_main(rank)
         return
     a = np.ones(4, np.float32)
@@ -74,11 +74,11 @@ def main():
 
     try:
         if rank == 0:   # hvd-lint: disable=HVD101  (deliberate divergence)
-            h1 = hvd.allreduce_async(a)
-            h2 = hvd.allreduce_async(b)
+            h1 = hvd.allreduce_async(a)  # hvd-lint: disable=HVD101
+            h2 = hvd.allreduce_async(b)  # hvd-lint: disable=HVD101
         else:
-            h1 = hvd.allreduce_async(b)
-            h2 = hvd.allreduce_async(a)
+            h1 = hvd.allreduce_async(b)  # hvd-lint: disable=HVD101
+            h2 = hvd.allreduce_async(a)  # hvd-lint: disable=HVD101  (deliberate order swap under test)
         hvd.synchronize([h1, h2])
         print("SANITIZER_MISSED", flush=True)
     except NegotiationError as e:

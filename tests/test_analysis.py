@@ -769,6 +769,80 @@ def test_spmd_check_strict_raises_on_bad_ppermute(world_size):
         fn(params, opt_state, data, data)
 
 
+# ================================== HVD201-203 on shard_map-partitioned fns
+# The compat-shimmed shard_map path (horovod_tpu.compat.shard_map) had no
+# direct trace-check coverage: the mesh axes are bound INSIDE the traced
+# jaxpr by the shard_map eqn's params, not by the outer axis_env, so the
+# walker's sub-jaxpr descent is what these tests pin down.
+def test_trace_check_hvd201_unknown_axis_inside_shard_map(world_size):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from horovod_tpu.compat import shard_map
+    from horovod_tpu.analysis.trace_check import check_step_fn
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    def body(x):
+        return jax.lax.psum(x, "tp")      # mesh binds only "dp"
+
+    step = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                     check_vma=False)
+    report = check_step_fn(step, jnp.zeros((world_size, 4)), mesh=mesh)
+    assert not report.ok
+    assert any(f.rule == "HVD201" for f in report.findings)
+    assert any("tp" in f.message for f in report.findings)
+
+
+def test_trace_check_hvd202_bad_groups_inside_shard_map(world_size):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from horovod_tpu.compat import shard_map
+    from horovod_tpu.analysis.trace_check import check_step_fn
+
+    if world_size < 4:
+        pytest.skip("needs >= 4 devices for a non-partitioning group set")
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    half = [[0, 1], [2, 3]]               # covers 0-3 of the dp axis only
+
+    def body(x):
+        return jax.lax.psum(x, "dp", axis_index_groups=half)
+
+    step = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                     check_vma=False)
+    report = check_step_fn(step, jnp.zeros((world_size, 4)), mesh=mesh)
+    f202 = [f for f in report.findings if f.rule == "HVD202"]
+    assert f202, [f.render() for f in report.findings]
+    assert "partition" in f202[0].message
+
+
+def test_trace_check_hvd203_callback_inside_shard_map(world_size):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from horovod_tpu.compat import shard_map
+    from horovod_tpu.analysis.trace_check import check_step_fn
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    def body(x):
+        g = jax.lax.psum(x, "dp")
+        return jax.pure_callback(
+            lambda v: v, jax.ShapeDtypeStruct(g.shape, g.dtype), g)
+
+    step = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                     check_vma=False)
+    report = check_step_fn(step, jnp.zeros((world_size, 4)), mesh=mesh)
+    assert any(f.rule == "HVD203" for f in report.findings), \
+        [f.render() for f in report.findings]
+    # The ledger still records the psum that precedes the callback.
+    assert any(r.primitive == "psum" for r in report.ledger)
+
+
 def test_hvd204_clean_on_multi_axis_ring():
     """Ranks in a multi-axis ppermute index the axes' flattened product:
     a full 4-ring over a 2x2 ('a','b') mesh must not be flagged."""

@@ -93,6 +93,63 @@ def test_self_lint_covers_fault_harness():
     assert not findings, "\n".join(f.render() for f in findings)
 
 
+# ------------------------------------------------- whole-package gate (13)
+_GATE_RESULT = []      # memo: the full-repo analysis runs once per session
+
+
+def _gate_result():
+    if not _GATE_RESULT:
+        from horovod_tpu.analysis.gate import run_gate
+        _GATE_RESULT.append(run_gate(root=REPO, quiet=True))
+    return _GATE_RESULT[0]
+
+
+def test_whole_package_gate_green():
+    """The interprocedural self-lint (tools/lint_gate.py semantics): the
+    two-pass analyzer over horovod_tpu/ + examples/ + tools/ + bench.py
+    must produce NO findings beyond the reviewed baseline."""
+    new, _stale, _baselined = _gate_result()
+    assert not new, (
+        "new whole-package findings (fix them, pragma them with a reason, "
+        "or — warnings only — re-baseline via "
+        "`python tools/lint_gate.py --update-baseline`):\n"
+        + "\n".join(f.render() for f in new))
+
+
+def test_whole_package_baseline_not_stale():
+    """Baseline honesty: entries whose finding no longer fires must be
+    pruned in the same PR that fixes the code."""
+    _new, stale, _baselined = _gate_result()
+    assert not stale, f"stale baseline entries, prune them: {stale}"
+
+
+def test_whole_package_baseline_carries_no_errors():
+    """Only warning-severity findings may be baselined; error-severity
+    ones must be fixed or carry an inline pragma with a reason."""
+    from horovod_tpu.analysis.baseline import load_baseline
+    from horovod_tpu.analysis.findings import RULES, Severity
+    baseline = load_baseline(
+        os.path.join(REPO, "tools", "lint_baseline.json"))
+    errors = [k for k in baseline
+              if RULES[k[0]].severity is Severity.ERROR]
+    assert not errors, errors
+
+
+def test_known_out_of_scope_files_now_lint_clean_via_pragmas():
+    """ISSUE 13 satellite: bench.py's HVD103 and the deliberate divergence
+    in tests/data/worker_join.py / worker_sanitizer.py are annotated with
+    inline pragmas — the files lint error-free WITHOUT directory scoping,
+    so the old ROADMAP carve-out is gone (bench.py is in the gate scope)."""
+    findings = lint_paths([
+        os.path.join(REPO, "bench.py"),
+        os.path.join(REPO, "tests", "data", "worker_join.py"),
+        os.path.join(REPO, "tests", "data", "worker_sanitizer.py"),
+    ])
+    errors = [f for f in findings if f.is_error]
+    assert not errors, "\n".join(f.render() for f in errors)
+    assert not any(f.rule == "HVD103" for f in findings)   # bench pragma
+
+
 def test_allowlist_entries_still_fire():
     """Stale allowlist entries (fixed code, moved lines) must be pruned."""
     findings = lint_paths([os.path.join(REPO, "horovod_tpu"),

@@ -1,0 +1,793 @@
+"""Tests for the whole-package interprocedural analyzer (ISSUE 13).
+
+Every acceptance claim has a positive AND a control: fixtures that the
+whole-package mode must flag are also run through per-module mode to prove
+the per-module analysis MISSES them (the gap the two-pass mode closes),
+and each new rule (HVD108/HVD109) has a negative fixture that stays clean.
+Plus: pragma parsing through the interprocedural path, baseline
+round-trip, SARIF 2.1.0 schema validity, static-index linkage into the
+runtime sanitizer, CLI exit codes, and the repo gate plumbing.
+
+Everything here is jax-free: the whole-package mode is pure AST analysis.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from horovod_tpu.analysis import analyze_package, lint_paths
+from horovod_tpu.analysis.whole_package import build_static_index
+
+
+def make_pkg(tmp_path, files, name="fixture"):
+    d = tmp_path / name
+    d.mkdir(exist_ok=True)
+    for rel, src in files.items():
+        p = d / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(d)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ==================================================== HVD101 interprocedural
+GUARDED_HELPER = {
+    "__init__.py": "",
+    "helpers.py": """
+        import horovod_tpu as hvd
+
+        def do_sum(x):
+            return hvd.allreduce(x, name="s")
+    """,
+    "train.py": """
+        import horovod_tpu as hvd
+        from .helpers import do_sum
+
+        def main(x):
+            if hvd.rank() == 0:
+                do_sum(x)
+    """,
+}
+
+
+def test_hvd101_cross_module_guarded_helper(tmp_path):
+    pkg = make_pkg(tmp_path, GUARDED_HELPER)
+    findings = analyze_package([pkg])
+    hits = by_rule(findings, "HVD101")
+    assert len(hits) == 1
+    f = hits[0]
+    assert f.path.endswith("helpers.py") and f.line == 5
+    assert "rank-guarded call chain" in f.message
+    assert "train.py" in f.message and "do_sum" in f.message
+
+
+def test_hvd101_control_per_module_mode_misses_it(tmp_path):
+    """The acceptance control: the SAME fixture is provably invisible to
+    per-module analysis — the gap ISSUE 13 closes."""
+    pkg = make_pkg(tmp_path, GUARDED_HELPER)
+    assert "HVD101" not in rules_of(lint_paths([pkg]))
+
+
+def test_hvd101_through_alias_partial_and_transitive_helper(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "__init__.py": "",
+        "deep.py": """
+            import horovod_tpu as hvd
+
+            def inner(x):
+                return hvd.barrier()
+
+            def outer(x):
+                return inner(x)
+        """,
+        "main.py": """
+            import functools
+            import horovod_tpu as hvd
+            from .deep import outer
+
+            g = functools.partial(outer, 1)
+
+            def run():
+                if hvd.rank() != 0:
+                    g()
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD101")
+    assert len(hits) == 1 and hits[0].path.endswith("deep.py")
+    assert "barrier" in hits[0].message
+
+
+def test_hvd101_context_sensitivity_reports_only_guarded_path(tmp_path):
+    """A helper called from BOTH guarded and unguarded sites reports once,
+    attributing the guarded chain — guard context travels per call chain,
+    it is not merged into the callee."""
+    pkg = make_pkg(tmp_path, {
+        "mod.py": """
+            import horovod_tpu as hvd
+
+            def both_sides(x):
+                return hvd.allreduce(x, name="b")
+
+            def caller(x):
+                both_sides(x)
+                if hvd.rank() == 0:
+                    both_sides(x)
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD101")
+    assert len(hits) == 1
+    assert "rank-guarded" in hits[0].message
+
+
+def test_hvd101_through_nested_package_reexport(tmp_path):
+    """Relative imports/re-exports inside a NESTED package's __init__.py
+    resolve against the full dotted package name (an __init__ IS its
+    package, not a sibling of it)."""
+    pkg = make_pkg(tmp_path, {
+        "__init__.py": "",
+        "sub/__init__.py": "from .impl import do_sum\n",
+        "sub/impl.py": """
+            import horovod_tpu as hvd
+
+            def do_sum(x):
+                return hvd.allreduce(x, name="s")
+        """,
+        "train.py": """
+            import horovod_tpu as hvd
+            from .sub import do_sum
+
+            def main(x):
+                if hvd.rank() == 0:
+                    do_sum(x)
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD101")
+    assert len(hits) == 1 and hits[0].path.endswith("impl.py")
+
+
+def test_hvd101_unguarded_helper_stays_clean(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "mod.py": """
+            import horovod_tpu as hvd
+
+            def helper(x):
+                return hvd.allreduce(x)
+
+            def caller(x):
+                return helper(x)
+        """,
+    })
+    assert "HVD101" not in rules_of(analyze_package([pkg]))
+
+
+def test_hvd101_method_resolution_through_binding_instance(tmp_path):
+    """The optimizer-binding idiom: a method reached through an instance
+    variable (``opt = Wrapper(); opt.apply(...)``) is resolved."""
+    pkg = make_pkg(tmp_path, {
+        "mod.py": """
+            import horovod_tpu as hvd
+
+            class Wrapper:
+                def apply(self, g):
+                    return self._reduce(g)
+
+                def _reduce(self, g):
+                    return hvd.allreduce(g, name="g")
+
+            def main(g):
+                opt = Wrapper()
+                if hvd.rank() == 0:
+                    opt.apply(g)
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD101")
+    assert len(hits) == 1 and "allreduce" in hits[0].message
+
+
+def test_hvd101_pragma_suppresses_interprocedural_finding(tmp_path):
+    files = dict(GUARDED_HELPER)
+    files["helpers.py"] = """
+        import horovod_tpu as hvd
+
+        def do_sum(x):
+            return hvd.allreduce(x, name="s")  # hvd-lint: disable=HVD101
+    """
+    pkg = make_pkg(tmp_path, files)
+    assert "HVD101" not in rules_of(analyze_package([pkg]))
+
+
+# ==================================================== HVD103 cross-module
+SPLIT_TRAINING = {
+    "__init__.py": "",
+    "trainer.py": """
+        import horovod_tpu as hvd
+
+        def make_opt(sgd):
+            return hvd.DistributedOptimizer(sgd)
+    """,
+    "train.py": """
+        import horovod_tpu as hvd
+        from .trainer import make_opt
+
+        def main(sgd):
+            hvd.init()
+            opt = make_opt(sgd)
+    """,
+}
+
+
+def test_hvd103_cross_module_missing_broadcast(tmp_path):
+    """init() in the entry, DistributedOptimizer in a helper module, no
+    broadcast anywhere: only the closure union sees the bug."""
+    pkg = make_pkg(tmp_path, SPLIT_TRAINING)
+    hits = by_rule(analyze_package([pkg]), "HVD103")
+    assert len(hits) == 1 and hits[0].path.endswith("train.py")
+
+
+def test_hvd103_control_per_module_mode_misses_it(tmp_path):
+    pkg = make_pkg(tmp_path, SPLIT_TRAINING)
+    assert "HVD103" not in rules_of(lint_paths([pkg]))
+
+
+def test_hvd103_cross_module_broadcast_refutes_per_module_fp(tmp_path):
+    """The other direction: per-module mode false-positives when the
+    broadcast lives in a helper module; whole-package mode is quiet."""
+    pkg = make_pkg(tmp_path, {
+        "__init__.py": "",
+        "setup.py": """
+            import horovod_tpu as hvd
+
+            def sync(params):
+                return hvd.broadcast_parameters(params, root_rank=0)
+        """,
+        "train.py": """
+            import horovod_tpu as hvd
+            from .setup import sync
+
+            def main(params, sgd):
+                hvd.init()
+                opt = hvd.DistributedOptimizer(sgd)
+                sync(params)
+        """,
+    })
+    per_module = lint_paths([pkg])
+    assert "HVD103" in rules_of(per_module)          # the old false positive
+    assert "HVD103" not in rules_of(analyze_package([pkg]))
+
+
+# ==================================================== HVD102 cross-module
+def test_hvd102_cross_module_process_set_registration(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "__init__.py": "",
+        "sets.py": """
+            import horovod_tpu as hvd
+
+            def make_sets():
+                return hvd.add_process_set([0, 2])
+        """,
+        "train.py": """
+            import horovod_tpu as hvd
+            from .sets import make_sets
+
+            def main(x):
+                evens = make_sets()
+                return hvd.allreduce(x)
+        """,
+    })
+    pm = [f for f in lint_paths([pkg])
+          if f.rule == "HVD102" and f.path.endswith("train.py")]
+    assert not pm                                    # per-module mode misses
+    hits = [f for f in by_rule(analyze_package([pkg]), "HVD102")
+            if f.path.endswith("train.py")]
+    assert len(hits) == 1 and "another" in hits[0].message
+
+
+# =========================================================== HVD108
+def test_hvd108_branch_divergent_schedule(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "step.py": """
+            import horovod_tpu as hvd
+
+            def helper(x):
+                return hvd.allreduce(x, name="g")
+
+            def step(x, fast):
+                if fast:
+                    y = helper(x)
+                    return hvd.allgather(y)
+                return hvd.allgather(x)
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD108")
+    assert len(hits) == 1 and hits[0].line == 8      # the `if fast:` line
+    assert "allreduce, allgather" in hits[0].message
+    assert not hits[0].is_error          # warning severity: needs judgement
+
+
+def test_hvd108_guard_clause_with_equal_paths_stays_clean(tmp_path):
+    """An early-returning arm's real alternative is the FALL-THROUGH code,
+    not the empty lexical orelse: two runtime-identical paths must compare
+    equal even when one is written guard-clause style."""
+    pkg = make_pkg(tmp_path, {
+        "step.py": """
+            import horovod_tpu as hvd
+
+            def helper(x):
+                return hvd.allreduce(x, name="g")
+
+            def step(x, fast):
+                if fast:
+                    return hvd.allgather(helper(x))
+                y = helper(x)
+                return hvd.allgather(y)
+        """,
+    })
+    assert "HVD108" not in rules_of(analyze_package([pkg]))
+
+
+def test_hvd108_schedule_records_nested_calls_in_evaluation_order(tmp_path):
+    """hvd.allgather(helper_allreduce(x)) submits the allreduce FIRST (the
+    argument is evaluated before the outer call) — the schedule, and hence
+    the divergence verdict, must honor evaluation order, not AST nesting."""
+    pkg = make_pkg(tmp_path, {
+        "step.py": """
+            import horovod_tpu as hvd
+
+            def helper(x):
+                return hvd.allreduce(x, name="g")
+
+            def step(x, fast):
+                if fast:
+                    return hvd.allgather(helper(x))   # allreduce, allgather
+                y = hvd.allgather(x)
+                return helper(y)                      # allgather, allreduce
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD108")
+    assert len(hits) == 1
+    assert "[allreduce, allgather] vs [allgather, allreduce]" \
+        in hits[0].message
+
+
+def test_hvd108_cycle_truncation_does_not_poison_the_memo(tmp_path):
+    """A schedule computed while its caller was on the recursion stack is
+    truncated at the back-edge; caching that truncated summary would hide
+    the callee's collectives from every later non-cyclic context."""
+    pkg = make_pkg(tmp_path, {
+        "mod.py": """
+            import horovod_tpu as hvd
+
+            def a(x):
+                y = hvd.allreduce(x, name="g")
+                return b(y)
+
+            def b(x):
+                if x > 0:
+                    return a(x - 1)
+                return x
+
+            def entry(x, flag):
+                if flag:
+                    b(x)
+                return x
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD108")
+    # entry's branch reaches a()'s allreduce through b(): [allreduce...]
+    # vs [] must diverge even though a<->b is cyclic.
+    assert any("entry()" in f.message for f in hits), \
+        [f.render() for f in hits]
+
+
+def test_same_stem_modules_outside_packages_both_analyzed(tmp_path):
+    """dir1/train.py and dir2/train.py share a module name; neither file's
+    findings may be dropped, in either argument order."""
+    files = {
+        "d1/train.py": """
+            import horovod_tpu as hvd
+
+            def main(opt):
+                hvd.init()
+                opt = hvd.DistributedOptimizer(opt)
+        """,
+        "d2/train.py": """
+            import horovod_tpu as hvd
+
+            def main(x):
+                return hvd.allreduce(x)
+        """,
+    }
+    pkg = make_pkg(tmp_path, files)
+    d1, d2 = f"{pkg}/d1/train.py", f"{pkg}/d2/train.py"
+    for order in ([d1, d2], [d2, d1]):
+        hits = by_rule(analyze_package(order), "HVD103")
+        assert len(hits) == 1 and hits[0].path.endswith("d1/train.py"), \
+            (order, [f.render() for f in hits])
+
+
+def test_hvd108_negative_controls_stay_clean(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "step.py": """
+            import horovod_tpu as hvd
+
+            def same_schedule(x, flag):
+                if flag:
+                    y = x * 2
+                    y = hvd.allreduce(y)
+                else:
+                    y = hvd.allreduce(x)
+                return y
+
+            def uniform_branch(x):
+                if hvd.size() > 1:
+                    return hvd.allreduce(x)
+                return x
+
+            def uniform_via_variable(x):
+                n = hvd.size()
+                if n >= 2:
+                    return hvd.allreduce(x)
+                return x
+
+            def rank_branch_is_hvd101_not_108(x):
+                if hvd.rank() == 0:
+                    return hvd.broadcast(x, root_rank=0)
+                return x
+        """,
+    })
+    findings = analyze_package([pkg])
+    assert "HVD108" not in rules_of(findings)
+    assert "HVD101" in rules_of(findings)     # the rank branch still fires
+
+
+# =========================================================== HVD109
+def test_hvd109_collective_in_transition_callback(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "elastic_cb.py": """
+            import horovod_tpu as hvd
+
+            def drain_stats(x):
+                return hvd.allreduce(x, name="drain")
+
+            class Hooks:
+                def on_leave(self, info):
+                    return drain_stats(info)
+
+                def new_generation(self, ranks):
+                    hvd.barrier()
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD109")
+    assert len(hits) == 2
+    assert all(f.is_error for f in hits)
+    msgs = " ".join(f.message for f in hits)
+    assert "on_leave" in msgs and "new_generation" in msgs
+    assert "mid-transition" in msgs
+
+
+def test_hvd109_registered_transition_callback(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "reg.py": """
+            import horovod_tpu as hvd
+
+            def flush(x):
+                return hvd.allgather(x)
+
+            def setup(driver):
+                driver.register_transition_callbacks([flush])
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD109")
+    assert len(hits) == 1 and "flush" in hits[0].message
+
+
+def test_hvd109_negative_controls_stay_clean(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "cb.py": """
+            import horovod_tpu as hvd
+
+            class Hooks:
+                def on_leave(self, info):
+                    print("leaving", info)     # no collective: clean
+
+                def on_reset(self):
+                    # post-transition state sync is the SANCTIONED pattern
+                    return hvd.broadcast_parameters({}, root_rank=0)
+
+            def ordinary(x):
+                return hvd.allreduce(x)
+        """,
+    })
+    assert "HVD109" not in rules_of(analyze_package([pkg]))
+
+
+# ============================================== satellite: jit unwrapping
+def test_jit_assignment_wrapping_no_longer_hides_body():
+    """``step = jax.jit(step_impl)`` puts step_impl in a jit context:
+    HVD106/HVD107 now see through the assignment wrap (previously the
+    decorated-by-assignment body hid from the jit-context rules)."""
+    from horovod_tpu.analysis import lint_source
+
+    findings = lint_source(textwrap.dedent("""
+        import jax
+        import horovod_tpu as hvd
+
+        def step_impl(x):
+            jax.block_until_ready(x)
+            return hvd.allreduce(x)
+
+        step = jax.jit(step_impl)
+    """), "fixture.py")
+    assert {"HVD106", "HVD107"} <= rules_of(findings)
+
+
+def test_shard_map_partial_decorator_counts_as_jit_context():
+    from horovod_tpu.analysis import lint_source
+
+    findings = lint_source(textwrap.dedent("""
+        import functools
+        import horovod_tpu as hvd
+        from horovod_tpu.compat import shard_map
+
+        @functools.partial(shard_map, mesh=None, in_specs=None,
+                           out_specs=None)
+        def body(x):
+            return hvd.allreduce(x)        # eager op at trace time
+    """), "fixture.py")
+    assert "HVD107" in rules_of(findings)
+
+
+def test_nested_jit_shard_map_assignment_unwraps():
+    from horovod_tpu.analysis import lint_source
+
+    findings = lint_source(textwrap.dedent("""
+        import jax
+        from horovod_tpu.compat import shard_map
+
+        def inner(x):
+            jax.device_get(x)
+            return x
+
+        step = jax.jit(shard_map(inner, mesh=None, in_specs=None,
+                                 out_specs=None))
+    """), "fixture.py")
+    assert "HVD106" in rules_of(findings)
+
+
+# ====================================================== baseline round-trip
+def test_baseline_round_trip_and_diff(tmp_path):
+    from horovod_tpu.analysis.baseline import (diff_baseline, finding_key,
+                                               load_baseline, write_baseline)
+    from horovod_tpu.analysis.findings import Finding
+
+    root = str(tmp_path)
+    a = Finding("HVD101", str(tmp_path / "a.py"), 3, 1, "m1")
+    b = Finding("HVD108", str(tmp_path / "sub" / "b.py"), 7, 1, "m2")
+    path = tmp_path / "baseline.json"
+    write_baseline([a, b], str(path), root=root)
+
+    loaded = load_baseline(str(path))
+    assert finding_key(a, root) in loaded
+    assert ("HVD108", "sub/b.py", 7) in loaded       # forward slashes
+
+    c = Finding("HVD104", str(tmp_path / "c.py"), 1, 1, "new one")
+    diff = diff_baseline([a, c], loaded, root=root)
+    assert [f.rule for f in diff.new] == ["HVD104"]
+    assert [f.rule for f in diff.matched] == ["HVD101"]
+    assert diff.stale == [("HVD108", "sub/b.py", 7)]   # b no longer fires
+
+
+def test_baseline_missing_file_is_empty(tmp_path):
+    from horovod_tpu.analysis.baseline import load_baseline
+    assert load_baseline(str(tmp_path / "nope.json")) == {}
+
+
+# ============================================================= SARIF output
+# The structural requirements of the SARIF 2.1.0 schema that matter for CI
+# ingestion (GitHub code scanning rejects logs violating any of these).
+_SARIF_SUBSET_SCHEMA = {
+    "type": "object",
+    "required": ["version", "runs"],
+    "properties": {
+        "version": {"const": "2.1.0"},
+        "runs": {
+            "type": "array",
+            "minItems": 1,
+            "items": {
+                "type": "object",
+                "required": ["tool"],
+                "properties": {
+                    "tool": {
+                        "type": "object",
+                        "required": ["driver"],
+                        "properties": {"driver": {
+                            "type": "object",
+                            "required": ["name"],
+                            "properties": {
+                                "name": {"type": "string"},
+                                "rules": {"type": "array", "items": {
+                                    "type": "object",
+                                    "required": ["id"],
+                                }},
+                            },
+                        }},
+                    },
+                    "results": {"type": "array", "items": {
+                        "type": "object",
+                        "required": ["message"],
+                        "properties": {
+                            "ruleId": {"type": "string"},
+                            "level": {"enum": ["none", "note", "warning",
+                                               "error"]},
+                            "message": {"type": "object",
+                                        "required": ["text"]},
+                            "locations": {"type": "array", "items": {
+                                "type": "object",
+                                "properties": {"physicalLocation": {
+                                    "type": "object",
+                                    "properties": {
+                                        "artifactLocation": {
+                                            "type": "object",
+                                            "properties": {"uri": {
+                                                "type": "string"}}},
+                                        "region": {
+                                            "type": "object",
+                                            "properties": {
+                                                "startLine": {
+                                                    "type": "integer",
+                                                    "minimum": 1},
+                                                "startColumn": {
+                                                    "type": "integer",
+                                                    "minimum": 1},
+                                            }},
+                                    }}},
+                            }},
+                        },
+                    }},
+                },
+            },
+        },
+    },
+}
+
+
+def test_sarif_output_validates_against_schema(tmp_path):
+    from horovod_tpu.analysis.sarif import to_sarif, write_sarif
+
+    pkg = make_pkg(tmp_path, GUARDED_HELPER)
+    findings = analyze_package([pkg])
+    assert findings
+    log = to_sarif(findings, root=pkg)
+
+    jsonschema = pytest.importorskip("jsonschema")
+    jsonschema.validate(log, _SARIF_SUBSET_SCHEMA)
+
+    run = log["runs"][0]
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert rule_ids == sorted(set(rule_ids))
+    for res in run["results"]:
+        assert res["ruleId"] in rule_ids
+        assert res["ruleIndex"] == rule_ids.index(res["ruleId"])
+        uri = res["locations"][0]["physicalLocation"]["artifactLocation"][
+            "uri"]
+        assert not uri.startswith("/") and "\\" not in uri   # repo-relative
+
+    out = tmp_path / "out.sarif"
+    write_sarif(findings, str(out), root=pkg)
+    assert json.loads(out.read_text())["version"] == "2.1.0"
+
+
+# ================================================ static index → sanitizer
+def test_static_index_links_runtime_ledger_to_callgraph(tmp_path,
+                                                        monkeypatch):
+    from horovod_tpu.analysis.runtime_sanitizer import (CollectiveSanitizer,
+                                                        StaticIndex)
+
+    pkg = make_pkg(tmp_path, GUARDED_HELPER)
+    index = build_static_index([pkg])
+    site = "helpers.py:5"
+    assert site in index["sites"]
+    rec = index["sites"][site]
+    assert rec["op"] == "allreduce" and "helpers:do_sum" in rec["node"]
+    assert "HVD101" in rec["rules"]      # the static finding at that site
+
+    idx_path = tmp_path / "index.json"
+    idx_path.write_text(json.dumps(index))
+    monkeypatch.setenv("HVD_TPU_SANITIZER_STATIC_INDEX", str(idx_path))
+
+    s = CollectiveSanitizer(capacity=8)
+    assert isinstance(s.static_index, StaticIndex)
+
+    class _E:
+        name = "s"
+        tensor = None
+        process_set_id = 0
+    # Forge the ledger entry at the static site: the runtime report must
+    # name the static node AND the rule that would have caught it.
+    s.observe([_E()], site=site)
+    tail = s.render_tail()
+    assert "helpers:do_sum" in tail
+    assert "HVD101" in tail and "statically" in tail
+
+
+def test_static_index_absent_env_is_none(monkeypatch):
+    from horovod_tpu.analysis.runtime_sanitizer import CollectiveSanitizer
+    monkeypatch.delenv("HVD_TPU_SANITIZER_STATIC_INDEX", raising=False)
+    assert CollectiveSanitizer().static_index is None
+
+
+# ==================================================================== CLI
+def test_cli_whole_package_flag(tmp_path):
+    from horovod_tpu.analysis.__main__ import main
+
+    pkg = make_pkg(tmp_path, GUARDED_HELPER)
+    assert main([pkg]) == 0                       # per-module: misses it
+    assert main(["--whole-package", pkg]) == 1    # interprocedural: error
+
+
+def test_cli_internal_error_exits_3(tmp_path, monkeypatch):
+    """Satellite: analyzer crashes are exit 3, distinct from findings (1)
+    and usage errors (2), so CI can tell 'your code is wrong' from 'the
+    linter is broken'."""
+    from horovod_tpu.analysis import collective_lint
+    from horovod_tpu.analysis.__main__ import main
+
+    target = tmp_path / "x.py"
+    target.write_text("import horovod_tpu as hvd\n")
+
+    # Usage errors stay 2 (not 3): missing path is the CALLER's fault.
+    assert main([str(tmp_path / "missing.py")]) == 2
+
+    def boom(paths):
+        raise RuntimeError("synthetic analyzer bug")
+
+    monkeypatch.setattr(collective_lint, "lint_paths", boom)
+    assert main([str(target)]) == 3
+
+
+def test_cli_baseline_and_sarif_flow(tmp_path):
+    from horovod_tpu.analysis.__main__ import main
+
+    pkg = make_pkg(tmp_path, GUARDED_HELPER)
+    baseline = tmp_path / "base.json"
+    sarif = tmp_path / "out.sarif"
+
+    # Write a baseline of the current state, then the gate-style run with
+    # that baseline is clean (exit 0) even though an error finding exists.
+    assert main(["--whole-package", pkg, "--write-baseline",
+                 str(baseline), "--root", pkg]) == 0
+    assert main(["--whole-package", pkg, "--baseline", str(baseline),
+                 "--root", pkg, "--sarif", str(sarif)]) == 0
+    log = json.loads(sarif.read_text())
+    assert log["runs"][0]["results"] == []        # everything baselined
+
+    # A NEW finding (fresh file) fails the baselined run with exit 1.
+    extra = tmp_path / "fixture" / "fresh.py"
+    extra.write_text(textwrap.dedent("""
+        import horovod_tpu as hvd
+        if hvd.rank() == 0:
+            hvd.barrier()
+    """))
+    assert main(["--whole-package", pkg, "--baseline", str(baseline),
+                 "--root", pkg]) == 1
+
+
+def test_cli_emit_static_index(tmp_path):
+    from horovod_tpu.analysis.__main__ import main
+
+    pkg = make_pkg(tmp_path, GUARDED_HELPER)
+    out = tmp_path / "index.json"
+    assert main(["--whole-package", pkg,
+                 "--emit-static-index", str(out)]) == 1
+    data = json.loads(out.read_text())
+    assert data["version"] == 1 and "helpers.py:5" in data["sites"]
+    # --emit-static-index without --whole-package is a usage error.
+    assert main([pkg, "--emit-static-index", str(out)]) == 2

@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""CI entry point for the whole-package collective-correctness gate.
+
+Thin wrapper over :mod:`horovod_tpu.analysis.gate` (kept importable so the
+``hvd-lint-gate`` console script and the tier-1 suite share one
+implementation).  Runs the two-pass interprocedural analyzer over
+``horovod_tpu/`` + ``examples/`` + ``tools/``, subtracts the reviewed
+baseline in ``tools/lint_baseline.json``, and exits nonzero on any new
+finding.
+
+  python tools/lint_gate.py                   # gate (exit 1 on new findings)
+  python tools/lint_gate.py --update-baseline # re-baseline after review
+  python tools/lint_gate.py --sarif out.sarif # CI annotation feed
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.analysis.gate import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
