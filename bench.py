@@ -522,6 +522,81 @@ def bench_autoscale(errors=None):
     return out
 
 
+def bench_restore_ab(errors=None, world=4, mb=None):
+    """Resilient-state-plane restore A/B (ISSUE 14): wall time to recover
+    a joiner's state from the DISK manifest (newest complete epoch, all
+    shards read + digest-verified) vs PEER-TO-PEER from the survivors'
+    in-memory shard servers — the elastic-recovery collapse this PR
+    claims.  Both paths restore the identical blob (bitwise pinned); the
+    peer path must do it with zero checkpoint-file reads.  Rank-0 only,
+    self-contained (tmp dir + loopback shard servers), jax-free."""
+    if os.environ.get("HOROVOD_RANK", "0") not in ("", "0"):
+        return None
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from horovod_tpu.elastic import stateplane as spl
+
+    t_section = time.perf_counter()
+    if mb is None:
+        mb = float(os.environ.get("HVD_BENCH_RESTORE_MB", "4"))
+    n = max(1, int(mb * (1 << 20) / 4))
+    state = {"step": 1, "params": np.arange(n, dtype=np.float32)}
+    ref_digest = spl.blob_digest(spl.encode_state(state))
+    d = tempfile.mkdtemp(prefix="hvd_restore_ab_")
+    out = {"world": world, "bytes": n * 4}
+    donors = []
+    try:
+        donors = [spl.StatePlane(d, rank=r, world=world, serve=True)
+                  for r in range(world)]
+        for p in donors:
+            p.commit(state=state, epoch=1, wait=True)
+
+        # Disk path: a fresh joiner, no peers declared.
+        j_disk = spl.StatePlane(d, rank=0, world=world, serve=False)
+        t0 = time.perf_counter()
+        _data, epoch, source = j_disk.restore()
+        disk_s = time.perf_counter() - t0
+        assert source == "disk" and epoch == 1, (source, epoch)
+        disk_ok = j_disk.memory_state()[2] == ref_digest
+
+        # Peer path: the survivors hold a NEWER epoch in memory.
+        for p in donors:
+            p.commit(state=state, epoch=2)
+        j_peer = spl.StatePlane(d + ".joiner", rank=0, world=world,
+                                serve=False)
+        peers = [("127.0.0.1", p.server.port) for p in donors]
+        t0 = time.perf_counter()
+        _data, epoch, source = j_peer.restore(peers=peers)
+        peer_s = time.perf_counter() - t0
+        assert source == "peer" and epoch == 2, (source, epoch)
+        out.update({
+            "disk_restore_us": round(disk_s * 1e6, 1),
+            "peer_restore_us": round(peer_s * 1e6, 1),
+            "peer_vs_disk": round(disk_s / peer_s, 3) if peer_s else None,
+            "peer_disk_reads": j_peer.disk_reads,
+            "peer_shards_fetched": j_peer.peer_shards_fetched,
+            "bitwise_identical": bool(
+                disk_ok and j_peer.memory_state()[2] == ref_digest),
+        })
+    except Exception as exc:  # noqa: BLE001 - recorded, never fatal
+        if errors is not None:
+            errors["restore_ab"] = repr(exc)
+    finally:
+        for p in donors:
+            try:
+                p.close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        shutil.rmtree(d, ignore_errors=True)
+        shutil.rmtree(d + ".joiner", ignore_errors=True)
+    _record_timing("restore_ab", warmup=0, iters=2,
+                   wall_s=time.perf_counter() - t_section)
+    return out
+
+
 def bench_zero_rtt(errors=None, world=4, warm=6, cycles=40, n_tensors=8):
     """Zero-RTT warm control plane A/B (ISSUE 11): a simulated world of
     REAL ``TCPController`` clients against the native root server, driven
@@ -2012,6 +2087,10 @@ def _run(out, errors):
         except Exception as exc:  # noqa: BLE001 - contained
             errors["autoscale"] = repr(exc)
         try:
+            out["restore_ab"] = bench_restore_ab(errors=errors)
+        except Exception as exc:  # noqa: BLE001 - contained
+            errors["restore_ab"] = repr(exc)
+        try:
             out["zero_rtt_ab"] = bench_zero_rtt(errors=errors)
         except Exception as exc:  # noqa: BLE001 - contained
             errors["zero_rtt_ab"] = repr(exc)
@@ -2140,6 +2219,11 @@ def _run(out, errors):
         out["autoscale"] = bench_autoscale(errors=errors)
     except Exception as exc:  # noqa: BLE001 - contained
         errors["autoscale"] = repr(exc)
+
+    try:
+        out["restore_ab"] = bench_restore_ab(errors=errors)
+    except Exception as exc:  # noqa: BLE001 - contained
+        errors["restore_ab"] = repr(exc)
 
     try:
         out["zero_rtt_ab"] = bench_zero_rtt(errors=errors)

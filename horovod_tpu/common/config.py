@@ -225,6 +225,24 @@ class Config:
     # path), still classified as a departure, never a blacklist.
     preempt_grace_s: float = 30.0
 
+    # Resilient state plane (ISSUE 14, docs/fault_tolerance.md "Resilient
+    # state plane").  HOROVOD_CKPT_DIR arms overlap-scheduled sharded
+    # checkpoints: on every elastic-state commit each rank streams its
+    # 1/N shard of the serialized state through the engine's lowest-
+    # priority `checkpoint` dispatch lane (two-phase manifest; gradient
+    # dispatch order provably unchanged) and serves the committed epoch
+    # to re-joining ranks peer-to-peer (disk is the fallback).
+    # HOROVOD_CKPT_CHUNK bounds one lane item's write; HOROVOD_CKPT_
+    # LANE_BUDGET bounds chunks per engine cycle.  HOROVOD_COMMIT_MAX_
+    # AGE_S is the autoscaler's stale-state guard: evict/scale_in
+    # decisions are refused while the fleet's last commit is older than
+    # this (0 = off) — shrinking a world whose restore point is stale
+    # would convert an orderly drain into lost work.
+    ckpt_dir: str = ""
+    ckpt_chunk_bytes: int = 1 << 20
+    ckpt_lane_budget: int = 2
+    commit_max_age_s: float = 0.0
+
     # Closed-loop elastic autoscaling (docs/elastic.md "Closed-loop
     # autoscaling") — consumed by the elastic DRIVER (torovodrun
     # --host-discovery-script), not by workers.  HOROVOD_AUTOSCALE=1
@@ -313,6 +331,10 @@ class Config:
                                               False),
             agent_port=_env_int("AGENT_PORT", 0),
             preempt_grace_s=_env_float("PREEMPT_GRACE_S", 30.0),
+            ckpt_dir=_env("CKPT_DIR", "") or "",
+            ckpt_chunk_bytes=_env_int("CKPT_CHUNK", 1 << 20),
+            ckpt_lane_budget=_env_int("CKPT_LANE_BUDGET", 2),
+            commit_max_age_s=_env_float("COMMIT_MAX_AGE_S", 0.0),
             autoscale=_env_bool("AUTOSCALE", False),
             autoscale_interval_s=_env_float("AUTOSCALE_INTERVAL", 5.0),
             autoscale_queue_high=_env_float("AUTOSCALE_QUEUE_HIGH", 16.0),

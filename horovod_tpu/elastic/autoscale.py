@@ -44,8 +44,13 @@ autoscaling" for the knob table):
                progress) for ``idle_s`` seconds and the world is above
                ``min_np``
 ``hold``       anything else — including the ``cooldown_s`` window
-               after every non-hold decision and any observation whose
-               trend windows have not filled (nulls never scale)
+               after every non-hold decision, any observation whose
+               trend windows have not filled (nulls never scale), and
+               the ISSUE 14 stale-state guard: an evict/scale_in that
+               would otherwise fire is REFUSED while the fleet's last
+               state-plane commit is older than ``commit_max_age_s``
+               (``HOROVOD_COMMIT_MAX_AGE_S``; preemption exempt — the
+               hardware is leaving either way)
 =============  ======================================================
 
 Hysteresis is everywhere deliberate: trends must PERSIST (the
@@ -99,7 +104,7 @@ class ScalePolicy:
                  queue_high: float = 16.0, queue_trend_up: float = 4.0,
                  straggler_factor: float = 3.0, persistence: int = 3,
                  cooldown_s: float = 30.0, idle_s: float = 60.0,
-                 scale_step: int = 1):
+                 scale_step: int = 1, commit_max_age_s: float = 0.0):
         self.min_np = max(1, int(min_np))
         self.max_np = int(max_np) if max_np else None
         self.queue_high = float(queue_high)
@@ -109,6 +114,16 @@ class ScalePolicy:
         self.cooldown_s = max(0.0, float(cooldown_s))
         self.idle_s = max(0.0, float(idle_s))
         self.scale_step = max(1, int(scale_step))
+        # Stale-state guard (ISSUE 14, HOROVOD_COMMIT_MAX_AGE_S): while
+        # the fleet's last state-plane commit is older than this, the
+        # policy REFUSES evict and scale_in — shrinking a world whose
+        # restore point is stale converts an orderly drain into lost
+        # work.  0 = off; a summary with no checkpoint telemetry is
+        # unknown, never stale (fleets without the state plane keep the
+        # old behavior).  Preemption is exempt: the hardware is going
+        # away on the platform's schedule either way.
+        self.commit_max_age_s = max(0.0, float(commit_max_age_s))
+        self.stale_holds = 0
         # Hysteresis state.
         self._last_action_ts: Optional[float] = None
         self._up_hits = 0
@@ -213,10 +228,24 @@ class ScalePolicy:
         elif self._idle_since is None:
             self._idle_since = now
 
+        # Stale-state guard (ISSUE 14): evict/scale_in shrink the world,
+        # and a shrink is only safe while the restore point is fresh —
+        # compute it once, consult it at both shrink decisions below.
+        commit_age = summary.get("last_commit_age_s")
+        stale = (self.commit_max_age_s > 0 and commit_age is not None
+                 and float(commit_age) > self.commit_max_age_s)
+
         # 1. Persistent straggler → drain-and-evict (attributed).
         straggler = self._straggler(summary, size)
         if straggler is not None:
             rank, evidence = straggler
+            if stale:
+                self.stale_holds += 1
+                return ScaleDecision(HOLD, reason=(
+                    f"stale-state guard: fleet commit age {commit_age:g}s"
+                    f" > {self.commit_max_age_s:g}s "
+                    f"(HOROVOD_COMMIT_MAX_AGE_S) — refusing evict of rank"
+                    f" {rank} until the fleet commits"))
             return self._acted(now, ScaleDecision(
                 EVICT, reason=f"persistent straggler; {evidence}",
                 evict_rank=rank))
@@ -238,9 +267,16 @@ class ScalePolicy:
                         f"trend={trend} for {self._up_hits} observations"),
                 target_size=target))
 
-        # 3. Idle → scale in.
+        # 3. Idle → scale in (refused while the restore point is stale).
         if (size > self.min_np and self._idle_since is not None
                 and now - self._idle_since >= self.idle_s):
+            if stale:
+                self.stale_holds += 1
+                return ScaleDecision(HOLD, reason=(
+                    f"stale-state guard: fleet commit age {commit_age:g}s"
+                    f" > {self.commit_max_age_s:g}s "
+                    f"(HOROVOD_COMMIT_MAX_AGE_S) — refusing scale_in "
+                    f"until the fleet commits"))
             return self._acted(now, ScaleDecision(
                 SCALE_IN,
                 reason=(f"idle for {now - self._idle_since:.0f}s "

@@ -336,10 +336,40 @@ class ElasticDriver:
                 log.warning("elastic driver: notify %s failed after "
                             "retries: %s", identity, exc)
 
+    # Assignment fields that define the world LAYOUT — everything except
+    # the per-generation controller ports (freshly bind-probed each call,
+    # so they always differ even when nothing else does).
+    _LAYOUT_KEYS = ("rank", "size", "local_rank", "local_size",
+                    "cross_rank", "cross_size", "hostname", "agent_port")
+
+    def _same_layout(self, assignments: Dict[str, dict]) -> bool:
+        def layout(table):
+            return {i: tuple(a.get(k) for k in self._LAYOUT_KEYS)
+                    for i, a in table.items()}
+        return bool(self._assigned) and \
+            layout(assignments) == layout(self._assigned)
+
     def _new_generation(self, hosts: List[DiscoveredHost]) -> bool:
         assignments = self.compute_assignments(hosts)
         if not assignments:
             return False
+        if self._same_layout(assignments):
+            # No-op regeneration guard (ISSUE 14): the active membership
+            # and rank layout are IDENTICAL to the live generation — the
+            # only delta would be freshly-allocated controller ports.
+            # Re-publishing forces every healthy worker through a full
+            # teardown/re-init for nothing, and the sub-second
+            # back-to-back generations it produces are exactly what
+            # strands a joining rank on a superseded init barrier (e.g.
+            # a cordoned host aging past the discovery-grace window
+            # right after its drain already re-formed the world).  Keep
+            # the live generation; just respawn any exited identities
+            # into it.
+            for identity, a in self._assigned.items():
+                proc = self._procs.get(identity)
+                if proc is None or proc.poll() is not None:
+                    self._spawn(identity, a)
+            return True
         self._assigned = assignments
         if self._rdv_addr_explicit is None:
             from ..common.net import routable_addr
@@ -485,6 +515,10 @@ class ElasticDriver:
                 continue
             del self._procs[identity]
             self._close_out_files(identity)
+            # A departed rank's shard server is gone with it: prune its
+            # rendezvous state record so later peer restores don't burn
+            # a connect timeout per corpse (ISSUE 14).
+            self.rendezvous.drop_state(identity)
             if identity in self._released:
                 self._released.discard(identity)
                 self.registry.record_left(identity)
@@ -564,7 +598,7 @@ class ElasticDriver:
         self._cordoned.add(hostname)
 
     # ------------------------------------------------- preemption drains
-    def _request_commit_all(self) -> None:
+    def _request_commit_all(self, wait_s: float = 2.0) -> Dict[str, bool]:
         """Checkpoint pacing (ISSUE 12): ask every live worker to commit
         its elastic state NOW — sent immediately before an imminent
         scale/preemption decision executes, so the last commit predates
@@ -572,12 +606,34 @@ class ElasticDriver:
         Best-effort, and fanned out in PARALLEL with a bounded wait: on
         the preemption path every second counts against the grace
         window, so one unreachable worker must not serialize the rest.
-        The workers' own commit cadence is the backstop."""
-        def _ping(addr, port):
+        The workers' own commit cadence is the backstop.
+
+        ISSUE 14 bugfix: workers now ACK the ping, the per-worker acks
+        are recorded in the event log (``action: commit_request``), and
+        the dict is returned so the preempt drain can WAIT (grace-
+        bounded) for the doomed host's ack before cordoning — previously
+        nothing recorded whether any worker ever saw the request, and a
+        drain could race its own in-flight snapshot ping."""
+        acks: Dict[str, bool] = {}
+
+        def _ping(identity, addr, port):
             try:
                 with socket.create_connection((addr, port),
                                               timeout=1.0) as s:
                     s.sendall(b"COMMIT\n")
+                    s.settimeout(max(0.5, wait_s))
+                    # Read to the newline (bounded): a single recv can
+                    # legally return a partial segment of "ACK\n", and a
+                    # false-negative ack here cordons a host early on the
+                    # exact path built to make acks truthful.
+                    buf = b""
+                    while b"\n" not in buf and len(buf) < 64:
+                        c = s.recv(8)
+                        if not c:
+                            break
+                        buf += c
+                    if buf.startswith(b"ACK"):
+                        acks[identity] = True
             except OSError:
                 pass
 
@@ -585,15 +641,22 @@ class ElasticDriver:
         for identity, port in self.rendezvous.notification_ports().items():
             if identity not in self._procs:
                 continue
+            acks[identity] = False
             host = identity.rsplit(":", 1)[0]
             addr = "127.0.0.1" if is_local_host(host) else host
-            t = threading.Thread(target=_ping, args=(addr, port),
+            t = threading.Thread(target=_ping, args=(identity, addr, port),
                                  daemon=True)
             t.start()
             pings.append(t)
-        deadline = time.monotonic() + 2.0
+        deadline = time.monotonic() + max(0.5, wait_s)
         for t in pings:
             t.join(timeout=max(0.0, deadline - time.monotonic()))
+        self.events.append({"action": "commit_request",
+                            "acks": dict(acks),
+                            "acked": sorted(i for i, ok in acks.items()
+                                            if ok),
+                            "ts": time.time()})
+        return acks
 
     def _check_preemption(self) -> None:
         """Consume the discovery source's preemption notices.  A noticed
@@ -652,8 +715,21 @@ class ElasticDriver:
         self.events.append({"action": "preempt_drain", "host": host,
                             "reason": reason, "ts": time.time()})
         # Commit first (checkpoint pacing), then cordon so the clean exit
-        # regenerates a world that excludes the host, then drain.
-        self._request_commit_all()
+        # regenerates a world that excludes the host, then drain.  The
+        # commit fan-out WAITS — bounded to a slice of the grace window —
+        # for the workers' acks before the cordon (ISSUE 14 bugfix): a
+        # drain must not race an in-flight snapshot request, and a
+        # missing ack is logged so the operator can see WHO never got the
+        # pacing ping (its restore point is one timer period older).
+        wait_s = (min(5.0, max(1.0, self.preempt_grace_s / 4.0))
+                  if self.preempt_grace_s > 0 else 1.0)
+        acks = self._request_commit_all(wait_s=wait_s)
+        missing = sorted(i for i, ok in acks.items() if not ok)
+        if missing:
+            log.warning(
+                "elastic driver: preempt drain of %s proceeding without "
+                "commit acks from %s (waited %.1fs); their restore point "
+                "is their last periodic commit", host, missing, wait_s)
         self.cordon(host)
         deadline = time.monotonic() + self.preempt_grace_s
         for identity, a in list(self._assigned.items()):
@@ -812,11 +888,14 @@ class ElasticDriver:
                 pass
 
     def _shutdown_workers(self):
-        for proc in self._procs.values():
+        # Snapshot: tests (and operators) may call this from another
+        # thread while the run loop's reap is still mutating the table.
+        procs = list(self._procs.values())
+        for proc in procs:
             if proc.poll() is None:
                 proc.terminate()
         t_end = time.monotonic() + 10
-        for proc in self._procs.values():
+        for proc in procs:
             while proc.poll() is None and time.monotonic() < t_end:
                 time.sleep(0.05)
             if proc.poll() is None:
@@ -872,7 +951,8 @@ def run_elastic(args) -> int:
             straggler_factor=cfg.autoscale_straggler_factor,
             persistence=cfg.autoscale_persistence,
             cooldown_s=cfg.autoscale_cooldown_s,
-            idle_s=cfg.autoscale_idle_s)
+            idle_s=cfg.autoscale_idle_s,
+            commit_max_age_s=cfg.commit_max_age_s)
         if not extra_env.get("HOROVOD_MONITOR_PORT"):
             log.warning(
                 "autoscale enabled without --monitor-port: the driver has "

@@ -28,6 +28,13 @@ class RendezvousServer:
         self._version = 0
         self._assignments: Dict[str, dict] = {}
         self._notify_ports: Dict[str, int] = {}
+        # State-plane metadata (ISSUE 14): identity -> declared state
+        # record ({"epoch", "port", "digest", ...}) — how a re-joining
+        # rank discovers which survivors hold a newer committed epoch and
+        # where their shard servers listen, BEFORE deciding peer-vs-disk
+        # restore.  Plain last-writer-wins KV; records survive generations
+        # (a survivor's epoch is exactly what outlives the world change).
+        self._state_records: Dict[str, dict] = {}
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -40,6 +47,9 @@ class RendezvousServer:
                 with outer._lock:
                     if parts[:1] == ["version"]:
                         return self._json({"version": outer._version})
+                    if parts[:1] == ["state"]:
+                        return self._json(
+                            {"state": dict(outer._state_records)})
                     if len(parts) == 2 and parts[0] == "assign":
                         identity = parts[1]
                         q = parse_qs(url.query)
@@ -59,6 +69,14 @@ class RendezvousServer:
                 if len(parts) == 2 and parts[0] == "notify":
                     with outer._lock:
                         outer._notify_ports[parts[1]] = int(body)
+                    return self._json({"ok": True})
+                if len(parts) == 2 and parts[0] == "state":
+                    try:
+                        rec = json.loads(body)
+                    except ValueError:
+                        return self._json({"error": "bad json"}, code=400)
+                    with outer._lock:
+                        outer._state_records[parts[1]] = rec
                     return self._json({"ok": True})
                 return self._json({"error": "not found"}, code=404)
 
@@ -94,6 +112,17 @@ class RendezvousServer:
     def notification_ports(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._notify_ports)
+
+    def state_records(self) -> Dict[str, dict]:
+        with self._lock:
+            return dict(self._state_records)
+
+    def drop_state(self, identity: str) -> None:
+        """Prune a departed rank's state record (the driver calls this
+        when it classifies an exit): a joiner must not waste a connect
+        timeout probing a corpse's shard server."""
+        with self._lock:
+            self._state_records.pop(identity, None)
 
     def stop(self):
         self._httpd.shutdown()
@@ -133,3 +162,30 @@ def register_notification_port(addr: str, port: int, identity: str,
     conn.request("PUT", f"/notify/{identity}", body=str(notify_port))
     conn.getresponse().read()
     conn.close()
+
+
+def declare_state(addr: str, port: int, identity: str, record: dict,
+                  timeout: float = 3.0):
+    """Publish this rank's state-plane record (epoch + shard-server port
+    + blob identity) to the driver's rendezvous KV — called after every
+    commit (off the training thread; short timeout: advisory metadata),
+    so survivors' declared epochs are current when a re-joining rank
+    reads the directory."""
+    import http.client
+    conn = http.client.HTTPConnection(addr, port, timeout=timeout)
+    conn.request("PUT", f"/state/{identity}", body=json.dumps(record))
+    conn.getresponse().read()
+    conn.close()
+
+
+def state_directory(addr: str, port: int) -> Dict[str, dict]:
+    """All declared state records (identity -> record)."""
+    import http.client
+    conn = http.client.HTTPConnection(addr, port, timeout=10)
+    conn.request("GET", "/state")
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    if resp.status != 200:
+        raise OSError(f"rendezvous /state returned {resp.status}")
+    return json.loads(data).get("state", {})

@@ -58,6 +58,21 @@ class State:
 
     def commit(self):
         self.save()
+        # Resilient state plane (ISSUE 14): when HOROVOD_CKPT_DIR armed a
+        # plane (attached by the @run wrapper), every commit also streams
+        # this rank's 1/N durable shard through the engine's checkpoint
+        # lane and publishes the epoch for peer-to-peer restore.  The
+        # committed attribute dict is exactly what restore() rolls back
+        # to, so it is exactly what becomes durable.
+        sp = getattr(self, "_stateplane", None)
+        saved = getattr(self, "_saved_state", None)
+        if sp is not None and saved:
+            try:
+                sp.commit(state=saved)
+            except Exception as exc:  # noqa: BLE001 - durability must
+                # never fail the training step; the previous epoch stays.
+                from ..utils.logging import get_logger
+                get_logger().error("state plane commit failed: %s", exc)
         self.check_host_updates()
 
     def check_host_updates(self):
@@ -187,19 +202,45 @@ def run(func: Callable) -> Callable:
         if os.environ.get("HOROVOD_ELASTIC"):
             from . import worker
             worker.attach_notification_manager(state)
+        # Resilient state plane (ISSUE 14): HOROVOD_CKPT_DIR arms a
+        # per-rank plane on the engine — attach it so state.commit()
+        # streams durable shards, and re-attach after every re-init (the
+        # reset builds a fresh engine, hence a fresh plane).
+        from . import stateplane as _sp
+        plane = _sp.attach(state)
         reset_required = False
         skip_sync = False
+        # Peer restore applies only while this rank's live state is
+        # actually STALE: a fresh process (initial params) or one that
+        # just rolled back to its last commit after a fault.  A survivor
+        # re-entering on a clean HostsUpdatedInterrupt holds the fleet's
+        # CURRENT state — its plane epoch may still lag a peer's (commit
+        # pings land on skewed cadence), and pulling that peer's older
+        # commit would roll live training backwards (and, re-ranked to
+        # rank 0, sync() the rollback fleet-wide).
+        stale = True
         while True:
             if reset_required:
                 _reset(state)
+                plane = _sp.attach(state)
                 state.on_reset()
             try:
                 if not skip_sync:
+                    if plane is not None and stale:
+                        # Peer-first restore: a (re-)joining rank whose
+                        # epoch lags the survivors' pulls the committed
+                        # state from their shard servers (disk manifest
+                        # as the fallback) BEFORE sync — so even a
+                        # re-ranked rank 0 broadcasts recovered state,
+                        # never its own stale/empty one.
+                        _sp.maybe_restore(state, plane)
                     state.sync()
+                stale = False
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
                 state.restore()
                 skip_sync = False
+                stale = True
             except DrainRequested:
                 # The driver asked this worker to drain (autoscale
                 # scale-in / straggler evict): the batch that just

@@ -102,6 +102,16 @@ class WorkerNotificationService:
                 elif data.startswith("COMMIT") and \
                         self._on_commit is not None:
                     self._on_commit()
+                    # Receipt ack (ISSUE 14 bugfix): the driver records
+                    # WHICH workers took the paced-commit request and the
+                    # preempt drain waits (grace-bounded) for these acks
+                    # before cordoning — a drain can no longer race a
+                    # commit ping that never arrived.  Old drivers close
+                    # without reading; the failed send is harmless.
+                    try:
+                        conn.sendall(b"ACK\n")
+                    except OSError:
+                        pass
             except (OSError, ValueError):
                 pass
             finally:

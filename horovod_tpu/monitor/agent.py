@@ -165,6 +165,38 @@ class MonitorAgent:
                 reg.gauge("hvd_sanitizer_ledger_entries",
                           "entries in the sanitizer ledger").set(
                     len(san.ledger))
+            sp = getattr(engine, "stateplane", None)
+            if sp is not None:
+                # Resilient state plane (ISSUE 14): commit freshness is
+                # the autoscaler's stale-state guard input, epoch/failure
+                # counters the recovery audit trail.
+                st = sp.status()
+                age = st.get("last_commit_age_s")
+                if age is None:
+                    # Same sentinel as the aggregator's fleet view: an
+                    # armed-but-never-committed rank is effectively
+                    # infinitely stale, never "fresher than everyone" —
+                    # a -1 here would hide exactly this rank from any
+                    # age > threshold alert while the autoscaler guard
+                    # is pinning the world size on its account.
+                    from .aggregator import NEVER_COMMITTED_AGE_S
+                    age = NEVER_COMMITTED_AGE_S
+                reg.gauge("hvd_last_commit_age_s",
+                          "seconds since the last state-plane commit "
+                          "(never committed = 1e12 sentinel)").set(age)
+                reg.gauge("hvd_ckpt_epoch",
+                          "this rank's in-memory committed epoch").set(
+                    st.get("epoch", -1))
+                reg.gauge("hvd_ckpt_durable_epoch",
+                          "this rank's newest on-disk epoch").set(
+                    st.get("durable_epoch", -1))
+                reg.counter("hvd_ckpt_write_failures_total",
+                            "abandoned checkpoint epochs").set_total(
+                    st.get("write_failures", 0))
+                reg.counter(
+                    "hvd_ckpt_chunks_total",
+                    "checkpoint-lane chunk writes dispatched").set_total(
+                    getattr(engine, "ckpt_chunks_dispatched", 0))
             tracer = getattr(engine, "tracer", None)
             if tracer is not None:
                 # Per-phase lifecycle histograms (horovod_tpu.trace):
@@ -257,6 +289,16 @@ class MonitorAgent:
             san = getattr(eng, "sanitizer", None)
             if san is not None:
                 snap["ledger"] = [e.render() for e in san.tail(8)]
+            sp = getattr(eng, "stateplane", None)
+            if sp is not None:
+                # State-plane block (ISSUE 14): rides the side-channel so
+                # rank 0's /health can report fleet commit age and the
+                # stale-state guard has its input.  Version-safe: peers
+                # without the plane just omit the key.
+                try:
+                    snap["checkpoint"] = sp.status()
+                except Exception:  # noqa: BLE001 - telemetry only
+                    pass
             tracer = getattr(eng, "tracer", None)
             if tracer is not None:
                 # Compact per-cycle phase digest (horovod_tpu.trace):

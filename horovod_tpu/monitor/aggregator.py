@@ -30,6 +30,14 @@ import time
 from typing import Dict, List, Optional
 
 
+# Fleet commit age reported for a rank whose state plane is armed but
+# has never committed (ISSUE 14): effectively-infinitely stale, but a
+# FINITE float — float('inf') would serialize into /health as the
+# non-standard JSON token `Infinity` and break strict parsers (jq,
+# JSON.parse, Go) exactly when operators look during startup/rejoin.
+NEVER_COMMITTED_AGE_S = 1e12
+
+
 class EwmaTrend:
     """Windowed EWMA trend of a scalar series: fast EWMA minus slow EWMA.
 
@@ -220,6 +228,28 @@ class RankAggregator:
                 if v is not None:
                     prog.append(v)
             out["progress_total"] = sum(prog) if prog else None
+            # Fleet commit age (ISSUE 14, the autoscaler's stale-state
+            # guard input): the STALEST reporting rank's state-plane
+            # commit age — one rank with an old restore point makes the
+            # whole fleet's shrink unsafe.  A rank whose plane is ARMED
+            # but has never committed counts as effectively-infinitely
+            # stale (NEVER_COMMITTED_AGE_S — finite, so /health stays
+            # strict JSON), not invisible: scaling in before its first
+            # commit is exactly the lost-work case the guard refuses.
+            # Null only when NO rank reports a checkpoint block at all
+            # (state plane not armed: guard stays off).
+            ages = []
+            for r, rec in self._table.items():
+                if r in self._left:
+                    continue
+                ck = rec["snap"].get("checkpoint")
+                if ck is None:
+                    continue
+                age = ck.get("last_commit_age_s")
+                ages.append(NEVER_COMMITTED_AGE_S if age is None
+                            else float(age))
+            out["last_commit_age_s"] = (round(max(ages), 3) if ages
+                                        else None)
         return out
 
     def peer_ledger_tails(self,
@@ -285,4 +315,30 @@ class RankAggregator:
         out = {"status": status, "world": self.world,
                "monitor_interval_s": interval_s, "ranks": ranks}
         out.update(self.summary())
+        # Checkpoint block (ISSUE 14): the state plane's fleet view — the
+        # per-rank epochs an operator reads to see WHO lags, plus the
+        # fleet commit age the stale-state guard consumes (also mirrored
+        # flat in the summary above).  Present only when some rank runs
+        # the plane.
+        ck_ranks = {}
+        for r, rec in table.items():
+            if r in left:
+                continue
+            ck = rec["snap"].get("checkpoint")
+            if ck:
+                ck_ranks[str(r)] = {
+                    "epoch": ck.get("epoch"),
+                    "durable_epoch": ck.get("durable_epoch"),
+                    "last_commit_age_s": ck.get("last_commit_age_s"),
+                    "write_failures": ck.get("write_failures"),
+                    "last_restore_source": ck.get("last_restore_source"),
+                }
+        if ck_ranks:
+            out["checkpoint"] = {
+                "last_commit_age_s": out.get("last_commit_age_s"),
+                "min_durable_epoch": min(
+                    (v["durable_epoch"] for v in ck_ranks.values()
+                     if v["durable_epoch"] is not None), default=None),
+                "ranks": ck_ranks,
+            }
         return out

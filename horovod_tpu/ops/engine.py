@@ -45,8 +45,9 @@ import heapq
 
 from . import collectives as C
 from .scheduler import (  # noqa: F401  (re-export: public engine surface)
-    FusedProgramCache, InflightRing, PingPongBuffers, StallInspector,
-    TensorQueue, partition_name, partition_plan,
+    CKPT_LANE, CheckpointChunk, FusedProgramCache, InflightRing,
+    PingPongBuffers, StallInspector, TensorQueue, partition_name,
+    partition_plan, pop_checkpoint_items, pop_gradient_batches,
 )
 from ..common.exceptions import ControlPlaneError
 from ..utils.logging import get_logger
@@ -221,9 +222,35 @@ class CollectiveEngine:
         self._staging_tokens: Dict[int, list] = {}
         self._backlog: List[tuple] = []       # heap: (lane, -prio, seq, batch)
         self._backlog_seq = itertools.count()
+        # Checkpoint-lane staging (ISSUE 14): submit_checkpoint_io runs
+        # on the TRAINING thread while the cycle thread heappops the
+        # backlog — heap mutation is not thread-safe, so cross-thread
+        # submissions land here (own lock) and the cycle thread folds
+        # them into the heap at its next turn.
+        self._ckpt_staging: List = []
+        self._ckpt_staging_lock = threading.Lock()
         self.fast_lane_dispatches = 0         # fast-lane batches dispatched
         self.fast_lane_hits = 0               # ... served by a pinned program
         self.partition_splits = 0             # parents split at enqueue
+        # Resilient state plane (ISSUE 14, docs/fault_tolerance.md):
+        # checkpoint shard writes ride the SAME backlog at CKPT_LANE —
+        # strictly after every gradient batch, popped by their own
+        # per-cycle budget so the durability stream overlaps training
+        # without touching gradient dispatch order or the control plane
+        # (checkpoint chunks are local I/O, never negotiated).
+        self.ckpt_lane_budget = max(1, int(cfg.ckpt_lane_budget))
+        self.ckpt_chunks_dispatched = 0
+        self.stateplane = None
+        if cfg.ckpt_dir:
+            # One plane per directory per PROCESS (stateplane.obtain):
+            # it survives elastic re-init like the per-host agent — a
+            # survivor's in-memory epoch is exactly what a re-joining
+            # rank restores from, so it must outlive the generation.
+            from ..elastic.stateplane import obtain as _obtain_plane
+            self.stateplane = _obtain_plane(
+                cfg.ckpt_dir, rank=max(0, cfg.rank_env),
+                world=max(1, cfg.size_env), engine=self,
+                chunk_bytes=cfg.ckpt_chunk_bytes)
         self.hierarchical_allreduce = cfg.hierarchical_allreduce
         self.hierarchical_allgather = cfg.hierarchical_allgather
         self._hier_local_size = cfg.hierarchical_local_size
@@ -350,14 +377,23 @@ class CollectiveEngine:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        # The cycle thread is gone: this thread is now the heap's sole
+        # mutator, so staged checkpoint items can fold in safely.
+        if self._fault is None:
+            self._drain_ckpt_staging()
         if self._backlog and self._fault is None:
             # Undispatched ready batches (the preemptive backlog only
             # defers dispatch while the window is full): dispatch them now,
             # before the ring drains — their waiters must not outlive the
-            # engine unsignalled.  The fault path already settled them.
+            # engine unsignalled.  Checkpoint-lane items run too (the
+            # shutdown finishes the durable write instead of abandoning
+            # a healthy epoch).  The fault path already settled both.
             while self._backlog:
-                batch = heapq.heappop(self._backlog)[3]
-                self._perform_operation(batch)
+                lane, _, _, item = heapq.heappop(self._backlog)
+                if lane == CKPT_LANE:
+                    self._run_ckpt_item(item)
+                else:
+                    self._perform_operation(item)
         if self._inflight is not None:
             # Settles every dispatched batch first: a waiter blocked in
             # synchronize() must never outlive the watcher unsignalled.
@@ -367,6 +403,17 @@ class CollectiveEngine:
             # After the ring: settling commits spans, and the trace file
             # must hold them all before the final flush.
             self.tracer.close()
+        if self.stateplane is not None:
+            # After the backlog drain above: any in-flight durable write
+            # has finished (or failed with attribution).  DETACH, never
+            # close — the plane (its shard server + in-memory epoch)
+            # survives the engine exactly like the per-host agent, so a
+            # re-joining rank can still restore from this survivor while
+            # the world re-forms.  Commits between generations write
+            # inline.
+            if self.stateplane.engine is self:
+                self.stateplane.engine = None
+            self.stateplane = None
 
     def _abort_engine(self, exc: BaseException, busy: bool = False):
         """Clean engine shutdown on a control-plane fault (HVD303).
@@ -406,10 +453,22 @@ class CollectiveEngine:
         self._settle_queued(pending, exc)
         # Ready-but-undispatched batches parked in the preemptive backlog
         # are waiters too: settle them with the fault (their negotiation
-        # lane is the one still open on the timeline).
+        # lane is the one still open on the timeline).  Checkpoint-lane
+        # items fail their write job instead — the epoch is abandoned
+        # cleanly and the previous durable epoch remains the restore
+        # point (never a torn write).  Staged-but-unfolded items get the
+        # same treatment (runs on the cycle thread; later submits fail
+        # fast on the latched fault).
+        self._drain_ckpt_staging()
         while self._backlog:
-            batch = heapq.heappop(self._backlog)[3]
-            self._settle_batch(batch, None, exc)
+            lane, _, _, item = heapq.heappop(self._backlog)
+            if lane == CKPT_LANE:
+                try:
+                    item.fail(exc)
+                except Exception:  # noqa: BLE001 - keep the abort going
+                    log.exception("checkpoint-lane abort settle failed")
+            else:
+                self._settle_batch(item, None, exc)
         if self._pingpong is not None:
             # Both staging buffers settle exactly once: outstanding tokens
             # are released (idempotently — a racing watcher settle is a
@@ -718,6 +777,64 @@ class CollectiveEngine:
             return all(s.done.is_set() for s in parts)
         return e.done.is_set()
 
+    # ------------------------------------------------------- checkpoint lane
+    def submit_checkpoint_io(self, items: Sequence) -> None:
+        """Queue checkpoint-lane work items (ISSUE 14): shard-chunk
+        writes from the state plane, scheduled at :data:`CKPT_LANE` —
+        strictly after every gradient batch, popped by their own
+        per-cycle budget (``HOROVOD_CKPT_LANE_BUDGET``).  Items are
+        plain local-I/O callables, never negotiated: zero control-plane
+        bytes, no cross-rank ordering requirement.  After a fault the
+        lane is closed — items fail immediately so the write job
+        abandons its epoch instead of queueing into a dead engine."""
+        # Stage, never touch the heap: this runs on the TRAINING thread
+        # (state.commit), and heappush racing the cycle thread's heappop
+        # would corrupt the backlog ordering every rank must share.  The
+        # cycle thread folds the staging in at its next turn.  The fault/
+        # shutdown check lives INSIDE the staging lock: _abort_engine
+        # latches the fault BEFORE draining the staging under this same
+        # lock, so an item either lands before that drain (and is failed
+        # there) or observes the latched fault here — never neither (an
+        # unlocked check could stage into an already-aborted engine,
+        # leaving the write job neither run nor failed and commit(wait)
+        # blocked for its full timeout).
+        with self._ckpt_staging_lock:
+            fault = self._fault
+            stopped = fault is not None or self._shutdown.is_set()
+            if not stopped:
+                self._ckpt_staging.extend(items)
+        if stopped:
+            for it in items:
+                try:
+                    it.fail(fault or RuntimeError("engine stopped"))
+                except Exception:  # noqa: BLE001 - settle the rest
+                    log.exception("checkpoint item fail hook failed")
+            return
+        self._wake.set()
+
+    def _drain_ckpt_staging(self) -> None:
+        """Fold staged checkpoint items into the backlog heap — CYCLE
+        THREAD ONLY (the heap has exactly one mutator)."""
+        with self._ckpt_staging_lock:
+            items, self._ckpt_staging = self._ckpt_staging, []
+        for it in items:
+            heapq.heappush(
+                self._backlog,
+                (CKPT_LANE, -int(getattr(it, "priority", 0)),
+                 next(self._backlog_seq), it))
+
+    def _run_ckpt_item(self, item) -> None:
+        """Dispatch one checkpoint-lane item on the cycle thread.  The
+        item owns its own retries/failure attribution (the state plane's
+        write job); the engine only guarantees a raising item cannot
+        kill the cycle loop."""
+        try:
+            item.run()
+            self.ckpt_chunks_dispatched += 1
+        except BaseException:  # noqa: BLE001 - the cycle must survive
+            log.exception("checkpoint-lane item %r failed",
+                          getattr(item, "name", item))
+
     # ------------------------------------------------------------- main loop
     def _background_loop(self):
         while not self._shutdown.is_set():
@@ -768,8 +885,11 @@ class CollectiveEngine:
         tl = self._state.timeline
         if tl is not None:
             tl.mark_cycle(self._cycle_index)
+        self._drain_ckpt_staging()
         entries = self.queue.drain()
-        if not entries and self.controller is None:
+        if not entries and self.controller is None and not self._backlog:
+            # (The backlog check keeps the checkpoint lane draining on
+            # otherwise-idle single-controller cycles.)
             return
         tr = self.tracer
         t_trace0 = t_drain = 0.0
@@ -876,21 +996,32 @@ class CollectiveEngine:
             # identical order, which cross-process XLA collectives
             # require.  An over-eager pop just blocks briefly in the
             # ring's bounded submit, exactly like the pre-backlog path.
+            # Checkpoint-lane items (ISSUE 14) sort after BOTH gradient
+            # lanes and never touch the fused budget — pop_gradient_
+            # batches is the identical budget rule with a CKPT_LANE
+            # guard, so gradient dispatch order is bitwise-unchanged
+            # with checkpointing armed (pinned by the dispatch-order
+            # tests).
             for batch in responses:
                 lane = 0 if batch[0].fast_lane else 1
                 prio = max(e.priority for e in batch)
                 heapq.heappush(self._backlog,
                                (lane, -prio, next(self._backlog_seq), batch))
-            budget = max(1, int(self.max_inflight))
-            while self._backlog and (self._backlog[0][0] == 0 or budget > 0):
-                if self._backlog[0][0] != 0:
-                    budget -= 1
-                batch = heapq.heappop(self._backlog)[3]
+            for batch in pop_gradient_batches(
+                    self._backlog, max(1, int(self.max_inflight))):
                 cycle_chunks += self._perform_operation(batch)
-            if self._backlog:
-                # Leftovers must not wait out a long cycle timer: run the
-                # next cycle (and its negotiation round) immediately.
-                self._wake.set()
+        # Checkpoint-lane tail (both dispatch modes): once no gradient
+        # batch remains poppable this cycle, a bounded number of shard-
+        # chunk writes ride the cycle's tail — the overlap-scheduled
+        # durability stream.
+        for item in pop_checkpoint_items(self._backlog,
+                                         self.ckpt_lane_budget):
+            self._run_ckpt_item(item)
+        if self._backlog:
+            # Leftovers (either lane) must not wait out a long cycle
+            # timer: run the next cycle (and its negotiation round)
+            # immediately.
+            self._wake.set()
         if responses:
             self.last_cycle_chunks = cycle_chunks
             if tl is not None and tl.enabled:

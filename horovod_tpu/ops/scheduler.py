@@ -20,6 +20,7 @@ and the double-buffered fusion staging handoff.
 
 from __future__ import annotations
 
+import heapq
 import threading
 import time
 from collections import deque
@@ -28,6 +29,71 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from ..utils.logging import get_logger
 
 log = get_logger()
+
+# Dispatch-backlog lanes (the heap orders by ``(lane, -priority, seq)``).
+# 0 = latency fast lane, 1 = fused gradient batches, 2 = the checkpoint
+# stream (ISSUE 14): checkpoint chunks sort strictly AFTER every gradient
+# batch and are popped by their own budget, so durability I/O rides each
+# cycle's tail without ever delaying (or re-ordering) gradient dispatch.
+FAST_LANE = 0
+FUSED_LANE = 1
+CKPT_LANE = 2
+
+
+class CheckpointChunk:
+    """One checkpoint-lane work item (ISSUE 14): a bounded local write —
+    one chunk of this rank's 1/N state shard — scheduled through the
+    priority dispatch backlog at :data:`CKPT_LANE`.  Not a collective:
+    it never negotiates, costs zero control-plane bytes, and its dispatch
+    order is invisible to the gradient lanes.  ``run`` performs the
+    chunk (the state plane owns retries/finalize inside it); ``fail`` is
+    the abort path — the engine settles the lane with the fault and the
+    epoch is abandoned, leaving the previous durable epoch in place."""
+
+    __slots__ = ("name", "priority", "_run", "_fail")
+
+    def __init__(self, name: str, run: Callable[[], None],
+                 fail: Optional[Callable] = None, priority: int = 0):
+        self.name = name
+        self.priority = int(priority)
+        self._run = run
+        self._fail = fail
+
+    def run(self) -> None:
+        self._run()
+
+    def fail(self, exc: BaseException) -> None:
+        if self._fail is not None:
+            self._fail(exc)
+
+
+def pop_gradient_batches(heap: List[tuple], budget: int) -> List:
+    """Pop the cycle's dispatchable gradient batches from the backlog
+    heap, in dispatch order: every fast-lane batch, plus up to ``budget``
+    fused batches.  EXACTLY the pre-checkpoint-lane budget rule — a pure
+    function of knob + heap state, never of checkpoint-lane occupancy:
+    checkpoint items are never popped here and never consume the fused
+    budget, so arming checkpointing cannot change gradient dispatch
+    order (the heap sorts ``CKPT_LANE`` after both gradient lanes, so
+    the guard only ever triggers once no gradient work remains)."""
+    out: List = []
+    while heap and heap[0][0] != CKPT_LANE \
+            and (heap[0][0] == FAST_LANE or budget > 0):
+        if heap[0][0] != FAST_LANE:
+            budget -= 1
+        out.append(heapq.heappop(heap)[3])
+    return out
+
+
+def pop_checkpoint_items(heap: List[tuple], budget: int) -> List:
+    """Pop up to ``budget`` checkpoint-lane items — callable only once
+    the gradient lanes are drained (the heap ordering enforces it: the
+    head is ``CKPT_LANE`` exactly when no gradient batch remains)."""
+    out: List = []
+    while heap and heap[0][0] == CKPT_LANE and budget > 0:
+        out.append(heapq.heappop(heap)[3])
+        budget -= 1
+    return out
 
 
 def partition_plan(n_elems: int, itemsize: int,

@@ -191,6 +191,24 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "mode): a noticed host's workers get this long "
                         "to commit + clean-LEAVE before the driver falls "
                         "back to termination (default 30)")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="Resilient state plane (docs/fault_tolerance.md "
+                        "'Resilient state plane'): arm overlap-scheduled "
+                        "sharded checkpoints under this directory — each "
+                        "rank streams its 1/N state shard through the "
+                        "engine's lowest-priority checkpoint lane on "
+                        "every elastic-state commit, and re-joining "
+                        "ranks restore peer-to-peer from survivors")
+    p.add_argument("--ckpt-chunk-mb", type=float, default=None,
+                   help="Checkpoint-lane chunk size in MB (one bounded "
+                        "write per lane dispatch; default 1)")
+    p.add_argument("--ckpt-lane-budget", type=int, default=None,
+                   help="Checkpoint chunks dispatched per engine cycle "
+                        "tail (default 2)")
+    p.add_argument("--commit-max-age-s", type=float, default=None,
+                   help="Autoscaler stale-state guard: refuse evict/"
+                        "scale_in while the fleet's last state-plane "
+                        "commit is older than this (0 = off)")
     # Cluster-scheduler backends (reference P7 ships jsrun/mpirun backends;
     # the TPU equivalents live in runner/tpu_vm.py).
     p.add_argument("--tpu", default=None,
@@ -351,10 +369,15 @@ def tuning_env(args) -> Dict[str, str]:
             ("trace_ring", "HOROVOD_TRACE_RING", 1),
             ("round_timeout", "HOROVOD_ROUND_TIMEOUT_S", 1),
             ("connect_retries", "HOROVOD_CONNECT_RETRIES", 1),
-            ("connect_backoff_ms", "HOROVOD_CONNECT_BACKOFF_MS", 1)):
+            ("connect_backoff_ms", "HOROVOD_CONNECT_BACKOFF_MS", 1),
+            ("ckpt_chunk_mb", "HOROVOD_CKPT_CHUNK", 1024 * 1024),
+            ("ckpt_lane_budget", "HOROVOD_CKPT_LANE_BUDGET", 1),
+            ("commit_max_age_s", "HOROVOD_COMMIT_MAX_AGE_S", 1)):
         val = getattr(args, flag, None)
         if val is not None:
             env[var] = str(int(val * scale) if scale != 1 else val)
+    if getattr(args, "ckpt_dir", None):
+        env["HOROVOD_CKPT_DIR"] = args.ckpt_dir
     if getattr(args, "monitor", False) \
             or getattr(args, "monitor_port", None):
         env["HOROVOD_MONITOR"] = "1"

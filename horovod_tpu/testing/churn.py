@@ -107,7 +107,9 @@ class ChurnRunner:
                  hier: bool = False, rounds: int = 30, warm: int = 5,
                  script: Sequence[ChurnEvent] = (),
                  connect_timeout_ms: int = 30000,
-                 round_deadline_ms: int = 0):
+                 round_deadline_ms: int = 0,
+                 state_dir: Optional[str] = None,
+                 serve_state: bool = True):
         if world < 2:
             raise ValueError("ChurnRunner needs world >= 2")
         if hier and ranks_per_host <= 0:
@@ -134,11 +136,34 @@ class ChurnRunner:
                     f"churn event {e} beyond the run ({self.rounds} rounds)")
             if e.verb in _HOST_VERBS and int(e.target) >= len(self.hosts):
                 raise ValueError(f"churn event {e}: no host {e.target}")
-            if e.verb in ("leave",) and int(e.target) >= world:
+            if e.verb in ("leave", "rejoin_restore") \
+                    and int(e.target) >= world:
                 raise ValueError(f"churn event {e}: no rank {e.target}")
             if e.verb == "agent_crash" and not self.hier:
                 raise ValueError("agent_crash needs hier=True (no agents "
                                  "exist on the flat plane)")
+        # Resilient state plane (ISSUE 14): rejoin_restore replays a
+        # replacement rank's state recovery against the survivors' shard
+        # servers / the shared manifest directory.  The target must have
+        # departed in an EARLIER event, or there is nothing to rejoin.
+        self._needs_state = any(e.verb == "rejoin_restore"
+                                for e in self.script)
+        for e in self.script:
+            if e.verb != "rejoin_restore":
+                continue
+            r = int(e.target)
+            departed = any(
+                (p.verb == "leave" and int(p.target) == r)
+                or (p.verb == "preempt_notice"
+                    and r in self.hosts[int(p.target)])
+                for p in self.script if p.at_round < e.at_round)
+            if not departed:
+                raise ValueError(
+                    f"churn event {e}: rank {r} never departed before "
+                    f"its rejoin_restore (add a leave/preempt first)")
+        self.state_dir = state_dir
+        self.serve_state = bool(serve_state)
+        self._planes: List = []
         # Phases: [warm] + measured segments split at each event round.
         bounds = sorted({e.at_round for e in self.script})
         self._phases: List[dict] = []
@@ -191,6 +216,10 @@ class ChurnRunner:
         self.abort_reason: Optional[str] = None
         self.events_fired: List[dict] = []
         self.drained_hosts: List[int] = []
+        # State-plane runtime (rejoin_restore scripts only).
+        self._state_left: set = set()
+        self._state_epoch = 0
+        self.restores: List[dict] = []
 
     # ------------------------------------------------------------- threads
     def _done(self, phase: int) -> None:
@@ -273,6 +302,81 @@ class ChurnRunner:
                 except OSError:
                     pass
 
+    # --------------------------------------------------------- state plane
+    def _synthetic_state(self, epoch: int) -> dict:
+        """Deterministic per-epoch state every live rank holds identically
+        (the bitwise-restore assertion compares against exactly this)."""
+        import numpy as np
+        return {"step": epoch,
+                "params": (np.arange(512, dtype=np.float32)
+                           * float(epoch))}
+
+    def _state_setup(self) -> None:
+        import tempfile
+
+        from ..elastic.stateplane import StatePlane
+        if self.state_dir is None:
+            self.state_dir = tempfile.mkdtemp(prefix="hvd_churn_state_")
+        self._planes = [StatePlane(self.state_dir, rank=r, world=self.world,
+                                   serve=self.serve_state)
+                        for r in range(self.world)]
+        self._advance_state_epoch()          # epoch 1: the disk baseline
+
+    def _advance_state_epoch(self) -> None:
+        """Every live rank commits the next epoch (inline durable write;
+        the wire fleet is untouched) — the survivors' state moving on
+        past a departure, which is what makes a later rejoiner's PEER
+        path strictly newer than its own last epoch.  Survivors re-shard
+        over the SHRUNK world, exactly like the real re-rendezvous
+        (elastic_bootstrap re-assigns rank/world): without it, every
+        post-departure epoch would be missing the leaver's shard and
+        never complete on disk."""
+        self._state_epoch += 1
+        state = self._synthetic_state(self._state_epoch)
+        live = [r for r, plane in enumerate(self._planes)
+                if plane is not None and r not in self._state_left
+                and r not in self._dead]
+        for i, r in enumerate(live):
+            plane = self._planes[r]
+            plane.rank, plane.world = i, len(live)
+            plane.commit(state=state, epoch=self._state_epoch)
+
+    def _state_depart(self, rank: int) -> None:
+        if not self._planes:
+            return
+        self._state_left.add(rank)
+        plane = self._planes[rank]
+        if plane is not None:
+            plane.close()        # a departed rank serves no shards
+
+    def _rejoin_restore(self, rank: int) -> dict:
+        """A fresh replacement rank's state recovery: reset the plane
+        (epoch -1, empty memory — a new process knows nothing) and
+        restore peer-first from the live survivors' shard servers, disk
+        manifest as the fallback.  Returns the assertion record."""
+        from ..elastic.stateplane import StatePlane
+        old = self._planes[rank]
+        if old is not None:
+            old.close()
+        plane = StatePlane(self.state_dir, rank=rank, world=self.world,
+                           serve=self.serve_state)
+        self._planes[rank] = plane
+        peers = [("127.0.0.1", p.server.port)
+                 for i, p in enumerate(self._planes)
+                 if p is not None and i != rank and p.server is not None
+                 and i not in self._state_left and i not in self._dead]
+        try:
+            _data, epoch, source = plane.restore(peers=peers)
+            rec = {"restore_source": source, "restore_epoch": epoch,
+                   "disk_reads": plane.disk_reads,
+                   "peer_shards": plane.peer_shards_fetched}
+        except FileNotFoundError as exc:
+            rec = {"restore_source": None, "restore_error": str(exc)}
+        else:
+            self._state_left.discard(rank)
+        self.restores.append(dict(rec, rank=rank))
+        return rec
+
     # -------------------------------------------------------------- events
     def _apply_events(self, phase_idx: int, events: List[ChurnEvent],
                       agents: list) -> None:
@@ -284,6 +388,11 @@ class ChurnRunner:
                 r = int(e.target)
                 if r not in self._left and r not in self._dead:
                     directives[r] = "leave"
+                    self._state_depart(r)
+                    if self._planes:
+                        self._advance_state_epoch()
+            elif e.verb == "rejoin_restore":
+                rec.update(self._rejoin_restore(int(e.target)))
             elif e.verb == "join":
                 targets = ([int(e.target)] if e.target != "*" else
                            [r for r in range(self.world)
@@ -302,6 +411,9 @@ class ChurnRunner:
                     if r not in self._left and r not in self._dead:
                         directives[r] = "leave"
                         drained.append(r)
+                        self._state_depart(r)
+                if drained and self._planes:
+                    self._advance_state_epoch()
                 rec["ranks"] = drained
             elif e.verb == "agent_crash":
                 h = int(e.target)
@@ -319,6 +431,8 @@ class ChurnRunner:
         from ..common.net import free_ports
 
         lib = _load()
+        if self._needs_state and not self._planes:
+            self._state_setup()
         (port,) = free_ports(1)
         server = lib.hvdtpu_server_start(
             port, self.world, ctypes.c_double(600.0), 2048,
@@ -399,6 +513,12 @@ class ChurnRunner:
                     a.stop()
                 except Exception:  # noqa: BLE001 - teardown best-effort
                     pass
+            for p in self._planes:
+                try:
+                    if p is not None:
+                        p.close()
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
             lib.hvdtpu_server_stop(server)
         survived = self.abort_reason is None
         measured = [ph for ph in phase_reports if ph["root_us"] is not None]
@@ -411,6 +531,8 @@ class ChurnRunner:
             "abort_reason": self.abort_reason,
             "left_ranks": sorted(self._left),
             "drained_hosts": sorted(set(self.drained_hosts)),
+            "restores": self.restores,
+            "state_epoch": self._state_epoch if self._planes else None,
             "events_fired": self.events_fired,
             "failures": self.failures[:8],
             "phases": phase_reports,

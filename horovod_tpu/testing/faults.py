@@ -55,8 +55,22 @@ log = get_logger()
 ENV_VAR = "HVD_TPU_FAULT"
 
 POINTS = ("connect", "pre_announce", "round_send", "mid_round_exit",
-          "round_recv")
-ACTIONS = ("crash", "hang", "delay_ms", "econnreset")
+          "round_recv",
+          # Resilient state plane (ISSUE 14, elastic/stateplane.py):
+          #   ckpt_write_fail    each shard-chunk write attempt (io_error
+          #                      with nth=1 proves retry_with_backoff
+          #                      recovers; nth=0 — persistent — proves a
+          #                      failed epoch degrades to the previous
+          #                      durable one)
+          #   ckpt_torn          between the shard rename and the
+          #                      manifest rename — a crash/io_error here
+          #                      leaves a torn epoch restore must skip
+          #   restore_peer_exit  a survivor about to serve a shard —
+          #                      econnreset/crash model peer death
+          #                      mid-restore (the joiner re-fetches from
+          #                      another survivor or falls back to disk)
+          "ckpt_write_fail", "ckpt_torn", "restore_peer_exit")
+ACTIONS = ("crash", "hang", "delay_ms", "econnreset", "io_error")
 
 # Bounded "forever": long enough to trip any reasonable deadline, short
 # enough that a leaked daemon thread cannot outlive a CI job by much.
@@ -96,9 +110,12 @@ class FaultSpec:
         if action not in ACTIONS:
             raise ValueError(
                 f"{ENV_VAR}: unknown action {action_s!r} "
-                f"(valid: crash, hang, delay_ms=N, econnreset)")
-        if nth < 1:
-            raise ValueError(f"{ENV_VAR}: nth must be >= 1, got {nth}")
+                f"(valid: crash, hang, delay_ms=N, econnreset, io_error)")
+        # nth=0 = PERSISTENT: fire on EVERY arrival (no one-shot latch) —
+        # how a persistently failing disk is modeled (the state plane's
+        # bounded retries must exhaust, not be saved by the next attempt).
+        if nth < 0:
+            raise ValueError(f"{ENV_VAR}: nth must be >= 0, got {nth}")
         return cls(point=point, rank=int(rank_s), action=action, arg=arg,
                    nth=nth)
 
@@ -172,9 +189,12 @@ def fire(point: str, rank: int,
     with _lock:
         n = _counts.get(point, 0) + 1
         _counts[point] = n
-        if n != s.nth or _fired:
-            return
-        _fired = True
+        if s.nth == 0:
+            _fired = True           # persistent: every arrival executes
+        else:
+            if n != s.nth or _fired:
+                return
+            _fired = True
     log.warning("fault injection: %s at %s (rank %d, arrival %d)",
                 s.action, point, rank, n)
     if s.action == "crash":
@@ -198,6 +218,11 @@ def fire(point: str, rank: int,
                         "callback; ignoring", point)
         else:
             sever()
+    elif s.action == "io_error":
+        # Raised INTO the caller: the state plane's chunk writer (and any
+        # future I/O fault point) sees exactly what a failing filesystem
+        # would hand it — an OSError from the write path.
+        raise OSError(f"injected I/O fault at {point} (HVD_TPU_FAULT)")
 
 
 # --------------------------------------------------------------- churn verbs
@@ -232,8 +257,19 @@ def fire(point: str, rank: int,
 # The scripts are replayed by :class:`horovod_tpu.testing.churn.ChurnRunner`
 # against the REAL native server, flat or hierarchical.
 
+#     verb    rejoin_restore  (ISSUE 14) the target RANK — which must
+#                             have departed in an earlier event — rejoins
+#                             the STATE plane as a fresh replacement: its
+#                             state plane is reset and restored from the
+#                             survivors' shard servers (peer path) or the
+#                             shared manifest directory (disk fallback);
+#                             the runner records the restore source
+#                             ("peer"/"disk"), epoch and disk-read count
+#                             in the phase/event output so scenarios can
+#                             assert WHICH path recovery took
 CHURN_ENV_VAR = "HVD_TPU_CHURN"
-CHURN_VERBS = ("leave", "join", "agent_crash", "preempt_notice")
+CHURN_VERBS = ("leave", "join", "agent_crash", "preempt_notice",
+               "rejoin_restore")
 _HOST_VERBS = ("agent_crash", "preempt_notice")
 
 

@@ -1,0 +1,65 @@
+"""Minimal jax-free scenario worker for MANY-host driver-level tests
+(ISSUE 14 satellite, carried from PR 12).
+
+The full acceptance workers (worker_autoscale/worker_stateplane) carry a
+real ``TCPController`` + ``MonitorAgent`` per process, which caps
+driver-level scenarios at a handful of hosts.  This worker is the
+lightest thing that still exercises the DRIVER end to end — versioned
+rendezvous long-poll, the notification channel (HOSTS_UPDATED / DRAIN /
+COMMIT with the receipt ack), generation re-entry, clean exit
+classification — so churn scenarios run at 64+ simulated hosts in
+seconds.  No controller, no monitor, no jax: what is under test is the
+driver's orchestration, not the wire protocol (the wire has its own
+2-proc and ChurnRunner tiers).
+
+Scripted through ``SCENARIO_DIR``: ``done`` ends the run (exit 0).
+"""
+
+import os
+import sys
+import time
+
+from horovod_tpu.common.exceptions import (
+    DrainRequested, HostsUpdatedInterrupt,
+)
+from horovod_tpu.elastic import rendezvous as rdv
+from horovod_tpu.elastic import worker as ew
+
+DIR = os.environ["SCENARIO_DIR"]
+
+
+def one_generation(mgr):
+    addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+    port = int(os.environ["HOROVOD_RENDEZVOUS_PORT"])
+    min_v = 0 if ew._current_version is None else ew._current_version + 1
+    a = rdv.fetch_assignment(addr, port, ew.identity(),
+                             min_version=min_v, timeout_s=300)
+    ew._current_version = int(a["version"])
+    print(f"[lite {ew.identity()}] generation {a['version']} "
+          f"rank={a['rank']}/{a['size']}", flush=True)
+    try:
+        while True:
+            if os.path.exists(os.path.join(DIR, "done")):
+                return False
+            if mgr.consume_commit_request():
+                print(f"[lite {ew.identity()}] commit requested",
+                      flush=True)
+            mgr.raise_if_updated()
+            time.sleep(0.05)
+    except DrainRequested:
+        print(f"[lite {ew.identity()}] drain -> exiting 0", flush=True)
+        return False
+    except HostsUpdatedInterrupt:
+        return True
+
+
+def main():
+    mgr = ew.WorkerNotificationManager()
+    ew._manager = mgr
+    while one_generation(mgr):
+        pass
+    print(f"[lite {ew.identity()}] exiting 0", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

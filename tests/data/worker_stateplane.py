@@ -1,0 +1,228 @@
+"""Resilient-state-plane acceptance worker (ISSUE 14) — jax-free.
+
+A synthetic elastic "trainer" over the REAL wire stack (versioned
+rendezvous + native lock-step negotiation, flat or behind a real
+per-host ``HostAgent``) whose elastic state rides the REAL
+:mod:`horovod_tpu.elastic.stateplane`: every worker commits epochs
+(paced by the driver's COMMIT pings plus a periodic cadence), declares
+them in the rendezvous state KV, and serves its committed blob from the
+plane's shard server.  A worker that joins a generation while survivors
+hold a NEWER epoch restores peer-to-peer — the scenario test asserts the
+replacement rank's ``source=peer``, ``disk_reads=0`` and a digest
+bitwise-identical to the survivors' committed epoch.
+
+Scripted through files in ``STATEPLANE_DIR``:
+
+- ``done``   existence ends the run (clean LEAVE, exit 0)
+
+Log lines the scenario test pins::
+
+    committed epoch=<E> digest=<D>
+    restored epoch=<E> source=<peer|disk> digest=<D> disk_reads=<N>
+"""
+
+import os
+import sys
+import time
+
+from horovod_tpu.common.controller import TCPController
+from horovod_tpu.common.exceptions import (
+    DrainRequested, HorovodInternalError, HostsUpdatedInterrupt,
+)
+from horovod_tpu.elastic import rendezvous as rdv
+from horovod_tpu.elastic import stateplane as spl
+from horovod_tpu.elastic import worker as ew
+
+DIR = os.environ["STATEPLANE_DIR"]
+CKPT_DIR = os.environ["HOROVOD_CKPT_DIR"]
+HIER = os.environ.get("HOROVOD_HIERARCHICAL_CONTROLLER", "") == "1"
+COMMIT_EVERY = int(os.environ.get("STATEPLANE_COMMIT_EVERY", "5"))
+
+_agent = None          # generation-surviving per-host agent (ISSUE 12)
+_plane = None          # generation-surviving state plane (ISSUE 14)
+
+
+def _state_for(epoch: int) -> dict:
+    """Deterministic per-epoch state, identical on every rank — what
+    makes 'bitwise-identical to the survivors' epoch' assertable."""
+    import numpy as np
+    return {"step": epoch,
+            "params": np.arange(4096, dtype=np.float32) * float(epoch)}
+
+
+def _plane_for(rank: int, world: int):
+    global _plane
+    if _plane is None:
+        _plane = spl.StatePlane(CKPT_DIR, rank=rank, world=world)
+    else:
+        # The plane (and its in-memory epoch — the thing a survivor
+        # serves across a world change) SURVIVES re-rendezvous; only its
+        # shard-file naming follows the new assignment.
+        _plane.rank, _plane.world = rank, world
+    addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+    port = int(os.environ["HOROVOD_RENDEZVOUS_PORT"])
+    ident = ew.identity()
+    _plane.set_declare(
+        lambda rec: rdv.declare_state(addr, port, ident, rec))
+    return _plane
+
+
+def _maybe_restore(plane) -> None:
+    """Peer-first restore at generation entry, mirroring
+    ``stateplane.maybe_restore`` for a stateless synthetic trainer."""
+    addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+    port = int(os.environ["HOROVOD_RENDEZVOUS_PORT"])
+    ident = ew.identity()
+    try:
+        records = rdv.state_directory(addr, port)
+    except OSError:
+        return
+    peers = [(who.rsplit(":", 1)[0], int(rec["port"]))
+             for who, rec in records.items()
+             if who != ident and rec.get("port")
+             and int(rec.get("epoch", -1)) > plane.epoch]
+    if not peers:
+        return
+    try:
+        _data, epoch, source = plane.restore(peers=peers)
+    except FileNotFoundError:
+        return
+    print(f"[worker {ident}] restored epoch={epoch} source={source} "
+          f"digest={plane.memory_state()[2]} "
+          f"disk_reads={plane.disk_reads}", flush=True)
+
+
+class E:
+    def __init__(self, name):
+        import numpy as np
+        self.name = name
+        self.tensor = np.zeros((2, 4), np.float32)
+        self.group_id = -1
+
+
+def one_generation(mgr):
+    addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+    port = int(os.environ["HOROVOD_RENDEZVOUS_PORT"])
+    min_v = 0 if ew._current_version is None else ew._current_version + 1
+    a = rdv.fetch_assignment(addr, port, ew.identity(),
+                             min_version=min_v, timeout_s=120)
+    ew._current_version = int(a["version"])
+    rank, size = int(a["rank"]), int(a["size"])
+    ctl_port = int(a["controller_port2"]) or int(a["controller_port"]) + 1
+    coord = a["controller_addr"]
+
+    connect_addr, connect_port, server_port = coord, ctl_port, None
+    if HIER:
+        from horovod_tpu.common.host_agent import HostAgent
+        global _agent
+        cross = int(a["cross_rank"])
+        agent_port = int(a.get("agent_port") or ctl_port + 1 + cross)
+        if int(a["local_rank"]) == 0:
+            reused = False
+            if _agent is not None and _agent.port == agent_port:
+                try:
+                    _agent.new_generation(coord, ctl_port, [rank],
+                                          host_index=cross)
+                    reused = True
+                except RuntimeError:
+                    pass
+            if not reused:
+                if _agent is not None:
+                    _agent.stop()
+                _agent = HostAgent(agent_port, coord, ctl_port, [rank],
+                                   host_index=cross).start()
+        connect_addr, connect_port = "127.0.0.1", agent_port
+        if rank == 0:
+            server_port = ctl_port
+    elif rank == 0:
+        server_port = ctl_port
+
+    plane = _plane_for(rank, size)
+    # The peer-vs-disk decision, BEFORE any training round: survivors
+    # holding a newer epoch hand it over shard-by-shard; a fresh
+    # replacement rank never opens a checkpoint file.
+    _maybe_restore(plane)
+
+    # Short round timeout: back-to-back generations (a discovery change
+    # landing while the drained worker's exit is being reaped) can strand
+    # THIS worker in a generation its peer never joined — the timeout is
+    # what converts that into a quick re-rendezvous instead of a minute-
+    # long wedge.  A failed CONNECT means the same thing (the hosting
+    # rank already moved on): re-rendezvous, don't crash.
+    try:
+        ctl = TCPController(connect_addr, connect_port, rank=rank,
+                            world=size, stall_warn_s=1e9,
+                            cache_capacity=256, round_timeout_s=6.0,
+                            server_port=server_port)
+    except (OSError, RuntimeError) as exc:
+        print(f"[worker {ew.identity()}] controller for generation "
+              f"{a['version']} unreachable ({exc}); re-rendezvous",
+              flush=True)
+        # Re-fetch the SAME generation (or any newer one the driver has
+        # published since): the hosting rank may simply not be there yet.
+        ew._current_version = int(a["version"]) - 1
+        return True
+    print(f"[worker {ew.identity()}] generation {a['version']} "
+          f"rank={rank}/{size} epoch={plane.epoch}", flush=True)
+
+    def commit():
+        epoch = plane.commit(state=_state_for(plane.epoch + 1))
+        plane.wait_durable(epoch, timeout=10)
+        print(f"[worker {ew.identity()}] committed epoch={epoch} "
+              f"digest={plane.memory_state()[2]}", flush=True)
+
+    step = 0
+    try:
+        while True:
+            entries = [E(f"g{a['version']}.s{step}")]
+            pending = list(entries)
+            for _ in range(50):
+                ready, _errs = ctl.negotiate(pending)
+                got = {e.name for e in ready}
+                pending = [e for e in pending if e.name not in got]
+                if not pending:
+                    break
+            step += 1
+            if os.path.exists(os.path.join(DIR, "done")):
+                return False
+            # Paced commit (the driver's COMMIT ping before scale/
+            # preemption decisions) OR the periodic cadence.
+            if mgr.consume_commit_request():
+                print(f"[worker {ew.identity()}] commit requested by the "
+                      f"driver (checkpoint pacing)", flush=True)
+                commit()
+            elif step % COMMIT_EVERY == 0:
+                commit()
+            mgr.raise_if_updated()
+            time.sleep(0.05)
+    except DrainRequested:
+        print(f"[worker {ew.identity()}] drain requested -> clean LEAVE",
+              flush=True)
+        return False
+    except HostsUpdatedInterrupt:
+        print(f"[worker {ew.identity()}] hosts updated -> re-rendezvous",
+              flush=True)
+        return True
+    except HorovodInternalError as exc:
+        print(f"[worker {ew.identity()}] control plane ended ({exc}); "
+              f"re-rendezvous", flush=True)
+        return True
+    finally:
+        ctl.leave()
+        ctl.shutdown()
+        if _agent is not None:
+            _agent.end_generation()
+
+
+def main():
+    mgr = ew.WorkerNotificationManager()
+    ew._manager = mgr
+    while one_generation(mgr):
+        pass
+    if _plane is not None:
+        _plane.close()
+    print(f"[worker {ew.identity()}] exiting 0", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

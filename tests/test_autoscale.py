@@ -95,10 +95,17 @@ def test_aggregator_mark_left_keeps_health_ok():
 
 # ------------------------------------------------------------- ScalePolicy
 def _summary(spread=None, slowest=None, per_rank=None, q=0, q_trend=None,
-             progress_total=None):
+             progress_total=None, commit_age=None):
     return {"cycle_us_spread": spread, "slowest_rank": slowest,
             "per_rank_cycle_us": per_rank or {}, "queue_depth": q,
-            "queue_depth_trend": q_trend, "progress_total": progress_total}
+            "queue_depth_trend": q_trend, "progress_total": progress_total,
+            "last_commit_age_s": commit_age}
+
+
+def _decisions(d):
+    """The driver's DECISION events — the paced-commit ack records
+    (``commit_request``, ISSUE 14) are bookkeeping, not decisions."""
+    return [e for e in d.events if e["action"] != "commit_request"]
 
 
 def test_policy_scale_out_needs_persistent_trend_then_cools_down():
@@ -331,14 +338,18 @@ def test_autoscale_step_executes_evict_through_drain_and_cordon():
     }
     d._autoscale_step()
     assert d._cordoned == {"hostB"}
-    assert len(d.events) == 1
-    ev = d.events[0]
+    # The paced COMMIT fan-out records its ack event first (ISSUE 14),
+    # then the decision event.
+    decisions_logged = _decisions(d)
+    assert len(decisions_logged) == 1
+    ev = decisions_logged[0]
     assert ev["action"] == EVICT and ev["identity"] == "hostB:0"
     assert "monitor attribution" in ev["reason"]
     assert not d.registry.is_blacklisted("hostB")
-    # Second step: hold → no new event.
+    # Second step: hold → no new decision event (and no commit ping).
+    before = len(d.events)
     d._autoscale_step()
-    assert len(d.events) == 1
+    assert len(d.events) == before
 
 
 # --------------------------------------------------- review-pass regressions
@@ -386,7 +397,7 @@ def test_host_granular_min_np_guard_blocks_scale_in_and_evict():
             "hostB:1": {"rank": 3, "hostname": "hostB"},
         }
         d._autoscale_step()
-        assert d.events == [], (action_decision.action, d.events)
+        assert _decisions(d) == [], (action_decision.action, d.events)
         assert d._cordoned == set(), (action_decision.action, d._cordoned)
 
 
@@ -524,9 +535,15 @@ def test_driver_preempt_drain_commits_cordons_and_classifies_left():
         finally:
             drv.is_local_host = orig
 
-        assert [e["action"] for e in d.events] == ["preempt_drain"]
-        assert d.events[0]["host"] == "hostB"
-        assert "preemption notice" in d.events[0]["reason"]
+        assert [e["action"] for e in _decisions(d)] == ["preempt_drain"]
+        assert _decisions(d)[0]["host"] == "hostB"
+        assert "preemption notice" in _decisions(d)[0]["reason"]
+        # ISSUE 14 bugfix: the paced-commit fan-out recorded per-worker
+        # acks in the event log BEFORE the cordon, and the listening
+        # worker's ack landed within the grace-bounded wait.
+        ack_ev = next(e for e in d.events
+                      if e["action"] == "commit_request")
+        assert ack_ev["acks"].get("hostB:0") is True, ack_ev
         assert "hostB" in d._cordoned
         assert "hostB:0" in d._draining
         assert "hostB:0" in d._drain_deadlines
@@ -549,7 +566,7 @@ def test_driver_preempt_drain_commits_cordons_and_classifies_left():
 
         # Handled once while the notice stands.
         d._check_preemption()
-        assert len(d.events) == 1
+        assert len(_decisions(d)) == 1
 
         # Clean exit 0 → LEFT, regeneration, never blacklisted.
         proc.exit(0)
@@ -567,7 +584,7 @@ def test_driver_preempt_drain_commits_cordons_and_classifies_left():
         disc.notices.add("hostB")
         d._procs["hostB:0"] = _LiveProc2()
         d._check_preemption()
-        assert len(d.events) == 2, d.events
+        assert len(_decisions(d)) == 2, d.events
         assert "hostB" in d._cordoned
 
         # A notice for a host OUTSIDE the assignment cordons it (a
@@ -706,3 +723,134 @@ def test_effective_hosts_preserves_discovery_order_for_new_hosts():
     eff2 = d._effective_hosts(disc2, now=101.0)
     assert [h.hostname for h in eff2] == ["node-b", "node-a", "node-z",
                                           "node-c"]
+
+
+# ------------------------------------------- stale-state guard (ISSUE 14)
+def test_policy_stale_commit_age_refuses_evict_and_scale_in():
+    """HOROVOD_COMMIT_MAX_AGE_S: a would-fire evict (and a would-fire
+    scale_in) is REFUSED while the fleet's last state-plane commit is
+    older than the bound — shrinking a world whose restore point is
+    stale converts an orderly drain into lost work.  The hold carries
+    the attribution, opens NO cooldown, and the decision fires the
+    moment the fleet commits again."""
+    per_rank = {0: 100.0, 1: 100.0, 2: 900.0}
+    p = ScalePolicy(min_np=1, straggler_factor=3.0, persistence=2,
+                    cooldown_s=0.0, commit_max_age_s=10.0)
+    t = 1000.0
+    for i in range(4):
+        d = p.observe(_summary(spread=800, slowest=2, per_rank=per_rank,
+                               progress_total=i, commit_age=60.0),
+                      3, now=t + i)
+        assert d.is_hold, (i, d)
+        if i >= 1:      # persistence satisfied: the GUARD is what holds
+            assert "stale-state guard" in d.reason, d.reason
+    assert p.stale_holds >= 2
+    # Fresh commit → the evict fires immediately (no cooldown was opened).
+    d = p.observe(_summary(spread=800, slowest=2, per_rank=per_rank,
+                           progress_total=9, commit_age=1.0),
+                  3, now=t + 10)
+    assert d.action == EVICT and d.evict_rank == 2, d
+
+    # scale_in: same guard.
+    p2 = ScalePolicy(min_np=1, persistence=1, cooldown_s=0.0, idle_s=5.0,
+                     commit_max_age_s=10.0)
+    p2.observe(_summary(q=0, progress_total=7, commit_age=60.0), 3,
+               now=t)
+    p2.observe(_summary(q=0, progress_total=7, commit_age=60.0), 3,
+               now=t + 10)
+    d = p2.observe(_summary(q=0, progress_total=7, commit_age=60.0), 3,
+                   now=t + 20)
+    assert d.is_hold and "stale-state guard" in d.reason, d
+    d = p2.observe(_summary(q=0, progress_total=7, commit_age=2.0), 3,
+                   now=t + 30)
+    assert d.action == SCALE_IN, d
+
+
+def test_policy_stale_guard_off_and_unknown_age_keep_old_behavior():
+    """Guard off (0, the default) or no checkpoint telemetry (age None):
+    evict/scale_in behave exactly as before ISSUE 14."""
+    per_rank = {0: 100.0, 1: 100.0, 2: 900.0}
+    for kwargs, age in (({}, 1e9), ({"commit_max_age_s": 10.0}, None)):
+        p = ScalePolicy(min_np=1, straggler_factor=3.0, persistence=1,
+                        cooldown_s=0.0, **kwargs)
+        d = p.observe(_summary(spread=800, slowest=2, per_rank=per_rank,
+                               progress_total=1, commit_age=age),
+                      3, now=1000.0)
+        assert d.action == EVICT, (kwargs, age, d)
+
+
+def test_policy_preempt_exempt_from_stale_guard():
+    """Preemption outranks the stale-state guard too: the hardware is
+    going away on the platform's schedule — holding would just convert
+    the orderly drain into a crash."""
+    p = ScalePolicy(min_np=1, commit_max_age_s=1.0)
+    d = p.observe(_summary(commit_age=1e9), 3, now=100.0,
+                  preempt_hosts=("hostB",))
+    assert d.action == "preempt", d
+
+
+# ------------------------------------- commit-ack plumbing (ISSUE 14 fix)
+def test_commit_ping_acked_by_worker_and_recorded_in_events():
+    """The notification service replies ACK to a COMMIT ping; the driver
+    records per-worker acks in the event log and returns them — the
+    preempt drain's grace-bounded wait keys on exactly this."""
+    from horovod_tpu.elastic.worker import WorkerNotificationManager
+
+    mgr = WorkerNotificationManager()
+    d = _driver(min_np=1)
+    try:
+        d._assigned = {"127.0.0.1:0": {"rank": 0,
+                                       "hostname": "127.0.0.1"}}
+        d._procs["127.0.0.1:0"] = _FakeProc(None)
+        d.rendezvous._notify_ports["127.0.0.1:0"] = mgr._service.port
+        acks = d._request_commit_all(wait_s=3.0)
+        assert acks == {"127.0.0.1:0": True}, acks
+        assert mgr.consume_commit_request() is True
+        ev = next(e for e in d.events if e["action"] == "commit_request")
+        assert ev["acks"]["127.0.0.1:0"] is True
+        assert ev["acked"] == ["127.0.0.1:0"]
+        # An unreachable worker records False — visible, not silent.
+        d._procs["127.0.0.1:9"] = _FakeProc(None)
+        d.rendezvous._notify_ports["127.0.0.1:9"] = 1     # dead port
+        acks = d._request_commit_all(wait_s=1.0)
+        assert acks["127.0.0.1:9"] is False, acks
+    finally:
+        mgr._service.stop()
+        d.rendezvous.stop()
+
+
+def test_no_op_regeneration_skipped_when_layout_unchanged():
+    """ISSUE 14 (review/drive fix): a regeneration whose active
+    membership + rank layout exactly matches the live generation — e.g.
+    an already-cordoned host aging past the discovery-grace window right
+    after its drain re-formed the world — must NOT re-publish: fresh
+    ports would force every healthy worker through a pointless
+    teardown/re-init, and sub-second back-to-back generations strand
+    joiners on superseded init barriers.  Exited identities still
+    respawn into the live generation."""
+    d = _driver(min_np=1)
+    try:
+        hosts = [DiscoveredHost("127.0.0.1", 1)]
+        assert d._new_generation(hosts) is True
+        v1 = d.rendezvous.version
+        a1 = dict(d._assigned)
+        # Same membership again: no new version, same assignment table.
+        assert d._new_generation(hosts) is True
+        assert d.rendezvous.version == v1
+        assert d._assigned == a1
+        # A membership change DOES regenerate.
+        assert d._new_generation(
+            hosts + [DiscoveredHost("127.0.0.2", 1)]) is True
+        assert d.rendezvous.version == v1 + 1
+        # ...and an exited identity respawns into the unchanged layout
+        # without a republish.
+        v2 = d.rendezvous.version
+        dead = _FakeProc(1)
+        for i in d._assigned:
+            d._procs[i] = dead
+        d._new_generation(hosts + [DiscoveredHost("127.0.0.2", 1)])
+        assert d.rendezvous.version == v2
+        assert all(p is not dead for p in d._procs.values())
+    finally:
+        d._shutdown_workers()
+        d.rendezvous.stop()

@@ -105,6 +105,16 @@ def test_bench_minimal_mode():
     assert asc["leave_sent"] is True, asc
     assert asc["left_observed"] is True, asc
     assert asc["drain_roundtrip_us"] > 0, asc
+    # Restore A/B (ISSUE 14) on every line: disk-vs-peer recovery wall
+    # time over the real state plane — both paths restore the identical
+    # blob, and the peer path never opens a checkpoint file.  (No
+    # which-is-faster assertion: on a local tmpfs the disk path can win;
+    # the production claim is about remote/networked checkpoint storage.)
+    rab = out["restore_ab"]
+    assert rab["disk_restore_us"] > 0 and rab["peer_restore_us"] > 0, rab
+    assert rab["bitwise_identical"] is True, rab
+    assert rab["peer_disk_reads"] == 0, rab
+    assert rab["peer_shards_fetched"] == rab["world"], rab
     # Zero-RTT A/B (ISSUE 11) on every line: with speculation on, warm
     # cycles stop paying the negotiation round trip (< 1 per cycle, hit
     # rate ≥ 90% on this stable workload) while every rank's verdict

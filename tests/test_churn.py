@@ -25,8 +25,10 @@ def test_churn_event_parse_valid_forms():
         "agent_crash", "1", 7)
     assert ChurnEvent.parse("preempt_notice:0@4") == ChurnEvent(
         "preempt_notice", "0", 4)
+    assert ChurnEvent.parse("rejoin_restore:2@9") == ChurnEvent(
+        "rejoin_restore", "2", 9)
     assert set(CHURN_VERBS) == {"leave", "join", "agent_crash",
-                                "preempt_notice"}
+                                "preempt_notice", "rejoin_restore"}
 
 
 def test_churn_script_parse_orders_by_round_stably():
@@ -121,3 +123,55 @@ def test_churn_runner_is_jax_free():
     import horovod_tpu.testing.churn as churn
     src = open(churn.__file__).read()
     assert "import jax" not in src
+
+
+# ---------------------------------------- rejoin_restore verb (ISSUE 14)
+def test_churn_runner_validates_rejoin_restore_needs_prior_departure():
+    with pytest.raises(ValueError):   # never departed
+        ChurnRunner(4, rounds=10,
+                    script=parse_churn("rejoin_restore:1@5"))
+    with pytest.raises(ValueError):   # departs AFTER the rejoin
+        ChurnRunner(4, rounds=10,
+                    script=parse_churn("rejoin_restore:1@5,leave:1@8"))
+    with pytest.raises(ValueError):   # rank out of range
+        ChurnRunner(4, rounds=10,
+                    script=parse_churn("leave:9@3,rejoin_restore:9@5"))
+
+
+def test_churn_rejoin_restore_records_peer_source(tmp_path):
+    """The satellite's assertion: a rank that left at round 4 rejoins at
+    round 9 as a fresh replacement and restores FROM THE SURVIVORS'
+    SHARD SERVERS — the phase output records source=peer, the epoch the
+    survivors advanced to after the departure, and zero disk reads."""
+    rep = ChurnRunner(
+        4, ranks_per_host=2, rounds=14, warm=3,
+        script=parse_churn("leave:3@4,rejoin_restore:3@9"),
+        state_dir=str(tmp_path)).run()
+    assert rep["survived"] is True, rep
+    assert rep["left_ranks"] == [3], rep
+    (restore,) = rep["restores"]
+    assert restore["rank"] == 3, restore
+    assert restore["restore_source"] == "peer", restore
+    assert restore["disk_reads"] == 0, restore
+    # The survivors committed PAST the departure epoch; the rejoiner got
+    # exactly that newest epoch, shard-by-shard from the live peers.
+    assert restore["restore_epoch"] == rep["state_epoch"] == 2, rep
+    assert restore["peer_shards"] >= 1, restore
+    ev = next(e for e in rep["events_fired"]
+              if e["verb"] == "rejoin_restore")
+    assert ev["restore_source"] == "peer", ev
+
+
+def test_churn_rejoin_restore_disk_fallback_without_peer_quorum(tmp_path):
+    """serve_state=False models survivors whose shard servers are
+    unreachable: no quorum — the rejoiner recovers from the newest
+    complete on-disk epoch instead, and the record says so."""
+    rep = ChurnRunner(
+        4, ranks_per_host=2, rounds=14, warm=3,
+        script=parse_churn("leave:3@4,rejoin_restore:3@9"),
+        state_dir=str(tmp_path), serve_state=False).run()
+    assert rep["survived"] is True, rep
+    (restore,) = rep["restores"]
+    assert restore["restore_source"] == "disk", restore
+    assert restore["disk_reads"] >= 1, restore
+    assert restore["restore_epoch"] == 2, restore

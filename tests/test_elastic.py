@@ -277,6 +277,47 @@ def test_run_wrapper_resets_on_peer_failure(monkeypatch):
     assert resets == ["down", "up"], "runtime was not reset between tries"
 
 
+def test_run_wrapper_peer_restore_only_when_stale(monkeypatch):
+    """Review fix: the wrapper's peer-first restore runs only while this
+    rank's live state is STALE — a fresh process, or right after a fault
+    rolled it back to its last commit.  A survivor re-entering on a clean
+    HostsUpdatedInterrupt holds the fleet's current state (its plane
+    epoch may lag a peer's on skewed commit cadence), and pulling that
+    peer's older commit would roll live training backwards fleet-wide."""
+    from horovod_tpu.common import basics
+    from horovod_tpu.common.exceptions import (
+        HostsUpdatedInterrupt, PeerFailureError,
+    )
+    from horovod_tpu.elastic import stateplane as spl
+    from horovod_tpu.elastic.state import ObjectState, run
+
+    monkeypatch.setattr(basics, "shutdown", lambda: None)
+    monkeypatch.setattr(basics, "init", lambda: None)
+    plane = object()
+    monkeypatch.setattr(spl, "attach", lambda state, p=None: plane)
+    restores = []
+    attempts = []
+    monkeypatch.setattr(spl, "maybe_restore",
+                        lambda state, p: restores.append(len(attempts)))
+
+    @run
+    def train(state):
+        attempts.append(1)
+        if len(attempts) == 1:
+            raise HostsUpdatedInterrupt(skip_sync=False)   # clean change
+        if len(attempts) == 2:
+            raise PeerFailureError("HVD303 peer died", dead_ranks=[1])
+        return "done"
+
+    state = ObjectState(bcast_object=_identity_bcast, epoch=5)
+    state.commit()
+    assert train(state) == "done"
+    # Restored on the fresh entry (before attempt 1) and after the fault
+    # rollback (before attempt 3) — NOT on the clean re-entry (a restore
+    # before attempt 2 would record a 1 here).
+    assert restores == [0, 2], restores
+
+
 # ------------------------------------------------- driver process lifecycle
 @pytest.mark.slow
 def test_driver_success_on_worker_exit_zero():

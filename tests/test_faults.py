@@ -121,12 +121,32 @@ def test_fault_spec_parse_forms():
     "nope",                       # too few fields
     "badpoint:1:crash",           # unknown point
     "round_send:1:explode",       # unknown action
-    "round_send:1:crash:0",       # nth < 1
+    "round_send:1:crash:-1",      # nth < 0 (0 = persistent, ISSUE 14)
     "round_send:1:crash:2:extra", # too many fields
 ])
 def test_fault_spec_parse_rejects(bad):
     with pytest.raises(ValueError):
         faults.FaultSpec.parse(bad)
+
+
+def test_fault_spec_nth_zero_is_persistent():
+    """nth=0 (ISSUE 14): the fault fires on EVERY arrival — how a
+    persistently failing disk is modeled — and still reports fired()."""
+    s = faults.FaultSpec.parse("round_send:1:delay_ms=0:0")
+    assert s.nth == 0
+    faults.arm(s)
+    try:
+        for _ in range(3):
+            faults.fire("round_send", 1)
+        assert faults.fired()
+        # Each arrival executes: the counter keeps advancing and a later
+        # arrival still runs the action (probed via io_error raising).
+        faults.arm("round_send:1:io_error:0")
+        for _ in range(3):
+            with pytest.raises(OSError):
+                faults.fire("round_send", 1)
+    finally:
+        faults.disarm()
 
 
 def test_fire_is_noop_when_unarmed_and_rank_gated():

@@ -71,13 +71,13 @@ def test_self_lint_covers_trace_package():
 
 
 def test_self_lint_covers_autoscale_stack():
-    """Explicit coverage for the autoscaling subsystem (ISSUE 10): the
-    policy engine and the driver/registration/worker layers it drives
-    must parse and lint clean."""
+    """Explicit coverage for the autoscaling + resilient-state subsystem
+    (ISSUES 10/14): the policy engine, the driver/registration/worker
+    layers it drives, and the state plane must parse and lint clean."""
     el_dir = os.path.join(REPO, "horovod_tpu", "elastic")
     files = {f for f in os.listdir(el_dir) if f.endswith(".py")}
     assert {"autoscale.py", "driver.py", "registration.py",
-            "worker.py"} <= files, files
+            "worker.py", "stateplane.py"} <= files, files
     findings = lint_paths([el_dir])
     assert not findings, "\n".join(f.render() for f in findings)
 

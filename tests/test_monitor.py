@@ -527,6 +527,11 @@ importlib.import_module('horovod_tpu.elastic.autoscale')
 importlib.import_module('horovod_tpu.elastic.driver')
 importlib.import_module('horovod_tpu.elastic.worker')
 importlib.import_module('horovod_tpu.elastic.rendezvous')
+# Resilient state plane (ISSUE 14): sharded checkpoint writes + the
+# peer-to-peer restore path run in the jax-free acceptance workers, the
+# churn runner and the bench — and the chunk items it hands the engine
+# come from the (already covered) jax-free ops/scheduler.
+importlib.import_module('horovod_tpu.elastic.stateplane')
 print('PURITY_OK')
 """
 

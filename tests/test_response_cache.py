@@ -286,6 +286,44 @@ def test_v4_liveness_adds_zero_warm_path_bytes():
         faults.disarm()
 
 
+def test_checkpoint_stream_adds_zero_warm_path_bytes(tmp_path):
+    """ISSUE 14 frame guard: the resilient state plane is LOCAL I/O plus
+    a peer-to-peer side service — checkpoint chunks are never negotiated
+    and commit/restore traffic never rides the coordinator.  With a
+    plane actively committing (and serving shards) on both ranks, the
+    warm-path negotiation frame stays the exact pinned 13 bytes and the
+    steady state stays full-announce-free."""
+    from horovod_tpu.elastic.stateplane import StatePlane
+
+    def fn(ctl, rank):
+        import numpy as _np
+        plane = StatePlane(str(tmp_path / f"r{rank}"), rank=rank, world=2,
+                           serve=True)
+        try:
+            _steps(ctl, lambda: [E("t")], 2)        # warm-up: learn slot
+            bytes_before = ctl.bytes_sent
+            rounds_before = ctl.rounds
+            full_before = ctl.cache_stats.full_announces
+            for i in range(4):
+                plane.commit(state={
+                    "step": i,
+                    "params": _np.arange(4096, dtype=_np.float32)})
+                _steps(ctl, lambda: [E("t")], 1)
+            per_round = ((ctl.bytes_sent - bytes_before)
+                         / (ctl.rounds - rounds_before))
+            assert per_round == 13, (
+                f"warm-path frame grew to {per_round}B with checkpointing "
+                f"armed — the checkpoint stream must cost zero control-"
+                f"plane bytes")
+            assert ctl.cache_stats.full_announces == full_before
+            assert plane.durable_epoch >= 0
+            return True
+        finally:
+            plane.close()
+
+    _pair(fn)
+
+
 def test_hierarchy_keeps_per_rank_warm_path_bytes_identical():
     """Protocol-v5 frame guard: with the hierarchical control plane ON
     (ranks talk to a per-host agent, not the root), each rank's warm-path
