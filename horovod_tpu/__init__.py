@@ -35,6 +35,8 @@ from .ops.collectives import (  # noqa: F401
 from .ops.eager import (  # noqa: F401
     allreduce, allreduce_async,
     grouped_allreduce, grouped_allreduce_async,
+    grouped_allgather, grouped_allgather_async,
+    grouped_reducescatter, grouped_reducescatter_async,
     allgather, allgather_async,
     broadcast, broadcast_async, broadcast_object, allgather_object,
     alltoall, alltoall_async,

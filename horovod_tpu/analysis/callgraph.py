@@ -88,6 +88,12 @@ class CollectiveSite:
     col: int
     guard: Optional[Guard]
     has_process_set: bool
+    # ZeRO-sharded site (ISSUE 15): a collective submitted with
+    # sharded=True, or the synthetic ``sharded_update`` site registered
+    # for ``opt.update(...)`` on a DistributedOptimizer(sharded=True) /
+    # sharded_optimizer binding — the schedule pass expands the latter to
+    # its real reduce-scatter + allgather sequence.
+    sharded: bool = False
 
 
 @dataclasses.dataclass
@@ -108,6 +114,9 @@ class FunctionNode:
     uses_elastic_state: bool = False
     is_callback: bool = False
     in_edges: int = 0
+    # Names bound to a sharded optimizer wrapper in this scope: their
+    # ``.update()`` calls register synthetic sharded_update sites.
+    sharded_opt_vars: Set[str] = dataclasses.field(default_factory=set)
 
     @property
     def short(self) -> str:
@@ -335,11 +344,32 @@ class _Collector(ast.NodeVisitor):
     visit_IfExp = _visit_divergent
 
     # --------------------------------------------------------- bindings
+    @staticmethod
+    def _is_sharded_opt_call(val: ast.Call) -> bool:
+        """A binding value that yields a ZeRO-sharded optimizer: the zero
+        wrapper itself, or DistributedOptimizer with a truthy constant
+        sharded= (non-constant sharded= is HVD110's territory)."""
+        name = _call_name(val)
+        if name == "sharded_optimizer":
+            return True
+        if name == "DistributedOptimizer":
+            for kw in val.keywords:
+                if kw.arg == "sharded" and isinstance(kw.value,
+                                                      ast.Constant):
+                    return bool(kw.value.value)
+        return False
+
     def visit_Assign(self, node: ast.Assign):
         if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
             tgt = node.targets[0].id
             val = node.value
+            # ANY rebind clears a sharded-optimizer marking first (a
+            # Name/None/attribute reassignment must not leave a stale
+            # entry registering phantom sharded_update sites).
+            self._cur().sharded_opt_vars.discard(tgt)
             if isinstance(val, ast.Call):
+                if self._is_sharded_opt_call(val):
+                    self._cur().sharded_opt_vars.add(tgt)
                 wrapped = unwrap_wrapped_callable(val)
                 if wrapped is not None:
                     self._cur().bindings[tgt] = ("alias", wrapped)
@@ -381,7 +411,27 @@ class _Collector(ast.NodeVisitor):
                 name=name, line=node.lineno, col=node.col_offset + 1,
                 guard=self._cur_guard(),
                 has_process_set=any(kw.arg == "process_set"
-                                    for kw in node.keywords)))
+                                    for kw in node.keywords),
+                sharded=any(kw.arg == "sharded"
+                            and isinstance(kw.value, ast.Constant)
+                            and bool(kw.value.value)
+                            for kw in node.keywords)))
+        elif name in ("update", "apply_gradients"):
+            # opt.update(...) on a name bound to DistributedOptimizer(
+            # sharded=True) / sharded_optimizer: a synthetic sharded_update
+            # site — the schedule pass expands it to the reduce-scatter +
+            # allgather sequence the eager pipeline actually submits, so
+            # HVD108/HVD109 model the sharded data plane, not an allreduce.
+            d = _dotted(node.func)
+            head = d.split(".")[0] if d else None
+            scopes = [fn.sharded_opt_vars]
+            if self.mod.toplevel is not None and fn is not self.mod.toplevel:
+                scopes.append(self.mod.toplevel.sharded_opt_vars)
+            if head is not None and any(head in s for s in scopes):
+                fn.collectives.append(CollectiveSite(
+                    name="sharded_update", line=node.lineno,
+                    col=node.col_offset + 1, guard=self._cur_guard(),
+                    has_process_set=False, sharded=True))
         fn.calls.append(CallSite(
             callee_expr=_dotted(node.func), line=node.lineno,
             col=node.col_offset + 1, guard=self._cur_guard()))

@@ -75,6 +75,15 @@ _DISABLE_RE = re.compile(r"hvd-lint\s*:\s*disable\s*=\s*([A-Za-z0-9_,\s]+)")
 # in a jit context for HVD106/HVD107.
 _JIT_WRAPPER_NAMES = {"jit", "shard_map", "pmap"}
 
+# ZeRO-sharded configuration arguments (ISSUE 15, HVD110): these shape the
+# whole data plane (reduce-scatter + allgather vs allreduce, 1/N shard
+# layouts) and ride the negotiation digest — they must be fleet-uniform,
+# never derived from rank identity.  Checked on collective submissions and
+# on the wrappers that accept them.
+_SHARD_ARG_NAMES = {"sharded", "num_shards", "shard_count"}
+_SHARD_ARG_CALLS = {"DistributedOptimizer", "sharded_optimizer",
+                    "init_sharded_state"}
+
 
 def _call_name(node: ast.AST) -> Optional[str]:
     """Last dotted segment of a call target: ``hvd.ops.allreduce`` → ``allreduce``."""
@@ -377,7 +386,23 @@ class _Linter(ast.NodeVisitor):
 
         if _is_collective_call(node):
             self._check_collective(node, name)
+        if name in COLLECTIVE_NAMES or name in _SHARD_ARG_CALLS:
+            self._check_shard_args(node, name)
         self.generic_visit(node)
+
+    def _check_shard_args(self, node: ast.Call, name: str):
+        """HVD110: sharded=/shard-count arguments must be rank-invariant
+        — the flag is part of the negotiation digest and forks the whole
+        collective schedule (reduce-scatter+allgather vs allreduce)."""
+        for kw in node.keywords:
+            if kw.arg in _SHARD_ARG_NAMES \
+                    and _mentions_rank(kw.value, self._tainted()):
+                self._emit(
+                    "HVD110", node,
+                    f"{kw.arg}= argument of {name!r} is derived from rank "
+                    f"identity: ranks would disagree on the sharded data "
+                    f"plane (reduce-scatter+allgather vs allreduce) and "
+                    f"submit mismatched programs")
 
     def _check_collective(self, node: ast.Call, name: str):
         if self._jit_depth > 0 and name in COLLECTIVE_NAMES \

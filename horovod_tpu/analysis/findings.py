@@ -152,6 +152,20 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
         "from the post-transition world.",
     ),
     Rule(
+        "HVD110", Severity.ERROR,
+        "world-divergent sharded-optimizer configuration",
+        "A sharded= / shard-count argument of a collective or a "
+        "DistributedOptimizer/sharded_optimizer wrapper is derived from "
+        "rank identity.  The sharded flag is part of the negotiation "
+        "digest and shapes the whole data plane (reduce-scatter + "
+        "allgather vs allreduce; 1/N shard layouts): ranks disagreeing "
+        "on it submit mismatched programs — negotiation fails fast at "
+        "best, or the fleet wedges mid-collective at worst.",
+        "Make the sharded configuration a fleet-uniform constant "
+        "(hyperparameter, HOROVOD_SHARDED_OPTIMIZER / --sharded), never "
+        "a function of rank()/local_rank().",
+    ),
+    Rule(
         "HVD201", Severity.ERROR,
         "collective over unknown mesh axis",
         "A traced lax collective names an axis_name the surrounding mesh "

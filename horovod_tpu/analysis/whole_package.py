@@ -47,6 +47,24 @@ _MAX_CHAIN = 16          # call-graph propagation depth bound
 _MAX_SCHEDULE_DEPTH = 10  # schedule splice depth bound
 
 
+def _site_events(col: CollectiveSite) -> List:
+    """Schedule events a collective site actually submits (ISSUE 15).
+
+    A ``sharded_update`` site (``opt.update(...)`` on a
+    ``DistributedOptimizer(sharded=True)`` / ``sharded_optimizer``
+    binding) schedules the ZeRO pipeline — reduce-scatter then allgather,
+    never an allreduce.  Sharded collectives carry the ``[sharded]``
+    dimension their fusion key / negotiation digest carries: a sharded
+    reduce-scatter and an unsharded one of the same shapes are DIFFERENT
+    programs, so schedules comparing them must diverge."""
+    if col.name == "sharded_update":
+        return [("op", "reducescatter[sharded]"),
+                ("op", "allgather[sharded]")]
+    if col.sharded:
+        return [("op", f"{col.name}[sharded]")]
+    return [("op", col.name)]
+
+
 def _suppressed(mod: ModuleInfo, line: int, rule: str) -> bool:
     ids = mod.suppressed.get(line, set())
     return "ALL" in ids or rule in ids
@@ -222,7 +240,7 @@ def _schedule_stmts(stmts, fn: FunctionNode, pkg: Package, memo, stack,
             key = (n.lineno, n.col_offset + 1)
             col = cols_by_line.get(key)
             if col is not None:
-                ev.append(("op", col.name))
+                ev.extend(_site_events(col))
                 return
             target = calls_by_line.get(key)
             if target is not None:
@@ -466,11 +484,15 @@ def _callback_hvd109(pkg: Package) -> List[Finding]:
                         _suppressed(target.module, col.line, "HVD109"):
                     continue
                 seen.add(key)
+                what = ("sharded optimizer update (schedules "
+                        "reducescatter[sharded] + allgather[sharded])"
+                        if col.name == "sharded_update" else
+                        f"collective {col.name!r}")
                 findings.append(Finding(
                     rule="HVD109", path=target.module.path, line=col.line,
                     col=col.col,
                     message=(
-                        f"collective {col.name!r} is reachable from "
+                        f"{what} is reachable from "
                         f"elastic-transition callback {fn.name!r} "
                         f"({fn.module.base}:{fn.lineno}"
                         + (f", via {_chain_str(fn, chain, target)}"

@@ -243,6 +243,16 @@ class Config:
     ckpt_lane_budget: int = 2
     commit_max_age_s: float = 0.0
 
+    # ZeRO-sharded optimizer (ISSUE 15, docs/performance.md "Sharded
+    # optimizer (ZeRO)").  HOROVOD_SHARDED_OPTIMIZER=1 flips every
+    # DistributedOptimizer built without an explicit ``sharded=`` to the
+    # reduce-scatter → 1/N shard update → allgather data plane: optimizer
+    # state lives 1/world per rank in HBM and gradient bytes ride the
+    # scatter at half an allreduce's wire cost.  Must be identical on
+    # every rank (the launcher's --sharded forwards it): the sharded flag
+    # is part of the negotiation digest, so divergence fails fast.
+    sharded_optimizer: bool = False
+
     # Closed-loop elastic autoscaling (docs/elastic.md "Closed-loop
     # autoscaling") — consumed by the elastic DRIVER (torovodrun
     # --host-discovery-script), not by workers.  HOROVOD_AUTOSCALE=1
@@ -335,6 +345,7 @@ class Config:
             ckpt_chunk_bytes=_env_int("CKPT_CHUNK", 1 << 20),
             ckpt_lane_budget=_env_int("CKPT_LANE_BUDGET", 2),
             commit_max_age_s=_env_float("COMMIT_MAX_AGE_S", 0.0),
+            sharded_optimizer=_env_bool("SHARDED_OPTIMIZER", False),
             autoscale=_env_bool("AUTOSCALE", False),
             autoscale_interval_s=_env_float("AUTOSCALE_INTERVAL", 5.0),
             autoscale_queue_high=_env_float("AUTOSCALE_QUEUE_HIGH", 16.0),

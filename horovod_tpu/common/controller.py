@@ -973,6 +973,15 @@ class TCPController:
         # Joined ranks parse digest fields positionally and rely on this
         # slot being parts[7] (see engine._synthesize_join_entry).
         parts.append(str(getattr(e, "compression", None) or "none"))
+        # ZeRO-sharded dimension (ISSUE 15): appended ONLY when set, so
+        # every flat digest stays byte-identical to the established
+        # protocol (and pinned response-cache slots survive the upgrade).
+        # A sharded reduce-scatter/allgather program differs from the
+        # ordinary one of the same shapes, so flag divergence across
+        # ranks must fail the consistency check, not execute.  Joined
+        # ranks read it positionally at parts[8].
+        if getattr(e, "sharded", False):
+            parts.append("sharded")
         return "|".join(parts)
 
     @staticmethod

@@ -107,6 +107,18 @@ class State:
     def sync(self):
         raise NotImplementedError
 
+    def load_recovered(self, data: Dict[str, Any]):
+        """Load a state dict recovered by the state plane's peer/disk
+        restore (``stateplane.maybe_restore``) into the LIVE attributes
+        and re-save.  The base implementation is a raw attribute load;
+        :class:`JaxState` overrides it to rebuild device arrays (and
+        re-slice a sharded optimizer's own 1/N shard) — the hook that
+        wires the REAL jax path through the peer shard fetch instead of
+        leaving it to the object-level ``sync()``."""
+        for k, v in data.items():
+            setattr(self, k, v)
+        self.save()
+
 
 class ObjectState(State):
     """Elastic state of plain Python attributes, synced via
@@ -143,6 +155,16 @@ class JaxState(ObjectState):
     to host memory on ``commit`` (cheap, async device→host), restored to
     device on ``restore``, and rank-0-broadcast on ``sync``.
 
+    **Sharded optimizer states** (ISSUE 15): a value that is a
+    ``DistributedOptimizer(sharded=True)`` eager state (1/world of the
+    optimizer state on this rank) saves as its rank-INVARIANT gathered
+    form — all ranks then serialize the identical blob, which is what the
+    state plane's shard digests require — and every load path (restore /
+    sync / the peer-fetch ``load_recovered``) re-slices exactly this
+    rank's own 1/N shard back out.  With the state plane armed, a
+    re-joiner's peer shard fetch therefore restores its optimizer slice
+    shard-natively instead of re-sharding a replicated copy.
+
     Usage:
         state = JaxState(params=params, opt_state=opt_state, epoch=0, batch=0)
     """
@@ -156,15 +178,35 @@ class JaxState(ObjectState):
         self._saved_state = {}
         for k in self._kwargs:
             v = getattr(self, k)
-            if k in self._tree_keys:
+            if hasattr(v, "hvd_sharded_saveable"):
+                self._saved_state[k] = v.hvd_sharded_saveable()
+            elif k in self._tree_keys:
                 self._saved_state[k] = jax.tree_util.tree_map(
                     lambda x: jax.device_get(x), v)
             else:
                 self._saved_state[k] = copy.deepcopy(v)
 
+    @staticmethod
+    def _revive(v):
+        """A saved value back to its live form: sharded saveables become
+        this rank's shard state, anything else passes through (``None``
+        means the sharded layout no longer fits — callers keep the raw
+        saveable and the user re-inits for the new world)."""
+        from ..jax.optimizer import is_sharded_saveable, \
+            load_sharded_saveable
+        if is_sharded_saveable(v):
+            from ..common import basics
+            loaded = load_sharded_saveable(v, basics.rank(), basics.size())
+            if loaded is not None:
+                return loaded
+        return None
+
     def restore(self):
         for k, v in self._saved_state.items():
-            if k in self._tree_keys:
+            revived = self._revive(v)
+            if revived is not None:
+                setattr(self, k, revived)
+            elif k in self._tree_keys:
                 setattr(self, k, jax.tree_util.tree_map(jax.numpy.asarray, v))
             else:
                 setattr(self, k, copy.deepcopy(v))
@@ -174,10 +216,32 @@ class JaxState(ObjectState):
             return
         synced = self._bcast_object(self._saved_state, root_rank=0)
         for k, v in synced.items():
-            if k in self._tree_keys:
+            revived = self._revive(v)
+            if revived is not None:
+                setattr(self, k, revived)
+            elif k in self._tree_keys:
                 setattr(self, k, jax.tree_util.tree_map(jax.numpy.asarray, v))
             else:
                 setattr(self, k, copy.deepcopy(v))
+            self._saved_state[k] = v
+
+    def load_recovered(self, data):
+        """Peer/disk-recovered state into live device arrays: tree keys
+        come back as device arrays, a sharded optimizer saveable comes
+        back as THIS rank's 1/N shard (the shard-native restore).
+
+        The recovered dict itself becomes the new ``_saved_state`` —
+        NEVER ``self.save()`` here: a sharded save gathers collectively,
+        and only the stale (re-joining) rank runs this path, so a
+        collective would deadlock against the survivors."""
+        for k, v in data.items():
+            revived = self._revive(v)
+            if revived is not None:
+                setattr(self, k, revived)
+            elif k in self._tree_keys and _is_pytree_of_arrays(v):
+                setattr(self, k, jax.tree_util.tree_map(jax.numpy.asarray, v))
+            else:
+                setattr(self, k, v)
             self._saved_state[k] = v
 
 

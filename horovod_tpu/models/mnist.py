@@ -69,14 +69,20 @@ def loss_fn(params, x, y, axis_name: Optional[str] = "hvd"):
     return jnp.sum(nll) / denom
 
 
-def make_train_step(optimizer, axis_name: Optional[str] = "hvd"):
+def make_train_step(optimizer, axis_name: Optional[str] = "hvd",
+                    reduce_grads: bool = True):
     """Per-shard DP train step: grads psum'd over the world axis — the
-    DistributedOptimizer pattern of SURVEY.md §3.2 in explicit SPMD."""
+    DistributedOptimizer pattern of SURVEY.md §3.2 in explicit SPMD.
+
+    ``reduce_grads=False`` hands RAW per-shard gradients to the optimizer
+    — for optimizers that own their reduction, like the ZeRO
+    ``parallel.zero.sharded_optimizer`` whose update reduce-scatters (a
+    pre-psum would double-reduce)."""
 
     def step(params, opt_state, x, y):
         loss_partial, grads = jax.value_and_grad(loss_fn)(params, x, y,
                                                           axis_name)
-        if axis_name:
+        if axis_name and reduce_grads:
             grads = jax.tree_util.tree_map(
                 lambda g: lax.psum(g, axis_name), grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -87,12 +93,33 @@ def make_train_step(optimizer, axis_name: Optional[str] = "hvd"):
     return step
 
 
-def make_sharded_train_step(optimizer, mesh: Mesh, axis_name: str = "hvd"):
-    step = make_train_step(optimizer, axis_name)
+def make_sharded_train_step(optimizer, mesh: Mesh, axis_name: str = "hvd",
+                            zero_specs=None):
+    """Compiled shard_map train step.
+
+    ``zero_specs`` (ISSUE 15): pass the opt-state spec tree from
+    ``parallel.zero.init_sharded_state(optimizer, params, mesh,
+    axis_name)`` to train with a ZeRO-sharded optimizer — the step then
+    wraps ``optimizer`` in ``parallel.zero.sharded_optimizer`` (raw
+    grads in, reduce-scatter inside, 1/world optimizer state per device)
+    and shards the opt state accordingly.  ``None`` keeps the legacy
+    replicated-state path.
+    """
+    if zero_specs is None:
+        step = make_train_step(optimizer, axis_name)
+        opt_specs = P()
+    else:
+        from ..parallel.zero import sharded_optimizer
+        # average=False: the replicated path psums (the loss already
+        # carries the 1/world factor), so the scatter must SUM too.
+        step = make_train_step(
+            sharded_optimizer(optimizer, axis_name, average=False),
+            axis_name, reduce_grads=False)
+        opt_specs = zero_specs
     return jax.jit(shard_map(
         step, mesh=mesh,
-        in_specs=(P(), P(), P(axis_name), P(axis_name)),
-        out_specs=(P(), P(), P()), check_vma=False),
+        in_specs=(P(), opt_specs, P(axis_name), P(axis_name)),
+        out_specs=(P(), opt_specs, P()), check_vma=False),
         donate_argnums=(0, 1))
 
 

@@ -57,6 +57,15 @@ _FAST_LANE_BOUNDS = (8.0, 24.0)
 # knob).  round_pipeline 1..4 in-flight negotiation rounds per client.
 _SPEC_BOUNDS = (0.0, 5.0)
 _RPIPE_BOUNDS = (0.0, 2.0)
+# Checkpoint-lane pair (ISSUE 15, closing the ISSUE 14 carry-over) —
+# gated on the state plane being armed (HOROVOD_CKPT_DIR): shard-chunk
+# size 64KB..64MB (smaller chunks interleave more finely with gradient
+# cycles but pay more dispatches; bigger chunks stall the cycle tail
+# longer), lane budget 1..8 chunks per engine cycle.  Neither knob can
+# change gradient dispatch order (the budget rule is lane-guarded), so
+# walking them trades ONLY commit latency against cycle-tail time.
+_CKPT_CHUNK_BOUNDS = (16.0, 26.0)
+_CKPT_BUDGET_BOUNDS = (0.0, 3.0)
 
 
 def _clamp(v: float, lo: float, hi: float) -> float:
@@ -257,6 +266,19 @@ class ParameterManager:
             rp0 = max(float(getattr(ctl, "round_pipeline", 1)), 1.0)
             starts.append(math.log2(rp0))
             bounds.append(_RPIPE_BOUNDS)
+        # Checkpoint-lane pair — gated on the state plane being ARMED
+        # (HOROVOD_CKPT_DIR is fleet-uniform config, so every rank takes
+        # the same branch and the agreement payload shape matches):
+        # tuning the chunk/budget knobs with no durability stream would
+        # waste eval budget on dead coordinates.
+        self._tune_ckpt = getattr(engine, "stateplane", None) is not None
+        if self._tune_ckpt:
+            ck0 = max(float(engine.stateplane.chunk_bytes), 1024.0)
+            starts.append(math.log2(ck0))
+            bounds.append(_CKPT_CHUNK_BOUNDS)
+            starts.append(math.log2(
+                max(float(engine.ckpt_lane_budget), 1.0)))
+            bounds.append(_CKPT_BUDGET_BOUNDS)
         self.search = LogCoordinateDescent(
             start=tuple(starts), bounds=tuple(bounds), max_evals=max_evals)
         self._sample_no = 0
@@ -339,6 +361,15 @@ class ParameterManager:
             # naturally at the next _round's entry drain.
             self._engine.controller.round_pipeline = max(
                 1, int(round(params[idx])))
+            idx += 1
+        if self._tune_ckpt and len(params) > idx + 1 \
+                and getattr(self._engine, "stateplane", None) is not None:
+            # Applies from the next commit's write job (chunk plans are
+            # per-epoch) and the next cycle's tail pop (the budget is
+            # read live); gradient dispatch order is invariant to both.
+            self._engine.stateplane.chunk_bytes = max(1, int(params[idx]))
+            self._engine.ckpt_lane_budget = max(
+                1, int(round(params[idx + 1])))
 
     def _poll_move(self):
         payload = self._poller(self._move_handle)
@@ -376,6 +407,11 @@ class ParameterManager:
             if self._tune_round_pipeline and len(params) > idx:
                 extra += (f" round_pipeline="
                           f"{max(1, int(round(params[idx])))}")
+                idx += 1
+            if self._tune_ckpt and len(params) > idx + 1:
+                extra += (f" ckpt_chunk_bytes={int(params[idx])}"
+                          f" ckpt_lane_budget="
+                          f"{max(1, int(round(params[idx + 1])))}")
             self._log_line(f"# final: fusion_threshold={int(params[0])} "
                            f"cycle_time_s={params[1]:.6f}{extra} "
                            f"evals={self.search.evals}\n")
@@ -419,6 +455,8 @@ class ParameterManager:
                 cols += ",spec_ready_after"
             if self._tune_round_pipeline:
                 cols += ",round_pipeline"
+            if self._tune_ckpt:
+                cols += ",ckpt_chunk_bytes,ckpt_lane_budget"
             self._log_line(f"sample,fusion_threshold_bytes,cycle_time_s"
                            f"{cols},score_bytes_per_s\n")
             self._log_header_written = True
@@ -440,6 +478,10 @@ class ParameterManager:
             idx += 1
         if self._tune_round_pipeline and len(params) > idx:
             extra += f",{max(1, int(round(params[idx])))}"
+            idx += 1
+        if self._tune_ckpt and len(params) > idx + 1:
+            extra += (f",{int(params[idx])}"
+                      f",{max(1, int(round(params[idx + 1])))}")
         self._log_line(f"{self._sample_no},{int(params[0])},"
                        f"{params[1]:.6f}{extra},{score:.1f}\n")
 

@@ -359,31 +359,56 @@ def allgather(tensor, name: Optional[str] = None,
     return _sync_now(allgather_async(tensor, name, process_set))
 
 
-def _grouped_async(tensors, name, prefix, ctype, process_set, **extra):
+def _grouped_async(tensors, name, prefix, ctype, process_set,
+                   priorities=None, **extra):
     """Shared grouped-enqueue core (reference N13 atomic groups): one
-    atomic push, every member negotiates/batches together."""
+    atomic push, every member negotiates/batches together.
+
+    ``priorities`` (one int per tensor, identical on every rank): drain
+    priority per member, exactly like ``grouped_allreduce_async`` — the
+    sharded optimizer stamps its reduce-scatter/allgather legs with
+    reverse-registration order so first-needed parameters lead."""
     ps_id = _ps(process_set)
     gid = next(_group_counter)
     base = _auto_name(prefix, name)
+    if priorities is not None and len(priorities) != len(tensors):
+        raise ValueError(
+            f"priorities must have one entry per tensor: got "
+            f"{len(priorities)} for {len(tensors)} tensors")
     items = []
     for i, t in enumerate(tensors):
         arr, owned = _as_stacked(t, ps_id)
         items.append(dict(name=f"{base}.{i}", ctype=ctype, tensor=arr,
                           process_set_id=ps_id, group_id=gid, donate=owned,
+                          priority=int(priorities[i])
+                          if priorities is not None else 0,
                           **extra))
     return _engine().enqueue_group(items)
 
 
 def grouped_allgather_async(tensors: Sequence, name: Optional[str] = None,
-                            process_set: Optional[ProcessSet] = None) -> List[int]:
-    """Reference: ``hvd.grouped_allgather`` (upstream v0.28)."""
+                            process_set: Optional[ProcessSet] = None,
+                            priorities: Optional[Sequence[int]] = None,
+                            sharded: bool = False) -> List[int]:
+    """Reference: ``hvd.grouped_allgather`` (upstream v0.28).
+
+    ``sharded=True`` marks the group as part of a ZeRO-sharded program
+    (the allgather leg of reduce-scatter → shard update → allgather): the
+    flag rides the fusion key AND the negotiation digest, so a sharded
+    program can never cross-serve an unsharded collective of the same
+    shapes (and divergence of the flag across ranks fails negotiation
+    fast instead of executing mismatched programs)."""
     return _grouped_async(tensors, name, "grouped_allgather",
-                          CollectiveType.ALLGATHER, process_set)
+                          CollectiveType.ALLGATHER, process_set,
+                          priorities=priorities, sharded=sharded)
 
 
 def grouped_allgather(tensors: Sequence, name: Optional[str] = None,
-                      process_set: Optional[ProcessSet] = None):
-    handles = grouped_allgather_async(tensors, name, process_set)
+                      process_set: Optional[ProcessSet] = None,
+                      priorities: Optional[Sequence[int]] = None,
+                      sharded: bool = False):
+    handles = grouped_allgather_async(tensors, name, process_set,
+                                      priorities, sharded)
     _engine().kick()
     return [synchronize(h) for h in handles]
 
@@ -391,18 +416,24 @@ def grouped_allgather(tensors: Sequence, name: Optional[str] = None,
 def grouped_reducescatter_async(tensors: Sequence,
                                 name: Optional[str] = None,
                                 op: C.ReduceOp = C.ReduceOp.SUM,
-                                process_set: Optional[ProcessSet] = None
-                                ) -> List[int]:
-    """Reference: ``hvd.grouped_reducescatter`` (upstream v0.28)."""
+                                process_set: Optional[ProcessSet] = None,
+                                priorities: Optional[Sequence[int]] = None,
+                                sharded: bool = False) -> List[int]:
+    """Reference: ``hvd.grouped_reducescatter`` (upstream v0.28).  See
+    :func:`grouped_allgather_async` for ``priorities``/``sharded``."""
     return _grouped_async(tensors, name, "grouped_reducescatter",
                           CollectiveType.REDUCESCATTER, process_set,
-                          reduce_op=op)
+                          reduce_op=op, priorities=priorities,
+                          sharded=sharded)
 
 
 def grouped_reducescatter(tensors: Sequence, name: Optional[str] = None,
                           op: C.ReduceOp = C.ReduceOp.SUM,
-                          process_set: Optional[ProcessSet] = None):
-    handles = grouped_reducescatter_async(tensors, name, op, process_set)
+                          process_set: Optional[ProcessSet] = None,
+                          priorities: Optional[Sequence[int]] = None,
+                          sharded: bool = False):
+    handles = grouped_reducescatter_async(tensors, name, op, process_set,
+                                          priorities, sharded)
     _engine().kick()
     return [synchronize(h) for h in handles]
 

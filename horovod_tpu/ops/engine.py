@@ -84,6 +84,13 @@ class TensorTableEntry:
     # the XLA way).  Reduction ops only; part of the fusion key AND the
     # negotiation digest (divergence would execute mismatched programs).
     compression: Optional[str] = None
+    # ZeRO-sharded data plane (ISSUE 15): True for the reduce-scatter /
+    # allgather legs of a sharded optimizer program.  Part of the fusion
+    # key AND the negotiation digest: a compiled sharded program can never
+    # cross-serve an ordinary collective of the same shapes, and a rank
+    # whose sharded= flag diverges from its peers fails negotiation with
+    # attribution instead of executing a mismatched program.
+    sharded: bool = False
     # Drain priority (higher drains first; default 0 = FIFO).  Stamped by
     # the DistributedOptimizer bindings with reverse-registration order so
     # first-needed gradients lead each cycle (ByteScheduler-style priority
@@ -136,6 +143,7 @@ def _fusion_key(e: TensorTableEntry) -> Tuple:
     """
     return (e.ctype, e.reduce_op, e.root_rank, e.process_set_id,
             e.prescale_factor, e.postscale_factor, e.compression,
+            e.sharded,
             e.partition[2] if e.partition is not None else 0)
 
 
@@ -543,13 +551,13 @@ class CollectiveEngine:
                 process_set_id: int = 0, prescale_factor=None,
                 postscale_factor=None, group_id: int = -1,
                 donate: bool = False, compression: Optional[str] = None,
-                priority: int = 0) -> int:
+                priority: int = 0, sharded: bool = False) -> int:
         return self.enqueue_group([dict(
             name=name, ctype=ctype, tensor=tensor, reduce_op=reduce_op,
             root_rank=root_rank, process_set_id=process_set_id,
             prescale_factor=prescale_factor, postscale_factor=postscale_factor,
             group_id=group_id, donate=donate, compression=compression,
-            priority=priority)])[0]
+            priority=priority, sharded=sharded)])[0]
 
     def enqueue_group(self, items: Sequence[dict]) -> List[int]:
         """Enqueue several entries atomically w.r.t. the drain — a cycle
@@ -1448,6 +1456,11 @@ class CollectiveEngine:
             # server may append the sanitizer tag after it — trailing
             # parts stay ignored as before.
             comp = parts[7]
+        # ZeRO-sharded digest dimension (appended ONLY for sharded ops, so
+        # flat digests are byte-identical to the pre-sharding protocol):
+        # the synthesized entry must carry the flag or its fusion key —
+        # and therefore its fused program — would diverge from the peers'.
+        sharded = len(parts) > 8 and parts[8] == "sharded"
         ps = self._state.process_set_table.get(0)
         sharding = NamedSharding(ps.mesh, P(ps.axis_name))
         local_devs = [d for d in ps.mesh.devices.flat
@@ -1461,7 +1474,7 @@ class CollectiveEngine:
             handle=handle, name=name, ctype=ctype, tensor=arr, reduce_op=op,
             root_rank=root, prescale_factor=pre, postscale_factor=post,
             group_id=group_id, donate=True, compression=comp,
-            enqueue_time=now)
+            sharded=sharded, enqueue_time=now)
         e.trace_synthesized = True
         if self.sanitizer is not None:
             self.sanitizer.observe_synthesized(e)

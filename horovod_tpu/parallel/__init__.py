@@ -16,4 +16,10 @@ from .adasum import (  # noqa: F401
     adasum_allreduce, adasum_allreduce_hd, adasum_combine, torus_bit_order,
 )
 from .hierarchical import hierarchical_allreduce  # noqa: F401
-from .zero import sharded_optimizer  # noqa: F401
+from .mesh import (  # noqa: F401
+    process_set_mesh, process_set_sharding, process_set_spec,
+)
+from .zero import (  # noqa: F401
+    init_sharded_state, shard_info, shard_slice_host, sharded_optimizer,
+    state_specs,
+)

@@ -21,11 +21,66 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..common.topology import ordered_devices
 
 DP, TP, SP, EP, PP = "dp", "tp", "sp", "ep", "pp"
+
+
+# ---------------------------------------------------------------------------
+# hvd process-set <-> jax.sharding mesh interop (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def process_set_mesh(process_set=None,
+                     axis_name: Optional[str] = None) -> Mesh:
+    """The ``jax.sharding.Mesh`` spanned by an hvd process set.
+
+    The translation layer that lets ``shard_map``-partitioned step
+    functions compose with the eager engine: the SAME devices, in the
+    SAME (negotiated) rank order, under a caller-chosen axis name — so a
+    ``lax.psum`` over this mesh reduces over exactly the ranks an eager
+    ``hvd.allreduce(process_set=...)`` would, and a sharded optimizer's
+    1/N shard layout matches the engine's reduce-scatter slices.
+
+    ``process_set=None`` is the global world.  ``axis_name=None`` keeps
+    the set's own axis name (``"hvd"`` for the world); passing e.g.
+    ``"dp"`` relabels the axis for reuse with the ``parallel`` helpers
+    (same devices, same order — only the label changes).
+    """
+    from ..common import basics
+    st = basics._get_state()
+    ps_id = 0 if process_set is None or process_set.process_set_id is None \
+        else process_set.process_set_id
+    ps = st.process_set_table.get(ps_id)
+    m = ps.mesh
+    if axis_name is None or (axis_name,) == tuple(m.axis_names):
+        return m
+    return Mesh(np.asarray(m.devices), (axis_name,))
+
+
+def process_set_spec(process_set=None,
+                     axis_name: Optional[str] = None) -> PartitionSpec:
+    """``PartitionSpec`` sharding dim 0 over the process set's axis — the
+    spec of a stacked per-rank ``[world, *S]`` engine tensor on
+    :func:`process_set_mesh`."""
+    if axis_name is not None:
+        return PartitionSpec(axis_name)
+    from ..common import basics
+    st = basics._get_state()
+    ps_id = 0 if process_set is None or process_set.process_set_id is None \
+        else process_set.process_set_id
+    return PartitionSpec(st.process_set_table.get(ps_id).axis_name)
+
+
+def process_set_sharding(process_set=None,
+                         axis_name: Optional[str] = None) -> NamedSharding:
+    """``NamedSharding`` for stacked per-rank tensors of a process set —
+    hand this to ``jax.device_put``/``jax.jit`` in/out shardings so
+    arrays flow between a partitioned step function and the eager engine
+    without resharding copies."""
+    return NamedSharding(process_set_mesh(process_set, axis_name),
+                         process_set_spec(process_set, axis_name))
 
 
 def make_mesh(axis_sizes: Dict[str, int],

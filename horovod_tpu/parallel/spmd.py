@@ -73,6 +73,16 @@ def make_sharded_train_step(step_fn: Callable, mesh: Mesh,
     hook: unknown axes, bad ``axis_index_groups``, non-bijective ppermute
     perms and host callbacks are caught before the program ever reaches a
     pod, where they would deadlock instead of erroring.
+
+    **Sharded (ZeRO) optimizer states** (ISSUE 15): a step built around
+    ``parallel.zero.sharded_optimizer`` holds 1/world of the optimizer
+    state per device — its leaves are rank-DISTINCT, so ``opt_state_specs``
+    must shard them over the dp axis, never replicate.  Build both the
+    state and its spec tree with ``parallel.zero.init_sharded_state``
+    (or derive specs from an existing state with
+    ``parallel.zero.state_specs``) and pass the specs here; ``P()``-style
+    replication of a sharded state is undefined behavior (each device
+    holds a different shard).
     """
     sharded = shard_map(
         step_fn, mesh=mesh,

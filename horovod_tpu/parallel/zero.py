@@ -11,18 +11,65 @@ stage 2):
           --allgather(dp)--> full updates
 
 Wire cost per step equals plain allreduce (RS + AG), while optimizer-state
-memory drops by ``dp``.  Use inside shard_map over the dp axis.
+memory drops by ``dp``.  Use inside shard_map over the dp axis — or, for
+the eager multi-process path, through
+``hvd.DistributedOptimizer(..., sharded=True)`` which routes the same
+pad+slice convention through the collective engine's reduce-scatter /
+allgather pipeline (``jax/optimizer.py``).
+
+**The pad+slice convention** (shared by every sharded consumer — this
+module, the eager sharded optimizer, and the state plane's byte sharding in
+``elastic/stateplane.py``): a leaf of ``n`` elements is flattened, padded
+with zeros to the next multiple of ``world`` and sliced into ``world``
+even shards of ``(n + pad) // world`` elements; rank ``r`` owns elements
+``[r*per, (r+1)*per)`` of the padded buffer.  ``shard_info`` is the one
+pure function every rank derives identical boundaries from.
 """
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
+from typing import Any, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
 from jax import lax
 from ..compat import axis_size as compat_axis_size
+
+
+def shard_info(n: int, world: int) -> Tuple[int, int]:
+    """``(pad, per)`` of the pad+slice convention: a flattened leaf of
+    ``n`` elements pads with ``pad`` zeros and splits into ``world`` even
+    shards of ``per`` elements.  Pure math (no jax) — rank-invariant by
+    construction, and the same convention ``elastic/stateplane.py``
+    applies to checkpoint bytes (``shard_bounds``)."""
+    world = max(1, int(world))
+    n = int(n)
+    pad = (-n) % world
+    return pad, (n + pad) // world
+
+
+def shard_slice_host(arr, rank: int, world: int):
+    """Rank ``rank``'s 1/world shard of a host array under the pad+slice
+    convention (numpy, flattened).  The host-side twin of
+    :func:`_shard_leaf` — the eager sharded optimizer slices its initial
+    state with it, and the elastic restore path re-slices a recovered
+    full optimizer state into the joining rank's shard."""
+    import numpy as np
+    flat = np.asarray(arr).reshape(-1)
+    pad, per = shard_info(flat.shape[0], world)
+    if pad:
+        flat = np.concatenate([flat, np.zeros((pad,), flat.dtype)])
+    return flat[rank * per:(rank + 1) * per]
+
+
+def unshard_host(shards, n: int, shape, dtype=None):
+    """Reassemble a leaf from its per-rank host shards (inverse of
+    :func:`shard_slice_host`): concatenate, drop the pad, reshape."""
+    import numpy as np
+    flat = np.concatenate([np.asarray(s).reshape(-1) for s in shards])[:n]
+    out = flat.reshape(shape)
+    return out.astype(dtype) if dtype is not None else out
 
 
 class _ZeroState(NamedTuple):
@@ -33,29 +80,119 @@ class _ZeroState(NamedTuple):
 def _shard_leaf(g, axis_name):
     n = compat_axis_size(axis_name)
     flat = g.reshape(-1)
-    pad = (-flat.shape[0]) % n
+    if flat.shape[0] == 0:
+        # Empty leaf: every rank's shard is the empty array — running a
+        # zero-length psum_scatter would be pointless (and some backends
+        # reject it outright).
+        return flat, 0
+    pad, _per = shard_info(flat.shape[0], n)
     if pad:
         flat = jnp.pad(flat, (0, pad))
+    if n == 1:
+        # world of 1: the shard IS the whole (padded) leaf; psum_scatter
+        # over a 1-sized axis is the identity, skip the collective.
+        return flat, pad
     return lax.psum_scatter(flat, axis_name, tiled=True), pad
 
 
+def _slice_leaf(p, axis_name):
+    """This rank's 1/world slice of a REPLICATED leaf — pad+slice via
+    ``axis_index``, NO reduction.  The in-graph twin of the eager path's
+    ``_device_shard``.  Params must come through here, never
+    :func:`_shard_leaf`: psum_scatter of a replicated leaf returns the
+    slice of the SUM over ranks (world × the value), which would hand
+    param-dependent inner transforms (adamw weight decay) world-scaled
+    parameters."""
+    n = compat_axis_size(axis_name)
+    flat = p.reshape(-1)
+    if flat.shape[0] == 0:
+        return flat
+    pad, per = shard_info(flat.shape[0], n)
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    if n == 1:
+        return flat
+    r = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(flat, r * per, per)
+
+
 def _unshard_leaf(u, pad, shape, axis_name):
-    full = lax.all_gather(u, axis_name, tiled=True)
+    n = compat_axis_size(axis_name)
+    if u.shape[0] == 0:
+        return jnp.zeros(shape, u.dtype) if 0 not in shape else \
+            u.reshape(shape)
+    full = lax.all_gather(u, axis_name, tiled=True) if n > 1 else u
     if pad:
         full = full[:-pad]
     return full.reshape(shape)
 
 
+def state_specs(opt_state, axis_name: str = "dp"):
+    """``PartitionSpec`` tree for a sharded-optimizer state: every array
+    leaf is a distinct 1/world shard over ``axis_name`` (flattened, dim
+    0); scalar leaves (step counters) are replicated.  Feed this as the
+    ``opt_state_specs`` of ``parallel/spmd.make_sharded_train_step``."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec(leaf):
+        return P(axis_name) if getattr(leaf, "ndim", 0) >= 1 else P()
+
+    return jax.tree_util.tree_map(spec, opt_state)
+
+
+def init_sharded_state(inner: optax.GradientTransformation, params,
+                       mesh, axis_name: str = "dp"):
+    """Initialize a sharded optimizer state ON the mesh: returns
+    ``(opt_state, opt_state_specs)`` where every array leaf is the global
+    ``[world * per]`` array sharded ``P(axis_name)`` — 1/world per device
+    in HBM, ready to feed a ``make_sharded_train_step`` whose step uses
+    :func:`sharded_optimizer`.
+
+    Two passes: the state *structure* comes from an abstract
+    ``eval_shape`` over host-computed shard shapes (the pad+slice
+    convention is pure math, so no device executes anything), which
+    yields the spec tree; the real init then runs under ``shard_map``
+    with those out_specs.
+    """
+    from ..compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    world = mesh.shape[axis_name]
+    opt = sharded_optimizer(inner, axis_name=axis_name)
+
+    # Pass 1: structure/specs from abstract shard shapes.
+    def shard_struct(p):
+        _pad, per = shard_info(int(p.size), world)
+        return jax.ShapeDtypeStruct((per,), p.dtype)
+
+    shard_shapes = jax.tree_util.tree_map(shard_struct, params)
+    abstract = jax.eval_shape(
+        lambda ps: _ZeroState(inner.init(ps), ()), shard_shapes)
+    specs = state_specs(abstract, axis_name)
+
+    # Pass 2: the real init under shard_map (each device slices its own
+    # shard of the padded flat leaves — no reduction).
+    init = shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                     out_specs=specs, check_vma=False)
+    return jax.jit(init)(params), specs
+
+
 def sharded_optimizer(inner: optax.GradientTransformation,
                       axis_name: str = "dp",
                       average: bool = True) -> optax.GradientTransformation:
-    """Wrap an optax optimizer so its state is sharded over ``axis_name``."""
+    """Wrap an optax optimizer so its state is sharded over ``axis_name``.
+
+    Per-shard semantics caveat (documented ZeRO behavior): the inner
+    transformation sees only this rank's 1/world shard of each leaf, so
+    elementwise optimizers (sgd/adam/adamw/...) are exact, while
+    transforms that aggregate across the whole tree (global-norm
+    clipping) aggregate per shard instead — compose those *outside* the
+    sharded wrapper if global semantics are required.
+    """
 
     def init_fn(params):
-        def shard_param(p):
-            s, _ = _shard_leaf(p, axis_name)
-            return s
-        sharded_params = jax.tree_util.tree_map(shard_param, params)
+        sharded_params = jax.tree_util.tree_map(
+            lambda p: _slice_leaf(p, axis_name), params)
         return _ZeroState(inner.init(sharded_params), ())
 
     def update_fn(grads, state: _ZeroState, params=None):
@@ -66,13 +203,17 @@ def sharded_optimizer(inner: optax.GradientTransformation,
         g_shards = [s for s, _ in shard_pairs]
         pads = [p for _, p in shard_pairs]
         if average:
-            g_shards = [g / jnp.asarray(n, g.dtype) for g in g_shards]
+            # Same AVERAGE semantics as the allreduce path: true division
+            # for floats, floor division for ints.
+            g_shards = [g / jnp.asarray(n, g.dtype)
+                        if jnp.issubdtype(g.dtype, jnp.floating) else g // n
+                        for g in g_shards]
         g_shards = jax.tree_util.tree_unflatten(treedef, g_shards)
         p_shards = None
         if params is not None:
             p_leaves = jax.tree_util.tree_flatten(params)[0]
             p_shards = jax.tree_util.tree_unflatten(
-                treedef, [_shard_leaf(p, axis_name)[0] for p in p_leaves])
+                treedef, [_slice_leaf(p, axis_name) for p in p_leaves])
         u_shards, inner_state = inner.update(g_shards, state.inner_state,
                                              p_shards)
         u_leaves = jax.tree_util.tree_flatten(u_shards)[0]

@@ -152,6 +152,14 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "(exponential, jittered)")
     p.add_argument("--autotune", action="store_true")
     p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--sharded", action="store_true",
+                   help="ZeRO-sharded optimizer data plane (docs/"
+                        "performance.md 'Sharded optimizer (ZeRO)'): "
+                        "DistributedOptimizer defaults to sharded=True — "
+                        "reduce-scatter of gradients, 1/N-per-rank "
+                        "optimizer state, allgather of updates.  "
+                        "Forwarded as HOROVOD_SHARDED_OPTIMIZER so every "
+                        "rank takes the identical data plane")
     p.add_argument("--hierarchical-allreduce", action="store_true")
     p.add_argument("--hierarchical-controller", action="store_true",
                    help="Two-level control plane (docs/performance.md "
@@ -387,6 +395,8 @@ def tuning_env(args) -> Dict[str, str]:
         env["HOROVOD_AUTOTUNE"] = "1"
         if getattr(args, "autotune_log_file", None):
             env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
+    if getattr(args, "sharded", False):
+        env["HOROVOD_SHARDED_OPTIMIZER"] = "1"
     if getattr(args, "hierarchical_allreduce", False):
         env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
     if getattr(args, "hierarchical_controller", False):

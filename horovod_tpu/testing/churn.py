@@ -305,11 +305,24 @@ class ChurnRunner:
     # --------------------------------------------------------- state plane
     def _synthetic_state(self, epoch: int) -> dict:
         """Deterministic per-epoch state every live rank holds identically
-        (the bitwise-restore assertion compares against exactly this)."""
+        (the bitwise-restore assertion compares against exactly this).
+
+        Includes a sharded-optimizer saveable (ISSUE 15) in the exact
+        rank-invariant marker form ``JaxState.save`` emits for a
+        ``DistributedOptimizer(sharded=True)`` state, so rejoin_restore
+        also proves a re-joiner re-slices exactly its own 1/N optimizer
+        shard from the recovered commit."""
         import numpy as np
         return {"step": epoch,
                 "params": (np.arange(512, dtype=np.float32)
-                           * float(epoch))}
+                           * float(epoch)),
+                "opt": {"__hvd_sharded_opt__": 1, "world": self.world,
+                        "plan": {},
+                        "inner_states": [
+                            {"mu": np.arange(self.world * 64,
+                                             dtype=np.float32)
+                             + float(epoch),
+                             "count": np.int32(epoch)}]}}
 
     def _state_setup(self) -> None:
         import tempfile
@@ -366,10 +379,26 @@ class ChurnRunner:
                  if p is not None and i != rank and p.server is not None
                  and i not in self._state_left and i not in self._dead]
         try:
-            _data, epoch, source = plane.restore(peers=peers)
+            data, epoch, source = plane.restore(peers=peers)
             rec = {"restore_source": source, "restore_epoch": epoch,
                    "disk_reads": plane.disk_reads,
                    "peer_shards": plane.peer_shards_fetched}
+            # Shard-native optimizer restore (ISSUE 15): the recovered
+            # sharded-optimizer saveable must yield exactly this rank's
+            # own 1/N slice under the pad+slice convention.
+            opt = data.get("opt") if isinstance(data, dict) else None
+            if isinstance(opt, dict) and opt.get("__hvd_sharded_opt__"):
+                import numpy as np
+
+                from ..elastic.stateplane import shard_slice_array
+                full = np.asarray(opt["inner_states"][0]["mu"])
+                got = shard_slice_array(full, rank, int(opt["world"]))
+                want = np.arange(self.world * 64, dtype=np.float32)
+                want = want + float(epoch)
+                per = want.size // int(opt["world"])
+                rec["opt_shard_ok"] = bool(
+                    np.array_equal(got, want[rank * per:(rank + 1) * per]))
+                rec["opt_shard_len"] = int(got.size)
         except FileNotFoundError as exc:
             rec = {"restore_source": None, "restore_error": str(exc)}
         else:

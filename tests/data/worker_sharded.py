@@ -1,0 +1,155 @@
+"""ZeRO-sharded data-plane worker (ISSUE 15 acceptance): the full
+``DistributedOptimizer(sharded=True)`` pipeline across REAL processes —
+per-bucket reduce-scatter of fused gradients, the inner optax update on
+this rank's 1/N shard only, allgather of the updated deltas.
+
+Proves, end to end through negotiate → fuse → execute:
+
+- parameters after 10 steps on the same gradient stream are BITWISE
+  identical to the replicated ``sharded=False`` path (2 ranks: one
+  floating add per element, so reduction order cannot drift — the
+  documented caveat only bites at wider worlds);
+- optimizer-state bytes on this rank scale ~1/world (adam's mu+nu live
+  only for the shard; the replicated path holds the full tree);
+- pad+slice edges ride along: a non-divisible leaf, a scalar leaf and a
+  bf16 leaf are all in the tree;
+- the sharded ops carry their own fusion-key/digest dimension (the
+  compiled reduce-scatter program count is additive, never cross-served),
+  and the steady-state warm path still rides the pinned ~13B bitvector
+  frame (no per-tensor metadata re-announces, request bytes flat);
+- the scatter → update → gather pipeline buckets engage when
+  HOROVOD_PIPELINE_CHUNK is set (more than one bucket's worth of RS/AG
+  groups per step) with results unchanged.
+
+Launched by test_multiprocess.py::test_torovodrun_sharded_optimizer with
+``torovodrun -np 2`` — flat AND --hierarchical-controller.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+
+STEPS = 10
+
+
+def make_params():
+    """Mixed tree: non-divisible (257 % 2 != 0), scalar, bf16 — the
+    pad+slice edge cases ride the acceptance run itself."""
+    return {
+        "w1": jnp.asarray(np.linspace(-1.0, 1.0, 257), jnp.float32),
+        "w2": jnp.asarray(np.linspace(0.5, -0.5, 128).reshape(16, 8),
+                          jnp.float32),
+        "scalar": jnp.asarray(0.25, jnp.float32),
+        "half": jnp.asarray(np.linspace(-2.0, 2.0, 66), jnp.bfloat16),
+    }
+
+
+def grad_stream(step, rank):
+    """Deterministic per-rank gradient stream — both paths replay it."""
+    rng = np.random.RandomState(1000 * (rank + 1) + step)
+    return {
+        "w1": jnp.asarray(rng.randn(257), jnp.float32),
+        "w2": jnp.asarray(rng.randn(16, 8), jnp.float32),
+        "scalar": jnp.asarray(rng.randn(), jnp.float32),
+        "half": jnp.asarray(rng.randn(66), jnp.bfloat16),
+    }
+
+
+def train(opt, rank, steps=STEPS):
+    params = make_params()
+    state = opt.init(params)
+    for s in range(steps):
+        grads = grad_stream(s, rank)
+        updates, state = opt.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+    return jax.device_get(params), state
+
+
+def opt_state_bytes(state):
+    from horovod_tpu.jax.optimizer import ShardedOptimizerState
+    if isinstance(state, ShardedOptimizerState):
+        return state.opt_state_bytes()
+    return sum(int(l.nbytes) for l in jax.tree_util.tree_leaves(state)
+               if hasattr(l, "nbytes"))
+
+
+def main():
+    hvd.init()
+    rank, world = hvd.rank(), hvd.size()
+    eng = basics._get_state().engine
+    ctl = eng.controller
+    assert ctl is not None, "worker needs the torovodrun controller"
+    st = ctl.cache_stats
+
+    inner = optax.adam(1e-2)
+
+    # ---- replicated baseline --------------------------------------------
+    p_rep, s_rep = train(hvd.DistributedOptimizer(inner, sharded=False),
+                         rank)
+    rep_bytes = opt_state_bytes(s_rep.inner_state)
+
+    # ---- sharded path: bitwise parity + 1/N state ------------------------
+    rs_misses0 = eng.cache.misses
+    p_sh, s_sh = train(hvd.DistributedOptimizer(inner, sharded=True), rank)
+    for k in sorted(p_rep):
+        np.testing.assert_array_equal(p_rep[k], p_sh[k])   # BITWISE
+    sh_bytes = opt_state_bytes(s_sh)
+    # mu+nu shard ≈ replicated/world; padding adds at most world-1 elems
+    # per leaf per moment, count scalars are replicated.
+    n_leaves = len(p_rep)
+    slack = 2 * n_leaves * world * 8 + 64 * n_leaves
+    assert sh_bytes <= rep_bytes / world + slack, (sh_bytes, rep_bytes)
+    assert eng.cache.misses > rs_misses0, \
+        "sharded programs never compiled (did the RS/AG legs run?)"
+
+    # ---- steady-state warm path: frames stay the pinned bitvector -------
+    opt = hvd.DistributedOptimizer(inner, sharded=True)
+    params = make_params()
+    state = opt.init(params)
+    for s in range(3):                       # warm-up: learn slots
+        updates, state = opt.update(grad_stream(s, rank), state, params)
+        params = optax.apply_updates(params, updates)
+    full_before = st.full_announces
+    bytes_before = ctl.bytes_sent
+    rounds_before = ctl.rounds
+    for s in range(5):
+        updates, state = opt.update(grad_stream(10 + s, rank), state,
+                                    params)
+        params = optax.apply_updates(params, updates)
+    assert st.full_announces == full_before, (
+        f"sharded steady state sent per-tensor metadata: "
+        f"{st.full_announces - full_before} full announces")
+    per_round = (ctl.bytes_sent - bytes_before) \
+        / max(1, ctl.rounds - rounds_before)
+    assert per_round <= 32, (
+        f"sharded warm-path request grew to {per_round}B/round")
+
+    # ---- chunked pipeline: >1 bucket, results unchanged ------------------
+    eng.pipeline_chunk_bytes = 512            # w1 alone exceeds one bucket
+    opt2 = hvd.DistributedOptimizer(inner, sharded=True)
+    p2, s2 = train(opt2, rank)
+    assert len(s2.plan.buckets) > 1, s2.plan.buckets
+    for k in sorted(p_rep):
+        np.testing.assert_array_equal(p_rep[k], p2[k])
+    eng.pipeline_chunk_bytes = 0
+
+    hvd.barrier()
+    print(f"SHARDED_OK rank={rank} state_bytes={sh_bytes} "
+          f"rep_bytes={rep_bytes} per_round={per_round:.1f}", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

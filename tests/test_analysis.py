@@ -279,6 +279,44 @@ def test_hvd107_quiet_on_in_graph_default_axis():
     assert "HVD107" not in rules_of(findings)
 
 
+# ---------------------------------------------------------------- HVD110
+def test_hvd110_fires_on_rank_derived_sharded_flag():
+    findings = lint("""
+        import horovod_tpu as hvd
+        import optax
+
+        opt = hvd.DistributedOptimizer(optax.adam(1e-3),
+                                       sharded=hvd.rank() == 0)
+    """)
+    assert "HVD110" in rules_of(findings)
+    f = next(x for x in findings if x.rule == "HVD110")
+    assert f.is_error and "rank identity" in f.message
+
+
+def test_hvd110_fires_via_tainted_shard_count():
+    findings = lint("""
+        import horovod_tpu as hvd
+
+        def scatter(x):
+            n = hvd.local_rank()
+            return hvd.grouped_reducescatter([x], num_shards=n + 1)
+    """)
+    assert "HVD110" in rules_of(findings)
+
+
+def test_hvd110_quiet_on_constant_and_env_flags():
+    findings = lint("""
+        import os
+        import horovod_tpu as hvd
+        import optax
+
+        opt = hvd.DistributedOptimizer(optax.adam(1e-3), sharded=True)
+        flag = bool(int(os.environ.get("HOROVOD_SHARDED_OPTIMIZER", "0")))
+        opt2 = hvd.DistributedOptimizer(optax.adam(1e-3), sharded=flag)
+    """)
+    assert "HVD110" not in rules_of(findings)
+
+
 # ---------------------------------------------------------------- misc lint
 def test_lint_source_handles_syntax_error():
     findings = lint_source("def broken(:\n", "bad.py")
@@ -288,9 +326,10 @@ def test_lint_source_handles_syntax_error():
 def test_rule_catalog_ids_and_severities():
     # ≥ 6 distinct lint rule classes, each with catalog metadata.
     lint_ids = {"HVD101", "HVD102", "HVD103", "HVD104", "HVD105",
-                "HVD106", "HVD107"}
+                "HVD106", "HVD107", "HVD110"}
     assert lint_ids <= set(RULES)
     assert RULES["HVD101"].severity is Severity.ERROR
+    assert RULES["HVD110"].severity is Severity.ERROR
     assert RULES["HVD105"].severity is Severity.WARNING
     assert summarize([Finding("HVD101", "f.py", 1, 1, "m")]).startswith("1 ")
 

@@ -198,6 +198,53 @@ def test_parameter_manager_pipeline_coordinates(tmp_path):
     assert "round_pipeline" in header and "spec_ready_after" not in header
 
 
+def test_parameter_manager_checkpoint_lane_coordinates(tmp_path):
+    """ISSUE 15 (the ISSUE 14 carry-over): with the state plane armed the
+    search gains the checkpoint-lane pair — shard-chunk bytes and the
+    per-cycle lane budget.  Gated on the plane (no dead knobs without a
+    durability stream), moves land on stateplane.chunk_bytes /
+    engine.ckpt_lane_budget within bounds, and the log carries the
+    columns.  Controller-less engine: the gradient-side pipeline
+    coordinates stay off, so the payload is [thr, cyc, chunk, budget,
+    done]."""
+
+    class FakePlane:
+        chunk_bytes = 1 << 20
+
+    eng = FakeEngine(thr=1 << 20, cyc=0.001)
+    eng.stateplane = FakePlane()
+    eng.ckpt_lane_budget = 2
+    clock = FakeClock()
+    bc, poll, sent = _loopback_transport()
+    log = tmp_path / "autotune_ckpt.csv"
+    pm = ParameterManager(eng, warmup_samples=0, steps_per_sample=1,
+                          log_path=str(log), clock=clock,
+                          broadcaster=bc, poller=poll, max_evals=10)
+    assert pm._tune_ckpt
+    assert not pm._tune_pipeline and not pm._tune_cache
+    assert len(pm.search.point) == 4
+    for _ in range(40):
+        if not pm.tuning:
+            break
+        _drive_sample(pm, clock, 1 << 20, 0.01)
+    assert sent and all(len(p) == 5 for p in sent), [len(p) for p in sent]
+    assert (1 << 16) <= eng.stateplane.chunk_bytes <= (1 << 26)
+    assert 1 <= eng.ckpt_lane_budget <= 8
+    header = log.read_text().splitlines()[0]
+    assert "ckpt_chunk_bytes" in header and "ckpt_lane_budget" in header
+    assert not pm.tuning or pm.search.evals <= 10
+
+
+def test_parameter_manager_no_ckpt_coordinates_without_plane():
+    """No state plane armed: the checkpoint pair must NOT enter the
+    search (a dead coordinate would burn a third of the eval budget)."""
+    eng = FakeEngine()
+    pm = ParameterManager(eng, warmup_samples=0, steps_per_sample=1,
+                          clock=FakeClock())
+    assert not pm._tune_ckpt
+    assert len(pm.search.point) == 2
+
+
 def test_parameter_manager_zero_rtt_coordinates(tmp_path):
     """ISSUE 11: with speculation armed (spec_ready_after > 0) the search
     gains BOTH zero-RTT coordinates (8-point search, 9-float payload);

@@ -157,6 +157,10 @@ def test_churn_rejoin_restore_records_peer_source(tmp_path):
     # exactly that newest epoch, shard-by-shard from the live peers.
     assert restore["restore_epoch"] == rep["state_epoch"] == 2, rep
     assert restore["peer_shards"] >= 1, restore
+    # Shard-native optimizer restore (ISSUE 15): the recovered sharded-
+    # optimizer saveable re-slices to exactly the rejoiner's 1/N shard.
+    assert restore["opt_shard_ok"] is True, restore
+    assert restore["opt_shard_len"] == 64, restore
     ev = next(e for e in rep["events_fired"]
               if e["verb"] == "rejoin_restore")
     assert ev["restore_source"] == "peer", ev

@@ -97,6 +97,24 @@ def test_worker_envs_hierarchical_controller():
     assert all(e["HOROVOD_HIERARCHICAL_CONTROLLER"] == "1" for e in envs)
 
 
+def test_sharded_flag_forwards_fleet_uniform_env(monkeypatch):
+    """ISSUE 15 launch path: --sharded forwards HOROVOD_SHARDED_OPTIMIZER=1
+    through tuning_env to EVERY rank (the flag rides the negotiation
+    digest — per-rank divergence is exactly the HVD110 bug), and the env
+    round-trips into Config where DistributedOptimizer reads its
+    default."""
+    from horovod_tpu.common.config import Config
+    from horovod_tpu.runner.run import tuning_env
+    args = parse_args(["-np", "2", "--sharded", "python", "t.py"])
+    assert tuning_env(args)["HOROVOD_SHARDED_OPTIMIZER"] == "1"
+    args = parse_args(["-np", "2", "python", "t.py"])
+    assert "HOROVOD_SHARDED_OPTIMIZER" not in tuning_env(args)
+    monkeypatch.setenv("HOROVOD_SHARDED_OPTIMIZER", "1")
+    assert Config.from_env().sharded_optimizer is True
+    monkeypatch.delenv("HOROVOD_SHARDED_OPTIMIZER")
+    assert Config.from_env().sharded_optimizer is False
+
+
 def test_platform_worker_env_cpu_hygiene():
     """CPU launches get gloo collectives + a single-device XLA_FLAGS injected
     by the LAUNCHER, so user scripts need no platform preamble; TPU launches

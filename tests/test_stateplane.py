@@ -631,3 +631,101 @@ def test_write_job_manifest_survives_plane_rebind(tmp_path):
     assert manifests is not None, "re-bound manifest rejected"
     assert (manifests[0]["rank"], manifests[0]["world"]) == (0, 1)
     assert p.durable_epoch == 3
+
+
+# ------------------------------------------- JaxState peer restore (ISSUE 15)
+def _sharded_saveable(world: int, base: float = 7.0):
+    """A rank-invariant sharded-optimizer saveable in exactly the form
+    ``JaxState.save`` emits for a DistributedOptimizer(sharded=True)
+    state: gathered flat moment arrays + a real shard plan."""
+    import jax.numpy as jnp
+    from horovod_tpu.jax.optimizer import _make_shard_plan
+    n = 10                                    # non-divisible by world=4
+    plan = _make_shard_plan([jnp.zeros((n,), jnp.float32)], world, 0, 0)
+    pad = plan.pads[0]
+    mu = np.concatenate([np.arange(n, dtype=np.float32) + base,
+                         np.zeros(pad, np.float32)])
+    return {"__hvd_sharded_opt__": 1, "world": world,
+            "plan": plan._replace(rank=-1)._asdict(),
+            "inner_states": [{"mu": mu, "count": np.int32(3)}]}, plan
+
+
+def test_jaxstate_load_recovered_reslices_own_shard(tmp_path, monkeypatch):
+    """The REAL jax path through the peer shard fetch: a committed state
+    holding a sharded-optimizer saveable round-trips the plane, and the
+    joining rank's JaxState.load_recovered puts tree leaves back on
+    device AND re-slices exactly its own 1/N optimizer shard (never the
+    gathered whole)."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.common import basics
+    from horovod_tpu.elastic.state import JaxState
+    from horovod_tpu.jax.optimizer import ShardedOptimizerState
+
+    world, my_rank = 4, 2
+    saveable, plan = _sharded_saveable(world)
+    committed = {"step": 9,
+                 "params": {"w": np.arange(6, dtype=np.float32) * 3.0},
+                 "opt": saveable}
+
+    # Round-trip the real plane: donors commit, a fresh joiner restores.
+    donors = [spl.StatePlane(str(tmp_path), rank=r, world=world, serve=True)
+              for r in range(world)]
+    try:
+        for p in donors:
+            p.commit(state=committed, epoch=2)
+        joiner = spl.StatePlane(str(tmp_path) + ".j", rank=my_rank,
+                                world=world, serve=False)
+        data, epoch, source = joiner.restore(
+            peers=[("127.0.0.1", p.server.port) for p in donors])
+        assert (epoch, source) == (2, "peer")
+    finally:
+        for p in donors:
+            p.close()
+
+    monkeypatch.setattr(basics, "rank", lambda: my_rank)
+    monkeypatch.setattr(basics, "size", lambda: world)
+    state = JaxState(params={"w": jnp.zeros((6,), jnp.float32)},
+                     opt=0, step=0)
+    state.load_recovered(data)
+
+    assert state.step == 9
+    assert isinstance(state.params["w"], jax.Array)
+    np.testing.assert_array_equal(np.asarray(state.params["w"]),
+                                  committed["params"]["w"])
+    # The optimizer came back as THIS rank's 1/N shard, not the whole.
+    assert isinstance(state.opt, ShardedOptimizerState)
+    assert state.opt.plan.rank == my_rank
+    per = plan.pers[0]
+    got = np.asarray(state.opt.inner_states[0]["mu"])
+    want = np.asarray(saveable["inner_states"][0]["mu"])
+    np.testing.assert_array_equal(
+        got, want[my_rank * per:(my_rank + 1) * per])
+    assert got.size == per < want.size
+    # Scalars stay replicated.
+    assert int(state.opt.inner_states[0]["count"]) == 3
+    # The recovered dict IS the new saved state (no re-gather, no
+    # collective on the lone stale rank).
+    assert state._saved_state["step"] == 9
+    assert state._saved_state["opt"]["__hvd_sharded_opt__"] == 1
+
+
+def test_jaxstate_load_recovered_world_mismatch_keeps_saveable(monkeypatch):
+    """A committed world that no longer matches the fleet cannot be
+    re-sliced silently: the raw saveable is kept (the caller re-inits),
+    never a wrong-shaped shard."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.common import basics
+    from horovod_tpu.elastic.state import JaxState
+
+    saveable, _plan = _sharded_saveable(4)
+    monkeypatch.setattr(basics, "rank", lambda: 0)
+    monkeypatch.setattr(basics, "size", lambda: 2)       # world changed
+    state = JaxState(params={"w": jnp.zeros((6,), jnp.float32)},
+                     opt=0, step=0)
+    state.load_recovered({"opt": saveable, "step": 1,
+                          "params": {"w": np.zeros(6, np.float32)}})
+    assert isinstance(state.opt, dict)
+    assert state.opt["__hvd_sharded_opt__"] == 1

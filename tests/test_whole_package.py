@@ -509,6 +509,169 @@ def test_hvd109_negative_controls_stay_clean(tmp_path):
     assert "HVD109" not in rules_of(analyze_package([pkg]))
 
 
+# ========================================= ZeRO-sharded schedules (ISSUE 15)
+def test_hvd108_sharded_update_schedules_reduce_scatter_allgather(tmp_path):
+    """A ``DistributedOptimizer(sharded=True)`` update site schedules the
+    ZeRO pipeline — reduce-scatter + allgather, NOT an allreduce: the
+    divergence report against a plain-allreduce arm must spell out the
+    real sharded sequence."""
+    pkg = make_pkg(tmp_path, {
+        "train.py": """
+            import horovod_tpu as hvd
+            import optax
+
+            opt = hvd.DistributedOptimizer(optax.adam(1e-3), sharded=True)
+
+            def step(g, s, p, use_sharded):
+                if use_sharded:
+                    return opt.update(g, s, p)
+                return hvd.allreduce(g), s
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD108")
+    assert len(hits) == 1 and hits[0].line == 8      # the `if use_sharded:`
+    assert "reducescatter[sharded], allgather[sharded]" in hits[0].message
+    assert "allreduce]" in hits[0].message
+
+
+def test_hvd108_sharded_update_both_arms_stay_clean(tmp_path):
+    """Accuracy control: two arms that both run the sharded update emit
+    the SAME reduce-scatter+allgather schedule — no false divergence from
+    the synthetic site expansion."""
+    pkg = make_pkg(tmp_path, {
+        "train.py": """
+            import horovod_tpu as hvd
+            import optax
+
+            from horovod_tpu.parallel.zero import sharded_optimizer
+
+            zopt = sharded_optimizer(optax.adam(1e-3))
+
+            def step(g, s, p, log):
+                if log:
+                    u, s = zopt.update(g, s, p)
+                    print("stepped")
+                    return u, s
+                return zopt.update(g, s, p)
+        """,
+    })
+    assert "HVD108" not in rules_of(analyze_package([pkg]))
+
+
+def test_hvd108_sharded_flag_is_a_schedule_dimension(tmp_path):
+    """sharded=True rides the fusion key and the negotiation digest, so a
+    sharded reduce-scatter and an unsharded one of identical spelling are
+    DIFFERENT programs — branches choosing between them must diverge."""
+    pkg = make_pkg(tmp_path, {
+        "step.py": """
+            import horovod_tpu as hvd
+
+            def step(x, zero):
+                if zero:
+                    return hvd.grouped_reducescatter([x], sharded=True)
+                return hvd.grouped_reducescatter([x])
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD108")
+    assert len(hits) == 1
+    assert "grouped_reducescatter[sharded]" in hits[0].message
+
+
+def test_hvd109_sharded_update_in_transition_callback(tmp_path):
+    """The sharded update is a collective program like any other: reachable
+    from a mid-transition callback it must fire HVD109, named as the
+    reduce-scatter+allgather it schedules."""
+    pkg = make_pkg(tmp_path, {
+        "cb.py": """
+            import horovod_tpu as hvd
+            import optax
+
+            opt = hvd.DistributedOptimizer(optax.adam(1e-3), sharded=True)
+
+            class Hooks:
+                def on_join(self, g, s):
+                    return opt.update(g, s)
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD109")
+    assert len(hits) == 1 and hits[0].is_error
+    assert "reducescatter[sharded]" in hits[0].message
+    assert "on_join" in hits[0].message
+
+
+def test_sharded_opt_rebind_clears_marking(tmp_path):
+    """A name rebound AWAY from a sharded optimizer (to a plain Name, not
+    a Call) must drop its marking — no phantom sharded_update sites, so
+    no HVD109 for the later .update()."""
+    pkg = make_pkg(tmp_path, {
+        "cb.py": """
+            import horovod_tpu as hvd
+            import optax
+
+            class Hooks:
+                def on_join(self, g, s, plain):
+                    opt = hvd.DistributedOptimizer(optax.adam(1e-3),
+                                                   sharded=True)
+                    opt = plain
+                    return opt.update(g, s)
+        """,
+    })
+    assert "HVD109" not in rules_of(analyze_package([pkg]))
+
+
+def test_hvd110_catches_injected_divergent_sharded_flag(tmp_path):
+    """ISSUE 15 acceptance: a world-divergent ``sharded=`` flag — ranks
+    would negotiate mismatched data planes — is an HVD110 ERROR, in
+    whole-package mode and per-module mode alike."""
+    src = {
+        "bad.py": """
+            import horovod_tpu as hvd
+            import optax
+
+            def build(inner):
+                opt = hvd.DistributedOptimizer(
+                    inner, sharded=hvd.rank() == 0)
+                return opt
+
+            def scatter(x):
+                r = hvd.local_rank()
+                return hvd.grouped_reducescatter([x], sharded=r < 2)
+        """,
+    }
+    pkg = make_pkg(tmp_path, src)
+    hits = by_rule(analyze_package([pkg]), "HVD110")
+    assert len(hits) == 2
+    assert all(f.is_error for f in hits)
+    assert "rank identity" in hits[0].message
+    assert {f.line for f in hits} == {6, 12}
+    # Per-module mode sees it too (the check is purely local).
+    assert len(by_rule(lint_paths([pkg]), "HVD110")) == 2
+
+
+def test_hvd110_quiet_on_fleet_uniform_sharded_config(tmp_path):
+    """Constants, env-derived config and world-size-derived shard counts
+    are fleet-uniform: no HVD110."""
+    pkg = make_pkg(tmp_path, {
+        "good.py": """
+            import os
+            import horovod_tpu as hvd
+            import optax
+
+            def build(inner):
+                return hvd.DistributedOptimizer(inner, sharded=True)
+
+            def build_env(inner):
+                flag = bool(int(os.environ.get("SHARD", "0")))
+                return hvd.DistributedOptimizer(inner, sharded=flag)
+
+            def scatter(x):
+                return hvd.grouped_reducescatter(
+                    [x], sharded=True, num_shards=hvd.size())
+        """,
+    })
+    assert "HVD110" not in rules_of(analyze_package([pkg]))
+
+
 # ============================================== satellite: jit unwrapping
 def test_jit_assignment_wrapping_no_longer_hides_body():
     """``step = jax.jit(step_impl)`` puts step_impl in a jit context:
