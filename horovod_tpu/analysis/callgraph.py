@@ -61,6 +61,93 @@ _UNIFORM_CALLS = {
 
 
 @dataclasses.dataclass(frozen=True)
+class ProcessSetValue:
+    """Abstract value of a collective's process-set argument (the dataflow
+    domain HVD111/113/114 run over).
+
+    ``kind`` is one of:
+
+    - ``"world"``   — no ``process_set=`` (or an explicit ``None``): the
+      global set, id 0;
+    - ``"named"``   — a value traced to an ``add_process_set(...)`` /
+      ``ProcessSet(...)`` registration; ``ranks`` carries the literal rank
+      list when the registration spelled one;
+    - ``"param"``   — the enclosing function's own ``process_set``-style
+      parameter (a scoped helper, resolved per call site by pass 2);
+    - ``"unknown"`` — anything the tracker cannot prove.
+
+    Overlap judgements (:func:`proven_overlap`) are deliberately
+    one-sided: only PROVEN overlap fires the ERROR rules, so an unknown
+    value can never produce a false HVD111.
+    """
+    kind: str
+    spelling: str
+    ranks: Optional[Tuple[int, ...]] = None
+
+    @property
+    def lane(self) -> str:
+        """Stable per-set schedule-lane key (world is the default lane)."""
+        if self.kind == "world":
+            return "world"
+        if self.kind == "param":
+            return f"<{self.spelling}>"
+        if self.kind == "unknown":
+            return f"?{self.spelling}"
+        return self.spelling
+
+    def describe(self) -> str:
+        if self.kind == "world":
+            return "the world set"
+        if self.kind == "named" and self.ranks is not None:
+            return f"process set {self.spelling} (ranks {list(self.ranks)})"
+        if self.kind == "param":
+            return f"the caller-supplied process set '{self.spelling}'"
+        return f"process set {self.spelling}"
+
+
+WORLD = ProcessSetValue("world", "<world>")
+
+
+def proven_overlap(a: ProcessSetValue, b: ProcessSetValue) -> bool:
+    """True only when two DISTINCT sets provably share at least one rank.
+
+    Every registered set is a nonempty subset of the world, so
+    (world, named) always overlaps; two named sets overlap only when both
+    spelled literal rank lists that intersect.  params/unknowns never
+    prove overlap — the conservative side that keeps HVD111 free of false
+    positives on disjoint or unresolvable sets.
+    """
+    if a.lane == b.lane:
+        return False                 # same lane: one stream, no entangling
+    kinds = (a.kind, b.kind)
+    if "world" in kinds:
+        other = b if a.kind == "world" else a
+        return other.kind == "named"
+    if a.kind == "named" and b.kind == "named" \
+            and a.ranks is not None and b.ranks is not None:
+        return bool(set(a.ranks) & set(b.ranks))
+    return False
+
+
+def _literal_ranks(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """``add_process_set([0, 2])`` → ``(0, 2)``; None when not literal."""
+    args = list(call.args)
+    for kw in call.keywords:
+        if kw.arg in ("ranks", "ps_or_ranks"):
+            args.append(kw.value)
+    for arg in args:
+        if isinstance(arg, (ast.List, ast.Tuple)):
+            vals = []
+            for e in arg.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    vals.append(e.value)
+                else:
+                    return None
+            return tuple(sorted(vals))
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
 class Guard:
     """A rank-divergent context a call site sits in."""
     line: int
@@ -79,6 +166,11 @@ class CallSite:
     col: int
     guard: Optional[Guard]
     resolved: Optional["FunctionNode"] = None
+    # Resolved ``process_set=`` kwarg at this call site — explicit, or
+    # pinned by a ``functools.partial(helper, process_set=...)`` alias the
+    # call goes through.  Pass 2 substitutes it for the callee's ``param``
+    # values (HVD113's scoped-region entry edges).
+    ps_kwarg: Optional[ProcessSetValue] = None
 
 
 @dataclasses.dataclass
@@ -94,6 +186,9 @@ class CollectiveSite:
     # sharded_optimizer binding — the schedule pass expands the latter to
     # its real reduce-scatter + allgather sequence.
     sharded: bool = False
+    # Resolved process-set value of this site (the schedule lane it
+    # submits on); WORLD when no process_set= / axis binding applies.
+    ps: ProcessSetValue = WORLD
 
 
 @dataclasses.dataclass
@@ -117,6 +212,18 @@ class FunctionNode:
     # Names bound to a sharded optimizer wrapper in this scope: their
     # ``.update()`` calls register synthetic sharded_update sites.
     sharded_opt_vars: Set[str] = dataclasses.field(default_factory=set)
+    # Process-set dataflow (ISSUE 16): parameter names (so a
+    # ``process_set=<param>`` resolves to kind="param"), names bound to
+    # registered sets in this scope, partial-pinned process_set kwargs
+    # (var of the partial alias -> pinned value), and mesh-axis bindings
+    # from ``process_set_mesh(ps, axis_name=...)``.
+    params: Tuple[str, ...] = ()
+    ps_bindings: Dict[str, ProcessSetValue] = dataclasses.field(
+        default_factory=dict)
+    partial_ps: Dict[str, ProcessSetValue] = dataclasses.field(
+        default_factory=dict)
+    axis_bindings: Dict[str, ProcessSetValue] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def short(self) -> str:
@@ -251,6 +358,10 @@ class _Collector(ast.NodeVisitor):
                           cls=cls.name if cls else None,
                           lineno=node.lineno, node=node)
         fn.is_callback = node.name in MID_TRANSITION_CALLBACKS
+        a = node.args
+        fn.params = tuple(p.arg for p in
+                          list(a.posonlyargs) + list(a.args)
+                          + list(a.kwonlyargs))
         for dec in node.decorator_list:
             target = dec.func if isinstance(dec, ast.Call) else dec
             d = _dotted(target) or ""
@@ -343,6 +454,40 @@ class _Collector(ast.NodeVisitor):
     visit_While = _visit_divergent
     visit_IfExp = _visit_divergent
 
+    # ------------------------------------------------- process-set values
+    def _ps_scopes(self) -> List["FunctionNode"]:
+        scopes = [self._cur()]
+        if self.mod.toplevel is not None \
+                and self._cur() is not self.mod.toplevel:
+            scopes.append(self.mod.toplevel)
+        return scopes
+
+    def _resolve_ps(self, expr: ast.AST) -> ProcessSetValue:
+        """Abstract-evaluate a ``process_set=`` argument expression."""
+        if isinstance(expr, ast.Constant) and expr.value is None:
+            return WORLD
+        if isinstance(expr, ast.Call):
+            cname = _call_name(expr)
+            if cname in ("add_process_set", "ProcessSet"):
+                return ProcessSetValue("named", "<anonymous>",
+                                       _literal_ranks(expr))
+            return ProcessSetValue("unknown", cname or "<call>")
+        if isinstance(expr, ast.Name):
+            for scope in self._ps_scopes():
+                if expr.id in scope.ps_bindings:
+                    return scope.ps_bindings[expr.id]
+            if expr.id in self._cur().params:
+                return ProcessSetValue("param", expr.id)
+            return ProcessSetValue("unknown", expr.id)
+        d = _dotted(expr)
+        return ProcessSetValue("unknown", d or "<expr>")
+
+    def _lookup_axis(self, axis: str) -> Optional[ProcessSetValue]:
+        for scope in self._ps_scopes():
+            if axis in scope.axis_bindings:
+                return scope.axis_bindings[axis]
+        return None
+
     # --------------------------------------------------------- bindings
     @staticmethod
     def _is_sharded_opt_call(val: ast.Call) -> bool:
@@ -365,9 +510,21 @@ class _Collector(ast.NodeVisitor):
             val = node.value
             # ANY rebind clears a sharded-optimizer marking first (a
             # Name/None/attribute reassignment must not leave a stale
-            # entry registering phantom sharded_update sites).
+            # entry registering phantom sharded_update sites).  Same for
+            # stale process-set / partial-pin entries.
             self._cur().sharded_opt_vars.discard(tgt)
+            self._cur().ps_bindings.pop(tgt, None)
+            self._cur().partial_ps.pop(tgt, None)
             if isinstance(val, ast.Call):
+                cname = _call_name(val)
+                if cname in ("add_process_set", "ProcessSet"):
+                    self._cur().ps_bindings[tgt] = ProcessSetValue(
+                        "named", tgt, _literal_ranks(val))
+                elif cname == "partial":
+                    for kw in val.keywords:
+                        if kw.arg == "process_set":
+                            self._cur().partial_ps[tgt] = \
+                                self._resolve_ps(kw.value)
                 if self._is_sharded_opt_call(val):
                     self._cur().sharded_opt_vars.add(tgt)
                 wrapped = unwrap_wrapped_callable(val)
@@ -379,10 +536,25 @@ class _Collector(ast.NodeVisitor):
                         self._cur().bindings[tgt] = ("instance", d)
             elif isinstance(val, ast.Name):
                 self._cur().bindings[tgt] = ("alias", val.id)
+                for scope in self._ps_scopes():
+                    if val.id in scope.ps_bindings:
+                        self._cur().ps_bindings[tgt] = \
+                            scope.ps_bindings[val.id]
+                        break
             elif isinstance(val, ast.Attribute):
                 d = _dotted(val)
                 if d:
                     self._cur().bindings[tgt] = ("alias", d)
+                # ``axis = ps.axis_name``: the axis VARIABLE now carries
+                # the set — in-graph collectives submitting over it are
+                # set-scoped, not bare world (the jax/optimizer.py
+                # pattern).  Keyed by variable name in the same table as
+                # constant axis strings; _lookup_axis serves both.
+                if val.attr == "axis_name" \
+                        and isinstance(val.value, ast.Name):
+                    base = self._resolve_ps(val.value)
+                    if base.kind in ("named", "param"):
+                        self._cur().axis_bindings[tgt] = base
         # self.attr = C(...) inside a method: class attribute type.
         if self._class_stack and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Attribute) \
@@ -406,16 +578,76 @@ class _Collector(ast.NodeVisitor):
                 self.mod.first_training_line = node.lineno
             if name in ("JaxState", "TorchState", "TensorFlowKerasState"):
                 fn.uses_elastic_state = True
+        if name == "process_set_mesh":
+            # ``m = process_set_mesh(evens, axis_name="dp")`` binds the
+            # mesh axis "dp" to the set's value: in-graph collectives over
+            # that axis_name submit on the set's lane.
+            ps_arg: Optional[ast.AST] = node.args[0] if node.args else None
+            axis: Optional[str] = None
+            for kw in node.keywords:
+                if kw.arg == "process_set":
+                    ps_arg = kw.value
+                elif kw.arg == "axis_name" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    axis = kw.value.value
+            if axis is not None:
+                fn.axis_bindings[axis] = (
+                    self._resolve_ps(ps_arg) if ps_arg is not None
+                    else WORLD)
         if name in COLLECTIVE_NAMES:
+            ps = WORLD
+            has_ps = False
+            for kw in node.keywords:
+                if kw.arg == "process_set":
+                    has_ps = True
+                    ps = self._resolve_ps(kw.value)
+            if not has_ps:
+                # Positional forwarding: a registered-set name (or the
+                # enclosing function's own process_set parameter) passed
+                # positionally still scopes the site — the eager-op
+                # wrappers thread process_set positionally, and treating
+                # them as bare world sites would false-positive HVD113.
+                for arg in node.args:
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    v: Optional[ProcessSetValue] = None
+                    for scope in self._ps_scopes():
+                        if arg.id in scope.ps_bindings:
+                            v = scope.ps_bindings[arg.id]
+                            break
+                    if v is None and "process_set" in arg.id \
+                            and arg.id in self._cur().params:
+                        v = ProcessSetValue("param", arg.id)
+                    if v is not None:
+                        ps = v
+                        break
+            if not has_ps and ps is WORLD:
+                # In-graph form: an axis_name bound by a process_set_mesh
+                # in scope (constant) or carrying ``ps.axis_name`` (axis
+                # variable) pins the site to that lane.
+                for kw in node.keywords:
+                    if kw.arg != "axis_name":
+                        continue
+                    key: Optional[str] = None
+                    if isinstance(kw.value, ast.Constant) \
+                            and isinstance(kw.value.value, str):
+                        key = kw.value.value
+                    elif isinstance(kw.value, ast.Name):
+                        key = kw.value.id
+                    if key is not None:
+                        bound = self._lookup_axis(key)
+                        if bound is not None:
+                            ps = bound
             fn.collectives.append(CollectiveSite(
                 name=name, line=node.lineno, col=node.col_offset + 1,
                 guard=self._cur_guard(),
-                has_process_set=any(kw.arg == "process_set"
-                                    for kw in node.keywords),
+                has_process_set=has_ps,
                 sharded=any(kw.arg == "sharded"
                             and isinstance(kw.value, ast.Constant)
                             and bool(kw.value.value)
-                            for kw in node.keywords)))
+                            for kw in node.keywords),
+                ps=ps))
         elif name in ("update", "apply_gradients"):
             # opt.update(...) on a name bound to DistributedOptimizer(
             # sharded=True) / sharded_optimizer: a synthetic sharded_update
@@ -432,9 +664,21 @@ class _Collector(ast.NodeVisitor):
                     name="sharded_update", line=node.lineno,
                     col=node.col_offset + 1, guard=self._cur_guard(),
                     has_process_set=False, sharded=True))
+        ps_kwarg: Optional[ProcessSetValue] = None
+        for kw in node.keywords:
+            if kw.arg == "process_set":
+                ps_kwarg = self._resolve_ps(kw.value)
+        callee_expr = _dotted(node.func)
+        if ps_kwarg is None and callee_expr:
+            head = callee_expr.split(".")[0]
+            for scope in self._ps_scopes():
+                if head in scope.partial_ps:
+                    ps_kwarg = scope.partial_ps[head]
+                    break
         fn.calls.append(CallSite(
-            callee_expr=_dotted(node.func), line=node.lineno,
-            col=node.col_offset + 1, guard=self._cur_guard()))
+            callee_expr=callee_expr, line=node.lineno,
+            col=node.col_offset + 1, guard=self._cur_guard(),
+            ps_kwarg=ps_kwarg))
         # Functions handed to TRANSITION registrars become transition
         # callbacks themselves.  register_reset_callbacks is deliberately
         # not here: reset callbacks run post-re-rendezvous (same reasoning

@@ -111,24 +111,131 @@ def _mentions_rank(expr: ast.AST, tainted: Set[str]) -> bool:
     return False
 
 
-def _iter_over_set_or_dict(it: ast.AST) -> Optional[str]:
-    """Classify a for-loop iterable: 'set', 'dict', or None.
+def _iter_over_set_or_dict(it: ast.AST,
+                           tainted: Optional[Set[str]] = None
+                           ) -> Tuple[Optional[str], bool]:
+    """Classify a for-loop iterable: ``(kind, neutralized)`` with kind
+    'set'/'dict'/None.
 
-    ``sorted(...)`` anywhere at the top neutralizes the order hazard.
+    ``sorted(...)`` at the top neutralizes the ITERATION-order hazard —
+    unless its ``key=`` is derived from rank identity, in which case each
+    rank sorts into a different order and the hazard stands (ISSUE 16
+    satellite: a sorted() wrapper must not launder rank-divergent order).
     """
     if isinstance(it, ast.Call) and _call_name(it) == "sorted":
-        return None
+        kind, _ = _iter_over_set_or_dict(it.args[0], tainted) if it.args \
+            else (None, False)
+        for kw in it.keywords:
+            if kw.arg == "key" and tainted is not None \
+                    and _mentions_rank(kw.value, tainted):
+                return kind, False
+        return kind, True
     if isinstance(it, (ast.Set, ast.SetComp)):
-        return "set"
+        return "set", False
     if isinstance(it, ast.Call):
         name = _call_name(it)
         if name == "set":
-            return "set"
+            return "set", False
         if name in ("keys", "values", "items"):
-            return "dict"
+            return "dict", False
         if name in ("enumerate", "list", "tuple", "reversed"):
-            return _iter_over_set_or_dict(it.args[0]) if it.args else None
+            return _iter_over_set_or_dict(it.args[0], tainted) if it.args \
+                else (None, False)
+    return None, False
+
+
+# In-graph lax collectives that name a mesh axis (positionally or via
+# axis_name=) — HVD112 checks the name against the binding mesh's axes.
+_LAX_AXIS_CALLS = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "axis_index", "psum_scatter",
+}
+
+
+def _axes_from_mesh_call(call: ast.Call) -> Optional[Tuple[str, ...]]:
+    """Statically known axis names of a mesh-constructing call:
+    ``make_mesh({"dp": 2, "tp": 4})`` → ("dp", "tp");
+    ``Mesh(devs, ("dp", "tp"))`` → ("dp", "tp");
+    ``process_set_mesh(ps, axis_name="x")`` → ("x",).  None when the axes
+    are not literal (no check is possible — and no false positive)."""
+    name = _call_name(call)
+    if name == "make_mesh":
+        cands = list(call.args) + [kw.value for kw in call.keywords
+                                   if kw.arg == "axis_sizes"]
+        for arg in cands:
+            if isinstance(arg, ast.Dict):
+                keys = tuple(k.value for k in arg.keys
+                             if isinstance(k, ast.Constant)
+                             and isinstance(k.value, str))
+                if keys and len(keys) == len(arg.keys):
+                    return keys
+        return None
+    if name == "Mesh":
+        cands = list(call.args[1:2]) + [kw.value for kw in call.keywords
+                                        if kw.arg == "axis_names"]
+        for arg in cands:
+            if isinstance(arg, (ast.Tuple, ast.List)):
+                if arg.elts and all(isinstance(e, ast.Constant)
+                                    and isinstance(e.value, str)
+                                    for e in arg.elts):
+                    return tuple(e.value for e in arg.elts)
+            elif isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                              str):
+                return (arg.value,)
+        return None
+    if name == "process_set_mesh":
+        for kw in call.keywords:
+            if kw.arg == "axis_name" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                return (kw.value.value,)
+        if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+                and isinstance(call.args[1].value, str):
+            return (call.args[1].value,)
     return None
+
+
+def _mesh_axis_vars(tree: ast.AST) -> Dict[str, Tuple[str, ...]]:
+    """Names assigned from a mesh constructor with literal axes."""
+    out: Dict[str, Tuple[str, ...]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            axes = _axes_from_mesh_call(node.value)
+            if axes:
+                out[node.targets[0].id] = axes
+    return out
+
+
+def _mesh_axes_of_expr(expr: Optional[ast.AST],
+                       mesh_vars: Dict[str, Tuple[str, ...]]
+                       ) -> Optional[Tuple[str, ...]]:
+    if isinstance(expr, ast.Name):
+        return mesh_vars.get(expr.id)
+    if isinstance(expr, ast.Call):
+        return _axes_from_mesh_call(expr)
+    return None
+
+
+def _shard_map_call_info(node: ast.Call):
+    """``(mesh_expr, spec_exprs, wrapped_name)`` for a ``shard_map(...)``
+    call or a ``partial(shard_map, ...)`` decorator build; None otherwise."""
+    name = _call_name(node)
+    wrapped: Optional[ast.AST] = None
+    if name == "shard_map":
+        wrapped = node.args[0] if node.args else None
+    elif not (name == "partial" and node.args
+              and _call_name(node.args[0]) == "shard_map"):
+        return None
+    mesh = None
+    specs: List[ast.AST] = []
+    for kw in node.keywords:
+        if kw.arg == "mesh":
+            mesh = kw.value
+        elif kw.arg in ("in_specs", "out_specs"):
+            specs.append(kw.value)
+    wname = wrapped.id if isinstance(wrapped, ast.Name) else None
+    return mesh, specs, wname
 
 
 def _jit_decorated(fn: ast.AST) -> bool:
@@ -266,6 +373,19 @@ class _Linter(ast.NodeVisitor):
         facts.visit(node)
         self._module_tainted = facts.tainted
         self._jit_wrapped_names = _jit_wrapped_fn_names(node)
+        # HVD112: mesh vars with literal axes, and functions put in a
+        # shard_map context by ASSIGNMENT (``step = shard_map(impl,
+        # mesh=m)`` / ``jit(shard_map(impl, mesh=m))``) — their bodies
+        # bind exactly that mesh's axes.
+        self._mesh_vars = _mesh_axis_vars(node)
+        self._shard_axes_by_name: Dict[str, Tuple[str, ...]] = {}
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and _call_name(sub) == "shard_map":
+                info = _shard_map_call_info(sub)
+                if info and info[0] is not None and info[2]:
+                    axes = _mesh_axes_of_expr(info[0], self._mesh_vars)
+                    if axes:
+                        self._shard_axes_by_name[info[2]] = axes
         self.generic_visit(node)
 
     def _visit_function(self, node):
@@ -288,7 +408,22 @@ class _Linter(ast.NodeVisitor):
                 self.uses_elastic_state = True
         jit = _jit_decorated(node) or \
             node.name in getattr(self, "_jit_wrapped_names", ())
-        self._fn_stack.append({"tainted": facts.tainted, "node": node})
+        # HVD112 context: the mesh axes this function's body is
+        # shard_map-bound to (decorator or assignment wrapping).
+        shard_axes: Optional[Tuple[str, ...]] = None
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Call):
+                info = _shard_map_call_info(dec)
+                if info and info[0] is not None:
+                    axes = _mesh_axes_of_expr(
+                        info[0], getattr(self, "_mesh_vars", {}))
+                    if axes:
+                        shard_axes = axes
+        if shard_axes is None:
+            shard_axes = getattr(self, "_shard_axes_by_name",
+                                 {}).get(node.name)
+        self._fn_stack.append({"tainted": facts.tainted, "node": node,
+                               "shard_axes": shard_axes})
         self._early_exit_after.append(None)
         if jit:
             self._jit_depth += 1
@@ -344,8 +479,9 @@ class _Linter(ast.NodeVisitor):
 
     # ------------------------------------------------------------ for loops
     def visit_For(self, node: ast.For):
-        kind = _iter_over_set_or_dict(node.iter)
-        if kind is not None:
+        kind, neutralized = _iter_over_set_or_dict(node.iter,
+                                                   self._tainted())
+        if kind is not None and not neutralized:
             for stmt in node.body:
                 for sub in ast.walk(stmt):
                     if isinstance(sub, ast.Call) and _is_collective_call(sub):
@@ -360,6 +496,38 @@ class _Linter(ast.NodeVisitor):
                 else:
                     continue
                 break
+        elif kind is not None and neutralized:
+            # sorted() fixed WHICH tensor comes out at each position — but
+            # a grouped op whose process_set=/priorities= kwarg is derived
+            # from rank identity still pairs each position with a
+            # different communicator/priority per rank: same deadlock, a
+            # sorted() wrapper must not launder it.
+            done = False
+            for stmt in node.body:
+                if done:
+                    break
+                for sub in ast.walk(stmt):
+                    if not (isinstance(sub, ast.Call)
+                            and _is_collective_call(sub)):
+                        continue
+                    for kw in sub.keywords:
+                        if kw.arg in ("process_set", "priorities") \
+                                and _mentions_rank(kw.value,
+                                                   self._tainted()):
+                            rule = "HVD104" if kind == "set" else "HVD105"
+                            self._emit(
+                                rule, sub,
+                                f"sorted() fixes the {kind}-iteration "
+                                f"order of the loop at line {node.lineno}, "
+                                f"but {kw.arg}= of "
+                                f"{_call_name(sub)!r} is derived from "
+                                f"rank identity — each rank still submits "
+                                f"the group against a different process "
+                                f"set/priority order")
+                            done = True
+                            break
+                    if done:
+                        break
         self.generic_visit(node)
 
     # ---------------------------------------------------------------- calls
@@ -384,11 +552,80 @@ class _Linter(ast.NodeVisitor):
                        f"{name!r} inside a jit-decorated function forces a "
                        f"host round-trip at trace/run time")
 
+        self._check_axis_binding(node, name)
+
         if _is_collective_call(node):
             self._check_collective(node, name)
         if name in COLLECTIVE_NAMES or name in _SHARD_ARG_CALLS:
             self._check_shard_args(node, name)
         self.generic_visit(node)
+
+    def _shard_axes(self) -> Optional[Tuple[str, ...]]:
+        for entry in reversed(self._fn_stack):
+            axes = entry.get("shard_axes")
+            if axes is not None:
+                return axes
+        return None
+
+    def _check_axis_binding(self, node: ast.Call, name: Optional[str]):
+        """HVD112 (AST half): a collective naming an axis its binding mesh
+        does not define, or a PartitionSpec naming an unknown axis at the
+        shard_map site — the fsdp × tp mismatch.  Only fires when the
+        mesh's axes are statically known (literal make_mesh/Mesh/
+        process_set_mesh), so unknown meshes can't false-positive."""
+        # (a) At a shard_map site with a known mesh: P()/PartitionSpec()
+        # entries in in_specs/out_specs must name that mesh's axes.
+        info = _shard_map_call_info(node) if isinstance(node, ast.Call) \
+            else None
+        if info and info[0] is not None:
+            axes = _mesh_axes_of_expr(info[0],
+                                      getattr(self, "_mesh_vars", {}))
+            if axes:
+                for spec in info[1]:
+                    for sub in ast.walk(spec):
+                        if isinstance(sub, ast.Call) and \
+                                _call_name(sub) in ("P", "PartitionSpec"):
+                            for c in ast.walk(sub):
+                                if isinstance(c, ast.Constant) \
+                                        and isinstance(c.value, str) \
+                                        and c.value not in axes:
+                                    self._emit(
+                                        "HVD112", sub,
+                                        f"PartitionSpec names axis "
+                                        f"{c.value!r}, but the shard_map "
+                                        f"mesh defines axes "
+                                        f"{list(axes)} — the spec shards "
+                                        f"over an axis that does not "
+                                        f"exist on this mesh")
+        # (b) Inside a shard_map-bound body: in-graph collectives must
+        # name axes of THE binding mesh.
+        axes = self._shard_axes()
+        if axes is None:
+            return
+        if name not in _LAX_AXIS_CALLS and name not in COLLECTIVE_NAMES:
+            return
+        targets: List[ast.AST] = [kw.value for kw in node.keywords
+                                  if kw.arg == "axis_name"]
+        if not targets and name in _LAX_AXIS_CALLS and len(node.args) >= 2:
+            targets = [node.args[1]]
+        for t in targets:
+            named: List[str] = []
+            if isinstance(t, ast.Constant) and isinstance(t.value, str):
+                named = [t.value]
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                named = [e.value for e in t.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str)]
+            for ax in named:
+                if ax not in axes:
+                    self._emit(
+                        "HVD112", node,
+                        f"collective {name!r} names axis {ax!r}, but its "
+                        f"binding mesh defines axes {list(axes)} — the "
+                        f"collective reduces over an axis that does not "
+                        f"exist on this mesh (at best lowering fails; on "
+                        f"a differently-built mesh it silently reduces "
+                        f"over a 1-sized axis)")
 
     def _check_shard_args(self, node: ast.Call, name: str):
         """HVD110: sharded=/shard-count arguments must be rank-invariant

@@ -166,6 +166,59 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
         "a function of rank()/local_rank().",
     ),
     Rule(
+        "HVD111", Severity.ERROR,
+        "branch-divergent interleaving of overlapping process sets",
+        "Two paths through one function submit collectives over two "
+        "process sets that share ranks in DIFFERENT interleavings.  Each "
+        "set is its own communicator with its own ordered stream, but the "
+        "shared ranks execute submissions in program order: rank A holds "
+        "set-1's slot while waiting on set-2, rank B holds set-2's slot "
+        "while waiting on set-1 — the classic cross-communicator deadlock "
+        "(MPI forbids exactly this; Horovod's per-communicator negotiation "
+        "cannot detect it because each lane looks self-consistent).",
+        "Give the overlapping sets one fixed relative submission order on "
+        "every path (hoist the collectives out of the branch), or make "
+        "the sets disjoint so their streams cannot entangle.",
+    ),
+    Rule(
+        "HVD112", Severity.ERROR,
+        "collective axis absent from its binding mesh/PartitionSpec",
+        "A shard_map/in-graph collective names an axis_name (or a "
+        "PartitionSpec names an axis) that the binding mesh does not "
+        "define — the fsdp-by-tp mismatch.  At best lowering fails; at "
+        "worst a differently-built mesh binds the name to a 1-sized axis "
+        "and the reduction silently becomes a no-op on every rank.",
+        "Use an axis name the binding mesh actually defines (check "
+        "make_mesh()/process_set_mesh(axis_name=...) at the shard_map "
+        "site), and keep PartitionSpecs within the mesh's axis set.",
+    ),
+    Rule(
+        "HVD113", Severity.ERROR,
+        "hard-coded world collective reachable from a process-set-scoped region",
+        "Code scoped to a registered process set (helpers called with "
+        "process_set=<set>, or functions that take a process_set and use "
+        "it) reaches a collective that omits process_set= and therefore "
+        "targets the GLOBAL set.  In a multi-tenant world only the set's "
+        "members run this region: the world collective waits on ranks "
+        "that never arrive (tenant-leak deadlock), and if they DO arrive "
+        "it silently mixes tenants' data.",
+        "Thread the process_set through to every collective in the scoped "
+        "region (forward the parameter), or hoist the deliberate world "
+        "sync out of the set-scoped code path.",
+    ),
+    Rule(
+        "HVD114", Severity.WARNING,
+        "overlapping process sets interleaved without a dominating order edge",
+        "A function alternates submissions between two process sets that "
+        "share ranks (set-1, set-2, set-1 ...) with no world-level "
+        "barrier establishing a dominating order edge between the lanes.  "
+        "Each lane is self-consistent, but nothing orders them against "
+        "each other: any rank-dependent scheduling skew (HVD111's dynamic "
+        "cousin) can entangle the shared ranks' streams.",
+        "Insert hvd.barrier() between the lanes, batch each set's "
+        "collectives contiguously, or make the sets disjoint.",
+    ),
+    Rule(
         "HVD201", Severity.ERROR,
         "collective over unknown mesh axis",
         "A traced lax collective names an axis_name the surrounding mesh "
@@ -250,6 +303,12 @@ class Finding:
     message: str
     severity: Optional[Severity] = None
     fix_hint: Optional[str] = None
+    # Interprocedural provenance (filled by the whole-package passes, used by
+    # `lint_gate --explain` and the SARIF `processSet` property).  Appended
+    # after the original fields so positional construction stays valid.
+    chain: Optional[List[str]] = None          # call path, caller -> site
+    process_set: Optional[str] = None          # resolved process-set value(s)
+    related: Optional[List[tuple]] = None      # [(path, line)] of involved sites
 
     def __post_init__(self):
         r = RULES.get(self.rule)

@@ -12,7 +12,14 @@ entries".
 Invocations:
   python tools/lint_gate.py                 # the gate (CI / tier-1)
   python tools/lint_gate.py --update-baseline   # re-baseline after review
+  python tools/lint_gate.py --explain HVD113:horovod_tpu/x.py:42
+                                            # why did this finding fire?
   hvd-lint-gate                             # console script (pyproject)
+
+``--explain RULE:path:line`` re-runs the analyzer and prints the full
+story behind one finding — the interprocedural call chain and the
+resolved process-set values — so deciding whether to baseline it stops
+requiring a debugger.
 
 Exit status: 0 gate passes, 1 new findings, 3 analyzer crash (matching
 ``python -m horovod_tpu.analysis`` CI contract).
@@ -55,6 +62,52 @@ def run_gate(root: str = REPO_ROOT, update_baseline: bool = False,
     return diff.new, diff.stale, len(diff.matched)
 
 
+def explain(spec: str, root: str = REPO_ROOT, quiet: bool = False) -> int:
+    """``--explain RULE:path:line``: print the interprocedural chain and
+    resolved process-set values behind one finding.  Returns 0 when the
+    finding exists, 1 when nothing at that key fires."""
+    from .whole_package import analyze_package
+    from .baseline import _rel
+
+    try:
+        rule, rest = spec.split(":", 1)
+        path, line_s = rest.rsplit(":", 1)
+        line = int(line_s)
+    except ValueError:
+        print(f"error: --explain wants RULE:path:line, got {spec!r}",
+              file=sys.stderr)
+        return 2
+
+    paths = [os.path.join(root, p) for p in SCOPE
+             if os.path.exists(os.path.join(root, p))]
+    findings = analyze_package(paths)
+    # Match the finding's repo-relative path by suffix, so both
+    # "horovod_tpu/x.py" and a bare "x.py" select the site.
+    rel_want = path.replace(os.sep, "/").lstrip("./")
+    hits = [f for f in findings
+            if f.rule == rule and f.line == line
+            and _rel(f.path, root).lstrip("/").endswith(rel_want)]
+    if not hits:
+        if not quiet:
+            print(f"no {rule} finding at {path}:{line} "
+                  f"(the analyzer reports {len(findings)} finding(s) "
+                  f"package-wide)")
+        return 1
+    for f in hits:
+        print(f.render())
+        if f.process_set:
+            print(f"  process set(s): {f.process_set}")
+        if f.chain:
+            print("  call chain:")
+            for hop in f.chain:
+                print(f"    {hop}")
+        if f.related:
+            print("  related collective sites:")
+            for rp, rl in f.related:
+                print(f"    {_rel(rp, root).lstrip('/')}:{rl}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="lint_gate",
@@ -68,6 +121,9 @@ def main(argv=None) -> int:
                          "current findings (after human review)")
     ap.add_argument("--sarif", metavar="FILE",
                     help="also write NEW findings as SARIF 2.1.0")
+    ap.add_argument("--explain", metavar="RULE:path:line",
+                    help="print the interprocedural chain + resolved "
+                         "process-set values behind one finding")
     args = ap.parse_args(argv)
 
     # Guard the console-script case: installed into site-packages, the
@@ -78,6 +134,15 @@ def main(argv=None) -> int:
               f"repo (no pyproject.toml) — pass --root <checkout>",
               file=sys.stderr)
         return 2
+
+    if args.explain:
+        try:
+            return explain(args.explain, root=args.root)
+        except Exception:  # noqa: BLE001 - crash != finding (CI contract)
+            print("internal error: --explain crashed (exit 3)",
+                  file=sys.stderr)
+            traceback.print_exc()
+            return 3
 
     try:
         new, stale, baselined = run_gate(

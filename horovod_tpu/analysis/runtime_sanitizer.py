@@ -11,7 +11,13 @@ Mechanism:
   ``ops/engine.py`` ``enqueue_group``) is recorded in a bounded per-rank
   **ledger**: sequence number, wire name, signature digest, and the user
   call site that issued it (first stack frame outside horovod_tpu).
-- Each entry is stamped with a ``sanitizer_tag`` (``seq=<i>;site=<f:l>``)
+  The ledger is **namespaced per process set**: sequence numbers count
+  within each set, and every entry lands both in the combined stream and
+  in a per-set view (``ledgers[ps]``) — so one tenant's divergence is
+  reported against ITS submissions (``render_tail(process_set=...)``)
+  without another set's interleaved traffic muddying the tail.
+- Each entry is stamped with a ``sanitizer_tag``
+  (``seq=<process_set>:<i>;site=<f:l>``)
   which the controller sends BESIDE its step-invariant negotiation digest
   (the announce's separate tag field on the full path; the sparse
   slot/tag side-channel next to the bitvector on the response-cache fast
@@ -101,9 +107,15 @@ class LedgerEntry:
     name: str
     digest: str
     site: str
+    # Which process set the entry was submitted under (0 = world).  The
+    # seq above counts WITHIN this set — the namespace that keeps one
+    # tenant's divergence report from perturbing another's stream.
+    process_set: int = 0
 
     def render(self) -> str:
-        return f"#{self.seq} {self.name} [{self.digest}] at {self.site}"
+        head = f"#{self.seq}" if self.process_set == 0 \
+            else f"#{self.process_set}:{self.seq}"
+        return f"{head} {self.name} [{self.digest}] at {self.site}"
 
 
 def _caller_site() -> str:
@@ -144,6 +156,9 @@ class StaticIndex:
         if rec is None:
             return ""
         s = f" [static: {rec.get('node', '?')} #{rec.get('index', '?')}"
+        ps = rec.get("process_set")
+        if ps and ps != "world":
+            s += f" over {ps}"
         rules = rec.get("rules")
         if rules:
             s += f"; {'/'.join(rules)} flagged this site statically"
@@ -177,6 +192,13 @@ class CollectiveSanitizer:
         # submits the same sequence — which is exactly what the tag checks.
         self._seq: dict = collections.defaultdict(int)
         self.ledger: Deque[LedgerEntry] = collections.deque(maxlen=capacity)
+        # Per-process-set views of the same stream: a tenant's divergence
+        # report can quote ITS submissions only, without another set's
+        # interleaved traffic pushing the relevant entries out of the
+        # tail.  Each view is bounded like the combined ledger.
+        self.ledgers: Dict[int, Deque[LedgerEntry]] = \
+            collections.defaultdict(
+                lambda: collections.deque(maxlen=capacity))
 
     # ------------------------------------------------------------- recording
     def observe(self, entries: Sequence, site: Optional[str] = None,
@@ -210,8 +232,10 @@ class CollectiveSanitizer:
                 # comparison — order/call-site divergence becomes an
                 # attributable per-tensor error on either wire path.
                 e.sanitizer_tag = tag
-                self.ledger.append(LedgerEntry(
-                    seq=seq, name=e.name, digest=digest, site=site))
+                rec = LedgerEntry(seq=seq, name=e.name, digest=digest,
+                                  site=site, process_set=ps)
+                self.ledger.append(rec)
+                self.ledgers[ps].append(rec)
 
     def rollback(self, entries: Sequence) -> None:
         """Undo :meth:`observe` for entries whose queue push was rejected
@@ -232,6 +256,10 @@ class CollectiveSanitizer:
                     if self.ledger and self.ledger[-1].seq == seq \
                             and self.ledger[-1].name == e.name:
                         self.ledger.pop()
+                    view = self.ledgers.get(ps)
+                    if view and view[-1].seq == seq \
+                            and view[-1].name == e.name:
+                        view.pop()
                 else:
                     log.warning(
                         "sanitizer: cannot roll back seq %d:%d for %r "
@@ -286,20 +314,28 @@ class CollectiveSanitizer:
         return "|".join(parts)
 
     # ------------------------------------------------------------- reporting
-    def tail(self, n: int = 8) -> List[LedgerEntry]:
+    def tail(self, n: int = 8,
+             process_set: Optional[int] = None) -> List[LedgerEntry]:
+        """Last ``n`` ledger entries — combined stream by default, one
+        process set's view when ``process_set`` is given."""
         with self._lock:
-            return list(self.ledger)[-n:]
+            src = self.ledger if process_set is None \
+                else self.ledgers.get(process_set, ())
+            return list(src)[-n:]
 
-    def render_tail(self, n: int = 8) -> str:
-        entries = self.tail(n)
+    def render_tail(self, n: int = 8,
+                    process_set: Optional[int] = None) -> str:
+        entries = self.tail(n, process_set=process_set)
+        scope = "" if process_set is None \
+            else f" (process set {process_set})"
         if not entries:
-            return "(collective ledger empty)"
+            return f"(collective ledger{scope} empty)"
         idx = self.static_index
 
         def line(e: LedgerEntry) -> str:
             return e.render() + (idx.annotate(e.site) if idx else "")
 
-        return "last submissions on this rank:\n  " + \
+        return f"last submissions on this rank{scope}:\n  " + \
             "\n  ".join(line(e) for e in entries)
 
 

@@ -61,7 +61,7 @@ def to_sarif(findings: Iterable[Finding], root: Optional[str] = None,
 
     results = []
     for f in findings:
-        results.append({
+        result = {
             "ruleId": f.rule,
             "ruleIndex": rule_index[f.rule],
             "level": _LEVELS.get(f.severity, "warning"),
@@ -73,7 +73,17 @@ def to_sarif(findings: Iterable[Finding], root: Optional[str] = None,
                                "startColumn": max(1, f.col)},
                 },
             }],
-        })
+        }
+        props = {}
+        if getattr(f, "process_set", None):
+            # Resolved process-set value(s) behind the finding — lets a
+            # SARIF viewer group multi-tenant findings per set.
+            props["processSet"] = f.process_set
+        if getattr(f, "chain", None):
+            props["callChain"] = list(f.chain)
+        if props:
+            result["properties"] = props
+        results.append(result)
 
     return {
         "$schema": SARIF_SCHEMA,

@@ -12,7 +12,14 @@ can still go wrong statically is checked here:
 - HVD203: host-callback primitives buried in the traced step;
 - HVD204: a ``ppermute`` whose perm is not a bijection over the axis
   (non-bijective perms deadlock on multi-host exactly like bad
-  ``axis_index_groups`` — JAX's zero-fill semantics mask it locally).
+  ``axis_index_groups`` — JAX's zero-fill semantics mask it locally);
+- HVD112: when the caller declares which axes its partition specs
+  actually shard over (``partition_axes=``), a collective over a *bound
+  but undeclared* axis is the fsdp × tp mismatch — the reduction runs
+  over an axis the data is not partitioned on, silently reducing
+  replicated values.  (HVD201 stays the unbound-axis case; HVD112 is
+  the bound-but-mismatched case, mirroring the AST check in
+  ``collective_lint``.)
 
 ``compare_ledgers`` diffs two ledgers (e.g. a refactored step against the
 golden one, or per-process ledgers recorded by the runtime sanitizer) and
@@ -170,7 +177,8 @@ def _check_ppermute(rec: CollectiveRecord, perm, bound: Dict[str, int],
 
 
 def _walk(jaxpr, bound: Dict[str, int], ledger: List[CollectiveRecord],
-          findings: List[Finding], path: str):
+          findings: List[Finding], path: str,
+          declared: Optional[frozenset] = None):
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
         params = eqn.params
@@ -195,6 +203,19 @@ def _walk(jaxpr, bound: Dict[str, int], ledger: List[CollectiveRecord],
                                 f"over axis {ax!r}, but the mesh only binds "
                                 f"axes {sorted(bound)} — this fails at "
                                 f"lowering or silently no-ops"))
+                elif declared is not None and ax not in declared \
+                        and name in COLLECTIVE_PRIMITIVES:
+                    # axis_index over an undeclared axis is fine (rng
+                    # folding); only data-moving collectives reduce
+                    # replicated values.
+                    findings.append(Finding(
+                        rule="HVD112", path=path, line=rec.index, col=1,
+                        message=f"collective #{rec.index} ({name}) reduces "
+                                f"over axis {ax!r}, which the mesh binds but "
+                                f"the step's partition specs never shard "
+                                f"over (declared: {sorted(declared)}) — the "
+                                f"reduction runs over replicated data, "
+                                f"scaling results by the axis size"))
             if groups_t is not None and axes:
                 ax = axes[0]
                 size = bound.get(ax)
@@ -219,18 +240,22 @@ def _walk(jaxpr, bound: Dict[str, int], ledger: List[CollectiveRecord],
         for sub, extra in _sub_jaxprs(params):
             inner = dict(bound)
             inner.update(extra)
-            _walk(sub, inner, ledger, findings, path)
+            _walk(sub, inner, ledger, findings, path, declared)
 
 
 def check_step_fn(fn, *example_args, mesh=None,
                   axis_sizes: Optional[Dict[str, int]] = None,
+                  partition_axes: Optional[Sequence[str]] = None,
                   path: str = "<trace>") -> TraceReport:
     """Trace ``fn(*example_args)`` and audit its collective ledger.
 
     ``mesh``: the Mesh the step runs under (optional if fn contains its own
     shard_map, whose mesh binds the axes).  ``axis_sizes``: extra name→size
     bindings, for step fns written to run under an outer pmap/shard_map
-    supplied elsewhere.  Example args may be arrays or ShapeDtypeStructs —
+    supplied elsewhere.  ``partition_axes``: the axes the step's partition
+    specs actually shard over; when given, a collective over a bound axis
+    *outside* this set fires HVD112 (the fsdp × tp mismatch — reducing
+    replicated data).  Example args may be arrays or ShapeDtypeStructs —
     tracing is abstract, nothing executes.
     """
     import jax
@@ -265,7 +290,9 @@ def check_step_fn(fn, *example_args, mesh=None,
         return TraceReport(ledger=[], findings=findings, bound_axes=bound)
 
     ledger: List[CollectiveRecord] = []
-    _walk(closed.jaxpr, bound, ledger, findings, path)
+    declared = frozenset(partition_axes) if partition_axes is not None \
+        else None
+    _walk(closed.jaxpr, bound, ledger, findings, path, declared)
     return TraceReport(ledger=ledger, findings=findings, bound_axes=bound)
 
 

@@ -36,10 +36,12 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .callgraph import (
     CallSite, CollectiveSite, FunctionNode, ModuleInfo, Package,
-    build_package, is_uniform_test, reachable,
+    ProcessSetValue, build_package, is_uniform_test, proven_overlap,
+    reachable,
 )
 from .collective_lint import (
-    _FunctionFacts, _SYNC_CALLS, _TRAINING_WRAPPERS, lint_file,
+    _FunctionFacts, _SYNC_CALLS, _TRAINING_WRAPPERS, _mentions_rank,
+    lint_file,
 )
 from .findings import Finding
 
@@ -56,13 +58,20 @@ def _site_events(col: CollectiveSite) -> List:
     never an allreduce.  Sharded collectives carry the ``[sharded]``
     dimension their fusion key / negotiation digest carries: a sharded
     reduce-scatter and an unsharded one of the same shapes are DIFFERENT
-    programs, so schedules comparing them must diverge."""
+    programs, so schedules comparing them must diverge.
+
+    Every event carries the site's process-set LANE (ISSUE 16): each
+    registered set is its own communicator with its own ordered stream, so
+    ``allreduce@evens`` and a world ``allreduce`` are different schedule
+    entries — divergence is judged per set, and HVD111 compares the
+    cross-lane interleaving of overlapping sets."""
+    lane = col.ps.lane
     if col.name == "sharded_update":
-        return [("op", "reducescatter[sharded]"),
-                ("op", "allgather[sharded]")]
+        return [("op", "reducescatter[sharded]", lane),
+                ("op", "allgather[sharded]", lane)]
     if col.sharded:
-        return [("op", f"{col.name}[sharded]")]
-    return [("op", col.name)]
+        return [("op", f"{col.name}[sharded]", lane)]
+    return [("op", col.name, lane)]
 
 
 def _suppressed(mod: ModuleInfo, line: int, rule: str) -> bool:
@@ -284,9 +293,17 @@ def _schedule_stmts(stmts, fn: FunctionNode, pkg: Package, memo, stack,
                 if a is not None:
                     seq.append(a)
             else:
-                if collect and not is_uniform_test(stmt.test, tainted,
-                                                   _fn_uniform_names(fn)):
-                    divergences.append((fn, stmt.lineno, a, b))
+                if collect:
+                    # Classify the divergence: rank-divergent tests are
+                    # HVD101's domain (so HVD108 skips them) but they ARE
+                    # the classic cross-communicator interleaving (HVD111
+                    # judges both kinds); provably-uniform tests diverge
+                    # for no rank at all.
+                    if _mentions_rank(stmt.test, tainted):
+                        divergences.append((fn, stmt.lineno, a, b, "rank"))
+                    elif not is_uniform_test(stmt.test, tainted,
+                                             _fn_uniform_names(fn)):
+                        divergences.append((fn, stmt.lineno, a, b, "data"))
                 seq.append(("branch", a or ("seq",), b or ("seq",)))
         elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
             if isinstance(stmt, ast.While):
@@ -415,7 +432,9 @@ def _render_schedule(sched, limit: int = 6) -> str:
         if not isinstance(node, tuple) or not node:
             return
         if node[0] == "op":
-            ops.append(node[1])
+            lane = node[2] if len(node) > 2 else "world"
+            ops.append(node[1] if lane == "world"
+                       else f"{node[1]}@{lane}")
         elif node[0] == "seq":
             for item in node[1:]:
                 walk(item)
@@ -435,33 +454,335 @@ def _render_schedule(sched, limit: int = 6) -> str:
     return ", ".join(ops)
 
 
-def _schedule_hvd108(pkg: Package) -> List[Finding]:
+def _collect_divergences(pkg: Package) -> List:
+    """All branch divergences in the package as ``(fn, line, a, b, kind)``
+    with kind ``"data"`` (HVD108's domain) or ``"rank"`` (HVD101's domain,
+    but HVD111-eligible: a rank-divergent branch is exactly how ranks end
+    up submitting different cross-set interleavings)."""
     import ast
-    findings: List[Finding] = []
     memo: Dict = {}
-    seen: Set[Tuple[str, int]] = set()
+    divergences: List = []
     for fn in pkg.iter_functions():
         if fn.node is None:
             continue
-        divergences: List = []
         body = fn.node.body if isinstance(
             fn.node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)) \
             else []
         _schedule_stmts(body, fn, pkg, memo, {fn.qname}, divergences, 0,
                         collect=True)
-        for owner, line, a, b in divergences:
-            key = (owner.module.path, line)
-            if key in seen or _suppressed(owner.module, line, "HVD108"):
+    return divergences
+
+
+def _schedule_hvd108(divergences: List) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    for owner, line, a, b, kind in divergences:
+        if kind != "data":
+            continue
+        key = (owner.module.path, line)
+        if key in seen or _suppressed(owner.module, line, "HVD108"):
+            continue
+        seen.add(key)
+        findings.append(Finding(
+            rule="HVD108", path=owner.module.path, line=line, col=1,
+            message=(
+                f"the if/else branches at line {line} of "
+                f"{owner.name}() emit different collective schedules: "
+                f"[{_render_schedule(a)}] vs [{_render_schedule(b)}] — "
+                f"ranks taking different branches negotiate different "
+                f"sequences")))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# HVD111: branch-divergent interleaving of overlapping process sets
+# ---------------------------------------------------------------------------
+
+def _flat_ops(sched) -> List[Tuple[str, str]]:
+    """Flatten a schedule to its ``(op, lane)`` submission stream.  Branch
+    arms are included in order (a then b) — deterministic, and identical
+    sub-branches contribute identically to both outer arms."""
+    out: List[Tuple[str, str]] = []
+
+    def walk(node):
+        if not isinstance(node, tuple) or not node:
+            return
+        if node[0] == "op":
+            out.append((node[1], node[2] if len(node) > 2 else "world"))
+        elif node[0] in ("seq", "branch"):
+            for item in node[1:]:
+                walk(item)
+        elif node[0] == "loop":
+            walk(node[1])
+
+    walk(sched)
+    return out
+
+
+def _lane_values(pkg: Package) -> Dict[str, ProcessSetValue]:
+    vals: Dict[str, ProcessSetValue] = {}
+    for fn in pkg.iter_functions():
+        for col in fn.collectives:
+            vals.setdefault(col.ps.lane, col.ps)
+    return vals
+
+
+def _schedule_hvd111(divergences: List, pkg: Package) -> List[Finding]:
+    """The cross-communicator deadlock: two branch arms interleave
+    collectives over two PROVEN-overlapping process sets differently.
+    Each set's own lane can even be self-consistent — but the shared
+    ranks execute submissions in program order, so arm A holds set-1's
+    slot while waiting on set-2 and arm B the reverse."""
+    import itertools
+    lane_vals = _lane_values(pkg)
+    findings: List[Finding] = []
+    seen: Set[Tuple] = set()
+    for owner, line, a, b, _kind in divergences:
+        fa, fb = _flat_ops(a), _flat_ops(b)
+        lanes = sorted({lane for _, lane in fa + fb})
+        for l1, l2 in itertools.combinations(lanes, 2):
+            v1, v2 = lane_vals.get(l1), lane_vals.get(l2)
+            if v1 is None or v2 is None or not proven_overlap(v1, v2):
+                continue
+            pa = [(op, ln) for op, ln in fa if ln in (l1, l2)]
+            pb = [(op, ln) for op, ln in fb if ln in (l1, l2)]
+            if pa == pb or not pa or not pb:
+                continue
+            # An actual interleaving requires one arm to touch BOTH lanes;
+            # one-sided pairs are HVD101/HVD108's territory.
+            if not any({ln for _, ln in p} == {l1, l2} for p in (pa, pb)):
+                continue
+            key = (owner.module.path, line, l1, l2)
+            if key in seen or _suppressed(owner.module, line, "HVD111"):
                 continue
             seen.add(key)
+            related = [(owner.module.path, c.line)
+                       for c in owner.collectives if c.ps.lane in (l1, l2)]
+
+            def _fmt(p):
+                return ", ".join(op if ln == "world" else f"{op}@{ln}"
+                                 for op, ln in p)
+
             findings.append(Finding(
-                rule="HVD108", path=owner.module.path, line=line, col=1,
+                rule="HVD111", path=owner.module.path, line=line, col=1,
                 message=(
-                    f"the if/else branches at line {line} of "
-                    f"{owner.name}() emit different collective schedules: "
-                    f"[{_render_schedule(a)}] vs [{_render_schedule(b)}] — "
-                    f"ranks taking different branches negotiate different "
-                    f"sequences")))
+                    f"the branches at line {line} of {owner.name}() submit "
+                    f"collectives over OVERLAPPING process sets "
+                    f"({v1.describe()} and {v2.describe()}) in different "
+                    f"interleavings: [{_fmt(pa)}] vs [{_fmt(pb)}] — ranks "
+                    f"shared by both sets hold one communicator's slot "
+                    f"while waiting on the other: cross-communicator "
+                    f"deadlock"),
+                process_set=f"{v1.lane} | {v2.lane}",
+                related=related or None))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# HVD113: world collective reachable from a process-set-scoped region
+# ---------------------------------------------------------------------------
+
+def _is_bare_world(col: CollectiveSite) -> bool:
+    return not col.has_process_set and col.ps.kind == "world"
+
+
+def _hvd113(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    best: Dict[Tuple[str, int], Tuple[int, Finding]] = {}
+
+    # (a) Interprocedural: a call site binds a concrete registered set into
+    # a helper (process_set=<named>, directly or pinned via partial) whose
+    # closure contains hard-coded world collectives.  Callees that FORWARD
+    # a process_set to a site don't trip it — that's the clean pattern.
+    for fn in pkg.iter_functions():
+        for cs in fn.calls:
+            v = cs.ps_kwarg
+            if v is None or v.kind != "named" or cs.resolved is None:
+                continue
+            targets = [(cs.resolved, (cs,))]
+            targets += [(t, (cs,) + chain)
+                        for t, chain in reachable(cs.resolved,
+                                                  max_depth=_MAX_CHAIN)]
+            for target, chain in targets:
+                for col in target.collectives:
+                    if not _is_bare_world(col):
+                        continue
+                    if _suppressed(target.module, col.line, "HVD113") or \
+                            _suppressed(fn.module, cs.line, "HVD113"):
+                        continue
+                    key = (target.module.path, col.line)
+                    f = Finding(
+                        rule="HVD113", path=target.module.path,
+                        line=col.line, col=col.col,
+                        message=(
+                            f"collective {col.name!r} hard-codes the WORLD "
+                            f"set but is reached from a region scoped to "
+                            f"{v.describe()} "
+                            f"(process_set= bound at {fn.module.base}:"
+                            f"{cs.line}, {_chain_str(fn, chain, target)}) "
+                            f"— only the set's members run this region, "
+                            f"so the world collective waits on ranks that "
+                            f"never arrive (tenant-leak)"),
+                        chain=[_chain_str(fn, chain, target)],
+                        process_set=v.lane,
+                        related=[(fn.module.path, cs.line)])
+                    prev = best.get(key)
+                    if prev is None or len(chain) < prev[0]:
+                        best[key] = (len(chain), f)
+    findings.extend(f for _, f in best.values())
+
+    # (b) Intra-function: a helper that takes a process set and scopes at
+    # least one collective with it (``process_set=<param>``, or forwarding
+    # the param positionally) leaks if another collective in the same body
+    # silently targets the world.
+    for fn in pkg.iter_functions():
+        scoped = [c for c in fn.collectives if c.ps.kind == "param"]
+        if not scoped:
+            continue
+        for col in fn.collectives:
+            if not _is_bare_world(col):
+                continue
+            if _suppressed(fn.module, col.line, "HVD113"):
+                continue
+            key = (fn.module.path, col.line)
+            if key in best:
+                continue
+            v = scoped[0].ps
+            findings.append(Finding(
+                rule="HVD113", path=fn.module.path, line=col.line,
+                col=col.col,
+                message=(
+                    f"collective {col.name!r} hard-codes the WORLD set "
+                    f"inside {fn.name}(), which scopes its other "
+                    f"collectives to {v.describe()} — when a caller binds "
+                    f"a subgroup, only its members reach this line and "
+                    f"the world collective deadlocks (tenant-leak)"),
+                process_set=v.lane,
+                related=[(fn.module.path, c.line) for c in scoped]))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# HVD114: overlapping sets interleaved with no dominating order edge
+# ---------------------------------------------------------------------------
+
+def _suite_streams(fn: FunctionNode):
+    """Yield ``(stream, in_loop)`` per straight-line suite of ``fn``:
+    the function body, each branch arm, each loop/try/with body — WITHOUT
+    mixing arms of one If into a single stream (they never execute
+    together).  ``stream`` is the suite's direct collective sites in
+    source order (nested control-flow suites are yielded separately)."""
+    import ast
+    if fn.node is None:
+        return
+    by_pos = {(c.line, c.col): c for c in fn.collectives}
+
+    def direct_sites(stmt) -> List[CollectiveSite]:
+        out = []
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call):
+                col = by_pos.get((n.lineno, n.col_offset + 1))
+                if col is not None:
+                    out.append(col)
+        return sorted(out, key=lambda c: (c.line, c.col))
+
+    def suites(body, in_loop):
+        stream: List[CollectiveSite] = []
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.If):
+                stream.extend(direct_sites(stmt.test))
+                yield from suites(stmt.body, in_loop)
+                yield from suites(stmt.orelse, in_loop)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                yield from suites(stmt.body, True)
+                yield from suites(stmt.orelse, in_loop)
+            elif isinstance(stmt, ast.Try):
+                yield from suites(stmt.body, in_loop)
+                for h in stmt.handlers:
+                    yield from suites(h.body, in_loop)
+                yield from suites(stmt.orelse, in_loop)
+                yield from suites(stmt.finalbody, in_loop)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                yield from suites(stmt.body, in_loop)
+            else:
+                stream.extend(direct_sites(stmt))
+        yield stream, in_loop
+
+    body = fn.node.body if isinstance(
+        fn.node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)) \
+        else []
+    yield from suites(body, False)
+
+
+def _is_order_edge(col: CollectiveSite) -> bool:
+    """A world-level barrier (or world synchronize) between two lanes
+    dominates both sets' streams: everything before it on every member
+    rank completes before anything after — the order edge HVD114 wants."""
+    return col.ps.kind == "world" and (
+        "barrier" in col.name or col.name == "synchronize")
+
+
+def _hvd114(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+
+    def emit(fn, site, a: CollectiveSite, b: CollectiveSite, looped: bool):
+        key = (fn.module.path, site.line)
+        if key in seen or _suppressed(fn.module, site.line, "HVD114"):
+            return
+        seen.add(key)
+        findings.append(Finding(
+            rule="HVD114", path=fn.module.path, line=site.line,
+            col=site.col,
+            message=(
+                f"{fn.name}() alternates submissions between overlapping "
+                f"process sets ({a.ps.describe()} and {b.ps.describe()}"
+                + (", across loop iterations" if looped else "")
+                + ") with no world barrier between the lanes — nothing "
+                  "establishes a dominating order edge, so scheduling "
+                  "skew on the shared ranks can entangle the two "
+                  "streams"),
+            process_set=f"{a.ps.lane} | {b.ps.lane}"))
+
+    for fn in pkg.iter_functions():
+        for stream, in_loop in _suite_streams(fn):
+            n = len(stream)
+            if n < 2:
+                continue
+            # Straight-line alternation A ... B ... A with no world
+            # barrier anywhere between the first and last leg.
+            for k in range(n):
+                ck = stream[k]
+                if _is_order_edge(ck):
+                    continue
+                for j in range(k):
+                    cj = stream[j]
+                    if cj.ps.lane == ck.ps.lane or \
+                            not proven_overlap(cj.ps, ck.ps):
+                        continue
+                    for i in range(j):
+                        ci = stream[i]
+                        if ci.ps.lane != ck.ps.lane or _is_order_edge(ci):
+                            continue
+                        if any(_is_order_edge(c)
+                               for c in stream[i + 1:k]):
+                            continue
+                        emit(fn, ck, cj, ck, looped=False)
+                        break
+            # A loop body touching two overlapping lanes alternates by
+            # construction (iteration N's tail meets iteration N+1's
+            # head) unless an order edge sits somewhere in the body.
+            if in_loop and not any(_is_order_edge(c) for c in stream):
+                for j in range(n):
+                    for i in range(j):
+                        if stream[i].ps.lane != stream[j].ps.lane and \
+                                proven_overlap(stream[i].ps,
+                                               stream[j].ps):
+                            emit(fn, stream[j], stream[i], stream[j],
+                                 looped=True)
     return findings
 
 
@@ -488,6 +809,8 @@ def _callback_hvd109(pkg: Package) -> List[Finding]:
                         "reducescatter[sharded] + allgather[sharded])"
                         if col.name == "sharded_update" else
                         f"collective {col.name!r}")
+                if col.ps.kind != "world":
+                    what += f" over {col.ps.describe()}"
                 findings.append(Finding(
                     rule="HVD109", path=target.module.path, line=col.line,
                     col=col.col,
@@ -534,7 +857,11 @@ def analyze_package(paths: Sequence[str],
             findings.append(finding)
     findings += _interprocedural_hvd101(pkg)
     findings += _closure_facts_hvd102_103(pkg)
-    findings += _schedule_hvd108(pkg)
+    divergences = _collect_divergences(pkg)
+    findings += _schedule_hvd108(divergences)
+    findings += _schedule_hvd111(divergences, pkg)
+    findings += _hvd113(pkg)
+    findings += _hvd114(pkg)
     findings += _callback_hvd109(pkg)
     uniq: Dict[Tuple[str, str, int, int], Finding] = {}
     for f in findings:
@@ -555,10 +882,12 @@ def build_static_index(paths: Sequence[str],
         findings = analyze_package(paths, package=pkg)
     rules_by_site: Dict[str, List[str]] = {}
     for f in findings:
-        site = f"{os.path.basename(f.path)}:{f.line}"
-        rules = rules_by_site.setdefault(site, [])
-        if f.rule not in rules:
-            rules.append(f.rule)
+        anchors = [(f.path, f.line)] + list(f.related or [])
+        for path, line in anchors:
+            site = f"{os.path.basename(path)}:{line}"
+            rules = rules_by_site.setdefault(site, [])
+            if f.rule not in rules:
+                rules.append(f.rule)
     sites: Dict[str, Dict] = {}
     for fn in pkg.iter_functions():
         for i, col in enumerate(fn.collectives):
@@ -568,6 +897,7 @@ def build_static_index(paths: Sequence[str],
                 "op": col.name,
                 "index": i,
                 "guarded": col.guard is not None,
+                "process_set": col.ps.lane,
                 "rules": rules_by_site.get(site, []),
             }
     return {"version": 1, "sites": sites}

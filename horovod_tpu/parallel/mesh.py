@@ -83,6 +83,31 @@ def process_set_sharding(process_set=None,
                          process_set_spec(process_set, axis_name))
 
 
+def axes_of(mesh: Mesh) -> Tuple[str, ...]:
+    """The mesh's named axes, in physical order.  The runtime twin of the
+    analyzer's mesh-axis extraction (HVD112): code that builds collective
+    axis names dynamically should validate them against this set."""
+    return tuple(str(a) for a in mesh.axis_names)
+
+
+def require_axis(mesh: Mesh, axis_name: str) -> str:
+    """Assert ``axis_name`` is bound by ``mesh`` and return it.
+
+    The runtime counterpart of HVD112: a collective over an axis its
+    binding mesh does not define either fails deep inside lowering with
+    an unhelpful traceback or — worse, with an outer binding in scope —
+    silently reduces over the WRONG axis.  Call this where the axis name
+    is computed rather than literal (literal names are already covered
+    statically by ``collective_lint``/``trace_check``)."""
+    names = axes_of(mesh)
+    if axis_name not in names:
+        raise ValueError(
+            f"axis {axis_name!r} is not bound by this mesh (axes: "
+            f"{list(names)}) — a collective over it would fail at "
+            f"lowering or reduce over the wrong communicator (HVD112)")
+    return axis_name
+
+
 def make_mesh(axis_sizes: Dict[str, int],
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build a named mesh, e.g. ``make_mesh({"dp": 2, "tp": 2, "sp": 2})``.

@@ -57,6 +57,23 @@ def shard_params(params, param_specs, mesh: Mesh):
                                   is_leaf=lambda x: hasattr(x, "shape"))
 
 
+def _spec_axes(spec_trees) -> set:
+    """Every mesh axis the given PartitionSpec trees shard over — the
+    declared partition axes handed to the trace checker's HVD112 pass."""
+    axes = set()
+    leaves = jax.tree_util.tree_leaves(
+        spec_trees, is_leaf=lambda x: isinstance(x, P))
+    for leaf in leaves:
+        if not isinstance(leaf, P):
+            continue
+        for entry in leaf:
+            if isinstance(entry, str):
+                axes.add(entry)
+            elif isinstance(entry, (tuple, list)):
+                axes.update(a for a in entry if isinstance(a, str))
+    return axes
+
+
 def make_sharded_train_step(step_fn: Callable, mesh: Mesh,
                             param_specs, opt_state_specs,
                             data_spec, check=False) -> Callable:
@@ -96,12 +113,18 @@ def make_sharded_train_step(step_fn: Callable, mesh: Mesh,
     from ..analysis import trace_check
     from ..utils.logging import get_logger
     checked = []
+    # The axes the step's partition specs actually shard over: a traced
+    # collective reducing over a mesh axis OUTSIDE this set runs over
+    # replicated data (the fsdp × tp mismatch) — trace_check flags it as
+    # HVD112, the jaxpr twin of collective_lint's AST check.
+    declared = _spec_axes((param_specs, opt_state_specs, data_spec))
 
     def checking_step(params, opt_state, tokens, targets):
         if not checked:
             checked.append(True)
             report = trace_check.check_step_fn(
                 sharded, params, opt_state, tokens, targets, mesh=mesh,
+                partition_axes=sorted(declared) if declared else None,
                 path="<make_sharded_train_step>")
             errors = [f for f in report.findings if f.is_error]
             if errors and check == "strict":

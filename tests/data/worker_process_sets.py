@@ -1,0 +1,109 @@
+"""Per-process-set sanitizer namespace worker (ISSUE 16 acceptance).
+
+Two singleton tenant sets (A = rank 0, B = rank 1) run collectives
+concurrently with world traffic.  The ranks deliberately interleave the
+WORLD lane in opposite orders — the cross-set submission-order divergence
+the static analyzer flags as HVD111 on this very file — while each
+tenant's own stream is clean.
+
+With ``HVD_TPU_SANITIZER=1`` the divergence is attributed to the world
+namespace (``seq=0:<i>`` tags) as a fail-fast NegotiationError; each
+tenant's collective completes undisturbed and its per-set ledger view
+shows exactly its own submission at ``seq=<set>:0``.  With
+``HVD_TPU_SANITIZER_STATIC_INDEX`` pointing at this file's emitted index,
+the ledger tail names the HVD111 node that flagged the divergent sites
+statically.
+
+Prints ``PROCESS_SET_OK`` when attribution lands on the right namespace
+and the tenant streams survive.
+"""
+
+import os
+
+# Each worker is one rank with ONE cpu device: strip the 8-virtual-device
+# flag inherited from the test process, use gloo for cross-process XLA
+# collectives (same preamble as worker_collectives.py).
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+from horovod_tpu.common.controller import NegotiationError
+
+
+def main():
+    hvd.init()
+    rank = hvd.rank()
+    assert hvd.size() == 2, "worker expects -np 2"
+
+    # Both ranks register BOTH sets (registration must be uniform); each
+    # rank is the sole member of its own tenant set.
+    a_set = hvd.add_process_set([0])
+    b_set = hvd.add_process_set([1])
+    tenant = a_set if rank == 0 else b_set
+    mine = float(rank + 1)
+
+    a = np.ones(4, np.float32)
+    b = np.full((4,), 2.0, np.float32)
+
+    try:
+        if rank == 0:   # hvd-lint: disable=HVD101  (deliberate divergence)
+            ha = hvd.allreduce_async(a, name="world.a")  # hvd-lint: disable=HVD101,HVD102
+            t_out = hvd.to_local(hvd.allreduce(  # hvd-lint: disable=HVD101
+                np.full((2,), mine, np.float32), name="tenant.t",
+                op=hvd.Sum, process_set=a_set))
+            hb = hvd.allreduce_async(b, name="world.b")  # hvd-lint: disable=HVD101,HVD102
+        else:
+            t_out = hvd.to_local(hvd.allreduce(  # hvd-lint: disable=HVD101
+                np.full((2,), mine, np.float32), name="tenant.t",
+                op=hvd.Sum, process_set=b_set))
+            hb = hvd.allreduce_async(b, name="world.b")  # hvd-lint: disable=HVD101,HVD102
+            ha = hvd.allreduce_async(a, name="world.a")  # hvd-lint: disable=HVD101,HVD102  (deliberate world-lane order swap)
+        # The tenant stream already completed (singleton negotiation) —
+        # only the world lane is entangled.
+        np.testing.assert_allclose(np.asarray(t_out).reshape(2),
+                                   np.full(2, mine, np.float32))
+        hvd.synchronize([ha, hb])
+        print("PROCESS_SET_MISSED", flush=True)
+    except NegotiationError as e:
+        msg = str(e)
+        # Attributed to the WORLD namespace, at this file's call sites.
+        assert "seq=0:" in msg, msg
+        assert "site=worker_process_sets.py" in msg, msg
+        # NOT attributed to either tenant's namespace.
+        assert f"seq={a_set.process_set_id}:" not in msg, msg
+        assert f"seq={b_set.process_set_id}:" not in msg, msg
+
+        san = basics._get_state().engine.sanitizer
+        assert san is not None
+        # This tenant's ledger view: exactly its own clean submission,
+        # numbered in its own namespace, untouched by world traffic.
+        view = san.tail(process_set=tenant.process_set_id)
+        assert [en.name for en in view] == ["tenant.t"], view
+        assert view[0].seq == 0 and \
+            view[0].process_set == tenant.process_set_id
+        scoped = san.render_tail(process_set=tenant.process_set_id)
+        assert f"process set {tenant.process_set_id}" in scoped, scoped
+        assert f"#{tenant.process_set_id}:0 tenant.t" in scoped, scoped
+        # World view holds ONLY the divergent world pair, in this rank's
+        # submission order.
+        world = [en.name for en in san.tail(process_set=0)]
+        want = ["world.a", "world.b"] if rank == 0 \
+            else ["world.b", "world.a"]
+        assert world == want, world
+        # Static linkage: the combined tail names the HVD111 node the
+        # whole-package analyzer pinned on these sites before launch.
+        tail = san.render_tail()
+        assert "HVD111" in tail and "statically" in tail, tail
+        print("PROCESS_SET_OK", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

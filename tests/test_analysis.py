@@ -897,3 +897,211 @@ def test_hvd204_clean_on_multi_axis_ring():
                            axis_sizes={"a": 2, "b": 2})
     assert not any(f.rule == "HVD204" for f in report.findings), \
         [f.render() for f in report.findings]
+
+
+# =============================================== process-set lanes (ISSUE 16)
+# Satellite: sorted() neutralizes the ITERATION order, but rank-varying
+# process_set=/priorities= kwargs on the grouped op still diverge per rank.
+def test_hvd105_sorted_does_not_excuse_rank_varying_process_set():
+    findings = lint("""
+        import horovod_tpu as hvd
+        for k in sorted(groups.keys()):
+            hvd.grouped_allreduce(groups[k],
+                                  process_set=sets[hvd.rank() % 2])
+    """)
+    hits = [f for f in findings if f.rule == "HVD105"]
+    assert hits and "process_set=" in hits[0].message
+
+
+def test_hvd104_sorted_key_derived_from_rank_is_no_neutralizer():
+    findings = lint("""
+        import horovod_tpu as hvd
+        r = hvd.rank()
+        for name in sorted(set(grads), key=lambda n: (hash(n) + r) % 7):
+            hvd.allreduce_async(grads[name], name=name)
+    """)
+    assert "HVD104" in rules_of(findings)
+
+
+def test_hvd104_105_sorted_with_uniform_kwargs_stays_quiet():
+    findings = lint("""
+        import horovod_tpu as hvd
+        for k in sorted(groups.keys()):
+            hvd.grouped_allreduce(groups[k], process_set=tenants)
+    """)
+    assert not ({"HVD104", "HVD105"} & rules_of(findings))
+
+
+# HVD112 (AST half): collective axis absent from its binding mesh.
+def test_hvd112_fires_on_axis_absent_from_shard_map_mesh():
+    findings = lint("""
+        import jax
+        from functools import partial
+        from jax import lax
+        from jax.sharding import Mesh, PartitionSpec as P
+        from horovod_tpu.parallel import make_mesh
+        from horovod_tpu.compat import shard_map
+
+        mesh = make_mesh({"fsdp": 4, "tp": 2})
+
+        @partial(shard_map, mesh=mesh, in_specs=P("fsdp"),
+                 out_specs=P("fsdp"))
+        def step(x):
+            return lax.psum(x, "dp")
+    """)
+    hits = [f for f in findings if f.rule == "HVD112"]
+    assert hits and hits[0].is_error
+    assert "'dp'" in hits[0].message and "fsdp" in hits[0].message
+
+
+def test_hvd112_fires_on_partition_spec_with_unknown_axis():
+    findings = lint("""
+        from functools import partial
+        from jax import lax
+        from horovod_tpu.parallel import make_mesh
+        from horovod_tpu.compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = make_mesh({"fsdp": 4, "tp": 2})
+
+        def impl(x):
+            return lax.psum(x, "tp")
+
+        step = shard_map(impl, mesh=mesh, in_specs=P("badaxis"),
+                         out_specs=P("fsdp"))
+    """)
+    hits = [f for f in findings if f.rule == "HVD112"]
+    assert hits and "badaxis" in hits[0].message
+
+
+def test_hvd112_quiet_when_axis_is_bound():
+    findings = lint("""
+        from functools import partial
+        from jax import lax
+        from horovod_tpu.parallel import make_mesh
+        from horovod_tpu.compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = make_mesh({"fsdp": 4, "tp": 2})
+
+        @partial(shard_map, mesh=mesh, in_specs=P("fsdp"),
+                 out_specs=P(("fsdp", "tp")))
+        def step(x):
+            x = lax.psum(x, "tp")
+            return lax.pmean(x, ("fsdp", "tp"))
+    """)
+    assert "HVD112" not in rules_of(findings)
+
+
+def test_hvd112_quiet_when_mesh_axes_unknown():
+    """Dynamically built meshes (non-literal axis dict) resolve to no
+    axis set: the rule must stay conservative, not guess."""
+    findings = lint("""
+        from functools import partial
+        from jax import lax
+        from horovod_tpu.parallel import make_mesh
+        from horovod_tpu.compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = make_mesh(dict(axes))
+
+        @partial(shard_map, mesh=mesh, in_specs=P("fsdp"),
+                 out_specs=P("fsdp"))
+        def step(x):
+            return lax.psum(x, "anything")
+    """)
+    assert "HVD112" not in rules_of(findings)
+
+
+# HVD112 (jaxpr half): bound axis outside the declared partition axes.
+def test_trace_check_hvd112_bound_but_undeclared_axis():
+    import jax.numpy as jnp
+    from jax import lax
+    from horovod_tpu.analysis.trace_check import check_step_fn
+
+    def step(x):
+        g = lax.psum(x, "dp")            # declared: fine
+        return lax.psum(g, "tp")         # bound but undeclared: HVD112
+
+    report = check_step_fn(step, jnp.ones((4,)),
+                           axis_sizes={"dp": 2, "tp": 2},
+                           partition_axes=["dp"])
+    hits = [f for f in report.findings if f.rule == "HVD112"]
+    assert len(hits) == 1 and hits[0].is_error
+    assert "'tp'" in hits[0].message and "replicated" in hits[0].message
+
+
+def test_trace_check_hvd112_axis_index_is_exempt():
+    """axis_index over an undeclared axis moves no data (rng folding,
+    shard bookkeeping) — only data-moving collectives fire."""
+    import jax.numpy as jnp
+    from jax import lax
+    from horovod_tpu.analysis.trace_check import check_step_fn
+
+    def step(x):
+        i = lax.axis_index("tp")
+        return lax.psum(x + i, "dp")
+
+    report = check_step_fn(step, jnp.ones((4,)),
+                           axis_sizes={"dp": 2, "tp": 2},
+                           partition_axes=["dp"])
+    assert "HVD112" not in {f.rule for f in report.findings}
+
+
+def test_trace_check_no_partition_axes_keeps_old_contract():
+    import jax.numpy as jnp
+    from jax import lax
+    from horovod_tpu.analysis.trace_check import check_step_fn
+
+    def step(x):
+        return lax.psum(lax.psum(x, "dp"), "tp")
+
+    report = check_step_fn(step, jnp.ones((4,)),
+                           axis_sizes={"dp": 2, "tp": 2})
+    assert not report.findings
+
+
+def test_spmd_derives_partition_axes_from_specs():
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.parallel.spmd import _spec_axes
+
+    axes = _spec_axes((P("dp"), {"w": P(None, ("fsdp", "tp"))}, P()))
+    assert axes == {"dp", "fsdp", "tp"}
+    assert _spec_axes((P(), P())) == set()
+
+
+# Per-process-set sanitizer namespace.
+def test_sanitizer_ledger_is_namespaced_per_process_set():
+    from horovod_tpu.analysis.runtime_sanitizer import CollectiveSanitizer
+
+    s = CollectiveSanitizer(capacity=8)
+    w0, t0, w1 = _FakeEntry("w0"), _FakeEntry("t0"), _FakeEntry("w1")
+    t0.process_set_id = 7
+    s.observe([w0]); s.observe([t0]); s.observe([w1])
+    # Combined stream sees everything; per-set views are filtered.
+    assert [e.name for e in s.tail()] == ["w0", "t0", "w1"]
+    assert [e.name for e in s.tail(process_set=7)] == ["t0"]
+    assert [e.name for e in s.tail(process_set=0)] == ["w0", "w1"]
+    # Rendered per-set tails are scoped and prefixed with the namespace.
+    r = s.render_tail(process_set=7)
+    assert "process set 7" in r and "#7:0 t0" in r
+    assert s.render_tail(process_set=3) == \
+        "(collective ledger (process set 3) empty)"
+
+
+def test_sanitizer_rollback_pops_per_set_view_too():
+    from horovod_tpu.analysis.runtime_sanitizer import CollectiveSanitizer
+
+    s = CollectiveSanitizer()
+    keep = _FakeEntry("keep")
+    keep.process_set_id = 7
+    s.observe([keep])
+    rejected = _FakeEntry("dup")
+    rejected.process_set_id = 7
+    s.observe([rejected])
+    s.rollback([rejected])
+    assert [e.name for e in s.tail(process_set=7)] == ["keep"]
+    after = _FakeEntry("after")
+    after.process_set_id = 7
+    s.observe([after])
+    assert after.sanitizer_tag.startswith("seq=7:1;")

@@ -498,6 +498,26 @@ importlib.import_module('horovod_tpu.monitor')
 importlib.import_module('horovod_tpu.monitor.__main__')
 importlib.import_module('horovod_tpu.monitor.http')
 importlib.import_module('horovod_tpu.analysis.findings')
+# Per-process-set sanitizer namespace (ISSUE 16): the ledger recorder
+# must import AND keep per-set books correctly with jax hard-blocked —
+# it runs in launcher-adjacent tooling and the jax-free test tier.
+rs = importlib.import_module('horovod_tpu.analysis.runtime_sanitizer')
+san = rs.CollectiveSanitizer(capacity=4)
+class _E:
+    def __init__(self, name, ps):
+        self.name = name
+        self.tensor = None
+        self.process_set_id = ps
+a, b, c = _E('w', 0), _E('t', 7), _E('w2', 0)
+san.observe([a], site='x.py:1')
+san.observe([b], site='x.py:2')
+san.observe([c], site='x.py:3')
+assert a.sanitizer_tag.startswith('seq=0:0;'), a.sanitizer_tag
+assert b.sanitizer_tag.startswith('seq=7:0;'), b.sanitizer_tag
+assert c.sanitizer_tag.startswith('seq=0:1;'), c.sanitizer_tag
+assert [e.name for e in san.tail(process_set=7)] == ['t']
+assert [e.name for e in san.tail()] == ['w', 't', 'w2']
+assert 'process set 7' in san.render_tail(process_set=7)
 # Distributed tracing: the span core, the merge/analyze halves and the CLI
 # must run standalone (operators merge traces on machines without jax).
 importlib.import_module('horovod_tpu.trace')

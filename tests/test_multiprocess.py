@@ -471,6 +471,38 @@ def test_sanitizer_off_misses_divergent_order():
         f"stderr:\n{res.stderr[-3000:]}")
 
 
+WORKER_PS = os.path.join(REPO, "tests", "data", "worker_process_sets.py")
+
+
+def test_process_set_namespaced_sanitizer_attribution(tmp_path):
+    """ISSUE 16 acceptance: two tenant process sets run collectives
+    concurrently with world traffic; the ranks deliberately swap the WORLD
+    lane's submission order.  The namespaced sanitizer must attribute the
+    divergence to the world namespace (seq=0:<i> tags), leave each
+    tenant's per-set ledger view clean (exactly its own submission at
+    seq=<set>:0), and — via HVD_TPU_SANITIZER_STATIC_INDEX — name the
+    HVD111 node the whole-package analyzer pinned on these very sites
+    before launch."""
+    import json
+    from horovod_tpu.analysis.whole_package import build_static_index
+
+    index = build_static_index([WORKER_PS])
+    flagged = [k for k, v in index["sites"].items()
+               if "HVD111" in v.get("rules", ())]
+    assert flagged, index  # the analyzer must flag the worker's own sites
+    idx_path = tmp_path / "worker_ps_index.json"
+    idx_path.write_text(json.dumps(index))
+
+    res = _run_torovodrun(
+        2, WORKER_PS, timeout=300,
+        extra_env={"HVD_TPU_SANITIZER": "1",
+                   "HVD_TPU_SANITIZER_STATIC_INDEX": str(idx_path)})
+    ok = res.stdout.count("PROCESS_SET_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
 WORKER_EST = os.path.join(REPO, "tests", "data", "worker_estimator.py")
 
 

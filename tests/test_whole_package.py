@@ -954,3 +954,362 @@ def test_cli_emit_static_index(tmp_path):
     assert data["version"] == 1 and "helpers.py:5" in data["sites"]
     # --emit-static-index without --whole-package is a usage error.
     assert main([pkg, "--emit-static-index", str(out)]) == 2
+
+
+# ========================================== process-set dataflow (ISSUE 16)
+# HVD111: overlapping sets, branch-divergent interleaving (the
+# cross-communicator deadlock).  World overlaps every registered set;
+# named sets overlap when their literal rank lists intersect.
+OVERLAP_INTERLEAVE = {
+    "step.py": """
+        import horovod_tpu as hvd
+
+        tenants = hvd.add_process_set([0, 1])
+
+        def step(x):
+            if hvd.rank() == 0:
+                hvd.allreduce(x, name="w")
+                hvd.allreduce(x, name="t", process_set=tenants)
+            else:
+                hvd.allreduce(x, name="t", process_set=tenants)
+                hvd.allreduce(x, name="w")
+    """,
+}
+
+
+def test_hvd111_overlapping_sets_divergent_interleaving(tmp_path):
+    pkg = make_pkg(tmp_path, OVERLAP_INTERLEAVE)
+    hits = by_rule(analyze_package([pkg]), "HVD111")
+    assert len(hits) == 1 and hits[0].is_error
+    f = hits[0]
+    assert f.line == 7                              # the `if` line
+    assert "OVERLAPPING" in f.message and "deadlock" in f.message
+    assert f.process_set == "tenants | world"
+    # Related sites: all four collective lines ride the finding so the
+    # static index can anchor runtime reports to any of them.
+    assert len(f.related) == 4
+
+
+def test_hvd111_named_overlap_via_shared_ranks(tmp_path):
+    """Two named sets sharing rank 1: the overlap is proven from the
+    literal rank lists, no world collective involved."""
+    pkg = make_pkg(tmp_path, {
+        "step.py": """
+            import horovod_tpu as hvd
+
+            left = hvd.add_process_set([0, 1])
+            mid = hvd.add_process_set([1, 2])
+
+            def step(x, flag):
+                if flag:
+                    hvd.allreduce(x, name="a", process_set=left)
+                    hvd.allreduce(x, name="m", process_set=mid)
+                else:
+                    hvd.allreduce(x, name="m", process_set=mid)
+                    hvd.allreduce(x, name="a", process_set=left)
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD111")
+    assert len(hits) == 1
+    assert "ranks [0, 1]" in hits[0].message
+    assert "ranks [1, 2]" in hits[0].message
+
+
+def test_hvd111_disjoint_sets_interleaved_stay_clean(tmp_path):
+    """The near-miss: DISJOINT sets interleaved differently are two
+    independent streams — reorderable without deadlock, NOT HVD111 (the
+    data-divergent schedule itself is still HVD108's call)."""
+    pkg = make_pkg(tmp_path, {
+        "step.py": """
+            import horovod_tpu as hvd
+
+            left = hvd.add_process_set([0, 1])
+            right = hvd.add_process_set([2, 3])
+
+            def step(x, flag):
+                if flag:
+                    hvd.allreduce(x, name="a", process_set=left)
+                    hvd.allreduce(x, name="b", process_set=right)
+                else:
+                    hvd.allreduce(x, name="b", process_set=right)
+                    hvd.allreduce(x, name="a", process_set=left)
+        """,
+    })
+    findings = analyze_package([pkg])
+    assert "HVD111" not in rules_of(findings)
+    assert "HVD108" in rules_of(findings)    # still a divergent schedule
+
+
+def test_hvd111_one_sided_pair_is_not_an_interleaving(tmp_path):
+    """Arms that each touch ONE lane never interleave two communicators
+    on a single rank's program order — HVD101/108 territory, not 111."""
+    pkg = make_pkg(tmp_path, {
+        "step.py": """
+            import horovod_tpu as hvd
+
+            tenants = hvd.add_process_set([0, 1])
+
+            def step(x, flag):
+                if flag:
+                    hvd.allreduce(x, name="w")
+                else:
+                    hvd.allreduce(x, name="t", process_set=tenants)
+        """,
+    })
+    assert "HVD111" not in rules_of(analyze_package([pkg]))
+
+
+def test_property_no_false_hvd111_on_provably_disjoint_sets(tmp_path):
+    """Property: random call graphs whose process sets have pairwise
+    DISJOINT literal rank lists must never fire HVD111, however the arms
+    interleave them (directly or through helpers)."""
+    import random
+    rng = random.Random(20260807)
+    for trial in range(8):
+        nsets = rng.randint(2, 4)
+        names = [f"s{i}" for i in range(nsets)]
+        lines = ["import horovod_tpu as hvd", ""]
+        for i, n in enumerate(names):
+            ranks = list(range(10 * i, 10 * i + rng.randint(1, 5)))
+            lines.append(f"{n} = hvd.add_process_set({ranks})")
+        nh = rng.randint(0, 3)
+        for j in range(nh):
+            s = rng.choice(names)
+            lines += ["", f"def h{j}(x):",
+                      f"    return hvd.allreduce(x, name='h{j}', "
+                      f"process_set={s})"]
+
+        def arm_ops():
+            ops = []
+            for _ in range(rng.randint(1, 4)):
+                if nh and rng.random() < 0.4:
+                    ops.append(f"h{rng.randrange(nh)}(x)")
+                else:
+                    ops.append(
+                        f"hvd.allreduce(x, name='d{rng.randrange(99)}', "
+                        f"process_set={rng.choice(names)})")
+            return ops
+
+        test = rng.choice(["hvd.rank() == 0", "flag"])
+        lines += ["", "def step(x, flag):", f"    if {test}:"]
+        lines += [f"        {op}" for op in arm_ops()]
+        lines += ["    else:"]
+        lines += [f"        {op}" for op in arm_ops()]
+        pkg = make_pkg(tmp_path, {"step.py": "\n".join(lines) + "\n"},
+                       name=f"prop{trial}")
+        hits = by_rule(analyze_package([pkg]), "HVD111")
+        assert not hits, (
+            f"false HVD111 on provably disjoint sets (trial {trial}):\n"
+            + "\n".join(lines) + "\n"
+            + "\n".join(f.render() for f in hits))
+
+
+# HVD113: hard-coded world collective reachable from a set-scoped region.
+LEAKY_TENANT = {
+    "helpers.py": """
+        import horovod_tpu as hvd
+
+        def scoped_helper(x, process_set=None):
+            hvd.allreduce(x, name="g", process_set=process_set)
+            hvd.barrier()
+
+        def clean_helper(x, process_set=None):
+            hvd.allreduce(x, name="g", process_set=process_set)
+            hvd.barrier(process_set=process_set)
+    """,
+    "train.py": """
+        import horovod_tpu as hvd
+        from .helpers import scoped_helper, clean_helper
+
+        tenants = hvd.add_process_set([0, 1])
+
+        def main(x):
+            scoped_helper(x, process_set=tenants)
+            clean_helper(x, process_set=tenants)
+    """,
+}
+
+
+def test_hvd113_world_collective_in_set_scoped_helper(tmp_path):
+    pkg = make_pkg(tmp_path, {"__init__.py": "", **LEAKY_TENANT},
+                   name="leaky")
+    hits = by_rule(analyze_package([pkg]), "HVD113")
+    assert len(hits) == 1 and hits[0].is_error
+    f = hits[0]
+    assert f.path.endswith("helpers.py") and f.line == 6   # the barrier
+    assert "tenant-leak" in f.message
+    assert f.process_set == "tenants"
+    assert f.chain and "scoped_helper" in f.chain[0]
+    # clean_helper forwards the set to every collective: refuted.
+    assert all(h.line != 10 for h in hits)
+
+
+def test_hvd113_intra_function_leak(tmp_path):
+    """The single-function form: one collective scoped by the function's
+    own process-set parameter, another silently world."""
+    pkg = make_pkg(tmp_path, {
+        "mix.py": """
+            import horovod_tpu as hvd
+
+            def reduce_and_sync(x, process_set=None):
+                hvd.allreduce(x, name="g", process_set=process_set)
+                hvd.allgather(x)
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD113")
+    assert len(hits) == 1 and hits[0].line == 6
+    assert "WORLD" in hits[0].message
+
+
+def test_hvd113_axis_variable_carries_the_set(tmp_path):
+    """``axis = ps.axis_name`` then an in-graph collective over that axis
+    variable is set-scoped, not a bare world site — the near-miss the
+    repo's own jax/optimizer.py pattern exercises."""
+    pkg = make_pkg(tmp_path, {
+        "graft.py": """
+            import horovod_tpu as hvd
+
+            def allreduce_gradients(x, axis_name="hvd", process_set=None):
+                if process_set is not None:
+                    axis_name = process_set.axis_name
+                hvd.grouped_allreduce([x], axis_name=axis_name)
+                return hvd.allreduce(x, name="g",
+                                     process_set=process_set)
+        """,
+    })
+    assert "HVD113" not in rules_of(analyze_package([pkg]))
+
+
+# HVD114: overlapping sets alternated with no dominating order edge.
+def test_hvd114_alternation_without_order_edge(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "pump.py": """
+            import horovod_tpu as hvd
+
+            tenants = hvd.add_process_set([0, 1])
+
+            def pump(x):
+                hvd.allreduce(x, name="w1")
+                hvd.allreduce(x, name="t", process_set=tenants)
+                hvd.allreduce(x, name="w2")
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD114")
+    assert len(hits) == 1 and not hits[0].is_error   # WARNING severity
+    assert hits[0].line == 9                         # the returning leg
+    assert "order edge" in hits[0].message
+
+
+def test_hvd114_world_barrier_refutes(tmp_path):
+    """The near-miss: a world barrier between the legs IS the dominating
+    order edge — both sets' streams are fenced, no entanglement."""
+    pkg = make_pkg(tmp_path, {
+        "pump.py": """
+            import horovod_tpu as hvd
+
+            tenants = hvd.add_process_set([0, 1])
+
+            def pump(x):
+                hvd.allreduce(x, name="w1")
+                hvd.allreduce(x, name="t", process_set=tenants)
+                hvd.barrier()
+                hvd.allreduce(x, name="w2")
+        """,
+    })
+    assert "HVD114" not in rules_of(analyze_package([pkg]))
+
+
+def test_hvd114_loop_body_alternation(tmp_path):
+    """Inside a loop the back-edge closes the alternation: two
+    overlapping lanes in one iteration entangle with the NEXT iteration
+    even without an A-B-A in straight-line order."""
+    pkg = make_pkg(tmp_path, {
+        "pump.py": """
+            import horovod_tpu as hvd
+
+            tenants = hvd.add_process_set([0, 1])
+
+            def pump(xs):
+                for x in xs:
+                    hvd.allreduce(x, name="w")
+                    hvd.allreduce(x, name="t", process_set=tenants)
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD114")
+    assert len(hits) == 1
+    assert "across loop iterations" in hits[0].message
+
+
+def test_hvd114_disjoint_sets_never_warn(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "pump.py": """
+            import horovod_tpu as hvd
+
+            left = hvd.add_process_set([0, 1])
+            right = hvd.add_process_set([2, 3])
+
+            def pump(x):
+                hvd.allreduce(x, name="a", process_set=left)
+                hvd.allreduce(x, name="b", process_set=right)
+                hvd.allreduce(x, name="c", process_set=left)
+        """,
+    })
+    assert "HVD114" not in rules_of(analyze_package([pkg]))
+
+
+# ------------------------------------------- explain / SARIF / static index
+def test_gate_explain_prints_chain_and_process_set(tmp_path, capsys):
+    from horovod_tpu.analysis.gate import explain
+
+    make_pkg(tmp_path, {"__init__.py": "", **LEAKY_TENANT},
+             name="horovod_tpu")
+    f = by_rule(analyze_package([str(tmp_path / "horovod_tpu")]),
+                "HVD113")[0]
+    rc = explain(f"HVD113:helpers.py:{f.line}", root=str(tmp_path))
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "process set(s): tenants" in out
+    assert "call chain:" in out and "scoped_helper" in out
+    assert "related collective sites:" in out
+
+    assert explain("HVD113:helpers.py:9999", root=str(tmp_path),
+                   quiet=True) == 1
+    assert explain("not-a-spec", root=str(tmp_path)) == 2
+
+
+def test_sarif_carries_process_set_property(tmp_path):
+    from horovod_tpu.analysis.sarif import to_sarif
+
+    pkg = make_pkg(tmp_path, {"__init__.py": "", **LEAKY_TENANT},
+                   name="leaky")
+    findings = by_rule(analyze_package([pkg]), "HVD113")
+    log = to_sarif(findings, root=pkg)
+    props = [r.get("properties", {}) for r in log["runs"][0]["results"]]
+    assert any(p.get("processSet") == "tenants" for p in props)
+    assert any("callChain" in p for p in props)
+
+
+def test_static_index_records_lanes_and_hvd111_anchors(tmp_path):
+    pkg = make_pkg(tmp_path, OVERLAP_INTERLEAVE)
+    index = build_static_index([pkg])
+    lanes = {rec.get("process_set") for rec in index["sites"].values()}
+    assert {"world", "tenants"} <= lanes
+    # HVD111's related anchors: every involved collective line carries
+    # the rule, so a runtime per-set report links back to the node.
+    flagged = [s for s, rec in index["sites"].items()
+               if "HVD111" in rec.get("rules", ())]
+    assert len(flagged) == 4, index["sites"]
+
+
+def test_gate_crash_in_process_set_pass_exits_3(tmp_path, monkeypatch,
+                                                capsys):
+    """Satellite: an analyzer crash inside the new process-set pass must
+    surface as the gate's exit 3 (linter broken), never a silent green."""
+    from horovod_tpu.analysis import gate, whole_package
+
+    def boom(pkg):
+        raise RuntimeError("synthetic process-set pass bug")
+
+    monkeypatch.setattr(whole_package, "_hvd113", boom)
+    assert gate.main([]) == 3
+    assert "exit 3" in capsys.readouterr().err

@@ -11,6 +11,10 @@ finding.
   python tools/lint_gate.py                   # gate (exit 1 on new findings)
   python tools/lint_gate.py --update-baseline # re-baseline after review
   python tools/lint_gate.py --sarif out.sarif # CI annotation feed
+  python tools/lint_gate.py --explain HVD113:horovod_tpu/x.py:42
+      # print the interprocedural call chain + resolved process-set
+      # values behind one finding (baselining decisions without a
+      # debugger)
 """
 
 import os
