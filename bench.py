@@ -714,6 +714,110 @@ def bench_sharded_ab(errors=None, steps=None, elems=None):
     return out
 
 
+def bench_hierarchical_ab(errors=None, steps=None, sizes=None):
+    """Two-level ICI/DCN allreduce A/B (ISSUE 17): the flat world ring vs
+    RS(local) → AR(cross) → AG(local) through the LIVE engine path, over
+    2 simulated slices of the single-process mesh, per payload size.
+
+    Three things land on every JSON line:
+
+    - **wall time per dispatch**, flat vs hierarchical (on a CPU mesh the
+      two-level pipeline's three launches usually lose — the measured
+      ``crossover_mb``, the smallest size where it wins, is therefore
+      often null here; on a real multi-slice pod the DCN byte saving
+      dominates past the crossover and the autotuner's ``hier_threshold``
+      coordinate learns it);
+    - **modeled per-link-class wire bytes** (ring model,
+      ``parallel.topology.modeled_leg_bytes``): the cross-slice leg
+      carries ≤ 1/local_size of the flat ring's bytes — asserted, the
+      headline claim;
+    - **bitwise_identical** — integer-valued payloads, so any combination
+      order must produce the same bits; a False here is a data-plane bug,
+      never fp noise.
+    """
+    import jax
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+    from horovod_tpu.parallel.topology import modeled_leg_bytes
+
+    if jax.process_count() > 1:
+        return None                      # single-controller section
+    world = hvd.size()
+    if world < 4 or world % 2:
+        return None                      # needs 2 slices of ≥ 2
+    t_section = time.perf_counter()
+    local = world // 2
+    if steps is None:
+        steps = int(os.environ.get("HVD_BENCH_HIER_STEPS", "5"))
+    if sizes is None:
+        sizes = [int(s) for s in os.environ.get(
+            "HVD_BENCH_HIER_SIZES", "4096,65536,1048576").split(",")]
+
+    eng = basics._get_state().engine
+    saved = (eng._hier_local_size, eng.slice_map)
+    eng._hier_local_size = local
+    eng._slice_topos.clear()             # knob mutated: drop cached split
+    d0, i0, c0 = eng.hier_dispatches, eng.hier_intra_legs, eng.hier_cross_legs
+    rows = []
+    try:
+        for n in sizes:
+            x = hvd.stack_per_rank([
+                (np.arange(n, dtype=np.float32) % 7) - 3 + r
+                for r in range(world)])
+
+            def run(hier, n=n, x=x):
+                name = f"hier_ab_{n}"
+                out = hvd.allreduce(x, name=name, op=hvd.Sum,
+                                    hierarchical=hier)   # compile + warm
+                np.asarray(out)
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    out = hvd.allreduce(x, name=name, op=hvd.Sum,
+                                        hierarchical=hier)
+                res = np.asarray(out)
+                return (time.perf_counter() - t0) / steps, res
+
+            flat_s, flat_out = run(False)
+            hier_s, hier_out = run(True)
+            legs = modeled_leg_bytes(n * 4, world, local)
+            rows.append({
+                "elems": n, "payload_bytes": n * 4,
+                "flat_ms": round(flat_s * 1e3, 3),
+                "hier_ms": round(hier_s * 1e3, 3),
+                "bitwise_identical": bool(
+                    np.array_equal(flat_out, hier_out)),
+                "wire_bytes_flat": int(legs["flat"]),
+                "wire_bytes_intra": int(legs["intra"]),
+                "wire_bytes_cross": int(legs["cross"]),
+                # the headline: slow links carry ≤ 1/local_size of flat
+                "cross_leq_flat_over_local": bool(
+                    legs["cross"] <= legs["flat"] / local + 1),
+            })
+    finally:
+        (eng._hier_local_size, eng.slice_map) = saved
+        eng._slice_topos.clear()
+    crossover_mb = None
+    for r in rows:
+        if r["hier_ms"] <= r["flat_ms"]:
+            crossover_mb = round(r["payload_bytes"] / (1 << 20), 3)
+            break
+    out = {
+        "world": world, "num_slices": 2, "local_size": local,
+        "steps": steps, "sizes": rows,
+        "crossover_mb": crossover_mb,
+        "hier_dispatches": eng.hier_dispatches - d0,
+        "hier_intra_legs": eng.hier_intra_legs - i0,
+        "hier_cross_legs": eng.hier_cross_legs - c0,
+        "bitwise_identical": all(r["bitwise_identical"] for r in rows),
+    }
+    _record_timing("hierarchical_ab", warmup=2 * len(sizes),
+                   iters=2 * steps * len(sizes),
+                   wall_s=time.perf_counter() - t_section)
+    return out
+
+
 def bench_zero_rtt(errors=None, world=4, warm=6, cycles=40, n_tensors=8):
     """Zero-RTT warm control plane A/B (ISSUE 11): a simulated world of
     REAL ``TCPController`` clients against the native root server, driven
@@ -2212,6 +2316,10 @@ def _run(out, errors):
         except Exception as exc:  # noqa: BLE001 - contained
             errors["sharded_ab"] = repr(exc)
         try:
+            out["hierarchical_ab"] = bench_hierarchical_ab(errors=errors)
+        except Exception as exc:  # noqa: BLE001 - contained
+            errors["hierarchical_ab"] = repr(exc)
+        try:
             out["zero_rtt_ab"] = bench_zero_rtt(errors=errors)
         except Exception as exc:  # noqa: BLE001 - contained
             errors["zero_rtt_ab"] = repr(exc)
@@ -2350,6 +2458,11 @@ def _run(out, errors):
         out["sharded_ab"] = bench_sharded_ab(errors=errors)
     except Exception as exc:  # noqa: BLE001 - contained
         errors["sharded_ab"] = repr(exc)
+
+    try:
+        out["hierarchical_ab"] = bench_hierarchical_ab(errors=errors)
+    except Exception as exc:  # noqa: BLE001 - contained
+        errors["hierarchical_ab"] = repr(exc)
 
     try:
         out["zero_rtt_ab"] = bench_zero_rtt(errors=errors)

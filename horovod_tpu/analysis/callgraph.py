@@ -186,6 +186,11 @@ class CollectiveSite:
     # sharded_optimizer binding — the schedule pass expands the latter to
     # its real reduce-scatter + allgather sequence.
     sharded: bool = False
+    # Two-level dispatch pin (ISSUE 17): a collective submitted with a
+    # constant hierarchical= override.  Unlike sharded= it rides the
+    # fusion key only (never the negotiation digest), but it still forks
+    # the batch plan — the schedule pass keys on it like [sharded].
+    hierarchical: bool = False
     # Resolved process-set value of this site (the schedule lane it
     # submits on); WORLD when no process_set= / axis binding applies.
     ps: ProcessSetValue = WORLD
@@ -647,6 +652,10 @@ class _Collector(ast.NodeVisitor):
                             and isinstance(kw.value, ast.Constant)
                             and bool(kw.value.value)
                             for kw in node.keywords),
+                hierarchical=any(kw.arg == "hierarchical"
+                                 and isinstance(kw.value, ast.Constant)
+                                 and bool(kw.value.value)
+                                 for kw in node.keywords),
                 ps=ps))
         elif name in ("update", "apply_gradients"):
             # opt.update(...) on a name bound to DistributedOptimizer(

@@ -79,8 +79,10 @@ _JIT_WRAPPER_NAMES = {"jit", "shard_map", "pmap"}
 # whole data plane (reduce-scatter + allgather vs allreduce, 1/N shard
 # layouts) and ride the negotiation digest — they must be fleet-uniform,
 # never derived from rank identity.  Checked on collective submissions and
-# on the wrappers that accept them.
-_SHARD_ARG_NAMES = {"sharded", "num_shards", "shard_count"}
+# on the wrappers that accept them.  ``hierarchical`` (ISSUE 17) rides the
+# fusion key rather than the digest, but batching groups entries BY fusion
+# key, so a rank-divergent value still forks the batch plan — same rule.
+_SHARD_ARG_NAMES = {"sharded", "num_shards", "shard_count", "hierarchical"}
 _SHARD_ARG_CALLS = {"DistributedOptimizer", "sharded_optimizer",
                     "init_sharded_state"}
 
@@ -628,18 +630,20 @@ class _Linter(ast.NodeVisitor):
                         f"over a 1-sized axis)")
 
     def _check_shard_args(self, node: ast.Call, name: str):
-        """HVD110: sharded=/shard-count arguments must be rank-invariant
-        — the flag is part of the negotiation digest and forks the whole
-        collective schedule (reduce-scatter+allgather vs allreduce)."""
+        """HVD110: sharded=/shard-count/hierarchical= arguments must be
+        rank-invariant — sharded= is part of the negotiation digest and
+        forks the whole collective schedule (reduce-scatter+allgather vs
+        allreduce); hierarchical= is fusion-key-only but batching groups
+        by fusion key, so divergence still forks the batch plan."""
         for kw in node.keywords:
             if kw.arg in _SHARD_ARG_NAMES \
                     and _mentions_rank(kw.value, self._tainted()):
                 self._emit(
                     "HVD110", node,
                     f"{kw.arg}= argument of {name!r} is derived from rank "
-                    f"identity: ranks would disagree on the sharded data "
-                    f"plane (reduce-scatter+allgather vs allreduce) and "
-                    f"submit mismatched programs")
+                    f"identity: ranks would disagree on the collective "
+                    f"data plane (sharded/two-level vs flat schedules) "
+                    f"and submit mismatched programs")
 
     def _check_collective(self, node: ast.Call, name: str):
         if self._jit_depth > 0 and name in COLLECTIVE_NAMES \

@@ -153,16 +153,20 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
     ),
     Rule(
         "HVD110", Severity.ERROR,
-        "world-divergent sharded-optimizer configuration",
-        "A sharded= / shard-count argument of a collective or a "
-        "DistributedOptimizer/sharded_optimizer wrapper is derived from "
-        "rank identity.  The sharded flag is part of the negotiation "
-        "digest and shapes the whole data plane (reduce-scatter + "
-        "allgather vs allreduce; 1/N shard layouts): ranks disagreeing "
-        "on it submit mismatched programs — negotiation fails fast at "
-        "best, or the fleet wedges mid-collective at worst.",
-        "Make the sharded configuration a fleet-uniform constant "
-        "(hyperparameter, HOROVOD_SHARDED_OPTIMIZER / --sharded), never "
+        "world-divergent collective data-plane configuration",
+        "A sharded= / shard-count / hierarchical= argument of a "
+        "collective or a DistributedOptimizer/sharded_optimizer wrapper "
+        "is derived from rank identity.  The sharded flag is part of the "
+        "negotiation digest and shapes the whole data plane "
+        "(reduce-scatter + allgather vs allreduce; 1/N shard layouts); "
+        "the hierarchical override rides the fusion key only, but "
+        "batching groups entries by fusion key, so divergence still "
+        "forks the batch plan: ranks disagreeing submit mismatched "
+        "programs — negotiation fails fast at best, or the fleet wedges "
+        "mid-collective at worst.",
+        "Make the data-plane configuration a fleet-uniform constant "
+        "(hyperparameter, HOROVOD_SHARDED_OPTIMIZER / --sharded, "
+        "HOROVOD_HIERARCHICAL_ALLREDUCE / HOROVOD_HIER_THRESHOLD), never "
         "a function of rank()/local_rank().",
     ),
     Rule(

@@ -71,6 +71,12 @@ def _site_events(col: CollectiveSite) -> List:
                 ("op", "allgather[sharded]", lane)]
     if col.sharded:
         return [("op", f"{col.name}[sharded]", lane)]
+    if col.hierarchical:
+        # Two-level dispatch pin (ISSUE 17): hierarchical= rides the
+        # fusion key (never the digest), so a pinned two-level allreduce
+        # and a flat one are different batch plans — a schedule dimension
+        # exactly like [sharded].
+        return [("op", f"{col.name}[hier]", lane)]
     return [("op", col.name, lane)]
 
 

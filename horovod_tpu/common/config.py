@@ -81,6 +81,10 @@ class Config:
     - ``stall_check_disable``      <- HOROVOD_STALL_CHECK_DISABLE
     - ``hierarchical_allreduce``   <- HOROVOD_HIERARCHICAL_ALLREDUCE
     - ``hierarchical_allgather``   <- HOROVOD_HIERARCHICAL_ALLGATHER
+    - ``hier_threshold_bytes``     <- HOROVOD_HIER_THRESHOLD (flat-vs-
+      two-level payload crossover; 0 = always two-level when armed)
+    - ``slice_map``                <- HOROVOD_SLICE_MAP (explicit slice
+      membership for CPU/simulated worlds; see parallel/topology.py)
     - ``autotune``                 <- HOROVOD_AUTOTUNE
     - ``autotune_log``             <- HOROVOD_AUTOTUNE_LOG
     - ``autotune_warmup_samples``  <- HOROVOD_AUTOTUNE_WARMUP_SAMPLES
@@ -195,6 +199,21 @@ class Config:
     # Local-axis extent for the two-level (cross x local) collectives; 0 =
     # derive from the topology's per-process device counts (multi-host).
     hierarchical_local_size: int = 0
+    # Payload crossover for the two-level data plane (ISSUE 17,
+    # docs/performance.md "Hierarchical collectives"): fused allreduce
+    # batches whose per-rank payload is at least this many bytes take the
+    # RS(ICI) -> AR(DCN) -> AG(ICI) schedule; smaller batches stay flat
+    # (the two extra phase latencies outweigh the DCN byte savings for
+    # small payloads).  0 = every eligible batch goes two-level once the
+    # mode is armed.  An autotune coordinate (``hier_threshold``) when the
+    # mode is armed; like HOROVOD_PIPELINE_CHUNK it is NOT part of the
+    # negotiation digest, so retunes cost zero control-plane traffic.
+    hier_threshold_bytes: int = 0
+    # Explicit slice membership for CPU/simulated worlds ("4" = uniform
+    # slice size, "4,4" = per-slice sizes); empty = derive from device
+    # slice_index attributes / hierarchical_local_size / process counts
+    # (parallel/topology.py precedence order).
+    slice_map: str = ""
 
     # Two-level control plane (protocol v5, docs/performance.md "Control
     # plane at scale").  HOROVOD_HIERARCHICAL_CONTROLLER=1: every rank's
@@ -337,6 +356,8 @@ class Config:
             hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool("HIERARCHICAL_ALLGATHER", False),
             hierarchical_local_size=_env_int("HIERARCHICAL_LOCAL_SIZE", 0),
+            hier_threshold_bytes=_env_int("HIER_THRESHOLD", 0),
+            slice_map=_env("SLICE_MAP", "") or "",
             hierarchical_controller=_env_bool("HIERARCHICAL_CONTROLLER",
                                               False),
             agent_port=_env_int("AGENT_PORT", 0),

@@ -34,12 +34,20 @@ from jax.sharding import Mesh
 
 
 def _device_sort_key(d: jax.Device):
-    """Sort devices so ring order follows the ICI torus when available."""
+    """Sort devices so ring order follows the ICI torus when available.
+
+    Slice index sorts FIRST: in a multi-slice world each slice owns its own
+    coordinate system, and the two-level data plane
+    (``horovod_tpu/parallel/topology.py``) requires slice membership to be
+    contiguous equal rank blocks — interleaving slices by raw coords would
+    break the (cross, local) mesh reshape and put DCN hops inside the
+    "local" axis."""
     coords = getattr(d, "coords", None)
+    slice_idx = getattr(d, "slice_index", 0) or 0
     if coords is not None:
         core = getattr(d, "core_on_chip", 0)
-        return (0, tuple(coords), core, d.id)
-    return (1, (), 0, d.id)
+        return (slice_idx, 0, tuple(coords), core, d.id)
+    return (slice_idx, 1, (), 0, d.id)
 
 
 def ordered_devices(devices: Optional[Sequence[jax.Device]] = None) -> List[jax.Device]:

@@ -57,13 +57,20 @@ def set_host_device_count(n: int):
     import os
 
     import jax
+    # Always strip any stale count flag first, even when the config path
+    # below succeeds: an inherited --xla_force_host_platform_device_count
+    # (e.g. a parent harness that stacked its own flags into XLA_FLAGS
+    # before spawning us) would otherwise override the config option at
+    # backend init and silently pin the OLD count.  Stripping makes
+    # stacked callers compose — last caller before backend init wins.
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
     try:
         jax.config.update("jax_num_cpu_devices", int(n))
+        os.environ["XLA_FLAGS"] = " ".join(flags)
         return
     except Exception:  # noqa: BLE001 - option unknown on jax <= 0.4.x
         pass
-    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
-             if "xla_force_host_platform_device_count" not in f]
     flags.append(f"--xla_force_host_platform_device_count={int(n)}")
     os.environ["XLA_FLAGS"] = " ".join(flags)
 

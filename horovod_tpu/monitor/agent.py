@@ -202,6 +202,11 @@ class MonitorAgent:
                 # Per-phase lifecycle histograms (horovod_tpu.trace):
                 # mirrored from the recorder's own buckets — visible at
                 # /metrics as hvd_trace_<phase>_us and in the CLI view.
+                # Once the two-level data plane engages, the recorder's
+                # payload grows reduce_intra/reduce_cross leg keys
+                # (core.REDUCE_LEGS) and the same loop materializes
+                # hvd_trace_reduce_intra_us / hvd_trace_reduce_cross_us —
+                # the DCN-vs-ICI attribution on /metrics.
                 try:
                     hists = tracer.phase_histograms()
                 except Exception:  # noqa: BLE001 - telemetry only

@@ -50,6 +50,15 @@ _INFLIGHT_BOUNDS = (0.0, 3.0)
 # base coordinate, so the latency pair (fast_lane_threshold, cycle_time)
 # is fully searched, never hand-set.
 _FAST_LANE_BOUNDS = (8.0, 24.0)
+# Hierarchical crossover threshold (two-level ICI/DCN allreduce, armed via
+# HOROVOD_HIERARCHICAL_ALLREDUCE): 1KB..256MB.  Below the crossover a flat
+# ring's single launch beats the three-leg pipeline's fixed cost; above it
+# the ~1/local_size cross-slice byte saving wins.  The crossover depends on
+# the DCN:ICI bandwidth ratio of the actual pod, so it is searched, not
+# hand-set.  Walking the knob only flips per-batch decisions (fusion-key
+# re-keyed, never in the negotiation digest), so moves are control-plane
+# free — the same zero-traffic rule as HOROVOD_PIPELINE_CHUNK.
+_HIER_THR_BOUNDS = (10.0, 28.0)
 # Zero-RTT pair (protocol v7, multi-process only).  spec_ready_after
 # 1..32 consecutive ready-on-first-announce rounds before the coordinator
 # predicts (small = aggressive speculation, large = conservative; 0 — the
@@ -247,6 +256,21 @@ class ParameterManager:
             fl0 = max(float(engine.fast_lane_threshold) or 4096.0, 256.0)
             starts.append(math.log2(fl0))
             bounds.append(_FAST_LANE_BOUNDS)
+        # Hierarchical crossover coordinate — gated on the two-level mode
+        # being ARMED (HOROVOD_HIERARCHICAL_ALLREDUCE is fleet-uniform
+        # config, so every rank takes the same branch): with the mode off
+        # every batch dispatches flat regardless of the threshold, and
+        # tuning a dead knob would waste eval budget.  Moves ride the same
+        # agreement broadcast, so the per-batch flat-vs-hier decision (a
+        # fusion-key input — batching must stay rank-invariant, HVD110)
+        # can never diverge across ranks.
+        self._tune_hier = (ctl is not None
+                           and getattr(engine, "hierarchical_allreduce",
+                                       False))
+        if self._tune_hier:
+            ht0 = max(float(engine.hier_threshold_bytes) or 65536.0, 1024.0)
+            starts.append(math.log2(ht0))
+            bounds.append(_HIER_THR_BOUNDS)
         # Zero-RTT pair (protocol v7) — spec_ready_after gated like the
         # cache coordinate (speculation off is an explicit opt-out, and
         # the server's streak threshold was fixed at start from the same
@@ -349,6 +373,14 @@ class ParameterManager:
             # self-invalidate on their validity compare.
             self._engine.fast_lane_threshold = int(params[idx])
             idx += 1
+        if self._tune_hier and len(params) > idx:
+            # Applies from the next batch's _hier_decision; the program
+            # cache and slot pins re-key on the per-batch DECISION (not
+            # the raw threshold), so walking it recompiles at most one
+            # program per (shape, mode) pair and stale pins self-
+            # invalidate on their validity compare.
+            self._engine.hier_threshold_bytes = max(0, int(params[idx]))
+            idx += 1
         if self._tune_spec and len(params) > idx:
             # Client-side consumption gate: never moves to 0 (the bounds
             # start at 1) — 0 is the config-level opt-out that disables
@@ -399,6 +431,9 @@ class ParameterManager:
                 idx += 2
             if self._tune_fast_lane and len(params) > idx:
                 extra += f" fast_lane_threshold={int(params[idx])}"
+                idx += 1
+            if self._tune_hier and len(params) > idx:
+                extra += f" hier_threshold_bytes={int(params[idx])}"
                 idx += 1
             if self._tune_spec and len(params) > idx:
                 extra += (f" spec_ready_after="
@@ -451,6 +486,8 @@ class ParameterManager:
                 cols += ",pipeline_chunk_bytes,max_inflight"
             if self._tune_fast_lane:
                 cols += ",fast_lane_threshold"
+            if self._tune_hier:
+                cols += ",hier_threshold_bytes"
             if self._tune_spec:
                 cols += ",spec_ready_after"
             if self._tune_round_pipeline:
@@ -471,6 +508,9 @@ class ParameterManager:
                       f",{max(1, int(round(params[idx + 1])))}")
             idx += 2
         if self._tune_fast_lane and len(params) > idx:
+            extra += f",{int(params[idx])}"
+            idx += 1
+        if self._tune_hier and len(params) > idx:
             extra += f",{int(params[idx])}"
             idx += 1
         if self._tune_spec and len(params) > idx:

@@ -255,7 +255,8 @@ def allreduce_async(tensor, name: Optional[str] = None,
                     prescale_factor: Optional[float] = None,
                     postscale_factor: Optional[float] = None,
                     process_set: Optional[ProcessSet] = None,
-                    compression=None, priority: int = 0) -> int:
+                    compression=None, priority: int = 0,
+                    hierarchical: Optional[bool] = None) -> int:
     """``compression="bf16"``/``"fp16"`` casts floating tensors to the wire
     dtype inside the fused program (before the reduce) and back after —
     half the ICI bytes, zero extra launches, result in the input dtype.
@@ -263,7 +264,15 @@ def allreduce_async(tensor, name: Optional[str] = None,
     ``priority``: higher drains first from the coordinator queue (stable
     within equal priority).  Must be stamped identically on every rank —
     the DistributedOptimizer bindings use reverse registration order so
-    first-needed gradients lead each cycle."""
+    first-needed gradients lead each cycle.
+
+    ``hierarchical``: per-call override of the two-level ICI/DCN schedule
+    (docs/performance.md "Hierarchical collectives") — True forces it,
+    False forces flat, None (default) defers to
+    HOROVOD_HIERARCHICAL_ALLREDUCE + the HOROVOD_HIER_THRESHOLD payload
+    crossover.  Must be a rank-invariant constant (it forks the fused
+    program shape; analyzer rule HVD110), but flipping it is free on the
+    control plane — it rides the fusion key, never the digest."""
     ps_id = _ps(process_set)
     arr, owned = _as_stacked(tensor, ps_id)
     return _engine().enqueue(
@@ -271,7 +280,7 @@ def allreduce_async(tensor, name: Optional[str] = None,
         arr, reduce_op=op, process_set_id=ps_id,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         donate=owned, compression=_wire_mode(compression),
-        priority=priority)
+        priority=priority, hierarchical=hierarchical)
 
 
 def _sync_now(handle):
@@ -286,10 +295,11 @@ def allreduce(tensor, name: Optional[str] = None,
               prescale_factor: Optional[float] = None,
               postscale_factor: Optional[float] = None,
               process_set: Optional[ProcessSet] = None,
-              compression=None, priority: int = 0):
+              compression=None, priority: int = 0,
+              hierarchical: Optional[bool] = None):
     return _sync_now(allreduce_async(
         tensor, name, op, prescale_factor, postscale_factor, process_set,
-        compression, priority))
+        compression, priority, hierarchical))
 
 
 def grouped_allreduce_async(tensors: Sequence, name: Optional[str] = None,
@@ -298,7 +308,8 @@ def grouped_allreduce_async(tensors: Sequence, name: Optional[str] = None,
                             postscale_factor: Optional[float] = None,
                             process_set: Optional[ProcessSet] = None,
                             compression=None,
-                            priorities: Optional[Sequence[int]] = None
+                            priorities: Optional[Sequence[int]] = None,
+                            hierarchical: Optional[bool] = None
                             ) -> List[int]:
     """Enqueue a group that fuses/executes atomically (reference: N13).
 
@@ -323,7 +334,8 @@ def grouped_allreduce_async(tensors: Sequence, name: Optional[str] = None,
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor, group_id=gid, donate=owned,
             compression=comp,
-            priority=int(priorities[i]) if priorities is not None else 0))
+            priority=int(priorities[i]) if priorities is not None else 0,
+            hierarchical=hierarchical))
     # One atomic push: all members negotiate in the same round on every
     # rank, which both preserves fusion atomicity and lets a negotiation
     # error on one member abort the whole group (reference N13).
@@ -336,10 +348,11 @@ def grouped_allreduce(tensors: Sequence, name: Optional[str] = None,
                       postscale_factor: Optional[float] = None,
                       process_set: Optional[ProcessSet] = None,
                       compression=None,
-                      priorities: Optional[Sequence[int]] = None):
+                      priorities: Optional[Sequence[int]] = None,
+                      hierarchical: Optional[bool] = None):
     handles = grouped_allreduce_async(
         tensors, name, op, prescale_factor, postscale_factor, process_set,
-        compression, priorities)
+        compression, priorities, hierarchical)
     _engine().kick()
     return [synchronize(h) for h in handles]
 

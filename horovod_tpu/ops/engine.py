@@ -91,6 +91,15 @@ class TensorTableEntry:
     # whose sharded= flag diverges from its peers fails negotiation with
     # attribution instead of executing a mismatched program.
     sharded: bool = False
+    # Two-level data plane (ISSUE 17): per-call override of the engine's
+    # HOROVOD_HIERARCHICAL_ALLREDUCE default — True forces the two-level
+    # schedule for this entry, False forces flat, None defers to the
+    # engine knob + HOROVOD_HIER_THRESHOLD crossover.  Part of the fusion
+    # key but NOT the negotiation digest (results are bitwise-identical
+    # either way for SUM/AVERAGE/MIN/MAX, so peers need not agree — but
+    # the VALUE must still be rank-invariant, like sharded=, because
+    # batching groups by fusion key; analyzer rule HVD110 checks that).
+    hierarchical: Optional[bool] = None
     # Drain priority (higher drains first; default 0 = FIFO).  Stamped by
     # the DistributedOptimizer bindings with reverse-registration order so
     # first-needed gradients lead each cycle (ByteScheduler-style priority
@@ -143,7 +152,7 @@ def _fusion_key(e: TensorTableEntry) -> Tuple:
     """
     return (e.ctype, e.reduce_op, e.root_rank, e.process_set_id,
             e.prescale_factor, e.postscale_factor, e.compression,
-            e.sharded,
+            e.sharded, e.hierarchical,
             e.partition[2] if e.partition is not None else 0)
 
 
@@ -262,6 +271,21 @@ class CollectiveEngine:
         self.hierarchical_allreduce = cfg.hierarchical_allreduce
         self.hierarchical_allgather = cfg.hierarchical_allgather
         self._hier_local_size = cfg.hierarchical_local_size
+        # Two-level data plane (ISSUE 17): payload crossover + explicit
+        # slice membership override.  hier_threshold_bytes is a local
+        # knob like pipeline_chunk_bytes — autotunable, never negotiated.
+        self.hier_threshold_bytes = cfg.hier_threshold_bytes
+        self.slice_map = cfg.slice_map
+        # Per-process-set slice topology, derived once (device attrs /
+        # HOROVOD_SLICE_MAP / local-size knob — parallel/topology.py) and
+        # probed on every dispatch by the crossover decision.
+        self._slice_topos: Dict[int, Any] = {}
+        # Leg counters: proof the two-level path actually engaged.  One
+        # hier dispatch = 2 intra-slice (ICI) legs (reduce-scatter +
+        # allgather) + 1 cross-slice (DCN) leg.
+        self.hier_dispatches = 0
+        self.hier_intra_legs = 0
+        self.hier_cross_legs = 0
         self._handle_counter = itertools.count(1)
         self._handles: Dict[int, TensorTableEntry] = {}
         self._handles_lock = threading.Lock()
@@ -551,13 +575,15 @@ class CollectiveEngine:
                 process_set_id: int = 0, prescale_factor=None,
                 postscale_factor=None, group_id: int = -1,
                 donate: bool = False, compression: Optional[str] = None,
-                priority: int = 0, sharded: bool = False) -> int:
+                priority: int = 0, sharded: bool = False,
+                hierarchical: Optional[bool] = None) -> int:
         return self.enqueue_group([dict(
             name=name, ctype=ctype, tensor=tensor, reduce_op=reduce_op,
             root_rank=root_rank, process_set_id=process_set_id,
             prescale_factor=prescale_factor, postscale_factor=postscale_factor,
             group_id=group_id, donate=donate, compression=compression,
-            priority=priority, sharded=sharded)])[0]
+            priority=priority, sharded=sharded,
+            hierarchical=hierarchical)])[0]
 
     def enqueue_group(self, items: Sequence[dict]) -> List[int]:
         """Enqueue several entries atomically w.r.t. the drain — a cycle
@@ -681,7 +707,8 @@ class CollectiveEngine:
                     prescale_factor=e.prescale_factor,
                     postscale_factor=e.postscale_factor,
                     group_id=-1, donate=True, compression=e.compression,
-                    priority=e.priority)          # priority inheritance
+                    priority=e.priority,          # priority inheritance
+                    hierarchical=e.hierarchical)
                 sub.partition = (e.name, i, k)
                 sub.parent = e
                 subs.append(sub)
@@ -1480,32 +1507,101 @@ class CollectiveEngine:
             self.sanitizer.observe_synthesized(e)
         return e
 
+    def _slice_topology(self, ps_id: int):
+        """The slice-level structure of this process set's world
+        (``parallel/topology.py``), derived once and cached, or None.
+
+        Precedence: ``HOROVOD_SLICE_MAP`` (explicit override, CPU/
+        simulated worlds) → device ``slice_index`` attributes (real
+        multi-slice TPU) → ``HOROVOD_HIERARCHICAL_LOCAL_SIZE`` →
+        per-process device counts (the PR-3 host-based derivation).
+        Only the global process set is eligible — subgroup process sets
+        keep the flat path.  A malformed slice map logs once and falls
+        back flat instead of killing the cycle thread."""
+        if ps_id != 0:
+            return None
+        if ps_id in self._slice_topos:
+            return self._slice_topos[ps_id]
+        from ..parallel import topology as slice_topo
+        topo = self._state.topology
+        ps = self._state.process_set_table.get(ps_id)
+        devs = list(np.asarray(ps.mesh.devices).reshape(-1))
+        try:
+            st = slice_topo.slice_topology(
+                devs, slice_map=self.slice_map,
+                local_size=self._hier_local_size,
+                local_counts=(topo.local_counts
+                              if topo is not None else None))
+        except ValueError as exc:
+            log.warning("HOROVOD_SLICE_MAP rejected (%s); "
+                        "hierarchical collectives stay flat", exc)
+            st = None
+        self._slice_topos[ps_id] = st
+        return st
+
     def _hier_mesh(self, ps_id: int):
         """2-D (cross, local) mesh for two-level collectives, or None.
 
         Reference parity: ``HOROVOD_HIERARCHICAL_ALLREDUCE`` in
         ``horovod/common/ops/nccl_operations.cc`` (SURVEY.md N17) splits the
         world into NCCL-intra-node × MPI-cross-node; here the split is
-        local = ICI within a host, cross = DCN between hosts.  The local
-        extent comes from the topology's per-process device counts, or from
-        ``HOROVOD_HIERARCHICAL_LOCAL_SIZE`` (single-process tests / explicit
-        override).  Only the global process set is eligible — subgroup
-        process sets keep the flat path.
-        """
-        if ps_id != 0:
+        local = ICI within a slice, cross = DCN between slices, with the
+        membership derived by ``_slice_topology``.  Ranks are slice-major
+        (``common.topology.ordered_devices`` sorts slice_index first), so
+        the reshape lays every slice along the ``local`` axis and the
+        cross axis walks the leader ring in rank order — the DCN ring
+        order derived from leader torus coordinates at rank assignment."""
+        st = self._slice_topology(ps_id)
+        if st is None:
             return None
-        topo = self._state.topology
         ps = self._state.process_set_table.get(ps_id)
-        world = ps.size()
-        local = self._hier_local_size
-        if local <= 0:
-            counts = topo.local_counts if topo is not None else []
-            if len(counts) > 1 and all(c == counts[0] for c in counts):
-                local = counts[0]
-        if local <= 1 or world % local or world // local <= 1:
-            return None
-        devs = np.asarray(ps.mesh.devices).reshape(world // local, local)
+        devs = np.asarray(ps.mesh.devices).reshape(st.num_slices,
+                                                   st.local_size)
         return Mesh(devs, ("cross", "local"))
+
+    def _hier_decision(self, e0: "TensorTableEntry", nbytes: int) -> bool:
+        """Per-batch flat-vs-two-level verdict — a pure function of the
+        negotiated batch (op/dtype/bytes), the engine knobs, and the
+        fleet-static slice topology, so every rank decides identically
+        with ZERO control-plane traffic (the knobs ride neither the
+        digest nor the announce, same rule as HOROVOD_PIPELINE_CHUNK).
+
+        ``nbytes`` counts per-rank payload bytes: the crossover trades
+        the two extra phase latencies against the DCN byte savings,
+        which scale with what each rank actually moves."""
+        if e0.hierarchical is False:
+            return False
+        if e0.hierarchical is None and not self.hierarchical_allreduce:
+            return False
+        if e0.ctype != CollectiveType.ALLREDUCE:
+            return False
+        if e0.reduce_op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE,
+                                C.ReduceOp.MIN, C.ReduceOp.MAX,
+                                C.ReduceOp.ADASUM):
+            return False
+        if e0.hierarchical is None and nbytes < self.hier_threshold_bytes:
+            return False
+        st = self._slice_topology(e0.process_set_id)
+        if st is None:
+            return False
+        if e0.reduce_op == C.ReduceOp.ADASUM:
+            # Two-level VHD needs power-of-two extents at both levels.
+            from ..parallel.topology import hier_bit_orders
+            if hier_bit_orders(st.local_size, st.num_slices) is None:
+                return False
+        return True
+
+    def _batch_payload_bytes(self, batch) -> int:
+        """Per-rank payload bytes of a fused batch (stacked tensors carry
+        [world, *S]; the per-rank shard is what rides the wire)."""
+        total = 0
+        for e in batch:
+            t = e.tensor
+            if t is None:
+                continue
+            world = max(1, int(t.shape[0])) if t.ndim else 1
+            total += t.nbytes // world
+        return total
 
     def _chunk_plan(self, ctype: CollectiveType, shapes, dtypes) -> Tuple:
         """Per-dtype-group chunk counts for a fused reduction.
@@ -1548,13 +1644,17 @@ class CollectiveEngine:
         validity compare below keeps name reuse sound)."""
         return e.cache_slot if e.cache_slot >= 0 else e.name
 
-    def _execute_fast_lane(self, e: TensorTableEntry):
+    def _execute_fast_lane(self, e: TensorTableEntry, hier_now: bool):
         """Dispatch a fast-lane entry through its pinned pre-compiled
         program — zero fusion-key construction, zero chunk planning, zero
         program-cache tuple hashing on the warm path; one dict probe and
-        a handful of scalar compares.  Returns ``(results, chunks)`` or
-        None (no valid pin yet — the caller takes the regular path and
-        pins the program it builds)."""
+        a handful of scalar compares.  ``hier_now`` is the batch's
+        flat-vs-two-level verdict (``_hier_decision``): the pin stores
+        the verdict its program was built under, so a threshold retune
+        that flips the schedule drops the pin and rebuilds — never
+        serves a flat program to a two-level decision or vice versa.
+        Returns ``(results, chunks)`` or None (no valid pin yet — the
+        caller takes the regular path and pins the program it builds)."""
         rec = self._fast_programs.get(self._fast_pin_key(e))
         if rec is None:
             return None
@@ -1562,7 +1662,7 @@ class CollectiveEngine:
         if (shape != e.tensor.shape or dtype != e.tensor.dtype
                 or donate != e.donate
                 or chunk_knob != self.pipeline_chunk_bytes
-                or hier != self.hierarchical_allreduce
+                or hier != hier_now
                 or fkey != _fusion_key(e)):
             # Stale pin (name reuse under new params, knob retune, ...):
             # drop it; the regular path rebuilds and re-pins.
@@ -1593,8 +1693,28 @@ class CollectiveEngine:
         e0 = batch[0]
         if e0.ctype == CollectiveType.BARRIER:
             return [None for _ in batch], 0
+        # Two-level crossover verdict — once per batch, BEFORE the fast
+        # lane probe (the pin's validity record compares against it) and
+        # before the cache key (the DECISION keys the program, never the
+        # raw knobs: retuning HOROVOD_HIER_THRESHOLD only recompiles when
+        # a batch actually changes schedule, mirroring chunk-plan keying).
+        hier = self._hier_decision(e0, self._batch_payload_bytes(batch))
+        if hier:
+            self.hier_dispatches += 1
+            self.hier_intra_legs += 2     # reduce-scatter + allgather (ICI)
+            self.hier_cross_legs += 1     # leader-ring allreduce (DCN)
+            tr = self.tracer
+            if tr is not None:
+                st = self._slice_topology(e0.process_set_id)
+                from ..parallel.topology import cross_fraction
+                frac = cross_fraction(self._batch_payload_bytes(batch),
+                                      st.world, st.local_size)
+                for e in batch:
+                    sp = _live_span(e)
+                    if sp is not None:
+                        sp.cross_frac = frac
         if e0.fast_lane and len(batch) == 1:
-            fast = self._execute_fast_lane(e0)
+            fast = self._execute_fast_lane(e0, hier)
             if fast is not None:
                 return fast
         mesh, axis, world = self._mesh_axis(e0.process_set_id)
@@ -1603,11 +1723,12 @@ class CollectiveEngine:
         donate = tuple(e.donate for e in batch)
         plan = self._chunk_plan(e0.ctype, shapes, dtypes)
         key = (_fusion_key(e0), shapes, dtypes, donate,
-               self.hierarchical_allreduce, self.hierarchical_allgather,
+               hier, self.hierarchical_allgather,
                plan)
         fn, hit = self.cache.get_or_build2(
             key, lambda: self._build_program(e0, shapes, dtypes, mesh, axis,
-                                             world, donate, plan))
+                                             world, donate, plan,
+                                             hier=hier))
         if e0.fast_lane and len(batch) == 1:
             # Pin the program for the next submission of this tensor: the
             # record stores exactly the inputs the program was built from,
@@ -1615,7 +1736,7 @@ class CollectiveEngine:
             pin = self._fast_programs
             pin[self._fast_pin_key(e0)] = (
                 key[0], e0.tensor.shape, e0.tensor.dtype, e0.donate,
-                self.pipeline_chunk_bytes, self.hierarchical_allreduce,
+                self.pipeline_chunk_bytes, hier,
                 fn, sum(plan) if plan else 1)
             if e0.cache_slot >= 0:
                 # Cold start pinned under the NAME (the slot was still
@@ -1651,7 +1772,7 @@ class CollectiveEngine:
     # XLA temporary in HBM — reference N7 without the memcpy machinery),
     # runs ONE collective, and splits results out.
     def _build_program(self, proto: TensorTableEntry, shapes, dtypes, mesh,
-                       axis, world, donate=(), plan=()):
+                       axis, world, donate=(), plan=(), hier=None):
         ctype = proto.ctype
         # Engine-owned input buffers are donated to XLA so the fused
         # program may alias them in HBM instead of allocating fresh
@@ -1663,9 +1784,14 @@ class CollectiveEngine:
             return jax.jit(fn, donate_argnums=dargs)
 
         if ctype == CollectiveType.ALLREDUCE:
-            if (self.hierarchical_allreduce
-                    and proto.reduce_op in (C.ReduceOp.SUM,
-                                            C.ReduceOp.AVERAGE)):
+            if hier is None:
+                # Direct callers carry no dispatch-time crossover verdict:
+                # the engine knob decides, threshold treated as met (the
+                # pre-crossover contract for knob-armed builds).
+                hier = self._hier_decision(proto, self.hier_threshold_bytes)
+            if hier:
+                # The crossover verdict already proved the slice topology
+                # exists and the op is eligible (_hier_decision).
                 hmesh = self._hier_mesh(proto.process_set_id)
                 if hmesh is not None:
                     return self._build_hier_allreduce(
@@ -1854,17 +1980,47 @@ class CollectiveEngine:
         shared ``_build_fused_reduce``), but the reduction runs over a
         (cross, local) mesh so bytes over the slow cross links drop by
         1/local_size (reference N17's hierarchical path; SURVEY.md §2c).
+
+        SUM/AVERAGE ride psum_scatter→psum→all_gather; MIN/MAX gather the
+        slice, reduce elementwise, and cross only their 1/local shard
+        (both exact in any association order, so results are
+        bitwise-identical to flat whenever the arithmetic is — min/max
+        always, sums for exactly-representable values); ADASUM maps its
+        vector-halving-doubling onto the torus axes at both levels
+        (``adasum_allreduce_hier``) — halving rounds ride ICI first, only
+        the fully-halved shards touch DCN.
         """
-        from ..parallel.hierarchical import hierarchical_allreduce
+        from ..parallel.hierarchical import (hierarchical_allreduce,
+                                             hierarchical_allreduce_minmax)
         op = proto.reduce_op
 
-        def reduce_flat(flat):
-            avg = (op == C.ReduceOp.AVERAGE
-                   and jnp.issubdtype(flat.dtype, jnp.floating))
-            red = hierarchical_allreduce(flat, "cross", "local", average=avg)
-            if op == C.ReduceOp.AVERAGE and not avg:
-                red = red // world
-            return red
+        if op in (C.ReduceOp.MIN, C.ReduceOp.MAX):
+            mm = "min" if op == C.ReduceOp.MIN else "max"
+
+            def reduce_flat(flat):
+                return hierarchical_allreduce_minmax(flat, mm, "cross",
+                                                     "local")
+        elif op == C.ReduceOp.ADASUM:
+            from ..common.topology import torus_dims
+            from ..parallel.adasum import adasum_allreduce_hier
+            from ..parallel.topology import hier_bit_orders
+            st = self._slice_topology(proto.process_set_id)
+            orders = hier_bit_orders(st.local_size, st.num_slices)
+            local_bits, cross_bits = orders
+
+            def reduce_flat(flat):
+                return adasum_allreduce_hier(flat, "cross", "local",
+                                             local_bits=local_bits,
+                                             cross_bits=cross_bits)
+        else:
+            def reduce_flat(flat):
+                avg = (op == C.ReduceOp.AVERAGE
+                       and jnp.issubdtype(flat.dtype, jnp.floating))
+                red = hierarchical_allreduce(flat, "cross", "local",
+                                             average=avg)
+                if op == C.ReduceOp.AVERAGE and not avg:
+                    red = red // world
+                return red
 
         return self._build_fused_reduce(proto, shapes, dtypes, hmesh,
                                         P(("cross", "local")), reduce_flat,

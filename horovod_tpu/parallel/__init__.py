@@ -13,9 +13,16 @@ from .ring_attention import (  # noqa: F401
 from .ulysses import heads_to_seq, seq_to_heads, ulysses_attention  # noqa: F401
 from .pipeline import microbatch, pipeline_apply  # noqa: F401
 from .adasum import (  # noqa: F401
-    adasum_allreduce, adasum_allreduce_hd, adasum_combine, torus_bit_order,
+    adasum_allreduce, adasum_allreduce_hd, adasum_allreduce_hier,
+    adasum_combine, torus_bit_order,
 )
-from .hierarchical import hierarchical_allreduce  # noqa: F401
+from .hierarchical import (  # noqa: F401
+    hierarchical_allreduce, hierarchical_allreduce_minmax,
+)
+from .topology import (  # noqa: F401
+    SliceTopology, cross_fraction, hier_bit_orders, modeled_leg_bytes,
+    parse_slice_map, slice_topology,
+)
 from .mesh import (  # noqa: F401
     process_set_mesh, process_set_sharding, process_set_spec,
 )

@@ -138,29 +138,86 @@ def adasum_allreduce_hd(x, axis_name="hvd", bit_order=None, eps=1e-30):
                          "size; use adasum_allreduce instead")
     if n_static == 1:
         return x
-    orig_shape, orig_dtype = x.shape, x.dtype
-    flat = x.astype(jnp.float32).reshape(-1)
-    pad = (-flat.shape[0]) % n_static
-    flat = jnp.pad(flat, (0, pad))
-    rank = lax.axis_index(axis_name)
     rounds = n_static.bit_length() - 1
     bits = list(bit_order) if bit_order is not None else list(range(rounds))
     assert sorted(bits) == list(range(rounds)), bits
+    return _vhd(x, [(axis_name, n_static, b) for b in bits], eps)
 
-    def _pair_perm(dist):
-        return [(i, i ^ dist) for i in range(n_static)]
+
+def adasum_allreduce_hier(x, cross_axis: str = "cross",
+                          local_axis: str = "local",
+                          local_bits=None, cross_bits=None, eps=1e-30):
+    """Two-level vector-halving-doubling Adasum over a (cross, local) mesh.
+
+    VHD mapped onto the torus axes at BOTH levels (ISSUE 17): the halving
+    rounds walk the local (ICI) axis first — by the time a round crosses
+    DCN, each rank's working segment has already shrunk to 1/local_size —
+    then the cross rounds halve over the leader ring, and doubling mirrors
+    back out.  Because ranks are slice-major (local = low rank bits), the
+    (local rounds, then cross rounds) schedule combines gradients in the
+    SAME binary-tree order as the flat identity-bit-order VHD over the
+    whole world, so hierarchical Adasum is the flat algorithm with its
+    cheap rounds pinned to ICI and only the halved shards touching DCN.
+
+    ``local_bits``/``cross_bits`` (from
+    :func:`horovod_tpu.parallel.topology.hier_bit_orders`, refined by the
+    slice's physical torus dims) schedule which rank bit each level's
+    rounds exchange over; identity order by default.  Both extents must be
+    powers of two — callers gate on :func:`hier_bit_orders` returning
+    non-None and keep the flat path otherwise."""
+    n_local = int(compat_axis_size(local_axis))
+    n_cross = int(compat_axis_size(cross_axis))
+    for name, n in (("local", n_local), ("cross", n_cross)):
+        if n & (n - 1):
+            raise ValueError(
+                f"adasum_allreduce_hier requires power-of-two {name} "
+                f"extent, got {n}")
+    lb = list(local_bits) if local_bits is not None \
+        else list(range(n_local.bit_length() - 1))
+    cb = list(cross_bits) if cross_bits is not None \
+        else list(range(n_cross.bit_length() - 1))
+    rounds = [(local_axis, n_local, b) for b in lb] \
+        + [(cross_axis, n_cross, b) for b in cb]
+    return _vhd(x, rounds, eps)
+
+
+def _vhd(x, rounds, eps=1e-30):
+    """Shared halving-doubling core over a round schedule.
+
+    ``rounds`` is a list of ``(axis_name, axis_size, bit)`` — each halving
+    round pairs ranks differing in that bit OF THAT MESH AXIS (a ppermute
+    on one axis permutes within every line of the other axes, so XOR
+    subgroups compose across axes exactly as rank bits do on a flat
+    world).  The Adasum coefficients need dot products over the FULL
+    vectors being combined, which at round ``i`` are spread across the
+    2^(i+1) ranks of the active subgroup — each rank computes partial
+    (a·b, |a|², |b|²) on its piece and the 3-float partials are summed
+    over the subgroup by recursive doubling across the same (axis, bit)
+    pairs (exactly how the reference distributes the dot products).
+    Doubling rounds mirror in reverse: partners exchange their combined
+    segments and concatenate low/high by the round's rank bit — no
+    all-gather anywhere; the whole program is collective-permutes."""
+    if not rounds:
+        return x
+    total = 1 << len(rounds)
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    pad = (-flat.shape[0]) % total
+    flat = jnp.pad(flat, (0, pad))
+
+    def _pair_perm(n_ax, dist):
+        return [(i, i ^ dist) for i in range(n_ax)]
 
     # Halving phase.
     seg = flat  # this rank's current working segment
-    for i, b in enumerate(bits):
-        dist = 1 << b
-        perm = _pair_perm(dist)
+    for i, (ax, n_ax, b) in enumerate(rounds):
+        perm = _pair_perm(n_ax, 1 << b)
         half = seg.shape[0] // 2
         low, high = seg[:half], seg[half:]
-        bit = (rank >> b) & 1        # 0 → keep low/send high; 1 → reverse
+        bit = (lax.axis_index(ax) >> b) & 1  # 0 → keep low/send high
         is_low = (bit == 0)
         to_send = jnp.where(is_low, high, low)
-        received = lax.ppermute(to_send, axis_name, perm=perm)
+        received = lax.ppermute(to_send, ax, perm=perm)
         kept = jnp.where(is_low, low, high)
         # Canonical orientation: "a" is the bit==0 group's vector.  For
         # bit==0 ranks kept is a's piece; for bit==1 ranks received is.
@@ -170,10 +227,10 @@ def adasum_allreduce_hd(x, axis_name="hvd", bit_order=None, eps=1e-30):
         partials = jnp.stack([kr,
                               jnp.where(is_low, kk, rr),
                               jnp.where(is_low, rr, kk)])
-        # Sum partial dots over the active 2^(i+1)-rank XOR subgroup.
-        for b2 in bits[:i + 1]:
+        # Sum partial dots over the active 2^(i+1)-rank subgroup.
+        for ax2, n2, b2 in rounds[:i + 1]:
             partials = partials + lax.ppermute(
-                partials, axis_name, perm=_pair_perm(1 << b2))
+                partials, ax2, perm=_pair_perm(n2, 1 << b2))
         ab, aa, bb = partials[0], partials[1], partials[2]
         ca = 1.0 - ab / (2.0 * aa + eps)
         cb = 1.0 - ab / (2.0 * bb + eps)
@@ -182,10 +239,10 @@ def adasum_allreduce_hd(x, axis_name="hvd", bit_order=None, eps=1e-30):
 
     # Doubling phase: reverse rounds; partners swap combined segments and
     # concatenate in rank-bit order.
-    for b in reversed(bits):
-        perm = _pair_perm(1 << b)
-        received = lax.ppermute(seg, axis_name, perm=perm)
-        seg = lax.cond(((rank >> b) & 1) == 0,
+    for ax, n_ax, b in reversed(rounds):
+        perm = _pair_perm(n_ax, 1 << b)
+        received = lax.ppermute(seg, ax, perm=perm)
+        seg = lax.cond(((lax.axis_index(ax) >> b) & 1) == 0,
                        lambda s, r: jnp.concatenate([s, r]),
                        lambda s, r: jnp.concatenate([r, s]),
                        seg, received)

@@ -42,3 +42,40 @@ def hierarchical_allreduce(x, cross_axis: str = "cross",
         world = n_local * compat_axis_size(cross_axis)
         out = out / jnp.asarray(world, out.dtype)
     return out.astype(orig_dtype)
+
+
+def hierarchical_allreduce_minmax(x, op: str = "min",
+                                  cross_axis: str = "cross",
+                                  local_axis: str = "local"):
+    """Two-level MIN/MAX allreduce; call inside shard_map over a 2-D mesh.
+
+    Same RS→AR→AG shape as the sum path, but min/max have no native
+    scatter-reduce: the intra-slice leg gathers over ICI, reduces
+    elementwise, and keeps this rank's 1/n_local shard (the same
+    construction the engine's flat reducescatter uses for these ops) —
+    only that shard crosses DCN via pmin/pmax.  min/max are exact in any
+    association order, so results are bitwise-identical to the flat
+    pmin/pmax program."""
+    if op not in ("min", "max"):
+        raise ValueError(f"op must be 'min' or 'max', got {op!r}")
+    orig_shape, orig_dtype = x.shape, x.dtype
+    n_local = compat_axis_size(local_axis)
+    flat = x.reshape(-1)
+    pad = (-flat.shape[0]) % n_local
+    if pad:
+        # Pad with this rank's edge value: min/max over copies of a real
+        # element never poisons neighbors, and the pad region is dropped.
+        flat = jnp.pad(flat, (0, pad), mode="edge")
+    # Phase 1: gather + elementwise reduce + keep our slice (RS-equivalent).
+    g = lax.all_gather(flat, local_axis)                 # [n_local, n]
+    full = jnp.min(g, axis=0) if op == "min" else jnp.max(g, axis=0)
+    chunk = full.shape[0] // n_local
+    idx = lax.axis_index(local_axis)
+    shard = lax.dynamic_slice_in_dim(full, idx * chunk, chunk, 0)
+    # Phase 2: reduce the 1/n_local shard across the slow cross axis.
+    shard = (lax.pmin if op == "min" else lax.pmax)(shard, cross_axis)
+    # Phase 3: allgather back across the local axis.
+    out = lax.all_gather(shard, local_axis, tiled=True)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(orig_shape).astype(orig_dtype)

@@ -16,13 +16,14 @@ See ``docs/timeline.md`` for knobs and reading recipes.
 from __future__ import annotations
 
 from .core import (DIGEST_MAX_CYCLES, DIGEST_MAX_OPEN, PHASE_BUCKETS_US,
-                   PHASES, CycleRecord, TensorSpan, TraceRecorder)
+                   PHASES, REDUCE_LEGS, CycleRecord, TensorSpan,
+                   TraceRecorder)
 from .writer import TraceWriter
 
 __all__ = [
-    "PHASES", "PHASE_BUCKETS_US", "DIGEST_MAX_CYCLES", "DIGEST_MAX_OPEN",
-    "CycleRecord", "TensorSpan", "TraceRecorder", "TraceWriter",
-    "maybe_install",
+    "PHASES", "REDUCE_LEGS", "PHASE_BUCKETS_US", "DIGEST_MAX_CYCLES",
+    "DIGEST_MAX_OPEN", "CycleRecord", "TensorSpan", "TraceRecorder",
+    "TraceWriter", "maybe_install",
 ]
 
 

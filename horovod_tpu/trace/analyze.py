@@ -20,7 +20,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from .core import PHASES, STAMPS, phases_from_stamps
+from .core import PHASES, REDUCE_LEGS, STAMPS, phases_from_stamps
 
 
 def _span_phases_us(span: dict) -> Dict[str, float]:
@@ -30,29 +30,59 @@ def _span_phases_us(span: dict) -> Dict[str, float]:
     return phases_from_stamps([span.get(k, 0.0) for k in STAMPS])
 
 
+def _span_legs_us(span: dict, reduce_us: float) -> Optional[Dict[str, float]]:
+    """ICI/DCN split of a span's reduce phase, from the ``cf`` key the
+    engine stamps on two-level dispatches (the modeled DCN share —
+    core.REDUCE_LEGS).  None for flat spans, so leg totals attribute only
+    the time the two-level path actually ran."""
+    frac = float(span.get("cf", 0.0) or 0.0)
+    if frac <= 0.0:
+        return None
+    return {REDUCE_LEGS[0]: reduce_us * (1.0 - frac),
+            REDUCE_LEGS[1]: reduce_us * frac}
+
+
 def phase_summary(ranks: List) -> dict:
-    """Fleet + per-rank per-phase mean/total microseconds."""
+    """Fleet + per-rank per-phase mean/total microseconds.
+
+    When any span rode the two-level data plane, a ``legs`` block splits
+    the fleet's reduce time into intra-slice (ICI) and cross-slice (DCN)
+    legs — the number the crossover-picking workflow reads (DCN time is
+    what a bigger HOROVOD_HIER_THRESHOLD trades against phase latency)."""
     fleet = {p: [0.0, 0] for p in PHASES}        # sum, count
+    legs = {p: [0.0, 0] for p in REDUCE_LEGS}
     per_rank: Dict[int, dict] = {}
     for rt in ranks:
         mine = {p: [0.0, 0] for p in PHASES}
         for s in rt.spans:
-            for p, us in _span_phases_us(s).items():
+            phases = _span_phases_us(s)
+            for p, us in phases.items():
                 mine[p][0] += us
                 mine[p][1] += 1
                 fleet[p][0] += us
                 fleet[p][1] += 1
+            ls = _span_legs_us(s, phases["reduce"])
+            if ls is not None:
+                for p, us in ls.items():
+                    legs[p][0] += us
+                    legs[p][1] += 1
         per_rank[rt.rank] = {
             p: {"total_us": round(v[0], 1),
                 "mean_us": round(v[0] / v[1], 2) if v[1] else None}
             for p, v in mine.items()}
-    return {
+    out = {
         "fleet": {p: {"total_us": round(v[0], 1),
                       "mean_us": round(v[0] / v[1], 2) if v[1] else None,
                       "spans": v[1]}
                   for p, v in fleet.items()},
         "per_rank": per_rank,
     }
+    if any(v[1] for v in legs.values()):
+        out["legs"] = {p: {"total_us": round(v[0], 1),
+                           "mean_us": round(v[0] / v[1], 2) if v[1] else None,
+                           "spans": v[1]}
+                       for p, v in legs.items()}
+    return out
 
 
 def critical_path(ranks: List, max_cycles: Optional[int] = None) -> dict:
@@ -121,6 +151,15 @@ def render_report(ranks: List, max_cycles: int = 20) -> str:
     lines.append(header)
     lines.append("  " + "".join(
         f"{(summary['fleet'][p]['mean_us'] or 0):>14.2f}" for p in PHASES))
+    legs = summary.get("legs")
+    if legs:
+        lines.append("")
+        lines.append("two-level reduce legs (ICI vs DCN, modeled split):")
+        for p in REDUCE_LEGS:
+            v = legs[p]
+            link = "ICI" if p == REDUCE_LEGS[0] else "DCN"
+            lines.append(f"  {p:>14}  {v['total_us']:>12.1f} us total  "
+                         f"{(v['mean_us'] or 0):>10.2f} us mean  [{link}]")
     att = cp["attributed_us"]
     if att:
         total = sum(att.values()) or 1.0

@@ -42,6 +42,17 @@ from typing import Dict, List, Optional, Tuple
 # everywhere else follows this tuple.
 PHASES = ("queue", "negotiation", "copy_in", "reduce", "drain")
 
+# Sub-legs of the ``reduce`` phase for two-level (hierarchical) dispatches
+# (ISSUE 17): the host cannot stamp inside one XLA launch, so the engine
+# stamps each hier span with the MODELED cross-link share of its wire time
+# (``parallel.topology.cross_fraction`` — DCN bytes over total bytes) and
+# the recorder splits the measured reduce duration accordingly.  Flat
+# spans carry cross_frac 0.0 and never touch the leg accumulators, so the
+# legs partition exactly the hier share of ``reduce``:
+#     reduce_intra  ICI legs (intra-slice reduce-scatter + allgather)
+#     reduce_cross  DCN leg  (cross-slice allreduce over the leader ring)
+REDUCE_LEGS = ("reduce_intra", "reduce_cross")
+
 # Span stamp keys on the wire (writer span lines), in lifecycle order:
 # enqueue, drain, ready, launch, result, finished.  PHASES[i] spans
 # STAMPS[i] -> STAMPS[i+1].  THE single definition — the writer, the merge
@@ -91,7 +102,8 @@ class TensorSpan:
     """
 
     __slots__ = ("name", "cycle", "slot", "t_enqueue", "t_drain", "t_ready",
-                 "t_launch", "t_result", "t_done", "error", "committed")
+                 "t_launch", "t_result", "t_done", "error", "committed",
+                 "cross_frac")
 
     def __init__(self):
         self.reset("", 0.0, 0.0)
@@ -109,6 +121,8 @@ class TensorSpan:
         self.t_done = 0.0
         self.error = False
         self.committed = False
+        # Modeled DCN share of the reduce phase; 0.0 = flat dispatch.
+        self.cross_frac = 0.0
 
     def phase_name(self) -> str:
         """The phase this span is currently in (stall attribution)."""
@@ -198,6 +212,13 @@ class TraceRecorder:
         self._phase_sum = {p: 0.0 for p in PHASES}
         self._phase_buckets = {p: [0] * (len(self.buckets) + 1)
                                for p in PHASES}
+        # Two-level reduce legs (REDUCE_LEGS): fed only by spans whose
+        # cross_frac > 0 — the flat path never touches these, so their
+        # absence from a digest proves no hier dispatch happened.
+        self._leg_sum = {p: 0.0 for p in REDUCE_LEGS}
+        self._leg_buckets = {p: [0] * (len(self.buckets) + 1)
+                             for p in REDUCE_LEGS}
+        self.leg_spans = 0
         self.lifecycle_us_total = 0.0
         # Recent cycles, newest last; _cycle_by_id lets late span commits
         # find their cycle's aggregate.
@@ -246,7 +267,8 @@ class TraceRecorder:
                 # the fields mid-write otherwise.
                 record = (span.name, span.cycle, span.slot, span.t_enqueue,
                           span.t_drain, span.t_ready, span.t_launch,
-                          span.t_result, span.t_done, span.error)
+                          span.t_result, span.t_done, span.error,
+                          span.cross_frac)
             span.committed = True
             self.spans_committed += 1
             self.lifecycle_us_total += span.lifecycle_us()
@@ -259,6 +281,22 @@ class TraceRecorder:
                         break
                 else:
                     counts[-1] += 1
+            frac = span.cross_frac
+            if frac > 0.0:
+                # Split the measured reduce duration into the modeled
+                # ICI/DCN legs; together they re-add to reduce exactly.
+                self.leg_spans += 1
+                red = phases["reduce"]
+                for leg, v in ((REDUCE_LEGS[0], red * (1.0 - frac)),
+                               (REDUCE_LEGS[1], red * frac)):
+                    self._leg_sum[leg] += v
+                    counts = self._leg_buckets[leg]
+                    for i, le in enumerate(self.buckets):
+                        if v <= le:
+                            counts[i] += 1
+                            break
+                    else:
+                        counts[-1] += 1
             rec = self._cycle_by_id.get(span.cycle)
             if rec is not None:
                 rec.n_committed += 1
@@ -297,10 +335,20 @@ class TraceRecorder:
 
     def phase_histograms(self) -> Dict[str, tuple]:
         """phase -> (bucket_counts, sum_us, count) cumulative totals, the
-        payload the monitor collector mirrors into registry histograms."""
+        payload the monitor collector mirrors into registry histograms.
+        The two-level reduce legs (REDUCE_LEGS) appear as extra keys once
+        a hierarchical dispatch commits — the collector mirrors whatever
+        keys arrive, so ``hvd_trace_reduce_intra_us`` /
+        ``hvd_trace_reduce_cross_us`` materialize exactly when the
+        two-level path engages."""
         with self._lock:
-            return {p: (list(self._phase_buckets[p]), self._phase_sum[p],
-                        sum(self._phase_buckets[p])) for p in PHASES}
+            out = {p: (list(self._phase_buckets[p]), self._phase_sum[p],
+                       sum(self._phase_buckets[p])) for p in PHASES}
+            if self.leg_spans:
+                for p in REDUCE_LEGS:
+                    out[p] = (list(self._leg_buckets[p]), self._leg_sum[p],
+                              sum(self._leg_buckets[p]))
+            return out
 
     def phase_summary(self) -> dict:
         """Mean per-phase microseconds + mean lifecycle — the bench.py
@@ -312,9 +360,15 @@ class TraceRecorder:
                 return {"spans": 0, "phases_us": None, "cycle_us": None,
                         "phase_sum_us": None}
             phases = {p: round(self._phase_sum[p] / n, 2) for p in PHASES}
-            return {"spans": n, "phases_us": phases,
-                    "cycle_us": round(self.lifecycle_us_total / n, 2),
-                    "phase_sum_us": round(sum(phases.values()), 2)}
+            out = {"spans": n, "phases_us": phases,
+                   "cycle_us": round(self.lifecycle_us_total / n, 2),
+                   "phase_sum_us": round(sum(phases.values()), 2)}
+            if self.leg_spans:
+                out["leg_spans"] = self.leg_spans
+                out["legs_us"] = {
+                    p: round(self._leg_sum[p] / self.leg_spans, 2)
+                    for p in REDUCE_LEGS}
+            return out
 
     def digest(self) -> dict:
         """Compact cross-rank digest for the MON1 monitor snapshot."""
@@ -323,9 +377,15 @@ class TraceRecorder:
                       for rec in self._cycles[-DIGEST_MAX_CYCLES:]]
             phases = {p: [int(round(self._phase_sum[p])),
                           sum(self._phase_buckets[p])] for p in PHASES}
+            legs = {p: [int(round(self._leg_sum[p])), self.leg_spans]
+                    for p in REDUCE_LEGS} if self.leg_spans else None
             n, total = self.spans_committed, self.lifecycle_us_total
         out = {"v": 1, "spans": n, "phases": phases, "cycles": cycles,
                "dropped": self.dropped}
+        if legs:
+            # Appears only once the two-level path engaged; old peers
+            # ignore unknown digest keys (version-safe).
+            out["legs"] = legs
         if n:
             out["cycle_us"] = round(total / n, 1)
         open_ = self.open_spans()

@@ -73,16 +73,23 @@ class TraceWriter:
                     "anchor_wall": anchor_wall, "anchor_mono": anchor_mono})
 
     def span_record(self, name, cycle, slot, t_enqueue, t_drain, t_ready,
-                    t_launch, t_result, t_done, error) -> None:
+                    t_launch, t_result, t_done, error,
+                    cross_frac: float = 0.0) -> None:
         """One span line from an already-snapshotted field tuple (the
         recorder snapshots under its lock BEFORE marking the ring slot
         reclaimable — passing the live span object here would race its
-        recycling).  Stamp keys follow ``core.STAMPS`` order."""
-        self._emit({"k": "s", "n": name, "c": cycle, "slot": slot,
-                    "e": round(t_enqueue, 7), "d": round(t_drain, 7),
-                    "r": round(t_ready, 7), "l": round(t_launch, 7),
-                    "x": round(t_result, 7), "f": round(t_done, 7),
-                    "err": 1 if error else 0})
+        recycling).  Stamp keys follow ``core.STAMPS`` order.  ``cf``
+        (modeled DCN share of the reduce phase, two-level dispatches
+        only) is omitted for flat spans — old readers never see it and
+        flat trace files pay zero extra bytes."""
+        obj = {"k": "s", "n": name, "c": cycle, "slot": slot,
+               "e": round(t_enqueue, 7), "d": round(t_drain, 7),
+               "r": round(t_ready, 7), "l": round(t_launch, 7),
+               "x": round(t_result, 7), "f": round(t_done, 7),
+               "err": 1 if error else 0}
+        if cross_frac:
+            obj["cf"] = round(cross_frac, 4)
+        self._emit(obj)
 
     def cycle(self, rec) -> None:
         self._emit({"k": "c", "c": rec.cycle, "t0": round(rec.t0, 7),

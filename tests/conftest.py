@@ -39,3 +39,12 @@ def hvd():
 def world_size():
     import jax
     return jax.device_count()
+
+
+@pytest.fixture()
+def sim_slices():
+    """The N-slice in-process harness (tests/slice_harness.py): a context
+    manager arming an engine's two-level mode over a simulated N×L split
+    of the 8-device CPU mesh, restoring every knob on exit."""
+    from slice_harness import simulated_slices
+    return simulated_slices

@@ -1,0 +1,146 @@
+"""Two-level allreduce acceptance worker (ISSUE 17): bitwise parity
+flat-vs-hierarchical across REAL processes.
+
+2 processes × 4 local devices = 8 global ranks over 2 simulated slices
+(``HOROVOD_SLICE_MAP=4``; the gloo TCP hop stands in for DCN, the
+intra-process device group for one slice's ICI domain).  Proves, end to
+end through negotiate → fuse → execute:
+
+- parameters after 10 steps on a mixed fp32/bf16/scalar gradient tree are
+  BITWISE identical between the flat ring and the two-level
+  RS(local) → AR(cross) → AG(local) pipeline — the gradient stream is
+  integer-valued (|sum| ≤ 32, inside bf16's exact-integer range), so
+  every reduction order produces the same bits and any parity break is a
+  data-plane bug, not fp noise;
+- the leg counters prove the two-level path actually ran (dispatches,
+  2 intra legs + 1 cross leg each);
+- toggling the mode mid-run costs ZERO warm-path control bytes: the
+  decision lives in the fusion key, never the negotiation digest, so the
+  response-cache slots stay pinned (no new full announces) and the
+  per-round request bytes stay on the same bitvector frame.
+
+Launched by test_multiprocess.py::test_torovodrun_hier_parity with
+``torovodrun -np 2`` — flat control plane AND --hierarchical-controller.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from slice_harness import configure_slice_world
+
+jax = configure_slice_world(4)
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+
+STEPS = 10
+LR = 1.0 / 64.0          # power of two: updates stay exactly representable
+
+
+def make_params():
+    """Mixed tree: non-divisible fp32, 2-D fp32, scalar — all updated in
+    fp32; the bf16 leaf exercises the wire dtype only (its reduced value
+    is exact for integer grads within ±256)."""
+    return {
+        "w1": (np.arange(257, dtype=np.float32) % 7) - 3.0,
+        "w2": ((np.arange(128, dtype=np.float32) % 5) - 2.0).reshape(16, 8),
+        "scalar": np.float32(2.0),
+        "half": (np.arange(66, dtype=np.float32) % 7) - 3.0,
+    }
+
+
+def grad_stream(step, r):
+    """Deterministic integer-valued grads for global rank ``r``."""
+    base = step * 31 + r * 7
+    return {
+        "w1": ((np.arange(257, dtype=np.float32) + base) % 7) - 3.0,
+        "w2": (((np.arange(128, dtype=np.float32) + base) % 5) - 2.0)
+        .reshape(16, 8),
+        "scalar": np.float32((base % 9) - 4),
+        "half": (((np.arange(66, dtype=np.float32) + base) % 7) - 3.0)
+        .astype(jax.numpy.bfloat16),
+    }
+
+
+def train(my_ranks, steps=STEPS, start=0):
+    params = make_params()
+    keys = sorted(params)
+    for s in range(start, start + steps):
+        stacked = [np.stack([np.asarray(grad_stream(s, r)[k])
+                             for r in my_ranks]) for k in keys]
+        outs = hvd.grouped_allreduce(stacked, name="hgrads", op=hvd.Sum)
+        for k, o in zip(keys, outs):
+            loc = np.asarray(hvd.to_local(o))
+            g = (loc if loc.ndim == np.ndim(params[k])
+                 else loc[0]).astype(np.float32)
+            params[k] = np.asarray(params[k] - LR * g, np.float32)
+    return params
+
+
+def main():
+    hvd.init()
+    rank, size, local = hvd.rank(), hvd.size(), hvd.local_size()
+    proc = jax.process_index()
+    assert size == 8 and local == 4, (size, local)
+    my_ranks = range(4 * proc, 4 * proc + 4)
+
+    eng = basics._get_state().engine
+    ctl = eng.controller
+    assert ctl is not None, "worker needs the torovodrun controller"
+    st = ctl.cache_stats
+    assert not eng.hierarchical_allreduce, \
+        "worker must start flat (it toggles the mode itself)"
+
+    # ---- flat baseline + warm-path frame measurement ---------------------
+    p_flat = train(my_ranks)
+    full_before = st.full_announces
+    bytes_before, rounds_before = ctl.bytes_sent, ctl.rounds
+    train(my_ranks, steps=5, start=STEPS)     # flat steady state
+    flat_full = st.full_announces - full_before
+    flat_round = (ctl.bytes_sent - bytes_before) \
+        / max(1, ctl.rounds - rounds_before)
+    assert flat_full == 0, f"flat steady state re-announced: {flat_full}"
+
+    # ---- toggle: two-level data plane over 2 simulated slices ------------
+    eng.hierarchical_allreduce = True
+    eng._slice_topos.clear()                  # knob mutated mid-run
+    topo = eng._slice_topology(0)
+    assert topo is not None and topo.num_slices == 2 \
+        and topo.local_size == 4, topo
+
+    d0, i0, c0 = eng.hier_dispatches, eng.hier_intra_legs, eng.hier_cross_legs
+    full_before = st.full_announces
+    bytes_before, rounds_before = ctl.bytes_sent, ctl.rounds
+    p_hier = train(my_ranks)
+    for k in sorted(p_flat):
+        np.testing.assert_array_equal(p_flat[k], p_hier[k])   # BITWISE
+
+    # Two-level path actually ran: 1 dispatch per step (one fused batch),
+    # 2 intra legs + 1 cross leg each.
+    assert eng.hier_dispatches > d0, "no hierarchical dispatches"
+    assert eng.hier_intra_legs == i0 + 2 * (eng.hier_dispatches - d0)
+    assert eng.hier_cross_legs == c0 + (eng.hier_dispatches - d0)
+
+    # Zero extra control bytes: the knob flip must not re-announce (the
+    # mode is fusion-key-only, never in the digest) and the per-round
+    # request must stay on the same pinned bitvector frame as flat.
+    hier_full = st.full_announces - full_before
+    hier_round = (ctl.bytes_sent - bytes_before) \
+        / max(1, ctl.rounds - rounds_before)
+    assert hier_full == 0, \
+        f"hier toggle re-announced {hier_full} tensors (digest leak?)"
+    assert hier_round <= flat_round + 0.5, (hier_round, flat_round)
+
+    hvd.barrier()
+    print(f"HIER_OK rank={rank} dispatches={eng.hier_dispatches} "
+          f"intra={eng.hier_intra_legs} cross={eng.hier_cross_legs} "
+          f"round={hier_round:.1f}", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -13,19 +13,18 @@ Launched by test_multiprocess.py::test_hierarchical_two_slices with
 """
 
 import os
+import sys
 
-# 4 virtual CPU devices per process — the "slice" (the launcher strips the
-# inherited 8-device flag; each worker declares its own local world).  The
-# device count goes through the compat shim: ``jax_num_cpu_devices`` does
-# not exist on jax 0.4.x, where only the XLA flag works.
-os.environ["XLA_FLAGS"] = " ".join(
-    f for f in os.environ.get("XLA_FLAGS", "").split()
-    if "xla_force_host_platform_device_count" not in f)
-import jax
-from horovod_tpu.compat import set_host_device_count
-jax.config.update("jax_platforms", "cpu")
-set_host_device_count(4)
-jax.config.update("jax_cpu_collectives_implementation", "gloo")
+# 4 virtual CPU devices per process — the "slice" — via the shared
+# harness (tests/slice_harness.py): strips the inherited 8-device flag,
+# declares the local count through the compat shim (``jax_num_cpu_devices``
+# does not exist on jax 0.4.x, where only the XLA flag works), pins CPU +
+# gloo.
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from slice_harness import configure_slice_world
+
+jax = configure_slice_world(4)
 
 import numpy as np
 import horovod_tpu as hvd

@@ -1,0 +1,74 @@
+"""Reusable N-slice simulation harness (promoted from the two-slice
+worker preamble, ISSUE 17 satellite).
+
+Two entry points for two process shapes:
+
+- :func:`configure_slice_world` — subprocess workers (``tests/data/
+  worker_*.py`` launched by ``torovodrun``): the pre-backend-init env
+  dance — strip any inherited ``xla_force_host_platform_device_count``
+  flag so stacked callers compose (the harness conftest injects one for
+  the in-process 8-device mesh; a worker that wants 4 must not inherit
+  8), declare the per-process device count through the compat shim, pin
+  the CPU platform + gloo cross-process collectives, and optionally set
+  ``HOROVOD_SLICE_MAP`` so the engine sees simulated slice boundaries
+  (CPU devices carry no ``slice_index`` attribute).  Must run before
+  anything initializes the JAX backend.
+
+- :func:`simulated_slices` — in-process tests on the conftest's 8-device
+  CPU mesh: arm an already-built engine's hierarchical mode with a
+  simulated N×L slice split, clear the cached topology (the engine
+  caches per process set — mutating the knobs without clearing would
+  keep serving the old split), yield the derived topology, and restore
+  every knob on exit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+
+def configure_slice_world(local_devices: int, *, slice_map: str = "",
+                          gloo: bool = True):
+    """Pre-init setup for one simulated-slice worker process.
+
+    Returns the ``jax`` module so callers can keep configuring it.
+    """
+    os.environ["XLA_FLAGS"] = " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    if slice_map:
+        os.environ["HOROVOD_SLICE_MAP"] = slice_map
+    import jax
+
+    from horovod_tpu.compat import set_host_device_count
+    jax.config.update("jax_platforms", "cpu")
+    set_host_device_count(int(local_devices))
+    if gloo:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    return jax
+
+
+@contextlib.contextmanager
+def simulated_slices(engine, num_slices: int, local_size: int, *,
+                     threshold: int = 0):
+    """Arm ``engine`` for two-level dispatch over a simulated
+    ``num_slices × local_size`` split of its (flat, usually 8-device CPU)
+    world; yield the derived ``SliceTopology``; restore on exit.
+    """
+    saved = (engine.hierarchical_allreduce, engine._hier_local_size,
+             engine.slice_map, engine.hier_threshold_bytes)
+    engine.hierarchical_allreduce = True
+    engine._hier_local_size = int(local_size)
+    engine.slice_map = ",".join([str(int(local_size))] * int(num_slices))
+    engine.hier_threshold_bytes = int(threshold)
+    engine._slice_topos.clear()
+    try:
+        st = engine._slice_topology(0)
+        assert st is not None and st.num_slices == num_slices \
+            and st.local_size == local_size, st
+        yield st
+    finally:
+        (engine.hierarchical_allreduce, engine._hier_local_size,
+         engine.slice_map, engine.hier_threshold_bytes) = saved
+        engine._slice_topos.clear()

@@ -198,6 +198,56 @@ def test_parameter_manager_pipeline_coordinates(tmp_path):
     assert "round_pipeline" in header and "spec_ready_after" not in header
 
 
+def test_parameter_manager_hier_threshold_coordinate(tmp_path):
+    """ISSUE 17: with the two-level mode ARMED the search gains the
+    hier_threshold coordinate (flat-vs-hierarchical crossover, learned
+    per pod instead of hand-set); it lands on engine.hier_threshold_bytes
+    inside bounds and rides the log header + final line.  Mode off →
+    coordinate off (no dead knob in the search)."""
+
+    class FakeCtl:
+        cache_enabled = False
+        cache_capacity = 0
+        spec_ready_after = 0
+        round_pipeline = 1
+
+    eng = FakeEngine(thr=1 << 20, cyc=0.001)
+    eng.controller = FakeCtl()
+    eng.pipeline_chunk_bytes = 0
+    eng.max_inflight = 2
+    eng.hierarchical_allreduce = True
+    eng.hier_threshold_bytes = 0           # start derives from the floor
+    clock = FakeClock()
+    bc, poll, sent = _loopback_transport()
+    log = tmp_path / "autotune_hier.csv"
+    pm = ParameterManager(eng, warmup_samples=0, steps_per_sample=1,
+                          log_path=str(log), clock=clock,
+                          broadcaster=bc, poller=poll, max_evals=8)
+    assert pm._tune_hier
+    # thr, cyc, chunk, inflight, fast_lane, hier, round_pipeline
+    assert len(pm.search.point) == 7
+    for _ in range(40):
+        if not pm.tuning:
+            break
+        _drive_sample(pm, clock, 1 << 20, 0.01)
+    assert sent and all(len(p) == 8 for p in sent), [len(p) for p in sent]
+    assert (1 << 10) <= eng.hier_threshold_bytes <= (1 << 28)
+    text = log.read_text()
+    assert "hier_threshold_bytes" in text.splitlines()[0]
+    assert "hier_threshold_bytes=" in text.splitlines()[-1]
+
+    # Mode disarmed → the coordinate never enters the search.
+    eng2 = FakeEngine()
+    eng2.controller = FakeCtl()
+    eng2.pipeline_chunk_bytes = 0
+    eng2.max_inflight = 2
+    pm2 = ParameterManager(eng2, warmup_samples=0, steps_per_sample=1,
+                           clock=FakeClock(), broadcaster=bc, poller=poll,
+                           max_evals=4)
+    assert not pm2._tune_hier
+    assert len(pm2.search.point) == 6
+
+
 def test_parameter_manager_checkpoint_lane_coordinates(tmp_path):
     """ISSUE 15 (the ISSUE 14 carry-over): with the state plane armed the
     search gains the checkpoint-lane pair — shard-chunk bytes and the

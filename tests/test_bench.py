@@ -129,6 +129,25 @@ def test_bench_minimal_mode():
         sab["wire_bytes_per_step_allreduce"], sab
     assert sab["params_match"] is True, sab
     assert sab["step_ms_sharded"] > 0 and sab["step_ms_replicated"] > 0, sab
+    # Two-level allreduce A/B (ISSUE 17) on every line: flat-vs-hier
+    # bitwise identity on integer payloads, the leg counters proving the
+    # two-level path ran, the modeled cross-slice (DCN) wire bytes ≤
+    # ~1/local_size of the flat ring's, and the crossover_mb key present
+    # (null is legitimate: on a CPU mesh the three-launch pipeline
+    # usually never beats one flat launch).
+    hab = out["hierarchical_ab"]
+    assert hab["world"] == 8 and hab["local_size"] == 4, hab
+    assert hab["bitwise_identical"] is True, hab
+    assert hab["hier_dispatches"] > 0, hab
+    assert hab["hier_intra_legs"] == 2 * hab["hier_dispatches"], hab
+    assert hab["hier_cross_legs"] == hab["hier_dispatches"], hab
+    assert "crossover_mb" in hab, hab
+    for rec in hab["sizes"]:
+        assert rec["bitwise_identical"] is True, rec
+        assert rec["cross_leq_flat_over_local"] is True, rec
+        assert rec["wire_bytes_cross"] <= \
+            rec["wire_bytes_flat"] / hab["local_size"] + 1, rec
+        assert rec["flat_ms"] > 0 and rec["hier_ms"] > 0, rec
     # Zero-RTT A/B (ISSUE 11) on every line: with speculation on, warm
     # cycles stop paying the negotiation round trip (< 1 per cycle, hit
     # rate ≥ 90% on this stable workload) while every rank's verdict

@@ -1,0 +1,183 @@
+"""Two-level ICI/DCN allreduce (ISSUE 17): topology derivation + engine
+data-plane tests on the in-process 8-device CPU mesh.
+
+The multi-process acceptance (real DCN hop) lives in
+``test_multiprocess.py::test_torovodrun_hier_parity`` / ``worker_hier``;
+here the ``sim_slices`` harness splits the single-process mesh into
+simulated slices, which exercises the identical fused program builders,
+cache keys and decision logic with fast turnaround.
+"""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.parallel.topology import (cross_fraction, hier_bit_orders,
+                                           modeled_leg_bytes,
+                                           parse_slice_map, slice_topology)
+
+
+# --------------------------------------------------------------- topology
+def test_parse_slice_map_uniform_and_explicit():
+    assert parse_slice_map("4", 8) == (0, 0, 0, 0, 1, 1, 1, 1)
+    assert parse_slice_map("2,2,2,2", 8) == (0, 0, 1, 1, 2, 2, 3, 3)
+    assert parse_slice_map("", 8) is None
+    # non-divisor, non-uniform, wrong sum, garbage — all loud failures
+    for bad in ("0", "3", "4,5", "4,4,4", "2,2,4", "x", "-2"):
+        with pytest.raises(ValueError):
+            parse_slice_map(bad, 8)
+
+
+def test_slice_topology_from_knobs():
+    st = slice_topology(None, world=8, slice_map="4")
+    assert st.num_slices == 2 and st.local_size == 4
+    assert st.ranks_of_slice(0) == [0, 1, 2, 3]
+    assert st.ranks_of_slice(1) == [4, 5, 6, 7]
+    assert st.leaders == (0, 4)
+    # local_size knob and uniform per-process counts derive the same split
+    assert slice_topology(None, world=8, local_size=4).leaders == (0, 4)
+    assert slice_topology(None, world=8,
+                          local_counts=[4, 4]).num_slices == 2
+    # no derivable split (or a world too small for two levels) → flat
+    assert slice_topology(None, world=8) is None
+    assert slice_topology(None, world=2, slice_map="1") is None
+    with pytest.raises(ValueError):
+        slice_topology(None, world=8, slice_map="5")
+
+
+def test_cross_ring_order_follows_coords():
+    class D:  # simulated TPU device attributes
+        def __init__(self, i, slice_index, coords):
+            self.id = i
+            self.slice_index = slice_index
+            self.coords = coords
+            self.core_on_chip = 0
+            self.platform = "tpu"
+
+    # Leader coords deliberately out of slice-id order: the DCN ring must
+    # visit slices in physical-neighbor order (0,0,0) < (2,0,0) < (4,0,0)
+    # → slice order 0, 2, 1.
+    devs = [D(0, 0, (0, 0, 0)), D(1, 0, (1, 0, 0)),
+            D(2, 1, (4, 0, 0)), D(3, 1, (5, 0, 0)),
+            D(4, 2, (2, 0, 0)), D(5, 2, (3, 0, 0))]
+    st = slice_topology(devs, world=6)
+    assert st.num_slices == 3 and st.local_size == 2
+    assert st.leaders == (0, 2, 4)
+    assert st.cross_order == (0, 2, 1)
+    assert st.leader_set_ranks() == [0, 4, 2]
+
+
+def test_hier_bit_orders_power_of_two_only():
+    lb, cb = hier_bit_orders(4, 2)
+    assert lb == [0, 1] and cb == [0]
+    assert hier_bit_orders(3, 2) is None
+    assert hier_bit_orders(4, 3) is None
+    assert hier_bit_orders(1, 8) is None     # one-rank slices are flat
+    assert hier_bit_orders(8, 4) == ([0, 1, 2], [0, 1])
+
+
+def test_modeled_leg_bytes_ratio():
+    m = modeled_leg_bytes(1 << 20, world=8, local_size=4)
+    # flat ring 2n(W-1)/W; cross leg 2(n/L)(C-1)/C ≤ flat/local_size
+    assert m["flat"] == pytest.approx(2 * (1 << 20) * 7 / 8)
+    assert m["cross"] == pytest.approx(2 * (1 << 20) / 4 / 2)
+    assert m["cross"] <= m["flat"] / 4
+    frac = cross_fraction(1 << 20, world=8, local_size=4)
+    assert 0.0 < frac < 1.0
+    assert frac == pytest.approx(m["cross"] / (m["cross"] + m["intra"]))
+
+
+# ------------------------------------------------------------- data plane
+def _int_stacked(hvd, world, shape=(16,), dtype=np.float32, seed=0):
+    """Integer-valued per-rank payloads: every reduction order produces
+    the same bits, so flat-vs-hier comparisons can demand equality."""
+    rng = np.random.RandomState(seed)
+    return hvd.stack_per_rank(
+        [rng.randint(-3, 4, size=shape).astype(dtype) for _ in range(world)])
+
+
+def _engine():
+    import horovod_tpu.ops.eager as eager
+    return eager._engine()
+
+
+@pytest.mark.parametrize("opname", ["Sum", "Average", "Min", "Max",
+                                    "Adasum"])
+def test_hier_bitwise_parity(hvd, world_size, sim_slices, opname):
+    """Flat and two-level dispatch agree BITWISE for every supported op
+    on integer-valued fp32 payloads over 2 simulated slices."""
+    eng = _engine()
+    op = getattr(hvd, opname)
+    x = _int_stacked(hvd, world_size, shape=(33,), seed=hash(opname) % 100)
+    flat = np.asarray(hvd.allreduce(x, name=f"hp_{opname}_f", op=op))
+    with sim_slices(eng, 2, world_size // 2):
+        d0 = eng.hier_dispatches
+        hier = np.asarray(hvd.allreduce(x, name=f"hp_{opname}_h", op=op))
+        assert eng.hier_dispatches == d0 + 1, "two-level path did not run"
+    np.testing.assert_array_equal(flat, hier)
+
+
+def test_hier_mixed_group_and_bf16(hvd, world_size, sim_slices):
+    """A fused mixed-dtype group (fp32 + bf16 + scalar-ish small tensor)
+    rides ONE two-level dispatch with flat-identical bits."""
+    import jax.numpy as jnp
+    eng = _engine()
+    a = _int_stacked(hvd, world_size, shape=(257,), seed=3)
+    b = hvd.stack_per_rank(
+        [np.full((2, 2), float(r - 1), np.float32).astype(jnp.bfloat16)
+         for r in range(world_size)])
+    c = _int_stacked(hvd, world_size, shape=(1,), seed=4)
+    flat = [np.asarray(o, np.float32) for o in hvd.grouped_allreduce(
+        [a, b, c], name="hg_f", op=hvd.Sum)]
+    with sim_slices(eng, 2, world_size // 2):
+        d0 = eng.hier_dispatches
+        hier = [np.asarray(o, np.float32) for o in hvd.grouped_allreduce(
+            [a, b, c], name="hg_h", op=hvd.Sum)]
+        assert eng.hier_dispatches == d0 + 1
+        assert eng.hier_intra_legs >= 2 and eng.hier_cross_legs >= 1
+    for f, h in zip(flat, hier):
+        np.testing.assert_array_equal(f, h)
+
+
+def test_hier_threshold_crossover(hvd, world_size, sim_slices):
+    """Payloads under HOROVOD_HIER_THRESHOLD dispatch flat; the per-call
+    ``hierarchical=True`` override wins over the threshold."""
+    eng = _engine()
+    small = _int_stacked(hvd, world_size, shape=(8,), seed=5)
+    with sim_slices(eng, 2, world_size // 2, threshold=1 << 20):
+        d0 = eng.hier_dispatches
+        hvd.allreduce(small, name="ht_small", op=hvd.Sum)
+        assert eng.hier_dispatches == d0, "sub-threshold batch went hier"
+        hvd.allreduce(small, name="ht_forced", op=hvd.Sum,
+                      hierarchical=True)
+        assert eng.hier_dispatches == d0 + 1, "override did not force hier"
+    # knob restored + topology cache cleared by the harness
+    assert eng.hier_threshold_bytes != 1 << 20 or not eng._slice_topos
+
+
+def test_hier_decision_rekeys_program_cache(hvd, world_size, sim_slices):
+    """The flat-vs-hier decision is a fusion-key/cache-key input: the
+    same (shape, dtype, op) compiles one program per mode and neither is
+    cross-served (a hier program run flat would change the wire
+    schedule silently)."""
+    eng = _engine()
+    x = _int_stacked(hvd, world_size, shape=(64,), seed=6)
+    hvd.allreduce(x, name="hk", op=hvd.Sum)           # flat program
+    misses0 = eng.cache.misses
+    with sim_slices(eng, 2, world_size // 2):
+        hvd.allreduce(x, name="hk", op=hvd.Sum)       # hier program
+        assert eng.cache.misses == misses0 + 1
+        hvd.allreduce(x, name="hk", op=hvd.Sum)       # warm hier hit
+        assert eng.cache.misses == misses0 + 1
+    hvd.allreduce(x, name="hk", op=hvd.Sum)           # flat again: warm
+    assert eng.cache.misses == misses0 + 1
+
+
+def test_hier_explicit_false_pins_flat(hvd, world_size, sim_slices):
+    """``hierarchical=False`` pins a batch flat even with the mode armed
+    and the payload over threshold."""
+    eng = _engine()
+    x = _int_stacked(hvd, world_size, shape=(128,), seed=7)
+    with sim_slices(eng, 2, world_size // 2):
+        d0 = eng.hier_dispatches
+        hvd.allreduce(x, name="hx", op=hvd.Sum, hierarchical=False)
+        assert eng.hier_dispatches == d0

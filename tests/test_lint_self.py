@@ -82,6 +82,16 @@ def test_self_lint_covers_autoscale_stack():
     assert not findings, "\n".join(f.render() for f in findings)
 
 
+def test_self_lint_covers_slice_topology():
+    """Explicit coverage for the two-level data plane's topology module
+    (ISSUE 17): ``parallel/topology.py`` is jax-free and feeds the engine
+    the (cross, local) mesh structure — it must parse and lint clean."""
+    path = os.path.join(REPO, "horovod_tpu", "parallel", "topology.py")
+    assert os.path.exists(path), path
+    findings = lint_paths([path])
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
 def test_self_lint_covers_fault_harness():
     """Explicit coverage for the fault-injection harness AND the churn
     runner (ISSUE 12): both drive the control plane from the jax-free

@@ -489,7 +489,8 @@ root = sys.argv[1]
 for name, sub in (('horovod_tpu', ''), ('horovod_tpu.ops', 'ops'),
                   ('horovod_tpu.utils', 'utils'),
                   ('horovod_tpu.common', 'common'),
-                  ('horovod_tpu.analysis', 'analysis')):
+                  ('horovod_tpu.analysis', 'analysis'),
+                  ('horovod_tpu.parallel', 'parallel')):
     m = types.ModuleType(name)
     m.__path__ = [os.path.join(root, sub)] if sub else [root]
     sys.modules[name] = m
@@ -498,6 +499,15 @@ importlib.import_module('horovod_tpu.monitor')
 importlib.import_module('horovod_tpu.monitor.__main__')
 importlib.import_module('horovod_tpu.monitor.http')
 importlib.import_module('horovod_tpu.analysis.findings')
+# Slice topology (ISSUE 17): derives the two-level (cross, local) mesh
+# structure for the engine but is itself pure Python — the analyzer and
+# bench model wire bytes with it from the jax-free tier.
+topo = importlib.import_module('horovod_tpu.parallel.topology')
+st = topo.slice_topology(None, world=8, slice_map='4')
+assert st.num_slices == 2 and st.leaders == (0, 4), st
+assert topo.hier_bit_orders(4, 2) == ([0, 1], [0])
+legs = topo.modeled_leg_bytes(1 << 20, 8, 4)
+assert legs['cross'] <= legs['flat'] / 4, legs
 # Per-process-set sanitizer namespace (ISSUE 16): the ledger recorder
 # must import AND keep per-set books correctly with jax hard-blocked —
 # it runs in launcher-adjacent tooling and the jax-free test tier.

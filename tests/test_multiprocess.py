@@ -185,6 +185,31 @@ def test_hierarchical_two_slices():
         f"stderr:\n{res.stderr[-3000:]}")
 
 
+WORKER_HIER_PARITY = os.path.join(REPO, "tests", "data", "worker_hier.py")
+
+
+@pytest.mark.parametrize("controller", ["flat", "hier"])
+def test_torovodrun_hier_parity(controller):
+    """ISSUE 17 acceptance: after 10 steps on a mixed fp32/bf16/scalar
+    integer-valued gradient tree over 2 simulated slices (2 procs × 4
+    local devices, HOROVOD_SLICE_MAP=4), parameters from the two-level
+    RS(local)→AR(cross)→AG(local) pipeline are BITWISE identical to the
+    flat ring's, the leg counters prove the path ran, and toggling the
+    mode mid-run cost zero warm-path control bytes (assertions live in
+    the worker).  Runs against both control planes — the per-host agent
+    must forward the unchanged digests identically."""
+    extra = (("--hierarchical-controller",) if controller == "hier"
+             else ())
+    res = _run_torovodrun(2, WORKER_HIER_PARITY, timeout=300,
+                          extra_args=extra,
+                          extra_env={"HOROVOD_ONE_PROC_PER_HOST": "1",
+                                     "HOROVOD_SLICE_MAP": "4"})
+    ok = res.stdout.count("HIER_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
 WORKER_TORCH = os.path.join(REPO, "tests", "data", "worker_torch.py")
 
 

@@ -246,6 +246,52 @@ def test_digest_blind_to_fast_lane_mark():
     assert TCPController._digest(a) == TCPController._digest(b)
 
 
+def test_digest_blind_to_hierarchical_mark():
+    """ISSUE 17: the flat-vs-hier decision re-keys the fused program
+    cache, NEVER the negotiation digest — a digest change would churn
+    every learned slot each time HOROVOD_HIER_THRESHOLD (or an autotune
+    move, or the mode knob itself) flips a batch across the crossover.
+    Same zero-traffic rule as the fast-lane mark and the chunk plan."""
+    a, b, c = E("t"), E("t"), E("t")
+    b.hierarchical = True
+    c.hierarchical = False
+    assert TCPController._digest(a) == TCPController._digest(b)
+    assert TCPController._digest(a) == TCPController._digest(c)
+
+
+def test_hier_toggle_keeps_13b_steady_state_frame():
+    """ISSUE 17 frame guard: flipping the hierarchical knob mid-run
+    leaves the warm-path request byte-identical — the steady-state
+    single-tensor cycle stays exactly 4B n_full + 4B bv_len + 1B bitvec
+    + 4B n_tag = 13 bytes, and no slot re-announces (the 13B frame is
+    how we know the toggle never touched the control plane)."""
+
+    def fn(ctl, rank):
+        def mk_flat():
+            return [E("t")]
+
+        def mk_hier():
+            e = E("t")
+            e.hierarchical = True     # engine-side mark: wire-invisible
+            return [e]
+
+        _steps(ctl, mk_flat, 2)                 # warm-up: learn the slot
+        st = ctl.cache_stats
+        full_before = st.full_announces
+        bytes_before, rounds_before = ctl.bytes_sent, ctl.rounds
+        _steps(ctl, mk_hier, 3)                 # toggle ON mid-run
+        _steps(ctl, mk_flat, 2)                 # ... and back OFF
+        assert st.full_announces == full_before, (
+            "hier toggle re-announced — the mark leaked into the digest")
+        per_round = ((ctl.bytes_sent - bytes_before)
+                     / (ctl.rounds - rounds_before))
+        assert per_round == 13, (
+            f"warm-path frame grew to {per_round}B across the hier toggle")
+        return True
+
+    _pair(fn)
+
+
 def test_v4_liveness_adds_zero_warm_path_bytes():
     """Protocol-v4 frame guard: the fault-tolerance machinery (FLT1
     capability ad, server liveness tracking, abort frames) must add ZERO

@@ -174,6 +174,99 @@ def test_phase_histograms_feed_registry():
         h.set_cumulative([1], 1, 1)
 
 
+def test_reduce_legs_partition_hier_spans_only():
+    """ISSUE 17: spans carrying a modeled cross_frac split their reduce
+    phase into ICI/DCN legs that re-add EXACTLY; flat spans (frac 0)
+    never touch the leg accumulators, so leg totals attribute only the
+    time the two-level path actually ran."""
+    from horovod_tpu.trace import REDUCE_LEGS
+
+    rec = TraceRecorder(capacity=64)
+    _make_span(rec, "flat", 1, 10.0)                   # frac 0.0
+    span = rec.begin("hier", 20.0, 20.001)
+    span.cycle = 2
+    span.cross_frac = 0.25
+    _stamp(span, 20.0)                                 # reduce = 4000us
+    rec.commit(span)
+
+    assert rec.leg_spans == 1
+    hists = rec.phase_histograms()
+    assert set(REDUCE_LEGS) <= set(hists)
+    _, intra_us, n_i = hists[REDUCE_LEGS[0]]
+    _, cross_us, n_c = hists[REDUCE_LEGS[1]]
+    assert n_i == 1 and n_c == 1
+    assert intra_us == pytest.approx(3000, rel=1e-6)
+    assert cross_us == pytest.approx(1000, rel=1e-6)
+    # the split re-adds to the hier span's reduce share exactly
+    assert intra_us + cross_us == pytest.approx(4000, rel=1e-6)
+
+    summary = rec.phase_summary()
+    assert summary["leg_spans"] == 1
+    assert summary["legs_us"][REDUCE_LEGS[1]] == pytest.approx(1000,
+                                                               rel=1e-3)
+    digest = rec.digest()
+    assert "legs" in digest and REDUCE_LEGS[1] in digest["legs"]
+
+    # monitor mirroring: leg keys ride the generic histogram loop
+    reg = MetricRegistry()
+    counts, sum_us, count = hists[REDUCE_LEGS[1]]
+    h = reg.histogram("hvd_trace_reduce_cross_us", buckets=rec.buckets)
+    h.set_cumulative(counts, sum_us, count)
+    assert h.snapshot_value()["sum"] == pytest.approx(1000, abs=0.1)
+
+
+def test_reduce_legs_absent_on_flat_runs():
+    """A recorder that never saw a two-level span exposes NO leg keys —
+    flat traces and /metrics stay byte-identical to the pre-ISSUE-17
+    shape (the disarmed-costs-nothing contract, leg edition)."""
+    from horovod_tpu.trace import REDUCE_LEGS
+
+    rec = TraceRecorder(capacity=64)
+    _make_span(rec, "flat", 1, 10.0)
+    assert rec.leg_spans == 0
+    assert set(rec.phase_histograms()) == set(PHASES)
+    assert "leg_spans" not in rec.phase_summary()
+    assert "legs" not in rec.digest()
+    for leg in REDUCE_LEGS:
+        assert leg not in rec.phase_histograms()
+
+
+def test_analyzer_splits_reduce_by_cf_key(tmp_path):
+    """Offline agreement: span lines carrying ``cf`` split the reduce
+    phase in phase_summary()['legs'] with the same carry-forward rule the
+    live recorder applies, and the report renders the ICI/DCN block."""
+    from horovod_tpu.trace.analyze import render_report
+
+    path = str(tmp_path / per_rank_filename("tr", 0))
+    writer = TraceWriter(path, rank=0)
+    rec = TraceRecorder(capacity=64, writer=writer, rank=0)
+    rec.anchor_wall, rec.anchor_mono = 1000.0, 0.0
+    writer.header(rank=0, anchor_wall=1000.0, anchor_mono=0.0)
+    rec.cycle(1, 1.0, 1.001, 1.002, 1.003, 2, 50.0)
+    _make_span(rec, "flat", 1, 1.0)
+    span = rec.begin("hier", 2.0, 2.001)
+    span.cycle = 1
+    span.cross_frac = 0.5
+    _stamp(span, 2.0)
+    rec.commit(span)
+    rec.close()
+
+    rt = load_trace_file(path)
+    flat_line = next(s for s in rt.spans if s["n"] == "flat")
+    hier_line = next(s for s in rt.spans if s["n"] == "hier")
+    assert "cf" not in flat_line                 # flat lines pay 0 bytes
+    assert hier_line["cf"] == pytest.approx(0.5, abs=1e-4)
+
+    summary = phase_summary([rt])
+    legs = summary["legs"]
+    assert legs["reduce_intra"]["spans"] == 1
+    assert legs["reduce_cross"]["total_us"] == pytest.approx(2000,
+                                                             rel=1e-3)
+    report = render_report([rt])
+    assert "two-level reduce legs" in report
+    assert "DCN" in report and "ICI" in report
+
+
 # ------------------------------------------------------------ writer/merge
 def _write_rank_file(tmp_path, rank, cycles, anchor_wall=1000.0,
                      phase_scale=1.0):

@@ -577,6 +577,70 @@ def test_hvd108_sharded_flag_is_a_schedule_dimension(tmp_path):
     assert "grouped_reducescatter[sharded]" in hits[0].message
 
 
+def test_hvd108_hierarchical_flag_is_a_schedule_dimension(tmp_path):
+    """ISSUE 17: hierarchical=True rides the fusion key (never the
+    digest), but batching groups by fusion key — a pinned two-level
+    allreduce and a flat one are different batch plans, so branches
+    choosing between them must diverge, exactly like [sharded]."""
+    pkg = make_pkg(tmp_path, {
+        "step.py": """
+            import horovod_tpu as hvd
+
+            def step(x, big):
+                if big:
+                    return hvd.allreduce(x, hierarchical=True)
+                return hvd.allreduce(x)
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD108")
+    assert len(hits) == 1
+    assert "allreduce[hier]" in hits[0].message
+
+
+def test_hvd108_hierarchical_both_arms_stay_clean(tmp_path):
+    """Accuracy control: both arms pinning hierarchical=True emit the
+    same [hier] schedule — no false divergence."""
+    pkg = make_pkg(tmp_path, {
+        "step.py": """
+            import horovod_tpu as hvd
+
+            def step(x, log):
+                if log:
+                    out = hvd.allreduce(x, hierarchical=True)
+                    print("stepped")
+                    return out
+                return hvd.allreduce(x, hierarchical=True)
+        """,
+    })
+    assert "HVD108" not in rules_of(analyze_package([pkg]))
+
+
+def test_hvd110_catches_rank_derived_hierarchical_flag(tmp_path):
+    """A world-divergent ``hierarchical=`` override forks the batch plan
+    (batching groups by fusion key) — HVD110, same as sharded=."""
+    pkg = make_pkg(tmp_path, {
+        "bad.py": """
+            import horovod_tpu as hvd
+
+            def reduce(x):
+                return hvd.allreduce(x, hierarchical=hvd.rank() < 4)
+        """,
+    })
+    hits = by_rule(analyze_package([pkg]), "HVD110")
+    assert len(hits) == 1 and hits[0].is_error
+    assert "hierarchical=" in hits[0].message
+    # fleet-uniform pins stay clean
+    pkg2 = make_pkg(tmp_path, {
+        "good.py": """
+            import horovod_tpu as hvd
+
+            def reduce(x):
+                return hvd.allreduce(x, hierarchical=True)
+        """,
+    }, name="ok")
+    assert "HVD110" not in rules_of(analyze_package([pkg2]))
+
+
 def test_hvd109_sharded_update_in_transition_callback(tmp_path):
     """The sharded update is a collective program like any other: reachable
     from a mid-transition callback it must fire HVD109, named as the
