@@ -33,7 +33,7 @@ from __future__ import annotations
 import ast
 import dataclasses
 import os
-from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from .collective_lint import (
     COLLECTIVE_NAMES, _FunctionFacts, _TRAINING_WRAPPERS, _call_name,
@@ -180,12 +180,14 @@ class CollectiveSite:
     col: int
     guard: Optional[Guard]
     has_process_set: bool
-    # ZeRO-sharded site (ISSUE 15): a collective submitted with
-    # sharded=True, or the synthetic ``sharded_update`` site registered
-    # for ``opt.update(...)`` on a DistributedOptimizer(sharded=True) /
-    # sharded_optimizer binding — the schedule pass expands the latter to
-    # its real reduce-scatter + allgather sequence.
-    sharded: bool = False
+    # ZeRO-sharded site (ISSUE 15/18): the constant ``sharded=`` value a
+    # collective was submitted with (True or "full"), or the mode of the
+    # synthetic ``sharded_update`` site registered for ``opt.update(...)``
+    # on a DistributedOptimizer(sharded=...) / sharded_optimizer /
+    # full_sharded_optimizer binding — the schedule pass expands the
+    # latter to its real reduce-scatter + allgather sequence, tagged
+    # [sharded] or [full] by mode.
+    sharded: Any = False
     # Two-level dispatch pin (ISSUE 17): a collective submitted with a
     # constant hierarchical= override.  Unlike sharded= it rides the
     # fusion key only (never the negotiation digest), but it still forks
@@ -214,9 +216,11 @@ class FunctionNode:
     uses_elastic_state: bool = False
     is_callback: bool = False
     in_edges: int = 0
-    # Names bound to a sharded optimizer wrapper in this scope: their
+    # Names bound to a sharded optimizer wrapper in this scope, mapped to
+    # the sharding mode (True = ZeRO-1, "full" = ZeRO-3/FSDP): their
     # ``.update()`` calls register synthetic sharded_update sites.
-    sharded_opt_vars: Set[str] = dataclasses.field(default_factory=set)
+    sharded_opt_vars: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
     # Process-set dataflow (ISSUE 16): parameter names (so a
     # ``process_set=<param>`` resolves to kind="param"), names bound to
     # registered sets in this scope, partial-pinned process_set kwargs
@@ -495,18 +499,25 @@ class _Collector(ast.NodeVisitor):
 
     # --------------------------------------------------------- bindings
     @staticmethod
-    def _is_sharded_opt_call(val: ast.Call) -> bool:
-        """A binding value that yields a ZeRO-sharded optimizer: the zero
-        wrapper itself, or DistributedOptimizer with a truthy constant
-        sharded= (non-constant sharded= is HVD110's territory)."""
+    def _is_sharded_opt_call(val: ast.Call) -> Any:
+        """The sharding mode a binding value yields, or False: the zero
+        wrappers themselves (sharded_optimizer → True,
+        full_sharded_optimizer → "full"), or DistributedOptimizer with a
+        constant sharded= whose value is the mode (non-constant sharded=
+        is HVD110's territory)."""
         name = _call_name(val)
         if name == "sharded_optimizer":
             return True
+        if name == "full_sharded_optimizer":
+            return "full"
         if name == "DistributedOptimizer":
             for kw in val.keywords:
                 if kw.arg == "sharded" and isinstance(kw.value,
                                                       ast.Constant):
-                    return bool(kw.value.value)
+                    v = kw.value.value
+                    if v == "full":
+                        return "full"
+                    return bool(v)
         return False
 
     def visit_Assign(self, node: ast.Assign):
@@ -517,7 +528,7 @@ class _Collector(ast.NodeVisitor):
             # Name/None/attribute reassignment must not leave a stale
             # entry registering phantom sharded_update sites).  Same for
             # stale process-set / partial-pin entries.
-            self._cur().sharded_opt_vars.discard(tgt)
+            self._cur().sharded_opt_vars.pop(tgt, None)
             self._cur().ps_bindings.pop(tgt, None)
             self._cur().partial_ps.pop(tgt, None)
             if isinstance(val, ast.Call):
@@ -530,8 +541,9 @@ class _Collector(ast.NodeVisitor):
                         if kw.arg == "process_set":
                             self._cur().partial_ps[tgt] = \
                                 self._resolve_ps(kw.value)
-                if self._is_sharded_opt_call(val):
-                    self._cur().sharded_opt_vars.add(tgt)
+                mode = self._is_sharded_opt_call(val)
+                if mode:
+                    self._cur().sharded_opt_vars[tgt] = mode
                 wrapped = unwrap_wrapped_callable(val)
                 if wrapped is not None:
                     self._cur().bindings[tgt] = ("alias", wrapped)
@@ -648,10 +660,14 @@ class _Collector(ast.NodeVisitor):
                 name=name, line=node.lineno, col=node.col_offset + 1,
                 guard=self._cur_guard(),
                 has_process_set=has_ps,
-                sharded=any(kw.arg == "sharded"
-                            and isinstance(kw.value, ast.Constant)
-                            and bool(kw.value.value)
-                            for kw in node.keywords),
+                sharded=next(
+                    (("full" if kw.value.value == "full"
+                      else bool(kw.value.value))
+                     for kw in node.keywords
+                     if kw.arg == "sharded"
+                     and isinstance(kw.value, ast.Constant)
+                     and kw.value.value),
+                    False),
                 hierarchical=any(kw.arg == "hierarchical"
                                  and isinstance(kw.value, ast.Constant)
                                  and bool(kw.value.value)
@@ -668,11 +684,13 @@ class _Collector(ast.NodeVisitor):
             scopes = [fn.sharded_opt_vars]
             if self.mod.toplevel is not None and fn is not self.mod.toplevel:
                 scopes.append(self.mod.toplevel.sharded_opt_vars)
-            if head is not None and any(head in s for s in scopes):
+            mode = next((s[head] for s in scopes
+                         if head is not None and head in s), False)
+            if mode:
                 fn.collectives.append(CollectiveSite(
                     name="sharded_update", line=node.lineno,
                     col=node.col_offset + 1, guard=self._cur_guard(),
-                    has_process_set=False, sharded=True))
+                    has_process_set=False, sharded=mode))
         ps_kwarg: Optional[ProcessSetValue] = None
         for kw in node.keywords:
             if kw.arg == "process_set":

@@ -82,9 +82,13 @@ _JIT_WRAPPER_NAMES = {"jit", "shard_map", "pmap"}
 # on the wrappers that accept them.  ``hierarchical`` (ISSUE 17) rides the
 # fusion key rather than the digest, but batching groups entries BY fusion
 # key, so a rank-divergent value still forks the batch plan — same rule.
-_SHARD_ARG_NAMES = {"sharded", "num_shards", "shard_count", "hierarchical"}
+# ``prefetch`` (ISSUE 18) is fusion-key-only too AND picks the dispatch
+# lane, so divergence would also reorder the backlog per rank.
+_SHARD_ARG_NAMES = {"sharded", "num_shards", "shard_count", "hierarchical",
+                    "prefetch"}
 _SHARD_ARG_CALLS = {"DistributedOptimizer", "sharded_optimizer",
-                    "init_sharded_state"}
+                    "init_sharded_state", "full_sharded_optimizer",
+                    "init_full_sharded_state"}
 
 
 def _call_name(node: ast.AST) -> Optional[str]:

@@ -53,12 +53,14 @@ def _site_events(col: CollectiveSite) -> List:
     """Schedule events a collective site actually submits (ISSUE 15).
 
     A ``sharded_update`` site (``opt.update(...)`` on a
-    ``DistributedOptimizer(sharded=True)`` / ``sharded_optimizer``
-    binding) schedules the ZeRO pipeline — reduce-scatter then allgather,
-    never an allreduce.  Sharded collectives carry the ``[sharded]``
-    dimension their fusion key / negotiation digest carries: a sharded
-    reduce-scatter and an unsharded one of the same shapes are DIFFERENT
-    programs, so schedules comparing them must diverge.
+    ``DistributedOptimizer(sharded=...)`` / ``sharded_optimizer`` /
+    ``full_sharded_optimizer`` binding) schedules the ZeRO pipeline —
+    reduce-scatter then allgather, never an allreduce.  Sharded
+    collectives carry the ``[sharded]`` / ``[full]`` dimension their
+    fusion key / negotiation digest carries: a sharded reduce-scatter and
+    an unsharded one of the same shapes are DIFFERENT programs — and the
+    FSDP (ISSUE 18) pipeline's legs a third flavour again — so schedules
+    comparing them must diverge.
 
     Every event carries the site's process-set LANE (ISSUE 16): each
     registered set is its own communicator with its own ordered stream, so
@@ -66,11 +68,12 @@ def _site_events(col: CollectiveSite) -> List:
     entries — divergence is judged per set, and HVD111 compares the
     cross-lane interleaving of overlapping sets."""
     lane = col.ps.lane
+    tag = "full" if col.sharded == "full" else "sharded"
     if col.name == "sharded_update":
-        return [("op", "reducescatter[sharded]", lane),
-                ("op", "allgather[sharded]", lane)]
+        return [("op", f"reducescatter[{tag}]", lane),
+                ("op", f"allgather[{tag}]", lane)]
     if col.sharded:
-        return [("op", f"{col.name}[sharded]", lane)]
+        return [("op", f"{col.name}[{tag}]", lane)]
     if col.hierarchical:
         # Two-level dispatch pin (ISSUE 17): hierarchical= rides the
         # fusion key (never the digest), so a pinned two-level allreduce
@@ -811,8 +814,9 @@ def _callback_hvd109(pkg: Package) -> List[Finding]:
                         _suppressed(target.module, col.line, "HVD109"):
                     continue
                 seen.add(key)
-                what = ("sharded optimizer update (schedules "
-                        "reducescatter[sharded] + allgather[sharded])"
+                tag = "full" if col.sharded == "full" else "sharded"
+                what = (f"sharded optimizer update (schedules "
+                        f"reducescatter[{tag}] + allgather[{tag}])"
                         if col.name == "sharded_update" else
                         f"collective {col.name!r}")
                 if col.ps.kind != "world":

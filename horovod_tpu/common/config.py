@@ -85,6 +85,10 @@ class Config:
       two-level payload crossover; 0 = always two-level when armed)
     - ``slice_map``                <- HOROVOD_SLICE_MAP (explicit slice
       membership for CPU/simulated worlds; see parallel/topology.py)
+    - ``sharded_params``           <- HOROVOD_SHARDED_PARAMS (ZeRO-3/FSDP:
+      DistributedOptimizer defaults to sharded="full")
+    - ``prefetch_depth``           <- HOROVOD_PREFETCH_DEPTH (FSDP
+      parameter-gather buckets in flight ahead of consumption)
     - ``autotune``                 <- HOROVOD_AUTOTUNE
     - ``autotune_log``             <- HOROVOD_AUTOTUNE_LOG
     - ``autotune_warmup_samples``  <- HOROVOD_AUTOTUNE_WARMUP_SAMPLES
@@ -272,6 +276,21 @@ class Config:
     # is part of the negotiation digest, so divergence fails fast.
     sharded_optimizer: bool = False
 
+    # Full parameter sharding (ISSUE 18, ZeRO-3/FSDP — docs/performance.md
+    # "Full parameter sharding (FSDP)").  HOROVOD_SHARDED_PARAMS=1 flips
+    # every DistributedOptimizer built without an explicit ``sharded=`` to
+    # ``sharded="full"``: parameters live 1/world per rank, forward-pass
+    # parameters rematerialize through prefetch allgathers on the engine's
+    # PREFETCH lane, gradients reduce-scatter straight into the owning
+    # shard.  Takes precedence over HOROVOD_SHARDED_OPTIMIZER; must be
+    # identical on every rank (part of the negotiation digest as the
+    # "sharded-full" token).  HOROVOD_PREFETCH_DEPTH bounds how many
+    # buckets of gathered parameters may be in flight ahead of
+    # consumption (peak HBM = shard + depth × bucket bytes); a local
+    # knob like HOROVOD_PIPELINE_CHUNK — never negotiated, autotunable.
+    sharded_params: bool = False
+    prefetch_depth: int = 2
+
     # Closed-loop elastic autoscaling (docs/elastic.md "Closed-loop
     # autoscaling") — consumed by the elastic DRIVER (torovodrun
     # --host-discovery-script), not by workers.  HOROVOD_AUTOSCALE=1
@@ -367,6 +386,8 @@ class Config:
             ckpt_lane_budget=_env_int("CKPT_LANE_BUDGET", 2),
             commit_max_age_s=_env_float("COMMIT_MAX_AGE_S", 0.0),
             sharded_optimizer=_env_bool("SHARDED_OPTIMIZER", False),
+            sharded_params=_env_bool("SHARDED_PARAMS", False),
+            prefetch_depth=_env_int("PREFETCH_DEPTH", 2),
             autoscale=_env_bool("AUTOSCALE", False),
             autoscale_interval_s=_env_float("AUTOSCALE_INTERVAL", 5.0),
             autoscale_queue_high=_env_float("AUTOSCALE_QUEUE_HIGH", 16.0),

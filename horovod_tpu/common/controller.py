@@ -980,7 +980,15 @@ class TCPController:
         # ordinary one of the same shapes, so flag divergence across
         # ranks must fail the consistency check, not execute.  Joined
         # ranks read it positionally at parts[8].
-        if getattr(e, "sharded", False):
+        # "sharded-full" (ISSUE 18) is the FSDP plane's token: the full-
+        # parameter-sharded reduce-scatter/allgather programs must never
+        # cross-serve the state-only-sharded (ISSUE 15) ones.  The
+        # prefetch/hierarchical flags deliberately do NOT ride the digest
+        # (fusion-key-only, results bitwise-identical either way).
+        sh = getattr(e, "sharded", False)
+        if sh == "full":
+            parts.append("sharded-full")
+        elif sh:
             parts.append("sharded")
         return "|".join(parts)
 

@@ -127,6 +127,35 @@ class MonitorAgent:
             reg.counter("hvd_pipeline_dispatches_total",
                         "fused batches dispatched").set_total(
                 getattr(engine, "pipeline_dispatches", 0))
+            # FSDP prefetch lane (ISSUE 18): dispatches count allgather
+            # batches routed through the PREFETCH lane; overlapped counts
+            # the ones issued while an earlier bucket was still unsettled
+            # — overlapped/dispatches is the pipelining efficiency the
+            # prefetch-depth knob tunes.
+            reg.counter("hvd_prefetch_dispatches_total",
+                        "prefetch-lane allgather batches dispatched"
+                        ).set_total(
+                getattr(engine, "prefetch_dispatches", 0))
+            reg.counter("hvd_prefetch_overlapped_total",
+                        "prefetch allgathers overlapped with compute"
+                        ).set_total(
+                getattr(engine, "prefetch_overlapped", 0))
+            # Two-level allgather legs mirror the allreduce counters:
+            # intra legs ride ICI, cross legs ride DCN leaders.
+            reg.counter("hvd_hier_ag_dispatches_total",
+                        "two-level allgather batches dispatched").set_total(
+                getattr(engine, "hier_ag_dispatches", 0))
+            reg.counter("hvd_hier_ag_intra_legs_total",
+                        "intra-slice allgather legs run").set_total(
+                getattr(engine, "hier_ag_intra_legs", 0))
+            reg.counter("hvd_hier_ag_cross_legs_total",
+                        "cross-slice allgather legs run").set_total(
+                getattr(engine, "hier_ag_cross_legs", 0))
+            reg.counter("hvd_slice_map_fallbacks_total",
+                        "HOROVOD_SLICE_MAP rejections (non-uniform "
+                        "slices); hierarchical collectives forced flat"
+                        ).set_total(
+                getattr(engine, "slice_map_fallbacks", 0))
             queue = getattr(engine, "queue", None)
             if queue is not None:
                 reg.gauge("hvd_queue_pending",

@@ -402,7 +402,8 @@ def _grouped_async(tensors, name, prefix, ctype, process_set,
 def grouped_allgather_async(tensors: Sequence, name: Optional[str] = None,
                             process_set: Optional[ProcessSet] = None,
                             priorities: Optional[Sequence[int]] = None,
-                            sharded: bool = False) -> List[int]:
+                            sharded=False,
+                            prefetch: bool = False) -> List[int]:
     """Reference: ``hvd.grouped_allgather`` (upstream v0.28).
 
     ``sharded=True`` marks the group as part of a ZeRO-sharded program
@@ -410,18 +411,27 @@ def grouped_allgather_async(tensors: Sequence, name: Optional[str] = None,
     flag rides the fusion key AND the negotiation digest, so a sharded
     program can never cross-serve an unsharded collective of the same
     shapes (and divergence of the flag across ranks fails negotiation
-    fast instead of executing mismatched programs)."""
+    fast instead of executing mismatched programs).  ``sharded="full"``
+    (ISSUE 18) is the FSDP plane's value — same properties, distinct
+    digest token, so full-sharded programs can't cross-serve PR 15 ones.
+
+    ``prefetch=True`` routes the group onto the engine's PREFETCH backlog
+    lane (after FAST, before FUSED, budget-exempt): the FSDP optimizer
+    marks the allgathers that rematerialize the next bucket's parameters
+    so they launch ahead of — without reordering — the gradient stream.
+    Fusion-key-only (not digest); must be rank-invariant (HVD110)."""
     return _grouped_async(tensors, name, "grouped_allgather",
                           CollectiveType.ALLGATHER, process_set,
-                          priorities=priorities, sharded=sharded)
+                          priorities=priorities, sharded=sharded,
+                          prefetch=prefetch)
 
 
 def grouped_allgather(tensors: Sequence, name: Optional[str] = None,
                       process_set: Optional[ProcessSet] = None,
                       priorities: Optional[Sequence[int]] = None,
-                      sharded: bool = False):
+                      sharded=False, prefetch: bool = False):
     handles = grouped_allgather_async(tensors, name, process_set,
-                                      priorities, sharded)
+                                      priorities, sharded, prefetch)
     _engine().kick()
     return [synchronize(h) for h in handles]
 
@@ -431,9 +441,10 @@ def grouped_reducescatter_async(tensors: Sequence,
                                 op: C.ReduceOp = C.ReduceOp.SUM,
                                 process_set: Optional[ProcessSet] = None,
                                 priorities: Optional[Sequence[int]] = None,
-                                sharded: bool = False) -> List[int]:
+                                sharded=False) -> List[int]:
     """Reference: ``hvd.grouped_reducescatter`` (upstream v0.28).  See
-    :func:`grouped_allgather_async` for ``priorities``/``sharded``."""
+    :func:`grouped_allgather_async` for ``priorities``/``sharded``
+    (``sharded="full"`` marks the FSDP gradient reduce-scatter legs)."""
     return _grouped_async(tensors, name, "grouped_reducescatter",
                           CollectiveType.REDUCESCATTER, process_set,
                           reduce_op=op, priorities=priorities,
@@ -444,7 +455,7 @@ def grouped_reducescatter(tensors: Sequence, name: Optional[str] = None,
                           op: C.ReduceOp = C.ReduceOp.SUM,
                           process_set: Optional[ProcessSet] = None,
                           priorities: Optional[Sequence[int]] = None,
-                          sharded: bool = False):
+                          sharded=False):
     handles = grouped_reducescatter_async(tensors, name, op, process_set,
                                           priorities, sharded)
     _engine().kick()

@@ -45,9 +45,10 @@ import heapq
 
 from . import collectives as C
 from .scheduler import (  # noqa: F401  (re-export: public engine surface)
-    CKPT_LANE, CheckpointChunk, FusedProgramCache, InflightRing,
-    PingPongBuffers, StallInspector, TensorQueue, partition_name,
-    partition_plan, pop_checkpoint_items, pop_gradient_batches,
+    CKPT_LANE, FAST_LANE, FUSED_LANE, PREFETCH_LANE, CheckpointChunk,
+    FusedProgramCache, InflightRing, PingPongBuffers, StallInspector,
+    TensorQueue, partition_name, partition_plan, pop_checkpoint_items,
+    pop_gradient_batches,
 )
 from ..common.exceptions import ControlPlaneError
 from ..utils.logging import get_logger
@@ -85,12 +86,15 @@ class TensorTableEntry:
     # negotiation digest (divergence would execute mismatched programs).
     compression: Optional[str] = None
     # ZeRO-sharded data plane (ISSUE 15): True for the reduce-scatter /
-    # allgather legs of a sharded optimizer program.  Part of the fusion
-    # key AND the negotiation digest: a compiled sharded program can never
-    # cross-serve an ordinary collective of the same shapes, and a rank
-    # whose sharded= flag diverges from its peers fails negotiation with
-    # attribution instead of executing a mismatched program.
-    sharded: bool = False
+    # allgather legs of a sharded optimizer program; "full" (ISSUE 18)
+    # for the legs of the full-parameter-sharded (FSDP) plane.  Part of
+    # the fusion key AND the negotiation digest: a compiled sharded
+    # program can never cross-serve an ordinary collective (or a
+    # full-sharded one a state-only-sharded one) of the same shapes, and
+    # a rank whose sharded= flag diverges from its peers fails
+    # negotiation with attribution instead of executing a mismatched
+    # program.
+    sharded: Any = False               # False | True | "full"
     # Two-level data plane (ISSUE 17): per-call override of the engine's
     # HOROVOD_HIERARCHICAL_ALLREDUCE default — True forces the two-level
     # schedule for this entry, False forces flat, None defers to the
@@ -112,6 +116,15 @@ class TensorTableEntry:
     # skipping the fusion-buffer concat/split and the per-cycle program-
     # cache key construction entirely (bitwise-identical results).
     fast_lane: bool = False
+    # FSDP parameter-prefetch lane (ISSUE 18): marked by the full-sharded
+    # optimizer binding on the allgathers that rematerialize the next
+    # bucket's parameters.  Routes the batch onto the PREFETCH backlog
+    # lane (after FAST, before FUSED, budget-exempt) so bucket k+1's
+    # gather overlaps bucket k's compute without perturbing gradient
+    # dispatch order.  Part of the fusion key but NOT the digest, like
+    # hierarchical= — peers need not agree, but the value must be
+    # rank-invariant (HVD110) because batching groups by fusion key.
+    prefetch: bool = False
     # Response-cache slot (stamped by the controller when this entry's
     # announce rides the warm-path bitvector; -1 until learned).  The
     # engine's persistent-program pin key: slot ids are server-assigned
@@ -152,7 +165,7 @@ def _fusion_key(e: TensorTableEntry) -> Tuple:
     """
     return (e.ctype, e.reduce_op, e.root_rank, e.process_set_id,
             e.prescale_factor, e.postscale_factor, e.compression,
-            e.sharded, e.hierarchical,
+            e.sharded, e.hierarchical, e.prefetch,
             e.partition[2] if e.partition is not None else 0)
 
 
@@ -286,6 +299,22 @@ class CollectiveEngine:
         self.hier_dispatches = 0
         self.hier_intra_legs = 0
         self.hier_cross_legs = 0
+        # Two-level allgather legs (ISSUE 18 satellite — the knob was a
+        # no-op until now): one hier-AG dispatch = 1 intra-slice (ICI)
+        # gather leg + 1 cross-slice (DCN) leader-exchange leg.
+        self.hier_ag_dispatches = 0
+        self.hier_ag_intra_legs = 0
+        self.hier_ag_cross_legs = 0
+        # Non-uniform HOROVOD_SLICE_MAP rejections (ISSUE 18 satellite):
+        # counted once per process set (the topology probe is cached), so
+        # mixed-size fleets can see WHY collectives stayed flat.
+        self.slice_map_fallbacks = 0
+        # FSDP parameter-prefetch lane (ISSUE 18): PREFETCH-lane batches
+        # dispatched, and how many of those were dispatched while an
+        # earlier bucket's gather was still in flight (overlap engaged —
+        # the acceptance criterion's evidence).
+        self.prefetch_dispatches = 0
+        self.prefetch_overlapped = 0
         self._handle_counter = itertools.count(1)
         self._handles: Dict[int, TensorTableEntry] = {}
         self._handles_lock = threading.Lock()
@@ -1038,7 +1067,21 @@ class CollectiveEngine:
             # with checkpointing armed (pinned by the dispatch-order
             # tests).
             for batch in responses:
-                lane = 0 if batch[0].fast_lane else 1
+                if batch[0].fast_lane:
+                    lane = FAST_LANE
+                elif batch[0].prefetch:
+                    # FSDP parameter gathers (ISSUE 18): after FAST,
+                    # before FUSED, budget-exempt — bucket k+1's gather
+                    # launches ahead of the gradient stream without
+                    # consuming its in-flight budget or reordering it.
+                    lane = PREFETCH_LANE
+                    self.prefetch_dispatches += 1
+                    for e in batch:
+                        sp = _live_span(e)
+                        if sp is not None:
+                            sp.prefetch = True
+                else:
+                    lane = FUSED_LANE
                 prio = max(e.priority for e in batch)
                 heapq.heappush(self._backlog,
                                (lane, -prio, next(self._backlog_seq), batch))
@@ -1487,7 +1530,14 @@ class CollectiveEngine:
         # flat digests are byte-identical to the pre-sharding protocol):
         # the synthesized entry must carry the flag or its fusion key —
         # and therefore its fused program — would diverge from the peers'.
-        sharded = len(parts) > 8 and parts[8] == "sharded"
+        # "sharded-full" (ISSUE 18) is the FSDP plane's token — a full-
+        # sharded program must never cross-serve a state-only one.
+        sharded: Any = False
+        if len(parts) > 8:
+            if parts[8] == "sharded":
+                sharded = True
+            elif parts[8] == "sharded-full":
+                sharded = "full"
         ps = self._state.process_set_table.get(0)
         sharding = NamedSharding(ps.mesh, P(ps.axis_name))
         local_devs = [d for d in ps.mesh.devices.flat
@@ -1533,8 +1583,17 @@ class CollectiveEngine:
                 local_counts=(topo.local_counts
                               if topo is not None else None))
         except ValueError as exc:
-            log.warning("HOROVOD_SLICE_MAP rejected (%s); "
-                        "hierarchical collectives stay flat", exc)
+            # One-time attributed fallback (ISSUE 18 satellite): the topo
+            # is cached per process set, so mixed-size fleets get exactly
+            # one warning naming the offending slice sizes (the ValueError
+            # text carries them) plus a monitor-scrapable counter — not a
+            # silent flat path.
+            self.slice_map_fallbacks += 1
+            log.warning(
+                "HOROVOD_SLICE_MAP rejected for process set %d (%s); "
+                "hierarchical allreduce/allgather stay FLAT on this fleet "
+                "— fix the slice map to uniform sizes to re-enable "
+                "two-level collectives", ps_id, exc)
             st = None
         self._slice_topos[ps_id] = st
         return st
@@ -1590,6 +1649,24 @@ class CollectiveEngine:
             if hier_bit_orders(st.local_size, st.num_slices) is None:
                 return False
         return True
+
+    def _hier_ag_decision(self, e0: "TensorTableEntry") -> bool:
+        """Per-entry flat-vs-two-level verdict for allgather (ISSUE 18
+        satellite — ``HOROVOD_HIERARCHICAL_ALLGATHER`` was a no-op knob
+        until now).  Same override semantics as ``_hier_decision`` and the
+        same zero-control-plane property: a pure function of the entry's
+        ``hierarchical`` override, the engine knob, and the fleet-static
+        slice topology.  No payload crossover — a two-level gather moves
+        the same total bytes as flat (every rank still receives the full
+        [world, *S] result); the win is that only the leader ring crosses
+        DCN, so the decision is purely topological."""
+        if e0.ctype != CollectiveType.ALLGATHER:
+            return False
+        if e0.hierarchical is False:
+            return False
+        if e0.hierarchical is None and not self.hierarchical_allgather:
+            return False
+        return self._slice_topology(e0.process_set_id) is not None
 
     def _batch_payload_bytes(self, batch) -> int:
         """Per-rank payload bytes of a fused batch (stacked tensors carry
@@ -1698,8 +1775,20 @@ class CollectiveEngine:
         # before the cache key (the DECISION keys the program, never the
         # raw knobs: retuning HOROVOD_HIER_THRESHOLD only recompiles when
         # a batch actually changes schedule, mirroring chunk-plan keying).
-        hier = self._hier_decision(e0, self._batch_payload_bytes(batch))
-        if hier:
+        if e0.ctype == CollectiveType.ALLGATHER:
+            # Two-level allgather verdict (ISSUE 18 satellite): per-entry,
+            # same override semantics as allreduce (e.hierarchical True
+            # forces, False forces flat, None defers to the knob), no
+            # payload threshold — the FSDP prefetch gathers that make
+            # this path hot are full-bucket-sized by construction.
+            hier = self._hier_ag_decision(e0)
+        else:
+            hier = self._hier_decision(e0, self._batch_payload_bytes(batch))
+        if hier and e0.ctype == CollectiveType.ALLGATHER:
+            self.hier_ag_dispatches += 1
+            self.hier_ag_intra_legs += 1  # intra-slice gather (ICI)
+            self.hier_ag_cross_legs += 1  # cross-slice leader exchange (DCN)
+        elif hier:
             self.hier_dispatches += 1
             self.hier_intra_legs += 2     # reduce-scatter + allgather (ICI)
             self.hier_cross_legs += 1     # leader-ring allreduce (DCN)
@@ -1722,9 +1811,7 @@ class CollectiveEngine:
         dtypes = tuple(str(e.tensor.dtype) for e in batch)
         donate = tuple(e.donate for e in batch)
         plan = self._chunk_plan(e0.ctype, shapes, dtypes)
-        key = (_fusion_key(e0), shapes, dtypes, donate,
-               hier, self.hierarchical_allgather,
-               plan)
+        key = (_fusion_key(e0), shapes, dtypes, donate, hier, plan)
         fn, hit = self.cache.get_or_build2(
             key, lambda: self._build_program(e0, shapes, dtypes, mesh, axis,
                                              world, donate, plan,
@@ -1802,7 +1889,11 @@ class CollectiveEngine:
             return self._build_broadcast(proto, shapes, mesh, axis, world,
                                          _jit)
         if ctype == CollectiveType.ALLGATHER:
-            if self.hierarchical_allgather:
+            if hier is None:
+                # Direct callers carry no dispatch-time verdict.
+                hier = self._hier_ag_decision(proto)
+            if hier:
+                # The verdict already proved the slice topology exists.
                 hmesh = self._hier_mesh(proto.process_set_id)
                 if hmesh is not None:
                     return self._build_hier_allgather(

@@ -31,13 +31,20 @@ from ..utils.logging import get_logger
 log = get_logger()
 
 # Dispatch-backlog lanes (the heap orders by ``(lane, -priority, seq)``).
-# 0 = latency fast lane, 1 = fused gradient batches, 2 = the checkpoint
-# stream (ISSUE 14): checkpoint chunks sort strictly AFTER every gradient
-# batch and are popped by their own budget, so durability I/O rides each
-# cycle's tail without ever delaying (or re-ordering) gradient dispatch.
+# 0 = latency fast lane, 1 = parameter-prefetch allgathers (ISSUE 18:
+# FSDP's gather-on-demand legs — the NEXT forward pass blocks on them, so
+# they sort ahead of the gradient drain, which only the step after needs),
+# 2 = fused gradient batches, 3 = the checkpoint stream (ISSUE 14):
+# checkpoint chunks sort strictly AFTER every gradient batch and are
+# popped by their own budget, so durability I/O rides each cycle's tail
+# without ever delaying (or re-ordering) gradient dispatch.  PREFETCH is
+# budget-exempt like FAST: its presence can never change WHICH fused
+# batches a cycle dispatches, nor their relative order (pinned by the
+# prefetch-lane scheduler tests).
 FAST_LANE = 0
-FUSED_LANE = 1
-CKPT_LANE = 2
+PREFETCH_LANE = 1
+FUSED_LANE = 2
+CKPT_LANE = 3
 
 
 class CheckpointChunk:
@@ -68,18 +75,23 @@ class CheckpointChunk:
 
 
 def pop_gradient_batches(heap: List[tuple], budget: int) -> List:
-    """Pop the cycle's dispatchable gradient batches from the backlog
-    heap, in dispatch order: every fast-lane batch, plus up to ``budget``
-    fused batches.  EXACTLY the pre-checkpoint-lane budget rule — a pure
-    function of knob + heap state, never of checkpoint-lane occupancy:
-    checkpoint items are never popped here and never consume the fused
-    budget, so arming checkpointing cannot change gradient dispatch
-    order (the heap sorts ``CKPT_LANE`` after both gradient lanes, so
-    the guard only ever triggers once no gradient work remains)."""
+    """Pop the cycle's dispatchable batches from the backlog heap, in
+    dispatch order: every fast-lane batch, every parameter-prefetch batch
+    (ISSUE 18 — the gathers the NEXT forward pass blocks on), plus up to
+    ``budget`` fused batches.  EXACTLY the pre-checkpoint-lane budget
+    rule — a pure function of knob + heap state, never of checkpoint-lane
+    occupancy: checkpoint items are never popped here and never consume
+    the fused budget, so arming checkpointing cannot change gradient
+    dispatch order (the heap sorts ``CKPT_LANE`` after every dispatch
+    lane, so the guard only ever triggers once no gradient work remains).
+    PREFETCH batches are likewise budget-exempt: arming parameter
+    prefetch inserts gathers AHEAD of the fused drain but never changes
+    which fused batches pop this cycle or their relative order — the
+    invariant the prefetch-lane scheduler tests pin."""
     out: List = []
     while heap and heap[0][0] != CKPT_LANE \
-            and (heap[0][0] == FAST_LANE or budget > 0):
-        if heap[0][0] != FAST_LANE:
+            and (heap[0][0] != FUSED_LANE or budget > 0):
+        if heap[0][0] == FUSED_LANE:
             budget -= 1
         out.append(heapq.heappop(heap)[3])
     return out

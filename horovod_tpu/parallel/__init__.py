@@ -24,9 +24,11 @@ from .topology import (  # noqa: F401
     parse_slice_map, slice_topology,
 )
 from .mesh import (  # noqa: F401
-    process_set_mesh, process_set_sharding, process_set_spec,
+    SpecLayout, fsdp_mesh, process_set_mesh, process_set_sharding,
+    process_set_spec,
 )
 from .zero import (  # noqa: F401
+    full_sharded_optimizer, gather_full_params, init_full_sharded_state,
     init_sharded_state, shard_info, shard_slice_host, sharded_optimizer,
-    state_specs,
+    state_specs, unshard_host,
 )

@@ -138,3 +138,86 @@ def infer_mesh(n_devices: int,
     # reference any of them unconditionally.
     axes = {DP: n_devices // denom, PP: pp, EP: ep, SP: sp, TP: tp}
     return make_mesh(axes, devices)
+
+
+# ---------------------------------------------------------------------------
+# FSDP axis layout (ISSUE 18) — canonical PartitionSpecs per parameter
+# family for data/fsdp/tp meshes, following the SpecLayout exemplar in
+# SNIPPETS [2]: one frozen value object names the mesh axes once, and every
+# spec the training step needs derives from it, so renaming an axis (or
+# collapsing fsdp into dp on a pure-FSDP fleet) is a one-line change.
+# ---------------------------------------------------------------------------
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecLayout:
+    """Canonical partition specs for a (data, fsdp, tp) mesh.
+
+    ``data_axis`` batches, ``fsdp_axis`` shards parameters ZeRO-3-style
+    (``parallel/zero.py``'s pad+slice convention rides it), ``tp_axis``
+    shards matmuls Megatron-style.  A pure-FSDP world sets
+    ``data_axis == fsdp_axis`` — the specs still compose because every
+    method references axes by field, never by literal."""
+    data_axis: str = DP
+    fsdp_axis: str = "fsdp"
+    tp_axis: str = TP
+
+    # ---- activations -----------------------------------------------------
+    def batch(self) -> PartitionSpec:
+        """Per-example activations: batch dim over data (and fsdp, when
+        distinct — DP×FSDP worlds split the global batch over both)."""
+        if self.fsdp_axis != self.data_axis:
+            return PartitionSpec((self.data_axis, self.fsdp_axis))
+        return PartitionSpec(self.data_axis)
+
+    # ---- parameter families (full, unsharded layouts for tp) -------------
+    def embedding(self) -> PartitionSpec:
+        """[vocab, d_model]: vocab over fsdp, features over tp."""
+        return PartitionSpec(self.fsdp_axis, self.tp_axis)
+
+    def qkv(self) -> PartitionSpec:
+        """[d_model, heads*d_head]: contraction over fsdp, heads over tp."""
+        return PartitionSpec(self.fsdp_axis, self.tp_axis)
+
+    def attn_out(self) -> PartitionSpec:
+        """[heads*d_head, d_model]: heads over tp, output over fsdp."""
+        return PartitionSpec(self.tp_axis, self.fsdp_axis)
+
+    def mlp_up(self) -> PartitionSpec:
+        return PartitionSpec(self.fsdp_axis, self.tp_axis)
+
+    def mlp_down(self) -> PartitionSpec:
+        return PartitionSpec(self.tp_axis, self.fsdp_axis)
+
+    def norm(self) -> PartitionSpec:
+        """[d_model] scale/bias: replicated (too small to shard)."""
+        return PartitionSpec()
+
+    # ---- ZeRO-3 flat shards ---------------------------------------------
+    def flat_shard(self) -> PartitionSpec:
+        """A ``zero.py`` pad+slice flat leaf ([world*per]) — dim 0 over
+        the fsdp axis; the spec of ``_FullZeroState`` array leaves."""
+        return PartitionSpec(self.fsdp_axis)
+
+    def replicated(self) -> PartitionSpec:
+        return PartitionSpec()
+
+
+def fsdp_mesh(n_devices: Optional[int] = None, tp: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None,
+              layout: SpecLayout = SpecLayout(data_axis="fsdp")
+              ) -> Tuple[Mesh, SpecLayout]:
+    """``(mesh, layout)`` for FSDP(×TP) training: the fsdp axis fills
+    what tp leaves over.  The default layout collapses data into fsdp
+    (pure ZeRO-3 — every device both batches and shards); pass a layout
+    with distinct axes for a 2-D DP×FSDP world built via
+    :func:`make_mesh` directly."""
+    devs = ordered_devices(devices)
+    if n_devices is None:
+        n_devices = len(devs)
+    if n_devices % tp:
+        raise ValueError(f"{n_devices} devices not divisible by tp={tp}")
+    axes = {layout.fsdp_axis: n_devices // tp, layout.tp_axis: tp}
+    return make_mesh(axes, devs[:n_devices]), layout

@@ -223,3 +223,116 @@ def sharded_optimizer(inner: optax.GradientTransformation,
         return updates, _ZeroState(inner_state, ())
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+# --------------------------------------------------------------------- FSDP
+# Full parameter sharding (ISSUE 18, ZeRO stage 3): the resident truth is
+# the 1/world PARAMETER shard, not just optimizer state.  Forward/backward
+# materialize full parameters with gather_full_params (an allgather the
+# eager pipeline prefetch-overlaps); backward's gradients reduce-scatter
+# straight into the owning shard; the inner optax update runs shard-local.
+# Wire per step: AG(params) + RS(grads) = the same 2·B·(world-1)/world ring
+# bytes as the stage-1 sharded path's RS + delta-AG — model memory drops
+# to shard + the bounded prefetch window at unchanged wire cost.
+
+class _FullZeroState(NamedTuple):
+    inner_state: Any        # inner optax state over the [per] shards
+    param_shards: Any       # tree of flat [per] leaves — the RESIDENT params
+
+
+def full_sharded_optimizer(inner: optax.GradientTransformation,
+                           axis_name: str = "dp",
+                           average: bool = True
+                           ) -> optax.GradientTransformation:
+    """ZeRO-3 wrapper: parameters live ONLY as the state's 1/world shards.
+
+    ``init(params)`` slices the full (replicated) parameters into this
+    rank's shards; ``update(grads, state)`` reduce-scatters the gradients,
+    advances the resident shards through the inner optimizer, and returns
+    the allgathered full *updates* so plain ``optax.apply_updates``
+    callers still work — a caller that instead keeps only the shard state
+    and rematerializes via :func:`gather_full_params` lets XLA dead-code-
+    eliminate that delta-allgather, so either usage costs the same
+    RS + one-AG wire per step.  The ``params`` argument of ``update`` is
+    ignored: the resident shards are the authoritative parameters (a
+    replicated copy need never exist).
+
+    Same per-shard semantics caveat as :func:`sharded_optimizer`:
+    elementwise inner transforms are exact; whole-tree aggregations
+    (global-norm clipping) act per shard."""
+
+    def init_fn(params):
+        shards = jax.tree_util.tree_map(
+            lambda p: _slice_leaf(p, axis_name), params)
+        return _FullZeroState(inner.init(shards), shards)
+
+    def update_fn(grads, state: _FullZeroState, params=None):
+        del params                       # resident shards are the truth
+        n = compat_axis_size(axis_name)
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        shapes = [g.shape for g in leaves]
+        shard_pairs = [_shard_leaf(g, axis_name) for g in leaves]
+        g_shards = [s for s, _ in shard_pairs]
+        pads = [p for _, p in shard_pairs]
+        if average:
+            g_shards = [g / jnp.asarray(n, g.dtype)
+                        if jnp.issubdtype(g.dtype, jnp.floating) else g // n
+                        for g in g_shards]
+        g_shards = jax.tree_util.tree_unflatten(treedef, g_shards)
+        u_shards, inner_state = inner.update(
+            g_shards, state.inner_state, state.param_shards)
+        new_shards = optax.apply_updates(state.param_shards, u_shards)
+        u_leaves = jax.tree_util.tree_flatten(u_shards)[0]
+        updates = jax.tree_util.tree_unflatten(
+            treedef, [_unshard_leaf(u, pad, shape, axis_name)
+                      for u, pad, shape in zip(u_leaves, pads, shapes)])
+        return updates, _FullZeroState(inner_state, new_shards)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def gather_full_params(state: _FullZeroState, template,
+                       axis_name: str = "dp"):
+    """Rematerialize the full parameter tree from the resident shards —
+    the in-graph FSDP prefetch allgather.  ``template`` supplies each
+    leaf's full shape/dtype (the original params tree or its
+    ``ShapeDtypeStruct``s); pad widths re-derive from ``shard_info``, so
+    no metadata travels in the state."""
+    n = compat_axis_size(axis_name)
+
+    def gather(t, shard):
+        shape = tuple(t.shape)
+        size = 1
+        for d in shape:
+            size *= int(d)
+        pad, _per = shard_info(size, n)
+        return _unshard_leaf(shard, pad, shape, axis_name)
+
+    return jax.tree_util.tree_map(gather, template, state.param_shards)
+
+
+def init_full_sharded_state(inner: optax.GradientTransformation, params,
+                            mesh, axis_name: str = "dp"):
+    """Initialize a full-sharded (ZeRO-3) state ON the mesh: returns
+    ``(state, state_specs)`` where every array leaf — inner optimizer
+    state AND the resident ``param_shards`` — is the global
+    ``[world * per]`` array sharded ``P(axis_name)``.  The two-pass
+    structure mirrors :func:`init_sharded_state`."""
+    from ..compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    world = mesh.shape[axis_name]
+    opt = full_sharded_optimizer(inner, axis_name=axis_name)
+
+    def shard_struct(p):
+        _pad, per = shard_info(int(p.size), world)
+        return jax.ShapeDtypeStruct((per,), p.dtype)
+
+    shard_shapes = jax.tree_util.tree_map(shard_struct, params)
+    abstract = jax.eval_shape(
+        lambda ps: _FullZeroState(inner.init(ps), ps), shard_shapes)
+    specs = state_specs(abstract, axis_name)
+
+    init = shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                     out_specs=specs, check_vma=False)
+    return jax.jit(init)(params), specs

@@ -160,7 +160,25 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "optimizer state, allgather of updates.  "
                         "Forwarded as HOROVOD_SHARDED_OPTIMIZER so every "
                         "rank takes the identical data plane")
+    p.add_argument("--sharded-params", action="store_true",
+                   help="Full parameter sharding (ZeRO-3/FSDP, docs/"
+                        "performance.md 'Full parameter sharding "
+                        "(FSDP)'): DistributedOptimizer defaults to "
+                        'sharded="full" — parameters live 1/N per rank, '
+                        "prefetch allgathers rematerialize them ahead of "
+                        "use, gradients reduce-scatter into the owning "
+                        "shard.  Forwarded as HOROVOD_SHARDED_PARAMS")
+    p.add_argument("--prefetch-depth", type=int, default=None,
+                   help="FSDP parameter-gather buckets in flight ahead "
+                        "of consumption (HOROVOD_PREFETCH_DEPTH; "
+                        "default 2)")
     p.add_argument("--hierarchical-allreduce", action="store_true")
+    p.add_argument("--hierarchical-allgather", action="store_true",
+                   help="Two-level allgather on the slice topology "
+                        "(intra-ICI gather after a cross-DCN leader "
+                        "exchange) — the gather legs FSDP makes hot; "
+                        "bitwise-identical to flat "
+                        "(HOROVOD_HIERARCHICAL_ALLGATHER)")
     p.add_argument("--hierarchical-controller", action="store_true",
                    help="Two-level control plane (docs/performance.md "
                         "'Control plane at scale'): a per-host agent "
@@ -397,8 +415,14 @@ def tuning_env(args) -> Dict[str, str]:
             env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
     if getattr(args, "sharded", False):
         env["HOROVOD_SHARDED_OPTIMIZER"] = "1"
+    if getattr(args, "sharded_params", False):
+        env["HOROVOD_SHARDED_PARAMS"] = "1"
+    if getattr(args, "prefetch_depth", None) is not None:
+        env["HOROVOD_PREFETCH_DEPTH"] = str(int(args.prefetch_depth))
     if getattr(args, "hierarchical_allreduce", False):
         env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    if getattr(args, "hierarchical_allgather", False):
+        env["HOROVOD_HIERARCHICAL_ALLGATHER"] = "1"
     if getattr(args, "hierarchical_controller", False):
         env["HOROVOD_HIERARCHICAL_CONTROLLER"] = "1"
     return env

@@ -103,7 +103,7 @@ class TensorSpan:
 
     __slots__ = ("name", "cycle", "slot", "t_enqueue", "t_drain", "t_ready",
                  "t_launch", "t_result", "t_done", "error", "committed",
-                 "cross_frac")
+                 "cross_frac", "prefetch")
 
     def __init__(self):
         self.reset("", 0.0, 0.0)
@@ -123,6 +123,10 @@ class TensorSpan:
         self.committed = False
         # Modeled DCN share of the reduce phase; 0.0 = flat dispatch.
         self.cross_frac = 0.0
+        # FSDP parameter-prefetch gather (ISSUE 18): stamped at backlog
+        # push for PREFETCH-lane batches; its reduce time feeds the
+        # "prefetch" leg of the phase breakdown (prefetch-depth tuning).
+        self.prefetch = False
 
     def phase_name(self) -> str:
         """The phase this span is currently in (stall attribution)."""
@@ -219,6 +223,12 @@ class TraceRecorder:
         self._leg_buckets = {p: [0] * (len(self.buckets) + 1)
                              for p in REDUCE_LEGS}
         self.leg_spans = 0
+        # FSDP prefetch leg (ISSUE 18): reduce-phase time of PREFETCH-lane
+        # gathers, keyed "prefetch" in phase_histograms once any commits —
+        # the phase-breakdown signal HOROVOD_PREFETCH_DEPTH tunes against.
+        self._prefetch_sum = 0.0
+        self._prefetch_buckets = [0] * (len(self.buckets) + 1)
+        self.prefetch_spans = 0
         self.lifecycle_us_total = 0.0
         # Recent cycles, newest last; _cycle_by_id lets late span commits
         # find their cycle's aggregate.
@@ -297,6 +307,17 @@ class TraceRecorder:
                             break
                     else:
                         counts[-1] += 1
+            if span.prefetch:
+                self.prefetch_spans += 1
+                v = phases["reduce"]
+                self._prefetch_sum += v
+                counts = self._prefetch_buckets
+                for i, le in enumerate(self.buckets):
+                    if v <= le:
+                        counts[i] += 1
+                        break
+                else:
+                    counts[-1] += 1
             rec = self._cycle_by_id.get(span.cycle)
             if rec is not None:
                 rec.n_committed += 1
@@ -348,6 +369,10 @@ class TraceRecorder:
                 for p in REDUCE_LEGS:
                     out[p] = (list(self._leg_buckets[p]), self._leg_sum[p],
                               sum(self._leg_buckets[p]))
+            if self.prefetch_spans:
+                out["prefetch"] = (list(self._prefetch_buckets),
+                                   self._prefetch_sum,
+                                   sum(self._prefetch_buckets))
             return out
 
     def phase_summary(self) -> dict:

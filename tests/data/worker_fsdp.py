@@ -1,0 +1,181 @@
+"""Full-parameter-sharding worker (ISSUE 18 acceptance): the complete
+``DistributedOptimizer(sharded="full")`` ZeRO-3/FSDP pipeline across REAL
+processes — per-step rematerialization of full parameters through the
+PREFETCH-lane allgather pipeline, per-bucket reduce-scatter of gradients
+straight into this rank's 1/N shard, shard-local inner update on the
+RESIDENT parameter shards.
+
+Proves, end to end through negotiate → fuse → execute:
+
+- parameters after 10 steps on the same gradient stream are BITWISE
+  identical to the replicated ``sharded=False`` path (2 ranks: one
+  floating add per element, so reduction order cannot drift);
+- resident bytes (parameter shards + optimizer state) scale ~1/world
+  against the replicated params + full optimizer tree;
+- with the chunked pipeline armed (>1 bucket) the prefetch lane engages:
+  ``prefetch_dispatches`` counts PREFETCH-lane batches and
+  ``prefetch_overlapped`` proves bucket k+1's gather was dispatched
+  before bucket k settled — the overlap acceptance criterion;
+- pad+slice edges ride along: a non-divisible leaf, a scalar leaf and a
+  bf16 leaf are all in the tree;
+- the steady-state warm path — gather_params + update every step, with
+  prefetch armed — still rides the pinned ~13B bitvector frame (no
+  per-tensor re-announces, request bytes flat);
+- the shard-native elastic form round-trips: ``hvd_sharded_saveable`` →
+  ``load_sharded_saveable`` restores bitwise-identical parameter shards
+  (the resident shard IS the checkpoint shard).
+
+Launched by test_multiprocess.py::test_torovodrun_full_sharding with
+``torovodrun -np 2`` — flat AND --hierarchical-controller.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+from horovod_tpu.jax.optimizer import load_sharded_saveable
+
+STEPS = 10
+
+
+def make_params():
+    """Mixed tree: non-divisible (257 % 2 != 0), scalar, bf16 — the
+    pad+slice edge cases ride the acceptance run itself."""
+    return {
+        "w1": jnp.asarray(np.linspace(-1.0, 1.0, 257), jnp.float32),
+        "w2": jnp.asarray(np.linspace(0.5, -0.5, 128).reshape(16, 8),
+                          jnp.float32),
+        "scalar": jnp.asarray(0.25, jnp.float32),
+        "half": jnp.asarray(np.linspace(-2.0, 2.0, 66), jnp.bfloat16),
+    }
+
+
+def grad_stream(step, rank):
+    """Deterministic per-rank gradient stream — both paths replay it."""
+    rng = np.random.RandomState(1000 * (rank + 1) + step)
+    return {
+        "w1": jnp.asarray(rng.randn(257), jnp.float32),
+        "w2": jnp.asarray(rng.randn(16, 8), jnp.float32),
+        "scalar": jnp.asarray(rng.randn(), jnp.float32),
+        "half": jnp.asarray(rng.randn(66), jnp.bfloat16),
+    }
+
+
+def train_replicated(inner, rank, steps=STEPS):
+    opt = hvd.DistributedOptimizer(inner, sharded=False)
+    params = make_params()
+    state = opt.init(params)
+    for s in range(steps):
+        updates, state = opt.update(grad_stream(s, rank), state, params)
+        params = optax.apply_updates(params, updates)
+    return jax.device_get(params), state
+
+
+def train_full(inner, rank, steps=STEPS):
+    """The FSDP loop: forward rematerializes full params through the
+    prefetch pipeline, backward reduce-scatters into the shard — no
+    replicated parameter or gradient tree survives a step."""
+    opt = hvd.DistributedOptimizer(inner, sharded="full")
+    state = opt.init(make_params())
+    for s in range(steps):
+        full = state.gather_params()     # forward half (prefetch lane)
+        assert set(full) == {"w1", "w2", "scalar", "half"}
+        del full                         # gathered buffers die with the step
+        _, state = opt.update(grad_stream(s, rank), state)
+    return state
+
+
+def tree_bytes(tree):
+    return sum(int(l.nbytes) for l in jax.tree_util.tree_leaves(tree)
+               if hasattr(l, "nbytes"))
+
+
+def main():
+    hvd.init()
+    rank, world = hvd.rank(), hvd.size()
+    eng = basics._get_state().engine
+    ctl = eng.controller
+    assert ctl is not None, "worker needs the torovodrun controller"
+    st = ctl.cache_stats
+
+    inner = optax.adam(1e-2)
+
+    # ---- replicated baseline --------------------------------------------
+    p_rep, s_rep = train_replicated(inner, rank)
+    rep_resident = tree_bytes(p_rep) + tree_bytes(s_rep.inner_state)
+
+    # ---- FSDP, chunked so >1 bucket: parity + 1/N + prefetch overlap ----
+    eng.pipeline_chunk_bytes = 512        # w1 alone exceeds one bucket
+    pf0, ov0 = eng.prefetch_dispatches, eng.prefetch_overlapped
+    state = train_full(inner, rank)
+    assert len(state.plan.buckets) > 1, state.plan.buckets
+    p_full = state.gather_params()
+    for k in sorted(p_rep):
+        np.testing.assert_array_equal(p_rep[k], p_full[k])   # BITWISE
+    assert eng.prefetch_dispatches > pf0, \
+        "no allgather rode the PREFETCH lane"
+    assert eng.prefetch_overlapped > ov0, \
+        "bucket k+1's gather never overlapped bucket k (prefetch depth?)"
+
+    # ---- resident bytes ≈ 1/N (params + opt state) ----------------------
+    resident = state.resident_bytes()
+    n_leaves = len(p_rep)
+    # padding ≤ world-1 elems/leaf for params and each adam moment;
+    # replicated step counters add a constant per leaf.
+    slack = 3 * n_leaves * world * 8 + 64 * n_leaves
+    assert resident <= rep_resident / world + slack, \
+        (resident, rep_resident)
+
+    # ---- shard-native elastic form: save → load → bitwise shards --------
+    saved = state.hvd_sharded_saveable()
+    assert saved.get("__hvd_full_sharded__") == 1
+    revived = load_sharded_saveable(saved, rank, world)
+    for b, shards in enumerate(state.param_shards):
+        for j, s in enumerate(shards):
+            np.testing.assert_array_equal(
+                np.asarray(jax.device_get(s)),
+                np.asarray(jax.device_get(revived.param_shards[b][j])))
+    eng.pipeline_chunk_bytes = 0
+
+    # ---- steady-state warm path with prefetch armed: frames stay 13B ---
+    opt = hvd.DistributedOptimizer(inner, sharded="full")
+    wstate = opt.init(make_params())
+    for s in range(3):                    # warm-up: learn slots
+        wstate.gather_params()
+        _, wstate = opt.update(grad_stream(s, rank), wstate)
+    full_before = st.full_announces
+    bytes_before = ctl.bytes_sent
+    rounds_before = ctl.rounds
+    for s in range(5):
+        wstate.gather_params()
+        _, wstate = opt.update(grad_stream(10 + s, rank), wstate)
+    assert st.full_announces == full_before, (
+        f"FSDP steady state sent per-tensor metadata: "
+        f"{st.full_announces - full_before} full announces")
+    per_round = (ctl.bytes_sent - bytes_before) \
+        / max(1, ctl.rounds - rounds_before)
+    assert per_round <= 32, (
+        f"FSDP warm-path request grew to {per_round}B/round")
+
+    hvd.barrier()
+    print(f"FSDP_OK rank={rank} resident={resident} "
+          f"rep_resident={rep_resident} "
+          f"prefetch={eng.prefetch_dispatches} "
+          f"overlapped={eng.prefetch_overlapped} "
+          f"per_round={per_round:.1f}", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

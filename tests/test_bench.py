@@ -129,6 +129,22 @@ def test_bench_minimal_mode():
         sab["wire_bytes_per_step_allreduce"], sab
     assert sab["params_match"] is True, sab
     assert sab["step_ms_sharded"] > 0 and sab["step_ms_replicated"] > 0, sab
+    # FSDP A/B (ISSUE 18) on every line: full parameter sharding keeps
+    # resident params + opt state ≈ 1/N of the replicated total
+    # (asserted by the section), its modeled wire bytes equal the ZeRO-1
+    # pipeline's (full sharding is a memory win at equal wire), and the
+    # gathered parameters match the replicated run.
+    fab = out["fsdp_ab"]
+    assert fab["world"] == 8, fab
+    assert fab["one_over_n"] is True, fab
+    assert fab["resident_bytes_full"] < \
+        fab["resident_bytes_replicated"] / 4, fab
+    assert fab["resident_bytes_full"] < fab["resident_bytes_sharded"], fab
+    assert fab["wire_full_eq_sharded"] is True, fab
+    assert fab["wire_bytes_per_step_full"] < \
+        fab["wire_bytes_per_step_allreduce"], fab
+    assert fab["params_match"] is True, fab
+    assert fab["step_ms_full"] > 0 and fab["step_ms_replicated"] > 0, fab
     # Two-level allreduce A/B (ISSUE 17) on every line: flat-vs-hier
     # bitwise identity on integer payloads, the leg counters proving the
     # two-level path ran, the modeled cross-slice (DCN) wire bytes ≤

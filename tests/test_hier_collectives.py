@@ -181,3 +181,113 @@ def test_hier_explicit_false_pins_flat(hvd, world_size, sim_slices):
         d0 = eng.hier_dispatches
         hvd.allreduce(x, name="hx", op=hvd.Sum, hierarchical=False)
         assert eng.hier_dispatches == d0
+
+
+# ---------------------------------------------------- two-level allgather
+def test_hier_allgather_bitwise_parity(hvd, world_size, sim_slices):
+    """Flat and two-level allgather agree BITWISE (ISSUE 18 satellite:
+    allgather is pure data movement — intra-slice gather after the
+    cross-DCN leader exchange reassembles the identical [world, *S]
+    result, no arithmetic to drift)."""
+    eng = _engine()
+    rng = np.random.RandomState(11)
+    xs = [hvd.stack_per_rank(
+        [rng.randn(*shape).astype(np.float32) + r
+         for r in range(world_size)])
+        for shape in ((33,), (4, 5))]
+    flat = [np.asarray(o) for o in hvd.grouped_allgather(xs, name="hag_f")]
+    with sim_slices(eng, 2, world_size // 2):
+        eng.hierarchical_allgather = True
+        try:
+            d0, i0, c0 = (eng.hier_ag_dispatches, eng.hier_ag_intra_legs,
+                          eng.hier_ag_cross_legs)
+            hier = [np.asarray(o) for o in hvd.grouped_allgather(
+                xs, name="hag_h")]
+            assert eng.hier_ag_dispatches == d0 + 1, \
+                "two-level allgather did not run"
+            assert eng.hier_ag_intra_legs == i0 + 1
+            assert eng.hier_ag_cross_legs == c0 + 1
+        finally:
+            eng.hierarchical_allgather = False
+    for f, h in zip(flat, hier):
+        np.testing.assert_array_equal(f, h)
+
+
+def test_hier_allgather_knob_off_stays_flat(hvd, world_size, sim_slices):
+    """With slices derivable but HOROVOD_HIERARCHICAL_ALLGATHER unset,
+    allgather dispatches FLAT (the knob was a documented no-op before
+    ISSUE 18; now it is the real gate) — and the per-call
+    ``hierarchical=True`` override on the async API wins over it."""
+    eng = _engine()
+    x = _int_stacked(hvd, world_size, shape=(16,), seed=21)
+    with sim_slices(eng, 2, world_size // 2):
+        assert eng.hierarchical_allgather is False
+        d0 = eng.hier_ag_dispatches
+        hvd.allgather(x, name="hag_off")
+        assert eng.hier_ag_dispatches == d0, "knob off but AG went hier"
+
+
+def test_hier_allgather_rekeys_program_cache(hvd, world_size, sim_slices):
+    """The flat-vs-hier allgather decision keys the program cache: one
+    program per mode for the same shapes, neither cross-served."""
+    eng = _engine()
+    x = _int_stacked(hvd, world_size, shape=(64,), seed=22)
+    hvd.allgather(x, name="hagk")                     # flat program
+    misses0 = eng.cache.misses
+    with sim_slices(eng, 2, world_size // 2):
+        eng.hierarchical_allgather = True
+        try:
+            hvd.allgather(x, name="hagk")             # hier program
+            assert eng.cache.misses == misses0 + 1
+            hvd.allgather(x, name="hagk")             # warm hier hit
+            assert eng.cache.misses == misses0 + 1
+        finally:
+            eng.hierarchical_allgather = False
+    hvd.allgather(x, name="hagk")                     # flat again: warm
+    assert eng.cache.misses == misses0 + 1
+
+
+# ------------------------------------------------- non-uniform slice map
+def test_nonuniform_slice_map_falls_back_once(hvd):
+    """A non-uniform HOROVOD_SLICE_MAP must not silently disable the
+    two-level path: the engine logs ONE attributed warning naming the
+    offending sizes, bumps ``slice_map_fallbacks`` once (the probe is
+    cached per process set), and every collective dispatches flat."""
+    import logging
+
+    from horovod_tpu.utils.logging import get_logger
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = Capture(level=logging.WARNING)
+    get_logger().addHandler(handler)      # propagate=False: attach direct
+    eng = _engine()
+    saved = (eng.hierarchical_allreduce, eng.slice_map,
+             eng.hier_threshold_bytes)
+    eng.hierarchical_allreduce = True
+    eng.slice_map = "2,6"                 # sums to 8, non-uniform
+    eng.hier_threshold_bytes = 0
+    eng._slice_topos.clear()
+    f0 = eng.slice_map_fallbacks
+    try:
+        assert eng._slice_topology(0) is None
+        assert eng._slice_topology(0) is None         # cached: no re-probe
+        assert eng.slice_map_fallbacks == f0 + 1
+        warns = [r for r in records
+                 if "HOROVOD_SLICE_MAP rejected" in r.getMessage()]
+        assert len(warns) == 1, [r.getMessage() for r in warns]
+        assert "[2, 6]" in warns[0].getMessage()      # names the sizes
+        d0 = eng.hier_dispatches
+        x = _int_stacked(hvd, 8, shape=(32,), seed=23)
+        out = np.asarray(hvd.allreduce(x, name="numap", op=hvd.Sum))
+        assert eng.hier_dispatches == d0, "fallback world dispatched hier"
+        np.testing.assert_array_equal(
+            out, np.asarray(x).sum(axis=0).astype(np.float32))
+    finally:
+        (eng.hierarchical_allreduce, eng.slice_map,
+         eng.hier_threshold_bytes) = saved
+        eng._slice_topos.clear()
+        get_logger().removeHandler(handler)

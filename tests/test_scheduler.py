@@ -436,3 +436,82 @@ def test_pingpong_abort_settles_both_buffers_exactly_once():
     assert pp.aborted
     # Post-abort acquires never block (the engine is going down).
     assert pp.acquire("k") is not None
+
+
+# ------------------------------------------------------- prefetch lane
+def _lane_heap(entries):
+    """Build a backlog heap from (lane, priority, payload) triples with
+    arrival-order sequence numbers — the engine's exact tuple shape."""
+    import heapq
+    heap = []
+    for seq, (lane, prio, payload) in enumerate(entries):
+        heapq.heappush(heap, (lane, -prio, seq, payload))
+    return heap
+
+
+def test_prefetch_pops_after_fast_before_fused():
+    from horovod_tpu.ops.scheduler import (
+        FAST_LANE, FUSED_LANE, PREFETCH_LANE, pop_gradient_batches,
+    )
+    heap = _lane_heap([(FUSED_LANE, 5, "fuseHot"), (PREFETCH_LANE, 0, "pf0"),
+                       (FAST_LANE, 0, "fast"), (PREFETCH_LANE, 3, "pfHot")])
+    # Fast lane leads (latency floor), then every prefetch gather (the
+    # NEXT forward pass blocks on them), then the fused drain.
+    assert pop_gradient_batches(heap, 10) == \
+        ["fast", "pfHot", "pf0", "fuseHot"]
+
+
+def test_prefetch_is_budget_exempt():
+    """Arming prefetch must never eat the fused dispatch budget: with a
+    budget of 1, every prefetch batch pops AND the one fused slot still
+    goes to the hottest fused batch."""
+    from horovod_tpu.ops.scheduler import (
+        FUSED_LANE, PREFETCH_LANE, pop_gradient_batches,
+    )
+    heap = _lane_heap([(PREFETCH_LANE, 0, "pf.b0"), (FUSED_LANE, 7, "hot"),
+                       (PREFETCH_LANE, 0, "pf.b1"), (FUSED_LANE, 0, "cold")])
+    assert pop_gradient_batches(heap, 1) == ["pf.b0", "pf.b1", "hot"]
+    assert [x[3] for x in heap] == ["cold"]
+
+
+def test_prefetch_never_perturbs_fused_dispatch_order():
+    """THE prefetch-lane guarantee (ISSUE 18), mirroring the checkpoint
+    lane's: for every budget, the fused/fast pop sequence with prefetch
+    batches interleaved in the heap is identical to the sequence without
+    them — parameter gathers jump ahead but never reorder or starve the
+    gradient drain."""
+    from horovod_tpu.ops.scheduler import (
+        FAST_LANE, FUSED_LANE, PREFETCH_LANE, pop_gradient_batches,
+    )
+    grads = [(FUSED_LANE, 0, "fuseA"), (FAST_LANE, 0, "fast1"),
+             (FUSED_LANE, 5, "fuseHot"), (FAST_LANE, 2, "fast2"),
+             (FUSED_LANE, 0, "fuseB")]
+    prefetch = [(PREFETCH_LANE, 4, "pf.b1"), (PREFETCH_LANE, 9, "pf.b0")]
+    for budget in (1, 2, 3, 10):
+        h_plain = _lane_heap(grads)
+        # Interleave prefetch entries mid-stream (arrival order differs
+        # from priority order to exercise the in-lane sort too).
+        h_pf = _lane_heap(grads[:2] + prefetch + grads[2:])
+        got_plain = pop_gradient_batches(h_plain, budget)
+        got_pf = pop_gradient_batches(h_pf, budget)
+        assert [x for x in got_pf if not x.startswith("pf.")] == got_plain, \
+            (budget, got_pf, got_plain)
+        # Every prefetch batch popped (budget-exempt), highest first.
+        assert [x for x in got_pf if x.startswith("pf.")] == \
+            ["pf.b0", "pf.b1"], (budget, got_pf)
+        # Identical leftovers: the fused backlog is byte-for-byte what it
+        # would have been with prefetch disarmed.
+        assert [x[3] for x in h_pf] == [x[3] for x in h_plain], budget
+
+
+def test_prefetch_outranks_checkpoint_lane():
+    from horovod_tpu.ops.scheduler import (
+        CKPT_LANE, PREFETCH_LANE, pop_checkpoint_items,
+        pop_gradient_batches,
+    )
+    heap = _lane_heap([(CKPT_LANE, 0, "ck"), (PREFETCH_LANE, 0, "pf")])
+    # A pending prefetch gather blocks the checkpoint drain...
+    assert pop_checkpoint_items(heap, 10) == []
+    # ...and pops on the gradient side; only then does the chunk go.
+    assert pop_gradient_batches(heap, 0) == ["pf"]
+    assert pop_checkpoint_items(heap, 10) == ["ck"]

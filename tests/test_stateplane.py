@@ -22,7 +22,8 @@ import pytest
 
 from horovod_tpu.elastic import stateplane as spl
 from horovod_tpu.ops.scheduler import (
-    CKPT_LANE, CheckpointChunk, pop_checkpoint_items, pop_gradient_batches,
+    CKPT_LANE, FAST_LANE, FUSED_LANE, CheckpointChunk, pop_checkpoint_items,
+    pop_gradient_batches,
 )
 from horovod_tpu.testing import faults
 
@@ -323,8 +324,9 @@ def test_gradient_pops_unchanged_by_checkpoint_items():
     pop sequence with checkpoint items in the heap is identical to the
     sequence without them, and checkpoint items never consume the fused
     budget."""
-    batches = [(1, 0, "fuseA"), (0, 0, "fast1"), (1, 5, "fuseHot"),
-               (0, 2, "fast2"), (1, 0, "fuseB")]
+    batches = [(FUSED_LANE, 0, "fuseA"), (FAST_LANE, 0, "fast1"),
+               (FUSED_LANE, 5, "fuseHot"), (FAST_LANE, 2, "fast2"),
+               (FUSED_LANE, 0, "fuseB")]
     ckpt = [CheckpointChunk(f"ck{i}", run=lambda: None) for i in range(4)]
     for budget in (1, 2, 3, 10):
         h_plain = _heap_with(batches, [])
@@ -345,7 +347,7 @@ def test_gradient_pops_unchanged_by_checkpoint_items():
 
 def test_checkpoint_items_pop_in_arrival_order_after_gradients():
     items = [CheckpointChunk(f"ck{i}", run=lambda: None) for i in range(3)]
-    heap = _heap_with([(1, 0, "g")], items)
+    heap = _heap_with([(FUSED_LANE, 0, "g")], items)
     assert pop_gradient_batches(heap, 1) == ["g"]
     assert [i.name for i in pop_checkpoint_items(heap, 10)] == [
         "ck0", "ck1", "ck2"]
