@@ -522,6 +522,249 @@ def bench_autoscale(errors=None):
     return out
 
 
+def bench_serving(errors=None):
+    """Closed-loop serving-plane bench (ISSUE 19, docs/serving.md), four
+    claims on every JSON line:
+
+    - **p50/p99 vs offered load** — a paced client drives the REAL
+      continuous batcher + jitted replica forward at a sweep of offered
+      rates; each point records achieved qps, tail latency percentiles,
+      batches formed and 429 rejections (the backpressure knee).
+    - **batched-vs-sequential bitwise parity** — the padded-bucket
+      batched forward must produce bit-identical rows to one-at-a-time
+      forwards, and batch-size churn inside the bucket menu must not
+      recompile (FusedProgramCache miss count pinned).
+    - **scripted ramp → scale_out → drain** — the ScalePolicy serving
+      mode under an injected clock: rising request rate fires scale_out
+      after the persistence window, a rate collapse below ``idle_qps``
+      fires the idle scale_in; plus the LIVE drain contract on the
+      batcher (in-flight requests complete, new admissions refused).
+    - **13 B warm-frame guard with serving active** — a real two-rank
+      controller negotiates steady-state cycles while serve traffic
+      hammers the batcher and its metrics ride the monitor side-channel;
+      the negotiation-critical bytes per cycle and the zero-full-announce
+      invariant must hold exactly as with serving off.
+
+    Rank-0 only, self-contained (own controller pair on a free port)."""
+    if os.environ.get("HOROVOD_RANK", "0") not in ("", "0"):
+        return None
+    import socket as _socket
+    import threading as _threading
+
+    import numpy as np
+
+    from horovod_tpu.common.controller import TCPController
+    from horovod_tpu.elastic.autoscale import ScalePolicy
+    from horovod_tpu.monitor.agent import MonitorAgent
+    from horovod_tpu.serve.batcher import ContinuousBatcher, Draining
+    from horovod_tpu.serve.replica import Replica
+
+    t_section = time.perf_counter()
+    out = {}
+
+    def apply_fn(params, x):
+        return x @ params["w"]
+
+    rng = np.random.RandomState(7)
+    rep = Replica(apply_fn)
+    rep.load({"w": rng.randn(16, 8).astype(np.float32)}, version=1)
+    x = rng.randn(8, 16).astype(np.float32)
+
+    # ---- parity + recompile pin -------------------------------------
+    # Row i alone (zero co-rows, same bucket-8 program) must be bitwise
+    # identical to row i of the full batch: results depend only on the
+    # request's own row, never its position or co-batched neighbours.
+    # Cross-bucket programs are different XLA reductions and cannot be
+    # pinned bitwise.
+    batched = rep.forward(x)
+    blank = np.zeros_like(x)
+    seq = []
+    for i in range(8):
+        alone = blank.copy()
+        alone[0] = x[i]
+        seq.append(rep.forward(alone)[0])
+    out["parity_bitwise"] = bool(np.array_equal(batched, np.stack(seq)))
+    misses0 = rep.cache.misses
+    for n in (3, 5, 7, 8, 2, 6):          # churn across the bucket menu
+        rep.forward(x[:n])
+    # bucket 8 compiled above; churn may add 2 and 4 — nothing else.
+    out["churn_recompiles"] = rep.cache.misses - misses0
+    out["churn_cache_hits"] = rep.cache.hits
+    out["batch_churn_bounded"] = bool(out["churn_recompiles"] <= 2)
+
+    # ---- p50/p99 vs offered load ------------------------------------
+    n_req = int(os.environ.get("HVD_BENCH_SERVE_REQS", "120"))
+    sweep = []
+    for offered in (100.0, 400.0, 1600.0):
+        b = ContinuousBatcher(max_batch=8, deadline_ms=2000.0,
+                              max_inflight=2, queue_depth=64)
+        stop = _threading.Event()
+        t = _threading.Thread(target=rep.serve_loop, args=(b, stop),
+                              kwargs={"poll_s": 0.005}, daemon=True)
+        t.start()
+        period = 1.0 / offered
+        reqs, rejected = [], 0
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            lag = t0 + i * period - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+            try:
+                reqs.append(b.submit(x[i % 8]))
+            except Exception:  # noqa: BLE001 - QueueFull = the knee
+                rejected += 1
+        for r in reqs:
+            try:
+                r.wait(10.0)
+            except Exception:  # noqa: BLE001 - expiry counted below
+                pass
+        elapsed = time.perf_counter() - t0
+        stop.set()
+        t.join(5)
+        st = b.stats()
+        sweep.append({
+            "offered_qps": offered,
+            "achieved_qps": round(len(reqs) / elapsed, 1),
+            "p50_ms": st["latency_p50_ms"], "p99_ms": st["latency_p99_ms"],
+            "batches": st["batches_total"], "rejected_429": rejected,
+            "expired": st["expired_total"],
+            "padding_rows": st["padding_rows_total"],
+        })
+    out["load_sweep"] = sweep
+
+    # ---- scripted ramp -> scale_out -> drain ------------------------
+    pol = ScalePolicy(min_np=1, max_np=4, persistence=2, cooldown_s=5.0,
+                      idle_s=10.0, rate_high=100.0,
+                      latency_target_ms=50.0, idle_qps=5.0)
+    size, clock, actions = 2, 0.0, []
+    script = ([80.0] * 2 + [350.0] * 3       # ramp past 100/replica
+              + [1.0] * 8)                   # collapse below idle_qps
+    for rate in script:
+        clock += 6.0                         # outpace the 5s cooldown
+        d = pol.observe({"request_rate": rate, "latency_p99_ms": 12.0,
+                         "queue_depth": 0}, size=size, now=clock)
+        actions.append(d.action)
+        if d.action == "scale_out":
+            size = d.target_size
+        elif d.action == "scale_in":
+            size = d.target_size
+            break
+    out["scenario"] = {
+        "actions": actions,
+        "scale_out_fired": "scale_out" in actions,
+        "drain_fired": "scale_in" in actions,
+        "final_size": size,
+    }
+
+    # Live drain contract: queued work completes, new work is refused.
+    b = ContinuousBatcher(max_batch=4, deadline_ms=5000.0, max_inflight=2)
+    inflight = [b.submit(x[i % 8]) for i in range(6)]
+    b.drain()
+    refused = False
+    try:
+        b.submit(x[0])
+    except Draining:
+        refused = True
+    served = rep.serve_loop(b)               # returns when drained + empty
+    out["scenario"]["drain_completed_inflight"] = bool(
+        all(r.done() and r.error is None for r in inflight))
+    out["scenario"]["drain_refused_new"] = refused
+    out["scenario"]["drain_batches"] = served
+
+    # ---- 13 B warm-frame guard with serving active ------------------
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    result = {}
+
+    class _E:
+        def __init__(self, name):
+            self.name = name
+            self.tensor = np.zeros((2, 4), np.float32)
+            self.group_id = -1
+
+    def _steps(ctl, names, n_steps):
+        for _ in range(n_steps):
+            pending = [_E(n) for n in names]
+            for _round in range(40):
+                ready, _errs = ctl.negotiate(pending)
+                got = {e.name for e in ready}
+                pending = [e for e in pending if e.name not in got]
+                if not pending:
+                    break
+
+    def run(rank):
+        names = [f"serve_bench.grad.{i}" for i in range(8)]
+        ctl = TCPController("127.0.0.1", port, rank=rank, world=2,
+                            stall_warn_s=60.0, cache_capacity=64)
+        sb = ContinuousBatcher(max_batch=4, deadline_ms=1000.0,
+                               max_inflight=2)
+        agent = MonitorAgent(engine=None, controller=ctl, rank=rank,
+                             world=2, interval_s=0.05,
+                             registry=sb.registry)
+        stop = _threading.Event()
+
+        def fake_worker():                   # jax-free: route 2x back
+            while not stop.is_set():
+                batch = sb.next_batch(timeout=0.01)
+                if batch is not None:
+                    sb.complete(batch, [np.asarray(r.inputs) * 2
+                                        for r in batch.requests])
+
+        def client():
+            while not stop.is_set():
+                try:
+                    sb.submit(np.ones(4, np.float32)).wait(1.0)
+                except Exception:  # noqa: BLE001 - load gen best effort
+                    pass
+
+        threads = [_threading.Thread(target=fake_worker, daemon=True),
+                   _threading.Thread(target=client, daemon=True)]
+        for th in threads:
+            th.start()
+        try:
+            _steps(ctl, names, 3)            # warm: learn cache slots
+            time.sleep(0.06)                 # arm the monitor interval
+            st = ctl.cache_stats
+            full_before = st.full_announces
+            bytes_before = ctl.bytes_sent
+            mon_before = ctl.monitor_bytes_sent
+            _steps(ctl, names, 5)
+            if rank == 0:
+                mon_bytes = ctl.monitor_bytes_sent - mon_before
+                per_cycle = (ctl.bytes_sent - bytes_before - mon_bytes) / 5
+                result["full_announce_delta"] = (st.full_announces
+                                                 - full_before)
+                result["warm_bytes_per_cycle"] = round(per_cycle, 1)
+                result["serve_requests_during_window"] = \
+                    sb.stats()["requests_total"]
+        except Exception as exc:  # noqa: BLE001 - recorded, never hangs
+            result.setdefault("error", repr(exc))
+        finally:
+            stop.set()
+            agent.close()
+            ctl.shutdown()
+
+    t = _threading.Thread(target=run, args=(1,), daemon=True)
+    t.start()
+    run(0)
+    t.join(timeout=30)
+    if "error" in result:
+        if errors is not None:
+            errors["serving_frame_guard"] = result["error"]
+    else:
+        out["frame_guard"] = {
+            **result,
+            "held": bool(result.get("full_announce_delta") == 0
+                         and (result.get("warm_bytes_per_cycle") or 1e9)
+                         <= 32),
+        }
+    _record_timing("serving", warmup=3, iters=3 * n_req,
+                   wall_s=time.perf_counter() - t_section)
+    return out
+
+
 def bench_restore_ab(errors=None, world=4, mb=None):
     """Resilient-state-plane restore A/B (ISSUE 14): wall time to recover
     a joiner's state from the DISK manifest (newest complete epoch, all
@@ -2462,6 +2705,10 @@ def _run(out, errors):
         except Exception as exc:  # noqa: BLE001 - contained
             errors["autoscale"] = repr(exc)
         try:
+            out["serving"] = bench_serving(errors=errors)
+        except Exception as exc:  # noqa: BLE001 - contained
+            errors["serving"] = repr(exc)
+        try:
             out["restore_ab"] = bench_restore_ab(errors=errors)
         except Exception as exc:  # noqa: BLE001 - contained
             errors["restore_ab"] = repr(exc)
@@ -2606,6 +2853,11 @@ def _run(out, errors):
         out["autoscale"] = bench_autoscale(errors=errors)
     except Exception as exc:  # noqa: BLE001 - contained
         errors["autoscale"] = repr(exc)
+
+    try:
+        out["serving"] = bench_serving(errors=errors)
+    except Exception as exc:  # noqa: BLE001 - contained
+        errors["serving"] = repr(exc)
 
     try:
         out["restore_ab"] = bench_restore_ab(errors=errors)

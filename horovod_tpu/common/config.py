@@ -81,6 +81,7 @@ class Config:
     - ``stall_check_disable``      <- HOROVOD_STALL_CHECK_DISABLE
     - ``hierarchical_allreduce``   <- HOROVOD_HIERARCHICAL_ALLREDUCE
     - ``hierarchical_allgather``   <- HOROVOD_HIERARCHICAL_ALLGATHER
+    - ``hierarchical_broadcast``   <- HOROVOD_HIERARCHICAL_BROADCAST
     - ``hier_threshold_bytes``     <- HOROVOD_HIER_THRESHOLD (flat-vs-
       two-level payload crossover; 0 = always two-level when armed)
     - ``slice_map``                <- HOROVOD_SLICE_MAP (explicit slice
@@ -200,6 +201,12 @@ class Config:
 
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
+    # Two-level broadcast on the same slice topology (ISSUE 19 satellite):
+    # leader exchange across DCN, then intra-slice fan-out over ICI —
+    # bitwise-identical to flat (pure data movement).  Like the allgather
+    # knob, the decision is purely topological (no payload crossover) and
+    # rides the fusion key only, never the negotiation digest.
+    hierarchical_broadcast: bool = False
     # Local-axis extent for the two-level (cross x local) collectives; 0 =
     # derive from the topology's per-process device counts (multi-host).
     hierarchical_local_size: int = 0
@@ -309,6 +316,41 @@ class Config:
     autoscale_persistence: int = 3
     autoscale_cooldown_s: float = 30.0
     autoscale_idle_s: float = 60.0
+    # Request-rate / latency-target autoscaling (ISSUE 19, serving mode;
+    # docs/serving.md).  All three are off at 0.  autoscale_rate_high:
+    # fleet-aggregate offered QPS per replica above which (with a rising
+    # EWMA trend) the policy scales out.  autoscale_latency_target_ms:
+    # serving p99 latency SLO — p99 above target counts toward scale_out
+    # with the same persistence/cooldown hysteresis as the queue signals.
+    # autoscale_idle_qps: offered load below this feeds the idle timer
+    # (scale_in after autoscale_idle_s), replacing the training-progress
+    # idle test when serving instruments are present.
+    autoscale_rate_high: float = 0.0
+    autoscale_latency_target_ms: float = 0.0
+    autoscale_idle_qps: float = 0.0
+
+    # Data-parallel serving plane (ISSUE 19, horovod_tpu.serve,
+    # docs/serving.md).  HOROVOD_SERVE=1 turns a launched worker fleet
+    # into inference replicas (torovodrun --serve); HOROVOD_SERVE_PORT is
+    # the rank-0 front-door HTTP ingest port (0 = in-process API only).
+    # serve_max_batch bounds one forward step's batch; serve_buckets
+    # ("1,2,4,8") pins the padded batch shapes the jitted forward may
+    # see — batch-size churn rounds up to a bucket so the program cache
+    # never recompiles mid-traffic (empty = powers of two up to
+    # serve_max_batch).  serve_deadline_ms is the per-request admission
+    # deadline (expired requests are failed, never dispatched);
+    # serve_max_inflight bounds admitted-but-unsettled batches (the
+    # HOROVOD_MAX_INFLIGHT window semantics applied at the front door;
+    # 0 = inherit max_inflight); serve_queue_depth bounds the ingest
+    # queue — a full queue is backpressure (HTTP 429 + queue-depth
+    # signal), the load-balancer/autoscaler signal to shed or grow.
+    serve: bool = False
+    serve_port: int = 0
+    serve_max_batch: int = 8
+    serve_buckets: str = ""
+    serve_deadline_ms: float = 1000.0
+    serve_max_inflight: int = 0
+    serve_queue_depth: int = 128
 
     autotune: bool = False
     autotune_log: str = ""
@@ -374,6 +416,7 @@ class Config:
             stall_check_disable=_env_bool("STALL_CHECK_DISABLE", False),
             hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool("HIERARCHICAL_ALLGATHER", False),
+            hierarchical_broadcast=_env_bool("HIERARCHICAL_BROADCAST", False),
             hierarchical_local_size=_env_int("HIERARCHICAL_LOCAL_SIZE", 0),
             hier_threshold_bytes=_env_int("HIER_THRESHOLD", 0),
             slice_map=_env("SLICE_MAP", "") or "",
@@ -397,6 +440,17 @@ class Config:
             autoscale_persistence=_env_int("AUTOSCALE_PERSISTENCE", 3),
             autoscale_cooldown_s=_env_float("AUTOSCALE_COOLDOWN", 30.0),
             autoscale_idle_s=_env_float("AUTOSCALE_IDLE_S", 60.0),
+            autoscale_rate_high=_env_float("AUTOSCALE_RATE_HIGH", 0.0),
+            autoscale_latency_target_ms=_env_float(
+                "AUTOSCALE_LATENCY_TARGET_MS", 0.0),
+            autoscale_idle_qps=_env_float("AUTOSCALE_IDLE_QPS", 0.0),
+            serve=_env_bool("SERVE", False),
+            serve_port=_env_int("SERVE_PORT", 0),
+            serve_max_batch=_env_int("SERVE_MAX_BATCH", 8),
+            serve_buckets=_env("SERVE_BUCKETS", "") or "",
+            serve_deadline_ms=_env_float("SERVE_DEADLINE_MS", 1000.0),
+            serve_max_inflight=_env_int("SERVE_MAX_INFLIGHT", 0),
+            serve_queue_depth=_env_int("SERVE_QUEUE_DEPTH", 128),
             autotune=_env_bool("AUTOTUNE", False),
             autotune_log=_env("AUTOTUNE_LOG", "") or "",
             autotune_warmup_samples=_env_int("AUTOTUNE_WARMUP_SAMPLES", 3),

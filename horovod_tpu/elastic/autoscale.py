@@ -39,10 +39,15 @@ autoscaling" for the knob table):
 ``scale_out``  fleet queue depth trends up (``queue_depth_trend`` >
                ``queue_trend_up``) or sits above ``queue_high`` for
                ``persistence`` observations, and the world is below
-               ``max_np`` — load is arriving faster than it drains
+               ``max_np`` — load is arriving faster than it drains.
+               Serving mode (ISSUE 19) feeds the SAME persistence
+               counter from two more triggers: per-replica request rate
+               above ``rate_high`` req/s, or fleet p99 latency above
+               ``latency_target_ms``
 ``scale_in``   the fleet has been idle (zero queued work, no cycle
-               progress) for ``idle_s`` seconds and the world is above
-               ``min_np``
+               progress — or, with ``idle_qps`` set, fleet request rate
+               below that floor) for ``idle_s`` seconds and the world
+               is above ``min_np``
 ``hold``       anything else — including the ``cooldown_s`` window
                after every non-hold decision, any observation whose
                trend windows have not filled (nulls never scale), and
@@ -104,7 +109,9 @@ class ScalePolicy:
                  queue_high: float = 16.0, queue_trend_up: float = 4.0,
                  straggler_factor: float = 3.0, persistence: int = 3,
                  cooldown_s: float = 30.0, idle_s: float = 60.0,
-                 scale_step: int = 1, commit_max_age_s: float = 0.0):
+                 scale_step: int = 1, commit_max_age_s: float = 0.0,
+                 rate_high: float = 0.0, latency_target_ms: float = 0.0,
+                 idle_qps: float = 0.0):
         self.min_np = max(1, int(min_np))
         self.max_np = int(max_np) if max_np else None
         self.queue_high = float(queue_high)
@@ -123,6 +130,20 @@ class ScalePolicy:
         # old behavior).  Preemption is exempt: the hardware is going
         # away on the platform's schedule either way.
         self.commit_max_age_s = max(0.0, float(commit_max_age_s))
+        # Serving mode (ISSUE 19, HOROVOD_AUTOSCALE_{RATE_HIGH,
+        # LATENCY_TARGET_MS,IDLE_QPS}): when the fleet runs the serving
+        # plane, the load signals are request rate and tail latency, not
+        # training queue depth.  ``rate_high`` is a PER-REPLICA request
+        # rate (req/s) above which the fleet scales out;
+        # ``latency_target_ms`` a fleet p99 SLO that triggers scale-out
+        # when breached; ``idle_qps`` a fleet rate floor below which the
+        # idle timer may accrue (serving replicas make no training
+        # progress, so the progress-based idle test would drain a busy
+        # serving fleet).  All default 0 = off: training-only fleets are
+        # byte-for-byte unaffected.
+        self.rate_high = max(0.0, float(rate_high))
+        self.latency_target_ms = max(0.0, float(latency_target_ms))
+        self.idle_qps = max(0.0, float(idle_qps))
         self.stale_holds = 0
         # Hysteresis state.
         self._last_action_ts: Optional[float] = None
@@ -218,11 +239,20 @@ class ScalePolicy:
         # load was never observed.
         queue_depth = summary.get("queue_depth")
         progress_total = summary.get("progress_total")
+        rate = summary.get("request_rate")
+        p99 = summary.get("latency_p99_ms")
         observed = queue_depth is not None or progress_total is not None
         progressed = (progress_total is not None
                       and progress_total != self._last_progress_total)
         self._last_progress_total = progress_total
         busy = bool(queue_depth) or progressed
+        if self.idle_qps > 0 and rate is not None:
+            # Serving-idle (ISSUE 19): replicas make no training progress,
+            # so idleness is "request rate below the floor", not "no cycle
+            # progress" — otherwise a fleet serving at full tilt would
+            # look idle and get drained.
+            observed = True
+            busy = rate >= self.idle_qps or bool(queue_depth)
         if busy or not observed:
             self._idle_since = None
         elif self._idle_since is None:
@@ -250,22 +280,37 @@ class ScalePolicy:
                 EVICT, reason=f"persistent straggler; {evidence}",
                 evict_rank=rank))
 
-        # 2. Load trending up → scale out.
+        # 2. Load trending up → scale out.  Serving mode (ISSUE 19) adds
+        # two more triggers to the same persistence counter: per-replica
+        # request rate above ``rate_high``, or fleet p99 latency above
+        # ``latency_target_ms`` — both null-safe (nulls never scale).
         trend = summary.get("queue_depth_trend")
+        rate_hot = (self.rate_high > 0 and rate is not None and size > 0
+                    and rate / size > self.rate_high)
+        latency_hot = (self.latency_target_ms > 0 and p99 is not None
+                       and p99 > self.latency_target_ms)
         high = ((trend is not None and trend > self.queue_trend_up)
                 or (queue_depth is not None
-                    and queue_depth > self.queue_high))
+                    and queue_depth > self.queue_high)
+                or rate_hot or latency_hot)
         self._up_hits = self._up_hits + 1 if high else 0
         if (self._up_hits >= self.persistence
                 and (self.max_np is None or size < self.max_np)):
             target = size + self.scale_step
             if self.max_np is not None:
                 target = min(target, self.max_np)
+            if rate_hot or latency_hot:
+                reason = (f"serving load rising: "
+                          f"request_rate={rate} ({size} replicas, "
+                          f"per-replica high {self.rate_high:g}/s) "
+                          f"p99={p99}ms (target "
+                          f"{self.latency_target_ms:g}ms) for "
+                          f"{self._up_hits} observations")
+            else:
+                reason = (f"load rising: queue_depth={queue_depth} "
+                          f"trend={trend} for {self._up_hits} observations")
             return self._acted(now, ScaleDecision(
-                SCALE_OUT,
-                reason=(f"load rising: queue_depth={queue_depth} "
-                        f"trend={trend} for {self._up_hits} observations"),
-                target_size=target))
+                SCALE_OUT, reason=reason, target_size=target))
 
         # 3. Idle → scale in (refused while the restore point is stale).
         if (size > self.min_np and self._idle_since is not None

@@ -952,7 +952,10 @@ def run_elastic(args) -> int:
             persistence=cfg.autoscale_persistence,
             cooldown_s=cfg.autoscale_cooldown_s,
             idle_s=cfg.autoscale_idle_s,
-            commit_max_age_s=cfg.commit_max_age_s)
+            commit_max_age_s=cfg.commit_max_age_s,
+            rate_high=cfg.autoscale_rate_high,
+            latency_target_ms=cfg.autoscale_latency_target_ms,
+            idle_qps=cfg.autoscale_idle_qps)
         if not extra_env.get("HOROVOD_MONITOR_PORT"):
             log.warning(
                 "autoscale enabled without --monitor-port: the driver has "

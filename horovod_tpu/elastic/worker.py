@@ -55,6 +55,25 @@ _current_version: Optional[int] = None
 _manager: Optional["WorkerNotificationManager"] = None
 
 
+def _mark_draining() -> None:
+    """Flip this rank's monitor readiness to NotReady the moment a driver
+    DRAIN ping lands (ISSUE 19: readiness split from liveness).
+
+    The drain itself is consumed later — at the next ``state.commit()``
+    via ``raise_if_updated()`` — but the load balancer must stop routing
+    NEW requests to a cordoned replica immediately, not at the next
+    commit boundary.  Lazy import + best-effort: worker.py stays
+    importable jax-free, and a fleet without the monitor (or before
+    ``init()``) simply has no readiness surface to flip."""
+    try:
+        from ..common import basics
+        agent = basics._get_state().monitor
+        if agent is not None:
+            agent.set_ready(False, "draining: driver cordon ping received")
+    except Exception:  # noqa: BLE001 - telemetry must never block a drain
+        pass
+
+
 def identity() -> str:
     host = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
     local_rank = os.environ.get("HOROVOD_LOCAL_RANK", "0")
@@ -154,6 +173,7 @@ class WorkerNotificationManager:
     def _notify_drain(self):
         with self._lock:
             self._drain_pending = True
+        _mark_draining()
 
     def _notify_commit(self):
         with self._lock:

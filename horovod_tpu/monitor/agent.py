@@ -83,6 +83,15 @@ class MonitorAgent:
         # dead-rank list so operators see WHO died, not just that the
         # fleet degraded.
         self._peer_failure: Optional[dict] = None
+        # Readiness latch (ISSUE 19 satellite, docs/serving.md): /ready
+        # splits load-balancer admission from liveness.  A draining
+        # replica is perfectly HEALTHY (in-flight requests must finish,
+        # so /health stays ok) but must take no NEW traffic — the elastic
+        # drain path flips this to NotReady the moment the driver's
+        # cordon reaches the worker (elastic/worker.py), and the serving
+        # front door flips it around its own drain.
+        self._ready = True
+        self._not_ready_reason = ""
         if controller is not None:
             controller.monitor_source = self.encode_frame
             controller.monitor_sink = self.on_frames
@@ -151,6 +160,17 @@ class MonitorAgent:
             reg.counter("hvd_hier_ag_cross_legs_total",
                         "cross-slice allgather legs run").set_total(
                 getattr(engine, "hier_ag_cross_legs", 0))
+            # Two-level broadcast legs (ISSUE 19): cross legs are the
+            # root→leader DCN exchange, intra legs the ICI fan-out.
+            reg.counter("hvd_hier_bcast_dispatches_total",
+                        "two-level broadcast batches dispatched").set_total(
+                getattr(engine, "hier_bcast_dispatches", 0))
+            reg.counter("hvd_hier_bcast_intra_legs_total",
+                        "intra-slice broadcast fan-out legs run").set_total(
+                getattr(engine, "hier_bcast_intra_legs", 0))
+            reg.counter("hvd_hier_bcast_cross_legs_total",
+                        "cross-slice broadcast leader legs run").set_total(
+                getattr(engine, "hier_bcast_cross_legs", 0))
             reg.counter("hvd_slice_map_fallbacks_total",
                         "HOROVOD_SLICE_MAP rejections (non-uniform "
                         "slices); hierarchical collectives forced flat"
@@ -481,10 +501,31 @@ class MonitorAgent:
         return ("monitor attribution (snapshot ages via side-channel):\n"
                 + "\n".join(lines))
 
+    # --------------------------------------------------------- readiness
+    def set_ready(self, ready: bool, reason: str = "") -> None:
+        """Flip the /ready verdict.  Liveness is DERIVED (snapshot ages,
+        stall state); readiness is DECLARED — cordon/drain and serving
+        front-door state own it, so a load balancer stops routing to a
+        draining replica while /health still reads ok."""
+        self._ready = bool(ready)
+        self._not_ready_reason = "" if ready else str(reason)[:500]
+
+    def readiness(self) -> dict:
+        """The ``/ready`` JSON body: the declared latch AND the derived
+        fault state — a rank whose control plane died is not ready either,
+        whatever the latch says."""
+        pf = self._peer_failure
+        if pf is not None:
+            return {"ready": False,
+                    "reason": f"peer_dead: {pf['reason'] or pf['dead_ranks']}"}
+        return {"ready": self._ready,
+                "reason": self._not_ready_reason if not self._ready else ""}
+
     # -------------------------------------------------------------- exports
     def health(self) -> dict:
         self._update_self(force=True)
         out = self.aggregator.health(self.interval_s)
+        out["ready"] = self.readiness()["ready"]
         pf = self._peer_failure
         if pf is not None:
             # A declared control-plane fault outranks every derived
@@ -518,7 +559,9 @@ class MonitorAgent:
         # Windowed trend gauges (autoscale policy inputs): emitted only
         # once their EWMA window fills — absence IS the null.
         summary = self.aggregator.summary()
-        for name in ("cycle_us_spread_trend", "queue_depth_trend"):
+        for name in ("cycle_us_spread_trend", "queue_depth_trend",
+                     "request_rate", "request_rate_trend",
+                     "latency_p99_ms"):
             v = summary.get(name)
             if v is not None:
                 out.append(f"# TYPE hvd_{name} gauge")

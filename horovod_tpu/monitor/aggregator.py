@@ -73,10 +73,50 @@ class EwmaTrend:
             return None
         return round(self._fast - self._slow, 4)
 
+    @property
+    def level(self) -> Optional[float]:
+        """Smoothed current value (the fast EWMA), null until the window
+        fills — the serving summary's ``request_rate``/``latency_p99_ms``
+        read this so one noisy sample never steers a scale decision."""
+        if self._n < self.min_samples:
+            return None
+        return round(self._fast, 4)
+
     def reset(self) -> None:
         self._fast = None
         self._slow = None
         self._n = 0
+
+
+def merged_percentile(hists, q: float) -> Optional[float]:
+    """Percentile of the UNION of per-rank histogram snapshots (the
+    ``{"count", "sum", "buckets": {le: cum}}`` shape the registry ships
+    over the side-channel).  Buckets merge by upper bound — every rank
+    publishes the same serving-latency buckets, so the cumulative counts
+    add directly; interpolation inside the crossing bucket matches
+    ``registry.Histogram.percentile``.  None until anything observed."""
+    merged: Dict[float, int] = {}
+    total = 0
+    for h in hists:
+        if not h:
+            continue
+        total += int(h.get("count") or 0)
+        for le, cum in (h.get("buckets") or {}).items():
+            le = float(le)
+            merged[le] = merged.get(le, 0) + int(cum)
+    if total == 0 or not merged:
+        return None
+    target = q * total
+    lo = 0.0
+    prev_cum = 0
+    for le in sorted(merged):
+        cum = merged[le]
+        if cum > prev_cum and cum >= target:
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return round(lo + (le - lo) * frac, 4)
+        prev_cum = max(prev_cum, cum)
+        lo = le
+    return max(merged)
 
 
 class RankAggregator:
@@ -98,6 +138,13 @@ class RankAggregator:
         # flushed on join epoch like the rest of the table.
         self._spread_trend = EwmaTrend()
         self._queue_trend = EwmaTrend()
+        # Serving instruments (ISSUE 19, docs/serving.md): fleet request
+        # rate from the summed per-rank request counters differenced at
+        # snapshot cadence, and fleet p99 latency from the merged serving
+        # histograms — both EWMA-smoothed, nulls until the window fills.
+        self._rate_trend = EwmaTrend(min_samples=3)
+        self._latency_trend = EwmaTrend(min_samples=3)
+        self._serve_last: Optional[tuple] = None   # (requests_total, mono)
         self.flushes = 0
         self.updates = 0
 
@@ -118,6 +165,40 @@ class RankAggregator:
             q = self._queue_depth_locked()
             if q is not None:
                 self._queue_trend.update(q)
+            self._update_serving_locked()
+
+    def _update_serving_locked(self) -> None:
+        """Feed the serving trends at snapshot cadence: the fleet request
+        counter's first derivative (offered QPS) and the merged-histogram
+        p99.  No serving metrics reported → no samples → the summary
+        fields stay null and the policy's serving mode stays inert."""
+        totals = []
+        hists = []
+        for r, rec in self._table.items():
+            if r in self._left:
+                continue
+            m = rec["snap"].get("metrics") or {}
+            v = m.get("hvd_serve_requests_total")
+            if v is not None:
+                totals.append(float(v))
+            h = m.get("hvd_serve_latency_ms")
+            if isinstance(h, dict):
+                hists.append(h)
+        if totals:
+            total = sum(totals)
+            now = time.monotonic()
+            if self._serve_last is not None:
+                last_total, last_t = self._serve_last
+                dt = now - last_t
+                if dt > 1e-3:
+                    self._rate_trend.update(
+                        max(0.0, total - last_total) / dt)
+                    self._serve_last = (total, now)
+            else:
+                self._serve_last = (total, now)
+        p99 = merged_percentile(hists, 0.99)
+        if p99 is not None:
+            self._latency_trend.update(p99)
 
     def mark_left(self, rank: int) -> None:
         """Record a clean departure (protocol v6 leave notice): the rank
@@ -135,6 +216,9 @@ class RankAggregator:
             self._table.clear()
             self._spread_trend.reset()
             self._queue_trend.reset()
+            self._rate_trend.reset()
+            self._latency_trend.reset()
+            self._serve_last = None
             self.flushes += 1
 
     @staticmethod
@@ -208,6 +292,14 @@ class RankAggregator:
             out["queue_depth"] = self._queue_depth_locked()
             out["cycle_us_spread_trend"] = self._spread_trend.trend
             out["queue_depth_trend"] = self._queue_trend.trend
+            # Serving instruments (ISSUE 19): fleet offered QPS (EWMA
+            # level of the summed request-counter derivative), its trend
+            # (the policy's "offered load rising" input), and fleet p99
+            # serving latency — nulls-until-filled like the queue trends,
+            # and null forever on fleets that never serve.
+            out["request_rate"] = self._rate_trend.level
+            out["request_rate_trend"] = self._rate_trend.trend
+            out["latency_p99_ms"] = self._latency_trend.level
             out["ranks_reporting"] = len(
                 [r for r in self._table if r not in self._left])
             out["left_ranks"] = sorted(self._left)

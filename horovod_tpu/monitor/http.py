@@ -8,6 +8,10 @@ Runs on rank 0 when ``HOROVOD_MONITOR_PORT`` is set (``docs/monitoring.md``):
 - ``GET /health``  — JSON: fleet status (``ok``/``stalled``/``degraded``),
   per-rank liveness, last-cycle age and stall state, slowest-rank /
   cycle-time-spread attribution.
+- ``GET /ready``   — readiness split from liveness (ISSUE 19): 200 while
+  this replica accepts new work, 503 (with a JSON reason) during
+  cordon/drain — the load balancer's routing signal, distinct from
+  ``/health``'s stall-driven 503.
 - ``GET /snapshot`` — raw JSON dump of the aggregation table (the format
   ``python -m horovod_tpu.monitor <file>`` pretty-prints).
 
@@ -28,7 +32,8 @@ log = get_logger()
 
 
 class MonitorHTTPServer:
-    """Serve ``/metrics`` + ``/health`` + ``/snapshot`` for a MonitorAgent."""
+    """Serve ``/metrics`` + ``/health`` + ``/ready`` + ``/snapshot`` for a
+    MonitorAgent."""
 
     def __init__(self, agent, port: int = 0, addr: str = ""):
         self._agent = agent
@@ -57,12 +62,23 @@ class MonitorHTTPServer:
                         code = 200 if health.get("status") == "ok" else 503
                         self._send(code, "application/json",
                                    json.dumps(health, indent=2))
+                    elif path == "/ready":
+                        # Readiness vs liveness (ISSUE 19): the LB's
+                        # routing signal.  NotReady during cordon/drain
+                        # while /health keeps reporting the truthful
+                        # liveness picture — a draining replica is
+                        # healthy, just not accepting new work.
+                        ready = outer._agent.readiness()
+                        code = 200 if ready.get("ready") else 503
+                        self._send(code, "application/json",
+                                   json.dumps(ready, indent=2))
                     elif path == "/snapshot":
                         self._send(200, "application/json",
                                    json.dumps(outer._agent.dump(), indent=2))
                     else:
                         self._send(404, "text/plain",
-                                   "try /metrics, /health or /snapshot\n")
+                                   "try /metrics, /health, /ready or "
+                                   "/snapshot\n")
                 except BrokenPipeError:  # pragma: no cover - client gone
                     pass
                 except Exception as exc:  # noqa: BLE001 - keep serving
@@ -87,7 +103,10 @@ class MonitorHTTPServer:
 
     def stop(self) -> None:
         try:
-            self._httpd.shutdown()
+            # shutdown() BLOCKS until serve_forever exits — only safe when
+            # start() actually ran; a never-started server just closes.
+            if self._thread is not None:
+                self._httpd.shutdown()
             self._httpd.server_close()
         except Exception:  # noqa: BLE001 - already down
             pass

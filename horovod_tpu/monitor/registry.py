@@ -157,6 +157,32 @@ class Histogram:
             return {"count": self._count, "sum": round(self._sum, 3),
                     "buckets": out}
 
+    def percentile(self, q: float) -> Optional[float]:
+        """Estimate the q-th percentile (``q`` in [0, 1]) by linear
+        interpolation inside the bucket that crosses it — the standard
+        Prometheus ``histogram_quantile`` estimate, computed locally so
+        ``/metrics`` can export p50/p99 without a query engine (ISSUE 19:
+        serving latency SLOs are percentile targets, not means).  None
+        until something was observed; observations past the last finite
+        bucket clamp to that bound (the estimate cannot exceed what the
+        buckets resolve)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"percentile wants q in [0, 1], got {q}")
+        with self._lock:
+            total = self._count
+            if total == 0 or not self.buckets:
+                return None
+            target = q * total
+            cum = 0
+            lo = 0.0
+            for le, c in zip(self.buckets, self._counts):
+                if c and cum + c >= target:
+                    frac = (target - cum) / c
+                    return round(lo + (le - lo) * frac, 4)
+                cum += c
+                lo = le
+            return self.buckets[-1]
+
     def set_cumulative(self, counts: Sequence[int], sum_: float,
                        count: int) -> None:
         """Adopt an externally maintained histogram (collectors mirroring
@@ -264,6 +290,13 @@ class MetricRegistry:
                 lines.append(f"{name}_bucket{{{body}}} {snap['count']}")
                 lines.append(f"{name}_sum{lab} {snap['sum']:g}")
                 lines.append(f"{name}_count{lab} {snap['count']}")
+                # Percentile export (ISSUE 19): pre-computed p50/p99
+                # gauges so load balancers / autoscalers without a
+                # histogram_quantile engine read latency SLOs directly.
+                for q, suffix in ((0.5, "p50"), (0.99, "p99")):
+                    v = m.percentile(q)
+                    if v is not None:
+                        lines.append(f"{name}_{suffix}{lab} {v:g}")
             else:
                 lines.append(f"{name}{lab} {m.snapshot_value():g}")
         return "\n".join(lines) + "\n"

@@ -283,6 +283,7 @@ class CollectiveEngine:
                 chunk_bytes=cfg.ckpt_chunk_bytes)
         self.hierarchical_allreduce = cfg.hierarchical_allreduce
         self.hierarchical_allgather = cfg.hierarchical_allgather
+        self.hierarchical_broadcast = cfg.hierarchical_broadcast
         self._hier_local_size = cfg.hierarchical_local_size
         # Two-level data plane (ISSUE 17): payload crossover + explicit
         # slice membership override.  hier_threshold_bytes is a local
@@ -305,6 +306,13 @@ class CollectiveEngine:
         self.hier_ag_dispatches = 0
         self.hier_ag_intra_legs = 0
         self.hier_ag_cross_legs = 0
+        # Two-level broadcast legs (ISSUE 19 satellite — serving's weight
+        # fan-out made this path hot): one hier-broadcast dispatch = 1
+        # cross-slice (DCN) leader-exchange leg + 1 intra-slice (ICI)
+        # fan-out leg.
+        self.hier_bcast_dispatches = 0
+        self.hier_bcast_intra_legs = 0
+        self.hier_bcast_cross_legs = 0
         # Non-uniform HOROVOD_SLICE_MAP rejections (ISSUE 18 satellite):
         # counted once per process set (the topology probe is cached), so
         # mixed-size fleets can see WHY collectives stayed flat.
@@ -1668,6 +1676,24 @@ class CollectiveEngine:
             return False
         return self._slice_topology(e0.process_set_id) is not None
 
+    def _hier_bcast_decision(self, e0: "TensorTableEntry") -> bool:
+        """Per-entry flat-vs-two-level verdict for broadcast (ISSUE 19
+        satellite — serving's versioned weight fan-out is the workload).
+        Same override semantics and zero-control-plane property as
+        ``_hier_ag_decision``: pure function of the entry's
+        ``hierarchical`` override, the engine knob, and the fleet-static
+        slice topology.  No payload crossover — two-level broadcast
+        moves the same bytes to every rank; the win is that only the
+        root→leader exchange crosses DCN (fan-out rides ICI), so the
+        decision is purely topological."""
+        if e0.ctype != CollectiveType.BROADCAST:
+            return False
+        if e0.hierarchical is False:
+            return False
+        if e0.hierarchical is None and not self.hierarchical_broadcast:
+            return False
+        return self._slice_topology(e0.process_set_id) is not None
+
     def _batch_payload_bytes(self, batch) -> int:
         """Per-rank payload bytes of a fused batch (stacked tensors carry
         [world, *S]; the per-rank shard is what rides the wire)."""
@@ -1782,12 +1808,21 @@ class CollectiveEngine:
             # payload threshold — the FSDP prefetch gathers that make
             # this path hot are full-bucket-sized by construction.
             hier = self._hier_ag_decision(e0)
+        elif e0.ctype == CollectiveType.BROADCAST:
+            # Two-level broadcast verdict (ISSUE 19 satellite): per-entry,
+            # purely topological like allgather — the serving weight
+            # fan-out that makes this path hot is whole-model-sized.
+            hier = self._hier_bcast_decision(e0)
         else:
             hier = self._hier_decision(e0, self._batch_payload_bytes(batch))
         if hier and e0.ctype == CollectiveType.ALLGATHER:
             self.hier_ag_dispatches += 1
             self.hier_ag_intra_legs += 1  # intra-slice gather (ICI)
             self.hier_ag_cross_legs += 1  # cross-slice leader exchange (DCN)
+        elif hier and e0.ctype == CollectiveType.BROADCAST:
+            self.hier_bcast_dispatches += 1
+            self.hier_bcast_cross_legs += 1  # root → slice leaders (DCN)
+            self.hier_bcast_intra_legs += 1  # leader → slice fan-out (ICI)
         elif hier:
             self.hier_dispatches += 1
             self.hier_intra_legs += 2     # reduce-scatter + allgather (ICI)
@@ -1886,6 +1921,15 @@ class CollectiveEngine:
             return self._build_allreduce(proto, shapes, dtypes, mesh, axis,
                                          world, _jit, plan)
         if ctype == CollectiveType.BROADCAST:
+            if hier is None:
+                # Direct callers carry no dispatch-time verdict.
+                hier = self._hier_bcast_decision(proto)
+            if hier:
+                # The verdict already proved the slice topology exists.
+                hmesh = self._hier_mesh(proto.process_set_id)
+                if hmesh is not None:
+                    return self._build_hier_broadcast(
+                        proto, shapes, hmesh, world, _jit)
             return self._build_broadcast(proto, shapes, mesh, axis, world,
                                          _jit)
         if ctype == CollectiveType.ALLGATHER:
@@ -2047,6 +2091,46 @@ class CollectiveEngine:
         return _jit(shard_map(
             body, mesh=mesh,
             in_specs=tuple(P(axis) for _ in shapes),
+            out_specs=tuple(P() for _ in shapes), check_vma=False))
+
+    def _build_hier_broadcast(self, proto, shapes, hmesh, world,
+                              _jit=jax.jit):
+        """Two-level broadcast: leader exchange (cross/DCN) → intra
+        fan-out (local/ICI).
+
+        The root masks everyone else to zero (same trick as the flat
+        builder), then ``psum("cross")`` lands the payload on the one
+        rank per slice that shares the root's local index (the DCN leg —
+        only L-1 slice leaders receive across the slow links), and
+        ``psum("local")`` fans it out within each slice over ICI.  Only
+        zeros are ever summed with the payload, so the result is
+        bitwise-identical to flat for every dtype.
+        """
+        root = proto.root_rank
+        local_size = int(hmesh.devices.shape[1])
+        root_cross, root_local = divmod(root, local_size)
+
+        def body(*shards):
+            outs = []
+            at_root = jnp.logical_and(
+                lax.axis_index("cross") == root_cross,
+                lax.axis_index("local") == root_local)
+            for s in shards:
+                x = s.reshape(s.shape[1:])
+                if jnp.issubdtype(x.dtype, jnp.bool_):
+                    m = jnp.where(at_root, x, False).astype(jnp.int32)
+                    m = lax.psum(m, "cross")      # root → slice leaders
+                    m = lax.psum(m, "local")      # leaders → slice fan-out
+                    outs.append(m.astype(jnp.bool_))
+                else:
+                    m = jnp.where(at_root, x, jnp.zeros_like(x))
+                    m = lax.psum(m, "cross")      # root → slice leaders
+                    outs.append(lax.psum(m, "local"))
+            return tuple(outs)
+
+        return _jit(shard_map(
+            body, mesh=hmesh,
+            in_specs=tuple(P(("cross", "local")) for _ in shapes),
             out_specs=tuple(P() for _ in shapes), check_vma=False))
 
     def _build_allgather(self, proto, shapes, mesh, axis, world,

@@ -179,6 +179,22 @@ def parse_args(argv: Sequence[str]) -> argparse.Namespace:
                         "exchange) — the gather legs FSDP makes hot; "
                         "bitwise-identical to flat "
                         "(HOROVOD_HIERARCHICAL_ALLGATHER)")
+    p.add_argument("--hierarchical-broadcast", action="store_true",
+                   help="Two-level broadcast on the slice topology (one "
+                        "cross-DCN leader exchange, then intra-ICI "
+                        "fan-out) — the leg serving weight fan-out makes "
+                        "hot; bitwise-identical to flat "
+                        "(HOROVOD_HIERARCHICAL_BROADCAST)")
+    p.add_argument("--serve", action="store_true",
+                   help="Serving plane (docs/serving.md): each rank runs "
+                        "a continuous-batching front door + replica "
+                        "forward loop instead of a training loop.  "
+                        "Forwarded as HOROVOD_SERVE; knobs via "
+                        "HOROVOD_SERVE_* (port, max batch, buckets, "
+                        "deadline, inflight window, queue depth)")
+    p.add_argument("--serve-port", type=int, default=None,
+                   help="Front-door HTTP port base; rank r listens on "
+                        "port+r (HOROVOD_SERVE_PORT; 0/unset = ephemeral)")
     p.add_argument("--hierarchical-controller", action="store_true",
                    help="Two-level control plane (docs/performance.md "
                         "'Control plane at scale'): a per-host agent "
@@ -423,8 +439,18 @@ def tuning_env(args) -> Dict[str, str]:
         env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
     if getattr(args, "hierarchical_allgather", False):
         env["HOROVOD_HIERARCHICAL_ALLGATHER"] = "1"
+    if getattr(args, "hierarchical_broadcast", False):
+        env["HOROVOD_HIERARCHICAL_BROADCAST"] = "1"
     if getattr(args, "hierarchical_controller", False):
         env["HOROVOD_HIERARCHICAL_CONTROLLER"] = "1"
+    # Serving plane (ISSUE 19, docs/serving.md): the flag plus the knob
+    # table travel as env so the workers' Config.from_env() sees them on
+    # every launch path; per-rank ports are derived worker-side from the
+    # base (rank r listens on serve_port + r when a base is given).
+    if getattr(args, "serve", False):
+        env["HOROVOD_SERVE"] = "1"
+    if getattr(args, "serve_port", None) is not None:
+        env["HOROVOD_SERVE_PORT"] = str(int(args.serve_port))
     return env
 
 

@@ -1,0 +1,44 @@
+"""Data-parallel serving plane (ISSUE 19, ``docs/serving.md``).
+
+The inference-time face of the collective runtime: the same process-set
+fabric that synchronizes training replicas fans a trained model out to N
+serving replicas and keeps their weights in lock-step through rolling
+updates, while a jax-free front door admits requests with continuous
+batching, padded-bucket shapes, deadlines and backpressure.
+
+Three layers, matching the training stack's jax-free/jax-backed split:
+
+- :class:`~.batcher.ContinuousBatcher` — admission queue + padded-bucket
+  batch former; ``HOROVOD_MAX_INFLIGHT``-style bounded dispatch window
+  (``batcher.py``, stdlib only).
+- :class:`~.frontdoor.FrontDoor` — HTTP/in-process ingest mapping
+  overload → 429, draining → 503, blown deadline → 504; ``drain()``
+  flips the monitor's ``/ready`` latch (``frontdoor.py``, stdlib only).
+- :class:`Replica` — version-stamped ``broadcast_parameters`` weight
+  fan-out + per-bucket jitted forward keyed into the
+  ``FusedProgramCache`` (``replica.py``, imports jax; loads lazily here
+  via PEP 562 so the jax-free tier can import ``horovod_tpu.serve``).
+
+Knob table (``HOROVOD_SERVE_*``) lives in ``common/config.py`` and
+``docs/serving.md``; ``torovodrun --serve`` wires it end-to-end.
+"""
+
+from .batcher import (  # noqa: F401  (jax-free re-exports)
+    Batch, ContinuousBatcher, DeadlineExceeded, Draining, QueueFull,
+    Request, parse_buckets,
+)
+from .frontdoor import FrontDoor  # noqa: F401
+
+# Lazily-loaded jax-backed replica layer (serve/replica.py imports jax).
+_REPLICA_ATTRS = ("Replica",)
+
+
+def __getattr__(name):
+    if name in _REPLICA_ATTRS:
+        from . import replica as _replica
+        return getattr(_replica, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_REPLICA_ATTRS))

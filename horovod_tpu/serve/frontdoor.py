@@ -1,0 +1,169 @@
+"""HTTP/in-process ingest for the serving plane (no jax imports).
+
+The jax-free front half of ``horovod_tpu.serve`` (ISSUE 19,
+``docs/serving.md``): a stdlib ``ThreadingHTTPServer`` that feeds the
+:class:`~.batcher.ContinuousBatcher` and maps its refusals onto the HTTP
+status codes load balancers already understand:
+
+- ``POST /v1/infer``  — ``{"inputs": [...], "deadline_ms": 250}`` →
+  ``200 {"outputs": ..., "latency_ms": ...}``.  Overload → **429** with
+  ``Retry-After`` and the live queue depth (the backpressure signal);
+  draining → **503**; deadline blown → **504**.
+- ``GET /v1/stats``   — the batcher's counters/percentiles as JSON (what
+  ``bench.py serving`` and operators poll).
+
+Readiness integration: :meth:`drain` stops admission AND flips the rank's
+:class:`~..monitor.agent.MonitorAgent` readiness latch, so the LB's
+``/ready`` probe (monitor HTTP server) goes 503 the moment the elastic
+driver cordons this replica — in-flight requests still complete.
+
+Deliberately per-replica: each replica runs its own front door and an
+external load balancer spreads requests across replicas using ``/ready``.
+The collective plane (weight fan-out, telemetry aggregation) is the only
+cross-replica traffic.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .batcher import ContinuousBatcher, Draining, QueueFull
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+
+class FrontDoor:
+    """One replica's ingest surface: HTTP + in-process ``infer()``."""
+
+    def __init__(self, batcher: ContinuousBatcher, port: int = 0,
+                 addr: str = "", agent=None):
+        self.batcher = batcher
+        self._agent = agent
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence stdlib request logging
+                pass
+
+            def _send(self, code: int, obj: dict, retry_after=None):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                if retry_after is not None:
+                    self.send_header("Retry-After", str(retry_after))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 - stdlib API
+                try:
+                    if self.path.split("?", 1)[0] == "/v1/stats":
+                        self._send(200, outer.batcher.stats())
+                    else:
+                        self._send(404, {"error": "try /v1/stats or "
+                                                  "POST /v1/infer"})
+                except BrokenPipeError:  # pragma: no cover - client gone
+                    pass
+
+            def do_POST(self):  # noqa: N802 - stdlib API
+                try:
+                    if self.path.split("?", 1)[0] != "/v1/infer":
+                        self._send(404, {"error": "POST /v1/infer"})
+                        return
+                    n = int(self.headers.get("Content-Length") or 0)
+                    try:
+                        body = json.loads(self.rfile.read(n) or b"{}")
+                    except ValueError:
+                        self._send(400, {"error": "invalid JSON"})
+                        return
+                    if "inputs" not in body:
+                        self._send(400, {"error": "missing 'inputs'"})
+                        return
+                    out = outer.infer_detailed(
+                        body["inputs"], body.get("deadline_ms"))
+                    self._send(out.pop("_code"), out,
+                               retry_after=out.pop("_retry_after", None))
+                except BrokenPipeError:  # pragma: no cover - client gone
+                    pass
+                except Exception as exc:  # noqa: BLE001 - keep serving
+                    try:
+                        self._send(500, {"error": str(exc)})
+                    except Exception:  # pragma: no cover
+                        pass
+
+        self._httpd = ThreadingHTTPServer((addr, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- ingest
+    def infer_detailed(self, inputs, deadline_ms=None) -> dict:
+        """One request end-to-end; returns a JSON-able dict carrying the
+        HTTP status in ``_code`` (shared by the HTTP handler and tests)."""
+        b = self.batcher
+        try:
+            req = b.submit(inputs, deadline_ms=deadline_ms)
+        except QueueFull:
+            return {"_code": 429, "_retry_after": 1,
+                    "error": "queue full",
+                    "queue_depth": b.stats()["queue_depth"]}
+        except Draining:
+            return {"_code": 503, "error": "draining"}
+        ttl = (b.deadline_ms if deadline_ms is None
+               else float(deadline_ms)) / 1000.0
+        try:
+            result = req.wait(timeout=ttl + 0.25)
+        except Exception as exc:  # noqa: BLE001 - routed per-request error
+            code = 504 if "expired" in str(exc) or "within" in str(exc) \
+                else 500
+            return {"_code": code, "error": str(exc)}
+        outputs = result.tolist() if hasattr(result, "tolist") else result
+        return {"_code": 200, "outputs": outputs,
+                "latency_ms": round(
+                    (req.completed_at - req.enqueued_at) * 1e3, 3)}
+
+    def infer(self, inputs, deadline_ms=None):
+        """In-process convenience: result or raised error."""
+        out = self.infer_detailed(inputs, deadline_ms=deadline_ms)
+        if out["_code"] != 200:
+            raise RuntimeError(f"infer failed ({out['_code']}): "
+                               f"{out.get('error')}")
+        return out["outputs"]
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "FrontDoor":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvd-tpu-serve-http",
+            daemon=True)
+        self._thread.start()
+        log.info("serve: front door listening on :%d "
+                 "(POST /v1/infer, GET /v1/stats)", self.port)
+        return self
+
+    def drain(self) -> None:
+        """Cordon this replica: refuse new work, flip ``/ready`` to 503,
+        let queued/in-flight requests complete."""
+        self.batcher.drain()
+        if self._agent is not None:
+            try:
+                self._agent.set_ready(
+                    False, "draining: serve front door cordoned")
+            except Exception:  # noqa: BLE001 - telemetry never blocks
+                pass
+
+    def stop(self) -> None:
+        try:
+            # shutdown() BLOCKS until serve_forever exits — only safe when
+            # start() actually ran; a never-started server just closes.
+            if self._thread is not None:
+                self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:  # noqa: BLE001 - already down
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

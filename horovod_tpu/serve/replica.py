@@ -1,0 +1,142 @@
+"""Replica: the jax-backed half of the serving plane (ISSUE 19).
+
+One :class:`Replica` per process-set member.  Three responsibilities:
+
+- **Weight fan-out** — :meth:`load` broadcasts a parameter pytree from
+  the root rank onto every replica via the collective engine's broadcast
+  path (:func:`~..jax.optimizer.broadcast_parameters` — which rides the
+  hierarchical two-level broadcast when ``HOROVOD_HIERARCHICAL_BROADCAST``
+  is on).  Loads are **version-stamped**: a rolling weight update calls
+  ``load(params, version=v+1)`` and every replica re-broadcasts without a
+  restart, while a redundant re-delivery of the version already serving
+  (``version <= self.version``) is a no-op — the idempotence that makes
+  "push weights to the fleet, retry on any failure" safe.
+- **Bucketed jitted forward** — :meth:`forward` pads a ragged batch up to
+  the batcher's bucket size and runs a per-bucket jitted program, cached
+  in a :class:`~..ops.scheduler.FusedProgramCache` keyed on
+  ``(bucket, per-sample shape, dtype)``.  Parameters are ARGUMENTS to the
+  jitted program, so a weight update never recompiles; batch-size churn
+  only ever compiles ``len(buckets)`` programs (the cache's hit/miss
+  counters prove it, and tests pin it).
+- **Serve loop** — :meth:`serve_loop` is the replica's consumer thread:
+  ``batcher.next_batch() → pad → forward → slice → complete``, with
+  per-batch failures routed back to the callers that sent them rather
+  than killing the loop.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.process_sets import ProcessSet
+from ..ops.scheduler import FusedProgramCache
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+
+class Replica:
+    """One serving replica: versioned weights + bucket-compiled forward."""
+
+    def __init__(self, apply_fn: Callable, process_set:
+                 Optional[ProcessSet] = None, cache_capacity: int = 64):
+        self._apply = apply_fn            # (params, inputs[b, ...]) -> out
+        self.process_set = process_set
+        self.params = None
+        self.version = -1                 # nothing loaded yet
+        self.loads = 0                    # broadcasts actually executed
+        self.cache = FusedProgramCache(capacity=cache_capacity)
+
+    # ------------------------------------------------------------- weights
+    def load(self, params, version: int = 0, root_rank: int = 0):
+        """Fan ``params`` from ``root_rank`` onto every replica and stamp
+        ``version``.  No-op (returns False) when ``version`` does not
+        advance — re-delivering the serving version is free, which is what
+        lets a rolling updater retry blindly."""
+        version = int(version)
+        if version <= self.version:
+            log.debug("serve: load(version=%d) <= serving version %d — "
+                      "no-op", version, self.version)
+            return False
+        from ..jax.optimizer import broadcast_parameters
+        self.params = broadcast_parameters(
+            params, root_rank=root_rank, process_set=self.process_set)
+        self.version = version
+        self.loads += 1
+        log.info("serve: weights version %d broadcast from rank %d "
+                 "(load #%d)", version, root_rank, self.loads)
+        return True
+
+    # ------------------------------------------------------------- forward
+    def _program(self, bucket: int, sample_shape: tuple, dtype):
+        """The per-bucket jitted forward, cached so batch-size churn
+        across requests never recompiles (ISSUE 19 acceptance)."""
+        key = ("serve_forward", int(bucket), tuple(sample_shape),
+               str(dtype))
+        fn, _hit = self.cache.get_or_build2(
+            key, lambda: jax.jit(self._apply))
+        return fn
+
+    def forward(self, inputs) -> np.ndarray:
+        """Run one padded-bucket batch; returns the REAL rows only.
+
+        ``inputs``: array of shape ``[n, *sample]`` with ``n`` anywhere in
+        ``(0, bucket]`` — rows are padded with zeros up to the smallest
+        power-of-two-ish bucket the cache already compiled for."""
+        if self.version < 0:
+            raise RuntimeError("serve: forward before load() — no weights")
+        x = np.asarray(inputs)
+        n = x.shape[0]
+        bucket = self._bucket_for(n)
+        if bucket > n:
+            pad = np.zeros((bucket - n,) + x.shape[1:], dtype=x.dtype)
+            x = np.concatenate([x, pad], axis=0)
+        fn = self._program(bucket, x.shape[1:], x.dtype)
+        out = fn(self.params, jnp.asarray(x))
+        return np.asarray(out)[:n]
+
+    def _bucket_for(self, n: int) -> int:
+        b = 1
+        while b < n:
+            b <<= 1
+        return b
+
+    def forward_batch(self, batch) -> np.ndarray:
+        """Batcher-aware forward: pad to the BATCHER's bucket (its menu,
+        not the local power-of-two fallback) and slice to real rows."""
+        x = np.stack([np.asarray(r.inputs) for r in batch.requests])
+        n = x.shape[0]
+        if batch.bucket > n:
+            pad = np.zeros((batch.bucket - n,) + x.shape[1:], dtype=x.dtype)
+            x = np.concatenate([x, pad], axis=0)
+        fn = self._program(batch.bucket, x.shape[1:], x.dtype)
+        out = fn(self.params, jnp.asarray(x))
+        return np.asarray(out)[:n]
+
+    # ---------------------------------------------------------- serve loop
+    def serve_loop(self, batcher, stop: Optional[threading.Event] = None,
+                   poll_s: float = 0.05) -> int:
+        """Consume ``batcher`` until ``stop`` is set AND the queue drained
+        (or the batcher is draining and empty).  Returns batches served.
+        Per-batch errors are routed to the waiting callers, not raised."""
+        served = 0
+        while True:
+            if stop is not None and stop.is_set() and batcher.pending() == 0:
+                return served
+            batch = batcher.next_batch(timeout=poll_s)
+            if batch is None:
+                if batcher.draining and batcher.pending() == 0:
+                    return served
+                continue
+            try:
+                results = self.forward_batch(batch)
+            except Exception as exc:  # noqa: BLE001 - route, don't die
+                batcher.fail(batch, exc)
+                continue
+            batcher.complete(batch, list(results))
+            served += 1

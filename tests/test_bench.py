@@ -178,6 +178,26 @@ def test_bench_minimal_mode():
     assert zrt["negotiation_us_per_cycle_off"] > 0, zrt
     # ...and the live-engine stats block carries the zero_rtt keys.
     assert "zero_rtt" in out and "spec_hits" in out["zero_rtt"], out.keys()
+    # Serving plane (ISSUE 19) on every line: batched-vs-sequential
+    # bitwise parity through the padded-bucket jitted forward, the
+    # recompile pin under batch-size churn, the p50/p99-vs-offered-load
+    # sweep, the scripted ramp → scale_out → drain scenario with the live
+    # drain contract, and the 13 B warm-frame guard with serving active.
+    srv = out["serving"]
+    assert srv["parity_bitwise"] is True, srv
+    assert srv["batch_churn_bounded"] is True, srv
+    assert len(srv["load_sweep"]) == 3, srv
+    for pt in srv["load_sweep"]:
+        assert pt["offered_qps"] > 0 and pt["achieved_qps"] > 0, pt
+        assert pt["batches"] > 0, pt
+    sc = srv["scenario"]
+    assert sc["scale_out_fired"] is True and sc["drain_fired"] is True, sc
+    assert sc["drain_completed_inflight"] is True, sc
+    assert sc["drain_refused_new"] is True, sc
+    fg = srv["frame_guard"]
+    assert fg["held"] is True, fg
+    assert fg["full_announce_delta"] == 0, fg
+    assert fg["serve_requests_during_window"] > 0, fg
 
 
 def test_bench_default_resnet():

@@ -247,6 +247,101 @@ def test_hier_allgather_rekeys_program_cache(hvd, world_size, sim_slices):
     assert eng.cache.misses == misses0 + 1
 
 
+# ---------------------------------------------------- two-level broadcast
+def test_hier_broadcast_bitwise_parity(hvd, world_size, sim_slices):
+    """Flat and two-level broadcast agree BITWISE (ISSUE 19 satellite:
+    broadcast is pure data movement — the cross-DCN leader exchange then
+    intra-slice fan-out only ever sums the payload with zeros, so every
+    dtype lands identical bits)."""
+    eng = _engine()
+    rng = np.random.RandomState(13)
+    xs = [hvd.stack_per_rank(
+        [rng.randn(*shape).astype(np.float32) * (r + 1)
+         for r in range(world_size)])
+        for shape in ((33,), (4, 5))]
+    flat = [np.asarray(hvd.broadcast(x, root_rank=1, name=f"hbc_f{i}"))
+            for i, x in enumerate(xs)]
+    with sim_slices(eng, 2, world_size // 2):
+        eng.hierarchical_broadcast = True
+        try:
+            d0, i0, c0 = (eng.hier_bcast_dispatches,
+                          eng.hier_bcast_intra_legs,
+                          eng.hier_bcast_cross_legs)
+            hier = [np.asarray(hvd.broadcast(x, root_rank=1,
+                                             name=f"hbc_h{i}"))
+                    for i, x in enumerate(xs)]
+            assert eng.hier_bcast_dispatches == d0 + 2, \
+                "two-level broadcast did not run"
+            assert eng.hier_bcast_intra_legs == i0 + 2
+            assert eng.hier_bcast_cross_legs == c0 + 2
+        finally:
+            eng.hierarchical_broadcast = False
+    for f, h in zip(flat, hier):
+        np.testing.assert_array_equal(f, h)
+
+
+def test_hier_broadcast_cross_slice_root(hvd, world_size, sim_slices):
+    """A root living in the SECOND slice (cross index 1) fans out
+    correctly — the leader-exchange leg is root-relative, not
+    slice-0-relative — and bools survive the int32 psum round-trip."""
+    eng = _engine()
+    root = world_size // 2 + 1                        # inside slice 1
+    vals = hvd.stack_per_rank(
+        [np.array([r, -r, 7 * r], np.int32) for r in range(world_size)])
+    flags = hvd.stack_per_rank(
+        [np.array([r % 2 == 0, r == root], bool)
+         for r in range(world_size)])
+    with sim_slices(eng, 2, world_size // 2):
+        eng.hierarchical_broadcast = True
+        try:
+            d0 = eng.hier_bcast_dispatches
+            out_v = np.asarray(hvd.broadcast(vals, root_rank=root,
+                                             name="hbc_xr_v"))
+            out_f = np.asarray(hvd.broadcast(flags, root_rank=root,
+                                             name="hbc_xr_f"))
+            assert eng.hier_bcast_dispatches == d0 + 2
+        finally:
+            eng.hierarchical_broadcast = False
+    np.testing.assert_array_equal(
+        out_v.reshape(-1)[-3:], np.array([root, -root, 7 * root], np.int32))
+    np.testing.assert_array_equal(
+        out_f.reshape(-1)[-2:], np.array([root % 2 == 0, True]))
+
+
+def test_hier_broadcast_knob_off_stays_flat(hvd, world_size, sim_slices):
+    """With slices derivable but HOROVOD_HIERARCHICAL_BROADCAST unset,
+    broadcast dispatches FLAT."""
+    eng = _engine()
+    x = _int_stacked(hvd, world_size, shape=(16,), seed=24)
+    with sim_slices(eng, 2, world_size // 2):
+        assert eng.hierarchical_broadcast is False
+        d0 = eng.hier_bcast_dispatches
+        hvd.broadcast(x, root_rank=0, name="hbc_off")
+        assert eng.hier_bcast_dispatches == d0, "knob off but bcast hier"
+
+
+def test_hier_broadcast_rekeys_program_cache(hvd, world_size, sim_slices):
+    """The flat-vs-hier broadcast decision keys the program cache: one
+    program per mode for the same shapes, neither cross-served, and the
+    knob flip itself costs zero control-plane bytes (fusion-key-only,
+    same contract the allreduce/allgather verdicts pinned)."""
+    eng = _engine()
+    x = _int_stacked(hvd, world_size, shape=(64,), seed=25)
+    hvd.broadcast(x, root_rank=0, name="hbck")        # flat program
+    misses0 = eng.cache.misses
+    with sim_slices(eng, 2, world_size // 2):
+        eng.hierarchical_broadcast = True
+        try:
+            hvd.broadcast(x, root_rank=0, name="hbck")  # hier program
+            assert eng.cache.misses == misses0 + 1
+            hvd.broadcast(x, root_rank=0, name="hbck")  # warm hier hit
+            assert eng.cache.misses == misses0 + 1
+        finally:
+            eng.hierarchical_broadcast = False
+    hvd.broadcast(x, root_rank=0, name="hbck")        # flat again: warm
+    assert eng.cache.misses == misses0 + 1
+
+
 # ------------------------------------------------- non-uniform slice map
 def test_nonuniform_slice_map_falls_back_once(hvd):
     """A non-uniform HOROVOD_SLICE_MAP must not silently disable the

@@ -562,6 +562,37 @@ importlib.import_module('horovod_tpu.elastic.rendezvous')
 # churn runner and the bench — and the chunk items it hands the engine
 # come from the (already covered) jax-free ops/scheduler.
 importlib.import_module('horovod_tpu.elastic.stateplane')
+# Serving plane (ISSUE 19): the REAL serve package surface (the Replica
+# loads lazily via PEP 562 — the lazy __init__ IS the thing under test),
+# plus a behavioral pass through the continuous batcher: admission,
+# padded-bucket formation, deadline expiry, backpressure.
+serve = importlib.import_module('horovod_tpu.serve')
+importlib.import_module('horovod_tpu.serve.batcher')
+importlib.import_module('horovod_tpu.serve.frontdoor')
+clock = [0.0]
+bt = serve.ContinuousBatcher(max_batch=4, deadline_ms=100.0,
+                             max_inflight=1, queue_depth=3,
+                             clock=lambda: clock[0])
+r1 = bt.submit([1]); r2 = bt.submit([2]); r3 = bt.submit([3])
+try:
+    bt.submit([4])
+    raise AssertionError('queue_depth=3 admitted a 4th request')
+except serve.QueueFull:
+    pass
+batch = bt.next_batch(timeout=0.0)
+assert batch.size == 3 and batch.bucket == 4, (batch.size, batch.bucket)
+assert bt.next_batch(timeout=0.0) is None      # in-flight window full
+bt.complete(batch, [[10], [20], [30]])
+assert r1.wait(0.0) == [10] and r3.wait(0.0) == [30]
+r4 = bt.submit([5])
+clock[0] = 1.0                                  # past the 100ms deadline
+assert bt.next_batch(timeout=0.0) is None
+try:
+    r4.wait(0.0)
+    raise AssertionError('expired request returned a result')
+except serve.DeadlineExceeded:
+    pass
+assert serve.parse_buckets('2,4', 8) == (2, 4, 8)
 print('PURITY_OK')
 """
 

@@ -657,6 +657,39 @@ def test_torovodrun_full_sharding_hierarchical():
         f"stderr:\n{res.stderr[-3000:]}")
 
 
+WORKER_SERVE = os.path.join(REPO, "tests", "data", "worker_serve.py")
+
+
+def test_torovodrun_serving():
+    """ISSUE 19 acceptance: the data-parallel serving plane across real
+    processes — version-stamped weight fan-out over the collective
+    broadcast path (rank 1 starts from zeros, ends bitwise identical;
+    re-delivery is a no-op; a rolling update re-broadcasts without
+    restart), batched-vs-sequential forward bitwise parity with the
+    per-bucket program cache pinned, the serving-mode ScalePolicy's
+    scripted ramp → scale_out → drain sequence, and the drain contract
+    under live load (in-flight requests complete, new admissions
+    refused).  Assertions live in the worker."""
+    res = _run_torovodrun(2, WORKER_SERVE, timeout=300)
+    ok = res.stdout.count("SERVE_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
+def test_torovodrun_serving_hierarchical():
+    """The same serving acceptance through the two-level control plane:
+    the per-host agent aggregates the broadcast fan-out's warm-path
+    frames exactly like allreduce's — fan-out parity, the version-stamp
+    no-op and the drain contract must all hold behind an agent."""
+    res = _run_torovodrun(2, WORKER_SERVE, timeout=300,
+                          extra_args=("--hierarchical-controller",))
+    ok = res.stdout.count("SERVE_OK")
+    assert res.returncode == 0 and ok == 2, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+
+
 WORKER_MONITOR = os.path.join(REPO, "tests", "data", "worker_monitor.py")
 
 
