@@ -765,6 +765,121 @@ def bench_serving(errors=None):
     return out
 
 
+def bench_serving_faults(errors=None):
+    """Serving-plane fault-tolerance bench (ISSUE 20, docs/serving.md):
+    an injected replica fault mid-batch under concurrent load through
+    the REAL front door — three claims on every JSON line:
+
+    - **zero lost accepted requests** — every request admitted before,
+      during and after the fault gets exactly one terminal response; the
+      interrupted batch re-enters via front-door retries under the same
+      request ids and completes correctly (``zero_lost``).
+    - **availability** — terminal-200 fraction stays 1.0 across the
+      fault (retryable failures are retried, never surfaced), plus the
+      retry/requeue/fault counter deltas the recovery produced.
+    - **recovery-time-to-ready** — wall time from the injected fault to
+      the first completed post-heal batch, while the simulated heal
+      window holds the dispatch loop down.
+
+    Jax-free (scripted echo worker — the serving math is pinned in
+    ``bench_serving``; this section isolates the RECOVERY plane).
+    Rank-0 only, self-contained."""
+    if os.environ.get("HOROVOD_RANK", "0") not in ("", "0"):
+        return None
+    import threading as _threading
+
+    import numpy as np
+
+    from horovod_tpu.serve.batcher import ContinuousBatcher
+    from horovod_tpu.serve.frontdoor import FrontDoor
+    from horovod_tpu.serve.resilience import CircuitBreaker
+
+    t_section = time.perf_counter()
+    n_req = int(os.environ.get("HVD_BENCH_SERVE_FAULT_REQS", "32"))
+    fault_at = 3                       # fail the 3rd dispatched batch
+    heal_s = 0.15                      # simulated re-rendezvous window
+
+    b = ContinuousBatcher(max_batch=4, buckets=(4,), deadline_ms=10000.0,
+                          max_inflight=1, queue_depth=2 * n_req)
+    # Breaker effectively disabled: one bucket of simultaneous retryable
+    # failures must RETRY, not fast-fail (the breaker's own behaviour is
+    # pinned in tests/test_serve_faults.py).
+    door = FrontDoor(b, retries=4, hedge_ms=0.0,
+                     breaker=CircuitBreaker(threshold=10000))
+
+    state = {"batches": 0, "t_fault": None, "t_ready": None}
+    stop = _threading.Event()
+
+    def worker():                      # echo replica: route 2x back
+        while not stop.is_set():
+            batch = b.next_batch(timeout=0.01)
+            if batch is None:
+                continue
+            state["batches"] += 1
+            if state["batches"] == fault_at:
+                # The chaos moment: a peer died mid-batch.  Fail THIS
+                # batch retryably (queued requests keep their deadlines)
+                # and hold the loop down for the heal window.
+                state["t_fault"] = time.perf_counter()
+                b.fail_retryable(
+                    batch, RuntimeError("injected replica fault (bench)"))
+                time.sleep(heal_s)
+                continue
+            b.complete(batch, [np.asarray(r.inputs) * 2.0
+                               for r in batch.requests])
+            if state["t_fault"] is not None and state["t_ready"] is None:
+                state["t_ready"] = time.perf_counter()
+
+    th = _threading.Thread(target=worker, daemon=True)
+    th.start()
+    outcomes = [None] * n_req
+    correct = [False] * n_req
+
+    def client(i):
+        x = np.full(4, float(i), np.float32)
+        o = door.infer_detailed(x, deadline_ms=10000.0,
+                                request_id=f"bench-fault-{i}")
+        if o["_code"] == 200:
+            correct[i] = bool(np.array_equal(
+                np.asarray(o["outputs"], np.float32), x * 2.0))
+        outcomes[i] = o
+
+    clients = [_threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(n_req)]
+    for c in clients:
+        c.start()
+    for c in clients:
+        c.join(timeout=60)
+    stop.set()
+    th.join(timeout=5)
+
+    st = door.stats()
+    lost = sum(1 for o in outcomes if o is None)
+    ok = sum(1 for o in outcomes if o is not None and o["_code"] == 200)
+    retried = sum(1 for o in outcomes
+                  if o is not None and o.get("attempts", 1) > 1)
+    out = {
+        "requests": n_req,
+        "lost_requests": lost,
+        "ok_responses": ok,
+        "retried_requests": retried,
+        "results_correct": bool(ok == n_req and all(correct)),
+        "replica_faults": st["replica_faults_total"],
+        "requeued": st["requeued_total"],
+        "retries_total": st["retries_total"],
+        "quarantined": st["quarantined_total"],
+        "availability": st["availability"],
+        "error_budget_remaining": st["error_budget_remaining"],
+        "recovery_to_ready_s": (
+            None if state["t_fault"] is None or state["t_ready"] is None
+            else round(state["t_ready"] - state["t_fault"], 4)),
+        "zero_lost": bool(lost == 0 and ok == n_req),
+    }
+    _record_timing("serving_faults", warmup=0, iters=n_req,
+                   wall_s=time.perf_counter() - t_section)
+    return out
+
+
 def bench_restore_ab(errors=None, world=4, mb=None):
     """Resilient-state-plane restore A/B (ISSUE 14): wall time to recover
     a joiner's state from the DISK manifest (newest complete epoch, all
@@ -2709,6 +2824,10 @@ def _run(out, errors):
         except Exception as exc:  # noqa: BLE001 - contained
             errors["serving"] = repr(exc)
         try:
+            out["serving_faults"] = bench_serving_faults(errors=errors)
+        except Exception as exc:  # noqa: BLE001 - contained
+            errors["serving_faults"] = repr(exc)
+        try:
             out["restore_ab"] = bench_restore_ab(errors=errors)
         except Exception as exc:  # noqa: BLE001 - contained
             errors["restore_ab"] = repr(exc)
@@ -2858,6 +2977,11 @@ def _run(out, errors):
         out["serving"] = bench_serving(errors=errors)
     except Exception as exc:  # noqa: BLE001 - contained
         errors["serving"] = repr(exc)
+
+    try:
+        out["serving_faults"] = bench_serving_faults(errors=errors)
+    except Exception as exc:  # noqa: BLE001 - contained
+        errors["serving_faults"] = repr(exc)
 
     try:
         out["restore_ab"] = bench_restore_ab(errors=errors)

@@ -351,6 +351,23 @@ class Config:
     serve_deadline_ms: float = 1000.0
     serve_max_inflight: int = 0
     serve_queue_depth: int = 128
+    # Serving fault tolerance (ISSUE 20) — ALL serve-local: consumed by
+    # the front door / batcher on this rank only, never negotiated, zero
+    # bytes on the warm control-plane frame.  serve_retries bounds the
+    # front door's deadline-charged retry loop for RETRYABLE failures;
+    # serve_hedge_ms > 0 arms tail-latency hedging (the value is the
+    # cold-start delay until an observed p99 exists); the breaker trips
+    # after serve_breaker_threshold consecutive retryable failures,
+    # fast-fails 503 + Retry-After for serve_breaker_reset_s, then
+    # half-opens and closes after serve_breaker_probes good probes;
+    # serve_quarantine_after consecutive forward failures of ONE request
+    # fail it terminally (poisoned input, not replica fault).
+    serve_retries: int = 2
+    serve_hedge_ms: float = 0.0
+    serve_breaker_threshold: int = 5
+    serve_breaker_reset_s: float = 5.0
+    serve_breaker_probes: int = 2
+    serve_quarantine_after: int = 3
 
     autotune: bool = False
     autotune_log: str = ""
@@ -451,6 +468,12 @@ class Config:
             serve_deadline_ms=_env_float("SERVE_DEADLINE_MS", 1000.0),
             serve_max_inflight=_env_int("SERVE_MAX_INFLIGHT", 0),
             serve_queue_depth=_env_int("SERVE_QUEUE_DEPTH", 128),
+            serve_retries=_env_int("SERVE_RETRIES", 2),
+            serve_hedge_ms=_env_float("SERVE_HEDGE_MS", 0.0),
+            serve_breaker_threshold=_env_int("SERVE_BREAKER_THRESHOLD", 5),
+            serve_breaker_reset_s=_env_float("SERVE_BREAKER_RESET_S", 5.0),
+            serve_breaker_probes=_env_int("SERVE_BREAKER_PROBES", 2),
+            serve_quarantine_after=_env_int("SERVE_QUARANTINE_AFTER", 3),
             autotune=_env_bool("AUTOTUNE", False),
             autotune_log=_env("AUTOTUNE_LOG", "") or "",
             autotune_warmup_samples=_env_int("AUTOTUNE_WARMUP_SAMPLES", 3),

@@ -94,7 +94,14 @@ def merged_percentile(hists, q: float) -> Optional[float]:
     over the side-channel).  Buckets merge by upper bound — every rank
     publishes the same serving-latency buckets, so the cumulative counts
     add directly; interpolation inside the crossing bucket matches
-    ``registry.Histogram.percentile``.  None until anything observed."""
+    ``registry.Histogram.percentile``.  None until anything observed.
+
+    The empty contract is AUDITED to match the local path exactly
+    (ISSUE 20: the front door's hedging delay reads a p99 at startup,
+    before any traffic, through either path): no snapshots, all-empty
+    snapshots, and count-without-finite-buckets snapshots all return
+    ``None`` here and from ``Histogram.percentile`` alike — never 0.0,
+    never a crash."""
     merged: Dict[float, int] = {}
     total = 0
     for h in hists:

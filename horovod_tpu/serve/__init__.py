@@ -24,10 +24,12 @@ Knob table (``HOROVOD_SERVE_*``) lives in ``common/config.py`` and
 """
 
 from .batcher import (  # noqa: F401  (jax-free re-exports)
-    Batch, ContinuousBatcher, DeadlineExceeded, Draining, QueueFull,
-    Request, parse_buckets,
+    Batch, Cancelled, ContinuousBatcher, DeadlineExceeded, Draining,
+    ForwardFailed, QueueFull, ReplicaFaulted, Request, RequestQuarantined,
+    Retryable, parse_buckets,
 )
 from .frontdoor import FrontDoor  # noqa: F401
+from .resilience import CircuitBreaker  # noqa: F401
 
 # Lazily-loaded jax-backed replica layer (serve/replica.py imports jax).
 _REPLICA_ATTRS = ("Replica",)

@@ -31,6 +31,7 @@ bucketing and backpressure deterministically.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from typing import Callable, List, Optional, Sequence, Tuple
@@ -60,6 +61,38 @@ class DeadlineExceeded(RuntimeError):
     stopped waiting).  The front door maps this to HTTP 504."""
 
 
+class Retryable(RuntimeError):
+    """Base for failures the front door may transparently retry: the
+    request itself is fine, the attempt died underneath it.  Retries are
+    deadline-bounded — backoff is charged against the request's original
+    deadline, never extended past it."""
+
+
+class ReplicaFaulted(Retryable):
+    """The replica lost a peer mid-batch (HVD303 / clean LEAVE race).
+    The batch's requests are failed with this so the front door can
+    re-submit them once the surviving world re-rendezvouses.  Maps to
+    HTTP 503 + ``Retry-After`` when retries are exhausted."""
+
+
+class ForwardFailed(Retryable):
+    """One forward execution failed (injected I/O fault, transient device
+    error).  Retryable until quarantine decides the request itself is the
+    problem.  Maps to HTTP 500 when retries are exhausted."""
+
+
+class RequestQuarantined(RuntimeError):
+    """Terminal: this request failed ``quarantine_after`` consecutive
+    forwards — the input is treated as poisoned and is never re-batched
+    (one bad request must not wedge the replica).  Maps to HTTP 500."""
+
+
+class Cancelled(RuntimeError):
+    """The request was cancelled before dispatch (a hedge whose twin
+    finished first).  Never surfaces to HTTP: the winner's response is
+    the terminal one."""
+
+
 def parse_buckets(spec: str, max_batch: int) -> Tuple[int, ...]:
     """Bucket menu from ``HOROVOD_SERVE_BUCKETS`` (comma-separated sizes);
     empty spec → powers of two up to ``max_batch``.  Always sorted, always
@@ -83,12 +116,14 @@ def parse_buckets(spec: str, max_batch: int) -> Tuple[int, ...]:
 class Request:
     """One in-flight inference request; ``wait()`` is the caller's side."""
 
-    __slots__ = ("id", "inputs", "deadline", "enqueued_at", "_event",
-                 "result", "error", "completed_at")
+    __slots__ = ("id", "key", "inputs", "deadline", "enqueued_at", "_event",
+                 "result", "error", "completed_at", "_callbacks", "_cb_lock")
     _ids = itertools.count()
 
-    def __init__(self, inputs, deadline: float, enqueued_at: float):
+    def __init__(self, inputs, deadline: float, enqueued_at: float,
+                 key: Optional[str] = None):
         self.id = next(Request._ids)
+        self.key = key if key is not None else f"req-{self.id}"
         self.inputs = inputs
         self.deadline = deadline
         self.enqueued_at = enqueued_at
@@ -96,9 +131,29 @@ class Request:
         self.result = None
         self.error: Optional[BaseException] = None
         self.completed_at: Optional[float] = None
+        self._callbacks: List[Callable[["Request"], None]] = []
+        self._cb_lock = threading.Lock()
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    def on_done(self, cb: Callable[["Request"], None]) -> None:
+        """Register ``cb(request)`` to run when this request settles;
+        fires immediately if it already has (the hedging race is between
+        registration and settlement)."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(cb)
+                return
+        cb(self)
+
+    def _fire_settled(self) -> List[Callable[["Request"], None]]:
+        """Flip the settled event and drain the callback list atomically;
+        the batcher invokes the returned callbacks outside its own lock."""
+        with self._cb_lock:
+            self._event.set()
+            cbs, self._callbacks = self._callbacks, []
+        return cbs
 
     def wait(self, timeout: Optional[float] = None):
         """Block until the replica settles this request; returns the
@@ -136,6 +191,7 @@ class ContinuousBatcher:
                  buckets: Optional[Sequence[int]] = None,
                  deadline_ms: float = 1000.0, max_inflight: int = 2,
                  queue_depth: int = 128, registry=None,
+                 quarantine_after: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.max_batch = max(1, int(max_batch))
         if buckets:
@@ -148,10 +204,24 @@ class ContinuousBatcher:
         self.max_inflight = max(1, int(max_inflight))
         self.queue_depth = max(1, int(queue_depth))
         self._clock = clock
+        if quarantine_after is None:
+            try:
+                quarantine_after = int(os.environ.get(
+                    "HOROVOD_SERVE_QUARANTINE_AFTER", "") or 3)
+            except ValueError:
+                quarantine_after = 3
+        self.quarantine_after = max(1, int(quarantine_after))
         self._cv = threading.Condition()
         self._queue: List[Request] = []
         self._inflight = 0
         self._draining = False
+        # Idempotent re-submission: request-id -> live (unsettled) Request.
+        # A front-door retry that races its own earlier attempt gets the
+        # resident request back instead of double-executing it.
+        self._resident: dict = {}
+        # Poisoned-request quarantine: request-id -> consecutive forward
+        # failures.  Reset on success, terminal at quarantine_after.
+        self._fail_counts: dict = {}
         # Telemetry: real registry metrics when the monitor is up, cheap
         # stand-ins otherwise — the batcher never imports jax either way.
         if registry is None:
@@ -176,20 +246,50 @@ class ContinuousBatcher:
             "hvd_serve_queue_depth", "requests awaiting dispatch")
         self._g_inflight = registry.gauge(
             "hvd_serve_inflight", "dispatched, unsettled batches")
+        self._m_resubmitted = registry.counter(
+            "hvd_serve_resubmitted_total",
+            "idempotent re-submissions joined to a resident request")
+        self._m_quarantined = registry.counter(
+            "hvd_serve_quarantined_total",
+            "requests failed terminally by the poisoned-request quarantine")
+        self._m_replica_faults = registry.counter(
+            "hvd_serve_replica_faults_total",
+            "batches failed retryably by a replica peer fault")
+        self._m_requeued = registry.counter(
+            "hvd_serve_requeued_total",
+            "queued requests preserved (original deadlines) across a "
+            "replica fault")
+        self._m_cancelled = registry.counter(
+            "hvd_serve_cancelled_total",
+            "queued requests cancelled before dispatch (hedge losers)")
 
     # ----------------------------------------------------------- admission
-    def submit(self, inputs, deadline_ms: Optional[float] = None) -> Request:
-        """Admit one request or refuse loudly (QueueFull / Draining)."""
+    def submit(self, inputs, deadline_ms: Optional[float] = None,
+               request_id: Optional[str] = None) -> Request:
+        """Admit one request or refuse loudly (QueueFull / Draining).
+
+        ``request_id`` makes admission idempotent: a re-submission under
+        an id that is still resident (queued or in a dispatched batch)
+        returns the EXISTING request instead of double-executing it — the
+        front door's retry path leans on this so a retry that races its
+        own not-yet-settled attempt joins it rather than forking it."""
         now = self._clock()
         ttl = self.deadline_ms if deadline_ms is None else float(deadline_ms)
-        req = Request(inputs, deadline=now + ttl / 1000.0, enqueued_at=now)
         with self._cv:
+            if request_id is not None:
+                live = self._resident.get(request_id)
+                if live is not None and not live.done():
+                    self._m_resubmitted.inc()
+                    return live
             if self._draining:
                 raise Draining("replica is draining; not accepting work")
             if len(self._queue) >= self.queue_depth:
                 self._m_rejected.inc()
                 raise QueueFull(
                     f"ingest queue at depth {self.queue_depth}")
+            req = Request(inputs, deadline=now + ttl / 1000.0,
+                          enqueued_at=now, key=request_id)
+            self._resident[req.key] = req
             self._queue.append(req)
             self._m_requests.inc()
             self._g_queue.set(len(self._queue))
@@ -255,10 +355,14 @@ class ContinuousBatcher:
         req.result = result
         req.error = error
         req.completed_at = self._clock()
-        if error is None:
-            self._m_latency.observe(
-                (req.completed_at - req.enqueued_at) * 1e3)
-        req._event.set()
+        with self._cv:   # RLock: safe under _expire_locked's held _cv
+            self._resident.pop(req.key, None)
+            if error is None:
+                self._fail_counts.pop(req.key, None)
+                self._m_latency.observe(
+                    (req.completed_at - req.enqueued_at) * 1e3)
+        for cb in req._fire_settled():
+            cb(req)
 
     def complete(self, batch: Batch, results: Sequence) -> None:
         """Route ``results`` back by position; frees one window slot."""
@@ -273,20 +377,89 @@ class ContinuousBatcher:
             self._cv.notify_all()
 
     def fail(self, batch: Batch, error: BaseException) -> None:
+        """Fail every request in ``batch`` with a typed error, charging
+        the poisoned-request quarantine: each consecutive forward failure
+        under the same request id counts toward ``quarantine_after``, at
+        which point the request is failed TERMINALLY
+        (:class:`RequestQuarantined`) instead of retryably — a re-submitted
+        poisoned input cannot wedge the replica into failing every batch
+        it rides in."""
         for req in batch.requests:
-            self._settle(req, error=error)
+            with self._cv:
+                n = self._fail_counts.get(req.key, 0) + 1
+                if n >= self.quarantine_after:
+                    self._fail_counts.pop(req.key, None)
+                    self._m_quarantined.inc()
+                    routed: BaseException = RequestQuarantined(
+                        f"request {req.key}: {n} consecutive forward "
+                        f"failures (last: {error}); quarantined")
+                else:
+                    self._fail_counts[req.key] = n
+                    # Bound the book-keeping: a failed request that is
+                    # never re-submitted must not leak its count forever.
+                    while len(self._fail_counts) > 4 * self.queue_depth:
+                        self._fail_counts.pop(
+                            next(iter(self._fail_counts)))
+                    routed = ForwardFailed(
+                        f"request {req.key}: forward failed "
+                        f"(consecutive failure {n}): {error}")
+                routed.__cause__ = error
+            self._settle(req, error=routed)
         with self._cv:
             self._inflight -= 1
             self._g_inflight.set(self._inflight)
             self._cv.notify_all()
 
+    def fail_retryable(self, batch: Batch,
+                       cause: Optional[BaseException] = None) -> None:
+        """Replica-fault path: a peer died mid-batch.  The dispatched
+        batch's requests are failed with :class:`ReplicaFaulted` — a
+        RETRYABLE verdict that does NOT charge the quarantine (the fault
+        is the world's, not the request's) — while everything still
+        queued is left untouched with its ORIGINAL deadline for the
+        re-armed serve loop to dispatch after re-rendezvous."""
+        for req in batch.requests:
+            routed = ReplicaFaulted(
+                f"request {req.key}: replica fault mid-batch "
+                f"({cause if cause is not None else 'peer lost'}); "
+                f"retryable")
+            if cause is not None:
+                routed.__cause__ = cause
+            self._settle(req, error=routed)
+        with self._cv:
+            self._m_replica_faults.inc()
+            self._m_requeued.inc(len(self._queue))
+            self._inflight -= 1
+            self._g_inflight.set(self._inflight)
+            self._cv.notify_all()
+
+    def cancel(self, req: Request) -> bool:
+        """Cancel a request that is still QUEUED (a hedge whose twin won):
+        removed from the queue and settled with :class:`Cancelled`.
+        Returns False — and does nothing — once the request was dispatched
+        or settled; an in-flight hedge loser just finishes and its result
+        is discarded by the caller."""
+        with self._cv:
+            if req.done() or req not in self._queue:
+                return False
+            self._queue.remove(req)
+            self._m_cancelled.inc()
+            self._g_queue.set(len(self._queue))
+            self._settle(req, error=Cancelled(
+                f"request {req.key}: cancelled before dispatch"))
+            self._cv.notify_all()
+        return True
+
     # -------------------------------------------------------------- drain
     def drain(self) -> None:
         """Stop admitting; queued work still dispatches and settles (the
         elastic drain contract: in-flight requests COMPLETE, new ones are
-        refused)."""
+        refused).  Queued requests whose deadlines have ALREADY expired
+        are failed promptly here — dead-on-arrival work completing as a
+        late 504 at dispatch time would waste the drain window."""
         with self._cv:
             self._draining = True
+            self._expire_locked()
             self._cv.notify_all()
 
     @property
@@ -297,6 +470,12 @@ class ContinuousBatcher:
     def pending(self) -> int:
         with self._cv:
             return len(self._queue) + self._inflight
+
+    def latency_percentile(self, q: float):
+        """Observed request-latency percentile in ms — ``None`` until the
+        first success lands (the hedging delay reads this at startup and
+        must fall back to its knob, not crash)."""
+        return self._m_latency.percentile(q)
 
     def stats(self) -> dict:
         with self._cv:
@@ -310,6 +489,11 @@ class ContinuousBatcher:
                 "expired_total": self._m_expired.value,
                 "batches_total": self._m_batches.value,
                 "padding_rows_total": self._m_padding.value,
+                "resubmitted_total": self._m_resubmitted.value,
+                "quarantined_total": self._m_quarantined.value,
+                "replica_faults_total": self._m_replica_faults.value,
+                "requeued_total": self._m_requeued.value,
+                "cancelled_total": self._m_cancelled.value,
                 "latency_p50_ms": self._m_latency.percentile(0.5),
                 "latency_p99_ms": self._m_latency.percentile(0.99),
             }

@@ -22,19 +22,38 @@ One :class:`Replica` per process-set member.  Three responsibilities:
   ``batcher.next_batch() → pad → forward → slice → complete``, with
   per-batch failures routed back to the callers that sent them rather
   than killing the loop.
+
+Fault tolerance (ISSUE 20): a PEER death mid-batch — the forward rides
+collectives in model-parallel serving, and even data-parallel replicas
+negotiate the versioned ``load()`` fan-out — surfaces as a typed
+:class:`~..common.exceptions.PeerFailureError` (or a clean
+:class:`~..common.exceptions.PeerLeftInterrupt`), or as the device
+collective failing underneath XLA first when the data plane wins the
+race.  :meth:`serve_loop` resolves either against the engine's
+control-plane verdict, fails the interrupted batch RETRYABLY
+(:meth:`~.batcher.ContinuousBatcher.fail_retryable` — queued requests
+keep their original deadlines), and RE-RAISES the typed error so the
+worker's elastic wrapper can re-rendezvous and re-arm the loop; the
+versioned ``load()`` re-broadcast after heal is a rank-local no-op on
+survivors.  Anything else is an application bug in one forward: routed
+to that batch's callers (who may retry into the quarantine budget), the
+loop keeps serving.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from ..common.process_sets import ProcessSet
 from ..ops.scheduler import FusedProgramCache
+from ..testing import faults as _faults
 from ..utils.logging import get_logger
 
 log = get_logger()
@@ -106,9 +125,25 @@ class Replica:
             b <<= 1
         return b
 
+    def _rank(self) -> int:
+        from ..common import basics
+        try:
+            if basics.is_initialized():
+                return basics.rank()
+        except Exception:  # noqa: BLE001 - single-process serving
+            pass
+        return 0
+
     def forward_batch(self, batch) -> np.ndarray:
         """Batcher-aware forward: pad to the BATCHER's bucket (its menu,
         not the local power-of-two fallback) and slice to real rows."""
+        if _faults.armed():
+            # Serving chaos verbs (replica_crash / forward_fault /
+            # slow_replica) fire HERE — mid-batch, after dispatch, before
+            # results route back.  Zero cost unarmed: one module-flag
+            # check per BATCH, never per request, never on the control
+            # plane.
+            _faults.fire("serve_forward", self._rank())
         x = np.stack([np.asarray(r.inputs) for r in batch.requests])
         n = x.shape[0]
         if batch.bucket > n:
@@ -119,11 +154,48 @@ class Replica:
         return np.asarray(out)[:n]
 
     # ---------------------------------------------------------- serve loop
+    def _peer_fault_verdict(self, exc, grace_s: float):
+        """Resolve one forward failure against the control plane.
+
+        A dying peer races two planes: the typed HVD303 abort (control)
+        and the in-flight device collective failing underneath XLA (data).
+        Typed errors ARE the verdict; for anything else, wait up to
+        ``grace_s`` for the engine's fault latch to converge — confirmed
+        means "the world died", unconfirmed means "this forward is buggy"
+        (an application error the quarantine budget handles)."""
+        if isinstance(exc, (HorovodInternalError, HostsUpdatedInterrupt)):
+            return exc
+        try:
+            from ..common import basics
+            if not basics.is_initialized():
+                return None
+            eng = basics._get_state().engine
+        except Exception:  # noqa: BLE001 - no engine, no verdict
+            return None
+        deadline = time.monotonic() + max(0.0, grace_s)
+        while True:
+            fault = getattr(eng, "fault", None)
+            if fault is not None:
+                return fault
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.05)
+
     def serve_loop(self, batcher, stop: Optional[threading.Event] = None,
-                   poll_s: float = 0.05) -> int:
+                   poll_s: float = 0.05, fault_grace_s: float = 0.0) -> int:
         """Consume ``batcher`` until ``stop`` is set AND the queue drained
         (or the batcher is draining and empty).  Returns batches served.
-        Per-batch errors are routed to the waiting callers, not raised."""
+
+        Per-batch APPLICATION errors are routed to the waiting callers
+        (``batcher.fail`` — retryable until quarantined), not raised.  A
+        PEER FAULT mid-batch fails the interrupted batch retryably,
+        leaves queued requests untouched with their original deadlines,
+        and re-raises the typed error: the caller re-rendezvouses through
+        the elastic path, re-arms via the versioned ``load()`` and runs
+        ``serve_loop`` again over the same batcher.  ``fault_grace_s``
+        bounds how long an untyped forward failure may wait for the
+        control plane's verdict before being treated as an application
+        bug (0 = one immediate check)."""
         served = 0
         while True:
             if stop is not None and stop.is_set() and batcher.pending() == 0:
@@ -135,7 +207,17 @@ class Replica:
                 continue
             try:
                 results = self.forward_batch(batch)
-            except Exception as exc:  # noqa: BLE001 - route, don't die
+            except Exception as exc:  # noqa: BLE001 - resolved below
+                verdict = self._peer_fault_verdict(exc, fault_grace_s)
+                if verdict is not None:
+                    log.warning(
+                        "serve: peer fault mid-batch (%s) — %d request(s) "
+                        "failed retryably, %d queued preserved; "
+                        "re-rendezvous required",
+                        type(verdict).__name__, batch.size,
+                        batcher.pending() - 1)
+                    batcher.fail_retryable(batch, verdict)
+                    raise verdict from exc
                 batcher.fail(batch, exc)
                 continue
             batcher.complete(batch, list(results))

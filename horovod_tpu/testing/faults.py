@@ -69,8 +69,28 @@ POINTS = ("connect", "pre_announce", "round_send", "mid_round_exit",
           #                      econnreset/crash model peer death
           #                      mid-restore (the joiner re-fetches from
           #                      another survivor or falls back to disk)
-          "ckpt_write_fail", "ckpt_torn", "restore_peer_exit")
+          "ckpt_write_fail", "ckpt_torn", "restore_peer_exit",
+          # Serving plane (ISSUE 20, serve/replica.py): fired once per
+          # dispatched BATCH, mid-batch — after the batcher handed the
+          # requests over, before results route back.  Usually armed
+          # through the serving sugar verbs below rather than spelled
+          # out.
+          "serve_forward")
 ACTIONS = ("crash", "hang", "delay_ms", "econnreset", "io_error")
+
+# Serving chaos sugar (ISSUE 20): operator-facing spellings that expand
+# to serve_forward faults.
+#
+#     replica_crash:<rank>@<nth>     unclean death mid-batch on the nth
+#                                    dispatched batch (also accepts ':'
+#                                    as the separator)
+#     forward_fault:<rank>:<nth>     the nth forward raises an injected
+#                                    I/O error (retryable at the front
+#                                    door; consecutive repeats feed the
+#                                    quarantine)
+#     slow_replica:<rank>:<delay_ms> EVERY forward stalls delay_ms
+#                                    (persistent; the hedging target)
+SERVE_VERBS = ("replica_crash", "forward_fault", "slow_replica")
 
 # Bounded "forever": long enough to trip any reasonable deadline, short
 # enough that a leaked daemon thread cannot outlive a CI job by much.
@@ -90,7 +110,11 @@ class FaultSpec:
 
     @classmethod
     def parse(cls, text: str) -> "FaultSpec":
-        parts = text.strip().split(":")
+        text = text.strip()
+        head = text.split(":", 1)[0].split("@", 1)[0]
+        if head in SERVE_VERBS:
+            return cls._parse_serving(text)
+        parts = text.split(":")
         if len(parts) not in (3, 4):
             raise ValueError(
                 f"{ENV_VAR} must be <point>:<rank>:<action>[:<nth>], "
@@ -118,6 +142,53 @@ class FaultSpec:
             raise ValueError(f"{ENV_VAR}: nth must be >= 0, got {nth}")
         return cls(point=point, rank=int(rank_s), action=action, arg=arg,
                    nth=nth)
+
+    @classmethod
+    def _parse_serving(cls, text: str) -> "FaultSpec":
+        """Expand a serving sugar verb into its serve_forward spec."""
+        parts = text.replace("@", ":").split(":")
+        verb = parts[0]
+        try:
+            rank = int(parts[1])
+            if rank < 0:
+                raise ValueError
+        except (IndexError, ValueError):
+            raise ValueError(
+                f"{ENV_VAR}: {verb} needs a non-negative rank, "
+                f"got {text!r}") from None
+        if verb == "slow_replica":
+            # slow_replica:<rank>:<delay_ms> — persistent (every batch).
+            if len(parts) != 3:
+                raise ValueError(
+                    f"{ENV_VAR}: slow_replica must be "
+                    f"slow_replica:<rank>:<delay_ms>, got {text!r}")
+            try:
+                delay = float(parts[2])
+                if delay < 0:
+                    raise ValueError
+            except ValueError:
+                raise ValueError(
+                    f"{ENV_VAR}: slow_replica delay_ms must be a "
+                    f"non-negative number, got {text!r}") from None
+            return cls(point="serve_forward", rank=rank, action="delay_ms",
+                       arg=delay, nth=0)
+        # replica_crash:<rank>@<nth> / forward_fault:<rank>:<nth>
+        # (nth optional, default 1; nth=0 = persistent like the base
+        # grammar).
+        if len(parts) not in (2, 3):
+            raise ValueError(
+                f"{ENV_VAR}: {verb} must be {verb}:<rank>[@<nth>], "
+                f"got {text!r}")
+        try:
+            nth = int(parts[2]) if len(parts) == 3 else 1
+            if nth < 0:
+                raise ValueError
+        except ValueError:
+            raise ValueError(
+                f"{ENV_VAR}: {verb} nth must be >= 0, got {text!r}") \
+                from None
+        action = "crash" if verb == "replica_crash" else "io_error"
+        return cls(point="serve_forward", rank=rank, action=action, nth=nth)
 
 
 _lock = threading.Lock()

@@ -1,0 +1,236 @@
+"""Serving-plane fault-tolerance acceptance worker (ISSUE 20's scripted
+chaos scenario).  Launched under the ELASTIC driver (two single-slot
+local "hosts") with ``HVD_TPU_FAULT=replica_crash:1@3``: rank 1 dies
+UNCLEANLY (os._exit) inside its 3rd dispatched batch — mid-batch, after
+dispatch, before results route back — while both replicas are serving a
+ramp of 24 concurrent front-door requests.
+
+The hard invariant under test: every ACCEPTED request gets exactly one
+terminal response, and the retried ones are BITWISE identical to their
+single-request references.  Scripted flow:
+
+- ramp: 24 concurrent clients POST through ``FrontDoor.infer_detailed``
+  (retries + idempotent request ids), queued before the first dispatch
+  so both ranks form the same deterministic 6 x full-bucket schedule;
+- every dispatched batch rides an allreduce-of-zeros liveness probe:
+  sum of zeros is world-size invariant (results stay bitwise identical
+  after the world shrinks), but it makes each batch a COLLECTIVE
+  participant, so rank 1's crash surfaces in the survivor's serve loop
+  as a typed peer fault (or the data-plane gloo failure the verdict
+  poll resolves) instead of staying invisible to a purely local forward;
+- the survivor's ``serve_loop`` fails the interrupted batch RETRYABLY
+  (queued requests keep their original deadlines — ``requeued_total``
+  pins that), re-raises the typed verdict, and the worker heals through
+  the elastic path: ``shutdown() → init()`` re-rendezvouses into the
+  shrunk world, the versioned ``load()`` re-arm is a rank-local no-op,
+  and the SAME batcher resumes serving;
+- the interrupted batch's requests re-enter via front-door retries
+  (attempts == 2, same request id), complete bitwise-identical to the
+  per-request references, and ZERO accepted requests are lost.
+
+Launched by test_multiprocess.py::test_torovodrun_serving_fault_recovery
+under BOTH control planes (flat and --hierarchical-controller); the
+proof is the result file the survivor writes.
+"""
+
+import json
+import os
+import threading
+import time
+
+# One rank per process, one CPU device each; gloo for cross-process XLA
+# collectives (same preamble as worker_serve.py).
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+from horovod_tpu.common.exceptions import (
+    HorovodInternalError, HostsUpdatedInterrupt,
+)
+from horovod_tpu.serve.batcher import ContinuousBatcher
+from horovod_tpu.serve.frontdoor import FrontDoor
+from horovod_tpu.serve.replica import Replica
+from horovod_tpu.serve.resilience import CircuitBreaker
+
+RESULT = os.environ.get("FAULT_RESULT", "")
+NREQ = 24                 # 6 full buckets per rank
+BUCKET = 4                # single-bucket menu: every batch, pre- and
+                          # post-heal, runs the SAME jitted program, so
+                          # all results are bitwise-comparable
+DEADLINE_MS = 90000.0     # generous: the heal is charged against it
+
+
+def _write_result(payload: dict):
+    tmp = RESULT + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, RESULT)   # atomic: the test never reads a torn file
+
+
+def apply_fn(params, x):
+    return x @ params["w"] + params["b"]
+
+
+def weights(seed):
+    rng = np.random.RandomState(seed)
+    return {"w": rng.randn(16, 8).astype(np.float32),
+            "b": rng.randn(8).astype(np.float32)}
+
+
+class ProbedReplica(Replica):
+    """Replica whose ``forward_batch`` rides a liveness probe: an
+    allreduce of zeros before the local forward.  World-size invariant
+    (0 + 0 == 0 == 0), so the serving math is untouched by the heal —
+    but a dead peer now fails the batch instead of going unnoticed."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.probes = 0
+
+    def forward_batch(self, batch):
+        self.probes += 1
+        probe = hvd.allreduce(np.zeros(1, np.float32),
+                              name=f"serve.sync.{self.probes}", op=hvd.Sum)
+        np.testing.assert_array_equal(
+            np.asarray(hvd.to_local(probe)).reshape(1),
+            np.zeros(1, np.float32))
+        return super().forward_batch(batch)
+
+
+def main():
+    hvd.init()
+    rank = hvd.rank()
+    assert hvd.size() == 2, hvd.size()
+
+    # Weight fan-out: rank 0 owns the tree, rank 1 ends bitwise identical.
+    rep = ProbedReplica(apply_fn)
+    v1 = weights(1) if rank == 0 else \
+        {"w": np.zeros((16, 8), np.float32), "b": np.zeros(8, np.float32)}
+    assert rep.load(v1, version=1) is True
+
+    batcher = ContinuousBatcher(max_batch=BUCKET, buckets=(BUCKET,),
+                                deadline_ms=DEADLINE_MS, max_inflight=1,
+                                queue_depth=64)
+    # Breaker effectively disabled: 4 simultaneous retryable failures
+    # must RETRY, not fast-fail — the breaker's own state machine is
+    # pinned in the jax-free tier (tests/test_serve_faults.py).
+    door = FrontDoor(batcher, retries=4, hedge_ms=0.0,
+                     breaker=CircuitBreaker(threshold=10000))
+
+    # Per-request references through the SAME bucket-4 program the
+    # serving batches use (Replica.forward pads 4 rows onto bucket 4):
+    # row i alone must equal row i co-batched, before or after the heal.
+    x = np.random.RandomState(7).randn(NREQ, 16).astype(np.float32)
+    ref = []
+    for i in range(NREQ):
+        alone = np.zeros((BUCKET, 16), np.float32)
+        alone[0] = x[i]
+        ref.append(rep.forward(alone)[0])
+    ref = np.stack(ref)
+
+    # ---- ramp: 24 concurrent clients through the front door -------------
+    outcomes = [None] * NREQ
+
+    def client(i):
+        outcomes[i] = door.infer_detailed(
+            x[i], deadline_ms=DEADLINE_MS, request_id=f"req-{i}")
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(NREQ)]
+    for t in threads:
+        t.start()
+    # Admission barrier: every request queued before the first dispatch,
+    # so both ranks form the same 6 x full-bucket schedule and the probe
+    # allreduces stay lock-step until the scripted crash.
+    t0 = time.monotonic()
+    while batcher.pending() < NREQ:
+        assert time.monotonic() - t0 < 60, batcher.pending()
+        time.sleep(0.005)
+
+    stop = threading.Event()
+
+    def watcher():      # cordon the loop once every client is terminal
+        for t in threads:
+            t.join()
+        stop.set()
+
+    threading.Thread(target=watcher, daemon=True).start()
+
+    # ---- serve; heal through the elastic path on the scripted crash -----
+    # Rank 1 os._exit(13)s inside its 3rd batch (after its probe): the
+    # survivor's 4th probe fails, serve_loop fails THAT batch retryably,
+    # preserves the queued two buckets, and re-raises the typed verdict.
+    faults_caught = []
+    batches = 0
+    t_fault = t_ready = None
+    while True:
+        try:
+            batches += rep.serve_loop(batcher, stop=stop, poll_s=0.05,
+                                      fault_grace_s=10.0)
+            break
+        except (HorovodInternalError, HostsUpdatedInterrupt) as verdict:
+            t_fault = time.monotonic()
+            faults_caught.append([type(verdict).__name__,
+                                  list(getattr(verdict, "dead_ranks", []))])
+            # Re-rendezvous into the shrunk generation over the surviving
+            # host set, then re-arm: re-delivering the serving version is
+            # a rank-local no-op on survivors — no broadcast, no restart.
+            basics.shutdown()
+            basics.init()
+            assert rep.load(rep.params, version=rep.version) is False
+            assert rep.loads == 1, rep.loads
+            t_ready = time.monotonic()
+    # Only the survivor gets here (rank 1 died inside forward_batch).
+
+    for t in threads:
+        t.join(timeout=120)
+    lost = sum(1 for o in outcomes if o is None)
+    assert lost == 0, f"{lost} accepted request(s) got no terminal response"
+    codes = sorted({o["_code"] for o in outcomes})
+    assert codes == [200], [o for o in outcomes if o["_code"] != 200]
+
+    # Bitwise: every response — first-attempt, queued-across-the-heal and
+    # retried alike — equals its single-request reference.
+    got = np.stack([np.asarray(o["outputs"], np.float32) for o in outcomes])
+    np.testing.assert_array_equal(got, ref)
+
+    # Exactly the interrupted bucket retried (same ids, second attempt);
+    # the two queued buckets were PRESERVED, not failed.
+    retried = [o for o in outcomes if o["attempts"] > 1]
+    assert len(retried) == BUCKET, [o["attempts"] for o in outcomes]
+    assert all(o["attempts"] == 2 for o in retried), retried
+    st = door.stats()
+    assert faults_caught and st["replica_faults_total"] == 1, \
+        (faults_caught, st)
+    assert st["requeued_total"] == 2 * BUCKET, st
+    assert st["retries_total"] == BUCKET, st
+    assert st["quarantined_total"] == 0, st
+    assert st["responses_ok_total"] == NREQ, st
+    assert st["responses_error_total"] == 0, st
+    assert st["availability"] == 1.0, st
+    assert hvd.size() == 1, hvd.size()
+
+    _write_result({
+        "ok": True, "lost": lost, "retried": len(retried),
+        "batches": batches, "final_size": hvd.size(),
+        "faults": faults_caught,
+        "requeued": st["requeued_total"],
+        "availability": st["availability"],
+        "recovery_s": round(t_ready - t_fault, 3),
+    })
+    print("SERVE_FAULTS_OK", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    assert RESULT, "FAULT_RESULT must point at a writable path"
+    main()

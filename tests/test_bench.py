@@ -198,6 +198,21 @@ def test_bench_minimal_mode():
     assert fg["held"] is True, fg
     assert fg["full_announce_delta"] == 0, fg
     assert fg["serve_requests_during_window"] > 0, fg
+    # Serving fault tolerance (ISSUE 20) on every line: an injected
+    # replica fault mid-batch under concurrent front-door load must lose
+    # ZERO accepted requests (every one gets exactly one terminal 200,
+    # bitwise-correct, the interrupted bucket via retries), availability
+    # stays 1.0, and recovery-time-to-ready is recorded.
+    sf = out["serving_faults"]
+    assert sf["zero_lost"] is True, sf
+    assert sf["lost_requests"] == 0, sf
+    assert sf["ok_responses"] == sf["requests"], sf
+    assert sf["results_correct"] is True, sf
+    assert sf["replica_faults"] == 1 and sf["retried_requests"] > 0, sf
+    assert sf["quarantined"] == 0, sf
+    assert sf["availability"] == 1.0, sf
+    assert sf["recovery_to_ready_s"] is not None \
+        and sf["recovery_to_ready_s"] < 30, sf
 
 
 def test_bench_default_resnet():

@@ -149,6 +149,54 @@ def test_fault_spec_nth_zero_is_persistent():
         faults.disarm()
 
 
+def test_fault_spec_serving_verbs_expand_to_serve_forward():
+    """ISSUE 20: the serving chaos sugar — replica_crash / forward_fault /
+    slow_replica — normalizes onto the serve_forward point with the right
+    action, nth gate and persistence."""
+    s = faults.FaultSpec.parse("replica_crash:1@3")
+    assert (s.point, s.rank, s.action, s.nth) == ("serve_forward", 1,
+                                                  "crash", 3)
+    # ':' works as the separator too, and nth defaults to 1.
+    assert faults.FaultSpec.parse("replica_crash:0:2").nth == 2
+    assert faults.FaultSpec.parse("replica_crash:0").nth == 1
+    s = faults.FaultSpec.parse("forward_fault:0:2")
+    assert (s.point, s.action, s.nth) == ("serve_forward", "io_error", 2)
+    # slow_replica is PERSISTENT (every batch) — the hedging target.
+    s = faults.FaultSpec.parse("slow_replica:1:250")
+    assert (s.point, s.action, s.arg, s.nth) == ("serve_forward",
+                                                 "delay_ms", 250.0, 0)
+
+
+@pytest.mark.parametrize("bad", [
+    "replica_crash",              # no rank
+    "replica_crash:x@1",          # non-integer rank
+    "replica_crash:-1@1",         # negative rank
+    "replica_crash:1@-2",         # negative nth
+    "forward_fault:1:2:3",        # too many fields
+    "slow_replica:1",             # missing delay
+    "slow_replica:1:abc",         # non-numeric delay
+    "slow_replica:1:-5",          # negative delay
+])
+def test_fault_spec_serving_verbs_reject(bad):
+    with pytest.raises(ValueError):
+        faults.FaultSpec.parse(bad)
+
+
+def test_serving_fault_fires_like_base_grammar():
+    """The sugar arms the same machinery: forward_fault raises the
+    injected OSError into the serve_forward arrival, nth-gated."""
+    faults.arm("forward_fault:0:2")
+    try:
+        faults.fire("serve_forward", 0)           # arrival 1: pass
+        assert not faults.fired()
+        with pytest.raises(OSError, match="injected I/O fault"):
+            faults.fire("serve_forward", 0)       # arrival 2: fires
+        assert faults.fired()
+        faults.fire("serve_forward", 0)           # one-shot: pass again
+    finally:
+        faults.disarm()
+
+
 def test_fire_is_noop_when_unarmed_and_rank_gated():
     assert not faults.armed()
     faults.fire("round_send", 0)          # no spec: must be a no-op

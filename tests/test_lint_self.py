@@ -103,6 +103,18 @@ def test_self_lint_covers_fault_harness():
     assert not findings, "\n".join(f.render() for f in findings)
 
 
+def test_self_lint_covers_serving_plane():
+    """Explicit coverage for the serving plane (ISSUES 19/20): the
+    batcher, replica loop, front door, and circuit breaker carry the
+    fault-tolerance invariants and must parse and lint clean."""
+    sv_dir = os.path.join(REPO, "horovod_tpu", "serve")
+    files = {f for f in os.listdir(sv_dir) if f.endswith(".py")}
+    assert {"batcher.py", "replica.py", "frontdoor.py",
+            "resilience.py"} <= files, files
+    findings = lint_paths([sv_dir])
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
 # ------------------------------------------------- whole-package gate (13)
 _GATE_RESULT = []      # memo: the full-repo analysis runs once per session
 

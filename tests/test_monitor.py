@@ -593,6 +593,30 @@ try:
 except serve.DeadlineExceeded:
     pass
 assert serve.parse_buckets('2,4', 8) == (2, 4, 8)
+# Serving fault tolerance (ISSUE 20): the resilience module and the
+# retry/breaker/quarantine surface are all front-door-side — jax-free by
+# construction — and the behavioral pass walks the breaker state machine
+# plus the retryable/terminal error taxonomy.
+importlib.import_module('horovod_tpu.serve.resilience')
+br = serve.CircuitBreaker(threshold=2, reset_s=5.0, probes=1,
+                          clock=lambda: clock[0])
+assert br.allow() and br.state == 'closed'
+br.record_failure(); br.record_failure()
+assert br.state == 'open' and not br.allow()
+clock[0] += 5.0
+assert br.allow() and br.state == 'half_open'
+br.record_success()
+assert br.state == 'closed'
+assert issubclass(serve.ReplicaFaulted, serve.Retryable)
+assert issubclass(serve.ForwardFailed, serve.Retryable)
+assert not issubclass(serve.RequestQuarantined, serve.Retryable)
+bq = serve.ContinuousBatcher(max_batch=1, deadline_ms=1000.0,
+                             quarantine_after=2, clock=lambda: clock[0])
+assert bq.submit([1], request_id='a') is bq.submit([1], request_id='a')
+bq.fail(bq.next_batch(timeout=0.0), RuntimeError('x'))
+bq.submit([1], request_id='a')
+bq.fail(bq.next_batch(timeout=0.0), RuntimeError('x'))
+assert bq.stats()['quarantined_total'] == 1
 print('PURITY_OK')
 """
 

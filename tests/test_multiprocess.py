@@ -690,6 +690,62 @@ def test_torovodrun_serving_hierarchical():
         f"stderr:\n{res.stderr[-3000:]}")
 
 
+WORKER_SERVE_FAULTS = os.path.join(REPO, "tests", "data",
+                                   "worker_serve_faults.py")
+
+
+@pytest.mark.parametrize("controller", ["flat", "hierarchical"])
+def test_torovodrun_serving_fault_recovery(tmp_path, controller):
+    """ISSUE 20 acceptance (the scripted chaos scenario, both control
+    planes): under the elastic driver, HVD_TPU_FAULT=replica_crash:1@3
+    kills rank 1 uncleanly inside its 3rd dispatched batch while 24
+    concurrent front-door requests are in flight.  The survivor's serve
+    loop fails the interrupted batch RETRYABLY, preserves the queued
+    buckets with their original deadlines, re-raises the typed verdict,
+    heals through the elastic path (re-rendezvous + no-op versioned
+    re-arm), and the SAME batcher resumes: the interrupted requests
+    re-enter via front-door retries and complete BITWISE identical to
+    their per-request references — zero accepted requests lost, exactly
+    one terminal response each.  The proof is the result file the
+    survivor writes; the driver exits 0."""
+    import json
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("localhost:1\n127.0.0.1:1\n")
+    result = tmp_path / "serve_fault_result.json"
+    env = dict(os.environ)
+    other_paths = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + other_paths)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("HOROVOD_TIMELINE", None)
+    env.update({
+        "FAULT_RESULT": str(result),
+        "HVD_TPU_FAULT": "replica_crash:1@3",
+        "HOROVOD_ROUND_TIMEOUT_S": "30",
+    })
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+           "--host-discovery-script", f"cat {hostfile}",
+           "--min-np", "1", "--max-np", "2"]
+    if controller == "hierarchical":
+        cmd.append("--hierarchical-controller")
+    cmd += [sys.executable, WORKER_SERVE_FAULTS]
+    res = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=300)
+    assert res.returncode == 0, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-3000:]}\n"
+        f"stderr:\n{res.stderr[-3000:]}")
+    assert result.exists(), res.stdout[-3000:]
+    data = json.loads(result.read_text())
+    assert data["ok"], data
+    assert data["lost"] == 0, data
+    assert data["retried"] == 4, data            # the interrupted bucket
+    assert data["requeued"] == 8, data           # the two preserved ones
+    assert data["availability"] == 1.0, data
+    assert data["final_size"] == 1, data
+    assert data["faults"], data
+    assert data["recovery_s"] < 60, data
+
+
 WORKER_MONITOR = os.path.join(REPO, "tests", "data", "worker_monitor.py")
 
 
