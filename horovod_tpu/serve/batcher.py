@@ -34,6 +34,7 @@ import itertools
 import os
 import threading
 import time
+from collections import OrderedDict
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from ..utils.logging import get_logger
@@ -221,7 +222,9 @@ class ContinuousBatcher:
         self._resident: dict = {}
         # Poisoned-request quarantine: request-id -> consecutive forward
         # failures.  Reset on success, terminal at quarantine_after.
-        self._fail_counts: dict = {}
+        # Ordered by last UPDATE so the size bound evicts stale entries,
+        # never the count of a request actively being retried.
+        self._fail_counts: OrderedDict = OrderedDict()
         # Telemetry: real registry metrics when the monitor is up, cheap
         # stand-ins otherwise — the batcher never imports jax either way.
         if registry is None:
@@ -395,11 +398,13 @@ class ContinuousBatcher:
                         f"failures (last: {error}); quarantined")
                 else:
                     self._fail_counts[req.key] = n
+                    self._fail_counts.move_to_end(req.key)
                     # Bound the book-keeping: a failed request that is
                     # never re-submitted must not leak its count forever.
+                    # Least-recently-UPDATED goes first, so a request
+                    # mid-retry never loses its streak to the bound.
                     while len(self._fail_counts) > 4 * self.queue_depth:
-                        self._fail_counts.pop(
-                            next(iter(self._fail_counts)))
+                        self._fail_counts.popitem(last=False)
                     routed = ForwardFailed(
                         f"request {req.key}: forward failed "
                         f"(consecutive failure {n}): {error}")

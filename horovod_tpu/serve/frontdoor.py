@@ -151,6 +151,7 @@ class FrontDoor:
             "fraction of the SLO error budget left (negative = blown)")
         self._g_avail.set(1.0)
         self._g_budget.set(1.0)
+        self._breaker_sync_lock = threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -233,6 +234,11 @@ class FrontDoor:
                 "breaker": self.breaker.state, "retryable": True})
 
         attempts = {"n": 0}
+        # Did any attempt deliver a breaker verdict?  Terminal outcomes
+        # that say nothing about replica health (deadline, queue full,
+        # drain, quarantine) must RELEASE an admitted half-open probe
+        # slot instead of leaking it — see the finally below.
+        verdict = {"recorded": False}
 
         def attempt():
             attempts["n"] += 1
@@ -250,9 +256,11 @@ class FrontDoor:
             try:
                 winner, result = self._await(req, rid)
             except Retryable:
+                verdict["recorded"] = True
                 self.breaker.record_failure()
                 self._sync_breaker_gauge()
                 raise
+            verdict["recorded"] = True
             self.breaker.record_success()
             self._sync_breaker_gauge()
             return winner, result
@@ -300,6 +308,10 @@ class FrontDoor:
                 else 500
             return self._finish({
                 "_code": code, "request_id": rid, "error": str(exc)})
+        finally:
+            if not verdict["recorded"]:
+                self.breaker.release_probe()
+                self._sync_breaker_gauge()
         outputs = result.tolist() if hasattr(result, "tolist") else result
         return self._finish({
             "_code": 200, "outputs": outputs, "request_id": rid,
@@ -319,7 +331,7 @@ class FrontDoor:
         remaining = max(0.0, req.deadline - self._clock())
         delay_s = self._hedge_delay_s(remaining)
         if delay_s is None:
-            return req, req.wait(timeout=remaining + 0.25)
+            return req, self._wait_or_cancel(req, remaining + 0.25)
         try:
             return req, req.wait(timeout=delay_s)
         except DeadlineExceeded:
@@ -331,7 +343,7 @@ class FrontDoor:
                              request_id=rid + ".hedge")
         except (QueueFull, Draining):
             # No room to hedge — keep waiting on the primary.
-            return req, req.wait(timeout=remaining + 0.25)
+            return req, self._wait_or_cancel(req, remaining + 0.25)
         self._m_hedges.inc()
         settled = threading.Event()
         req.on_done(lambda _r: settled.set())
@@ -348,13 +360,28 @@ class FrontDoor:
         elif hedge.done():
             winner, loser = hedge, req
         else:
+            # Terminal timeout: cancel BOTH twins, not just the hedge —
+            # a primary left resident would absorb a client re-submission
+            # under the same id (submit joins resident entries, ignoring
+            # the fresh deadline) and doom it to another 504.
             b.cancel(hedge)
+            b.cancel(req)
             raise DeadlineExceeded(
                 f"request {rid}: no result within {remaining:.3f}s")
         if winner is hedge:
             self._m_hedge_wins.inc()
         b.cancel(loser)
         return winner, winner.wait(0)
+
+    def _wait_or_cancel(self, req, timeout_s: float):
+        """``req.wait`` that cancels the request on ITS OWN timeout, so a
+        timed-out-but-still-queued request does not stay resident to
+        swallow a client re-submission under the same id."""
+        try:
+            return req.wait(timeout=timeout_s)
+        except DeadlineExceeded:
+            self.batcher.cancel(req)
+            raise
 
     def _hedge_delay_s(self, remaining_s: float) -> Optional[float]:
         if self.hedge_ms <= 0:
@@ -377,10 +404,14 @@ class FrontDoor:
 
     # ---------------------------------------------------------- telemetry
     def _sync_breaker_gauge(self) -> None:
-        self._g_breaker.set(self.breaker.state_code())
-        trips = self.breaker.trips
-        while self._m_breaker_open.value < trips:
-            self._m_breaker_open.inc()
+        # One lock around the read-then-inc: two handler threads racing
+        # the naive `while value < trips: inc()` loop would both observe
+        # the gap and over-count a Counter that can never be corrected.
+        with self._breaker_sync_lock:
+            self._g_breaker.set(self.breaker.state_code())
+            delta = self.breaker.trips - self._m_breaker_open.value
+            if delta > 0:
+                self._m_breaker_open.inc(delta)
 
     def _finish(self, out: dict) -> dict:
         """Classify the terminal response into the availability gauges.

@@ -16,7 +16,13 @@ Classic three-state machine, deliberately minimal:
   window); the clock then half-opens it.
 - **half-open** — up to ``probes`` requests are admitted as probes.
   ``probes`` consecutive successes close the breaker (the replica
-  healed); ANY failure re-opens it for a fresh ``reset_s``.
+  healed); ANY failure re-opens it for a fresh ``reset_s``.  A probe
+  that terminates with NEITHER verdict (deadline blown, queue full,
+  drain race, quarantine — the request's problem, not the replica's)
+  must give its slot back via :meth:`~CircuitBreaker.release_probe`;
+  as a backstop, probe slots idle for ``reset_s`` are reclaimed by the
+  clock so an abandoned probe can never wedge the breaker half-open
+  with ``allow()`` refusing forever.
 
 State changes are observable: ``state_code()`` feeds the
 ``hvd_serve_breaker_state`` gauge (0=closed, 1=open, 2=half-open) and
@@ -55,6 +61,7 @@ class CircuitBreaker:
         self._successes = 0         # consecutive, while half-open
         self._opened_at = 0.0
         self._probes_out = 0        # admitted-but-unresolved half-open probes
+        self._probe_activity_at = 0.0   # last half-open admit/resolve time
         self.trips = 0              # lifetime closed/half-open -> open count
 
     # ------------------------------------------------------------- queries
@@ -89,6 +96,7 @@ class CircuitBreaker:
             if self._probes_out >= self.probes:
                 return False
             self._probes_out += 1
+            self._probe_activity_at = self._clock()
             return True
 
     def record_success(self) -> None:
@@ -96,6 +104,7 @@ class CircuitBreaker:
             self._tick_locked()
             if self._state == HALF_OPEN:
                 self._probes_out = max(0, self._probes_out - 1)
+                self._probe_activity_at = self._clock()
                 self._successes += 1
                 if self._successes >= self.probes:
                     self._state = CLOSED
@@ -119,6 +128,20 @@ class CircuitBreaker:
                     self._trip_locked()
             # OPEN: late losers of an already-tripped window change nothing.
 
+    def release_probe(self) -> None:
+        """Give back a half-open probe slot whose request terminated with
+        NEITHER verdict — deadline blown, queue full, drain race,
+        quarantine: the request's problem, not the replica's, so it says
+        nothing about heal.  Without the release, ``probes`` such
+        outcomes would pin ``_probes_out`` at the cap and ``allow()``
+        would refuse forever (504-on-probe is the COMMON case while the
+        replica is still re-rendezvousing)."""
+        with self._lock:
+            self._tick_locked()
+            if self._state == HALF_OPEN and self._probes_out > 0:
+                self._probes_out -= 1
+                self._probe_activity_at = self._clock()
+
     # ------------------------------------------------------------ internal
     def _trip_locked(self) -> None:
         self._state = OPEN
@@ -129,8 +152,18 @@ class CircuitBreaker:
         self.trips += 1
 
     def _tick_locked(self) -> None:
-        if self._state == OPEN and \
-                self._clock() - self._opened_at >= self.reset_s:
+        now = self._clock()
+        if self._state == OPEN and now - self._opened_at >= self.reset_s:
             self._state = HALF_OPEN
             self._successes = 0
             self._probes_out = 0
+            self._probe_activity_at = now
+        elif self._state == HALF_OPEN and self._probes_out > 0 and \
+                self.reset_s > 0 and \
+                now - self._probe_activity_at >= self.reset_s:
+            # Backstop for a probe holder that died without releasing:
+            # a slot idle past reset_s is reclaimed so half-open can
+            # never wedge with allow() refusing forever.
+            self._probes_out = 0
+            self._successes = 0
+            self._probe_activity_at = now

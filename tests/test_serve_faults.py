@@ -102,6 +102,44 @@ def test_breaker_half_open_failure_reopens_fresh_window():
     assert br.retry_after_s() == pytest.approx(2.0)
 
 
+def test_breaker_release_probe_frees_the_slot():
+    """A probe that ends with NEITHER verdict (deadline, queue full,
+    drain, quarantine) must give its slot back — otherwise `probes` such
+    outcomes wedge the breaker half-open with allow() refusing forever."""
+    clk = _Clock()
+    br = CircuitBreaker(threshold=1, reset_s=2.0, probes=2, clock=clk)
+    br.record_failure()
+    clk.tick(2.0)
+    assert br.allow() and br.allow()             # both probe slots out
+    assert not br.allow()
+    br.release_probe()                           # e.g. probe hit its 504
+    assert br.state == HALF_OPEN
+    assert br.allow()                            # slot usable again
+    br.release_probe()
+    br.release_probe()                           # extra releases: clamped
+    assert br.allow() and br.allow()
+    assert not br.allow()
+    # While closed, release_probe is a no-op.
+    br2 = CircuitBreaker(threshold=3, clock=_Clock())
+    br2.release_probe()
+    assert br2.state == CLOSED and br2.allow()
+
+
+def test_breaker_abandoned_probes_reclaimed_by_clock():
+    """Backstop: even if a probe holder dies without releasing, slots
+    idle past reset_s are reclaimed — there is a time-based escape from
+    half-open, never a permanent wedge."""
+    clk = _Clock()
+    br = CircuitBreaker(threshold=1, reset_s=2.0, probes=1, clock=clk)
+    br.record_failure()
+    clk.tick(2.0)
+    assert br.allow()                            # probe out, never resolved
+    assert not br.allow()
+    clk.tick(2.0)                                # slot idle for reset_s
+    assert br.state == HALF_OPEN
+    assert br.allow()                            # reclaimed, not wedged
+
+
 # ------------------------------------------------------- batcher fault API
 
 
@@ -147,6 +185,28 @@ def test_quarantine_success_resets_the_count():
         b.submit(1.0, request_id="flaky")
         b.complete(b.next_batch(timeout=0.1), [2.0])   # success: reset
     assert b.stats()["quarantined_total"] == 0
+
+
+def test_quarantine_count_survives_unrelated_traffic_under_bound():
+    """The _fail_counts size bound evicts least-recently-UPDATED entries:
+    a poisoned request actively being retried keeps its streak even when
+    unrelated failing traffic churns the table past the bound."""
+    b = ContinuousBatcher(max_batch=1, deadline_ms=60000.0,
+                          quarantine_after=3, queue_depth=1)  # bound = 4
+
+    def _fail_once(rid):
+        r = b.submit(1.0, request_id=rid)
+        b.fail(b.next_batch(timeout=0.1), RuntimeError("boom"))
+        return r
+
+    _fail_once("poison")                         # count 1, oldest inserted
+    _fail_once("u1")
+    _fail_once("poison")                         # count 2, moved to end
+    for rid in ("u2", "u3", "u4"):               # churn past the bound
+        _fail_once(rid)
+    r = _fail_once("poison")                     # 3rd consecutive: terminal
+    assert isinstance(r.error, RequestQuarantined), r.error
+    assert b.stats()["quarantined_total"] == 1
 
 
 def test_fail_retryable_preserves_queue_with_original_deadlines():
@@ -334,6 +394,75 @@ def test_front_door_breaker_trips_and_fast_fails_then_heals():
     finally:
         stop.set()
         door.stop()
+
+
+def test_front_door_probe_504_releases_slot_and_breaker_still_heals():
+    """The common heal race: half-open probes time out to 504 while the
+    replica is still re-rendezvousing.  Those probes carry no breaker
+    verdict — their slots must be RELEASED, so once the replica is back
+    the next requests are admitted as probes and close the breaker,
+    instead of allow() refusing forever."""
+    b = ContinuousBatcher(max_batch=4, deadline_ms=2000.0)
+    breaker = CircuitBreaker(threshold=1, reset_s=0.05, probes=2)
+    door = _door(b, retries=0, breaker=breaker)
+    stop = _consume(b, lambda batch, n: b.fail_retryable(
+        batch, RuntimeError("replica faulted")))
+    try:
+        assert door.infer_detailed(1.0)["_code"] == 503   # trips (thr=1)
+        stop.set()                               # replica gone: no consumer
+        time.sleep(0.06)                         # window over: half-open
+        # Both probe slots burn out as 504s (nobody serves the queue).
+        for _ in range(2):
+            out = door.infer_detailed(1.0, deadline_ms=30.0)
+            assert out["_code"] == 504, out
+        assert door.stats()["breaker_state"] == "half_open"
+        # Healed: probes must be admitted (slots were released) and
+        # close the breaker — the wedge would 503 here forever.
+        stop = _consume(b, lambda batch, n: b.complete(
+            batch, [r.inputs for r in batch.requests]))
+        for _ in range(2):
+            assert door.infer_detailed(7.0)["_code"] == 200
+        assert door.stats()["breaker_state"] == "closed"
+    finally:
+        stop.set()
+        door.stop()
+
+
+def test_timed_out_request_is_cancelled_not_left_resident():
+    """A 504'd request must not stay resident: a client retry under the
+    same id with fresh deadline budget gets a FRESH request, not a join
+    onto the doomed expired one."""
+    b = ContinuousBatcher(max_batch=4, deadline_ms=2000.0)
+    door = _door(b, retries=0)
+    # Phase 1: nobody consumes — the request times out to 504 and is
+    # cancelled out of the queue (not left resident).
+    out = door.infer_detailed(1.0, deadline_ms=40.0, request_id="rid-x")
+    assert out["_code"] == 504, out
+    assert b.stats()["queue_depth"] == 0         # cancelled, not resident
+    # Phase 2: replica serves again — the SAME id with fresh deadline
+    # budget succeeds instead of joining the expired resident entry.
+    stop = _consume(b, lambda batch, n: b.complete(
+        batch, [r.inputs * 2 for r in batch.requests]))
+    try:
+        out = door.infer_detailed(4.0, deadline_ms=2000.0,
+                                  request_id="rid-x")
+        assert out["_code"] == 200 and out["outputs"] == 8.0, out
+    finally:
+        stop.set()
+        door.stop()
+
+
+def test_hedge_timeout_cancels_both_twins():
+    """On overall hedge timeout the PRIMARY is cancelled along with the
+    hedge twin, releasing the resident entry for re-submission."""
+    b = ContinuousBatcher(max_batch=1, deadline_ms=2000.0, max_inflight=4)
+    door = _door(b, retries=0, hedge_ms=15.0)
+    out = door.infer_detailed(3.0, deadline_ms=80.0, request_id="rid-h")
+    assert out["_code"] == 504, out
+    s = b.stats()
+    assert s["queue_depth"] == 0, s              # neither twin left queued
+    assert s["cancelled_total"] == 2, s          # primary AND hedge
+    door.stop()
 
 
 def test_front_door_drain_503_carries_retry_after_and_stats_flag():
